@@ -1,0 +1,2 @@
+"""LightGBM-TPU: TPU-native gradient boosting framework."""
+__version__ = "0.1.0"

@@ -1,0 +1,255 @@
+"""Leaf-wise (best-first) tree grower as a single jitted program.
+
+TPU-native equivalent of SerialTreeLearner::Train
+(ref: src/treelearner/serial_tree_learner.cpp:183-249 main split loop,
+:344 BeforeFindBestSplit smaller/larger leaf logic, :770 SplitInner).
+
+Design (SURVEY.md §7 "hard parts"):
+- The reference's dynamic leaf membership (permuted index arrays in
+  DataPartition) becomes a per-row ``leaf_id`` vector updated by masked
+  `where` — XLA-friendly, no dynamic shapes.
+- The split loop is a `fori_loop` with exactly num_leaves-1 steps. A latched
+  ``done`` flag turns trailing steps into no-ops, so when step i proceeds,
+  the tree provably has i+1 leaves: node/new-leaf indices are static.
+- LightGBM's "build smaller child, subtract for the larger" trick
+  (serial_tree_learner.cpp:368-386 + FeatureHistogram::Subtract) is kept:
+  one masked full-row histogram pass per split for the smaller child; the
+  sibling comes from parent - smaller.
+- Distributed training reuses this exact program: `reduce_hist` /
+  `reduce_sums` hooks psum partial histograms over the mesh's data axis
+  (≡ DataParallelTreeLearner's ReduceScatter+sync, SURVEY §2.3).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.histogram import make_hist_fn
+from ..ops.split import (FeatureMeta, SplitHyperParams, SplitRecord,
+                         K_EPSILON, K_MIN_SCORE, best_split_for_leaf,
+                         calculate_splitted_leaf_output)
+from .tree import TreeArrays
+
+
+@dataclasses.dataclass(frozen=True)
+class GrowerConfig:
+    """Static knobs baked into the jitted grower."""
+    num_leaves: int = 31
+    max_depth: int = -1
+    num_bin: int = 256          # B: max bins over used features
+    hparams: SplitHyperParams = SplitHyperParams()
+    hist_backend: str = "xla"   # xla | scatter | pallas
+    block_rows: int = 4096
+
+
+class GrowState(NamedTuple):
+    leaf_id: jnp.ndarray        # i32 [R]
+    hist: jnp.ndarray           # f32 [L, F, B, 3]
+    # per-leaf stats
+    sum_g: jnp.ndarray          # f32 [L]
+    sum_h: jnp.ndarray          # f32 [L]
+    count: jnp.ndarray          # f32 [L]
+    value: jnp.ndarray          # f32 [L] current leaf output
+    depth: jnp.ndarray          # i32 [L]
+    parent_node: jnp.ndarray    # i32 [L] internal node owning this leaf's slot
+    is_right: jnp.ndarray       # bool [L]
+    best: SplitRecord           # [L] per-leaf best split
+    tree: TreeArrays
+    num_leaves: jnp.ndarray     # i32
+    done: jnp.ndarray           # bool
+
+
+def _set(arr, idx, val, cond):
+    """arr[idx] = val if cond (guarded functional update)."""
+    return arr.at[idx].set(jnp.where(cond, val, arr[idx]))
+
+
+def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
+                     reduce_hist: Optional[Callable] = None,
+                     reduce_sums: Optional[Callable] = None):
+    """Build the tree-growing function for a fixed dataset geometry.
+
+    Returns ``grow(bins_t, gh, feature_mask) -> (TreeArrays, leaf_id)`` where
+    ``bins_t`` is uint8/uint16 [F, R] and ``gh`` is f32 [R, 3] =
+    (grad*m, hess*m, m) with m the bagging/validity mask.
+    """
+    hp = cfg.hparams
+    L = cfg.num_leaves
+    B = cfg.num_bin
+    hist_fn = make_hist_fn(cfg.hist_backend, B, cfg.block_rows)
+    if reduce_hist is None:
+        reduce_hist = lambda h: h
+    if reduce_sums is None:
+        reduce_sums = lambda s: s
+
+    def leaf_hist(bins_t, gh, leaf_id, target_leaf):
+        mask = (leaf_id == target_leaf).astype(gh.dtype)
+        return reduce_hist(hist_fn(bins_t, gh * mask[:, None]))
+
+    def best_of(hist, sg, sh, cnt, parent_out, feature_mask):
+        return best_split_for_leaf(hist, sg, sh, cnt, parent_out, meta, hp,
+                                   feature_mask)
+
+    def grow(bins_t: jnp.ndarray, gh: jnp.ndarray,
+             feature_mask: Optional[jnp.ndarray] = None
+             ) -> Tuple[TreeArrays, jnp.ndarray]:
+        F, R = bins_t.shape
+
+        # ---- root (ref: LeafSplits::Init + first FindBestSplits) ----
+        sums = reduce_sums(gh.sum(axis=0))            # [3]
+        root_g, root_h, root_c = sums[0], sums[1], sums[2]
+        root_out = calculate_splitted_leaf_output(
+            root_g, root_h + 2 * K_EPSILON, hp, root_c, jnp.float32(0.0))
+        leaf_id0 = jnp.zeros(R, jnp.int32)
+        hist_root = reduce_hist(hist_fn(bins_t, gh))
+        best_root = best_of(hist_root, root_g, root_h, root_c, root_out,
+                            feature_mask)
+
+        hist_pool = jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(hist_root)
+        zf = jnp.zeros(L, jnp.float32)
+        zi = jnp.zeros(L, jnp.int32)
+        best0 = SplitRecord.invalid((L,))
+        best0 = jax.tree.map(lambda a, b: a.at[0].set(b), best0, best_root)
+
+        state = GrowState(
+            leaf_id=leaf_id0,
+            hist=hist_pool,
+            sum_g=zf.at[0].set(root_g),
+            sum_h=zf.at[0].set(root_h),
+            count=zf.at[0].set(root_c),
+            value=zf.at[0].set(root_out),
+            depth=zi,
+            parent_node=jnp.full(L, -1, jnp.int32),
+            is_right=jnp.zeros(L, bool),
+            best=best0,
+            tree=TreeArrays.empty(L),
+            num_leaves=jnp.asarray(1, jnp.int32),
+            done=jnp.asarray(False),
+        )
+
+        def body(i, state: GrowState) -> GrowState:
+            # ---- pick best leaf (ref: serial_tree_learner.cpp:229 ArgMax) --
+            exists = jnp.arange(L) < state.num_leaves
+            if cfg.max_depth > 0:
+                exists &= state.depth < cfg.max_depth
+            cand = jnp.where(exists, state.best.gain, K_MIN_SCORE)
+            l = jnp.argmax(cand).astype(jnp.int32)
+            gain = cand[l]
+            proceed = jnp.logical_and(~state.done, gain > 0.0)
+            done = ~proceed
+            new_leaf = i + 1  # static thanks to latched done
+
+            rec = jax.tree.map(lambda a: a[l], state.best)
+            t = state.tree
+
+            # ---- record split into tree arrays (ref: tree.cpp Tree::Split) --
+            t = t._replace(
+                split_feature=_set(t.split_feature, i, rec.feature, proceed),
+                threshold_bin=_set(t.threshold_bin, i, rec.threshold, proceed),
+                default_left=_set(t.default_left, i, rec.default_left, proceed),
+                split_gain=_set(t.split_gain, i, rec.gain, proceed),
+                internal_value=_set(t.internal_value, i, state.value[l], proceed),
+                internal_weight=_set(t.internal_weight, i, state.sum_h[l], proceed),
+                internal_count=_set(t.internal_count, i, state.count[l], proceed),
+                left_child=_set(t.left_child, i, -(l + 1), proceed),
+                right_child=_set(t.right_child, i, -(new_leaf + 1), proceed),
+            )
+            # fix-up the parent's child pointer that pointed at leaf l
+            p = state.parent_node[l]
+            p_safe = jnp.maximum(p, 0)
+            has_parent = proceed & (p >= 0)
+            t = t._replace(
+                left_child=_set(t.left_child, p_safe, i,
+                                has_parent & ~state.is_right[l]),
+                right_child=_set(t.right_child, p_safe, i,
+                                 has_parent & state.is_right[l]),
+                leaf_value=_set(_set(t.leaf_value, l, rec.left_output, proceed),
+                                new_leaf, rec.right_output, proceed),
+                leaf_weight=_set(_set(t.leaf_weight, l, rec.left_sum_hessian,
+                                      proceed),
+                                 new_leaf, rec.right_sum_hessian, proceed),
+                leaf_count=_set(_set(t.leaf_count, l, rec.left_count, proceed),
+                                new_leaf, rec.right_count, proceed),
+                leaf_parent=_set(_set(t.leaf_parent, l, i, proceed),
+                                 new_leaf, i, proceed),
+                num_leaves=jnp.where(proceed, new_leaf + 1, t.num_leaves),
+            )
+
+            # ---- partition rows (ref: dense_bin.hpp:317 SplitInner) --------
+            f = rec.feature
+            bin_col = jnp.take(bins_t, jnp.maximum(f, 0), axis=0).astype(jnp.int32)
+            nbin_f = meta.num_bin[f]
+            miss_f = meta.missing_type[f]
+            dflt_f = meta.default_bin[f]
+            go_left = bin_col <= rec.threshold
+            is_nan_bin = (miss_f == 2) & (bin_col == nbin_f - 1)
+            is_dflt_bin = (miss_f == 1) & (bin_col == dflt_f)
+            go_left = jnp.where(is_nan_bin | is_dflt_bin, rec.default_left,
+                                go_left)
+            in_leaf = state.leaf_id == l
+            leaf_id = jnp.where(proceed & in_leaf & ~go_left,
+                                new_leaf, state.leaf_id)
+
+            # ---- children stats --------------------------------------------
+            sum_g = _set(_set(state.sum_g, l, rec.left_sum_gradient, proceed),
+                         new_leaf, rec.right_sum_gradient, proceed)
+            sum_h = _set(_set(state.sum_h, l, rec.left_sum_hessian, proceed),
+                         new_leaf, rec.right_sum_hessian, proceed)
+            count = _set(_set(state.count, l, rec.left_count, proceed),
+                         new_leaf, rec.right_count, proceed)
+            value = _set(_set(state.value, l, rec.left_output, proceed),
+                         new_leaf, rec.right_output, proceed)
+            child_depth = state.depth[l] + 1
+            depth = _set(_set(state.depth, l, child_depth, proceed),
+                         new_leaf, child_depth, proceed)
+            parent_node = _set(_set(state.parent_node, l, i, proceed),
+                               new_leaf, i, proceed)
+            is_right = _set(_set(state.is_right, l, False, proceed),
+                            new_leaf, True, proceed)
+
+            # ---- children histograms: smaller pass + subtraction -----------
+            # (ref: serial_tree_learner.cpp:368-386 + FeatureHistogram::Subtract)
+            left_smaller = rec.left_count <= rec.right_count
+            small_leaf = jnp.where(left_smaller, l, new_leaf)
+            hist_small = lax.cond(
+                proceed,
+                lambda: leaf_hist(bins_t, gh, leaf_id, small_leaf),
+                lambda: jnp.zeros((F, B, 3), jnp.float32))
+            hist_parent = state.hist[l]
+            hist_large = hist_parent - hist_small
+            hist_left = jnp.where(left_smaller, hist_small, hist_large)
+            hist_right = jnp.where(left_smaller, hist_large, hist_small)
+            hist = state.hist.at[l].set(
+                jnp.where(proceed, hist_left, state.hist[l]))
+            hist = hist.at[new_leaf].set(
+                jnp.where(proceed, hist_right, hist[new_leaf]))
+
+            # ---- children best splits --------------------------------------
+            hists2 = jnp.stack([hist_left, hist_right])
+            sg2 = jnp.stack([rec.left_sum_gradient, rec.right_sum_gradient])
+            sh2 = jnp.stack([rec.left_sum_hessian, rec.right_sum_hessian])
+            cn2 = jnp.stack([rec.left_count, rec.right_count])
+            ou2 = jnp.stack([rec.left_output, rec.right_output])
+            best2 = jax.vmap(
+                lambda hh, a, b, c, d: best_of(hh, a, b, c, d, feature_mask)
+            )(hists2, sg2, sh2, cn2, ou2)
+            best = jax.tree.map(
+                lambda cur, nb: _set(_set(cur, l, nb[0], proceed),
+                                     new_leaf, nb[1], proceed),
+                state.best, best2)
+
+            return GrowState(
+                leaf_id=leaf_id, hist=hist, sum_g=sum_g, sum_h=sum_h,
+                count=count, value=value, depth=depth,
+                parent_node=parent_node, is_right=is_right, best=best,
+                tree=t, num_leaves=t.num_leaves, done=done | state.done)
+
+        state = lax.fori_loop(0, L - 1, body, state)
+        return state.tree, state.leaf_id
+
+    return grow

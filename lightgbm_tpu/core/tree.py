@@ -1,0 +1,148 @@
+"""Tree model arrays (structure-of-arrays, fixed capacity).
+
+TPU-native equivalent of the reference Tree object
+(ref: include/LightGBM/tree.h:27, src/io/tree.cpp). The reference stores
+per-node vectors that grow during training; here every tree is a pytree of
+fixed-size arrays (capacity = num_leaves), XLA-friendly and stackable across
+trees for batched prediction.
+
+Node numbering matches Tree::Split exactly so that the text format
+round-trips against the reference: splitting leaf ``l`` at step ``s`` creates
+internal node ``s``; the left child keeps leaf index ``l``, the right child
+becomes leaf ``s+1``; leaves are encoded in child pointers as ``~leaf_idx``
+(ref: tree.cpp Tree::Split, tree.h left_child_/right_child_ docs).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class TreeArrays(NamedTuple):
+    """One tree. Internal-node arrays have length L-1, leaf arrays L."""
+    # internal nodes
+    split_feature: jnp.ndarray    # i32 [L-1] inner (used-feature) index
+    threshold_bin: jnp.ndarray    # i32 [L-1]
+    default_left: jnp.ndarray     # bool [L-1]
+    left_child: jnp.ndarray       # i32 [L-1]; >=0 internal, <0 is ~leaf
+    right_child: jnp.ndarray      # i32 [L-1]
+    split_gain: jnp.ndarray       # f32 [L-1]
+    internal_value: jnp.ndarray   # f32 [L-1] node output (ref: internal_value_)
+    internal_weight: jnp.ndarray  # f32 [L-1] sum_hessian at node
+    internal_count: jnp.ndarray   # f32 [L-1]
+    # leaves
+    leaf_value: jnp.ndarray       # f32 [L]
+    leaf_weight: jnp.ndarray      # f32 [L] sum_hessian
+    leaf_count: jnp.ndarray       # f32 [L]
+    leaf_parent: jnp.ndarray      # i32 [L]
+    num_leaves: jnp.ndarray       # i32 scalar
+    shrinkage: jnp.ndarray        # f32 scalar
+
+    @staticmethod
+    def empty(max_leaves: int) -> "TreeArrays":
+        li = max_leaves - 1
+        return TreeArrays(
+            split_feature=jnp.zeros(li, jnp.int32),
+            threshold_bin=jnp.zeros(li, jnp.int32),
+            default_left=jnp.zeros(li, bool),
+            left_child=jnp.zeros(li, jnp.int32),
+            right_child=jnp.zeros(li, jnp.int32),
+            split_gain=jnp.zeros(li, jnp.float32),
+            internal_value=jnp.zeros(li, jnp.float32),
+            internal_weight=jnp.zeros(li, jnp.float32),
+            internal_count=jnp.zeros(li, jnp.float32),
+            leaf_value=jnp.zeros(max_leaves, jnp.float32),
+            leaf_weight=jnp.zeros(max_leaves, jnp.float32),
+            leaf_count=jnp.zeros(max_leaves, jnp.float32),
+            leaf_parent=jnp.full(max_leaves, -1, jnp.int32),
+            num_leaves=jnp.asarray(1, jnp.int32),
+            shrinkage=jnp.asarray(1.0, jnp.float32),
+        )
+
+    @property
+    def max_leaves(self) -> int:
+        return self.leaf_value.shape[0]
+
+
+class HostTree:
+    """Host-side (numpy) view of a trained tree, for model IO & prediction
+    bookkeeping. Thresholds are resolved to real values lazily via the
+    dataset's BinMappers (ref: Tree::threshold_ double values in model text).
+    """
+
+    def __init__(self, arrays: TreeArrays, used_feature_map: np.ndarray):
+        a = {f: np.asarray(getattr(arrays, f)) for f in arrays._fields}
+        self.num_leaves = int(a["num_leaves"])
+        n_int = max(self.num_leaves - 1, 0)
+        self.split_feature_inner = a["split_feature"][:n_int].astype(np.int32)
+        self.split_feature = (
+            used_feature_map[self.split_feature_inner]
+            if n_int else np.zeros(0, np.int32))
+        self.threshold_bin = a["threshold_bin"][:n_int]
+        self.default_left = a["default_left"][:n_int]
+        self.left_child = a["left_child"][:n_int]
+        self.right_child = a["right_child"][:n_int]
+        self.split_gain = a["split_gain"][:n_int].astype(np.float64)
+        self.internal_value = a["internal_value"][:n_int].astype(np.float64)
+        self.internal_weight = a["internal_weight"][:n_int].astype(np.float64)
+        self.internal_count = a["internal_count"][:n_int].astype(np.int64)
+        L = self.num_leaves
+        self.leaf_value = a["leaf_value"][:L].astype(np.float64)
+        self.leaf_weight = a["leaf_weight"][:L].astype(np.float64)
+        self.leaf_count = a["leaf_count"][:L].astype(np.int64)
+        self.leaf_parent = a["leaf_parent"][:L]
+        self.shrinkage = float(a["shrinkage"])
+        # filled by model IO
+        self.threshold_real: np.ndarray = np.zeros(n_int, np.float64)
+        self.decision_type: np.ndarray = np.zeros(n_int, np.int32)
+        self.is_linear = False
+        self.num_cat = 0
+
+    def shrink(self, rate: float) -> None:
+        """ref: tree.h Tree::Shrinkage."""
+        self.leaf_value = self.leaf_value * rate
+        self.internal_value = self.internal_value * rate
+        self.shrinkage *= rate
+
+    def add_output(self, delta: np.ndarray) -> None:
+        self.leaf_value = self.leaf_value + delta
+
+    def predict_leaf(self, X: np.ndarray) -> np.ndarray:
+        """Raw-feature traversal -> leaf index per row (host path; device
+        batched traversal lives in ops/predict.py)."""
+        n = X.shape[0]
+        out = np.zeros(n, dtype=np.int64)
+        if self.num_leaves == 1:
+            return out
+        node = np.zeros(n, dtype=np.int64)
+        active = np.ones(n, dtype=bool)
+        # decision_type bits (ref: tree.h kCategoricalMask=1, kDefaultLeftMask=2,
+        # missing type in bits 2-3)
+        for _ in range(self.num_leaves):  # depth bound
+            if not active.any():
+                break
+            f = self.split_feature[node]
+            thr = self.threshold_real[node]
+            dl = (self.decision_type[node] & 2) != 0
+            mtype = (self.decision_type[node] >> 2) & 3
+            x = X[np.arange(n), f]
+            isnan = np.isnan(x)
+            x0 = np.where(isnan, 0.0, x)
+            le = x0 <= thr
+            # missing handling: 0 none (NaN->0), 1 zero, 2 nan
+            miss = np.where(mtype == 2, isnan,
+                            (mtype == 1) & (np.abs(x0) <= 1e-35))
+            go_left = np.where(miss, dl, le)
+            child = np.where(go_left, self.left_child[node],
+                             self.right_child[node])
+            is_leaf = child < 0
+            upd = active & is_leaf
+            out[upd] = ~child[upd]
+            active = active & ~is_leaf
+            node = np.where(active, np.maximum(child, 0), node)
+        return out
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        return self.leaf_value[self.predict_leaf(X)]

@@ -1,0 +1,263 @@
+"""Binned dataset: feature-major bin matrix + metadata.
+
+TPU-native equivalent of the reference data layer
+(ref: include/LightGBM/dataset.h:492 Dataset, dataset.h:49 Metadata,
+src/io/dataset_loader.cpp:601 ConstructFromSampleData).
+
+Instead of the reference's Bin/FeatureGroup class zoo (dense/sparse bins, EFB
+bundles), the TPU representation is a single dense feature-major matrix
+``bins[num_used_features, num_data]`` of uint8/uint16 bin indices. Feature-major
+(transposed) layout keeps the row axis on TPU lanes, where it tiles well for
+the histogram kernels; sparse/EFB become packing strategies over this same
+array (SURVEY.md §7 arch sketch #1).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..config import Config
+from ..utils import log
+from .binning import (BIN_CATEGORICAL, BIN_NUMERICAL, MISSING_NAN,
+                      MISSING_NONE, MISSING_ZERO, BinMapper)
+
+
+class Metadata:
+    """label/weight/init_score/query storage (ref: dataset.h:49)."""
+
+    def __init__(self, num_data: int):
+        self.num_data = num_data
+        self.label: Optional[np.ndarray] = None          # f32 [N]
+        self.weight: Optional[np.ndarray] = None         # f32 [N]
+        self.init_score: Optional[np.ndarray] = None     # f64 [N * num_class]
+        self.query_boundaries: Optional[np.ndarray] = None  # i32 [num_queries+1]
+        self.position: Optional[np.ndarray] = None       # i32 [N]
+
+    def set_label(self, label: Sequence[float]) -> None:
+        label = np.ascontiguousarray(label, dtype=np.float32).reshape(-1)
+        if len(label) != self.num_data:
+            log.fatal(f"Length of label ({len(label)}) != num_data ({self.num_data})")
+        self.label = label
+
+    def set_weight(self, weight: Optional[Sequence[float]]) -> None:
+        if weight is None:
+            self.weight = None
+            return
+        weight = np.ascontiguousarray(weight, dtype=np.float32).reshape(-1)
+        if len(weight) != self.num_data:
+            log.fatal(f"Length of weight ({len(weight)}) != num_data ({self.num_data})")
+        self.weight = weight
+
+    def set_init_score(self, init_score: Optional[Sequence[float]]) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        init_score = np.ascontiguousarray(init_score, dtype=np.float64).reshape(-1)
+        if len(init_score) % self.num_data != 0:
+            log.fatal("Length of init_score must be a multiple of num_data")
+        self.init_score = init_score
+
+    def set_query(self, group: Optional[Sequence[int]]) -> None:
+        """Set query/group sizes; stored as boundaries (ref: metadata.cpp SetQuery)."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        group = np.ascontiguousarray(group, dtype=np.int64).reshape(-1)
+        boundaries = np.zeros(len(group) + 1, dtype=np.int64)
+        np.cumsum(group, out=boundaries[1:])
+        if boundaries[-1] != self.num_data:
+            log.fatal(f"Sum of query counts ({boundaries[-1]}) != num_data "
+                      f"({self.num_data})")
+        self.query_boundaries = boundaries.astype(np.int32)
+
+    def set_position(self, position: Optional[Sequence[int]]) -> None:
+        if position is None:
+            self.position = None
+            return
+        position = np.ascontiguousarray(position, dtype=np.int32).reshape(-1)
+        if len(position) != self.num_data:
+            log.fatal("Length of position != num_data")
+        self.position = position
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+
+class BinnedDataset:
+    """Quantized training data (the device-facing product of loading).
+
+    Attributes
+    ----------
+    bins : np.ndarray uint8/uint16 [num_used_features, num_data]
+        Feature-major bin indices. Trivial (constant / pre-filtered) features
+        are excluded.
+    bin_mappers : per ORIGINAL feature BinMapper (len == num_total_features).
+    used_feature_map : original feature index for each row of ``bins``.
+    """
+
+    def __init__(self) -> None:
+        self.bins: Optional[np.ndarray] = None
+        self.bin_mappers: List[BinMapper] = []
+        self.used_feature_map: np.ndarray = np.zeros(0, dtype=np.int32)
+        self.num_data: int = 0
+        self.num_total_features: int = 0
+        self.metadata: Optional[Metadata] = None
+        self.feature_names: List[str] = []
+        self.max_bin: int = 255
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_matrix(cls, data: np.ndarray, config: Config,
+                    label: Optional[Sequence[float]] = None,
+                    weight: Optional[Sequence[float]] = None,
+                    group: Optional[Sequence[int]] = None,
+                    init_score: Optional[Sequence[float]] = None,
+                    position: Optional[Sequence[int]] = None,
+                    feature_names: Optional[List[str]] = None,
+                    categorical_features: Sequence[int] = (),
+                    reference: Optional["BinnedDataset"] = None,
+                    ) -> "BinnedDataset":
+        """Build from a dense [N, F] float matrix.
+
+        (ref: DatasetLoader::ConstructFromSampleData dataset_loader.cpp:601;
+        validation sets reuse the reference's BinMappers like
+        Dataset::CreateValid.)
+        """
+        data = np.asarray(data)
+        if data.ndim != 2:
+            log.fatal("data must be 2-dimensional")
+        num_data, num_features = data.shape
+        self = cls()
+        self.num_data = num_data
+        self.num_total_features = num_features
+        self.max_bin = config.max_bin
+        self.feature_names = (list(feature_names) if feature_names
+                              else [f"Column_{i}" for i in range(num_features)])
+
+        if reference is not None:
+            # align to reference's bin mappers (validation data path)
+            self.bin_mappers = reference.bin_mappers
+            self.used_feature_map = reference.used_feature_map
+            self.max_bin = reference.max_bin
+            self.feature_names = reference.feature_names
+        else:
+            self.bin_mappers = cls._find_bin_mappers(
+                data, config, categorical_features)
+            self.used_feature_map = np.asarray(
+                [i for i, m in enumerate(self.bin_mappers) if not m.is_trivial],
+                dtype=np.int32)
+
+        # quantize: feature-major u8/u16 matrix
+        n_used = len(self.used_feature_map)
+        max_num_bin = max((self.bin_mappers[i].num_bin
+                           for i in self.used_feature_map), default=2)
+        dtype = np.uint8 if max_num_bin <= 256 else np.uint16
+        bins = np.empty((n_used, num_data), dtype=dtype)
+        col = np.empty(num_data, dtype=np.float64)
+        for out_i, feat_i in enumerate(self.used_feature_map):
+            np.copyto(col, data[:, feat_i])
+            bins[out_i] = self.bin_mappers[feat_i].value_to_bin(col)
+        self.bins = bins
+
+        meta = Metadata(num_data)
+        if label is not None:
+            meta.set_label(label)
+        meta.set_weight(weight)
+        meta.set_query(group)
+        meta.set_init_score(init_score)
+        meta.set_position(position)
+        self.metadata = meta
+        return self
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _find_bin_mappers(data: np.ndarray, config: Config,
+                          categorical_features: Sequence[int],
+                          sample_indices: Optional[np.ndarray] = None,
+                          ) -> List[BinMapper]:
+        """Sample rows and find per-feature bin boundaries
+        (ref: dataset_loader.cpp:1080 ConstructBinMappersFromTextData)."""
+        num_data, num_features = data.shape
+        sample_cnt = min(config.bin_construct_sample_cnt, num_data)
+        if sample_indices is None:
+            if sample_cnt < num_data:
+                rng = np.random.default_rng(config.data_random_seed)
+                sample_indices = np.sort(rng.choice(num_data, size=sample_cnt,
+                                                    replace=False))
+            else:
+                sample_indices = np.arange(num_data)
+        sample = np.asarray(data[sample_indices], dtype=np.float64)
+        cat_set = set(int(c) for c in categorical_features)
+
+        # pre-filter needs the split constraint (ref: dataset_loader.cpp
+        # filter_cnt computation)
+        filter_cnt = int(max(
+            config.min_data_in_leaf * len(sample_indices) / max(num_data, 1),
+            config.min_data_in_bin))
+
+        mappers: List[BinMapper] = []
+        max_bin_by_feature = config.max_bin_by_feature
+        for f in range(num_features):
+            col = sample[:, f]
+            bin_type = BIN_CATEGORICAL if f in cat_set else BIN_NUMERICAL
+            mb = (max_bin_by_feature[f] if f < len(max_bin_by_feature)
+                  else config.max_bin)
+            mappers.append(BinMapper.find_bin(
+                col, len(sample_indices), mb, config.min_data_in_bin,
+                filter_cnt, pre_filter=config.feature_pre_filter,
+                bin_type=bin_type, use_missing=config.use_missing,
+                zero_as_missing=config.zero_as_missing))
+        n_trivial = sum(m.is_trivial for m in mappers)
+        if n_trivial:
+            log.info(f"{n_trivial} trivial feature(s) removed")
+        return mappers
+
+    # ------------------------------------------------------------------
+    @property
+    def num_used_features(self) -> int:
+        return len(self.used_feature_map)
+
+    def used_bin_mappers(self) -> List[BinMapper]:
+        return [self.bin_mappers[i] for i in self.used_feature_map]
+
+    def num_bins_per_feature(self) -> np.ndarray:
+        return np.asarray([self.bin_mappers[i].num_bin
+                           for i in self.used_feature_map], dtype=np.int32)
+
+    def feature_infos(self) -> List[str]:
+        return [m.feature_info() for m in self.bin_mappers]
+
+    def subset(self, row_indices: np.ndarray) -> "BinnedDataset":
+        """Row-subset copy (ref: Dataset::CopySubrow) — used by cv()."""
+        out = BinnedDataset()
+        out.bins = self.bins[:, row_indices] if self.bins is not None else None
+        out.bin_mappers = self.bin_mappers
+        out.used_feature_map = self.used_feature_map
+        out.num_data = len(row_indices)
+        out.num_total_features = self.num_total_features
+        out.feature_names = self.feature_names
+        out.max_bin = self.max_bin
+        meta = Metadata(out.num_data)
+        src = self.metadata
+        if src is not None:
+            if src.label is not None:
+                meta.label = src.label[row_indices]
+            if src.weight is not None:
+                meta.weight = src.weight[row_indices]
+            if src.init_score is not None:
+                ncol = len(src.init_score) // src.num_data
+                meta.init_score = src.init_score.reshape(
+                    ncol, src.num_data)[:, row_indices].reshape(-1)
+            if src.query_boundaries is not None:
+                # subset must respect query boundaries; recompute from
+                # per-row query ids
+                qid = np.searchsorted(src.query_boundaries, np.arange(src.num_data),
+                                      side="right") - 1
+                sub_qid = qid[row_indices]
+                # rows of one query must stay adjacent for ranking
+                _, counts = np.unique(sub_qid, return_counts=True)
+                meta.set_query(counts)
+        out.metadata = meta
+        return out

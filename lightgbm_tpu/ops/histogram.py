@@ -1,0 +1,101 @@
+"""Histogram construction: the hottest op in GBDT training.
+
+TPU-native equivalent of Bin::ConstructHistogram /
+MultiValBinWrapper::ConstructHistograms (ref: include/LightGBM/bin.h:351-422,
+src/io/dense_bin.hpp, src/treelearner/cuda/cuda_histogram_constructor.cu:21).
+
+The reference scatter-adds (grad, hess) into per-feature bin arrays. TPUs have
+no fast generic scatter, so the kernel is reformulated as a matmul against an
+in-register one-hot expansion of the bin indices — the MXU-friendly shape
+(SURVEY.md §7 kernels (a)):
+
+    hist[c, f*B + b] = sum_r gh[c, r] * onehot(bins[r, f] == b)
+
+i.e. a [C, R_blk] @ [R_blk, F*B] matmul per row block, accumulated in f32.
+Leaf membership enters as a mask multiplied into gh — histogram of a leaf is a
+full pass with rows of other leaves zeroed (LightGBM's O(rows_in_leaf) via
+index partitioning is recovered later through block-skip scheduling; the
+sibling subtraction trick halves the passes either way, see grower.py).
+
+Two implementations:
+- ``hist_xla``: lax.scan over row blocks of an einsum — portable baseline.
+- ``hist_pallas`` (ops/hist_pallas.py): the Pallas TPU kernel.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def hist_xla(bins_t: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
+             block_rows: int = 4096) -> jnp.ndarray:
+    """Histogram via blocked one-hot einsum.
+
+    Parameters
+    ----------
+    bins_t : uint8/uint16/int32 [F, R] feature-major bin indices.
+    gh : f32 [R, C] per-row values to accumulate (pre-masked: typically
+        (grad*m, hess*m, m) so channel 2 yields exact in-leaf counts).
+    num_bin : static B (max bins over features).
+    block_rows : rows per scan step; R must be divisible (pad upstream).
+
+    Returns f32 [F, num_bin, C].
+    """
+    F, R = bins_t.shape
+    C = gh.shape[1]
+    iota = jnp.arange(num_bin, dtype=jnp.int32)
+
+    def block_hist(bb, gb):
+        onehot = (bb[:, :, None] == iota).astype(jnp.float32)  # [F, rb, B]
+        # HIGHEST keeps true-f32 accumulation on the MXU (the one-hot side is
+        # exact in bf16 but gradients are not)
+        return jnp.einsum("frb,rc->fbc", onehot, gb,
+                          precision=lax.Precision.HIGHEST,
+                          preferred_element_type=jnp.float32)
+
+    nb = R // block_rows
+    main = nb * block_rows
+    acc = jnp.zeros((F, num_bin, C), jnp.float32)
+    if nb > 0:
+        bins_blk = bins_t[:, :main].reshape(F, nb, block_rows).transpose(1, 0, 2)
+        gh_blk = gh[:main].reshape(nb, block_rows, C)
+
+        def body(a, inp):
+            bb, gb = inp                              # [F, rb], [rb, C]
+            return a + block_hist(bb, gb), None
+
+        acc, _ = lax.scan(body, acc, (bins_blk, gh_blk))
+    if main < R:  # ragged tail block
+        acc = acc + block_hist(bins_t[:, main:], gh[main:])
+    return acc
+
+
+def hist_scatter(bins_t: jnp.ndarray, gh: jnp.ndarray,
+                 num_bin: int) -> jnp.ndarray:
+    """Histogram via scatter-add. Fastest on CPU backend (tests), slow on TPU."""
+    F, R = bins_t.shape
+    C = gh.shape[1]
+    out = jnp.zeros((F, num_bin, C), jnp.float32)
+    f_idx = jnp.broadcast_to(jnp.arange(F, dtype=jnp.int32)[:, None], (F, R))
+    b_idx = bins_t.astype(jnp.int32)
+    vals = jnp.broadcast_to(gh.T[None, :, :], (F, C, R)).transpose(0, 2, 1)
+    return out.at[f_idx.reshape(-1), b_idx.reshape(-1)].add(
+        vals.reshape(F * R, C))
+
+
+def make_hist_fn(backend: str, num_bin: int, block_rows: int = 4096):
+    """Select histogram implementation by backend name."""
+    if backend == "scatter":
+        return functools.partial(hist_scatter, num_bin=num_bin)
+    if backend == "xla":
+        return functools.partial(hist_xla, num_bin=num_bin,
+                                 block_rows=block_rows)
+    if backend == "pallas":
+        from .hist_pallas import hist_pallas
+        return functools.partial(hist_pallas, num_bin=num_bin,
+                                 block_rows=block_rows)
+    raise ValueError(f"unknown histogram backend {backend}")

@@ -1,0 +1,309 @@
+"""Vectorized best-split search over feature histograms.
+
+TPU-native equivalent of FeatureHistogram::FindBestThreshold
+(ref: src/treelearner/feature_histogram.hpp:166 FindBestThreshold,
+:838 FindBestThresholdSequentially, :712-830 gain/output formulas).
+
+Where the reference scans each feature's bins sequentially per direction, here
+both directions for ALL features are evaluated at once as cumulative sums over
+the [F, B] histogram — an XLA-friendly formulation of the same math:
+
+- REVERSE scan (missing goes left, default_left=True): suffix sums.
+- FORWARD scan (missing goes right, default_left=False): prefix sums.
+- MissingType::None  -> reverse scan only (single direction suffices).
+- MissingType::Zero  -> both scans, default bin skipped (its rows follow the
+  default direction).
+- MissingType::NaN   -> both scans, NaN bin (last) pinned to the default side.
+
+Tie-breaking matches the reference exactly: within the reverse scan ties pick
+the LARGER threshold (first-seen in a high-to-low scan); within forward the
+SMALLER; forward replaces reverse only on strictly greater gain; across
+features the smaller feature index wins (SplitInfo::operator> semantics,
+split_info.hpp:22).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ref: include/LightGBM/meta.h:51-57
+K_EPSILON = 1e-15
+K_MIN_SCORE = -np.inf
+
+MISSING_ENUM = {"none": 0, "zero": 1, "nan": 2}
+
+
+@dataclasses.dataclass(frozen=True)
+class SplitHyperParams:
+    """Static split-quality knobs (subset of Config that the scan reads)."""
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    max_delta_step: float = 0.0
+    path_smooth: float = 0.0
+
+    @property
+    def use_l1(self) -> bool:
+        return self.lambda_l1 > 0.0
+
+    @property
+    def use_smoothing(self) -> bool:
+        return self.path_smooth > K_EPSILON
+
+
+class FeatureMeta(NamedTuple):
+    """Per-used-feature static metadata as device arrays [F]."""
+    num_bin: jnp.ndarray       # i32
+    missing_type: jnp.ndarray  # i32 enum per MISSING_ENUM
+    default_bin: jnp.ndarray   # i32
+    is_categorical: jnp.ndarray  # bool
+
+    @staticmethod
+    def from_mappers(mappers) -> "FeatureMeta":
+        return FeatureMeta(
+            num_bin=jnp.asarray([m.num_bin for m in mappers], jnp.int32),
+            missing_type=jnp.asarray(
+                [MISSING_ENUM[m.missing_type] for m in mappers], jnp.int32),
+            default_bin=jnp.asarray([m.default_bin for m in mappers], jnp.int32),
+            is_categorical=jnp.asarray(
+                [m.bin_type == "categorical" for m in mappers], bool),
+        )
+
+
+class SplitRecord(NamedTuple):
+    """Best split candidate (ref: split_info.hpp:22 SplitInfo). All leading
+    axes broadcast; scalar per leaf in the grower."""
+    gain: jnp.ndarray          # f32; kMinScore when invalid
+    feature: jnp.ndarray       # i32 inner (used-feature) index; -1 invalid
+    threshold: jnp.ndarray     # i32 bin threshold (left: bin <= threshold)
+    default_left: jnp.ndarray  # bool
+    left_sum_gradient: jnp.ndarray
+    left_sum_hessian: jnp.ndarray
+    left_count: jnp.ndarray    # f32 (exact counts accumulated as floats)
+    left_output: jnp.ndarray
+    right_sum_gradient: jnp.ndarray
+    right_sum_hessian: jnp.ndarray
+    right_count: jnp.ndarray
+    right_output: jnp.ndarray
+
+    @staticmethod
+    def invalid(shape=(), dtype=jnp.float32) -> "SplitRecord":
+        f = lambda v: jnp.full(shape, v, dtype)
+        i = lambda v: jnp.full(shape, v, jnp.int32)
+        return SplitRecord(
+            gain=f(K_MIN_SCORE), feature=i(-1), threshold=i(0),
+            default_left=jnp.full(shape, True),
+            left_sum_gradient=f(0), left_sum_hessian=f(0), left_count=f(0),
+            left_output=f(0), right_sum_gradient=f(0), right_sum_hessian=f(0),
+            right_count=f(0), right_output=f(0))
+
+
+# ---------------------------------------------------------------------------
+# Gain math (ref: feature_histogram.hpp:712-830)
+# ---------------------------------------------------------------------------
+
+def threshold_l1(s, l1):
+    """ref: feature_histogram.hpp:712 ThresholdL1."""
+    return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
+
+
+def calculate_splitted_leaf_output(sum_g, sum_h, hp: SplitHyperParams,
+                                   num_data=None, parent_output=None):
+    """ref: feature_histogram.hpp:718 CalculateSplittedLeafOutput."""
+    if hp.use_l1:
+        ret = -threshold_l1(sum_g, hp.lambda_l1) / (sum_h + hp.lambda_l2)
+    else:
+        ret = -sum_g / (sum_h + hp.lambda_l2)
+    if hp.max_delta_step > 0.0:
+        ret = jnp.clip(ret, -hp.max_delta_step, hp.max_delta_step)
+    if hp.use_smoothing:
+        n_over_s = num_data / hp.path_smooth
+        ret = ret * n_over_s / (n_over_s + 1.0) + parent_output / (n_over_s + 1.0)
+    return ret
+
+
+def leaf_gain_given_output(sum_g, sum_h, hp: SplitHyperParams, output):
+    """ref: feature_histogram.hpp:819 GetLeafGainGivenOutput."""
+    sg = threshold_l1(sum_g, hp.lambda_l1) if hp.use_l1 else sum_g
+    return -(2.0 * sg * output + (sum_h + hp.lambda_l2) * output * output)
+
+
+def leaf_gain(sum_g, sum_h, hp: SplitHyperParams, num_data=None,
+              parent_output=None):
+    """ref: feature_histogram.hpp:801 GetLeafGain."""
+    if hp.max_delta_step <= 0.0 and not hp.use_smoothing:
+        sg = threshold_l1(sum_g, hp.lambda_l1) if hp.use_l1 else sum_g
+        return (sg * sg) / (sum_h + hp.lambda_l2)
+    output = calculate_splitted_leaf_output(sum_g, sum_h, hp, num_data,
+                                            parent_output)
+    return leaf_gain_given_output(sum_g, sum_h, hp, output)
+
+
+def split_gain(lg, lh, rg, rh, hp: SplitHyperParams, lcnt=None, rcnt=None,
+               parent_output=None):
+    """ref: feature_histogram.hpp:760 GetSplitGains (no monotone constraints)."""
+    return (leaf_gain(lg, lh, hp, lcnt, parent_output) +
+            leaf_gain(rg, rh, hp, rcnt, parent_output))
+
+
+# ---------------------------------------------------------------------------
+# The vectorized two-direction scan
+# ---------------------------------------------------------------------------
+
+def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
+                        num_data, parent_output, meta: FeatureMeta,
+                        hp: SplitHyperParams,
+                        feature_mask: jnp.ndarray = None) -> SplitRecord:
+    """Find the best split over all features for one leaf.
+
+    Parameters
+    ----------
+    hist : f32 [F, B, 3]  (sum_grad, sum_hess, count) per feature per bin.
+    sum_gradient, sum_hessian, num_data : scalar leaf totals (count as f32).
+    parent_output : scalar current leaf output (for path smoothing).
+    feature_mask : optional bool [F] — feature_fraction / interaction
+        constraints (ref: col_sampler.hpp).
+
+    Returns a scalar-per-field SplitRecord.
+
+    The arithmetic mirrors FindBestThresholdSequentially with the kEpsilon
+    seeding: accumulating side starts at kEpsilon, parent hessian has +2eps
+    (ref: feature_histogram.hpp:172 FindBestThreshold call site).
+    """
+    F, B, _ = hist.shape
+    g = hist[:, :, 0]
+    h = hist[:, :, 1]
+    c = hist[:, :, 2]
+
+    sum_hessian = sum_hessian + 2 * K_EPSILON
+    num_data_f = jnp.asarray(num_data, jnp.float32)
+
+    bin_idx = jnp.arange(B, dtype=jnp.int32)[None, :]          # [1, B]
+    nbin = meta.num_bin[:, None]                               # [F, 1]
+    miss = meta.missing_type[:, None]
+    dflt = meta.default_bin[:, None]
+
+    multi_bin = nbin > 2
+    run_forward = multi_bin & (miss != MISSING_ENUM["none"])
+    skip_default = multi_bin & (miss == MISSING_ENUM["zero"])
+    na_as_missing = multi_bin & (miss == MISSING_ENUM["nan"])
+    # num_bin<=2 && missing==nan: reverse-only scan reports default_left=False
+    # (ref: feature_histogram.hpp:431-441)
+    dl_false = (~multi_bin) & (miss == MISSING_ENUM["nan"])
+
+    in_range = bin_idx < nbin
+    acc_mask = in_range & ~(skip_default & (bin_idx == dflt))
+
+    min_gain_shift = (leaf_gain(sum_gradient, sum_hessian, hp, num_data_f,
+                                parent_output) + hp.min_gain_to_split)
+
+    def side_stats(acc_g, acc_h, acc_c):
+        """Complement side via subtraction from parent totals."""
+        other_g = sum_gradient - acc_g
+        other_h = sum_hessian - acc_h
+        other_c = num_data_f - acc_c
+        return other_g, other_h, other_c
+
+    def gains_and_validity(lg, lh, lc, rg, rh, rc):
+        valid = ((lc >= hp.min_data_in_leaf) &
+                 (rc >= hp.min_data_in_leaf) &
+                 (lh >= hp.min_sum_hessian_in_leaf) &
+                 (rh >= hp.min_sum_hessian_in_leaf))
+        gains = split_gain(lg, lh, rg, rh, hp, lc, rc, parent_output)
+        gains = jnp.where(jnp.isnan(gains), K_MIN_SCORE, gains)
+        valid = valid & (gains > min_gain_shift)
+        return gains, valid
+
+    # ---------------- REVERSE scan: right side accumulates hi..t -----------
+    # hi = num_bin-1 - (1 if na_as_missing): NaN bin excluded => goes left.
+    hi = nbin - 1 - na_as_missing.astype(jnp.int32)
+    rev_mask = (acc_mask & (bin_idx <= hi)).astype(hist.dtype)
+    # suffix sums: rg_acc[t] = sum_{b>=t} masked
+    def suffix(x, m):
+        xm = x * m
+        return jnp.cumsum(xm[:, ::-1], axis=1)[:, ::-1]
+    rg_acc = suffix(g, rev_mask)
+    rh_acc = suffix(h, rev_mask) + K_EPSILON
+    rc_acc = suffix(c, rev_mask)
+    # candidate threshold thr means right side accumulates bins >= thr+1
+    # shift left by one: right_at_thr[t] = acc[t+1]
+    pad = jnp.zeros((F, 1), hist.dtype)
+    rg_thr = jnp.concatenate([rg_acc[:, 1:], pad], axis=1)
+    rh_thr = jnp.concatenate([rh_acc[:, 1:], pad + K_EPSILON], axis=1)
+    rc_thr = jnp.concatenate([rc_acc[:, 1:], pad], axis=1)
+    lg_rev, lh_rev, lc_rev = side_stats(rg_thr, rh_thr, rc_thr)
+    gains_rev, valid_rev = gains_and_validity(lg_rev, lh_rev, lc_rev,
+                                              rg_thr, rh_thr, rc_thr)
+    # thresholds evaluated by the reverse loop: thr in [0, hi-1]
+    thr_ok_rev = (bin_idx <= hi - 1) & (bin_idx >= 0) & in_range
+    # skip-default applies to the *iteration* t=thr+1 in the reference loop
+    thr_ok_rev &= ~(skip_default & ((bin_idx + 1) == dflt))
+    gains_rev = jnp.where(valid_rev & thr_ok_rev, gains_rev, K_MIN_SCORE)
+
+    # ---------------- FORWARD scan: left side accumulates 0..t -------------
+    fwd_mask = (acc_mask & (bin_idx <= nbin - 2)).astype(hist.dtype)
+    lg_acc = jnp.cumsum(g * fwd_mask, axis=1)
+    lh_acc = jnp.cumsum(h * fwd_mask, axis=1) + K_EPSILON
+    lc_acc = jnp.cumsum(c * fwd_mask, axis=1)
+    rg_fwd, rh_fwd, rc_fwd = side_stats(lg_acc, lh_acc, lc_acc)
+    gains_fwd, valid_fwd = gains_and_validity(lg_acc, lh_acc, lc_acc,
+                                              rg_fwd, rh_fwd, rc_fwd)
+    thr_ok_fwd = (bin_idx <= nbin - 2) & in_range & run_forward
+    thr_ok_fwd &= ~(skip_default & (bin_idx == dflt))
+    gains_fwd = jnp.where(valid_fwd & thr_ok_fwd, gains_fwd, K_MIN_SCORE)
+
+    # ---------------- per-feature best, then across features ---------------
+    # reverse ties -> larger threshold (first seen high-to-low)
+    rev_best_t = (B - 1) - jnp.argmax(gains_rev[:, ::-1], axis=1)
+    rev_best_gain = jnp.take_along_axis(gains_rev, rev_best_t[:, None],
+                                        axis=1)[:, 0]
+    # forward ties -> smaller threshold
+    fwd_best_t = jnp.argmax(gains_fwd, axis=1)
+    fwd_best_gain = jnp.take_along_axis(gains_fwd, fwd_best_t[:, None],
+                                        axis=1)[:, 0]
+    # forward replaces reverse only on strictly greater gain
+    use_fwd = fwd_best_gain > rev_best_gain
+    best_t = jnp.where(use_fwd, fwd_best_t, rev_best_t).astype(jnp.int32)
+    best_gain = jnp.where(use_fwd, fwd_best_gain, rev_best_gain)
+    best_dl = jnp.where(use_fwd, False, ~dl_false[:, 0])
+
+    take = lambda a, idx: jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+    blg = jnp.where(use_fwd, take(lg_acc, best_t), take(lg_rev, best_t))
+    blh = jnp.where(use_fwd, take(lh_acc, best_t), take(lh_rev, best_t))
+    blc = jnp.where(use_fwd, take(lc_acc, best_t), take(lc_rev, best_t))
+    brg = jnp.where(use_fwd, take(rg_fwd, best_t), take(rg_thr, best_t))
+    brh = jnp.where(use_fwd, take(rh_fwd, best_t), take(rh_thr, best_t))
+    brc = jnp.where(use_fwd, take(rc_fwd, best_t), take(rc_thr, best_t))
+
+    if feature_mask is not None:
+        best_gain = jnp.where(feature_mask, best_gain, K_MIN_SCORE)
+
+    best_f = jnp.argmax(best_gain).astype(jnp.int32)  # ties -> smaller index
+    sel = lambda a: a[best_f]
+    gain_out = sel(best_gain) - min_gain_shift
+    lout = calculate_splitted_leaf_output(sel(blg), sel(blh), hp, sel(blc),
+                                          parent_output)
+    rout = calculate_splitted_leaf_output(sel(brg), sel(brh), hp, sel(brc),
+                                          parent_output)
+    has_valid = sel(best_gain) > K_MIN_SCORE
+
+    return SplitRecord(
+        gain=jnp.where(has_valid, gain_out, K_MIN_SCORE),
+        feature=jnp.where(has_valid, best_f, -1).astype(jnp.int32),
+        threshold=sel(best_t),
+        default_left=sel(best_dl),
+        left_sum_gradient=sel(blg),
+        left_sum_hessian=sel(blh) - K_EPSILON,
+        left_count=sel(blc),
+        left_output=lout,
+        right_sum_gradient=sel(brg),
+        right_sum_hessian=sel(brh) - K_EPSILON,
+        right_count=sel(brc),
+        right_output=rout,
+    )

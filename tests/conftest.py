@@ -1,0 +1,33 @@
+"""Test configuration: force JAX onto CPU with 8 virtual devices so the
+multi-device sharding paths run without TPU hardware (mirrors the reference's
+DistributedMockup which exercises the real socket stack on localhost,
+ref: tests/distributed/_test_distributed.py).
+
+Environment notes (hard-won):
+- This image boots an 'axon' TPU-tunnel JAX plugin from sitecustomize which
+  force-sets JAX_PLATFORMS=axon and initializes eagerly on first backend use;
+  if the tunnel is busy/wedged, ANY jax backend init hangs. The reliable
+  opt-out after interpreter boot is ``jax.config.update('jax_platforms',
+  'cpu')`` — env vars are too late (jax is already imported at boot).
+- XLA_FLAGS must be set before the CPU client initializes (i.e., before the
+  first jax operation), which conftest import-time guarantees.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+_test_platform = os.environ.get("LGBM_TPU_TEST_DEVICE", "cpu")
+jax.config.update("jax_platforms", _test_platform)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

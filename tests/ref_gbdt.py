@@ -1,0 +1,303 @@
+"""Independent pure-numpy reference of the LightGBM split search + leaf-wise
+growth, used as the parity oracle for the JAX grower.
+
+Deliberately written as literal sequential loops mirroring the reference C++
+(ref: src/treelearner/feature_histogram.hpp:838 FindBestThresholdSequentially,
+serial_tree_learner.cpp:183 Train) — a different code path from
+lightgbm_tpu/ops/split.py so shared bugs are unlikely.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+K_EPSILON = 1e-15
+K_MIN_SCORE = -np.inf
+
+
+@dataclasses.dataclass
+class HP:
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    max_delta_step: float = 0.0
+    path_smooth: float = 0.0
+    num_leaves: int = 31
+    max_depth: int = -1
+
+
+def _thr_l1(s, l1):
+    return np.sign(s) * max(abs(s) - l1, 0.0)
+
+
+def _leaf_output(sg, sh, hp: HP, n=0.0, parent=0.0):
+    if hp.lambda_l1 > 0:
+        ret = -_thr_l1(sg, hp.lambda_l1) / (sh + hp.lambda_l2)
+    else:
+        ret = -sg / (sh + hp.lambda_l2)
+    if hp.max_delta_step > 0 and abs(ret) > hp.max_delta_step:
+        ret = np.sign(ret) * hp.max_delta_step
+    if hp.path_smooth > K_EPSILON:
+        ns = n / hp.path_smooth
+        ret = ret * ns / (ns + 1) + parent / (ns + 1)
+    return ret
+
+
+def _leaf_gain(sg, sh, hp: HP, n=0.0, parent=0.0):
+    if hp.max_delta_step <= 0 and hp.path_smooth <= K_EPSILON:
+        s = _thr_l1(sg, hp.lambda_l1) if hp.lambda_l1 > 0 else sg
+        return s * s / (sh + hp.lambda_l2)
+    out = _leaf_output(sg, sh, hp, n, parent)
+    s = _thr_l1(sg, hp.lambda_l1) if hp.lambda_l1 > 0 else sg
+    return -(2.0 * s * out + (sh + hp.lambda_l2) * out * out)
+
+
+@dataclasses.dataclass
+class RefSplit:
+    gain: float = K_MIN_SCORE
+    feature: int = -1
+    threshold: int = 0
+    default_left: bool = True
+    lg: float = 0.0
+    lh: float = 0.0
+    lc: float = 0.0
+    lout: float = 0.0
+    rg: float = 0.0
+    rh: float = 0.0
+    rc: float = 0.0
+    rout: float = 0.0
+
+
+def _scan_one_dir(g, h, c, num_bin, sum_g, sum_h, num_data, parent_out,
+                  hp: HP, reverse: bool, skip_default: bool,
+                  na_as_missing: bool, default_bin: int, min_gain_shift: float
+                  ) -> Tuple[float, int, float, float, float]:
+    """One direction of FindBestThresholdSequentially. Returns
+    (best_gain, best_threshold, best_lg, best_lh, best_lc)."""
+    best_gain = K_MIN_SCORE
+    best_t = num_bin
+    best_lg = best_lh = best_lc = 0.0
+    if reverse:
+        acc_g, acc_h, acc_c = 0.0, K_EPSILON, 0.0
+        t_start = num_bin - 1 - (1 if na_as_missing else 0)
+        for t in range(t_start, 0, -1):
+            if skip_default and t == default_bin:
+                continue
+            acc_g += g[t]
+            acc_h += h[t]
+            acc_c += c[t]
+            if acc_c < hp.min_data_in_leaf or acc_h < hp.min_sum_hessian_in_leaf:
+                continue
+            left_c = num_data - acc_c
+            if left_c < hp.min_data_in_leaf:
+                break
+            left_h = sum_h - acc_h
+            if left_h < hp.min_sum_hessian_in_leaf:
+                break
+            left_g = sum_g - acc_g
+            gain = (_leaf_gain(left_g, left_h, hp, left_c, parent_out) +
+                    _leaf_gain(acc_g, acc_h, hp, acc_c, parent_out))
+            if gain <= min_gain_shift:
+                continue
+            if gain > best_gain:
+                best_gain = gain
+                best_t = t - 1
+                best_lg, best_lh, best_lc = left_g, left_h, left_c
+    else:
+        acc_g, acc_h, acc_c = 0.0, K_EPSILON, 0.0
+        for t in range(0, num_bin - 1):
+            if skip_default and t == default_bin:
+                continue
+            acc_g += g[t]
+            acc_h += h[t]
+            acc_c += c[t]
+            if acc_c < hp.min_data_in_leaf or acc_h < hp.min_sum_hessian_in_leaf:
+                continue
+            right_c = num_data - acc_c
+            if right_c < hp.min_data_in_leaf:
+                break
+            right_h = sum_h - acc_h
+            if right_h < hp.min_sum_hessian_in_leaf:
+                break
+            right_g = sum_g - acc_g
+            gain = (_leaf_gain(acc_g, acc_h, hp, acc_c, parent_out) +
+                    _leaf_gain(right_g, right_h, hp, right_c, parent_out))
+            if gain <= min_gain_shift:
+                continue
+            if gain > best_gain:
+                best_gain = gain
+                best_t = t
+                best_lg, best_lh, best_lc = acc_g, acc_h, acc_c
+    return best_gain, best_t, best_lg, best_lh, best_lc
+
+
+def best_split_feature(g, h, c, num_bin, missing_type, default_bin,
+                       sum_g, sum_h, num_data, parent_out, hp: HP
+                       ) -> RefSplit:
+    """FindBestThreshold for one feature (numerical)."""
+    sum_h = sum_h + 2 * K_EPSILON
+    min_gain_shift = _leaf_gain(sum_g, sum_h, hp, num_data, parent_out) \
+        + hp.min_gain_to_split
+    out = RefSplit()
+    multi = num_bin > 2
+
+    scans = []
+    if multi and missing_type != "none":
+        if missing_type == "zero":
+            scans = [(True, True, False), (False, True, False)]
+        else:
+            scans = [(True, False, True), (False, False, True)]
+    else:
+        scans = [(True, False, False)]
+
+    best_gain = K_MIN_SCORE
+    best = None
+    for reverse, skip_d, na_miss in scans:
+        gain, t, lg, lh, lc = _scan_one_dir(
+            g, h, c, num_bin, sum_g, sum_h, num_data, parent_out, hp,
+            reverse, skip_d, na_miss, default_bin, min_gain_shift)
+        if gain > best_gain:
+            best_gain = gain
+            best = (t, reverse, lg, lh, lc)
+    if best is not None and best_gain > K_MIN_SCORE:
+        t, reverse, lg, lh, lc = best
+        out.gain = best_gain - min_gain_shift
+        out.threshold = t
+        out.default_left = reverse
+        if not multi and missing_type == "nan":
+            out.default_left = False
+        out.lg, out.lh, out.lc = lg, lh - K_EPSILON, lc
+        out.rg = sum_g - lg
+        out.rh = sum_h - lh - K_EPSILON
+        out.rc = num_data - lc
+        out.lout = _leaf_output(lg, lh, hp, lc, parent_out)
+        out.rout = _leaf_output(out.rg, sum_h - lh, hp, out.rc, parent_out)
+    return out
+
+
+def leaf_histogram(bins, gh, mask):
+    """bins [F, R] ints; gh [R, 3]; mask bool [R] -> hist [F, B, 3] f64."""
+    F, R = bins.shape
+    B = int(bins.max()) + 1 if bins.size else 1
+    hist = np.zeros((F, 256, 3), np.float64)
+    idx = np.flatnonzero(mask)
+    for f in range(F):
+        np.add.at(hist[f], bins[f, idx], gh[idx])
+    return hist
+
+
+@dataclasses.dataclass
+class RefNode:
+    feature: int
+    threshold: int
+    default_left: bool
+    left: int   # ~leaf or node
+    right: int
+    gain: float
+
+
+class RefTree:
+    def __init__(self):
+        self.nodes: List[RefNode] = []
+        self.leaf_value: List[float] = [0.0]
+        self.leaf_count: List[float] = [0.0]
+        self.split_seq: List[Tuple[int, int, int, bool]] = []  # (node, feat, thr, dl)
+
+
+def grow_tree_ref(bins, gh, num_bins, missing_types, default_bins, hp: HP
+                  ) -> Tuple[RefTree, np.ndarray]:
+    """Leaf-wise growth; returns tree + final leaf ids."""
+    F, R = bins.shape
+    leaf_id = np.zeros(R, np.int32)
+    mask_all = gh[:, 2] > 0
+
+    sum_g, sum_h, cnt = gh[:, 0].sum(), gh[:, 1].sum(), gh[:, 2].sum()
+    root_out = _leaf_output(sum_g, sum_h + 2 * K_EPSILON, hp, cnt, 0.0)
+    hists = {0: leaf_histogram(bins, gh, mask_all)}
+    stats = {0: (sum_g, sum_h, cnt, root_out)}
+    depth = {0: 0}
+
+    def find_best(leaf):
+        hg = hists[leaf]
+        sg, sh, n, pout = stats[leaf]
+        best = RefSplit()
+        for f in range(F):
+            s = best_split_feature(
+                hg[f, :, 0], hg[f, :, 1], hg[f, :, 2], num_bins[f],
+                missing_types[f], default_bins[f], sg, sh, n, pout, hp)
+            if s.gain > best.gain:
+                best = s
+                best.feature = f
+        return best
+
+    best_split = {0: find_best(0)}
+    tree = RefTree()
+    tree.leaf_value = [root_out]
+    tree.leaf_count = [cnt]
+
+    for step in range(hp.num_leaves - 1):
+        # pick leaf
+        cands = [(best_split[l].gain, l) for l in best_split
+                 if hp.max_depth <= 0 or depth[l] < hp.max_depth]
+        if not cands:
+            break
+        best_gain = max(g for g, _ in cands)
+        leaf = min(l for g, l in cands if g == best_gain)
+        s = best_split[leaf]
+        if not (s.gain > 0):
+            break
+        node_idx = step
+        new_leaf = step + 1
+        tree.split_seq.append((node_idx, s.feature, s.threshold,
+                               s.default_left))
+        # fix parent pointers
+        for nd in tree.nodes:
+            if nd.left == ~leaf and nd.left < 0 and False:
+                pass
+        # partition
+        col = bins[s.feature]
+        go_left = col <= s.threshold
+        if missing_types[s.feature] == "nan":
+            nanb = num_bins[s.feature] - 1
+            go_left = np.where(col == nanb, s.default_left, go_left)
+        elif missing_types[s.feature] == "zero":
+            go_left = np.where(col == default_bins[s.feature],
+                               s.default_left, go_left)
+        in_leaf = leaf_id == leaf
+        leaf_id[in_leaf & ~go_left] = new_leaf
+
+        node = RefNode(s.feature, s.threshold, s.default_left,
+                       ~leaf, ~new_leaf, s.gain)
+        # fixup: find parent whose child slot is ~leaf
+        for nd in tree.nodes:
+            if nd.left == ~leaf:
+                nd.left = node_idx
+            elif nd.right == ~leaf:
+                nd.right = node_idx
+        tree.nodes.append(node)
+        while len(tree.leaf_value) <= new_leaf:
+            tree.leaf_value.append(0.0)
+            tree.leaf_count.append(0.0)
+        tree.leaf_value[leaf] = s.lout
+        tree.leaf_value[new_leaf] = s.rout
+        tree.leaf_count[leaf] = s.lc
+        tree.leaf_count[new_leaf] = s.rc
+
+        # children hists: smaller pass + subtraction
+        left_smaller = s.lc <= s.rc
+        small = leaf if left_smaller else new_leaf
+        hist_small = leaf_histogram(bins, gh, mask_all & (leaf_id == small))
+        hist_large = hists[leaf] - hist_small
+        hists[leaf] = hist_small if left_smaller else hist_large
+        hists[new_leaf] = hist_large if left_smaller else hist_small
+        stats[leaf] = (s.lg, s.lh, s.lc, s.lout)
+        stats[new_leaf] = (s.rg, s.rh, s.rc, s.rout)
+        depth[new_leaf] = depth[leaf] = depth[leaf] + 1
+        best_split[leaf] = find_best(leaf)
+        best_split[new_leaf] = find_best(new_leaf)
+
+    return tree, leaf_id
