@@ -1,2 +1,32 @@
-"""LightGBM-TPU: TPU-native gradient boosting framework."""
+"""LightGBM-TPU: TPU-native gradient boosting framework.
+
+Public surface mirrors python-package/lightgbm/__init__.py of the reference:
+Dataset, Booster, train, cv, callbacks, sklearn estimators, plotting.
+"""
+from .basic import Booster, Dataset, LightGBMError
+from .callback import (EarlyStopException, early_stopping, log_evaluation,
+                       record_evaluation, reset_parameter)
+from .engine import CVBooster, cv, train
+from .utils.log import register_logger
+
 __version__ = "0.1.0"
+
+__all__ = [
+    "Dataset", "Booster", "LightGBMError",
+    "train", "cv", "CVBooster",
+    "early_stopping", "log_evaluation", "record_evaluation",
+    "reset_parameter", "EarlyStopException",
+    "register_logger",
+]
+
+
+def __getattr__(name):
+    # lazy imports for the heavier optional surfaces
+    if name in ("LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"):
+        from . import sklearn as _sk
+        return getattr(_sk, name)
+    if name in ("plot_importance", "plot_metric", "plot_tree",
+                "create_tree_digraph", "plot_split_value_histogram"):
+        from . import plotting as _pl
+        return getattr(_pl, name)
+    raise AttributeError(f"module 'lightgbm_tpu' has no attribute {name!r}")

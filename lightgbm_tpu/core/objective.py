@@ -1,0 +1,927 @@
+"""Objective functions: score -> (gradient, hessian), fully jittable.
+
+TPU-native equivalent of the reference objective layer
+(ref: include/LightGBM/objective_function.h:20 ObjectiveFunction,
+src/objective/objective_function.cpp:58 CreateObjectiveFunction factory,
+src/objective/regression_objective.hpp, binary_objective.hpp,
+multiclass_objective.hpp, xentropy_objective.hpp, rank_objective.hpp).
+
+Design: each objective exposes ``get_gradients(score) -> (grad, hess)`` as a
+pure function of device arrays so it fuses into the jitted boosting step —
+the analogue of the reference's CUDA objectives writing grad/hess directly
+into device buffers (ref: src/objective/cuda/*, gbdt.cpp:111 boosting_on_gpu_).
+Host-side one-time setup (label stats, init score, percentile renewal) stays
+numpy, exactly as the reference does it once per Init()/tree.
+
+Score layout: [N] for single-model objectives, [K, N] class-major for
+multiclass (matches the reference's ``num_data * k + i`` indexing).
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..utils import log
+
+# ref: include/LightGBM/meta.h kEpsilon
+K_EPSILON = 1e-15
+
+
+def _percentile(values: np.ndarray, alpha: float) -> float:
+    """Unweighted percentile (ref: regression_objective.hpp PercentileFun).
+
+    LightGBM's scheme: pos = floor((n-1)*(1-alpha)) + 1 counted from the TOP
+    of the descending order; equivalently an interpolated order statistic.
+    """
+    values = np.asarray(values, dtype=np.float64)
+    n = len(values)
+    if n == 0:
+        return 0.0
+    if n <= 1:
+        return float(values[0])
+    s = np.sort(values)[::-1]  # descending
+    float_pos = (n - 1) * (1.0 - alpha)
+    pos = int(float_pos) + 1
+    if pos < 1:
+        return float(s.min())
+    if pos >= n:
+        return float(s.max())
+    bias = float_pos - (pos - 1)
+    v1 = s[pos - 1]
+    v2 = s[pos]
+    return float(v1 - (v1 - v2) * bias)
+
+
+def _weighted_percentile(values: np.ndarray, weights: np.ndarray,
+                         alpha: float) -> float:
+    """Weighted percentile (ref: regression_objective.hpp
+    WeightedPercentileFun)."""
+    values = np.asarray(values, dtype=np.float64)
+    weights = np.asarray(weights, dtype=np.float64)
+    n = len(values)
+    if n == 0:
+        return 0.0
+    if n <= 1:
+        return float(values[0])
+    order = np.argsort(values, kind="stable")
+    wcdf = np.cumsum(weights[order])
+    threshold = wcdf[-1] * alpha
+    pos = int(np.searchsorted(wcdf, threshold, side="right"))
+    pos = min(pos, n - 1)
+    if pos == 0 or pos == n - 1:
+        return float(values[order[pos]])
+    v1 = float(values[order[pos - 1]])
+    v2 = float(values[order[pos]])
+    if wcdf[pos] - wcdf[pos - 1] >= 1.0:
+        return (threshold - wcdf[pos - 1]) / (wcdf[pos] - wcdf[pos - 1]) \
+            * (v2 - v1) + v1
+    return v1
+
+
+class ObjectiveFunction:
+    """Base objective (ref: objective_function.h:20)."""
+
+    NAME = "custom"
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.num_data = 0
+        self.label: Optional[np.ndarray] = None
+        self.weight: Optional[np.ndarray] = None
+        self._label_dev = None
+        self._weight_dev = None
+
+    # -- lifecycle ------------------------------------------------------
+    def init(self, metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label = metadata.label
+        self.weight = metadata.weight
+        self._label_dev = (jnp.asarray(self.label, jnp.float32)
+                           if self.label is not None else None)
+        self._weight_dev = (jnp.asarray(self.weight, jnp.float32)
+                            if self.weight is not None else None)
+
+    # -- hot path -------------------------------------------------------
+    def get_gradients(self, score) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """score [N] (or [K, N]) -> (grad, hess) of the same shape.
+        Pure & jittable; called inside the boosting step."""
+        raise NotImplementedError
+
+    def _apply_weight(self, grad, hess):
+        if self._weight_dev is not None:
+            grad = grad * self._weight_dev
+            hess = hess * self._weight_dev
+        return grad, hess
+
+    # -- traits (ref: objective_function.h virtuals) --------------------
+    @property
+    def num_model_per_iteration(self) -> int:
+        return 1
+
+    @property
+    def num_predict_one_row(self) -> int:
+        return 1
+
+    def is_constant_hessian(self) -> bool:
+        return False
+
+    def is_renew_tree_output(self) -> bool:
+        return False
+
+    def class_need_train(self, class_id: int) -> bool:
+        return True
+
+    def boost_from_score(self, class_id: int) -> float:
+        return 0.0
+
+    def convert_output(self, raw: np.ndarray) -> np.ndarray:
+        """Raw score -> prediction space (ref: ConvertOutput)."""
+        return raw
+
+    def renew_tree_output(self, pred: np.ndarray, residual_fn,
+                          leaf_index: np.ndarray, num_leaves: int
+                          ) -> Optional[np.ndarray]:
+        """Per-leaf output re-fit for L1-family (ref: RenewTreeOutput).
+        Returns new leaf values [num_leaves] or None."""
+        return None
+
+    def to_string(self) -> str:
+        return self.NAME
+
+    def __str__(self) -> str:
+        return self.to_string()
+
+
+# ---------------------------------------------------------------------------
+# Regression family (ref: regression_objective.hpp)
+# ---------------------------------------------------------------------------
+
+class RegressionL2(ObjectiveFunction):
+    NAME = "regression"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.sqrt = bool(config.reg_sqrt)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.sqrt:
+            lbl = np.sign(self.label) * np.sqrt(np.abs(self.label))
+            self.label = lbl.astype(np.float32)
+            self._label_dev = jnp.asarray(self.label)
+
+    def get_gradients(self, score):
+        grad = score - self._label_dev
+        hess = jnp.ones_like(score)
+        return self._apply_weight(grad, hess)
+
+    def is_constant_hessian(self):
+        return self.weight is None
+
+    def boost_from_score(self, class_id):
+        if self.weight is not None:
+            return float(np.sum(self.label * self.weight) /
+                         np.sum(self.weight))
+        return float(np.mean(self.label))
+
+    def convert_output(self, raw):
+        if self.sqrt:
+            return np.sign(raw) * raw * raw
+        return raw
+
+    def to_string(self):
+        return self.NAME + (" sqrt" if self.sqrt else "")
+
+
+class RegressionL1(RegressionL2):
+    NAME = "regression_l1"
+    RENEW_ALPHA = 0.5
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.sqrt = False
+
+    def get_gradients(self, score):
+        diff = score - self._label_dev
+        grad = jnp.sign(diff)
+        hess = jnp.ones_like(score)
+        return self._apply_weight(grad, hess)
+
+    def is_constant_hessian(self):
+        return self.weight is None
+
+    def is_renew_tree_output(self):
+        return True
+
+    def boost_from_score(self, class_id):
+        if self.weight is not None:
+            return _weighted_percentile(self.label, self.weight,
+                                        self.RENEW_ALPHA)
+        return _percentile(self.label, self.RENEW_ALPHA)
+
+    def _renew_weights(self, idx: np.ndarray) -> Optional[np.ndarray]:
+        return None if self.weight is None else self.weight[idx]
+
+    def renew_tree_output(self, pred, residual_fn, leaf_index, num_leaves):
+        out = np.zeros(num_leaves, dtype=np.float64)
+        residual = residual_fn()  # label - pred (before adding this tree)
+        for leaf in range(num_leaves):
+            idx = np.flatnonzero(leaf_index == leaf)
+            if len(idx) == 0:
+                continue
+            w = self._renew_weights(idx)
+            if w is None:
+                out[leaf] = _percentile(residual[idx], self.RENEW_ALPHA)
+            else:
+                out[leaf] = _weighted_percentile(residual[idx], w,
+                                                 self.RENEW_ALPHA)
+        return out
+
+    def to_string(self):
+        return self.NAME
+
+
+class RegressionHuber(RegressionL2):
+    NAME = "huber"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.sqrt = False
+        self.alpha = float(config.alpha)
+
+    def get_gradients(self, score):
+        diff = score - self._label_dev
+        grad = jnp.clip(diff, -self.alpha, self.alpha)
+        hess = jnp.ones_like(score)
+        return self._apply_weight(grad, hess)
+
+    def to_string(self):
+        return self.NAME
+
+
+class RegressionFair(RegressionL2):
+    NAME = "fair"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.c = float(config.fair_c)
+
+    def get_gradients(self, score):
+        x = score - self._label_dev
+        denom = jnp.abs(x) + self.c
+        grad = self.c * x / denom
+        hess = self.c * self.c / (denom * denom)
+        return self._apply_weight(grad, hess)
+
+    def is_constant_hessian(self):
+        return False
+
+    def to_string(self):
+        return self.NAME
+
+
+class RegressionPoisson(RegressionL2):
+    NAME = "poisson"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.sqrt = False
+        self.max_delta_step = float(config.poisson_max_delta_step)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if np.min(self.label) < 0.0:
+            log.fatal(f"[{self.NAME}]: at least one target label is negative")
+        if np.sum(self.label) == 0.0:
+            log.fatal(f"[{self.NAME}]: sum of labels is zero")
+
+    def get_gradients(self, score):
+        exp_score = jnp.exp(score)
+        grad = exp_score - self._label_dev
+        hess = exp_score * math.exp(self.max_delta_step)
+        return self._apply_weight(grad, hess)
+
+    def is_constant_hessian(self):
+        return False
+
+    def boost_from_score(self, class_id):
+        mean = RegressionL2.boost_from_score(self, class_id)
+        return math.log(mean) if mean > 0 else math.log(K_EPSILON)
+
+    def convert_output(self, raw):
+        return np.exp(raw)
+
+    def to_string(self):
+        return self.NAME
+
+
+class RegressionQuantile(RegressionL2):
+    NAME = "quantile"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.alpha = float(config.alpha)
+        if not (0.0 < self.alpha < 1.0):
+            log.fatal("alpha must be in (0, 1) for quantile objective")
+
+    def get_gradients(self, score):
+        delta = score - self._label_dev
+        grad = jnp.where(delta >= 0, 1.0 - self.alpha, -self.alpha)
+        hess = jnp.ones_like(score)
+        return self._apply_weight(grad, hess)
+
+    def is_renew_tree_output(self):
+        return True
+
+    def boost_from_score(self, class_id):
+        if self.weight is not None:
+            return _weighted_percentile(self.label, self.weight, self.alpha)
+        return _percentile(self.label, self.alpha)
+
+    def renew_tree_output(self, pred, residual_fn, leaf_index, num_leaves):
+        out = np.zeros(num_leaves, dtype=np.float64)
+        residual = residual_fn()
+        for leaf in range(num_leaves):
+            idx = np.flatnonzero(leaf_index == leaf)
+            if len(idx) == 0:
+                continue
+            if self.weight is None:
+                out[leaf] = _percentile(residual[idx], self.alpha)
+            else:
+                out[leaf] = _weighted_percentile(residual[idx],
+                                                 self.weight[idx], self.alpha)
+        return out
+
+    def to_string(self):
+        return self.NAME
+
+
+class RegressionMAPE(RegressionL1):
+    NAME = "mape"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if np.any(np.abs(self.label) < 1):
+            log.warning("Some label values are < 1 in absolute value. MAPE "
+                        "is unstable with such values; rounding them to 1.0")
+        lw = 1.0 / np.maximum(1.0, np.abs(self.label))
+        if self.weight is not None:
+            lw = lw * self.weight
+        self.label_weight = lw.astype(np.float32)
+        self._label_weight_dev = jnp.asarray(self.label_weight)
+
+    def get_gradients(self, score):
+        diff = score - self._label_dev
+        grad = jnp.sign(diff) * self._label_weight_dev
+        if self._weight_dev is not None:
+            hess = self._weight_dev
+        else:
+            hess = jnp.ones_like(score)
+        return grad, hess
+
+    def is_constant_hessian(self):
+        return True
+
+    def boost_from_score(self, class_id):
+        return _weighted_percentile(self.label, self.label_weight, 0.5)
+
+    def _renew_weights(self, idx):
+        return self.label_weight[idx]
+
+    def renew_tree_output(self, pred, residual_fn, leaf_index, num_leaves):
+        out = np.zeros(num_leaves, dtype=np.float64)
+        residual = residual_fn()
+        for leaf in range(num_leaves):
+            idx = np.flatnonzero(leaf_index == leaf)
+            if len(idx) == 0:
+                continue
+            out[leaf] = _weighted_percentile(residual[idx],
+                                             self.label_weight[idx], 0.5)
+        return out
+
+
+class RegressionGamma(RegressionPoisson):
+    NAME = "gamma"
+
+    def get_gradients(self, score):
+        exp_neg = jnp.exp(-score)
+        grad = 1.0 - self._label_dev * exp_neg
+        hess = self._label_dev * exp_neg
+        return self._apply_weight(grad, hess)
+
+
+class RegressionTweedie(RegressionPoisson):
+    NAME = "tweedie"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.rho = float(config.tweedie_variance_power)
+
+    def get_gradients(self, score):
+        e1 = jnp.exp((1.0 - self.rho) * score)
+        e2 = jnp.exp((2.0 - self.rho) * score)
+        grad = -self._label_dev * e1 + e2
+        hess = -self._label_dev * (1.0 - self.rho) * e1 + (2.0 - self.rho) * e2
+        return self._apply_weight(grad, hess)
+
+
+# ---------------------------------------------------------------------------
+# Binary classification (ref: binary_objective.hpp)
+# ---------------------------------------------------------------------------
+
+class BinaryLogloss(ObjectiveFunction):
+    NAME = "binary"
+
+    def __init__(self, config: Config, is_pos=None):
+        super().__init__(config)
+        self.sigmoid = float(config.sigmoid)
+        if self.sigmoid <= 0:
+            log.fatal(f"Sigmoid parameter {self.sigmoid} should be > 0")
+        self.is_unbalance = bool(config.is_unbalance)
+        self.scale_pos_weight = float(config.scale_pos_weight)
+        if self.is_unbalance and abs(self.scale_pos_weight - 1.0) > 1e-6:
+            log.fatal("Cannot set is_unbalance and scale_pos_weight together")
+        self.is_pos = is_pos or (lambda y: y > 0)
+        self.need_train = True
+        self.num_pos_data = 0
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        pos_mask = self.is_pos(self.label)
+        cnt_pos = int(pos_mask.sum())
+        cnt_neg = num_data - cnt_pos
+        self.num_pos_data = cnt_pos
+        self.need_train = cnt_pos > 0 and cnt_neg > 0
+        if not self.need_train:
+            log.warning("Contains only one class")
+        log.info(f"Number of positive: {cnt_pos}, number of negative: {cnt_neg}")
+        w_pos, w_neg = 1.0, 1.0
+        if self.is_unbalance and cnt_pos > 0 and cnt_neg > 0:
+            if cnt_pos > cnt_neg:
+                w_neg = cnt_pos / cnt_neg
+            else:
+                w_pos = cnt_neg / cnt_pos
+        w_pos *= self.scale_pos_weight
+        # per-row signed label (+1/-1) and label weight, as device constants
+        self._sign = jnp.where(jnp.asarray(pos_mask), 1.0, -1.0).astype(
+            jnp.float32)
+        self._lw = jnp.where(jnp.asarray(pos_mask), w_pos, w_neg).astype(
+            jnp.float32)
+        self._pos_mask = pos_mask
+
+    def get_gradients(self, score):
+        if not self.need_train:
+            return jnp.zeros_like(score), jnp.zeros_like(score)
+        response = -self._sign * self.sigmoid / (
+            1.0 + jnp.exp(self._sign * self.sigmoid * score))
+        abs_response = jnp.abs(response)
+        grad = response * self._lw
+        hess = abs_response * (self.sigmoid - abs_response) * self._lw
+        return self._apply_weight(grad, hess)
+
+    def boost_from_score(self, class_id):
+        if self.weight is not None:
+            suml = float(np.sum(self._pos_mask * self.weight))
+            sumw = float(np.sum(self.weight))
+        else:
+            suml = float(np.sum(self._pos_mask))
+            sumw = float(self.num_data)
+        pavg = min(max(suml / sumw, K_EPSILON), 1.0 - K_EPSILON)
+        initscore = math.log(pavg / (1.0 - pavg)) / self.sigmoid
+        log.info(f"[{self.NAME}:BoostFromScore]: pavg={pavg:.6f} -> "
+                 f"initscore={initscore:.6f}")
+        return initscore
+
+    def class_need_train(self, class_id):
+        return self.need_train
+
+    def convert_output(self, raw):
+        return 1.0 / (1.0 + np.exp(-self.sigmoid * raw))
+
+    def to_string(self):
+        return f"{self.NAME} sigmoid:{self.sigmoid:g}"
+
+
+# ---------------------------------------------------------------------------
+# Multiclass (ref: multiclass_objective.hpp)
+# ---------------------------------------------------------------------------
+
+class MulticlassSoftmax(ObjectiveFunction):
+    NAME = "multiclass"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.num_class = int(config.num_class)
+        self.factor = self.num_class / (self.num_class - 1.0)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        label_int = self.label.astype(np.int32)
+        if label_int.min() < 0 or label_int.max() >= self.num_class:
+            log.fatal(f"Label must be in [0, {self.num_class})")
+        w = self.weight if self.weight is not None else np.ones(num_data)
+        probs = np.zeros(self.num_class)
+        np.add.at(probs, label_int, w)
+        self.class_init_probs = probs / w.sum()
+        # one-hot labels as a [K, N] device constant
+        self._onehot = jnp.asarray(
+            label_int[None, :] == np.arange(self.num_class)[:, None],
+            jnp.float32)
+
+    def get_gradients(self, score):
+        # score [K, N]
+        p = jax.nn.softmax(score, axis=0)
+        grad = p - self._onehot
+        hess = self.factor * p * (1.0 - p)
+        if self._weight_dev is not None:
+            grad = grad * self._weight_dev[None, :]
+            hess = hess * self._weight_dev[None, :]
+        return grad, hess
+
+    @property
+    def num_model_per_iteration(self):
+        return self.num_class
+
+    @property
+    def num_predict_one_row(self):
+        return self.num_class
+
+    def boost_from_score(self, class_id):
+        return math.log(max(K_EPSILON, self.class_init_probs[class_id]))
+
+    def class_need_train(self, class_id):
+        p = self.class_init_probs[class_id]
+        return K_EPSILON < abs(p) < 1.0 - K_EPSILON
+
+    def convert_output(self, raw):
+        # raw [..., K] -> softmax over last axis
+        e = np.exp(raw - raw.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+
+    def to_string(self):
+        return f"{self.NAME} num_class:{self.num_class}"
+
+
+class MulticlassOVA(ObjectiveFunction):
+    NAME = "multiclassova"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.num_class = int(config.num_class)
+        self.sigmoid = float(config.sigmoid)
+        self.binary_losses = [
+            BinaryLogloss(config,
+                          is_pos=(lambda y, k=k: y.astype(np.int32) == k))
+            for k in range(self.num_class)]
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        for b in self.binary_losses:
+            b.init(metadata, num_data)
+
+    def get_gradients(self, score):
+        grads, hesss = [], []
+        for k in range(self.num_class):
+            g, h = self.binary_losses[k].get_gradients(score[k])
+            grads.append(g)
+            hesss.append(h)
+        return jnp.stack(grads), jnp.stack(hesss)
+
+    @property
+    def num_model_per_iteration(self):
+        return self.num_class
+
+    @property
+    def num_predict_one_row(self):
+        return self.num_class
+
+    def boost_from_score(self, class_id):
+        return self.binary_losses[class_id].boost_from_score(0)
+
+    def class_need_train(self, class_id):
+        return self.binary_losses[class_id].need_train
+
+    def convert_output(self, raw):
+        return 1.0 / (1.0 + np.exp(-self.sigmoid * raw))
+
+    def to_string(self):
+        return f"{self.NAME} num_class:{self.num_class} sigmoid:{self.sigmoid:g}"
+
+
+# ---------------------------------------------------------------------------
+# Cross-entropy on [0,1] labels (ref: xentropy_objective.hpp)
+# ---------------------------------------------------------------------------
+
+class CrossEntropy(ObjectiveFunction):
+    NAME = "cross_entropy"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.label.min() < 0 or self.label.max() > 1:
+            log.fatal("[cross_entropy]: label must be in [0, 1]")
+
+    def get_gradients(self, score):
+        z = jax.nn.sigmoid(score)
+        grad = z - self._label_dev
+        hess = z * (1.0 - z)
+        return self._apply_weight(grad, hess)
+
+    def boost_from_score(self, class_id):
+        w = self.weight if self.weight is not None else np.ones(self.num_data)
+        pavg = float(np.sum(self.label * w) / np.sum(w))
+        pavg = min(max(pavg, K_EPSILON), 1.0 - K_EPSILON)
+        initscore = math.log(pavg / (1.0 - pavg))
+        log.info(f"[{self.NAME}:BoostFromScore]: pavg={pavg:.6f} -> "
+                 f"initscore={initscore:.6f}")
+        return initscore
+
+    def convert_output(self, raw):
+        return 1.0 / (1.0 + np.exp(-raw))
+
+
+class CrossEntropyLambda(ObjectiveFunction):
+    """Alternative parameterization with weights entering the link
+    (ref: xentropy_objective.hpp:186 CrossEntropyLambda)."""
+    NAME = "cross_entropy_lambda"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.label.min() < 0 or self.label.max() > 1:
+            log.fatal("[cross_entropy_lambda]: label must be in [0, 1]")
+
+    def get_gradients(self, score):
+        if self._weight_dev is None:
+            z = jax.nn.sigmoid(score)
+            grad = z - self._label_dev
+            hess = z * (1.0 - z)
+            return grad, hess
+        w = self._weight_dev
+        y = self._label_dev
+        epf = jnp.exp(score)
+        enf = 1.0 / epf
+        z = 1.0 - jnp.exp(-w * jnp.log1p(epf))
+        grad = (1.0 - y / jnp.maximum(z, K_EPSILON)) * w / (1.0 + enf)
+        c = 1.0 / (1.0 - jnp.minimum(z, 1.0 - K_EPSILON))
+        b = 1.0 + w * epf - c
+        a = w * epf / ((1.0 + epf) * (1.0 + epf))
+        hess = a * (1.0 + y * b)
+        return grad, hess
+
+    def boost_from_score(self, class_id):
+        w = self.weight if self.weight is not None else np.ones(self.num_data)
+        havg = float(np.sum(self.label * w) / np.sum(w))
+        initscore = math.log(math.expm1(max(havg, K_EPSILON)))
+        log.info(f"[{self.NAME}:BoostFromScore]: havg={havg:.6f} -> "
+                 f"initscore={initscore:.6f}")
+        return initscore
+
+    def convert_output(self, raw):
+        return np.log1p(np.exp(raw))
+
+
+# ---------------------------------------------------------------------------
+# Ranking (ref: rank_objective.hpp LambdarankNDCG / RankXENDCG)
+# ---------------------------------------------------------------------------
+
+def default_label_gain(max_label: int = 31) -> np.ndarray:
+    """2^i - 1 gains (ref: dcg_calculator.cpp DefaultLabelGain)."""
+    return (np.power(2.0, np.arange(max_label + 1)) - 1.0)
+
+
+class _RankingObjective(ObjectiveFunction):
+    """Shared padded-query machinery. Queries are padded to a common
+    max length so the per-query pairwise computation becomes one dense
+    [Q, M, M] masked tensor op — the TPU-native shape of the reference's
+    per-query OMP loop (ref: rank_objective.hpp:56 GetGradients)."""
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            log.fatal("Ranking tasks require query information")
+        qb = metadata.query_boundaries.astype(np.int64)
+        self.query_boundaries = qb
+        self.num_queries = len(qb) - 1
+        counts = np.diff(qb)
+        self.max_query = int(counts.max())
+        Q, M = self.num_queries, self.max_query
+        # row index per (query, slot); padded slots point at row 0 & masked
+        idx = np.zeros((Q, M), dtype=np.int64)
+        valid = np.zeros((Q, M), dtype=bool)
+        for q in range(Q):
+            c = counts[q]
+            idx[q, :c] = np.arange(qb[q], qb[q + 1])
+            valid[q, :c] = True
+        self._qidx = jnp.asarray(idx)
+        self._qvalid = jnp.asarray(valid)
+        self._qcounts = counts
+        self._label_q = jnp.asarray(
+            np.where(valid, self.label[idx], 0.0), jnp.float32)
+
+    def scatter_back(self, padded: jnp.ndarray) -> jnp.ndarray:
+        """[Q, M] padded per-doc values -> [N] flat (padded slots dropped)."""
+        flat = jnp.zeros(self.num_data, jnp.float32)
+        return flat.at[self._qidx.reshape(-1)].add(
+            jnp.where(self._qvalid, padded, 0.0).reshape(-1))
+
+
+class LambdarankNDCG(_RankingObjective):
+    NAME = "lambdarank"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.sigmoid = float(config.sigmoid)
+        if self.sigmoid <= 0:
+            log.fatal(f"Sigmoid param {self.sigmoid} should be > 0")
+        self.norm = bool(config.lambdarank_norm)
+        self.truncation_level = int(config.lambdarank_truncation_level)
+        lg = list(config.label_gain)
+        self.label_gain = (np.asarray(lg, np.float64) if lg
+                           else default_label_gain())
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.label.max() >= len(self.label_gain):
+            log.fatal(f"Label {int(self.label.max())} exceeds label_gain "
+                      "size; set label_gain explicitly")
+        # per-query inverse max DCG at truncation level
+        inv = np.zeros(self.num_queries)
+        gains = self.label_gain
+        for q in range(self.num_queries):
+            lo, hi = self.query_boundaries[q], self.query_boundaries[q + 1]
+            lbl = np.sort(self.label[lo:hi])[::-1][:self.truncation_level]
+            dcg = np.sum(gains[lbl.astype(np.int64)] /
+                         np.log2(np.arange(len(lbl)) + 2.0))
+            inv[q] = 1.0 / dcg if dcg > 0 else 0.0
+        self._inv_max_dcg = jnp.asarray(inv, jnp.float32)
+        self._gain_q = jnp.asarray(
+            self.label_gain[np.asarray(self._label_q, np.int64)], jnp.float32)
+
+    def get_gradients(self, score):
+        """Padded all-pairs lambdas (ref: rank_objective.hpp:181
+        GetGradientsForOneQuery, exact sigmoid instead of the lookup table)."""
+        Q, M = self._qidx.shape
+        s = jnp.where(self._qvalid, score[self._qidx], -jnp.inf)  # [Q, M]
+        lbl = self._label_q
+        gain = self._gain_q
+
+        # rank of each doc within its query by descending score (stable)
+        order = jnp.argsort(-jnp.where(self._qvalid, s, -jnp.inf),
+                            axis=1, stable=True)              # [Q, M] doc slot at rank r
+        rank = jnp.zeros_like(order).at[
+            jnp.arange(Q)[:, None], order].set(jnp.arange(M)[None, :])
+        discount = 1.0 / jnp.log2(rank.astype(jnp.float32) + 2.0)
+
+        valid = self._qvalid
+        pair_valid = (valid[:, :, None] & valid[:, None, :] &
+                      (lbl[:, :, None] != lbl[:, None, :]))
+        # truncation: pair needs at least one doc ranked < truncation_level
+        in_trunc = rank < self.truncation_level
+        pair_valid &= in_trunc[:, :, None] | in_trunc[:, None, :]
+        # orient: i = high-label doc, j = low
+        high_is_i = lbl[:, :, None] > lbl[:, None, :]
+
+        delta_score = s[:, :, None] - s[:, None, :]            # s_i - s_j
+        dcg_gap = gain[:, :, None] - gain[:, None, :]
+        paired_discount = jnp.abs(discount[:, :, None] - discount[:, None, :])
+        delta_ndcg = jnp.abs(dcg_gap) * paired_discount * \
+            self._inv_max_dcg[:, None, None]
+
+        if self.norm:
+            best = jnp.max(jnp.where(valid, s, -jnp.inf), axis=1)
+            worst = jnp.min(jnp.where(valid, s, jnp.inf), axis=1)
+            norm_ok = (best != worst)[:, None, None]
+            delta_ndcg = jnp.where(
+                norm_ok, delta_ndcg / (0.01 + jnp.abs(delta_score)),
+                delta_ndcg)
+
+        # signed delta from high to low: use delta for (high, low) pair
+        hl_delta = jnp.where(high_is_i, delta_score, -delta_score)
+        p = jax.nn.sigmoid(-self.sigmoid * hl_delta)           # 1/(1+e^{s_h-s_l})
+        p_lambda = -self.sigmoid * delta_ndcg * p
+        p_hess = self.sigmoid * self.sigmoid * delta_ndcg * p * (1.0 - p)
+
+        pair_valid &= high_is_i  # count each unordered pair once, i as high
+        p_lambda = jnp.where(pair_valid, p_lambda, 0.0)
+        p_hess = jnp.where(pair_valid, p_hess, 0.0)
+
+        # i (high) receives +lambda, j (low) receives -lambda
+        lambdas = p_lambda.sum(axis=2) - p_lambda.sum(axis=1)
+        hess = p_hess.sum(axis=2) + p_hess.sum(axis=1)
+        sum_lambdas = -2.0 * p_lambda.sum(axis=(1, 2))
+
+        if self.norm:
+            nf = jnp.where(sum_lambdas > 0,
+                           jnp.log2(1.0 + sum_lambdas) /
+                           jnp.maximum(sum_lambdas, K_EPSILON), 1.0)
+            lambdas = lambdas * nf[:, None]
+            hess = hess * nf[:, None]
+
+        return self.scatter_back(lambdas), self.scatter_back(hess)
+
+    def to_string(self):
+        return self.NAME
+
+
+class RankXENDCG(_RankingObjective):
+    """Cross-entropy surrogate for NDCG (ref: rank_objective.hpp RankXENDCG;
+    Bruch et al., 'An Alternative Cross Entropy Loss for Learning-to-Rank')."""
+    NAME = "rank_xendcg"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.seed = int(config.objective_seed)
+        self._iter = 0
+
+    def get_gradients(self, score):
+        Q, M = self._qidx.shape
+        valid = self._qvalid
+        s = jnp.where(valid, score[self._qidx], -jnp.inf)
+        # fresh gumbel noise per call (ref: Rands in GetGradientsForOneQuery)
+        self._iter += 1
+        key = jax.random.PRNGKey(self.seed + self._iter)
+        rho = jax.nn.softmax(jnp.where(valid, s, -jnp.inf), axis=1)
+        rho = jnp.where(valid, rho, 0.0)
+        # terms: phi(label, gumbel) = 2^label - gumbel
+        gumbel = jax.random.gumbel(key, (Q, M))
+        phi = jnp.power(2.0, self._label_q) - gumbel
+        phi = jnp.where(valid, phi, 0.0)
+        phi_sum = jnp.maximum(phi.sum(axis=1, keepdims=True), K_EPSILON)
+        ys = phi / phi_sum
+        l1 = rho - ys
+        # second-order correction terms (ref: rank_objective.hpp:400-430)
+        rho_sq = rho * rho
+        l2_denom = jnp.maximum(1.0 - rho, K_EPSILON)
+        params = (ys + l1 * rho / l2_denom)
+        l2 = params.sum(axis=1, keepdims=True) * rho - l1 * rho / l2_denom - ys * rho / l2_denom * 0
+        lambdas = l1 + rho * (params.sum(axis=1, keepdims=True) - params)
+        hess = rho * (1.0 - rho)
+        lambdas = jnp.where(valid, lambdas, 0.0)
+        hess = jnp.where(valid, hess, 0.0)
+        return self.scatter_back(lambdas), self.scatter_back(hess)
+
+    def to_string(self):
+        return self.NAME
+
+
+# ---------------------------------------------------------------------------
+# Custom objective adapter (fobj from Python callbacks)
+# ---------------------------------------------------------------------------
+
+class CustomObjective(ObjectiveFunction):
+    """Gradients supplied by the caller (ref: gbdt.cpp:364-381 custom path,
+    'custom'/'none' factory names objective_function.cpp:147)."""
+    NAME = "custom"
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.num_class = int(config.num_class)
+
+    def get_gradients(self, score):
+        raise RuntimeError("custom objective: gradients must be passed to "
+                           "Booster.update(train_set, fobj)")
+
+    @property
+    def num_model_per_iteration(self):
+        return self.num_class
+
+    @property
+    def num_predict_one_row(self):
+        return self.num_class
+
+
+# ---------------------------------------------------------------------------
+# Factory (ref: objective_function.cpp:58 CreateObjectiveFunction)
+# ---------------------------------------------------------------------------
+
+_OBJECTIVES = {
+    "regression": RegressionL2,
+    "regression_l1": RegressionL1,
+    "huber": RegressionHuber,
+    "fair": RegressionFair,
+    "poisson": RegressionPoisson,
+    "quantile": RegressionQuantile,
+    "mape": RegressionMAPE,
+    "gamma": RegressionGamma,
+    "tweedie": RegressionTweedie,
+    "binary": BinaryLogloss,
+    "multiclass": MulticlassSoftmax,
+    "multiclassova": MulticlassOVA,
+    "cross_entropy": CrossEntropy,
+    "cross_entropy_lambda": CrossEntropyLambda,
+    "lambdarank": LambdarankNDCG,
+    "rank_xendcg": RankXENDCG,
+    "custom": CustomObjective,
+}
+
+
+def create_objective(name: str, config: Config) -> ObjectiveFunction:
+    from ..config import canonical_objective
+    canonical = canonical_objective(name)
+    if canonical not in _OBJECTIVES:
+        log.fatal(f"Unknown objective type name: {name}")
+    return _OBJECTIVES[canonical](config)

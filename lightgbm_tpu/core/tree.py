@@ -99,12 +99,52 @@ class HostTree:
         self.decision_type: np.ndarray = np.zeros(n_int, np.int32)
         self.is_linear = False
         self.num_cat = 0
+        # original-feature-index -> {category value: bin} for categorical
+        # splits (interim ordered-bin representation; see gbdt._finalize_tree)
+        self.cat_value_to_bin: dict = {}
+
+    @classmethod
+    def constant(cls, value: float) -> "HostTree":
+        """Single-leaf constant tree (ref: tree.cpp Tree::AsConstantTree)."""
+        self = cls.__new__(cls)
+        self.num_leaves = 1
+        for f in ("split_feature_inner", "split_feature", "threshold_bin",
+                  "default_left", "left_child", "right_child"):
+            setattr(self, f, np.zeros(0, np.int32))
+        for f in ("split_gain", "internal_value", "internal_weight"):
+            setattr(self, f, np.zeros(0, np.float64))
+        self.internal_count = np.zeros(0, np.int64)
+        self.leaf_value = np.asarray([value], np.float64)
+        self.leaf_weight = np.zeros(1, np.float64)
+        self.leaf_count = np.zeros(1, np.int64)
+        self.leaf_parent = np.full(1, -1, np.int32)
+        self.shrinkage = 1.0
+        self.threshold_real = np.zeros(0, np.float64)
+        self.decision_type = np.zeros(0, np.int32)
+        self.is_linear = False
+        self.num_cat = 0
+        self.cat_value_to_bin = {}
+        return self
 
     def shrink(self, rate: float) -> None:
         """ref: tree.h Tree::Shrinkage."""
         self.leaf_value = self.leaf_value * rate
         self.internal_value = self.internal_value * rate
         self.shrinkage *= rate
+
+    def copy(self) -> "HostTree":
+        """Deep copy (continued training keeps the source model intact)."""
+        import copy as _copy
+        new = self.__class__.__new__(self.__class__)
+        for k, v in self.__dict__.items():
+            new.__dict__[k] = v.copy() if isinstance(v, np.ndarray) else v
+        return new
+
+    def add_bias(self, val: float) -> None:
+        """ref: tree.cpp Tree::AddBias — folds the boost-from-average init
+        score into the first tree so the saved model is self-contained."""
+        self.leaf_value = self.leaf_value + val
+        self.internal_value = self.internal_value + val
 
     def add_output(self, delta: np.ndarray) -> None:
         self.leaf_value = self.leaf_value + delta
@@ -120,20 +160,33 @@ class HostTree:
         active = np.ones(n, dtype=bool)
         # decision_type bits (ref: tree.h kCategoricalMask=1, kDefaultLeftMask=2,
         # missing type in bits 2-3)
+        cat_lut = {}
+        for f_orig, mapping in self.cat_value_to_bin.items():
+            cat_lut[f_orig] = mapping
         for _ in range(self.num_leaves):  # depth bound
             if not active.any():
                 break
             f = self.split_feature[node]
             thr = self.threshold_real[node]
             dl = (self.decision_type[node] & 2) != 0
+            is_cat = (self.decision_type[node] & 1) != 0
             mtype = (self.decision_type[node] >> 2) & 3
             x = X[np.arange(n), f]
             isnan = np.isnan(x)
             x0 = np.where(isnan, 0.0, x)
             le = x0 <= thr
+            if is_cat.any():
+                # categorical: compare the category's BIN to the threshold
+                # (train/serve consistency for the ordered-bin cat split)
+                xb = np.zeros(n)
+                for i in np.flatnonzero(is_cat & active):
+                    mapping = cat_lut.get(int(f[i]), {})
+                    xb[i] = mapping.get(-1 if isnan[i] else int(x0[i]), 0)
+                le = np.where(is_cat, xb <= thr, le)
             # missing handling: 0 none (NaN->0), 1 zero, 2 nan
             miss = np.where(mtype == 2, isnan,
                             (mtype == 1) & (np.abs(x0) <= 1e-35))
+            miss = miss & ~is_cat  # cat NaN already routed to bin 0
             go_left = np.where(miss, dl, le)
             child = np.where(go_left, self.left_child[node],
                              self.right_child[node])

@@ -1,0 +1,339 @@
+"""Training entry points: train() and cv().
+
+TPU-native equivalent of python-package/lightgbm/engine.py
+(ref: train() :109-353 — param normalization, callback orchestration,
+early-stopping injection :275-288, update loop :310-323; cv()/CVBooster
+:356+).
+"""
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from . import callback as callback_module
+from .basic import Booster, Dataset, LightGBMError
+from .callback import CallbackEnv, EarlyStopException
+from .config import Config, _ConfigAliases
+from .utils import log
+
+__all__ = ["train", "cv", "CVBooster"]
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          feval=None, init_model: Optional[Union[str, Booster]] = None,
+          keep_training_booster: bool = False,
+          callbacks: Optional[List[Callable]] = None) -> Booster:
+    """Train one model (ref: engine.py:109)."""
+    params = copy.deepcopy(params) if params else {}
+    # resolve num_boost_round aliases (ref: engine.py:149-160)
+    for alias in _ConfigAliases.get("num_iterations"):
+        if alias in params and alias != "num_iterations":
+            num_boost_round = int(params.pop(alias))
+            log.warning(f"Found '{alias}' in params. Will use it instead of "
+                        "'num_boost_round' argument")
+        elif alias == "num_iterations" and alias in params:
+            num_boost_round = int(params.pop(alias))
+    # early stopping from params (ref: engine.py:275)
+    early_stopping_round = None
+    for alias in _ConfigAliases.get("early_stopping_round"):
+        if alias in params and params[alias] is not None:
+            early_stopping_round = int(params[alias])
+    first_metric_only = bool(params.get("first_metric_only", False))
+
+    fobj = None
+    obj = params.get("objective")
+    for alias in _ConfigAliases.get("objective"):
+        if alias in params:
+            obj = params[alias]
+    if callable(obj):
+        fobj = obj
+        for alias in _ConfigAliases.get("objective"):
+            params.pop(alias, None)
+        params["objective"] = "custom"
+
+    if not isinstance(train_set, Dataset):
+        raise TypeError("train() only accepts Dataset object")
+    train_set.construct()
+
+    # continued training (ref: engine.py:233-244)
+    if isinstance(init_model, (str,)):
+        predictor = Booster(model_file=init_model)
+    elif isinstance(init_model, Booster):
+        predictor = init_model
+    else:
+        predictor = None
+
+    booster = Booster(params=params, train_set=train_set)
+    if predictor is not None:
+        booster._engine.init_from_model(predictor._engine)
+
+    eval_train_name = None
+    if valid_sets is not None:
+        if isinstance(valid_sets, Dataset):
+            valid_sets = [valid_sets]
+        if valid_names is None:
+            valid_names = [f"valid_{i}" for i in range(len(valid_sets))]
+        for vs, name in zip(valid_sets, valid_names):
+            if vs is train_set:
+                eval_train_name = name
+            else:
+                booster.add_valid(vs, name)
+
+    if num_boost_round <= 0:
+        raise ValueError("num_boost_round must be greater than 0")
+    cbs = set(callbacks or [])
+    if early_stopping_round is not None and early_stopping_round > 0:
+        cbs.add(callback_module.early_stopping(
+            early_stopping_round, first_metric_only,
+            verbose=bool(params.get("verbosity", 1) >= 1)))
+    callbacks_before = [cb for cb in cbs
+                        if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in cbs
+                       if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    if eval_train_name is not None:
+        booster.train_data_name = eval_train_name
+    init_iteration = booster.current_iteration()
+    booster.best_iteration = -1
+    evaluation_result_list = []
+
+    for i in range(init_iteration, init_iteration + num_boost_round):
+        for cb in callbacks_before:
+            cb(CallbackEnv(model=booster, params=params, iteration=i,
+                           begin_iteration=init_iteration,
+                           end_iteration=init_iteration + num_boost_round,
+                           evaluation_result_list=None))
+        finished = booster.update(fobj=fobj)
+
+        evaluation_result_list = []
+        if eval_train_name is not None or \
+                booster._engine.config.is_provide_training_metric:
+            name = eval_train_name or "training"
+            evaluation_result_list.extend(
+                (name, n, v, h) for _, n, v, h in booster.eval_train(feval))
+        if booster.valid_sets:
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in callbacks_after:
+                cb(CallbackEnv(model=booster, params=params, iteration=i,
+                               begin_iteration=init_iteration,
+                               end_iteration=init_iteration + num_boost_round,
+                               evaluation_result_list=evaluation_result_list))
+        except EarlyStopException as earlyStopException:
+            booster.best_iteration = earlyStopException.best_iteration + 1
+            evaluation_result_list = earlyStopException.best_score
+            break
+        if finished:
+            break
+
+    booster.best_score = collections.defaultdict(collections.OrderedDict)
+    for item in evaluation_result_list:
+        if len(item) == 4:
+            booster.best_score[item[0]][item[1]] = item[2]
+    if not keep_training_booster:
+        booster.free_dataset()
+    return booster
+
+
+class CVBooster:
+    """Container of k boosters from cv() (ref: engine.py:356 CVBooster)."""
+
+    def __init__(self, model_file: Optional[str] = None):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def _append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name: str):
+        if name.startswith("__"):  # keep copy/pickle/introspection sane
+            raise AttributeError(name)
+
+        def handler_function(*args: Any, **kwargs: Any) -> List[Any]:
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, folds, nfold: int, params: Dict,
+                  seed: int, stratified: bool, shuffle: bool):
+    """ref: engine.py _make_n_folds."""
+    full_data.construct()
+    num_data = full_data.num_data()
+    if folds is not None:
+        if not hasattr(folds, "__iter__") and not hasattr(folds, "split"):
+            raise AttributeError(
+                "folds should be a generator or iterator of (train_idx, "
+                "test_idx) tuples or scikit-learn splitter object")
+        if hasattr(folds, "split"):
+            group_info = full_data.get_group()
+            if group_info is not None:
+                group_info = np.asarray(group_info, np.int64)
+                flatted_group = np.repeat(
+                    np.arange(len(group_info)), repeats=group_info)
+            else:
+                flatted_group = np.zeros(num_data, dtype=np.int64)
+            folds = folds.split(X=np.empty(num_data),
+                                y=full_data.get_label(),
+                                groups=flatted_group)
+    else:
+        rng = np.random.default_rng(seed)
+        group = full_data.get_group()
+        if group is not None:
+            # group-aware folds: split whole queries
+            ngroups = len(group)
+            gidx = np.arange(ngroups)
+            if shuffle:
+                rng.shuffle(gidx)
+            gfolds = np.array_split(gidx, nfold)
+            boundaries = np.concatenate([[0], np.cumsum(group)])
+            folds = []
+            for gf in gfolds:
+                test_rows = np.concatenate(
+                    [np.arange(boundaries[g], boundaries[g + 1])
+                     for g in gf]) if len(gf) else np.zeros(0, np.int64)
+                train_rows = np.setdiff1d(np.arange(num_data), test_rows)
+                folds.append((train_rows, test_rows))
+        elif stratified:
+            label = np.asarray(full_data.get_label())
+            folds = []
+            # within each class, (optionally shuffled) round-robin deal so
+            # every fold gets the same class proportions
+            assignment = np.zeros(num_data, np.int64)
+            for cls in np.unique(label):
+                rows = np.flatnonzero(label == cls)
+                if shuffle:
+                    rng.shuffle(rows)
+                assignment[rows] = np.arange(len(rows)) % nfold
+            for f in range(nfold):
+                test_rows = np.flatnonzero(assignment == f)
+                train_rows = np.flatnonzero(assignment != f)
+                folds.append((train_rows, test_rows))
+        else:
+            idx = np.arange(num_data)
+            if shuffle:
+                rng.shuffle(idx)
+            parts = np.array_split(idx, nfold)
+            folds = [(np.setdiff1d(np.arange(num_data), p), p)
+                     for p in parts]
+    return folds
+
+
+def _agg_cv_result(raw_results):
+    """ref: engine.py _agg_cv_result — mean/std across folds."""
+    cvmap = collections.OrderedDict()
+    metric_type = {}
+    for one_result in raw_results:
+        for one_line in one_result:
+            key = f"{one_line[0]} {one_line[1]}"
+            metric_type[key] = one_line[3]
+            cvmap.setdefault(key, [])
+            cvmap[key].append(one_line[2])
+    return [("cv_agg", k, float(np.mean(v)), metric_type[k],
+             float(np.std(v))) for k, v in cvmap.items()]
+
+
+def cv(params: Dict[str, Any], train_set: Dataset,
+       num_boost_round: int = 100, folds=None, nfold: int = 5,
+       stratified: bool = True, shuffle: bool = True,
+       metrics=None, feval=None,
+       init_model: Optional[Union[str, Booster]] = None,
+       fpreproc=None, seed: int = 0, callbacks=None,
+       eval_train_metric: bool = False,
+       return_cvbooster: bool = False) -> Dict[str, Any]:
+    """Cross-validation (ref: engine.py:356 cv)."""
+    params = copy.deepcopy(params) if params else {}
+    if not isinstance(train_set, Dataset):
+        raise TypeError("cv() only accepts Dataset object")
+    for alias in _ConfigAliases.get("num_iterations"):
+        if alias in params:
+            num_boost_round = int(params.pop(alias))
+    early_stopping_round = None
+    for alias in _ConfigAliases.get("early_stopping_round"):
+        if alias in params and params[alias] is not None:
+            early_stopping_round = int(params[alias])
+    if metrics is not None:
+        params["metric"] = metrics
+    obj = params.get("objective")
+    fobj = None
+    if callable(obj):
+        fobj = obj
+        params["objective"] = "custom"
+    # stratification only makes sense for classification
+    cfg_probe = Config({k: v for k, v in params.items()
+                        if not callable(v)})
+    if cfg_probe.objective not in ("binary", "multiclass", "multiclassova"):
+        stratified = False
+
+    train_set.construct()
+    folds = _make_n_folds(train_set, folds, nfold, params, seed, stratified,
+                          shuffle)
+
+    cvbooster = CVBooster()
+    boosters_env = []
+    for train_idx, test_idx in folds:
+        tr = train_set.subset(train_idx)
+        te = train_set.subset(test_idx)
+        if fpreproc is not None:
+            tr, te, params = fpreproc(tr, te, params.copy())
+        b = Booster(params=params, train_set=tr)
+        b.add_valid(te, "valid")
+        cvbooster._append(b)
+        boosters_env.append(b)
+
+    cbs = set(callbacks or [])
+    if early_stopping_round is not None and early_stopping_round > 0:
+        cbs.add(callback_module.early_stopping(
+            early_stopping_round,
+            bool(params.get("first_metric_only", False)), verbose=False))
+    callbacks_before = sorted(
+        [cb for cb in cbs if getattr(cb, "before_iteration", False)],
+        key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after = sorted(
+        [cb for cb in cbs if not getattr(cb, "before_iteration", False)],
+        key=lambda cb: getattr(cb, "order", 0))
+
+    results = collections.defaultdict(list)
+    for i in range(num_boost_round):
+        for cb in callbacks_before:
+            cb(CallbackEnv(model=cvbooster, params=params, iteration=i,
+                           begin_iteration=0, end_iteration=num_boost_round,
+                           evaluation_result_list=None))
+        for b in boosters_env:
+            b.update(fobj=fobj)
+        raw = []
+        for b in boosters_env:
+            one = []
+            if eval_train_metric:
+                one.extend(b.eval_train(feval))
+            one.extend(b.eval_valid(feval))
+            raw.append(one)
+        res = _agg_cv_result(raw)
+        for _, key, mean, _, std in res:
+            results[f"{key}-mean"].append(mean)
+            results[f"{key}-stdv"].append(std)
+        try:
+            for cb in callbacks_after:
+                cb(CallbackEnv(model=cvbooster, params=params, iteration=i,
+                               begin_iteration=0,
+                               end_iteration=num_boost_round,
+                               evaluation_result_list=res))
+        except EarlyStopException as e:
+            cvbooster.best_iteration = e.best_iteration + 1
+            for bst in boosters_env:
+                bst.best_iteration = cvbooster.best_iteration
+            for k in results:
+                results[k] = results[k][:cvbooster.best_iteration]
+            break
+
+    out: Dict[str, Any] = dict(results)
+    if return_cvbooster:
+        out["cvbooster"] = cvbooster
+    return out

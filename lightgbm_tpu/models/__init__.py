@@ -1,0 +1,21 @@
+"""Boosting model factory (ref: src/boosting/boosting.cpp CreateBoosting,
+include/LightGBM/boosting.h:317)."""
+from __future__ import annotations
+
+from ..config import Config
+from ..utils import log
+
+
+def create_boosting(config: Config, train_set, objective):
+    from .gbdt import GBDT
+    from .dart import DART
+    from .rf import RF
+    name = str(config.boosting).lower()
+    if name in ("gbdt", "gbrt", "gradient_boosting",
+                "gradient_boosted_trees", "goss"):
+        return GBDT(config, train_set, objective)
+    if name == "dart":
+        return DART(config, train_set, objective)
+    if name in ("rf", "random_forest"):
+        return RF(config, train_set, objective)
+    log.fatal(f"Unknown boosting type {config.boosting}")

@@ -1,0 +1,136 @@
+"""DART boosting (Dropouts meet Multiple Additive Regression Trees).
+
+TPU-native equivalent of src/boosting/dart.hpp: per-iteration tree dropout
+with renormalization. Score add/subtract of dropped trees runs as batched
+device traversals over the binned data (ref: dart.hpp:98 DroppingTrees,
+:159 Normalize and the three-step shrinkage scheme documented there).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..config import Config
+from ..utils import log
+from .gbdt import GBDT
+
+
+class DART(GBDT):
+    NAME = "dart"
+
+    def __init__(self, config: Config, train_set, objective):
+        super().__init__(config, train_set, objective)
+        self.rng = np.random.default_rng(config.drop_seed)
+        self.tree_weight: List[float] = []
+        self.sum_weight = 0.0
+        self.drop_index: List[int] = []
+        log.info("Using DART")
+
+    def _add_tree_score(self, tree_idx: int, k: int, factor: float) -> None:
+        """score += factor * tree_output for train+valid (tree's current
+        leaf values; factor folds the Shrinkage(-1) style steps)."""
+        t = self.models[tree_idx]
+        self.score = self.score.at[k].add(
+            factor * self._tree_outputs(t, self.bins_dev))
+
+    def _add_tree_score_valid(self, tree_idx: int, k: int,
+                              factor: float) -> None:
+        t = self.models[tree_idx]
+        for vd in self.valid_sets:
+            vd.score = vd.score.at[k].add(
+                factor * self._tree_outputs(t, vd.bins_dev))
+
+    def _dropping_trees(self) -> None:
+        """ref: dart.hpp:98 DroppingTrees."""
+        cfg = self.config
+        self.drop_index = []
+        if self.rng.random() >= cfg.skip_drop:
+            drop_rate = cfg.drop_rate
+            n_tree = self.iter
+            if cfg.uniform_drop:
+                if cfg.max_drop > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / max(n_tree, 1))
+                for i in range(n_tree):
+                    if self.rng.random() < drop_rate:
+                        self.drop_index.append(self.num_init_iteration + i)
+                        if len(self.drop_index) >= cfg.max_drop > 0:
+                            break
+            else:
+                inv_avg = len(self.tree_weight) / max(self.sum_weight, 1e-300)
+                if cfg.max_drop > 0:
+                    drop_rate = min(
+                        drop_rate,
+                        cfg.max_drop * inv_avg / max(self.sum_weight, 1e-300))
+                for i in range(n_tree):
+                    if self.rng.random() < \
+                            drop_rate * self.tree_weight[i] * inv_avg:
+                        self.drop_index.append(self.num_init_iteration + i)
+                        if len(self.drop_index) >= cfg.max_drop > 0:
+                            break
+        K = self.num_tree_per_iteration
+        # drop: negate tree, add to train score (ref: Shrinkage(-1)+AddScore)
+        for i in self.drop_index:
+            for k in range(K):
+                ti = i * K + k
+                self.models[ti].shrink(-1.0)
+                self._add_tree_score(ti, k, 1.0)
+        n_drop = len(self.drop_index)
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage_rate = cfg.learning_rate / (1.0 + n_drop)
+        else:
+            if n_drop == 0:
+                self.shrinkage_rate = cfg.learning_rate
+            else:
+                self.shrinkage_rate = cfg.learning_rate / (
+                    cfg.learning_rate + n_drop)
+
+    def _normalize(self) -> None:
+        """ref: dart.hpp:159 Normalize (three-step shrinkage scheme)."""
+        cfg = self.config
+        k_drop = float(len(self.drop_index))
+        K = self.num_tree_per_iteration
+        for i in self.drop_index:
+            for k in range(K):
+                ti = i * K + k
+                if not cfg.xgboost_dart_mode:
+                    self.models[ti].shrink(1.0 / (k_drop + 1.0))
+                    self._add_tree_score_valid(ti, k, 1.0)
+                    self.models[ti].shrink(-k_drop)
+                    self._add_tree_score(ti, k, 1.0)
+                else:
+                    self.models[ti].shrink(self.shrinkage_rate)
+                    self._add_tree_score_valid(ti, k, 1.0)
+                    self.models[ti].shrink(-k_drop / cfg.learning_rate)
+                    self._add_tree_score(ti, k, 1.0)
+            wi = i - self.num_init_iteration
+            if not cfg.uniform_drop:
+                if not cfg.xgboost_dart_mode:
+                    self.sum_weight -= self.tree_weight[wi] / (k_drop + 1.0)
+                    self.tree_weight[wi] *= k_drop / (k_drop + 1.0)
+                else:
+                    self.sum_weight -= self.tree_weight[wi] / (
+                        k_drop + cfg.learning_rate)
+                    self.tree_weight[wi] *= k_drop / (
+                        k_drop + cfg.learning_rate)
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        self._dropping_trees()
+        finished = super().train_one_iter(gradients, hessians)
+        if not finished:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+            self._normalize()
+        else:
+            # restore the trees we dropped (training ends here)
+            self._restore_dropped()
+        return finished
+
+    def _restore_dropped(self) -> None:
+        K = self.num_tree_per_iteration
+        for i in self.drop_index:
+            for k in range(K):
+                ti = i * K + k
+                self.models[ti].shrink(-1.0)
+                self._add_tree_score(ti, k, 1.0)
+        self.drop_index = []

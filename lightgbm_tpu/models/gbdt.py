@@ -1,0 +1,470 @@
+"""GBDT boosting orchestrator.
+
+TPU-native equivalent of the reference boosting layer
+(ref: src/boosting/gbdt.{h,cpp} — Init :60, BoostFromAverage :328,
+Boosting :229, TrainOneIter :353-461, UpdateScore :502, eval :534,
+RollbackOneIter :463; src/boosting/score_updater.hpp ScoreUpdater).
+
+State design (SURVEY.md §7): scores live on device as f32 [K, N] arrays;
+gradients are computed on device by the objective (≡ boosting_on_gpu_,
+gbdt.cpp:111); each tree is grown by the jitted leaf-wise grower; the train
+score update reuses the grower's per-row leaf_id (no traversal needed);
+valid scores update via batched device traversal over binned data.
+Host keeps the canonical model list (HostTree) for IO/serving, exactly
+mirroring models_ in the reference.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..core.grower import GrowerConfig, make_tree_grower
+from ..core.metrics import Metric, metrics_for_config
+from ..core.objective import ObjectiveFunction, CustomObjective, K_EPSILON
+from ..core.tree import HostTree, TreeArrays
+from ..io.dataset_core import BinnedDataset
+from ..ops.split import FeatureMeta, SplitHyperParams
+from ..ops.predict import tree_leaf_bins
+from ..utils import log
+from .sample_strategy import SampleStrategy
+
+
+def _host_tree_to_arrays(t: HostTree, max_leaves: int) -> TreeArrays:
+    """Rebuild device TreeArrays from a host tree (for DART drop/restore &
+    valid-set traversal of reloaded models)."""
+    li = max_leaves - 1
+    L = max_leaves
+
+    def pad_i(a, n):
+        out = np.zeros(n, np.int32)
+        out[:len(a)] = a
+        return jnp.asarray(out)
+
+    def pad_f(a, n):
+        out = np.zeros(n, np.float32)
+        out[:len(a)] = a
+        return jnp.asarray(out)
+
+    def pad_b(a, n):
+        out = np.zeros(n, bool)
+        out[:len(a)] = a
+        return jnp.asarray(out)
+
+    return TreeArrays(
+        split_feature=pad_i(t.split_feature_inner, li),
+        threshold_bin=pad_i(t.threshold_bin, li),
+        default_left=pad_b(t.default_left, li),
+        left_child=pad_i(t.left_child, li),
+        right_child=pad_i(t.right_child, li),
+        split_gain=pad_f(t.split_gain, li),
+        internal_value=pad_f(t.internal_value, li),
+        internal_weight=pad_f(t.internal_weight, li),
+        internal_count=pad_f(t.internal_count, li),
+        leaf_value=pad_f(t.leaf_value, L),
+        leaf_weight=pad_f(t.leaf_weight, L),
+        leaf_count=pad_f(t.leaf_count, L),
+        leaf_parent=pad_i(t.leaf_parent, L),
+        num_leaves=jnp.asarray(t.num_leaves, jnp.int32),
+        shrinkage=jnp.asarray(t.shrinkage, jnp.float32),
+    )
+
+
+class _ValidData:
+    """One validation set: device bins + score + metrics
+    (ref: valid_score_updater_ / valid_metrics_ in gbdt.h)."""
+
+    def __init__(self, dataset: BinnedDataset, metrics: List[Metric],
+                 num_class: int, name: str = "valid"):
+        self.dataset = dataset
+        self.metrics = metrics
+        self.name = name
+        self.bins_dev = jnp.asarray(dataset.bins)
+        self.score = jnp.zeros((num_class, dataset.num_data), jnp.float32)
+        if dataset.metadata.init_score is not None:
+            init = dataset.metadata.init_score.reshape(
+                -1, dataset.num_data).astype(np.float32)
+            self.score = jnp.asarray(init)
+
+
+class GBDT:
+    """Gradient Boosting Decision Tree engine (ref: gbdt.h:28)."""
+
+    NAME = "gbdt"
+
+    def __init__(self, config: Config, train_set: Optional[BinnedDataset],
+                 objective: Optional[ObjectiveFunction]):
+        self.config = config
+        self.train_set = train_set
+        self.objective = objective
+        self.models: List[HostTree] = []
+        self.iter = 0
+        self.num_init_iteration = 0
+        self.shrinkage_rate = float(config.learning_rate)
+        self.valid_sets: List[_ValidData] = []
+        self.train_metrics: List[Metric] = []
+        self.best_score_by_metric: Dict[str, float] = {}
+        # model-level metadata for IO
+        self.max_feature_idx = 0
+        self.label_idx = 0
+        self.feature_names: List[str] = []
+        self.feature_infos: List[str] = []
+        self.average_output = False  # RF sets true
+
+        if objective is not None:
+            self.num_tree_per_iteration = objective.num_model_per_iteration
+        else:
+            self.num_tree_per_iteration = int(config.num_class)
+
+        if train_set is not None:
+            self._setup_train(train_set)
+
+    # ------------------------------------------------------------------
+    def _setup_train(self, train: BinnedDataset) -> None:
+        cfg = self.config
+        self.num_data = train.num_data
+        self.max_feature_idx = train.num_total_features - 1
+        self.feature_names = list(train.feature_names)
+        self.feature_infos = train.feature_infos()
+        md = train.metadata
+
+        if self.objective is not None:
+            self.objective.init(md, train.num_data)
+        self.train_metrics = []
+
+        mappers = train.used_bin_mappers()
+        self.feature_meta = FeatureMeta.from_mappers(mappers) if mappers else None
+        self.num_bin_max = int(max((m.num_bin for m in mappers), default=2))
+        self.bins_dev = jnp.asarray(train.bins) if train.bins is not None \
+            else None
+
+        K = self.num_tree_per_iteration
+        self.score = jnp.zeros((K, self.num_data), jnp.float32)
+        if md.init_score is not None:
+            init = md.init_score.reshape(-1, self.num_data).astype(np.float32)
+            self.score = jnp.asarray(init)
+            self.has_init_score = True
+        else:
+            self.has_init_score = False
+
+        self.class_need_train = [
+            self.objective.class_need_train(k) if self.objective else True
+            for k in range(K)]
+
+        self.sample_strategy = SampleStrategy.create(
+            cfg, self.num_data, K, metadata=md)
+
+        hp = SplitHyperParams(
+            lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
+            min_data_in_leaf=cfg.min_data_in_leaf,
+            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+            min_gain_to_split=cfg.min_gain_to_split,
+            max_delta_step=cfg.max_delta_step,
+            path_smooth=cfg.path_smooth)
+        backend = "xla"
+        if cfg.tpu_use_pallas and jax.default_backend() == "tpu":
+            backend = "pallas"
+        self.grower_cfg = GrowerConfig(
+            num_leaves=cfg.num_leaves, max_depth=cfg.max_depth,
+            num_bin=self.num_bin_max, hparams=hp, hist_backend=backend,
+            block_rows=cfg.tpu_rows_per_block)
+        if self.feature_meta is not None:
+            self._grow = jax.jit(
+                make_tree_grower(self.grower_cfg, self.feature_meta))
+        else:
+            self._grow = None
+
+        # jitted gradient fn (device-resident labels/weights in the closure)
+        if self.objective is not None and \
+                not isinstance(self.objective, CustomObjective):
+            obj = self.objective
+            if K == 1:
+                self._gh_fn = jax.jit(lambda s: obj.get_gradients(s[0]))
+            else:
+                self._gh_fn = jax.jit(lambda s: obj.get_gradients(s))
+        else:
+            self._gh_fn = None
+
+        # feature sampling state (ref: col_sampler.hpp)
+        self._col_rng = np.random.default_rng(cfg.feature_fraction_seed)
+        self.num_used_features = train.num_used_features
+
+    # ------------------------------------------------------------------
+    def add_valid_data(self, valid: BinnedDataset,
+                       metrics: Optional[List[Metric]] = None,
+                       name: Optional[str] = None) -> None:
+        if metrics is None:
+            metrics = metrics_for_config(
+                self.config,
+                self.objective.NAME if self.objective else "custom")
+        for m in metrics:
+            m.init(valid.metadata, valid.num_data)
+        vd = _ValidData(valid, metrics, self.num_tree_per_iteration,
+                        name or f"valid_{len(self.valid_sets) + 1}")
+        # replay existing model onto the new valid set (continued training)
+        for it in range(len(self.models) // self.num_tree_per_iteration):
+            for k in range(self.num_tree_per_iteration):
+                t = self.models[it * self.num_tree_per_iteration + k]
+                vd.score = vd.score.at[k].add(self._tree_outputs(
+                    t, vd.bins_dev))
+        self.valid_sets.append(vd)
+
+    def add_train_metrics(self, metrics: List[Metric]) -> None:
+        for m in metrics:
+            m.init(self.train_set.metadata, self.num_data)
+        self.train_metrics = metrics
+
+    # ------------------------------------------------------------------
+    def _feature_mask(self) -> Optional[jnp.ndarray]:
+        """Per-tree column sampling (ref: col_sampler.hpp feature_fraction)."""
+        frac = self.config.feature_fraction
+        F = self.num_used_features
+        if frac >= 1.0 or F <= 1:
+            return None
+        n_take = max(1, min(F, int(round(F * frac))))
+        idx = self._col_rng.choice(F, size=n_take, replace=False)
+        mask = np.zeros(F, bool)
+        mask[idx] = True
+        return jnp.asarray(mask)
+
+    def _obtain_init_score(self, k: int) -> float:
+        """ref: gbdt.cpp:317 ObtainAutomaticInitialScore + network mean."""
+        init = self.objective.boost_from_score(k) if self.objective else 0.0
+        return float(init)
+
+    def _boost_from_average(self, k: int) -> float:
+        """ref: gbdt.cpp:328 BoostFromAverage."""
+        if (not self.models and not self.has_init_score and
+                self.objective is not None and
+                (self.config.boost_from_average or
+                 self.num_used_features == 0)):
+            init_score = self._obtain_init_score(k)
+            if abs(init_score) > K_EPSILON:
+                self.score = self.score.at[k].add(init_score)
+                for vd in self.valid_sets:
+                    vd.score = vd.score.at[k].add(init_score)
+                log.info(f"Start training from score {init_score:.6f}")
+                return init_score
+        return 0.0
+
+    def _tree_outputs(self, t: HostTree, bins_dev) -> jnp.ndarray:
+        """Per-row output of a host tree over binned data."""
+        arrs = _host_tree_to_arrays(t, self.config.num_leaves)
+        leaf = tree_leaf_bins(arrs, bins_dev, self.feature_meta.num_bin,
+                              self.feature_meta.missing_type,
+                              self.feature_meta.default_bin)
+        return arrs.leaf_value[leaf]
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, gradients: Optional[np.ndarray] = None,
+                       hessians: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration (ref: gbdt.cpp:353 TrainOneIter).
+        Returns True when training should stop (no more valid splits)."""
+        K = self.num_tree_per_iteration
+        init_scores = [0.0] * K
+
+        if gradients is None or hessians is None:
+            for k in range(K):
+                init_scores[k] = self._boost_from_average(k)
+            grad, hess = self._gh_fn(self.score)
+            if K == 1:
+                grad = grad[None, :]
+                hess = hess[None, :]
+        else:
+            grad = jnp.asarray(
+                np.asarray(gradients, np.float32).reshape(K, self.num_data))
+            hess = jnp.asarray(
+                np.asarray(hessians, np.float32).reshape(K, self.num_data))
+
+        # -- bagging / GOSS (host decision, device apply) ---------------
+        sample = self.sample_strategy.sample(
+            self.iter, np.asarray(grad), np.asarray(hess))
+        if sample is not None:
+            selected, weight = sample
+            sel_dev = jnp.asarray(selected)
+            w_dev = jnp.asarray(weight)
+        else:
+            selected = None
+            sel_dev = None
+            w_dev = None
+
+        should_continue = False
+        for k in range(K):
+            if not self.class_need_train[k] or self._grow is None:
+                self.models.append(self._constant_tree(init_scores[k]))
+                continue
+            g, h = grad[k], hess[k]
+            if sel_dev is not None:
+                gh = jnp.stack([g * w_dev, h * w_dev, sel_dev], axis=1)
+            else:
+                ones = jnp.ones_like(g)
+                gh = jnp.stack([g, h, ones], axis=1)
+            fmask = self._feature_mask()
+            tree_dev, leaf_id = self._grow(self.bins_dev, gh, fmask)
+            host = HostTree(jax.tree.map(np.asarray, tree_dev),
+                            self.train_set.used_feature_map)
+
+            if host.num_leaves <= 1:
+                # no valid split for this class this iteration
+                if len(self.models) < K:
+                    if (self.objective is not None and
+                            not self.config.boost_from_average and
+                            not self.has_init_score):
+                        init_scores[k] = self._obtain_init_score(k)
+                        self.score = self.score.at[k].add(init_scores[k])
+                        for vd in self.valid_sets:
+                            vd.score = vd.score.at[k].add(init_scores[k])
+                    self.models.append(self._constant_tree(init_scores[k]))
+                else:
+                    self.models.append(self._constant_tree(0.0))
+                continue
+
+            should_continue = True
+            self._finalize_tree(host)
+            leaf_np = np.asarray(leaf_id)
+
+            # -- RenewTreeOutput (L1-family percentile re-fit) ----------
+            # (ref: gbdt.cpp:418 via tree_learner_->RenewTreeOutput)
+            if (self.objective is not None and
+                    self.objective.is_renew_tree_output()):
+                score_k = np.asarray(self.score[k], np.float64)
+                label = self.train_set.metadata.label
+
+                def residual_fn():
+                    return label.astype(np.float64) - score_k
+
+                renew_leaf = leaf_np
+                if selected is not None:
+                    # restrict percentile to bagged rows (ref: bag indices)
+                    renew_leaf = np.where(selected > 0, leaf_np, -1)
+                new_vals = self.objective.renew_tree_output(
+                    score_k, residual_fn, renew_leaf, host.num_leaves)
+                if new_vals is not None:
+                    old = host.leaf_value[:host.num_leaves]
+                    host.leaf_value[:host.num_leaves] = np.where(
+                        np.isfinite(new_vals), new_vals, old)
+
+            # -- shrinkage + score updates ------------------------------
+            host.shrink(self.shrinkage_rate)
+            lv = np.zeros(self.config.num_leaves, np.float32)
+            lv[:host.num_leaves] = host.leaf_value[:host.num_leaves]
+            lv_dev = jnp.asarray(lv)
+            self.score = self.score.at[k].add(lv_dev[leaf_id])
+            for vd in self.valid_sets:
+                vd.score = vd.score.at[k].add(
+                    self._tree_outputs(host, vd.bins_dev))
+            if abs(init_scores[k]) > K_EPSILON:
+                host.add_bias(init_scores[k])
+            self.models.append(host)
+
+        if not should_continue:
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            if len(self.models) > K:
+                del self.models[-K:]
+            return True
+        self.iter += 1
+        return False
+
+    def _constant_tree(self, value: float) -> HostTree:
+        """ref: Tree::AsConstantTree."""
+        t = HostTree.constant(value)
+        return t
+
+    def _finalize_tree(self, host: HostTree) -> None:
+        """Resolve bin thresholds to real values and pack decision_type bits
+        (ref: tree.h kCategoricalMask=1, kDefaultLeftMask=2, missing type in
+        bits 2-3; Tree::Split stores RealThreshold = bin upper bound)."""
+        from ..io.binning import MISSING_NONE, MISSING_ZERO
+        mappers = self.train_set.bin_mappers
+        n_int = host.num_leaves - 1
+        thr_real = np.zeros(n_int, np.float64)
+        dtype_bits = np.zeros(n_int, np.int32)
+        miss_enum = {"none": 0, "zero": 1, "nan": 2}
+        cat_maps = {}
+        for i in range(n_int):
+            m = mappers[host.split_feature[i]]
+            tb = int(host.threshold_bin[i])
+            if m.bin_type == "categorical":
+                # interim ordered-bin categorical split: serve by mapping the
+                # raw category to its bin (train/serve consistent); the
+                # LightGBM bitset subset split lands with the categorical
+                # optimal-split work (ref: feature_histogram.hpp sorted-subset)
+                thr_real[i] = float(tb)
+                dtype_bits[i] |= 1
+                f_orig = int(host.split_feature[i])
+                if f_orig not in cat_maps:
+                    cat_maps[f_orig] = dict(m.categorical_2_bin)
+            else:
+                thr_real[i] = m.bin_upper_bound[min(
+                    tb, len(m.bin_upper_bound) - 1)]
+            if host.default_left[i]:
+                dtype_bits[i] |= 2
+            dtype_bits[i] |= miss_enum[m.missing_type] << 2
+        host.threshold_real = thr_real
+        host.decision_type = dtype_bits
+        host.cat_value_to_bin = cat_maps
+
+    def rollback_one_iter(self) -> None:
+        """ref: gbdt.cpp:463 RollbackOneIter."""
+        if self.iter <= 0:
+            return
+        K = self.num_tree_per_iteration
+        for k in range(K):
+            t = self.models[len(self.models) - K + k]
+            # subtract contribution from train & valid scores
+            self.score = self.score.at[k].add(
+                -self._tree_outputs(t, self.bins_dev))
+            for vd in self.valid_sets:
+                vd.score = vd.score.at[k].add(
+                    -self._tree_outputs(t, vd.bins_dev))
+        del self.models[-K:]
+        self.iter -= 1
+
+    # ------------------------------------------------------------------
+    def eval_train(self) -> List[Tuple[str, str, float, bool]]:
+        return self._eval(self.train_metrics, self.score, "training")
+
+    def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
+        out = []
+        for vd in self.valid_sets:
+            out.extend(self._eval(vd.metrics, vd.score, vd.name))
+        return out
+
+    def init_from_model(self, other: "GBDT") -> None:
+        """Continued training from an existing model (ref: CLI input_model,
+        boosting.h:305 Boosting::CreateBoosting(filename) then continue)."""
+        if other.num_tree_per_iteration != self.num_tree_per_iteration:
+            log.fatal("Cannot continue training: num_tree_per_iteration "
+                      "differs between the init model and this config")
+        K = self.num_tree_per_iteration
+        self.models = [t.copy() for t in other.models]
+        self.num_init_iteration = len(self.models) // max(K, 1)
+        for i, t in enumerate(self.models):
+            k = i % K
+            self.score = self.score.at[k].add(
+                self._tree_outputs(t, self.bins_dev))
+            for vd in self.valid_sets:
+                vd.score = vd.score.at[k].add(
+                    self._tree_outputs(t, vd.bins_dev))
+
+    def _eval(self, metrics, score, data_name):
+        out = []
+        score_np = np.asarray(score, np.float64)
+        view = score_np[0] if self.num_tree_per_iteration == 1 else score_np
+        for m in metrics:
+            for name, value, hib in m.eval(view, self.objective):
+                out.append((data_name, name, value, hib))
+        return out
+
+    # ------------------------------------------------------------------
+    @property
+    def num_iterations_trained(self) -> int:
+        return self.iter
+
+    def current_iteration(self) -> int:
+        return len(self.models) // max(self.num_tree_per_iteration, 1)

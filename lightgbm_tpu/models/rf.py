@@ -1,0 +1,134 @@
+"""Random Forest mode.
+
+TPU-native equivalent of src/boosting/rf.hpp:26 — no shrinkage, bagging or
+feature sampling required, gradients computed ONCE from the constant init
+score, score maintained as the running average of tree outputs
+(MultiplyScore trick, rf.hpp TrainOneIter), prediction averages trees
+(average_output_).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..config import Config
+from ..core.objective import K_EPSILON
+from ..core.tree import HostTree
+from ..utils import log
+from .gbdt import GBDT
+
+
+class RF(GBDT):
+    NAME = "rf"
+
+    def __init__(self, config: Config, train_set, objective):
+        if str(config.data_sample_strategy).lower() == "bagging":
+            ok = ((config.bagging_freq > 0 and
+                   0.0 < config.bagging_fraction < 1.0) or
+                  0.0 < config.feature_fraction < 1.0)
+            if not ok:
+                log.fatal("RF mode requires bagging "
+                          "(bagging_freq>0 and bagging_fraction in (0,1)) "
+                          "or feature_fraction in (0,1)")
+        super().__init__(config, train_set, objective)
+        self.average_output = True
+        self.shrinkage_rate = 1.0
+        # gradients from the constant init score, computed once (ref: rf.hpp
+        # Boosting())
+        K = self.num_tree_per_iteration
+        self.init_scores = [0.0] * K
+        if self.objective is not None:
+            for k in range(K):
+                if self.config.boost_from_average:
+                    self.init_scores[k] = self._obtain_init_score(k)
+            const_score = jnp.asarray(
+                np.repeat(np.asarray(self.init_scores, np.float32)[:, None],
+                          self.num_data, axis=1))
+            grad, hess = self._gh_fn(const_score)
+            if K == 1:
+                grad, hess = grad[None, :], hess[None, :]
+            self._grad_const = grad
+            self._hess_const = hess
+        log.info("Using RF (random forest) mode")
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        """ref: rf.hpp TrainOneIter — running-average score maintenance."""
+        if gradients is not None or hessians is not None:
+            log.fatal("RF mode does not support custom objective functions")
+        K = self.num_tree_per_iteration
+        grad, hess = self._grad_const, self._hess_const
+
+        sample = self.sample_strategy.sample(
+            self.iter, np.asarray(grad), np.asarray(hess))
+        if sample is not None:
+            selected, weight = sample
+            sel_dev = jnp.asarray(selected)
+            w_dev = jnp.asarray(weight)
+        else:
+            selected, sel_dev, w_dev = None, None, None
+
+        should_continue = False
+        for k in range(K):
+            if not self.class_need_train[k] or self._grow is None:
+                out = self.init_scores[k]
+                self.models.append(HostTree.constant(out))
+                continue
+            g, h = grad[k], hess[k]
+            if sel_dev is not None:
+                gh = jnp.stack([g * w_dev, h * w_dev, sel_dev], axis=1)
+            else:
+                gh = jnp.stack([g, h, jnp.ones_like(g)], axis=1)
+            fmask = self._feature_mask()
+            tree_dev, leaf_id = self._grow(self.bins_dev, gh, fmask)
+            import jax
+            host = HostTree(jax.tree.map(np.asarray, tree_dev),
+                            self.train_set.used_feature_map)
+            if host.num_leaves <= 1:
+                self.models.append(HostTree.constant(
+                    self.init_scores[k] if len(self.models) < K else 0.0))
+                continue
+            should_continue = True
+            self._finalize_tree(host)
+            leaf_np = np.asarray(leaf_id)
+
+            if self.objective is not None and \
+                    self.objective.is_renew_tree_output():
+                init = self.init_scores[k]
+                label = self.train_set.metadata.label
+
+                def residual_fn():
+                    return label.astype(np.float64) - init
+
+                renew_leaf = leaf_np
+                if selected is not None:
+                    renew_leaf = np.where(selected > 0, leaf_np, -1)
+                new_vals = self.objective.renew_tree_output(
+                    None, residual_fn, renew_leaf, host.num_leaves)
+                if new_vals is not None:
+                    old = host.leaf_value[:host.num_leaves]
+                    host.leaf_value[:host.num_leaves] = np.where(
+                        np.isfinite(new_vals), new_vals, old)
+            if abs(self.init_scores[k]) > K_EPSILON:
+                host.add_bias(self.init_scores[k])
+
+            # running average: score = (score*n + tree) / (n+1)
+            n_prev = self.iter + self.num_init_iteration
+            lv = np.zeros(self.config.num_leaves, np.float32)
+            lv[:host.num_leaves] = host.leaf_value[:host.num_leaves]
+            lv_dev = jnp.asarray(lv)
+            self.score = self.score.at[k].set(
+                (self.score[k] * n_prev + lv_dev[leaf_id]) / (n_prev + 1))
+            for vd in self.valid_sets:
+                vd.score = vd.score.at[k].set(
+                    (vd.score[k] * n_prev +
+                     self._tree_outputs(host, vd.bins_dev)) / (n_prev + 1))
+            self.models.append(host)
+
+        if not should_continue:
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            if len(self.models) > K:
+                del self.models[-K:]
+            return True
+        self.iter += 1
+        return False

@@ -1,0 +1,123 @@
+"""Batched tree traversal on device.
+
+TPU-native equivalent of Tree::AddPredictionToScore / Tree::Predict
+(ref: include/LightGBM/tree.h:135 NumericalDecision, src/io/tree.cpp,
+src/boosting/score_updater.hpp:22 ScoreUpdater,
+src/treelearner/cuda/cuda_tree.cu AddPredictionToScore kernels).
+
+The reference walks one row at a time through pointer-chasing nodes (OMP over
+rows). Here all rows advance in lockstep through a fixed-depth `fori_loop`
+over structure-of-arrays tree nodes — each step is a gather + vectorized
+compare, which XLA maps onto the VPU with fully static shapes.
+
+Two entry points:
+- ``tree_leaf_bins``: traversal over BINNED data (training/valid scores) using
+  integer bin thresholds — exact, no float compares.
+- ``tree_leaf_raw``: traversal over RAW feature values using real thresholds
+  (serving path; mirrors NumericalDecision missing handling).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .split import MISSING_ENUM
+from ..core.tree import TreeArrays
+
+# decision_type bit layout (ref: tree.h kCategoricalMask=1, kDefaultLeftMask=2)
+K_CATEGORICAL_MASK = 1
+K_DEFAULT_LEFT_MASK = 2
+K_ZERO_THRESHOLD = 1e-35
+
+
+def tree_leaf_bins(tree: TreeArrays, bins_t: jnp.ndarray,
+                   feat_num_bin: jnp.ndarray, feat_missing: jnp.ndarray,
+                   feat_default_bin: jnp.ndarray) -> jnp.ndarray:
+    """Leaf index per row for binned data.
+
+    bins_t: [F, R] uint bins; returns i32 [R].
+    """
+    R = bins_t.shape[1]
+    L = tree.max_leaves
+    node = jnp.zeros(R, jnp.int32)          # current internal node
+    leaf = jnp.zeros(R, jnp.int32)
+    active = jnp.broadcast_to(tree.num_leaves > 1, (R,))
+
+    def body(_, carry):
+        node, leaf, active = carry
+        f = tree.split_feature[node]
+        thr = tree.threshold_bin[node]
+        dl = tree.default_left[node]
+        b = bins_t[f, jnp.arange(R)].astype(jnp.int32)
+        nbin = feat_num_bin[f]
+        miss = feat_missing[f]
+        dflt = feat_default_bin[f]
+        go_left = b <= thr
+        is_nan_bin = (miss == MISSING_ENUM["nan"]) & (b == nbin - 1)
+        is_dflt_bin = (miss == MISSING_ENUM["zero"]) & (b == dflt)
+        go_left = jnp.where(is_nan_bin | is_dflt_bin, dl, go_left)
+        child = jnp.where(go_left, tree.left_child[node],
+                          tree.right_child[node])
+        hit_leaf = active & (child < 0)
+        leaf = jnp.where(hit_leaf, -(child + 1), leaf)
+        active = active & (child >= 0)
+        node = jnp.where(active, jnp.maximum(child, 0), node)
+        return node, leaf, active
+
+    node, leaf, active = lax.fori_loop(0, L - 1, body, (node, leaf, active))
+    return leaf
+
+
+def tree_leaf_raw(tree_threshold_real: jnp.ndarray, tree: TreeArrays,
+                  X: jnp.ndarray, feat_orig: jnp.ndarray,
+                  feat_missing: jnp.ndarray) -> jnp.ndarray:
+    """Leaf index per row for raw features.
+
+    X: [R, F_total] float32/64 raw matrix; feat_orig maps inner feature ->
+    original column; returns i32 [R]. Mirrors tree.h NumericalDecision:
+    MissingType::None treats NaN as 0; Zero routes |x|<=kZeroThreshold to the
+    default side; NaN routes NaN to the default side.
+    """
+    R = X.shape[0]
+    L = tree.max_leaves
+    node = jnp.zeros(R, jnp.int32)
+    leaf = jnp.zeros(R, jnp.int32)
+    active = jnp.broadcast_to(tree.num_leaves > 1, (R,))
+
+    def body(_, carry):
+        node, leaf, active = carry
+        f_in = tree.split_feature[node]
+        f = feat_orig[f_in]
+        thr = tree_threshold_real[node]
+        dl = tree.default_left[node]
+        miss = feat_missing[f_in]
+        x = X[jnp.arange(R), f]
+        isnan = jnp.isnan(x)
+        x0 = jnp.where(isnan, 0.0, x)
+        le = x0 <= thr
+        is_missing = jnp.where(miss == MISSING_ENUM["nan"], isnan,
+                               (miss == MISSING_ENUM["zero"]) &
+                               (jnp.abs(x0) <= K_ZERO_THRESHOLD))
+        go_left = jnp.where(is_missing, dl, le)
+        child = jnp.where(go_left, tree.left_child[node],
+                          tree.right_child[node])
+        hit_leaf = active & (child < 0)
+        leaf = jnp.where(hit_leaf, -(child + 1), leaf)
+        active = active & (child >= 0)
+        node = jnp.where(active, jnp.maximum(child, 0), node)
+        return node, leaf, active
+
+    node, leaf, active = lax.fori_loop(0, L - 1, body, (node, leaf, active))
+    return leaf
+
+
+def tree_output_bins(tree: TreeArrays, bins_t, feat_num_bin, feat_missing,
+                     feat_default_bin) -> jnp.ndarray:
+    """Per-row output of one tree over binned data (leaf values already
+    include shrinkage — ref: Tree::AddPredictionToScore after Shrinkage)."""
+    leaf = tree_leaf_bins(tree, bins_t, feat_num_bin, feat_missing,
+                          feat_default_bin)
+    return tree.leaf_value[leaf]
