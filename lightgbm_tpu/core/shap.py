@@ -1,0 +1,204 @@
+"""TreeSHAP feature contributions (pred_contrib).
+
+TPU-native equivalent of the reference SHAP path
+(ref: include/LightGBM/tree.h ExpectedValue/TreeSHAP declarations,
+src/io/tree.cpp TreeSHAP recursion — Lundberg & Lee's exact polynomial-time
+algorithm over decision paths; exposed via predict(pred_contrib=True),
+c_api.cpp PredictType kPredictContrib).
+
+Implementation is the standard EXTEND/UNWIND path-polynomial recursion,
+written against our structure-of-arrays HostTree.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .tree import HostTree
+
+
+class _PathElement:
+    __slots__ = ("feature_index", "zero_fraction", "one_fraction",
+                 "pweight")
+
+    def __init__(self, f=-1, z=1.0, o=1.0, w=1.0):
+        self.feature_index = f
+        self.zero_fraction = z
+        self.one_fraction = o
+        self.pweight = w
+
+
+def _extend(path: List[_PathElement], unique_depth: int,
+            zero_fraction: float, one_fraction: float,
+            feature_index: int) -> None:
+    path[unique_depth].feature_index = feature_index
+    path[unique_depth].zero_fraction = zero_fraction
+    path[unique_depth].one_fraction = one_fraction
+    path[unique_depth].pweight = 1.0 if unique_depth == 0 else 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        path[i + 1].pweight += (one_fraction * path[i].pweight * (i + 1)
+                                / (unique_depth + 1))
+        path[i].pweight = (zero_fraction * path[i].pweight *
+                           (unique_depth - i) / (unique_depth + 1))
+
+
+def _unwind(path: List[_PathElement], unique_depth: int,
+            path_index: int) -> None:
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = path[i].pweight
+            path[i].pweight = (next_one_portion * (unique_depth + 1)
+                               / ((i + 1) * one_fraction))
+            next_one_portion = tmp - path[i].pweight * zero_fraction * \
+                (unique_depth - i) / (unique_depth + 1)
+        else:
+            path[i].pweight = (path[i].pweight * (unique_depth + 1) /
+                               (zero_fraction * (unique_depth - i)))
+    for i in range(path_index, unique_depth):
+        path[i].feature_index = path[i + 1].feature_index
+        path[i].zero_fraction = path[i + 1].zero_fraction
+        path[i].one_fraction = path[i + 1].one_fraction
+
+
+def _unwound_path_sum(path: List[_PathElement], unique_depth: int,
+                      path_index: int) -> float:
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    total = 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = (next_one_portion * (unique_depth + 1)
+                   / ((i + 1) * one_fraction))
+            total += tmp
+            next_one_portion = path[i].pweight - tmp * zero_fraction * \
+                ((unique_depth - i) / (unique_depth + 1))
+        else:
+            total += (path[i].pweight / zero_fraction) / \
+                ((unique_depth - i) / (unique_depth + 1))
+    return total
+
+
+def _expected_value(t: HostTree, node: int) -> float:
+    """Weighted mean of leaf values below node (ref: Tree::ExpectedValue)."""
+    if node < 0:
+        return float(t.leaf_value[-(node + 1)])
+    lw = _subtree_weight(t, int(t.left_child[node]))
+    rw = _subtree_weight(t, int(t.right_child[node]))
+    tot = lw + rw
+    if tot <= 0:
+        return 0.0
+    return (lw * _expected_value(t, int(t.left_child[node])) +
+            rw * _expected_value(t, int(t.right_child[node]))) / tot
+
+
+def _subtree_weight(t: HostTree, node: int) -> float:
+    if node < 0:
+        return float(t.leaf_count[-(node + 1)])
+    return float(t.internal_count[node])
+
+
+def _decision_path(t: HostTree, node: int, x: np.ndarray) -> bool:
+    """Which child does row x take at internal node? (hot/cold)."""
+    f = int(t.split_feature[node])
+    dt = int(t.decision_type[node])
+    val = x[f]
+    isnan = np.isnan(val)
+    dl = bool(dt & 2)
+    mtype = (dt >> 2) & 3
+    if dt & 1:  # categorical: interim ordered-bin decision
+        mapping = t.cat_value_to_bin.get(f, {})
+        b = mapping.get(-1 if isnan else int(0.0 if isnan else val), 0)
+        return b <= t.threshold_real[node]
+    if mtype == 2 and isnan:
+        return dl
+    v0 = 0.0 if isnan else val
+    if mtype == 1 and abs(v0) <= 1e-35:
+        return dl
+    return v0 <= t.threshold_real[node]
+
+
+def _tree_shap(t: HostTree, x: np.ndarray, phi: np.ndarray, node: int,
+               unique_depth: int, parent_path: List[_PathElement],
+               parent_zero_fraction: float, parent_one_fraction: float,
+               parent_feature_index: int) -> None:
+    """ref: Tree::TreeSHAP recursion (src/io/tree.cpp)."""
+    path = [
+        _PathElement() for _ in range(unique_depth + 1)
+    ]
+    for i in range(unique_depth):
+        src = parent_path[i]
+        path[i].feature_index = src.feature_index
+        path[i].zero_fraction = src.zero_fraction
+        path[i].one_fraction = src.one_fraction
+        path[i].pweight = src.pweight
+    _extend(path, unique_depth, parent_zero_fraction, parent_one_fraction,
+            parent_feature_index)
+
+    if node < 0:  # leaf
+        leaf = -(node + 1)
+        for i in range(1, unique_depth + 1):
+            w = _unwound_path_sum(path, unique_depth, i)
+            el = path[i]
+            phi[el.feature_index] += w * (el.one_fraction -
+                                          el.zero_fraction) * \
+                float(t.leaf_value[leaf])
+        return
+
+    hot_left = _decision_path(t, node, x)
+    hot = int(t.left_child[node]) if hot_left else int(t.right_child[node])
+    cold = int(t.right_child[node]) if hot_left else int(t.left_child[node])
+    w_node = _subtree_weight(t, node)
+    hot_zero_fraction = _subtree_weight(t, hot) / w_node if w_node else 0.0
+    cold_zero_fraction = _subtree_weight(t, cold) / w_node if w_node else 0.0
+    incoming_zero_fraction = 1.0
+    incoming_one_fraction = 1.0
+
+    # dedup features on the path
+    f = int(t.split_feature[node])
+    path_index = next((i for i in range(unique_depth + 1)
+                       if path[i].feature_index == f), unique_depth + 1)
+    if path_index <= unique_depth:
+        incoming_zero_fraction = path[path_index].zero_fraction
+        incoming_one_fraction = path[path_index].one_fraction
+        _unwind(path, unique_depth, path_index)
+        unique_depth -= 1
+
+    _tree_shap(t, x, phi, hot, unique_depth + 1, path,
+               hot_zero_fraction * incoming_zero_fraction,
+               incoming_one_fraction, f)
+    _tree_shap(t, x, phi, cold, unique_depth + 1, path,
+               cold_zero_fraction * incoming_zero_fraction, 0.0, f)
+
+
+def shap_one_tree(t: HostTree, x: np.ndarray, num_features: int
+                  ) -> np.ndarray:
+    """phi[num_features + 1]; last slot is the expected value (bias)."""
+    phi = np.zeros(num_features + 1)
+    if t.num_leaves <= 1:
+        phi[-1] += float(t.leaf_value[0])
+        return phi
+    phi[-1] += _expected_value(t, 0)
+    _tree_shap(t, x, phi, 0, 0, [], 1.0, 1.0, -1)
+    return phi
+
+
+def predict_contrib(engine, X: np.ndarray, start_iteration: int,
+                    end_iteration: int) -> np.ndarray:
+    """SHAP contributions [N, (F+1)*K] (ref: PredictType kPredictContrib,
+    layout matches the reference: per-class blocks of F+1)."""
+    K = engine.num_tree_per_iteration
+    F = engine.max_feature_idx + 1
+    N = X.shape[0]
+    out = np.zeros((N, (F + 1) * K))
+    for it in range(start_iteration, end_iteration):
+        for k in range(K):
+            t = engine.models[it * K + k]
+            base = k * (F + 1)
+            for r in range(N):
+                out[r, base:base + F + 1] += shap_one_tree(t, X[r], F)
+    return out.reshape(N, -1) if K > 1 else out

@@ -1,0 +1,396 @@
+"""LightGBM-compatible model text format: save / load / JSON dump.
+
+TPU-native equivalent of src/boosting/gbdt_model_text.cpp
+(ref: SaveModelToString :315 — header fields, per-tree blocks with
+tree_sizes index :359-369, feature importances :377, parameters block
+:399-403; LoadModelFromString :425; Tree::ToString src/io/tree.cpp:344,
+Tree(const char*) parser tree.cpp:640+; JSON dump DumpModel :37).
+
+The on-disk format matches the reference so models round-trip between the
+two implementations (same keys, same ordering, same `tree_sizes=` index).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..core.tree import HostTree
+from ..utils import log
+
+K_MODEL_VERSION = "v4"
+
+
+def _arr_to_str(arr, fmt="{}") -> str:
+    return " ".join(fmt.format(v) for v in arr)
+
+
+def _float_str(v: float) -> str:
+    """High-precision float used for thresholds/leaf values
+    (ref: ArrayToString<true> uses max_digits10)."""
+    return np.format_float_repr(float(v))
+
+
+def np_format(v):
+    return repr(float(v))
+
+
+def _tree_to_string(t: HostTree) -> str:
+    """ref: Tree::ToString (src/io/tree.cpp:344)."""
+    n = t.num_leaves
+    ni = n - 1
+    lines = [f"num_leaves={n}", f"num_cat={t.num_cat}"]
+    lines.append("split_feature=" + _arr_to_str(t.split_feature[:ni]))
+    lines.append("split_gain=" + _arr_to_str(
+        [f"{v:g}" for v in t.split_gain[:ni]]))
+    lines.append("threshold=" + " ".join(
+        repr(float(v)) for v in t.threshold_real[:ni]))
+    lines.append("decision_type=" + _arr_to_str(t.decision_type[:ni]))
+    lines.append("left_child=" + _arr_to_str(t.left_child[:ni]))
+    lines.append("right_child=" + _arr_to_str(t.right_child[:ni]))
+    lines.append("leaf_value=" + " ".join(
+        repr(float(v)) for v in t.leaf_value[:n]))
+    lines.append("leaf_weight=" + " ".join(
+        repr(float(v)) for v in t.leaf_weight[:n]))
+    lines.append("leaf_count=" + _arr_to_str(
+        np.asarray(t.leaf_count[:n], np.int64)))
+    lines.append("internal_value=" + _arr_to_str(
+        [f"{v:g}" for v in t.internal_value[:ni]]))
+    lines.append("internal_weight=" + _arr_to_str(
+        [f"{v:g}" for v in t.internal_weight[:ni]]))
+    lines.append("internal_count=" + _arr_to_str(
+        np.asarray(t.internal_count[:ni], np.int64)))
+    if t.num_cat > 0:
+        lines.append("cat_boundaries=" + _arr_to_str(t.cat_boundaries))
+        lines.append("cat_threshold=" + _arr_to_str(t.cat_threshold))
+    lines.append(f"is_linear={int(t.is_linear)}")
+    lines.append(f"shrinkage={t.shrinkage:g}")
+    # non-standard extension: interim ordered-bin categorical mapping
+    if t.cat_value_to_bin:
+        packed = ";".join(
+            f"{f}:" + ",".join(f"{c}={b}" for c, b in sorted(m.items()))
+            for f, m in sorted(t.cat_value_to_bin.items()))
+        lines.append(f"cat_value_to_bin={packed}")
+    return "\n".join(lines) + "\n"
+
+
+def model_to_string(engine, config: Config,
+                    num_iteration: Optional[int] = None,
+                    start_iteration: int = 0,
+                    importance_type: str = "split") -> str:
+    """ref: GBDT::SaveModelToString (gbdt_model_text.cpp:315)."""
+    K = engine.num_tree_per_iteration
+    obj = engine.objective
+    num_class = getattr(obj, "num_class", 1) if obj is not None else K
+
+    lines = ["tree", f"version={K_MODEL_VERSION}",
+             f"num_class={num_class}",
+             f"num_tree_per_iteration={K}",
+             f"label_index={engine.label_idx}",
+             f"max_feature_idx={engine.max_feature_idx}"]
+    if obj is not None:
+        lines.append(f"objective={obj.to_string()}")
+    if engine.average_output:
+        lines.append("average_output")
+    lines.append("feature_names=" + " ".join(engine.feature_names))
+    lines.append("feature_infos=" + " ".join(engine.feature_infos))
+
+    total_iteration = len(engine.models) // max(K, 1)
+    start_iteration = min(max(start_iteration, 0), total_iteration)
+    num_used_model = len(engine.models)
+    if num_iteration is not None and num_iteration > 0:
+        num_used_model = min((start_iteration + num_iteration) * K,
+                             num_used_model)
+    start_model = start_iteration * K
+
+    tree_strs = []
+    for i in range(start_model, num_used_model):
+        s = f"Tree={i - start_model}\n" + _tree_to_string(engine.models[i]) \
+            + "\n"
+        tree_strs.append(s)
+    lines.append("tree_sizes=" + " ".join(str(len(s)) for s in tree_strs))
+    lines.append("")
+    body = "\n".join(lines)
+    body += "\n" + "".join(tree_strs)
+    body += "end of trees\n"
+
+    # feature importances (ref: :377)
+    imp = np.zeros(engine.max_feature_idx + 1)
+    for t in engine.models[start_model:num_used_model]:
+        for i in range(t.num_leaves - 1):
+            if importance_type == "split":
+                if t.split_gain[i] > 0:
+                    imp[int(t.split_feature[i])] += 1
+            else:
+                imp[int(t.split_feature[i])] += max(t.split_gain[i], 0.0)
+    pairs = [(int(imp[i]), engine.feature_names[i])
+             for i in np.argsort(-imp, kind="stable") if imp[i] > 0]
+    body += "\nfeature_importances:\n"
+    for v, name in pairs:
+        body += f"{name}={v}\n"
+
+    body += "\nparameters:\n" + config.to_string() + \
+        "\nend of parameters\n"
+    return body
+
+
+def save_model_file(engine, config: Config, filename: str,
+                    num_iteration: Optional[int] = None,
+                    start_iteration: int = 0,
+                    importance_type: str = "split") -> None:
+    with open(filename, "w") as f:
+        f.write(model_to_string(engine, config, num_iteration,
+                                start_iteration, importance_type))
+
+
+# ---------------------------------------------------------------------------
+# Loading (ref: GBDT::LoadModelFromString gbdt_model_text.cpp:425,
+# Tree::Tree(const char*, size_t*) tree.cpp)
+# ---------------------------------------------------------------------------
+
+def _parse_kv_block(lines: List[str]) -> Dict[str, str]:
+    out = {}
+    for ln in lines:
+        if "=" in ln:
+            k, _, v = ln.partition("=")
+            out[k.strip()] = v.strip()
+    return out
+
+
+def _tree_from_block(block: Dict[str, str]) -> HostTree:
+    n = int(block["num_leaves"])
+    t = HostTree.constant(0.0)
+    t.num_leaves = n
+    ni = max(n - 1, 0)
+
+    def ints(key, count):
+        if count == 0 or key not in block or not block[key]:
+            return np.zeros(count, np.int32)
+        return np.asarray([int(float(x)) for x in block[key].split()],
+                          np.int32)
+
+    def floats(key, count):
+        if count == 0 or key not in block or not block[key]:
+            return np.zeros(count, np.float64)
+        return np.asarray([float(x) for x in block[key].split()], np.float64)
+
+    t.split_feature = ints("split_feature", ni)
+    t.split_feature_inner = t.split_feature.copy()
+    t.split_gain = floats("split_gain", ni)
+    t.threshold_real = floats("threshold", ni)
+    t.threshold_bin = np.zeros(ni, np.int32)
+    t.decision_type = ints("decision_type", ni)
+    t.default_left = (t.decision_type & 2) != 0
+    t.left_child = ints("left_child", ni)
+    t.right_child = ints("right_child", ni)
+    t.leaf_value = floats("leaf_value", n)
+    t.leaf_weight = floats("leaf_weight", n)
+    t.leaf_count = ints("leaf_count", n).astype(np.int64)
+    t.internal_value = floats("internal_value", ni)
+    t.internal_weight = floats("internal_weight", ni)
+    t.internal_count = ints("internal_count", ni).astype(np.int64)
+    t.num_cat = int(block.get("num_cat", 0))
+    t.is_linear = bool(int(block.get("is_linear", 0)))
+    t.shrinkage = float(block.get("shrinkage", 1.0))
+    t.leaf_parent = np.full(n, -1, np.int32)
+    if "cat_value_to_bin" in block and block["cat_value_to_bin"]:
+        maps = {}
+        for part in block["cat_value_to_bin"].split(";"):
+            fs, _, kvs = part.partition(":")
+            maps[int(fs)] = {
+                int(c): int(b) for c, b in
+                (kv.split("=") for kv in kvs.split(",") if kv)}
+        t.cat_value_to_bin = maps
+    if t.num_cat > 0:
+        t.cat_boundaries = ints("cat_boundaries", t.num_cat + 1)
+        nthr = t.cat_boundaries[-1] if len(t.cat_boundaries) else 0
+        t.cat_threshold = ints("cat_threshold", int(nthr)).astype(np.uint32)
+    t.from_text = True  # threshold_bin/inner indices need rebinding
+    return t
+
+
+class _LoadedEngine:
+    """Minimal engine facade for a model loaded from text: supports
+    predict / save / dump / importance without training state
+    (ref: prediction-only Booster, c_api.cpp LGBM_BoosterCreateFromModelfile).
+    """
+
+    def __init__(self):
+        self.models: List[HostTree] = []
+        self.num_tree_per_iteration = 1
+        self.objective = None
+        self.average_output = False
+        self.max_feature_idx = 0
+        self.label_idx = 0
+        self.feature_names: List[str] = []
+        self.feature_infos: List[str] = []
+        self.config = Config()
+        self.train_metrics: List = []
+        self.valid_sets: List = []
+        self.iter = 0
+
+    def current_iteration(self) -> int:
+        return len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    def eval_train(self):
+        return []
+
+    def eval_valid(self):
+        return []
+
+
+def load_model_string(model_str: str) -> Tuple[_LoadedEngine, Config]:
+    """ref: GBDT::LoadModelFromString (gbdt_model_text.cpp:425)."""
+    lines = model_str.split("\n")
+    # split header (up to first Tree=) and tree blocks
+    try:
+        first_tree = next(i for i, ln in enumerate(lines)
+                          if ln.startswith("Tree="))
+    except StopIteration:
+        first_tree = len(lines)
+    header = _parse_kv_block(lines[:first_tree])
+    eng = _LoadedEngine()
+    eng.num_tree_per_iteration = int(header.get("num_tree_per_iteration", 1))
+    eng.max_feature_idx = int(header.get("max_feature_idx", 0))
+    eng.label_idx = int(header.get("label_index", 0))
+    eng.feature_names = header.get("feature_names", "").split()
+    eng.feature_infos = header.get("feature_infos", "").split()
+    eng.average_output = any(
+        ln.strip() == "average_output" for ln in lines[:first_tree])
+
+    obj_str = header.get("objective", "")
+    if obj_str:
+        eng.objective = _objective_from_string(obj_str)
+
+    # parameters block -> Config (for later continued training)
+    cfg = Config()
+    try:
+        p_start = lines.index("parameters:")
+        p_end = lines.index("end of parameters")
+        params = {}
+        for ln in lines[p_start + 1:p_end]:
+            ln = ln.strip()
+            if ln.startswith("[") and ln.endswith("]") and ": " in ln:
+                k, _, v = ln[1:-1].partition(": ")
+                params[k] = v
+        keep = {k: v for k, v in params.items()
+                if k not in ("objective",)}
+        cfg = Config(keep)
+    except ValueError:
+        pass
+
+    # tree blocks
+    i = first_tree
+    current: List[str] = []
+    for ln in lines[first_tree:]:
+        if ln.startswith("Tree="):
+            if current:
+                eng.models.append(_tree_from_block(_parse_kv_block(current)))
+            current = []
+        elif ln.strip() == "end of trees":
+            if current:
+                eng.models.append(_tree_from_block(_parse_kv_block(current)))
+            current = []
+            break
+        elif ln.strip():
+            current.append(ln)
+    return eng, cfg
+
+
+def load_model_file(filename: str) -> Tuple[_LoadedEngine, Config]:
+    with open(filename) as f:
+        return load_model_string(f.read())
+
+
+def _objective_from_string(s: str):
+    """Rebuild an objective from its model-file string
+    (ref: ObjectiveFunction::CreateObjectiveFunction(str) overload)."""
+    from ..core.objective import create_objective
+    parts = s.split()
+    name = parts[0]
+    kv = {}
+    for p in parts[1:]:
+        if ":" in p:
+            k, _, v = p.partition(":")
+            kv[k] = v
+    params = {"objective": name}
+    if "num_class" in kv:
+        params["num_class"] = int(kv["num_class"])
+    if "sigmoid" in kv:
+        params["sigmoid"] = float(kv["sigmoid"])
+    cfg = Config(params)
+    obj = create_objective(name, cfg)
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# JSON dump (ref: GBDT::DumpModel gbdt_model_text.cpp:37)
+# ---------------------------------------------------------------------------
+
+def _node_to_dict(t: HostTree, node: int, feature_names: List[str]) -> Dict:
+    if node < 0:  # leaf
+        leaf = -(node + 1)
+        return {
+            "leaf_index": int(leaf),
+            "leaf_value": float(t.leaf_value[leaf]),
+            "leaf_weight": float(t.leaf_weight[leaf]),
+            "leaf_count": int(t.leaf_count[leaf]),
+        }
+    dt = int(t.decision_type[node])
+    return {
+        "split_index": int(node),
+        "split_feature": int(t.split_feature[node]),
+        "split_gain": float(t.split_gain[node]),
+        "threshold": float(t.threshold_real[node]),
+        "decision_type": "==" if (dt & 1) else "<=",
+        "default_left": bool(dt & 2),
+        "missing_type": ["None", "Zero", "NaN", "NaN"][(dt >> 2) & 3],
+        "internal_value": float(t.internal_value[node]),
+        "internal_weight": float(t.internal_weight[node]),
+        "internal_count": int(t.internal_count[node]),
+        "left_child": _node_to_dict(t, int(t.left_child[node]),
+                                    feature_names),
+        "right_child": _node_to_dict(t, int(t.right_child[node]),
+                                     feature_names),
+    }
+
+
+def dump_model_dict(engine, config: Config,
+                    num_iteration: Optional[int] = None,
+                    start_iteration: int = 0,
+                    importance_type: str = "split") -> Dict:
+    K = engine.num_tree_per_iteration
+    obj = engine.objective
+    total_iteration = len(engine.models) // max(K, 1)
+    start_iteration = min(max(start_iteration, 0), total_iteration)
+    num_used = len(engine.models)
+    if num_iteration is not None and num_iteration > 0:
+        num_used = min((start_iteration + num_iteration) * K, num_used)
+
+    trees = []
+    for i in range(start_iteration * K, num_used):
+        t = engine.models[i]
+        trees.append({
+            "tree_index": i,
+            "num_leaves": t.num_leaves,
+            "num_cat": t.num_cat,
+            "shrinkage": t.shrinkage,
+            "tree_structure": (_node_to_dict(t, 0, engine.feature_names)
+                               if t.num_leaves > 1 else
+                               _node_to_dict(t, -1, engine.feature_names)),
+        })
+    return {
+        "name": "tree",
+        "version": K_MODEL_VERSION,
+        "num_class": getattr(obj, "num_class", 1) if obj else K,
+        "num_tree_per_iteration": K,
+        "label_index": engine.label_idx,
+        "max_feature_idx": engine.max_feature_idx,
+        "objective": obj.to_string() if obj else "",
+        "average_output": engine.average_output,
+        "feature_names": list(engine.feature_names),
+        "feature_infos": list(engine.feature_infos),
+        "tree_info": trees,
+    }

@@ -444,6 +444,27 @@ class GBDT:
         K = self.num_tree_per_iteration
         self.models = [t.copy() for t in other.models]
         self.num_init_iteration = len(self.models) // max(K, 1)
+        # trees loaded from model text carry ORIGINAL feature indices and
+        # real thresholds; rebind them to this dataset's inner indices/bins
+        inner_of = {int(orig): i for i, orig in
+                    enumerate(self.train_set.used_feature_map)}
+        mappers = self.train_set.bin_mappers
+        for t in self.models:
+            if not getattr(t, "from_text", False):
+                continue
+            for i in range(t.num_leaves - 1):
+                f = int(t.split_feature[i])
+                if f not in inner_of:
+                    log.fatal(f"init model splits on feature {f} which is "
+                              "trivial/absent in the new training data")
+                t.split_feature_inner[i] = inner_of[f]
+                m = mappers[f]
+                if m.bin_type == "numerical":
+                    t.threshold_bin[i] = int(
+                        m.value_to_bin(np.asarray([t.threshold_real[i]]))[0])
+                else:
+                    t.threshold_bin[i] = int(t.threshold_real[i])
+            t.from_text = False
         for i, t in enumerate(self.models):
             k = i % K
             self.score = self.score.at[k].add(

@@ -1,0 +1,133 @@
+"""Model text format round-trip tests (ref: the reference's model-file
+round-trip tier — tests/python_package_test/test_basic.py save/load)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(rng, n=1500, f=8):
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] * 2 + np.sin(X[:, 1] * 3) + rng.normal(scale=0.1, size=n)
+    return X, y
+
+
+def test_model_string_roundtrip(rng, tmp_path):
+    X, y = _data(rng)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=12)
+    s = bst.model_to_string()
+    assert s.startswith("tree\nversion=v4\n")
+    assert "end of trees" in s
+    assert "feature_importances:" in s
+    assert "parameters:" in s
+
+    bst2 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X),
+                               rtol=1e-12, atol=1e-12)
+
+    path = tmp_path / "model.txt"
+    bst.save_model(path)
+    bst3 = lgb.Booster(model_file=str(path))
+    np.testing.assert_allclose(bst.predict(X), bst3.predict(X),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_binary_model_roundtrip(rng):
+    X, y = _data(rng)
+    yb = (y > np.median(y)).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=yb),
+                    num_boost_round=10)
+    bst2 = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X), rtol=1e-10)
+    # transformed output still sigmoid
+    p = bst2.predict(X)
+    assert (p >= 0).all() and (p <= 1).all()
+
+
+def test_multiclass_model_roundtrip(rng):
+    X, _ = _data(rng, n=900)
+    y = rng.integers(0, 3, size=900).astype(np.float64)
+    X[:, 0] += y * 2  # separable signal
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    bst2 = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X), rtol=1e-10)
+
+
+def test_continue_training_from_file(rng, tmp_path):
+    X, y = _data(rng)
+    params = {"objective": "regression", "num_leaves": 15, "verbosity": -1}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8)
+    path = tmp_path / "m.txt"
+    bst.save_model(path)
+    bst2 = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8,
+                     init_model=str(path))
+    assert bst2.num_trees() == 16
+    mse1 = float(np.mean((bst.predict(X) - y) ** 2))
+    mse2 = float(np.mean((bst2.predict(X) - y) ** 2))
+    assert mse2 < mse1
+
+
+def test_dump_model(rng):
+    X, y = _data(rng, n=800)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=3)
+    d = bst.dump_model()
+    assert d["version"] == "v4"
+    assert len(d["tree_info"]) == 3
+    ts = d["tree_info"][0]["tree_structure"]
+    assert "split_feature" in ts and "left_child" in ts
+
+
+def test_num_iteration_predict(rng):
+    X, y = _data(rng)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=20)
+    p5 = bst.predict(X, num_iteration=5)
+    p20 = bst.predict(X)
+    assert not np.allclose(p5, p20)
+    mse5 = np.mean((p5 - y) ** 2)
+    mse20 = np.mean((p20 - y) ** 2)
+    assert mse20 < mse5
+
+
+def test_pred_leaf(rng):
+    X, y = _data(rng, n=600)
+    bst = lgb.train({"objective": "regression", "num_leaves": 8,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=5)
+    leaves = bst.predict(X, pred_leaf=True)
+    assert leaves.shape == (600, 5)
+    assert leaves.max() < 8
+    assert leaves.min() >= 0
+
+
+def test_pred_contrib_sums_to_prediction(rng):
+    X, y = _data(rng, n=300)
+    bst = lgb.train({"objective": "regression", "num_leaves": 8,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=5)
+    contrib = bst.predict(X, pred_contrib=True)
+    assert contrib.shape == (300, X.shape[1] + 1)
+    raw = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-6,
+                               atol=1e-6)
+
+
+def test_feature_importance(rng):
+    X, y = _data(rng)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=10)
+    imp_split = bst.feature_importance("split")
+    imp_gain = bst.feature_importance("gain")
+    assert imp_split.dtype == np.int64
+    assert imp_split.sum() > 0
+    # features 0 and 1 carry the signal
+    assert imp_gain[0] > imp_gain[3]
