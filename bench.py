@@ -1,0 +1,78 @@
+"""Benchmark harness: Higgs-style boosting throughput on the current backend.
+
+Mirrors the reference's headline benchmark (docs/Experiments.rst:82-134 —
+Higgs 10.5M rows x 28 features, num_leaves=255, lr=0.1, 500 iters, 130.1 s on
+a 16-thread CPU => 3.84 iters/sec). Rows are synthetic with the same shape
+and a learnable binary signal; data prep/binning is excluded from the timed
+region, matching the reference's convention of reporting training time.
+
+`vs_baseline` scales the reference CPU throughput linearly to the benched row
+count (per-iteration cost in histogram GBDT is ~linear in rows at fixed
+leaves/bins): ref_ips(N) = 3.843 * (10.5e6 / N).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "iters/sec", "vs_baseline": N}
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+N_FEATURES = 28
+NUM_LEAVES = 255
+MAX_BIN = 255
+WARMUP_ITERS = 3
+TIMED_ITERS = int(os.environ.get("BENCH_ITERS", 20))
+REF_HIGGS_IPS = 500.0 / 130.094     # docs/Experiments.rst:113
+REF_HIGGS_ROWS = 10_500_000
+
+
+def synth_higgs(n, f, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    logits = (X[:, 0] - 0.5 * X[:, 1] * X[:, 2] + 0.25 * X[:, 3] ** 2
+              + 0.1 * rng.normal(size=n))
+    y = (logits > np.median(logits)).astype(np.float32)
+    return X, y
+
+
+def main():
+    import lightgbm_tpu as lgb
+
+    X, y = synth_higgs(N_ROWS, N_FEATURES)
+    params = {
+        "objective": "binary",
+        "num_leaves": NUM_LEAVES,
+        "learning_rate": 0.1,
+        "max_bin": MAX_BIN,
+        "min_data_in_leaf": 20,
+        "verbose": -1,
+    }
+    ds = lgb.Dataset(X, label=y)
+    booster = lgb.Booster(params, ds)
+    for _ in range(WARMUP_ITERS):      # compile + cache warm
+        booster.update()
+
+    import jax
+    jax.block_until_ready(booster._engine.score)
+    t0 = time.perf_counter()
+    for _ in range(TIMED_ITERS):
+        booster.update()
+    jax.block_until_ready(booster._engine.score)
+    dt = time.perf_counter() - t0
+
+    ips = TIMED_ITERS / dt
+    ref_ips_at_n = REF_HIGGS_IPS * (REF_HIGGS_ROWS / N_ROWS)
+    print(json.dumps({
+        "metric": f"higgs_synth_{N_ROWS}x{N_FEATURES}_iters_per_sec",
+        "value": round(ips, 4),
+        "unit": "iters/sec",
+        "vs_baseline": round(ips / ref_ips_at_n, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

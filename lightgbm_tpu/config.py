@@ -219,7 +219,7 @@ _reg("num_gpu", int, 1, (), (0, None, False, False))
 # TPU mesh shape for distributed training: rows are sharded over 'data' axis.
 _reg("tpu_num_devices", int, 0, ())          # 0 = use all visible devices
 _reg("tpu_hist_dtype", str, "float32", ())   # histogram accumulator dtype
-_reg("tpu_use_pallas", bool, True, ())       # use Pallas histogram kernel on TPU
+_reg("tpu_use_pallas", bool, False, ())      # Pallas histogram kernel (off until tuned)
 _reg("tpu_rows_per_block", int, 1024, ())    # row tile for histogram kernels
 _reg("tpu_donate_state", bool, True, ())     # donate training state buffers
 

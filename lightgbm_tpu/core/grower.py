@@ -82,6 +82,10 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     L = cfg.num_leaves
     B = cfg.num_bin
     hist_fn = make_hist_fn(cfg.hist_backend, B, cfg.block_rows)
+    # Distributed mode: the per-split histogram pass contains a collective
+    # (psum over the mesh's data axis), which must not sit inside a lax.cond
+    # branch — replaced by masking so every device executes it symmetrically.
+    distributed = reduce_hist is not None
     if reduce_hist is None:
         reduce_hist = lambda h: h
     if reduce_sums is None:
@@ -216,10 +220,15 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             # (ref: serial_tree_learner.cpp:368-386 + FeatureHistogram::Subtract)
             left_smaller = rec.left_count <= rec.right_count
             small_leaf = jnp.where(left_smaller, l, new_leaf)
-            hist_small = lax.cond(
-                proceed,
-                lambda: leaf_hist(bins_t, gh, leaf_id, small_leaf),
-                lambda: jnp.zeros((F, B, 3), jnp.float32))
+            if distributed:
+                # mask instead of branch: dead steps contribute psum(0)
+                gh_live = gh * proceed.astype(gh.dtype)
+                hist_small = leaf_hist(bins_t, gh_live, leaf_id, small_leaf)
+            else:
+                hist_small = lax.cond(
+                    proceed,
+                    lambda: leaf_hist(bins_t, gh, leaf_id, small_leaf),
+                    lambda: jnp.zeros((F, B, 3), jnp.float32))
             hist_parent = state.hist[l]
             hist_large = hist_parent - hist_small
             hist_left = jnp.where(left_smaller, hist_small, hist_large)

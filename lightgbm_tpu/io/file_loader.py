@@ -118,9 +118,13 @@ def load_svm_or_csv(path: str, config: Config
             group = np.loadtxt(path + ext, dtype=np.int64).reshape(-1)
             break
     if group is None and group_raw is not None:
-        # group column holds per-row query ids -> convert to counts
-        _, counts = np.unique(group_raw, return_counts=True)
-        group = counts
+        # group column holds per-row query ids -> run-length counts in ROW
+        # order (qids must be contiguous; ref: Metadata::SetQueryId)
+        change = np.flatnonzero(group_raw[1:] != group_raw[:-1]) + 1
+        starts = np.concatenate([[0], change, [len(group_raw)]])
+        group = np.diff(starts)
+        if len(np.unique(group_raw)) != len(group):
+            log.fatal("Query ids in the group column must be contiguous")
     return X, y, weight, group
 
 

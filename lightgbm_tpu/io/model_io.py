@@ -27,16 +27,6 @@ def _arr_to_str(arr, fmt="{}") -> str:
     return " ".join(fmt.format(v) for v in arr)
 
 
-def _float_str(v: float) -> str:
-    """High-precision float used for thresholds/leaf values
-    (ref: ArrayToString<true> uses max_digits10)."""
-    return np.format_float_repr(float(v))
-
-
-def np_format(v):
-    return repr(float(v))
-
-
 def _tree_to_string(t: HostTree) -> str:
     """ref: Tree::ToString (src/io/tree.cpp:344)."""
     n = t.num_leaves
@@ -125,7 +115,10 @@ def model_to_string(engine, config: Config,
                     imp[int(t.split_feature[i])] += 1
             else:
                 imp[int(t.split_feature[i])] += max(t.split_gain[i], 0.0)
-    pairs = [(int(imp[i]), engine.feature_names[i])
+    # split importances are integer counts; gain importances are doubles
+    # (ref: gbdt_model_text.cpp:377 FeatureImportance written as-is)
+    cast = int if importance_type == "split" else lambda v: repr(float(v))
+    pairs = [(cast(imp[i]), engine.feature_names[i])
              for i in np.argsort(-imp, kind="stable") if imp[i] > 0]
     body += "\nfeature_importances:\n"
     for v, name in pairs:

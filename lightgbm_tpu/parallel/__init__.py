@@ -1,0 +1,17 @@
+"""Distributed training over jax device meshes.
+
+TPU-native replacement for the reference's parallel learners + Network stack
+(ref: src/treelearner/parallel_tree_learner.h, src/network/ — SURVEY.md §2.3,
+§2.4). machine_list/ports become a `Mesh`; socket/MPI collectives become XLA
+collectives over ICI/DCN.
+"""
+from .mesh import (DATA_AXIS, FEATURE_AXIS, build_mesh, pad_rows_np,
+                   padded_rows, replicated, row_sharding)
+from .data_parallel import (make_data_parallel_grower,
+                            make_distributed_train_step)
+
+__all__ = [
+    "DATA_AXIS", "FEATURE_AXIS", "build_mesh", "padded_rows", "pad_rows_np",
+    "row_sharding", "replicated",
+    "make_data_parallel_grower", "make_distributed_train_step",
+]

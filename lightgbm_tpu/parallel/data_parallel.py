@@ -1,0 +1,100 @@
+"""Row-sharded (data-parallel) tree growing over a device mesh.
+
+TPU-native equivalent of DataParallelTreeLearner
+(ref: src/treelearner/data_parallel_tree_learner.cpp; comm pattern per
+SURVEY.md §3.3: local histograms → ReduceScatter → local best split on owned
+features → SyncUpGlobalBestSplit → every machine applies the identical split).
+
+The TPU formulation runs the *same* leaf-wise grower program on every device
+under `shard_map`, with rows sharded over the mesh's data axis:
+
+- per-leaf histograms are built from local rows then `psum` over the data
+  axis (≡ ReduceScatter+Allgather fused by XLA; the reference's explicit
+  buffer layout `PrepareBufferPos` disappears — XLA lays out the collective);
+- root grad/hess/count sums `psum` (≡ Network::Allreduce of the root tuples,
+  data_parallel_tree_learner.cpp:170,201);
+- the split scan then runs on the replicated histogram, so every device
+  computes the *identical* best split and tree — no split broadcast needed,
+  exactly like the reference where all machines apply the global split
+  locally (SURVEY.md §3.3 last line);
+- the per-row `leaf_id` partition stays sharded: each device partitions only
+  its rows (≡ DataPartition::Split on the local shard).
+
+Gradient computation and score updates are elementwise over the sharded row
+axis and need no collectives at all (the reference likewise keeps
+scores/gradients fully local per machine).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.8 jax
+    from jax.experimental.shard_map import shard_map
+
+from ..core.grower import GrowerConfig, make_tree_grower
+from ..ops.split import FeatureMeta
+from .mesh import DATA_AXIS
+
+
+def make_data_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
+                              mesh: Mesh, data_axis: str = DATA_AXIS):
+    """Build `grow(bins_t, gh, feature_mask) -> (TreeArrays, leaf_id)` where
+    `bins_t` [F, R] and `gh` [R, 3] are sharded over `data_axis` on their row
+    dimension; R must be divisible by the axis size (pad upstream with
+    gh rows of zeros). The returned tree is replicated; `leaf_id` is sharded.
+    """
+    grow = make_tree_grower(
+        cfg, meta,
+        reduce_hist=lambda h: lax.psum(h, data_axis),
+        reduce_sums=lambda s: lax.psum(s, data_axis))
+
+    def grow_with_mask(bins_t, gh, feature_mask):
+        return grow(bins_t, gh, feature_mask)
+
+    sharded = shard_map(
+        grow_with_mask, mesh=mesh,
+        in_specs=(P(None, data_axis), P(data_axis, None), P()),
+        out_specs=(P(), P(data_axis)),
+        check_vma=False)
+
+    def grow_fn(bins_t, gh, feature_mask: Optional[jnp.ndarray] = None):
+        if feature_mask is None:
+            feature_mask = jnp.ones(bins_t.shape[0], bool)
+        return sharded(bins_t, gh, feature_mask)
+
+    return grow_fn
+
+
+def make_distributed_train_step(cfg: GrowerConfig, meta: FeatureMeta,
+                                mesh: Mesh, grad_fn: Callable,
+                                learning_rate: float,
+                                data_axis: str = DATA_AXIS):
+    """One full boosting iteration as a single jittable program over the mesh
+    (≡ GBDT::TrainOneIter on every machine, gbdt.cpp:353 — gradients,
+    tree growth with collective histogram reduction, score update).
+
+    grad_fn(score, label) -> (grad, hess), elementwise over rows.
+    Returns step(bins_t, label, score, row_mask) -> (new_score, tree,
+    leaf_id). ``row_mask`` (f32 0/1 [R]) zeroes padding rows so they carry
+    gh = (0, 0, 0) and never count toward histograms, hessians or
+    min_data_in_leaf (see mesh.pad_rows_np); pass all-ones when R divides
+    the mesh evenly.
+    """
+    grow = make_data_parallel_grower(cfg, meta, mesh, data_axis)
+
+    def step(bins_t, label, score, row_mask):
+        grad, hess = grad_fn(score, label)
+        gh = jnp.stack([grad * row_mask, hess * row_mask, row_mask], axis=1)
+        tree, leaf_id = grow(bins_t, gh, None)
+        leaf_value = tree.leaf_value * jnp.float32(learning_rate)
+        new_score = score + leaf_value[leaf_id]
+        return new_score, tree, leaf_id
+
+    return step

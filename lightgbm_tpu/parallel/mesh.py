@@ -1,0 +1,74 @@
+"""Device-mesh helpers for distributed training.
+
+TPU-native replacement for the reference's Network bootstrap
+(ref: src/network/network.cpp Network::Init, linkers_socket.cpp TCP mesh,
+linkers_mpi.cpp). Where the reference builds a socket/MPI world from
+`machine_list_file` + `local_listen_port` (config.h:1092-1112), the TPU
+framework's "world" is a `jax.sharding.Mesh` over the visible devices;
+collectives ride ICI/DCN via XLA (`psum`, `psum_scatter`, `all_gather`)
+instead of hand-written Bruck/recursive-halving algorithms
+(network.cpp:160-320).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"      # row sharding  ≡ tree_learner=data
+FEATURE_AXIS = "feature"  # feature sharding ≡ tree_learner=feature
+
+
+def build_mesh(num_devices: Optional[int] = None,
+               axis_names: Sequence[str] = (DATA_AXIS,),
+               shape: Optional[Sequence[int]] = None) -> Mesh:
+    """Build a mesh over the first `num_devices` visible devices.
+
+    ``shape`` gives the per-axis sizes; default puts everything on the first
+    axis (pure data-parallel, the reference's dominant distributed mode —
+    Criteo 1.7B scaling, docs/Experiments.rst:228-242).
+    """
+    devs = jax.devices()
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    n = len(devs)
+    if shape is None:
+        shape = [n] + [1] * (len(axis_names) - 1)
+    arr = np.asarray(devs).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def padded_rows(num_rows: int, num_shards: int) -> int:
+    """Rows after padding to an even multiple of the data-axis size."""
+    return ((num_rows + num_shards - 1) // num_shards) * num_shards
+
+
+def pad_rows_np(arr: np.ndarray, target: int, axis: int,
+                fill=0) -> np.ndarray:
+    """Pad `arr` along `axis` to `target` length with `fill` (host side).
+
+    Padded rows carry gh = (0, 0, 0) so they are invisible to histograms,
+    split stats and counts — the same trick the reference uses for bagging
+    (zero-hessian rows simply don't contribute).
+    """
+    n = arr.shape[axis]
+    if n == target:
+        return arr
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, target - n)
+    return np.pad(arr, widths, constant_values=fill)
+
+
+def row_sharding(mesh: Mesh, row_dim: int, ndim: int,
+                 axis: str = DATA_AXIS) -> NamedSharding:
+    """NamedSharding that shards dimension `row_dim` of an ndim-array over
+    the data axis, replicating the rest."""
+    spec = [None] * ndim
+    spec[row_dim] = axis
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
