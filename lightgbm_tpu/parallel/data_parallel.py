@@ -26,17 +26,23 @@ scores/gradients fully local per machine).
 """
 from __future__ import annotations
 
-import functools
-from typing import Callable, Optional, Tuple
+from typing import Callable, Optional
 
-import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 try:
-    from jax import shard_map
-except ImportError:  # pre-0.8 jax
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map as _shard_map
+
+    def _make_sharded(fn, mesh, in_specs, out_specs):
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+except ImportError:  # pre-0.8 jax: experimental API, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def _make_sharded(fn, mesh, in_specs, out_specs):
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
 
 from ..core.grower import GrowerConfig, make_tree_grower
 from ..ops.split import FeatureMeta
@@ -55,14 +61,10 @@ def make_data_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
         reduce_hist=lambda h: lax.psum(h, data_axis),
         reduce_sums=lambda s: lax.psum(s, data_axis))
 
-    def grow_with_mask(bins_t, gh, feature_mask):
-        return grow(bins_t, gh, feature_mask)
-
-    sharded = shard_map(
-        grow_with_mask, mesh=mesh,
+    sharded = _make_sharded(
+        grow, mesh,
         in_specs=(P(None, data_axis), P(data_axis, None), P()),
-        out_specs=(P(), P(data_axis)),
-        check_vma=False)
+        out_specs=(P(), P(data_axis)))
 
     def grow_fn(bins_t, gh, feature_mask: Optional[jnp.ndarray] = None):
         if feature_mask is None:

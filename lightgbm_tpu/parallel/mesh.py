@@ -32,6 +32,9 @@ def build_mesh(num_devices: Optional[int] = None,
     """
     devs = jax.devices()
     if num_devices is not None:
+        if num_devices > len(devs):
+            raise ValueError(
+                f"requested {num_devices} devices, only {len(devs)} visible")
         devs = devs[:num_devices]
     n = len(devs)
     if shape is None:

@@ -1,0 +1,388 @@
+"""Plotting library.
+
+TPU-native equivalent of python-package/lightgbm/plotting.py (849 LoC):
+plot_importance, plot_split_value_histogram, plot_metric, plot_tree,
+create_tree_digraph. matplotlib / graphviz are optional imports, checked
+at call time like the reference.
+"""
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .basic import Booster, LightGBMError
+from .sklearn import LGBMModel
+
+__all__ = ["plot_importance", "plot_split_value_histogram", "plot_metric",
+           "plot_tree", "create_tree_digraph"]
+
+
+def _check_not_tuple_of_2_elements(obj: Any, obj_name: str) -> None:
+    if not isinstance(obj, tuple) or len(obj) != 2:
+        raise TypeError(f"{obj_name} must be a tuple of 2 elements.")
+
+
+def _float2str(value: float, precision: Optional[int] = None) -> str:
+    return (f"{value:.{precision}f}" if precision is not None
+            and not isinstance(value, str) else str(value))
+
+
+def _get_booster(booster: Union[Booster, LGBMModel]) -> Booster:
+    if isinstance(booster, LGBMModel):
+        return booster.booster_
+    if isinstance(booster, Booster):
+        return booster
+    raise TypeError("booster must be Booster or LGBMModel.")
+
+
+def plot_importance(booster: Union[Booster, LGBMModel], ax=None,
+                    height: float = 0.2, xlim=None, ylim=None,
+                    title: Optional[str] = "Feature importance",
+                    xlabel: Optional[str] = "Feature importance",
+                    ylabel: Optional[str] = "Features",
+                    importance_type: str = "auto",
+                    max_num_features: Optional[int] = None,
+                    ignore_zero: bool = True, figsize=None, dpi=None,
+                    grid: bool = True, precision: Optional[int] = 3,
+                    **kwargs):
+    """Bar chart of feature importances (ref: plotting.py plot_importance)."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot importance.")
+
+    if importance_type == "auto":
+        importance_type = (booster.importance_type
+                           if isinstance(booster, LGBMModel) else "split")
+    bst = _get_booster(booster)
+    importance = bst.feature_importance(importance_type=importance_type)
+    feature_name = bst.feature_name()
+
+    if not len(importance):
+        raise ValueError("Booster's feature_importance is empty.")
+
+    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
+    if ignore_zero:
+        tuples = [x for x in tuples if x[1] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        tuples = tuples[-max_num_features:]
+    labels, values = zip(*tuples)
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    for x, y in zip(values, ylocs):
+        ax.text(x + 1, y,
+                _float2str(x, precision) if importance_type == "gain"
+                else str(int(x)), va="center")
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+    else:
+        xlim = (0, max(values) * 1.1)
+    ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+    else:
+        ylim = (-1, len(values))
+    ax.set_ylim(ylim)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        xlabel = xlabel.replace("@importance_type@", importance_type)
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_split_value_histogram(booster: Union[Booster, LGBMModel],
+                               feature: Union[int, str], bins=None, ax=None,
+                               width_coef: float = 0.8, xlim=None, ylim=None,
+                               title: Optional[str] =
+                               "Split value histogram for feature with "
+                               "@index/name@ @feature@",
+                               xlabel: Optional[str] = "Feature split value",
+                               ylabel: Optional[str] = "Count",
+                               figsize=None, dpi=None, grid: bool = True,
+                               **kwargs):
+    """Histogram of a feature's split thresholds across the model
+    (ref: plotting.py plot_split_value_histogram)."""
+    try:
+        import matplotlib.pyplot as plt
+        from matplotlib.ticker import MaxNLocator
+    except ImportError:
+        raise ImportError(
+            "You must install matplotlib to plot split value histogram.")
+
+    bst = _get_booster(booster)
+    model = bst.dump_model()
+    feature_names = model.get("feature_names", bst.feature_name())
+    if isinstance(feature, str):
+        if feature not in feature_names:
+            raise ValueError(f"feature {feature} not found")
+        fidx = feature_names.index(feature)
+    else:
+        fidx = int(feature)
+
+    values: List[float] = []
+
+    def _walk(node):
+        if "split_feature" in node:
+            if int(node["split_feature"]) == fidx and \
+                    node.get("decision_type") == "<=":
+                values.append(float(node["threshold"]))
+            _walk(node["left_child"])
+            _walk(node["right_child"])
+
+    for tree in model["tree_info"]:
+        _walk(tree["tree_structure"])
+    if not values:
+        raise ValueError(
+            "Cannot plot split value histogram, "
+            f"because feature {feature} was not used in splitting")
+
+    hist_counts, bin_edges = np.histogram(values, bins=bins or "auto")
+    centred = (bin_edges[:-1] + bin_edges[1:]) / 2.0
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    width = width_coef * (bin_edges[1] - bin_edges[0])
+    ax.bar(centred, hist_counts, width=width, align="center", **kwargs)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+    else:
+        range_result = bin_edges[-1] - bin_edges[0]
+        xlim = (bin_edges[0] - range_result * 0.2,
+                bin_edges[-1] + range_result * 0.2)
+    ax.set_xlim(xlim)
+    ax.yaxis.set_major_locator(MaxNLocator(integer=True))
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+    else:
+        ylim = (0, max(hist_counts) * 1.1)
+    ax.set_ylim(ylim)
+    if title is not None:
+        title = title.replace("@feature@", str(feature))
+        title = title.replace("@index/name@",
+                              "name" if isinstance(feature, str) else "index")
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(booster: Union[Dict, LGBMModel], metric: Optional[str] = None,
+                dataset_names: Optional[List[str]] = None, ax=None,
+                xlim=None, ylim=None,
+                title: Optional[str] = "Metric during training",
+                xlabel: Optional[str] = "Iterations",
+                ylabel: Optional[str] = "@metric@", figsize=None, dpi=None,
+                grid: bool = True):
+    """Plot a recorded eval metric over iterations
+    (ref: plotting.py plot_metric)."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot metric.")
+
+    if isinstance(booster, LGBMModel):
+        eval_results = deepcopy(booster.evals_result_)
+    elif isinstance(booster, dict):
+        eval_results = deepcopy(booster)
+    elif isinstance(booster, Booster):
+        raise TypeError("booster must be dict or LGBMModel. To use plot_"
+                        "metric with Booster type, first record the metrics "
+                        "using record_evaluation callback then pass that to "
+                        "plot_metric as argument `booster`")
+    else:
+        raise TypeError("booster must be dict or LGBMModel.")
+    if not eval_results:
+        raise ValueError("eval results cannot be empty.")
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+
+    if dataset_names is None:
+        dataset_names_iter = iter(eval_results.keys())
+    elif not dataset_names:
+        raise ValueError("dataset_names cannot be empty.")
+    else:
+        dataset_names_iter = iter(dataset_names)
+
+    name = next(dataset_names_iter)  # take one as sample
+    metrics_for_one = eval_results[name]
+    num_metric = len(metrics_for_one)
+    if metric is None:
+        if num_metric > 1:
+            raise ValueError(
+                "more than one metric available, pick one with metric=...")
+        metric, results = metrics_for_one.popitem()
+    else:
+        if metric not in metrics_for_one:
+            raise KeyError("No given metric in eval results.")
+        results = metrics_for_one[metric]
+    num_iteration = len(results)
+    max_result = max(results)
+    min_result = min(results)
+    x_ = range(num_iteration)
+    ax.plot(x_, results, label=name)
+
+    for name in dataset_names_iter:
+        metrics_for_one = eval_results[name]
+        results = metrics_for_one[metric]
+        max_result = max(*results, max_result)
+        min_result = min(*results, min_result)
+        ax.plot(x_, results, label=name)
+
+    ax.legend(loc="best")
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+    else:
+        xlim = (0, num_iteration)
+    ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+    else:
+        range_result = max_result - min_result
+        ylim = (min_result - range_result * 0.2,
+                max_result + range_result * 0.2)
+    ax.set_ylim(ylim)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ylabel = ylabel.replace("@metric@", metric)
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def _to_graphviz(tree_info: Dict[str, Any], show_info: List[str],
+                 feature_names: List[str], precision: Optional[int],
+                 orientation: str, constraints=None, example_case=None,
+                 max_category_values: int = 10, **kwargs):
+    """Build a graphviz Digraph for one tree (ref: plotting.py _to_graphviz)."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("You must install graphviz to plot tree.")
+
+    def add(root, total_count, parent=None, decision=None):
+        if "split_index" in root:  # non-leaf
+            name = f"split{root['split_index']}"
+            fidx = int(root["split_feature"])
+            l_dec, r_dec = "<=", ">"
+            if feature_names is not None and fidx < len(feature_names):
+                feat = feature_names[fidx]
+            else:
+                feat = f"feature_{fidx}"
+            if root.get("decision_type") == "==":
+                l_dec, r_dec = "is", "isn't"
+                threshold = str(root["threshold"])
+                cats = threshold.split("||")
+                if len(cats) > max_category_values:
+                    cats = cats[:max_category_values] + ["..."]
+                threshold = "||".join(cats)
+            else:
+                threshold = _float2str(root["threshold"], precision)
+            label = f"{feat} {l_dec} {threshold}"
+            for info in ["split_gain", "internal_value", "internal_weight",
+                         "internal_count"]:
+                if info in show_info and info in root:
+                    output = info.split("_")[-1]
+                    label += f"\n{output}: " + _float2str(root[info],
+                                                          precision)
+            graph.node(name, label=label, shape="rectangle")
+            add(root["left_child"], total_count, name, l_dec)
+            add(root["right_child"], total_count, name, r_dec)
+        else:  # leaf
+            name = f"leaf{root['leaf_index']}"
+            label = f"leaf {root['leaf_index']}: "
+            label += _float2str(root["leaf_value"], precision)
+            if "leaf_weight" in show_info and "leaf_weight" in root:
+                label += "\nweight: " + _float2str(root["leaf_weight"],
+                                                   precision)
+            if "leaf_count" in show_info and "leaf_count" in root:
+                label += f"\ncount: {root['leaf_count']}"
+                if "data_percentage" in show_info and total_count:
+                    pct = root["leaf_count"] / total_count * 100
+                    label += f"\n{pct:.2f}% of data"
+            graph.node(name, label=label)
+        if parent is not None:
+            graph.edge(parent, name, decision)
+
+    graph = Digraph(**kwargs)
+    rankdir = "LR" if orientation == "horizontal" else "TB"
+    graph.attr("graph", nodesep="0.05", ranksep="0.3", rankdir=rankdir)
+    struct = tree_info["tree_structure"]
+    total_count = struct.get("internal_count", 0)
+    add(struct, total_count)
+    return graph
+
+
+def create_tree_digraph(booster: Union[Booster, LGBMModel],
+                        tree_index: int = 0,
+                        show_info: Optional[List[str]] = None,
+                        precision: Optional[int] = 3,
+                        orientation: str = "horizontal",
+                        example_case=None, max_category_values: int = 10,
+                        **kwargs):
+    """Graphviz digraph of one tree (ref: plotting.py create_tree_digraph)."""
+    bst = _get_booster(booster)
+    model = bst.dump_model()
+    tree_infos = model["tree_info"]
+    feature_names = model.get("feature_names", bst.feature_name())
+    if tree_index >= len(tree_infos):
+        raise IndexError("tree_index is out of range.")
+    if show_info is None:
+        show_info = []
+    return _to_graphviz(tree_infos[tree_index], show_info, feature_names,
+                        precision, orientation,
+                        max_category_values=max_category_values, **kwargs)
+
+
+def plot_tree(booster: Union[Booster, LGBMModel], ax=None,
+              tree_index: int = 0, figsize=None, dpi=None,
+              show_info: Optional[List[str]] = None,
+              precision: Optional[int] = 3,
+              orientation: str = "horizontal", example_case=None, **kwargs):
+    """Render one tree to a matplotlib axis (ref: plotting.py plot_tree)."""
+    try:
+        import matplotlib.image as image
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot tree.")
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+
+    graph = create_tree_digraph(booster=booster, tree_index=tree_index,
+                                show_info=show_info, precision=precision,
+                                orientation=orientation,
+                                example_case=example_case, **kwargs)
+    from io import BytesIO
+    s = BytesIO()
+    s.write(graph.pipe(format="png"))
+    s.seek(0)
+    img = image.imread(s)
+    ax.imshow(img)
+    ax.axis("off")
+    return ax

@@ -16,7 +16,7 @@ from lightgbm_tpu.core.grower import GrowerConfig, make_tree_grower
 from lightgbm_tpu.ops.split import FeatureMeta, SplitHyperParams
 from lightgbm_tpu.parallel import (build_mesh, make_data_parallel_grower,
                                    make_distributed_train_step, padded_rows,
-                                   pad_rows_np, row_sharding, replicated)
+                                   pad_rows_np, row_sharding)
 
 
 def _toy_problem(rng, n=4096, f=10, num_bin=32):
@@ -32,7 +32,7 @@ def _toy_problem(rng, n=4096, f=10, num_bin=32):
     return bins, gh, meta
 
 
-@pytest.mark.parametrize("n", [4096, 4000])  # even and ragged row counts
+@pytest.mark.parametrize("n", [4096, 4001])  # even and ragged row counts
 def test_distributed_tree_equals_serial(rng, n):
     num_bin = 32
     bins, gh, meta = _toy_problem(rng, n=n, num_bin=num_bin)

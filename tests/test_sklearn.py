@@ -1,0 +1,141 @@
+"""sklearn-estimator and plotting tests (ref: tests/python_package_test/
+test_sklearn.py, test_plotting.py — condensed to the behavioral core)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import (LGBMClassifier, LGBMModel, LGBMRanker,
+                          LGBMRegressor)
+
+
+def _make_reg(rng, n=400, f=8):
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.05 * rng.normal(size=n)
+    return X, y
+
+
+def test_regressor_fit_predict(rng):
+    X, y = _make_reg(rng)
+    model = LGBMRegressor(n_estimators=20, num_leaves=15,
+                          min_child_samples=5)
+    model.fit(X, y)
+    pred = model.predict(X)
+    assert 1 - np.var(y - pred) / np.var(y) > 0.8
+    assert model.n_features_ == 8
+    assert len(model.feature_importances_) == 8
+    assert model.feature_importances_.sum() > 0
+    assert model.objective_ == "regression"
+
+
+def test_regressor_eval_set_and_early_stopping(rng):
+    X, y = _make_reg(rng)
+    Xv, yv = _make_reg(rng, n=100)
+    model = LGBMRegressor(n_estimators=50, num_leaves=15,
+                          min_child_samples=5)
+    model.fit(X, y, eval_set=[(Xv, yv)],
+              callbacks=[lgb.early_stopping(5, verbose=False)])
+    assert "valid_0" in model.evals_result_
+    assert "l2" in model.evals_result_["valid_0"]
+    assert model.best_iteration_ >= 1
+
+
+def test_binary_classifier(rng):
+    X, y = _make_reg(rng)
+    yc = (y > np.median(y)).astype(int)
+    model = LGBMClassifier(n_estimators=20, num_leaves=15,
+                           min_child_samples=5)
+    model.fit(X, yc)
+    assert (model.predict(X) == yc).mean() > 0.9
+    proba = model.predict_proba(X)
+    assert proba.shape == (len(yc), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+    assert list(model.classes_) == [0, 1]
+    assert model.n_classes_ == 2
+
+
+def test_classifier_string_labels(rng):
+    X, y = _make_reg(rng)
+    yc = np.where(y > np.median(y), "pos", "neg")
+    model = LGBMClassifier(n_estimators=10, num_leaves=15,
+                           min_child_samples=5)
+    model.fit(X, yc)
+    pred = model.predict(X)
+    assert set(pred) <= {"pos", "neg"}
+    assert (pred == yc).mean() > 0.9
+
+
+def test_multiclass_classifier(rng):
+    X, y = _make_reg(rng)
+    y3 = np.digitize(y, np.quantile(y, [0.33, 0.66]))
+    model = LGBMClassifier(n_estimators=10, num_leaves=15,
+                           min_child_samples=5)
+    model.fit(X, y3)
+    assert model.n_classes_ == 3
+    proba = model.predict_proba(X)
+    assert proba.shape == (len(y3), 3)
+    assert (model.predict(X) == y3).mean() > 0.8
+
+
+def test_ranker(rng):
+    X, y = _make_reg(rng, n=300)
+    rel = rng.integers(0, 4, size=300)
+    group = np.full(15, 20)
+    model = LGBMRanker(n_estimators=8, num_leaves=7, min_child_samples=3)
+    model.fit(X, rel, group=group, eval_set=[(X, rel)], eval_group=[group],
+              eval_at=[3, 5])
+    assert "ndcg@3" in model.evals_result_["valid_0"]
+    assert "ndcg@5" in model.evals_result_["valid_0"]
+    assert model.predict(X).shape == (300,)
+
+
+def test_custom_objective(rng):
+    X, y = _make_reg(rng)
+
+    def l2_obj(y_true, y_pred):
+        return y_pred - y_true, np.ones_like(y_true)
+
+    model = LGBMRegressor(n_estimators=15, num_leaves=15,
+                          min_child_samples=5, objective=l2_obj)
+    model.fit(X, y)
+    pred = model.predict(X)
+    assert 1 - np.var(y - pred) / np.var(y) > 0.5
+
+
+def test_sklearn_integration(rng):
+    from sklearn.model_selection import GridSearchCV, cross_val_score
+    X, y = _make_reg(rng, n=200)
+    model = LGBMRegressor(n_estimators=5, num_leaves=7, min_child_samples=5)
+    scores = cross_val_score(model, X, y, cv=2)
+    assert len(scores) == 2
+    # clone/get_params/set_params round trip
+    from sklearn.base import clone
+    c = clone(model)
+    assert c.get_params()["n_estimators"] == 5
+    c.set_params(n_estimators=3)
+    assert c.get_params()["n_estimators"] == 3
+
+
+def test_pandas_input(rng):
+    pd = pytest.importorskip("pandas")
+    X, y = _make_reg(rng, n=200)
+    df = pd.DataFrame(X, columns=[f"col_{i}" for i in range(X.shape[1])])
+    model = LGBMRegressor(n_estimators=5, num_leaves=7, min_child_samples=5)
+    model.fit(df, y)
+    assert model.feature_name_ == list(df.columns)
+    assert model.predict(df).shape == (200,)
+
+
+def test_plot_importance_and_metric(rng):
+    mpl = pytest.importorskip("matplotlib")
+    mpl.use("Agg")
+    X, y = _make_reg(rng, n=200)
+    model = LGBMRegressor(n_estimators=10, num_leaves=7, min_child_samples=5)
+    model.fit(X, y, eval_set=[(X, y)])
+    ax = lgb.plot_importance(model)
+    assert len(ax.patches) > 0
+    ax2 = lgb.plot_metric(model.evals_result_)
+    assert ax2.get_xlabel() == "Iterations"
+    ax3 = lgb.plot_split_value_histogram(model, feature=0)
+    assert len(ax3.patches) > 0
+    import matplotlib.pyplot as plt
+    plt.close("all")
