@@ -1,0 +1,4 @@
+"""`python -m lightgbm_tpu` — the CLI entry point (ref: src/main.cpp)."""
+from .cli import main
+
+main()

@@ -91,6 +91,24 @@ class Dataset:
             return self
 
         if isinstance(self.data, (str, Path)):
+            from .io.binary_io import is_binary_dataset_file, load_binary
+            if is_binary_dataset_file(str(self.data)):
+                self._binned = load_binary(str(self.data))
+                md = self._binned.metadata
+                if self.label is not None:
+                    md.set_label(_to_1d_numpy(self.label))
+                if self.weight is not None:
+                    md.set_weight(_to_1d_numpy(self.weight))
+                if self.group is not None:
+                    md.set_query(_to_1d_numpy(self.group, np.int64))
+                if self.init_score is not None:
+                    md.set_init_score(_to_1d_numpy(self.init_score,
+                                                   np.float64))
+                if self.position is not None:
+                    md.set_position(_to_1d_numpy(self.position, np.int32))
+                if self.free_raw_data:
+                    self.data = None
+                return self
             from .io.file_loader import load_svm_or_csv
             cfg = Config(self.params)
             X, y, w, grp = load_svm_or_csv(str(self.data), cfg)
@@ -220,6 +238,13 @@ class Dataset:
                        group=group, init_score=init_score,
                        params=params or self.params, position=position)
 
+    def save_binary(self, filename) -> "Dataset":
+        """Serialize the constructed (binned) dataset to a binary file
+        (ref: Dataset::SaveBinaryFile, dataset.h:710)."""
+        from .io.binary_io import save_binary
+        save_binary(self.construct()._binned, str(filename))
+        return self
+
     @property
     def binned(self) -> BinnedDataset:
         self.construct()
@@ -310,6 +335,90 @@ class Booster:
     def _raw_train_score(self) -> np.ndarray:
         s = np.asarray(self._engine.score, np.float64)
         return s[0] if s.shape[0] == 1 else s
+
+    def refit(self, data, label, decay_rate: float = 0.9, weight=None,
+              group=None, init_score=None, **kwargs) -> "Booster":
+        """Refit the existing tree structures on new data: tree shapes and
+        thresholds are kept, leaf values are re-estimated from the new
+        data's gradient statistics and blended with the old values by
+        ``decay_rate`` (ref: basic.py Booster.refit -> LGBM_BoosterRefit;
+        gbdt.cpp GBDT::RefitTree with refit_decay_rate)."""
+        from .core.objective import create_objective
+        from .io.dataset_core import Metadata as _Metadata
+        from .io.model_io import load_model_string
+        from .ops.split import SplitHyperParams, calculate_splitted_leaf_output
+
+        X, _ = _to_2d_numpy(data)
+        y = _to_1d_numpy(label)
+        n = X.shape[0]
+
+        # fresh engine carrying only the model (no training state)
+        new_engine, new_config = load_model_string(self.model_to_string())
+        new_config.update({k: v for k, v in kwargs.items()})
+        cfg = new_config
+
+        md = _Metadata(n)
+        md.set_label(y)
+        if weight is not None:
+            md.set_weight(_to_1d_numpy(weight))
+        if group is not None:
+            md.set_query(_to_1d_numpy(group, np.int64))
+        objective = create_objective(cfg.objective, cfg)
+        objective.init(md, n)
+
+        hp = SplitHyperParams(
+            lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
+            min_data_in_leaf=cfg.min_data_in_leaf,
+            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+            min_gain_to_split=cfg.min_gain_to_split,
+            max_delta_step=cfg.max_delta_step)
+
+        K = new_engine.num_tree_per_iteration
+        n_iter = len(new_engine.models) // max(K, 1)
+        score = np.zeros((K, n), np.float64)
+        if init_score is not None:
+            score += np.asarray(init_score, np.float64).reshape(-1, n)
+
+        import jax.numpy as jnp
+        for it in range(n_iter):
+            s_dev = jnp.asarray(score, jnp.float32)
+            g, h = objective.get_gradients(s_dev[0] if K == 1 else s_dev)
+            g = np.asarray(g, np.float64).reshape(K, n)
+            h = np.asarray(h, np.float64).reshape(K, n)
+            for k in range(K):
+                t = new_engine.models[it * K + k]
+                if t.num_leaves <= 1:
+                    score[k] += t.leaf_value[0] if len(t.leaf_value) else 0.0
+                    continue
+                leaf = t.predict_leaf(X)
+                sum_g = np.bincount(leaf, weights=g[k],
+                                    minlength=t.num_leaves)
+                sum_h = np.bincount(leaf, weights=h[k],
+                                    minlength=t.num_leaves)
+                new_val = np.asarray(calculate_splitted_leaf_output(
+                    jnp.asarray(sum_g), jnp.asarray(sum_h), hp), np.float64)
+                new_val *= t.shrinkage
+                # leaves with no rows in the new data keep their old value
+                # (ref: gbdt.cpp RefitTree only updates populated leaves)
+                has_data = sum_h > 0
+                t.leaf_value = np.where(
+                    has_data,
+                    decay_rate * t.leaf_value + (1.0 - decay_rate) * new_val,
+                    t.leaf_value)
+                score[k] += t.leaf_value[leaf]
+
+        out = Booster.__new__(Booster)
+        out.params = copy.deepcopy(self.params)
+        out.train_set = None
+        out.valid_sets = []
+        out.name_valid_sets = []
+        out.best_iteration = -1
+        out.best_score = {}
+        out.train_data_name = "training"
+        out._network_initialized = False
+        out._engine = new_engine
+        out.config = cfg
+        return out
 
     def rollback_one_iter(self) -> "Booster":
         self._engine.rollback_one_iter()

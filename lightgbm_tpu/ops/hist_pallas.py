@@ -1,0 +1,121 @@
+"""Pallas TPU histogram kernel — the hottest op, on the MXU.
+
+TPU-native counterpart of the reference's histogram kernels
+(ref: src/treelearner/cuda/cuda_histogram_constructor.cu:21-71 shared-mem
+atomicAdd kernel; src/io/dense_bin.hpp Bin::ConstructHistogram). TPUs have
+no fast scatter-add, so the scatter is reformulated as a one-hot matmul
+(SURVEY.md §7 kernels (a)) — the same contraction `hist_xla` expresses, but
+with explicit VMEM residency:
+
+- grid = (feature tiles, row blocks); the row-block axis is innermost and
+  maps to the SAME output block, so the [C, FT*B] accumulator stays pinned
+  in VMEM across the whole row loop — zero HBM traffic for partial
+  histograms (XLA's scan materializes the [F, B, C] carry each step).
+- per step: build the one-hot expansion of a [FT, RB] bin tile in VMEM and
+  contract gh_t [C, RB] @ onehot [RB, FT*B] on the MXU with f32
+  accumulation.
+
+Gradients/hessians enter pre-masked by leaf (gh rows of other leaves are
+zero), so a leaf histogram is one pass over the row blocks; the sibling
+subtraction trick (FeatureHistogram::Subtract) halves the passes upstream.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _hist_kernel(bins_ref, gh_ref, out_ref, *, feature_tile: int,
+                 num_bin_padded: int):
+    """One (feature-tile, row-block) grid step.
+
+    bins_ref: int32 [FT, RB]   — bin indices for this tile
+    gh_ref:   f32  [C, RB]     — transposed, leaf-masked (grad, hess, count)
+    out_ref:  f32  [C, FT*Bp]  — accumulator, pinned across row blocks
+    """
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bins = bins_ref[:].astype(jnp.int32)            # [FT, RB]
+    gh = gh_ref[:]                                  # [C, RB]
+    rb = bins.shape[1]
+    iota_b = lax.broadcasted_iota(jnp.int32, (rb, num_bin_padded), 1)
+
+    # one-hot expansion, feature-major columns: col = f * Bp + b
+    onehot = jnp.concatenate(
+        [(bins[f, :][:, None] == iota_b).astype(jnp.float32)
+         for f in range(feature_tile)], axis=1)     # [RB, FT*Bp]
+
+    out_ref[:] += lax.dot_general(
+        gh, onehot, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _pad_to(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+@functools.partial(jax.jit, static_argnames=("num_bin", "block_rows",
+                                             "feature_tile", "interpret"))
+def _hist_pallas_impl(bins_t: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
+                      block_rows: int, feature_tile: int,
+                      interpret: bool) -> jnp.ndarray:
+    F, R = bins_t.shape
+    C = gh.shape[1]
+    Bp = _pad_to(num_bin, 128)            # lane-align the bin axis
+    Fp = _pad_to(F, feature_tile)
+    Rp = _pad_to(R, block_rows)
+
+    if Fp != F:
+        bins_t = jnp.pad(bins_t, ((0, Fp - F), (0, 0)))
+    if Rp != R:
+        # padded rows carry gh = 0 → contribute nothing to any bin
+        bins_t = jnp.pad(bins_t, ((0, 0), (0, Rp - R)))
+        gh = jnp.pad(gh, ((0, Rp - R), (0, 0)))
+    gh_t = gh.T                            # [C, Rp]
+
+    grid = (Fp // feature_tile, Rp // block_rows)
+    kernel = functools.partial(_hist_kernel, feature_tile=feature_tile,
+                               num_bin_padded=Bp)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((feature_tile, block_rows),
+                         lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, block_rows), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((C, feature_tile * Bp), lambda i, j: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((C, Fp * Bp), jnp.float32),
+        interpret=interpret,
+    )(bins_t.astype(jnp.int32), gh_t)
+
+    # [C, Fp*Bp] -> [Fp, Bp, C] -> [F, num_bin, C]
+    hist = out.reshape(C, Fp, Bp).transpose(1, 2, 0)
+    return hist[:F, :num_bin, :]
+
+
+def hist_pallas(bins_t: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
+                block_rows: int = 1024, feature_tile: int = 8,
+                interpret: bool | None = None) -> jnp.ndarray:
+    """Histogram [F, num_bin, C] of leaf-masked gh over binned features.
+
+    Same contract as hist_xla (ops/histogram.py). `interpret=None` picks
+    compiled mode on TPU and the Pallas interpreter elsewhere (tests run
+    the interpreter on the CPU mesh; the kernel itself is identical).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _hist_pallas_impl(bins_t, gh, num_bin, block_rows, feature_tile,
+                             bool(interpret))

@@ -421,6 +421,23 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
         self._le = _SKLabelEncoder().fit(y)
         self._classes = self._le.classes_
         self._n_classes = len(self._classes)
+        # class weights must be resolved against ORIGINAL labels, before
+        # label encoding (dict keys are in user label space)
+        if self.class_weight is not None and sample_weight is None:
+            sample_weight = self._class_weights_to_sample_weight(y)
+        if eval_set is not None and eval_class_weight is not None:
+            if isinstance(eval_set, tuple):
+                eval_set = [eval_set]
+            ecw = (eval_class_weight if isinstance(eval_class_weight,
+                                                   (list, tuple))
+                   else [eval_class_weight] * len(eval_set))
+            esw = list(eval_sample_weight) if eval_sample_weight is not None \
+                else [None] * len(eval_set)
+            for i, (vx, vy) in enumerate(eval_set):
+                if ecw[i] is not None and esw[i] is None:
+                    esw[i] = self._class_weights_to_sample_weight(vy, ecw[i])
+            eval_sample_weight = esw
+            eval_class_weight = None
         y_enc = self._le.transform(y)
         if not callable(self.objective):
             if self.objective is None:

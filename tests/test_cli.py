@@ -1,0 +1,205 @@
+"""CLI / binary dataset / refit / convert_model tests
+(ref: tests/cpp_tests/test.py CLI smoke, test_consistency.py conf-driven
+training, examples/*/train.conf)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.cli import run
+
+
+def _write_csv(path, X, y):
+    data = np.column_stack([y, X])
+    np.savetxt(path, data, delimiter=",", fmt="%.8g")
+
+
+@pytest.fixture
+def csv_data(tmp_path, rng):
+    X = rng.normal(size=(300, 6))
+    y = (X[:, 0] + X[:, 1] ** 2 > 0.5).astype(np.float64)
+    train = str(tmp_path / "train.csv")
+    _write_csv(train, X, y)
+    return train, X, y
+
+
+def test_cli_train_and_predict(tmp_path, csv_data):
+    train_csv, X, y = csv_data
+    model_path = str(tmp_path / "model.txt")
+    conf = tmp_path / "train.conf"
+    conf.write_text(
+        f"task = train\n"
+        f"objective = binary  # comment here\n"
+        f"data = {train_csv}\n"
+        f"num_iterations = 8\n"
+        f"num_leaves = 7\n"
+        f"min_data_in_leaf = 5\n"
+        f"verbosity = -1\n"
+        f"output_model = {model_path}\n")
+    assert run([f"config={conf}"]) == 0
+    assert os.path.exists(model_path)
+
+    # predict task over the same file
+    out_path = str(tmp_path / "preds.txt")
+    assert run([f"task=predict", f"data={train_csv}",
+                f"input_model={model_path}", f"output_result={out_path}",
+                "verbosity=-1"]) == 0
+    preds = np.loadtxt(out_path)
+    assert preds.shape[0] == 300
+    acc = ((preds > 0.5) == y).mean()
+    assert acc > 0.85
+
+    # CLI args override config-file values
+    model2 = str(tmp_path / "model2.txt")
+    assert run([f"config={conf}", "num_iterations=2",
+                f"output_model={model2}"]) == 0
+    b2 = lgb.Booster(model_file=model2)
+    assert b2.num_trees() == 2
+
+
+def test_cli_unknown_task(csv_data):
+    train_csv, _, _ = csv_data
+    assert run([f"task=nope", f"data={train_csv}"]) == 1
+
+
+def test_cli_module_entry(tmp_path, csv_data):
+    train_csv, _, _ = csv_data
+    model_path = str(tmp_path / "m.txt")
+    env = dict(os.environ)
+    env["LGBM_TPU_TEST_DEVICE"] = "cpu"
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        "import lightgbm_tpu.cli as c, sys;"
+        f"sys.exit(c.run(['task=train', 'data={train_csv}', "
+        f"'objective=regression', 'num_iterations=2', 'num_leaves=4', "
+        f"'min_data_in_leaf=5', 'verbosity=-1', "
+        f"'output_model={model_path}']))")
+    r = subprocess.run([sys.executable, "-c", code], env=env, timeout=300)
+    assert r.returncode == 0
+    assert os.path.exists(model_path)
+
+
+def test_save_binary_roundtrip(tmp_path, rng):
+    X = rng.normal(size=(200, 5))
+    y = X[:, 0] * 2 + 0.1 * rng.normal(size=200)
+    w = rng.uniform(0.5, 2.0, size=200)
+    ds = lgb.Dataset(X, label=y, weight=w,
+                     params={"min_data_in_leaf": 5}).construct()
+    bin_path = str(tmp_path / "data.bin")
+    ds.save_binary(bin_path)
+
+    loaded = lgb.Dataset(bin_path).construct()
+    assert loaded.num_data() == 200
+    assert loaded.num_feature() == 5
+    np.testing.assert_allclose(loaded.get_label(), y.astype(np.float32),
+                               rtol=1e-6)
+    np.testing.assert_allclose(loaded.get_weight(), w.astype(np.float32),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(loaded.binned.bins, ds.binned.bins)
+
+    # training from the binary file matches training from the matrix
+    # (same weights both sides — the binary carries the weight column)
+    params = {"objective": "regression", "num_leaves": 7,
+              "min_data_in_leaf": 5, "verbosity": -1}
+    b1 = lgb.train(params, lgb.Dataset(X, label=y, weight=w),
+                   num_boost_round=5)
+    b2 = lgb.train(params, lgb.Dataset(bin_path), num_boost_round=5)
+    np.testing.assert_allclose(b1.predict(X), b2.predict(X), rtol=1e-6)
+
+
+def test_cli_save_binary_task(tmp_path, csv_data):
+    train_csv, X, y = csv_data
+    assert run([f"task=save_binary", f"data={train_csv}",
+                "verbosity=-1"]) == 0
+    assert os.path.exists(train_csv + ".bin")
+    ds = lgb.Dataset(train_csv + ".bin").construct()
+    assert ds.num_data() == 300
+
+
+def test_refit(rng):
+    X = rng.normal(size=(400, 6))
+    y = X[:, 0] + 0.5 * X[:, 1] + 0.05 * rng.normal(size=400)
+    booster = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "min_data_in_leaf": 5, "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=10)
+    # refit on shifted data: structures identical, leaf values move
+    y2 = y + 1.0
+    refitted = booster.refit(X, y2, decay_rate=0.0)
+    assert refitted.num_trees() == booster.num_trees()
+    d1 = booster.dump_model()
+    d2 = refitted.dump_model()
+    for t1, t2 in zip(d1["tree_info"], d2["tree_info"]):
+        def structure(node, acc):
+            if "split_feature" in node:
+                acc.append((node["split_feature"], node["threshold"]))
+                structure(node["left_child"], acc)
+                structure(node["right_child"], acc)
+            return acc
+        assert structure(t1["tree_structure"], []) == \
+            structure(t2["tree_structure"], [])
+    # refitted model predicts the shifted target better than the original
+    mse_old = np.mean((booster.predict(X) - y2) ** 2)
+    mse_new = np.mean((refitted.predict(X) - y2) ** 2)
+    assert mse_new < mse_old
+    # decay_rate=1 keeps the old leaf values
+    same = booster.refit(X, y2, decay_rate=1.0)
+    np.testing.assert_allclose(same.predict(X), booster.predict(X),
+                               rtol=1e-6)
+
+
+def test_cli_refit_task(tmp_path, csv_data):
+    train_csv, X, y = csv_data
+    model_path = str(tmp_path / "model.txt")
+    assert run([f"task=train", f"data={train_csv}", "objective=binary",
+                "num_iterations=5", "num_leaves=7", "min_data_in_leaf=5",
+                f"output_model={model_path}", "verbosity=-1"]) == 0
+    refit_model = str(tmp_path / "refit.txt")
+    assert run([f"task=refit", f"data={train_csv}",
+                f"input_model={model_path}", f"output_model={refit_model}",
+                "verbosity=-1"]) == 0
+    assert os.path.exists(refit_model)
+    b = lgb.Booster(model_file=refit_model)
+    assert b.num_trees() == 5
+
+
+def test_convert_model(tmp_path, csv_data):
+    train_csv, X, y = csv_data
+    model_path = str(tmp_path / "model.txt")
+    assert run([f"task=train", f"data={train_csv}", "objective=binary",
+                "num_iterations=3", "num_leaves=7", "min_data_in_leaf=5",
+                f"output_model={model_path}", "verbosity=-1"]) == 0
+    cpp_path = str(tmp_path / "model.cpp")
+    assert run([f"task=convert_model", f"input_model={model_path}",
+                f"convert_model={cpp_path}", "verbosity=-1"]) == 0
+    src = open(cpp_path).read()
+    assert "PredictTree0" in src and "void Predict(" in src
+
+    # compile and check numeric parity with Booster.predict on a few rows
+    import shutil
+    gxx = shutil.which("g++")
+    if gxx is None:
+        pytest.skip("no g++ available")
+    harness = tmp_path / "harness.cpp"
+    harness.write_text(
+        '#include <cstdio>\n#include "model.cpp"\n'
+        "int main() {\n"
+        "  double arr[6]; double out[1];\n"
+        "  while (scanf(\"%lf %lf %lf %lf %lf %lf\", arr, arr+1, arr+2,"
+        " arr+3, arr+4, arr+5) == 6) {\n"
+        "    lightgbm_tpu_model::Predict(arr, out);\n"
+        "    printf(\"%.10f\\n\", out[0]);\n"
+        "  }\n  return 0;\n}\n")
+    exe = str(tmp_path / "model_exe")
+    subprocess.run([gxx, "-O0", "-o", exe, str(harness)], check=True,
+                   cwd=tmp_path, timeout=120)
+    rows = X[:20]
+    inp = "\n".join(" ".join(f"{v:.10g}" for v in row) for row in rows)
+    r = subprocess.run([exe], input=inp, capture_output=True, text=True,
+                       timeout=60)
+    cpp_preds = np.asarray([float(v) for v in r.stdout.split()])
+    booster = lgb.Booster(model_file=model_path)
+    py_preds = booster.predict(rows)
+    np.testing.assert_allclose(cpp_preds, py_preds, rtol=1e-6, atol=1e-9)

@@ -88,6 +88,19 @@ def test_ranker(rng):
     assert model.predict(X).shape == (300,)
 
 
+def test_class_weight_original_label_space(rng):
+    """class_weight dict keys are user labels, not encoded ones."""
+    X, y = _make_reg(rng)
+    yc = np.where(y > np.median(y), 5, 9)  # labels {5, 9}, encoded {0, 1}
+    m = LGBMClassifier(n_estimators=5, num_leaves=7, min_child_samples=5,
+                       class_weight={5: 10.0, 9: 1.0})
+    m.fit(X, yc)
+    w = m._class_weights_to_sample_weight(yc)
+    assert set(np.unique(w)) == {10.0, 1.0}
+    assert (w[yc == 5] == 10.0).all() and (w[yc == 9] == 1.0).all()
+    assert (m.predict(X) != 0).all()  # predictions in original label space
+
+
 def test_custom_objective(rng):
     X, y = _make_reg(rng)
 
