@@ -27,6 +27,7 @@ from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..ops.histogram import make_hist_fn
@@ -45,6 +46,12 @@ class GrowerConfig:
     hparams: SplitHyperParams = SplitHyperParams()
     hist_backend: str = "xla"   # xla | scatter | pallas
     block_rows: int = 4096
+    # feature_mask is [L, F] with one row per node (feature_fraction_bynode,
+    # ref: col_sampler.hpp) instead of a single [F] row for the whole tree
+    bynode_mask: bool = False
+    # static interaction groups over USED feature indices
+    # (ref: col_sampler.hpp interaction_constraints)
+    interaction_groups: Optional[tuple] = None
 
 
 class GrowState(NamedTuple):
@@ -62,6 +69,13 @@ class GrowState(NamedTuple):
     tree: TreeArrays
     num_leaves: jnp.ndarray     # i32
     done: jnp.ndarray           # bool
+    # per-leaf output bounds from monotone ancestors (BasicConstraint);
+    # all-(-inf,+inf) when constraints are off
+    leaf_min: jnp.ndarray = None  # f32 [L]
+    leaf_max: jnp.ndarray = None  # f32 [L]
+    # bool [L, F]: features used on the path from root (interaction
+    # constraints); None when constraints are off
+    path_mask: jnp.ndarray = None
 
 
 def _set(arr, idx, val, cond):
@@ -91,18 +105,49 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     if reduce_sums is None:
         reduce_sums = lambda s: s
 
+    use_mc = meta.monotone is not None
+    use_ic = cfg.interaction_groups is not None
+
     def leaf_hist(bins_t, gh, leaf_id, target_leaf):
         mask = (leaf_id == target_leaf).astype(gh.dtype)
         return reduce_hist(hist_fn(bins_t, gh * mask[:, None]))
 
-    def best_of(hist, sg, sh, cnt, parent_out, feature_mask):
+    def best_of(hist, sg, sh, cnt, parent_out, feature_mask,
+                leaf_range=None, leaf_depth=None):
         return best_split_for_leaf(hist, sg, sh, cnt, parent_out, meta, hp,
-                                   feature_mask)
+                                   feature_mask, leaf_range=leaf_range,
+                                   leaf_depth=leaf_depth)
 
     def grow(bins_t: jnp.ndarray, gh: jnp.ndarray,
              feature_mask: Optional[jnp.ndarray] = None
              ) -> Tuple[TreeArrays, jnp.ndarray]:
         F, R = bins_t.shape
+
+        if use_ic:
+            # bool [G, F]: membership of each interaction group
+            gm = np.zeros((len(cfg.interaction_groups), F), bool)
+            for gi, group in enumerate(cfg.interaction_groups):
+                for fi in group:
+                    if 0 <= fi < F:
+                        gm[gi, fi] = True
+            group_masks = jnp.asarray(gm)
+
+            def allowed_features(path):
+                """Union of groups that contain every path feature
+                (ref: col_sampler.hpp interaction-constraint filtering)."""
+                contains = jnp.all(group_masks | ~path[None, :], axis=1)
+                return jnp.any(group_masks & contains[:, None], axis=0)
+
+        def node_mask(node_row, path):
+            """Mask for one node: row `node_row` of the per-node sample
+            (root=0, step i children = 2i+1 / 2i+2) ∧ interaction filter."""
+            fm = feature_mask
+            if cfg.bynode_mask and fm is not None:
+                fm = fm[jnp.minimum(node_row, fm.shape[0] - 1)]
+            if use_ic:
+                al = allowed_features(path)
+                fm = al if fm is None else (fm & al)
+            return fm
 
         # ---- root (ref: LeafSplits::Init + first FindBestSplits) ----
         sums = reduce_sums(gh.sum(axis=0))            # [3]
@@ -111,8 +156,11 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             root_g, root_h + 2 * K_EPSILON, hp, root_c, jnp.float32(0.0))
         leaf_id0 = jnp.zeros(R, jnp.int32)
         hist_root = reduce_hist(hist_fn(bins_t, gh))
+        inf = jnp.float32(jnp.inf)
+        root_path = jnp.zeros(F, bool)
         best_root = best_of(hist_root, root_g, root_h, root_c, root_out,
-                            feature_mask)
+                            node_mask(0, root_path), leaf_range=(-inf, inf),
+                            leaf_depth=jnp.int32(0))
 
         hist_pool = jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(hist_root)
         zf = jnp.zeros(L, jnp.float32)
@@ -134,6 +182,9 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             tree=TreeArrays.empty(L),
             num_leaves=jnp.asarray(1, jnp.int32),
             done=jnp.asarray(False),
+            leaf_min=jnp.full(L, -jnp.inf, jnp.float32),
+            leaf_max=jnp.full(L, jnp.inf, jnp.float32),
+            path_mask=jnp.zeros((L, F), bool) if use_ic else None,
         )
 
         def body(i, state: GrowState) -> GrowState:
@@ -238,15 +289,67 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             hist = hist.at[new_leaf].set(
                 jnp.where(proceed, hist_right, hist[new_leaf]))
 
+            # ---- monotone constraint propagation ---------------------------
+            # (ref: monotone_constraints.hpp:488-504 BasicLeafConstraints::
+            # Update — mid-point bound tightening on the split children)
+            p_min, p_max = state.leaf_min[l], state.leaf_max[l]
+            if use_mc:
+                mono_f = jnp.where(rec.feature >= 0,
+                                   meta.monotone[jnp.maximum(rec.feature, 0)],
+                                   0)
+                mid = (rec.left_output + rec.right_output) * 0.5
+                l_min = jnp.where(mono_f < 0, jnp.maximum(p_min, mid), p_min)
+                l_max = jnp.where(mono_f > 0, jnp.minimum(p_max, mid), p_max)
+                r_min = jnp.where(mono_f > 0, jnp.maximum(p_min, mid), p_min)
+                r_max = jnp.where(mono_f < 0, jnp.minimum(p_max, mid), p_max)
+            else:
+                l_min = r_min = p_min
+                l_max = r_max = p_max
+            leaf_min = _set(_set(state.leaf_min, l, l_min, proceed),
+                            new_leaf, r_min, proceed)
+            leaf_max = _set(_set(state.leaf_max, l, l_max, proceed),
+                            new_leaf, r_max, proceed)
+
+            # ---- interaction path bookkeeping ------------------------------
+            if use_ic:
+                f_onehot = (jnp.arange(F) ==
+                            jnp.maximum(rec.feature, 0)) & (rec.feature >= 0)
+                child_path = state.path_mask[l] | f_onehot
+                path_mask = state.path_mask
+                path_mask = path_mask.at[l].set(
+                    jnp.where(proceed, child_path, path_mask[l]))
+                path_mask = path_mask.at[new_leaf].set(
+                    jnp.where(proceed, child_path, path_mask[new_leaf]))
+            else:
+                child_path = None
+                path_mask = None
+
             # ---- children best splits --------------------------------------
+            # each child gets its own per-node feature sample (rows 2i+1 and
+            # 2i+2 — siblings decorrelated, like ColSampler bynode)
+            fm_l = node_mask(2 * i + 1, child_path)
+            fm_r = node_mask(2 * i + 2, child_path)
             hists2 = jnp.stack([hist_left, hist_right])
             sg2 = jnp.stack([rec.left_sum_gradient, rec.right_sum_gradient])
             sh2 = jnp.stack([rec.left_sum_hessian, rec.right_sum_hessian])
             cn2 = jnp.stack([rec.left_count, rec.right_count])
             ou2 = jnp.stack([rec.left_output, rec.right_output])
-            best2 = jax.vmap(
-                lambda hh, a, b, c, d: best_of(hh, a, b, c, d, feature_mask)
-            )(hists2, sg2, sh2, cn2, ou2)
+            mn2 = jnp.stack([l_min, r_min])
+            mx2 = jnp.stack([l_max, r_max])
+            dp2 = jnp.stack([child_depth, child_depth])
+            if fm_l is None:
+                best2 = jax.vmap(
+                    lambda hh, a, b, c, d, mn, mx, dp: best_of(
+                        hh, a, b, c, d, None, leaf_range=(mn, mx),
+                        leaf_depth=dp)
+                )(hists2, sg2, sh2, cn2, ou2, mn2, mx2, dp2)
+            else:
+                fm2 = jnp.stack([fm_l, fm_r])
+                best2 = jax.vmap(
+                    lambda hh, a, b, c, d, mn, mx, dp, fm: best_of(
+                        hh, a, b, c, d, fm, leaf_range=(mn, mx),
+                        leaf_depth=dp)
+                )(hists2, sg2, sh2, cn2, ou2, mn2, mx2, dp2, fm2)
             best = jax.tree.map(
                 lambda cur, nb: _set(_set(cur, l, nb[0], proceed),
                                      new_leaf, nb[1], proceed),
@@ -256,7 +359,8 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 leaf_id=leaf_id, hist=hist, sum_g=sum_g, sum_h=sum_h,
                 count=count, value=value, depth=depth,
                 parent_node=parent_node, is_right=is_right, best=best,
-                tree=t, num_leaves=t.num_leaves, done=done | state.done)
+                tree=t, num_leaves=t.num_leaves, done=done | state.done,
+                leaf_min=leaf_min, leaf_max=leaf_max, path_mask=path_mask)
 
         state = lax.fori_loop(0, L - 1, body, state)
         return state.tree, state.leaf_id

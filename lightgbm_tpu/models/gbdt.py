@@ -74,6 +74,16 @@ def _host_tree_to_arrays(t: HostTree, max_leaves: int) -> TreeArrays:
     )
 
 
+def _parse_interaction_constraints(spec) -> list:
+    """Parse "[0,1,2],[2,3]" (or a list of lists) into a list of int lists
+    (ref: config.h interaction_constraints string format)."""
+    if isinstance(spec, (list, tuple)):
+        return [list(map(int, grp)) for grp in spec]
+    import re
+    return [[int(v) for v in grp.split(",") if v.strip() != ""]
+            for grp in re.findall(r"\[([^\[\]]*)\]", str(spec))]
+
+
 class _ValidData:
     """One validation set: device bins + score + metrics
     (ref: valid_score_updater_ / valid_metrics_ in gbdt.h)."""
@@ -137,7 +147,24 @@ class GBDT:
         self.train_metrics = []
 
         mappers = train.used_bin_mappers()
-        self.feature_meta = FeatureMeta.from_mappers(mappers) if mappers else None
+        # monotone constraints are per ORIGINAL feature; gather to used
+        # features (ref: feature_histogram.hpp:1440-1443)
+        monotone = None
+        if cfg.monotone_constraints:
+            mc_in = np.asarray(cfg.monotone_constraints, np.int32)
+            if len(mc_in) != train.num_total_features:
+                log.fatal(
+                    f"monotone_constraints has {len(mc_in)} entries but the "
+                    f"dataset has {train.num_total_features} features")
+            if np.any(mc_in != 0):
+                monotone = mc_in[train.used_feature_map]
+                if cfg.monotone_constraints_method not in ("basic",):
+                    log.warning(
+                        f"monotone_constraints_method="
+                        f"{cfg.monotone_constraints_method} not implemented; "
+                        "using 'basic'")
+        self.feature_meta = FeatureMeta.from_mappers(mappers, monotone) \
+            if mappers else None
         self.num_bin_max = int(max((m.num_bin for m in mappers), default=2))
         self.bins_dev = jnp.asarray(train.bins) if train.bins is not None \
             else None
@@ -164,14 +191,34 @@ class GBDT:
             min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
             min_gain_to_split=cfg.min_gain_to_split,
             max_delta_step=cfg.max_delta_step,
-            path_smooth=cfg.path_smooth)
+            path_smooth=cfg.path_smooth,
+            monotone_penalty=cfg.monotone_penalty)
         backend = "xla"
         if cfg.tpu_use_pallas and jax.default_backend() == "tpu":
             backend = "pallas"
+        # interaction constraints: "[0,1,2],[2,3]" over ORIGINAL feature
+        # indices -> tuple of tuples of USED indices (ref: col_sampler.hpp,
+        # config.h interaction_constraints)
+        groups = None
+        if cfg.interaction_constraints:
+            parsed = _parse_interaction_constraints(
+                cfg.interaction_constraints)
+            if not parsed:
+                log.fatal(
+                    f"could not parse interaction_constraints="
+                    f"{cfg.interaction_constraints!r}; expected e.g. "
+                    "\"[0,1,2],[2,3]\"")
+            orig2used = {int(o): u for u, o in
+                         enumerate(train.used_feature_map)}
+            groups = tuple(
+                tuple(orig2used[f] for f in grp if f in orig2used)
+                for grp in parsed)
+        self._bynode = cfg.feature_fraction_bynode < 1.0
         self.grower_cfg = GrowerConfig(
             num_leaves=cfg.num_leaves, max_depth=cfg.max_depth,
             num_bin=self.num_bin_max, hparams=hp, hist_backend=backend,
-            block_rows=cfg.tpu_rows_per_block)
+            block_rows=cfg.tpu_rows_per_block,
+            bynode_mask=self._bynode, interaction_groups=groups)
         if self.feature_meta is not None:
             self._grow = jax.jit(
                 make_tree_grower(self.grower_cfg, self.feature_meta))
@@ -220,16 +267,32 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def _feature_mask(self) -> Optional[jnp.ndarray]:
-        """Per-tree column sampling (ref: col_sampler.hpp feature_fraction)."""
+        """Column sampling (ref: col_sampler.hpp): feature_fraction samples
+        once per tree; feature_fraction_bynode additionally samples per node
+        (one mask row per grower step)."""
         frac = self.config.feature_fraction
         F = self.num_used_features
-        if frac >= 1.0 or F <= 1:
-            return None
-        n_take = max(1, min(F, int(round(F * frac))))
-        idx = self._col_rng.choice(F, size=n_take, replace=False)
-        mask = np.zeros(F, bool)
-        mask[idx] = True
-        return jnp.asarray(mask)
+        tree_mask = np.ones(F, bool)
+        if frac < 1.0 and F > 1:
+            n_take = max(1, min(F, int(round(F * frac))))
+            tree_mask = np.zeros(F, bool)
+            tree_mask[self._col_rng.choice(F, size=n_take,
+                                           replace=False)] = True
+        if not self._bynode:
+            if frac >= 1.0 or F <= 1:
+                return None
+            return jnp.asarray(tree_mask)
+        # per-node masks: sample within the tree-level subset per node.
+        # Row layout matches the grower: root=0, step i children 2i+1/2i+2.
+        L = self.config.num_leaves
+        frac_node = self.config.feature_fraction_bynode
+        base_idx = np.flatnonzero(tree_mask)
+        n_node = max(1, int(round(len(base_idx) * frac_node)))
+        masks = np.zeros((2 * L, F), bool)
+        for i in range(2 * L):
+            take = self._col_rng.choice(base_idx, size=n_node, replace=False)
+            masks[i, take] = True
+        return jnp.asarray(masks)
 
     def _obtain_init_score(self, k: int) -> float:
         """ref: gbdt.cpp:317 ObtainAutomaticInitialScore + network mean."""

@@ -47,6 +47,7 @@ class SplitHyperParams:
     min_gain_to_split: float = 0.0
     max_delta_step: float = 0.0
     path_smooth: float = 0.0
+    monotone_penalty: float = 0.0
 
     @property
     def use_l1(self) -> bool:
@@ -63,9 +64,12 @@ class FeatureMeta(NamedTuple):
     missing_type: jnp.ndarray  # i32 enum per MISSING_ENUM
     default_bin: jnp.ndarray   # i32
     is_categorical: jnp.ndarray  # bool
+    # i8 in {-1, 0, +1} per feature, or None when no constraints anywhere
+    # (ref: config monotone_constraints; feature_histogram.hpp:766)
+    monotone: jnp.ndarray = None
 
     @staticmethod
-    def from_mappers(mappers) -> "FeatureMeta":
+    def from_mappers(mappers, monotone=None) -> "FeatureMeta":
         return FeatureMeta(
             num_bin=jnp.asarray([m.num_bin for m in mappers], jnp.int32),
             missing_type=jnp.asarray(
@@ -73,6 +77,8 @@ class FeatureMeta(NamedTuple):
             default_bin=jnp.asarray([m.default_bin for m in mappers], jnp.int32),
             is_categorical=jnp.asarray(
                 [m.bin_type == "categorical" for m in mappers], bool),
+            monotone=(None if monotone is None
+                      else jnp.asarray(monotone, jnp.int32)),
         )
 
 
@@ -159,7 +165,8 @@ def split_gain(lg, lh, rg, rh, hp: SplitHyperParams, lcnt=None, rcnt=None,
 def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
                         num_data, parent_output, meta: FeatureMeta,
                         hp: SplitHyperParams,
-                        feature_mask: jnp.ndarray = None) -> SplitRecord:
+                        feature_mask: jnp.ndarray = None,
+                        leaf_range=None, leaf_depth=None) -> SplitRecord:
     """Find the best split over all features for one leaf.
 
     Parameters
@@ -169,6 +176,11 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
     parent_output : scalar current leaf output (for path smoothing).
     feature_mask : optional bool [F] — feature_fraction / interaction
         constraints (ref: col_sampler.hpp).
+    leaf_range : optional (min, max) output bounds from monotone ancestors
+        (ref: monotone_constraints.hpp BasicConstraint); used only when
+        meta.monotone is set.
+    leaf_depth : optional scalar i32 — this leaf's depth, for the monotone
+        split-gain penalty (monotone_constraints.hpp:358).
 
     Returns a scalar-per-field SplitRecord.
 
@@ -183,6 +195,12 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
 
     sum_hessian = sum_hessian + 2 * K_EPSILON
     num_data_f = jnp.asarray(num_data, jnp.float32)
+
+    use_mc = meta.monotone is not None
+    if use_mc:
+        mono = meta.monotone[:, None]                          # [F, 1]
+        out_min, out_max = (leaf_range if leaf_range is not None
+                            else (jnp.float32(-np.inf), jnp.float32(np.inf)))
 
     bin_idx = jnp.arange(B, dtype=jnp.int32)[None, :]          # [1, B]
     nbin = meta.num_bin[:, None]                               # [F, 1]
@@ -215,7 +233,20 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
                  (rc >= hp.min_data_in_leaf) &
                  (lh >= hp.min_sum_hessian_in_leaf) &
                  (rh >= hp.min_sum_hessian_in_leaf))
-        gains = split_gain(lg, lh, rg, rh, hp, lc, rc, parent_output)
+        if use_mc:
+            # constrained path (ref: GetSplitGains USE_MC branch,
+            # feature_histogram.hpp:781-797): outputs clamped to the leaf's
+            # [min, max]; monotone violation invalidates the candidate
+            lo = jnp.clip(calculate_splitted_leaf_output(
+                lg, lh, hp, lc, parent_output), out_min, out_max)
+            ro = jnp.clip(calculate_splitted_leaf_output(
+                rg, rh, hp, rc, parent_output), out_min, out_max)
+            viol = (((mono > 0) & (lo > ro)) | ((mono < 0) & (lo < ro)))
+            gains = (leaf_gain_given_output(lg, lh, hp, lo) +
+                     leaf_gain_given_output(rg, rh, hp, ro))
+            valid = valid & ~viol
+        else:
+            gains = split_gain(lg, lh, rg, rh, hp, lc, rc, parent_output)
         gains = jnp.where(jnp.isnan(gains), K_MIN_SCORE, gains)
         valid = valid & (gains > min_gain_shift)
         return gains, valid
@@ -284,14 +315,37 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
     if feature_mask is not None:
         best_gain = jnp.where(feature_mask, best_gain, K_MIN_SCORE)
 
-    best_f = jnp.argmax(best_gain).astype(jnp.int32)  # ties -> smaller index
-    sel = lambda a: a[best_f]
-    gain_out = sel(best_gain) - min_gain_shift
+    if use_mc and hp.monotone_penalty > 0.0:
+        # penalty scales the NET per-feature gain before cross-feature
+        # comparison (ref: serial_tree_learner.cpp:1001-1005,
+        # monotone_constraints.hpp:358 ComputeMonotoneSplitGainPenalty)
+        depth = (jnp.asarray(leaf_depth, jnp.float32)
+                 if leaf_depth is not None else jnp.float32(0.0))
+        pen = hp.monotone_penalty
+        if pen <= 1.0:
+            penalty = 1.0 - pen / jnp.exp2(depth) + K_EPSILON
+        else:
+            penalty = 1.0 - jnp.exp2(pen - 1.0 - depth) + K_EPSILON
+        penalty = jnp.where(pen >= depth + 1.0, K_EPSILON, penalty)
+        net_gain = best_gain - min_gain_shift
+        net_gain = jnp.where(mono[:, 0] != 0, net_gain * penalty, net_gain)
+        net_gain = jnp.where(best_gain > K_MIN_SCORE, net_gain, K_MIN_SCORE)
+        best_f = jnp.argmax(net_gain).astype(jnp.int32)
+        sel = lambda a: a[best_f]
+        gain_out = sel(net_gain)
+        has_valid = sel(net_gain) > K_MIN_SCORE
+    else:
+        best_f = jnp.argmax(best_gain).astype(jnp.int32)  # ties -> smaller f
+        sel = lambda a: a[best_f]
+        gain_out = sel(best_gain) - min_gain_shift
+        has_valid = sel(best_gain) > K_MIN_SCORE
     lout = calculate_splitted_leaf_output(sel(blg), sel(blh), hp, sel(blc),
                                           parent_output)
     rout = calculate_splitted_leaf_output(sel(brg), sel(brh), hp, sel(brc),
                                           parent_output)
-    has_valid = sel(best_gain) > K_MIN_SCORE
+    if use_mc:
+        lout = jnp.clip(lout, out_min, out_max)
+        rout = jnp.clip(rout, out_min, out_max)
 
     return SplitRecord(
         gain=jnp.where(has_valid, gain_out, K_MIN_SCORE),

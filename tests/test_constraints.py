@@ -1,0 +1,122 @@
+"""Monotone-constraint tests (ref: tests/python_package_test/
+test_engine.py test_monotone_constraints — trained model must be
+monotone in each constrained feature)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _make_data(rng, n=600):
+    x0 = rng.uniform(0, 1, n)
+    x1 = rng.uniform(0, 1, n)
+    x2 = rng.uniform(0, 1, n)  # unconstrained
+    y = (5 * x0 - 5 * x1 + 2 * np.sin(6 * x2)
+         + 0.1 * rng.normal(size=n))
+    X = np.column_stack([x0, x1, x2])
+    return X, y
+
+
+def _is_monotone(booster, X, feature, sign, n_grid=40):
+    """Sweep `feature` over a grid for several base rows; check direction."""
+    grid = np.linspace(0.0, 1.0, n_grid)
+    for row in X[:10]:
+        probe = np.tile(row, (n_grid, 1))
+        probe[:, feature] = grid
+        pred = booster.predict(probe)
+        diffs = np.diff(pred)
+        if sign > 0 and (diffs < -1e-10).any():
+            return False
+        if sign < 0 and (diffs > 1e-10).any():
+            return False
+    return True
+
+
+@pytest.mark.parametrize("method_params", [
+    {"monotone_constraints": [1, -1, 0]},
+    {"monotone_constraints": [1, -1, 0], "monotone_penalty": 2.0},
+])
+def test_monotone_constraints_enforced(rng, method_params):
+    X, y = _make_data(rng)
+    params = {"objective": "regression", "num_leaves": 31,
+              "min_data_in_leaf": 5, "verbosity": -1, **method_params}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=30)
+    assert _is_monotone(bst, X, 0, +1)
+    assert _is_monotone(bst, X, 1, -1)
+    # model still learns (unconstrained fit quality in the same ballpark)
+    pred = bst.predict(X)
+    assert 1 - np.var(y - pred) / np.var(y) > 0.7
+
+
+def test_unconstrained_violates(rng):
+    """Sanity: without constraints the same data DOES violate monotonicity
+    somewhere (so the test above is actually exercising the constraint)."""
+    X, y = _make_data(rng)
+    params = {"objective": "regression", "num_leaves": 31,
+              "min_data_in_leaf": 5, "verbosity": -1}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=30)
+    assert not (_is_monotone(bst, X, 0, +1) and _is_monotone(bst, X, 1, -1))
+
+
+def _used_feature_pairs(booster):
+    """Set of per-tree used-feature sets."""
+    out = []
+    for tree in booster.dump_model()["tree_info"]:
+        feats = set()
+
+        def walk(node):
+            if "split_feature" in node:
+                feats.add(int(node["split_feature"]))
+                walk(node["left_child"])
+                walk(node["right_child"])
+        walk(tree["tree_structure"])
+        out.append(feats)
+    return out
+
+
+def test_interaction_constraints(rng):
+    """Features from different groups never co-occur on a path (stronger:
+    per tree here, since every path starts at the root)
+    (ref: test_engine.py test_interaction_constraints)."""
+    X = rng.normal(size=(500, 6))
+    y = (X[:, 0] * X[:, 1] + X[:, 2] * X[:, 3] + X[:, 4]
+         + 0.05 * rng.normal(size=500))
+    params = {"objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "interaction_constraints": "[0,1],[2,3],[4,5]"}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=20)
+    groups = [{0, 1}, {2, 3}, {4, 5}]
+    for feats in _used_feature_pairs(bst):
+        if not feats:
+            continue
+        assert any(feats <= g for g in groups), \
+            f"tree used features across groups: {feats}"
+    # list-of-lists input form works too
+    params["interaction_constraints"] = [[0, 1], [2, 3], [4, 5]]
+    bst2 = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    for feats in _used_feature_pairs(bst2):
+        assert not feats or any(feats <= g for g in groups)
+
+
+def test_feature_fraction_bynode(rng):
+    X = rng.normal(size=(400, 10))
+    y = X @ rng.normal(size=10) + 0.1 * rng.normal(size=400)
+    params = {"objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "feature_fraction_bynode": 0.5}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10)
+    pred = bst.predict(X)
+    assert 1 - np.var(y - pred) / np.var(y) > 0.5
+    # combined with per-tree fraction
+    params["feature_fraction"] = 0.8
+    bst2 = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10)
+    assert np.isfinite(bst2.predict(X)).all()
+
+
+def test_monotone_constraints_aliases(rng):
+    X, y = _make_data(rng)
+    params = {"objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "monotonic_cst": [1, 0, 0]}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10)
+    assert _is_monotone(bst, X, 0, +1)
