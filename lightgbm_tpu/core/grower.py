@@ -33,7 +33,7 @@ from jax import lax
 from ..ops.histogram import make_hist_fn
 from ..ops.split import (FeatureMeta, SplitHyperParams, SplitRecord,
                          K_EPSILON, K_MIN_SCORE, best_split_for_leaf,
-                         calculate_splitted_leaf_output)
+                         calculate_splitted_leaf_output, forced_split_record)
 from .tree import TreeArrays
 
 
@@ -76,6 +76,8 @@ class GrowState(NamedTuple):
     # bool [L, F]: features used on the path from root (interaction
     # constraints); None when constraints are off
     path_mask: jnp.ndarray = None
+    # forced-split sequence still on track (ForceSplits abort semantics)
+    forced_ok: jnp.ndarray = None  # bool scalar
 
 
 def _set(arr, idx, val, cond):
@@ -85,12 +87,23 @@ def _set(arr, idx, val, cond):
 
 def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                      reduce_hist: Optional[Callable] = None,
-                     reduce_sums: Optional[Callable] = None):
+                     reduce_sums: Optional[Callable] = None,
+                     forced: Optional[tuple] = None):
     """Build the tree-growing function for a fixed dataset geometry.
 
-    Returns ``grow(bins_t, gh, feature_mask) -> (TreeArrays, leaf_id)`` where
-    ``bins_t`` is uint8/uint16 [F, R] and ``gh`` is f32 [R, 3] =
-    (grad*m, hess*m, m) with m the bagging/validity mask.
+    Returns ``grow(bins_t, gh, feature_mask, cegb) -> (TreeArrays, leaf_id)``
+    where ``bins_t`` is uint8/uint16 [F, R] and ``gh`` is f32 [R, 3] =
+    (grad*m, hess*m, m) with m the bagging/validity mask. ``cegb`` is an
+    optional (const [F], per_count [F]) penalty pair — CEGB's DeltaGain as
+    penalty[f] = const[f] + per_count[f] * num_data_in_leaf.
+
+    ``forced`` bakes a forced-split prefix into the program
+    (ref: SerialTreeLearner::ForceSplits serial_tree_learner.cpp:560):
+    (active [L-1] bool, slot [L-1], feature [L-1], threshold_bin [L-1])
+    numpy arrays; step i with active[i] splits leaf slot[i] at the given
+    (feature, threshold) instead of the best-gain leaf. A forced split whose
+    net gain is not positive aborts the remaining forced prefix and normal
+    best-first growth takes over (abort_last_forced_split semantics).
     """
     hp = cfg.hparams
     L = cfg.num_leaves
@@ -107,19 +120,26 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
 
     use_mc = meta.monotone is not None
     use_ic = cfg.interaction_groups is not None
+    if forced is not None:
+        forced_active = jnp.asarray(forced[0], bool)
+        forced_slot = jnp.asarray(forced[1], jnp.int32)
+        forced_feat = jnp.asarray(forced[2], jnp.int32)
+        forced_thr = jnp.asarray(forced[3], jnp.int32)
 
     def leaf_hist(bins_t, gh, leaf_id, target_leaf):
         mask = (leaf_id == target_leaf).astype(gh.dtype)
         return reduce_hist(hist_fn(bins_t, gh * mask[:, None]))
 
     def best_of(hist, sg, sh, cnt, parent_out, feature_mask,
-                leaf_range=None, leaf_depth=None):
+                leaf_range=None, leaf_depth=None, cegb=None):
+        gp = None if cegb is None else cegb[0] + cegb[1] * cnt
         return best_split_for_leaf(hist, sg, sh, cnt, parent_out, meta, hp,
                                    feature_mask, leaf_range=leaf_range,
-                                   leaf_depth=leaf_depth)
+                                   leaf_depth=leaf_depth, gain_penalty=gp)
 
     def grow(bins_t: jnp.ndarray, gh: jnp.ndarray,
-             feature_mask: Optional[jnp.ndarray] = None
+             feature_mask: Optional[jnp.ndarray] = None,
+             cegb: Optional[tuple] = None
              ) -> Tuple[TreeArrays, jnp.ndarray]:
         F, R = bins_t.shape
 
@@ -160,7 +180,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         root_path = jnp.zeros(F, bool)
         best_root = best_of(hist_root, root_g, root_h, root_c, root_out,
                             node_mask(0, root_path), leaf_range=(-inf, inf),
-                            leaf_depth=jnp.int32(0))
+                            leaf_depth=jnp.int32(0), cegb=cegb)
 
         hist_pool = jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(hist_root)
         zf = jnp.zeros(L, jnp.float32)
@@ -185,6 +205,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             leaf_min=jnp.full(L, -jnp.inf, jnp.float32),
             leaf_max=jnp.full(L, jnp.inf, jnp.float32),
             path_mask=jnp.zeros((L, F), bool) if use_ic else None,
+            forced_ok=jnp.asarray(True),
         )
 
         def body(i, state: GrowState) -> GrowState:
@@ -195,11 +216,37 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             cand = jnp.where(exists, state.best.gain, K_MIN_SCORE)
             l = jnp.argmax(cand).astype(jnp.int32)
             gain = cand[l]
+            forced_ok = state.forced_ok
+
+            if forced is not None:
+                # forced-prefix step: split forced_slot[i] at the given
+                # (feature, threshold) if its net gain is positive;
+                # otherwise abort the rest of the forced prefix and fall
+                # back to the best-gain leaf this very step
+                # (ref: serial_tree_learner.cpp ForceSplits + abort path)
+                want_forced = forced_active[i] & state.forced_ok
+                slot_i = forced_slot[i]
+                frec = forced_split_record(
+                    state.hist[slot_i], forced_feat[i], forced_thr[i],
+                    state.sum_g[slot_i], state.sum_h[slot_i],
+                    state.count[slot_i], state.value[slot_i], meta, hp)
+                f_valid = frec.gain > 0.0
+                if cfg.max_depth > 0:  # forced prefix honors max_depth too
+                    f_valid &= state.depth[slot_i] < cfg.max_depth
+                apply_forced = want_forced & f_valid
+                forced_ok = state.forced_ok & (~want_forced | f_valid)
+                l = jnp.where(apply_forced, slot_i, l)
+                gain = jnp.where(apply_forced, frec.gain, gain)
+                rec = jax.tree.map(
+                    lambda fa, a: jnp.where(apply_forced, fa, a[l]),
+                    frec, state.best)
+            else:
+                rec = jax.tree.map(lambda a: a[l], state.best)
+
             proceed = jnp.logical_and(~state.done, gain > 0.0)
             done = ~proceed
             new_leaf = i + 1  # static thanks to latched done
 
-            rec = jax.tree.map(lambda a: a[l], state.best)
             t = state.tree
 
             # ---- record split into tree arrays (ref: tree.cpp Tree::Split) --
@@ -341,14 +388,14 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 best2 = jax.vmap(
                     lambda hh, a, b, c, d, mn, mx, dp: best_of(
                         hh, a, b, c, d, None, leaf_range=(mn, mx),
-                        leaf_depth=dp)
+                        leaf_depth=dp, cegb=cegb)
                 )(hists2, sg2, sh2, cn2, ou2, mn2, mx2, dp2)
             else:
                 fm2 = jnp.stack([fm_l, fm_r])
                 best2 = jax.vmap(
                     lambda hh, a, b, c, d, mn, mx, dp, fm: best_of(
                         hh, a, b, c, d, fm, leaf_range=(mn, mx),
-                        leaf_depth=dp)
+                        leaf_depth=dp, cegb=cegb)
                 )(hists2, sg2, sh2, cn2, ou2, mn2, mx2, dp2, fm2)
             best = jax.tree.map(
                 lambda cur, nb: _set(_set(cur, l, nb[0], proceed),
@@ -360,7 +407,8 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 count=count, value=value, depth=depth,
                 parent_node=parent_node, is_right=is_right, best=best,
                 tree=t, num_leaves=t.num_leaves, done=done | state.done,
-                leaf_min=leaf_min, leaf_max=leaf_max, path_mask=path_mask)
+                leaf_min=leaf_min, leaf_max=leaf_max, path_mask=path_mask,
+                forced_ok=forced_ok)
 
         state = lax.fori_loop(0, L - 1, body, state)
         return state.tree, state.leaf_id

@@ -74,6 +74,12 @@ def _host_tree_to_arrays(t: HostTree, max_leaves: int) -> TreeArrays:
     )
 
 
+def _orig_to_used(used_feature_map) -> dict:
+    """Original feature index -> used (inner) index (ref: Dataset::
+    InnerFeatureIndex)."""
+    return {int(o): u for u, o in enumerate(used_feature_map)}
+
+
 def _parse_interaction_constraints(spec) -> list:
     """Parse "[0,1,2],[2,3]" (or a list of lists) into a list of int lists
     (ref: config.h interaction_constraints string format)."""
@@ -208,8 +214,7 @@ class GBDT:
                     f"could not parse interaction_constraints="
                     f"{cfg.interaction_constraints!r}; expected e.g. "
                     "\"[0,1,2],[2,3]\"")
-            orig2used = {int(o): u for u, o in
-                         enumerate(train.used_feature_map)}
+            orig2used = _orig_to_used(train.used_feature_map)
             groups = tuple(
                 tuple(orig2used[f] for f in grp if f in orig2used)
                 for grp in parsed)
@@ -219,9 +224,12 @@ class GBDT:
             num_bin=self.num_bin_max, hparams=hp, hist_backend=backend,
             block_rows=cfg.tpu_rows_per_block,
             bynode_mask=self._bynode, interaction_groups=groups)
+        forced = self._load_forced_splits(train)
+        self._setup_cegb(train)
         if self.feature_meta is not None:
             self._grow = jax.jit(
-                make_tree_grower(self.grower_cfg, self.feature_meta))
+                make_tree_grower(self.grower_cfg, self.feature_meta,
+                                 forced=forced))
         else:
             self._grow = None
 
@@ -264,6 +272,144 @@ class GBDT:
         for m in metrics:
             m.init(self.train_set.metadata, self.num_data)
         self.train_metrics = metrics
+
+    # ------------------------------------------------------------------
+    def _load_forced_splits(self, train: BinnedDataset):
+        """Parse forcedsplits_filename JSON into the grower's static forced
+        arrays (ref: gbdt.cpp:91-97 forced_splits_json_, serial_tree_learner
+        ForceSplits). Leaf slots are simulated exactly like the grower
+        assigns them: splitting slot s at step i keeps the left child in s
+        and puts the right child in slot i+1."""
+        cfg = self.config
+        if not cfg.forcedsplits_filename:
+            return None
+        import json
+        with open(cfg.forcedsplits_filename) as f:
+            root = json.load(f)
+        if not root or "feature" not in root:
+            return None
+        orig2used = _orig_to_used(train.used_feature_map)
+        L = cfg.num_leaves
+        active = np.zeros(L - 1, bool)
+        slot = np.zeros(L - 1, np.int32)
+        feat = np.zeros(L - 1, np.int32)
+        thr = np.zeros(L - 1, np.int32)
+        from collections import deque
+        q = deque([(root, 0)])
+        step = 0
+        while q and step < L - 1:
+            node, s = q.popleft()
+            f_orig = int(node["feature"])
+            if f_orig not in orig2used:
+                log.warning(f"forced split on unused feature {f_orig}; "
+                            "stopping forced prefix here")
+                break
+            mapper = train.bin_mappers[f_orig]
+            # real threshold -> bin: the left side is value <= threshold,
+            # i.e. bin(threshold) (ref: Dataset::BinThreshold)
+            tb = int(mapper.value_to_bin(
+                np.asarray([float(node["threshold"])]))[0])
+            active[step] = True
+            slot[step] = s
+            feat[step] = orig2used[f_orig]
+            thr[step] = tb
+            left_slot, right_slot = s, step + 1
+            for key, child_slot in (("left", left_slot),
+                                    ("right", right_slot)):
+                child = node.get(key)
+                if isinstance(child, dict) and "feature" in child and \
+                        "threshold" in child:
+                    q.append((child, child_slot))
+            step += 1
+        if not active.any():
+            return None
+        return (active, slot, feat, thr)
+
+    # ------------------------------------------------------------------
+    def _setup_cegb(self, train: BinnedDataset) -> None:
+        """Cost-efficient gradient boosting state (ref: cost_effective_
+        gradient_boosting.hpp). Penalties are applied per feature as
+        penalty[f] = const[f] + per_count[f] * num_data_in_leaf:
+
+        - cegb_penalty_split enters per_count exactly;
+        - cegb_penalty_feature_coupled enters const for features not yet
+          used anywhere in the forest (used-set updated between trees —
+          the reference's within-tree re-ranking of cached candidates,
+          UpdateLeafBestSplits, is approximated at tree granularity);
+        - cegb_penalty_feature_lazy enters per_count scaled by the fraction
+          of rows not yet charged for the feature (the reference charges
+          per uncharged row in the leaf; here the global uncharged fraction
+          stands in for the per-leaf one, again tree-granular).
+        """
+        cfg = self.config
+        F = train.num_used_features
+        coupled = cfg.cegb_penalty_feature_coupled
+        lazy = cfg.cegb_penalty_feature_lazy
+        self._cegb_enabled = bool(
+            cfg.cegb_penalty_split > 0.0 or coupled or lazy)
+        if not self._cegb_enabled:
+            return
+        for name, pen in (("coupled", coupled), ("lazy", lazy)):
+            if pen and len(pen) != train.num_total_features:
+                log.fatal(f"cegb_penalty_feature_{name} should be the same "
+                          "size as feature number")
+        ufm = train.used_feature_map
+        self._cegb_coupled = (np.asarray(coupled, np.float64)[ufm]
+                              if coupled else np.zeros(F))
+        self._cegb_lazy = (np.asarray(lazy, np.float64)[ufm]
+                           if lazy else np.zeros(F))
+        self._cegb_feature_used = np.zeros(F, bool)
+        self._cegb_row_charged = (np.zeros((F, self.num_data), bool)
+                                  if lazy else None)
+
+    def _cegb_penalty(self):
+        """(const [F], per_count [F]) for the current tree, or None."""
+        if not getattr(self, "_cegb_enabled", False):
+            return None
+        cfg = self.config
+        tradeoff = cfg.cegb_tradeoff
+        const = tradeoff * self._cegb_coupled * (~self._cegb_feature_used)
+        per_count = np.full(self.num_used_features,
+                            tradeoff * cfg.cegb_penalty_split)
+        if self._cegb_row_charged is not None:
+            frac_uncharged = 1.0 - self._cegb_row_charged.mean(axis=1)
+            per_count = per_count + tradeoff * self._cegb_lazy * frac_uncharged
+        return (jnp.asarray(const, jnp.float32),
+                jnp.asarray(per_count, jnp.float32))
+
+    def _cegb_after_tree(self, host: "HostTree", leaf_np: np.ndarray,
+                         selected: Optional[np.ndarray] = None) -> None:
+        """Update the forest-level used-feature set and per-row charges.
+        ``selected`` is the bagging mask — only in-bag rows actually had
+        their features fetched, so only they get charged (ref: cost_
+        effective_gradient_boosting.hpp UpdateLeafBestSplits uses
+        data_partition indices, which contain bagged rows only)."""
+        if not getattr(self, "_cegb_enabled", False):
+            return
+        n_int = host.num_leaves - 1
+        for i in range(n_int):
+            self._cegb_feature_used[int(host.split_feature_inner[i])] = True
+        if self._cegb_row_charged is not None and n_int > 0:
+            # rows in each leaf are charged for the features on its path
+            path_feats = {}  # leaf -> set of inner features
+
+            def walk(node, feats):
+                if node < 0:
+                    path_feats[~node] = feats
+                    return
+                f = int(host.split_feature_inner[node])
+                walk(int(host.left_child[node]), feats | {f})
+                walk(int(host.right_child[node]), feats | {f})
+            walk(0, frozenset())
+            in_bag = selected > 0 if selected is not None else None
+            for leaf, feats in path_feats.items():
+                if not feats:
+                    continue
+                rows = leaf_np == leaf
+                if in_bag is not None:
+                    rows = rows & in_bag
+                for f in feats:
+                    self._cegb_row_charged[f, rows] = True
 
     # ------------------------------------------------------------------
     def _feature_mask(self) -> Optional[jnp.ndarray]:
@@ -367,7 +513,8 @@ class GBDT:
                 ones = jnp.ones_like(g)
                 gh = jnp.stack([g, h, ones], axis=1)
             fmask = self._feature_mask()
-            tree_dev, leaf_id = self._grow(self.bins_dev, gh, fmask)
+            tree_dev, leaf_id = self._grow(self.bins_dev, gh, fmask,
+                                           self._cegb_penalty())
             host = HostTree(jax.tree.map(np.asarray, tree_dev),
                             self.train_set.used_feature_map)
 
@@ -389,6 +536,7 @@ class GBDT:
             should_continue = True
             self._finalize_tree(host)
             leaf_np = np.asarray(leaf_id)
+            self._cegb_after_tree(host, leaf_np, selected)
 
             # -- RenewTreeOutput (L1-family percentile re-fit) ----------
             # (ref: gbdt.cpp:418 via tree_learner_->RenewTreeOutput)

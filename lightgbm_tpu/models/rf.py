@@ -79,7 +79,8 @@ class RF(GBDT):
             else:
                 gh = jnp.stack([g, h, jnp.ones_like(g)], axis=1)
             fmask = self._feature_mask()
-            tree_dev, leaf_id = self._grow(self.bins_dev, gh, fmask)
+            tree_dev, leaf_id = self._grow(self.bins_dev, gh, fmask,
+                                           self._cegb_penalty())
             import jax
             host = HostTree(jax.tree.map(np.asarray, tree_dev),
                             self.train_set.used_feature_map)
@@ -90,6 +91,7 @@ class RF(GBDT):
             should_continue = True
             self._finalize_tree(host)
             leaf_np = np.asarray(leaf_id)
+            self._cegb_after_tree(host, leaf_np, selected)
 
             if self.objective is not None and \
                     self.objective.is_renew_tree_output():

@@ -166,7 +166,8 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
                         num_data, parent_output, meta: FeatureMeta,
                         hp: SplitHyperParams,
                         feature_mask: jnp.ndarray = None,
-                        leaf_range=None, leaf_depth=None) -> SplitRecord:
+                        leaf_range=None, leaf_depth=None,
+                        gain_penalty: jnp.ndarray = None) -> SplitRecord:
     """Find the best split over all features for one leaf.
 
     Parameters
@@ -181,6 +182,9 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
         meta.monotone is set.
     leaf_depth : optional scalar i32 — this leaf's depth, for the monotone
         split-gain penalty (monotone_constraints.hpp:358).
+    gain_penalty : optional f32 [F] — per-feature penalty subtracted from
+        the net gain before the cross-feature argmax (CEGB DeltaGain,
+        cost_effective_gradient_boosting.hpp:81-98).
 
     Returns a scalar-per-field SplitRecord.
 
@@ -315,10 +319,15 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
     if feature_mask is not None:
         best_gain = jnp.where(feature_mask, best_gain, K_MIN_SCORE)
 
+    # per-feature NET gain; per-feature modifiers apply before the
+    # cross-feature argmax (ref: serial_tree_learner.cpp:996-1005 — CEGB
+    # DeltaGain subtraction then monotone penalty on new_split.gain)
+    valid_any = best_gain > K_MIN_SCORE
+    net_gain = jnp.where(valid_any, best_gain - min_gain_shift, K_MIN_SCORE)
+    if gain_penalty is not None:
+        net_gain = jnp.where(valid_any, net_gain - gain_penalty, net_gain)
     if use_mc and hp.monotone_penalty > 0.0:
-        # penalty scales the NET per-feature gain before cross-feature
-        # comparison (ref: serial_tree_learner.cpp:1001-1005,
-        # monotone_constraints.hpp:358 ComputeMonotoneSplitGainPenalty)
+        # (ref: monotone_constraints.hpp:358 ComputeMonotoneSplitGainPenalty)
         depth = (jnp.asarray(leaf_depth, jnp.float32)
                  if leaf_depth is not None else jnp.float32(0.0))
         pen = hp.monotone_penalty
@@ -327,18 +336,12 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
         else:
             penalty = 1.0 - jnp.exp2(pen - 1.0 - depth) + K_EPSILON
         penalty = jnp.where(pen >= depth + 1.0, K_EPSILON, penalty)
-        net_gain = best_gain - min_gain_shift
-        net_gain = jnp.where(mono[:, 0] != 0, net_gain * penalty, net_gain)
-        net_gain = jnp.where(best_gain > K_MIN_SCORE, net_gain, K_MIN_SCORE)
-        best_f = jnp.argmax(net_gain).astype(jnp.int32)
-        sel = lambda a: a[best_f]
-        gain_out = sel(net_gain)
-        has_valid = sel(net_gain) > K_MIN_SCORE
-    else:
-        best_f = jnp.argmax(best_gain).astype(jnp.int32)  # ties -> smaller f
-        sel = lambda a: a[best_f]
-        gain_out = sel(best_gain) - min_gain_shift
-        has_valid = sel(best_gain) > K_MIN_SCORE
+        net_gain = jnp.where(valid_any & (mono[:, 0] != 0),
+                             net_gain * penalty, net_gain)
+    best_f = jnp.argmax(net_gain).astype(jnp.int32)  # ties -> smaller f
+    sel = lambda a: a[best_f]
+    gain_out = sel(net_gain)
+    has_valid = sel(valid_any)
     lout = calculate_splitted_leaf_output(sel(blg), sel(blh), hp, sel(blc),
                                           parent_output)
     rout = calculate_splitted_leaf_output(sel(brg), sel(brh), hp, sel(brc),
@@ -359,5 +362,67 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
         right_sum_gradient=sel(brg),
         right_sum_hessian=sel(brh) - K_EPSILON,
         right_count=sel(brc),
+        right_output=rout,
+    )
+
+
+def forced_split_record(hist: jnp.ndarray, feature, threshold_bin,
+                        sum_gradient, sum_hessian, num_data, parent_output,
+                        meta: FeatureMeta, hp: SplitHyperParams
+                        ) -> SplitRecord:
+    """Split statistics for a FORCED (feature, threshold) on one leaf.
+
+    Mirror of FeatureHistogram::GatherInfoForThresholdNumerical
+    (ref: feature_histogram.hpp:487-589, used by SerialTreeLearner::
+    ForceSplits serial_tree_learner.cpp:560-740): the right side accumulates
+    bins in (threshold, hi] with the zero-missing default bin skipped and
+    the NaN bin pinned left; default_left is always True; the split is
+    invalid (kMinScore) when its net gain is not positive — the reference
+    warns and ignores such forced splits.
+    """
+    F, B, _ = hist.shape
+    f = jnp.maximum(feature, 0)
+    hist_f = hist[f]                               # [B, 3]
+    g, h, c = hist_f[:, 0], hist_f[:, 1], hist_f[:, 2]
+    sum_hessian = sum_hessian + 2 * K_EPSILON
+    num_data_f = jnp.asarray(num_data, jnp.float32)
+
+    nbin_f = meta.num_bin[f]
+    miss_f = meta.missing_type[f]
+    dflt_f = meta.default_bin[f]
+    bin_idx = jnp.arange(B, dtype=jnp.int32)
+    hi = nbin_f - 1 - (miss_f == MISSING_ENUM["nan"]).astype(jnp.int32)
+    right_mask = ((bin_idx > threshold_bin) & (bin_idx <= hi) &
+                  ~((miss_f == MISSING_ENUM["zero"]) & (bin_idx == dflt_f)))
+    rm = right_mask.astype(hist.dtype)
+    rg = jnp.sum(g * rm)
+    rh = jnp.sum(h * rm) + K_EPSILON
+    rc = jnp.sum(c * rm)
+    lg = sum_gradient - rg
+    lh = sum_hessian - rh
+    lc = num_data_f - rc
+
+    gain_shift = leaf_gain(sum_gradient, sum_hessian, hp, num_data_f,
+                           parent_output)
+    min_gain_shift = gain_shift + hp.min_gain_to_split
+    gain = (leaf_gain(lg, lh, hp, lc, parent_output) +
+            leaf_gain(rg, rh, hp, rc, parent_output))
+    valid = jnp.isfinite(gain) & (gain > min_gain_shift)
+
+    lout = calculate_splitted_leaf_output(lg, lh, hp, lc, parent_output)
+    rout = calculate_splitted_leaf_output(rg, rh, hp, rc, parent_output)
+    return SplitRecord(
+        gain=jnp.where(valid, gain - min_gain_shift,
+                       jnp.float32(K_MIN_SCORE)),
+        feature=jnp.where(valid, f, -1).astype(jnp.int32),
+        threshold=jnp.asarray(threshold_bin, jnp.int32),
+        default_left=jnp.asarray(True),
+        left_sum_gradient=lg,
+        left_sum_hessian=lh - K_EPSILON,
+        left_count=lc,
+        left_output=lout,
+        right_sum_gradient=rg,
+        right_sum_hessian=rh - K_EPSILON,
+        right_count=rc,
         right_output=rout,
     )

@@ -113,6 +113,105 @@ def test_feature_fraction_bynode(rng):
     assert np.isfinite(bst2.predict(X)).all()
 
 
+def test_forced_splits(rng, tmp_path):
+    """Forced JSON prefix appears at the top of every tree
+    (ref: test_engine.py test_forced_split, examples forced splits JSON)."""
+    import json
+    X = rng.normal(size=(500, 5))
+    y = X[:, 0] + 2 * X[:, 2] + 0.05 * rng.normal(size=500)
+    fs = tmp_path / "forced.json"
+    fs.write_text(json.dumps({
+        "feature": 1, "threshold": 0.0,
+        "left": {"feature": 3, "threshold": 0.5},
+    }))
+    params = {"objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "forcedsplits_filename": str(fs)}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    for tree in bst.dump_model()["tree_info"]:
+        root = tree["tree_structure"]
+        assert root["split_feature"] == 1
+        assert abs(root["threshold"] - 0.0) < 0.5  # bin upper bound near 0
+        left = root["left_child"]
+        assert left["split_feature"] == 3
+    pred = bst.predict(X)
+    assert 1 - np.var(y - pred) / np.var(y) > 0.3
+
+
+def test_cegb_penalty_reduces_splits(rng):
+    """CEGB feature penalties steer splits away from penalized features
+    (ref: test_engine.py test_cegb)."""
+    X = rng.normal(size=(500, 4))
+    # feature 0 and 1 are equally informative (duplicated signal)
+    X[:, 1] = X[:, 0] + 0.01 * rng.normal(size=500)
+    y = X[:, 0] + 0.5 * X[:, 2] + 0.05 * rng.normal(size=500)
+    base = {"objective": "regression", "num_leaves": 15,
+            "min_data_in_leaf": 5, "verbosity": -1}
+    bst = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=10)
+    # heavily penalize feature 0 -> its splits migrate to twin feature 1
+    pen = dict(base, cegb_penalty_feature_coupled=[1e6, 0.0, 0.0, 0.0])
+    bst_pen = lgb.train(pen, lgb.Dataset(X, label=y), num_boost_round=10)
+    imp = bst.feature_importance()
+    imp_pen = bst_pen.feature_importance()
+    assert imp[0] > 0                      # unpenalized model uses f0
+    assert imp_pen[0] == 0                 # penalized model avoids f0
+    assert imp_pen[1] > 0                  # twin takes over
+    # split penalty shrinks tree sizes
+    pen2 = dict(base, cegb_penalty_split=0.5)
+    bst_small = lgb.train(pen2, lgb.Dataset(X, label=y), num_boost_round=10)
+    n_leaves = sum(t["num_leaves"] for t in
+                   bst_small.dump_model()["tree_info"])
+    n_leaves_base = sum(t["num_leaves"] for t in
+                        bst.dump_model()["tree_info"])
+    assert n_leaves < n_leaves_base
+
+
+def test_forced_splits_respect_max_depth(rng, tmp_path):
+    import json
+    X = rng.normal(size=(400, 3))
+    y = X[:, 0] + X[:, 1] + 0.05 * rng.normal(size=400)
+    fs = tmp_path / "forced.json"
+    # 3-deep forced spine with max_depth=2: deepest forced split must drop
+    fs.write_text(json.dumps({
+        "feature": 0, "threshold": 0.0,
+        "left": {"feature": 1, "threshold": 0.0,
+                 "left": {"feature": 2, "threshold": 0.0}}}))
+    params = {"objective": "regression", "num_leaves": 8, "max_depth": 2,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "forcedsplits_filename": str(fs)}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=3)
+
+    def depth(node):
+        if "split_feature" not in node:
+            return 0
+        return 1 + max(depth(node["left_child"]), depth(node["right_child"]))
+    for tree in bst.dump_model()["tree_info"]:
+        assert depth(tree["tree_structure"]) <= 2
+
+
+def test_cegb_applies_in_rf_mode(rng):
+    X = rng.normal(size=(400, 4))
+    X[:, 1] = X[:, 0] + 0.01 * rng.normal(size=400)
+    y = (X[:, 0] + 0.5 * X[:, 2] > 0).astype(np.float64)
+    params = {"objective": "binary", "boosting": "rf",
+              "bagging_fraction": 0.8, "bagging_freq": 1,
+              "num_leaves": 15, "min_data_in_leaf": 5, "verbosity": -1,
+              "cegb_penalty_feature_coupled": [1e6, 0.0, 0.0, 0.0]}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    assert bst.feature_importance()[0] == 0  # penalized feature avoided
+
+
+def test_cegb_lazy_penalty_runs(rng):
+    X = rng.normal(size=(300, 4))
+    y = X[:, 0] + 0.05 * rng.normal(size=300)
+    params = {"objective": "regression", "num_leaves": 7,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "cegb_penalty_feature_lazy": [0.01] * 4,
+              "cegb_tradeoff": 2.0}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    assert np.isfinite(bst.predict(X)).all()
+
+
 def test_monotone_constraints_aliases(rng):
     X, y = _make_data(rng)
     params = {"objective": "regression", "num_leaves": 15,
