@@ -88,7 +88,11 @@ def _set(arr, idx, val, cond):
 def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                      reduce_hist: Optional[Callable] = None,
                      reduce_sums: Optional[Callable] = None,
-                     forced: Optional[tuple] = None):
+                     forced: Optional[tuple] = None,
+                     prepare_split_hist: Optional[Callable] = None,
+                     select_best: Optional[Callable] = None,
+                     fetch_bin_column: Optional[Callable] = None,
+                     partition_meta: Optional[FeatureMeta] = None):
     """Build the tree-growing function for a fixed dataset geometry.
 
     Returns ``grow(bins_t, gh, feature_mask, cegb) -> (TreeArrays, leaf_id)``
@@ -104,6 +108,27 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     (feature, threshold) instead of the best-gain leaf. A forced split whose
     net gain is not positive aborts the remaining forced prefix and normal
     best-first growth takes over (abort_last_forced_split semantics).
+
+    Distributed-learner hooks (SURVEY.md §2.3 strategies):
+    - reduce_hist(h, ctx): applied to the freshly built (smaller-child)
+      histogram before it enters the pool. Data-parallel psums here so
+      the pool holds GLOBAL hists and sibling subtraction needs no comm
+      (≡ ReduceScatter, data_parallel_tree_learner.cpp:285). Voting keeps
+      it identity so the pool stays LOCAL (≡ voting learner's local
+      smaller/larger arrays + local Subtract).
+    - prepare_split_hist(h, ctx) -> (h', extra_feature_mask|None): applied
+      per child right before the split scan. Voting does its vote +
+      selective psum here (≡ GlobalVoting + CopyLocalHistogram +
+      ReduceScatter of selected features).
+    - select_best(rec) -> rec: cross-device winner selection
+      (≡ SyncUpGlobalBestSplit, parallel_tree_learner.h:210) — used by the
+      feature-parallel learner, where each device scans its feature slice.
+    - fetch_bin_column(bins_t, f) -> [R] i32: the split feature's bin
+      column for partitioning; feature-parallel broadcasts the owner's
+      column. ``partition_meta`` is the GLOBAL FeatureMeta used for the
+      partition direction rules when ``meta`` is a sharded slice.
+    ctx is (sum_g, sum_h, count, output) of the leaf the histogram
+    belongs to.
     """
     hp = cfg.hparams
     L = cfg.num_leaves
@@ -114,9 +139,17 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     # branch — replaced by masking so every device executes it symmetrically.
     distributed = reduce_hist is not None
     if reduce_hist is None:
-        reduce_hist = lambda h: h
+        reduce_hist = lambda h, ctx=None: h
     if reduce_sums is None:
         reduce_sums = lambda s: s
+    if prepare_split_hist is None:
+        prepare_split_hist = lambda h, ctx=None, fm=None: (h, None)
+    if select_best is None:
+        select_best = lambda rec: rec
+    if fetch_bin_column is None:
+        fetch_bin_column = lambda bt, f: jnp.take(
+            bt, jnp.maximum(f, 0), axis=0).astype(jnp.int32)
+    pmeta = partition_meta if partition_meta is not None else meta
 
     use_mc = meta.monotone is not None
     use_ic = cfg.interaction_groups is not None
@@ -126,16 +159,22 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         forced_feat = jnp.asarray(forced[2], jnp.int32)
         forced_thr = jnp.asarray(forced[3], jnp.int32)
 
-    def leaf_hist(bins_t, gh, leaf_id, target_leaf):
+    def leaf_hist(bins_t, gh, leaf_id, target_leaf, ctx=None):
         mask = (leaf_id == target_leaf).astype(gh.dtype)
-        return reduce_hist(hist_fn(bins_t, gh * mask[:, None]))
+        return reduce_hist(hist_fn(bins_t, gh * mask[:, None]), ctx)
 
     def best_of(hist, sg, sh, cnt, parent_out, feature_mask,
                 leaf_range=None, leaf_depth=None, cegb=None):
+        hist, extra_mask = prepare_split_hist(
+            hist, (sg, sh, cnt, parent_out), feature_mask)
+        if extra_mask is not None:
+            feature_mask = (extra_mask if feature_mask is None
+                            else feature_mask & extra_mask)
         gp = None if cegb is None else cegb[0] + cegb[1] * cnt
-        return best_split_for_leaf(hist, sg, sh, cnt, parent_out, meta, hp,
-                                   feature_mask, leaf_range=leaf_range,
-                                   leaf_depth=leaf_depth, gain_penalty=gp)
+        rec = best_split_for_leaf(hist, sg, sh, cnt, parent_out, meta, hp,
+                                  feature_mask, leaf_range=leaf_range,
+                                  leaf_depth=leaf_depth, gain_penalty=gp)
+        return select_best(rec)
 
     def grow(bins_t: jnp.ndarray, gh: jnp.ndarray,
              feature_mask: Optional[jnp.ndarray] = None,
@@ -175,7 +214,8 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         root_out = calculate_splitted_leaf_output(
             root_g, root_h + 2 * K_EPSILON, hp, root_c, jnp.float32(0.0))
         leaf_id0 = jnp.zeros(R, jnp.int32)
-        hist_root = reduce_hist(hist_fn(bins_t, gh))
+        hist_root = reduce_hist(hist_fn(bins_t, gh),
+                                (root_g, root_h, root_c, root_out))
         inf = jnp.float32(jnp.inf)
         root_path = jnp.zeros(F, bool)
         best_root = best_of(hist_root, root_g, root_h, root_c, root_out,
@@ -284,10 +324,10 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
 
             # ---- partition rows (ref: dense_bin.hpp:317 SplitInner) --------
             f = rec.feature
-            bin_col = jnp.take(bins_t, jnp.maximum(f, 0), axis=0).astype(jnp.int32)
-            nbin_f = meta.num_bin[f]
-            miss_f = meta.missing_type[f]
-            dflt_f = meta.default_bin[f]
+            bin_col = fetch_bin_column(bins_t, f)
+            nbin_f = pmeta.num_bin[f]
+            miss_f = pmeta.missing_type[f]
+            dflt_f = pmeta.default_bin[f]
             go_left = bin_col <= rec.threshold
             is_nan_bin = (miss_f == 2) & (bin_col == nbin_f - 1)
             is_dflt_bin = (miss_f == 1) & (bin_col == dflt_f)
@@ -318,14 +358,21 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             # (ref: serial_tree_learner.cpp:368-386 + FeatureHistogram::Subtract)
             left_smaller = rec.left_count <= rec.right_count
             small_leaf = jnp.where(left_smaller, l, new_leaf)
+            pick = lambda a, b: jnp.where(left_smaller, a, b)
+            small_ctx = (pick(rec.left_sum_gradient, rec.right_sum_gradient),
+                         pick(rec.left_sum_hessian, rec.right_sum_hessian),
+                         pick(rec.left_count, rec.right_count),
+                         pick(rec.left_output, rec.right_output))
             if distributed:
                 # mask instead of branch: dead steps contribute psum(0)
                 gh_live = gh * proceed.astype(gh.dtype)
-                hist_small = leaf_hist(bins_t, gh_live, leaf_id, small_leaf)
+                hist_small = leaf_hist(bins_t, gh_live, leaf_id, small_leaf,
+                                       small_ctx)
             else:
                 hist_small = lax.cond(
                     proceed,
-                    lambda: leaf_hist(bins_t, gh, leaf_id, small_leaf),
+                    lambda: leaf_hist(bins_t, gh, leaf_id, small_leaf,
+                                      small_ctx),
                     lambda: jnp.zeros((F, B, 3), jnp.float32))
             hist_parent = state.hist[l]
             hist_large = hist_parent - hist_small
@@ -342,7 +389,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             p_min, p_max = state.leaf_min[l], state.leaf_max[l]
             if use_mc:
                 mono_f = jnp.where(rec.feature >= 0,
-                                   meta.monotone[jnp.maximum(rec.feature, 0)],
+                                   pmeta.monotone[jnp.maximum(rec.feature, 0)],
                                    0)
                 mid = (rec.left_output + rec.right_output) * 0.5
                 l_min = jnp.where(mono_f < 0, jnp.maximum(p_min, mid), p_min)

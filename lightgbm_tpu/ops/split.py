@@ -192,6 +192,17 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
     seeding: accumulating side starts at kEpsilon, parent hessian has +2eps
     (ref: feature_histogram.hpp:172 FindBestThreshold call site).
     """
+    scan = _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
+                             parent_output, meta, hp, leaf_range)
+    return _select_across_features(scan, meta, hp, feature_mask, leaf_depth,
+                                   gain_penalty, parent_output)
+
+
+def _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
+                      parent_output, meta: FeatureMeta, hp: SplitHyperParams,
+                      leaf_range=None) -> dict:
+    """The two-direction cumulative scan; returns per-feature best arrays
+    (gain/threshold/side-sums [F]) plus the scalars the selection needs."""
     F, B, _ = hist.shape
     g = hist[:, :, 0]
     h = hist[:, :, 1]
@@ -316,6 +327,28 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
     brh = jnp.where(use_fwd, take(rh_fwd, best_t), take(rh_thr, best_t))
     brc = jnp.where(use_fwd, take(rc_fwd, best_t), take(rc_thr, best_t))
 
+    return dict(best_gain=best_gain, best_t=best_t, best_dl=best_dl,
+                blg=blg, blh=blh, blc=blc, brg=brg, brh=brh, brc=brc,
+                min_gain_shift=min_gain_shift,
+                out_range=((out_min, out_max) if use_mc else None))
+
+
+def _select_across_features(scan: dict, meta: FeatureMeta,
+                            hp: SplitHyperParams, feature_mask,
+                            leaf_depth, gain_penalty,
+                            parent_output) -> SplitRecord:
+    """Cross-feature selection over _per_feature_scan output."""
+    use_mc = meta.monotone is not None
+    if use_mc:
+        mono = meta.monotone[:, None]
+        out_min, out_max = scan["out_range"]
+    best_gain = scan["best_gain"]
+    best_t = scan["best_t"]
+    best_dl = scan["best_dl"]
+    blg, blh, blc = scan["blg"], scan["blh"], scan["blc"]
+    brg, brh, brc = scan["brg"], scan["brh"], scan["brc"]
+    min_gain_shift = scan["min_gain_shift"]
+
     if feature_mask is not None:
         best_gain = jnp.where(feature_mask, best_gain, K_MIN_SCORE)
 
@@ -364,6 +397,21 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
         right_count=sel(brc),
         right_output=rout,
     )
+
+
+def per_feature_net_gains(hist, sum_gradient, sum_hessian, num_data,
+                          parent_output, meta: FeatureMeta,
+                          hp: SplitHyperParams) -> jnp.ndarray:
+    """Best NET split gain per feature [F] (kMinScore where no valid split).
+
+    The voting-parallel learner's local vote ranks features by exactly this
+    quantity (ref: voting_parallel_tree_learner.cpp local SplitInfo gains
+    feeding GlobalVoting :152)."""
+    scan = _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
+                             parent_output, meta, hp)
+    valid = scan["best_gain"] > K_MIN_SCORE
+    return jnp.where(valid, scan["best_gain"] - scan["min_gain_shift"],
+                     K_MIN_SCORE)
 
 
 def forced_split_record(hist: jnp.ndarray, feature, threshold_bin,

@@ -9,9 +9,14 @@ from .mesh import (DATA_AXIS, FEATURE_AXIS, build_mesh, pad_rows_np,
                    padded_rows, replicated, row_sharding)
 from .data_parallel import (make_data_parallel_grower,
                             make_distributed_train_step)
+from .feature_parallel import (make_feature_parallel_grower,
+                               pad_feature_meta, padded_features)
+from .voting_parallel import make_voting_parallel_grower
 
 __all__ = [
     "DATA_AXIS", "FEATURE_AXIS", "build_mesh", "padded_rows", "pad_rows_np",
     "row_sharding", "replicated",
     "make_data_parallel_grower", "make_distributed_train_step",
+    "make_feature_parallel_grower", "pad_feature_meta", "padded_features",
+    "make_voting_parallel_grower",
 ]

@@ -58,7 +58,7 @@ def make_data_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
     """
     grow = make_tree_grower(
         cfg, meta,
-        reduce_hist=lambda h: lax.psum(h, data_axis),
+        reduce_hist=lambda h, ctx=None: lax.psum(h, data_axis),
         reduce_sums=lambda s: lax.psum(s, data_axis))
 
     sharded = _make_sharded(
@@ -77,7 +77,9 @@ def make_data_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
 def make_distributed_train_step(cfg: GrowerConfig, meta: FeatureMeta,
                                 mesh: Mesh, grad_fn: Callable,
                                 learning_rate: float,
-                                data_axis: str = DATA_AXIS):
+                                data_axis: str = DATA_AXIS,
+                                tree_learner: str = "data",
+                                top_k: int = 20):
     """One full boosting iteration as a single jittable program over the mesh
     (≡ GBDT::TrainOneIter on every machine, gbdt.cpp:353 — gradients,
     tree growth with collective histogram reduction, score update).
@@ -89,7 +91,17 @@ def make_distributed_train_step(cfg: GrowerConfig, meta: FeatureMeta,
     min_data_in_leaf (see mesh.pad_rows_np); pass all-ones when R divides
     the mesh evenly.
     """
-    grow = make_data_parallel_grower(cfg, meta, mesh, data_axis)
+    if tree_learner in ("data", "serial"):
+        grow = make_data_parallel_grower(cfg, meta, mesh, data_axis)
+    elif tree_learner == "voting":
+        from .voting_parallel import make_voting_parallel_grower
+        grow = make_voting_parallel_grower(cfg, meta, mesh, top_k=top_k,
+                                           data_axis=data_axis)
+    else:
+        raise ValueError(
+            f"tree_learner={tree_learner!r}; row-sharded step supports "
+            "'data' and 'voting' (feature-parallel shards features — use "
+            "make_feature_parallel_grower)")
 
     def step(bins_t, label, score, row_mask):
         grad, hess = grad_fn(score, label)

@@ -1,0 +1,100 @@
+"""Voting-parallel (PV-Tree) tree growing over a device mesh.
+
+TPU-native equivalent of VotingParallelTreeLearner
+(ref: src/treelearner/voting_parallel_tree_learner.cpp,
+parallel_tree_learner.h:126-207; SURVEY.md §2.3): rows are sharded like
+data-parallel, but instead of reducing FULL histograms, each device votes
+its top-k features by LOCAL split gain; the global vote selects the top-2k
+features; only THOSE features' histograms are aggregated — communication
+per split drops from O(F·B) to O(k·B) (docs/Features.rst:78+).
+
+Mapping onto the grower hooks:
+- reduce_hist = identity → the histogram pool stays LOCAL and sibling
+  subtraction happens on local sums (≡ the reference's local
+  smaller/larger arrays + FeatureHistogram::Subtract,
+  voting_parallel_tree_learner.cpp:338);
+- prepare_split_hist = vote → aggregate: local per-feature best gains
+  (per_feature_net_gains ≡ local SplitInfo gains), top-k one-hot vote,
+  psum of votes (≡ Allgather of votes + GlobalVoting :152,373), top-2k
+  selection, selective psum of the chosen histograms (≡ CopyLocalHistogram
+  + ReduceScatter :396), and a feature mask restricting the split scan to
+  aggregated features;
+- reduce_sums = psum (root tuple Allreduce, like data-parallel).
+
+The global vote is identical on every device (computed from the psum'd
+vote counts), so all devices select the same features and find the same
+split — no further sync needed.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core.grower import GrowerConfig, make_tree_grower
+from ..ops.split import FeatureMeta, per_feature_net_gains
+from .data_parallel import _make_sharded
+from .mesh import DATA_AXIS
+
+
+def make_voting_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
+                                mesh: Mesh, top_k: int = 20,
+                                data_axis: str = DATA_AXIS):
+    """Build grow(bins_t, gh, feature_mask) with rows sharded over
+    `data_axis` ([F, R] on dim 1, gh on dim 0), aggregating only the
+    globally voted 2*top_k features per leaf (top_k ≡ config.top_k,
+    config.h "top_k"/"topk").
+    """
+    F = int(meta.num_bin.shape[0])
+    k = max(1, min(top_k, F))
+    k2 = min(2 * k, F)
+    hp = cfg.hparams
+
+    def prepare(hist_local, ctx, feature_mask=None):
+        _, _, _, parent_out = ctx
+        # the LOCAL vote ranks by LOCAL gains (ref: voting learner votes
+        # with this->smaller_leaf_splits_, the local sums) — recover the
+        # local leaf totals from any feature's bin sums
+        local_sg = jnp.sum(hist_local[0, :, 0])
+        local_sh = jnp.sum(hist_local[0, :, 1])
+        local_cnt = jnp.sum(hist_local[0, :, 2])
+        gains = per_feature_net_gains(hist_local, local_sg, local_sh,
+                                      local_cnt, parent_out, meta, hp)  # [F]
+        if feature_mask is not None:
+            # col sampling applies BEFORE the vote (ref: voting learner
+            # checks is_feature_used_bytree before computing local splits)
+            gains = jnp.where(feature_mask, gains, -jnp.inf)
+        _, local_top = lax.top_k(gains, k)
+        votes = jnp.zeros(F, jnp.float32).at[local_top].add(1.0)
+        votes = lax.psum(votes, data_axis)
+        # deterministic global tie-break toward smaller feature index
+        # (GlobalVoting keeps the first-seen max like ArgMax); integer key
+        # keeps ordering exact for any F with votes bounded by mesh size
+        keyed = (votes.astype(jnp.int32) * F
+                 + (F - 1 - jnp.arange(F, dtype=jnp.int32)))
+        _, sel = lax.top_k(keyed, k2)                               # [k2]
+        hist_sel = lax.psum(hist_local[sel], data_axis)         # [k2, B, 3]
+        hist_global = jnp.zeros_like(hist_local).at[sel].set(hist_sel)
+        sel_mask = jnp.zeros(F, bool).at[sel].set(True)
+        return hist_global, sel_mask
+
+    grow = make_tree_grower(
+        cfg, meta,
+        reduce_hist=lambda h, ctx=None: h,      # pool stays LOCAL
+        reduce_sums=lambda s: lax.psum(s, data_axis),
+        prepare_split_hist=prepare)
+
+    sharded = _make_sharded(
+        grow, mesh,
+        in_specs=(P(None, data_axis), P(data_axis, None), P()),
+        out_specs=(P(), P(data_axis)))
+
+    def grow_fn(bins_t, gh, feature_mask: Optional[jnp.ndarray] = None):
+        if feature_mask is None:
+            feature_mask = jnp.ones(bins_t.shape[0], bool)
+        return sharded(bins_t, gh, feature_mask)
+
+    return grow_fn
