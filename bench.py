@@ -58,6 +58,8 @@ def main():
 
     import jax
     jax.block_until_ready(booster._engine.score)
+    from lightgbm_tpu.utils.timer import global_timer
+    global_timer.reset()  # drop warmup/compile time from the table
     t0 = time.perf_counter()
     for _ in range(TIMED_ITERS):
         booster.update()
@@ -65,6 +67,8 @@ def main():
     dt = time.perf_counter() - t0
 
     ips = TIMED_ITERS / dt
+    if global_timer.enabled:
+        print(global_timer.table(), file=sys.stderr)
     ref_ips_at_n = REF_HIGGS_IPS * (REF_HIGGS_ROWS / N_ROWS)
     print(json.dumps({
         "metric": f"higgs_synth_{N_ROWS}x{N_FEATURES}_iters_per_sec",

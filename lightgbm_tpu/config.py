@@ -353,6 +353,18 @@ def _parse_list(value: Any, elem_type: Any) -> List[Any]:
     return [elem_type(v) for v in value]
 
 
+# Parameters whose explicit non-default values currently change nothing.
+# Each entry maps name -> predicate over the resolved value that is True when
+# the setting would require an unimplemented feature. Entries are removed as
+# the features land.
+_UNIMPLEMENTED_WHEN = {
+    "linear_tree": lambda v: bool(v),
+    "enable_bundle": lambda v: bool(v),   # EFB not implemented; default True
+                                          # behaves as no-bundling
+    "tpu_donate_state": lambda v: True,
+}
+
+
 class Config:
     """Resolved parameter set with attribute access.
 
@@ -443,6 +455,17 @@ class Config:
                 v = ",".join(str(x) for x in v)
             lines.append(f"[{name}: {v}]")
         return "\n".join(lines)
+
+    def warn_unimplemented(self) -> None:
+        """Warn on explicitly-set parameters that map to features this
+        framework does not implement yet, instead of silently ignoring them
+        (the reference either implements or warns for every registered
+        parameter; ref: config.cpp CheckParamConflict)."""
+        for name, bad in _UNIMPLEMENTED_WHEN.items():
+            if not self.is_default(name) and bad(self._values[name]):
+                log.warning(
+                    f"{name}={self._values[name]} is not implemented in "
+                    "lightgbm_tpu yet; the parameter has no effect")
 
     # -- internals -------------------------------------------------------
     def _post_process(self) -> None:

@@ -31,6 +31,7 @@ from ..io.dataset_core import BinnedDataset
 from ..ops.split import FeatureMeta, SplitHyperParams
 from ..ops.predict import tree_leaf_bins
 from ..utils import log
+from ..utils.timer import global_timer
 from .sample_strategy import SampleStrategy
 
 
@@ -142,6 +143,7 @@ class GBDT:
     # ------------------------------------------------------------------
     def _setup_train(self, train: BinnedDataset) -> None:
         cfg = self.config
+        cfg.warn_unimplemented()
         self.num_data = train.num_data
         self.max_feature_idx = train.num_total_features - 1
         self.feature_names = list(train.feature_names)
@@ -479,7 +481,9 @@ class GBDT:
         if gradients is None or hessians is None:
             for k in range(K):
                 init_scores[k] = self._boost_from_average(k)
-            grad, hess = self._gh_fn(self.score)
+            with global_timer.section("GBDT::Boosting",
+                                      sync=lambda: grad):
+                grad, hess = self._gh_fn(self.score)
             if K == 1:
                 grad = grad[None, :]
                 hess = hess[None, :]
@@ -513,10 +517,13 @@ class GBDT:
                 ones = jnp.ones_like(g)
                 gh = jnp.stack([g, h, ones], axis=1)
             fmask = self._feature_mask()
-            tree_dev, leaf_id = self._grow(self.bins_dev, gh, fmask,
-                                           self._cegb_penalty())
-            host = HostTree(jax.tree.map(np.asarray, tree_dev),
-                            self.train_set.used_feature_map)
+            with global_timer.section("TreeLearner::Train",
+                                      sync=lambda: tree_dev.leaf_value):
+                tree_dev, leaf_id = self._grow(self.bins_dev, gh, fmask,
+                                               self._cegb_penalty())
+            with global_timer.section("Tree::ToHost"):
+                host = HostTree(jax.tree.map(np.asarray, tree_dev),
+                                self.train_set.used_feature_map)
 
             if host.num_leaves <= 1:
                 # no valid split for this class this iteration
@@ -561,13 +568,18 @@ class GBDT:
 
             # -- shrinkage + score updates ------------------------------
             host.shrink(self.shrinkage_rate)
-            lv = np.zeros(self.config.num_leaves, np.float32)
-            lv[:host.num_leaves] = host.leaf_value[:host.num_leaves]
-            lv_dev = jnp.asarray(lv)
-            self.score = self.score.at[k].add(lv_dev[leaf_id])
-            for vd in self.valid_sets:
-                vd.score = vd.score.at[k].add(
-                    self._tree_outputs(host, vd.bins_dev))
+            with global_timer.section("GBDT::UpdateScore",
+                                      sync=lambda: self.score):
+                lv = np.zeros(self.config.num_leaves, np.float32)
+                lv[:host.num_leaves] = host.leaf_value[:host.num_leaves]
+                lv_dev = jnp.asarray(lv)
+                self.score = self.score.at[k].add(lv_dev[leaf_id])
+            with global_timer.section(
+                    "GBDT::UpdateValidScore",
+                    sync=lambda: [vd.score for vd in self.valid_sets]):
+                for vd in self.valid_sets:
+                    vd.score = vd.score.at[k].add(
+                        self._tree_outputs(host, vd.bins_dev))
             if abs(init_scores[k]) > K_EPSILON:
                 host.add_bias(init_scores[k])
             self.models.append(host)
