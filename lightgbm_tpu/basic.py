@@ -74,6 +74,15 @@ class Dataset:
         self.version = 0
 
     # -- construction ---------------------------------------------------
+    def _update_params(self, params) -> "Dataset":
+        """Fill dataset params from booster params before construction
+        (ref: Dataset._update_params, python-package basic.py — dataset
+        keys keep precedence; no-op once constructed)."""
+        if self._binned is None and params:
+            for k, v in params.items():
+                self.params.setdefault(k, v)
+        return self
+
     def construct(self) -> "Dataset":
         if self._binned is not None:
             return self
@@ -303,6 +312,7 @@ class Booster:
         merged = dict(train_set.params)
         merged.update(self.params)
         self.config = Config(merged)
+        train_set._update_params(self.params)
         binned = train_set.construct().binned
         obj_name = self.config.objective
         objective = create_objective(obj_name, self.config)
@@ -314,6 +324,7 @@ class Booster:
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         if self._engine is None or self.train_set is None:
             raise LightGBMError("Booster has no training data")
+        data._update_params(self.params)
         data.construct()
         self.valid_sets.append(data)
         self.name_valid_sets.append(name)
@@ -527,7 +538,7 @@ class Booster:
         score = np.zeros((K, binned.num_data), np.float64)
         for i, t in enumerate(eng.models):
             k = i % K
-            score[k] += np.asarray(eng._tree_outputs(t, bins_dev))
+            score[k] += np.asarray(eng._tree_outputs(t, bins_dev, binned.raw))
         return score
 
     # -- model IO -------------------------------------------------------

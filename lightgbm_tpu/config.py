@@ -218,7 +218,14 @@ _reg("gpu_use_dp", bool, False, ())
 _reg("num_gpu", int, 1, (), (0, None, False, False))
 # TPU mesh shape for distributed training: rows are sharded over 'data' axis.
 _reg("tpu_num_devices", int, 0, ())          # 0 = use all visible devices
-_reg("tpu_hist_dtype", str, "float32", ())   # histogram accumulator dtype
+_reg("tpu_hist_dtype", str, "float32", ())   # histogram input dtype:
+                                             # float32 | bfloat16
+_reg("tpu_hist_kernel", str, "auto", ())     # auto | einsum | scatter
+                                             # (auto: einsum on TPU,
+                                             #  scatter-add on CPU)
+_reg("tpu_row_scheduling", str, "compact", ())  # compact | full
+_reg("tpu_partition_mode", str, "scatter", ())  # scatter | sort
+_reg("tpu_min_bucket", int, 2048, ())        # smallest pow2 segment bucket
 _reg("tpu_use_pallas", bool, False, ())      # Pallas histogram kernel (off until tuned)
 _reg("tpu_rows_per_block", int, 1024, ())    # row tile for histogram kernels
 _reg("tpu_donate_state", bool, True, ())     # donate training state buffers
@@ -358,7 +365,6 @@ def _parse_list(value: Any, elem_type: Any) -> List[Any]:
 # the setting would require an unimplemented feature. Entries are removed as
 # the features land.
 _UNIMPLEMENTED_WHEN = {
-    "linear_tree": lambda v: bool(v),
     "enable_bundle": lambda v: bool(v),   # EFB not implemented; default True
                                           # behaves as no-bundling
     "tpu_donate_state": lambda v: True,

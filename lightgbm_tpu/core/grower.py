@@ -30,10 +30,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..ops.histogram import make_hist_fn
+from ..ops.histogram import make_hist_fn, hist_rowmajor
 from ..ops.split import (FeatureMeta, SplitHyperParams, SplitRecord,
                          K_EPSILON, K_MIN_SCORE, best_split_for_leaf,
-                         calculate_splitted_leaf_output, forced_split_record)
+                         calculate_splitted_leaf_output, forced_split_record,
+                         meta_has_categorical)
 from .tree import TreeArrays
 
 
@@ -46,6 +47,30 @@ class GrowerConfig:
     hparams: SplitHyperParams = SplitHyperParams()
     hist_backend: str = "xla"   # xla | scatter | pallas
     block_rows: int = 4096
+    # row scheduling: "full" = masked full-row histogram passes (bins given
+    # feature-major [F, R]); "compact" = per-leaf contiguous row ordering
+    # with gathered O(rows_in_leaf) passes (bins given ROW-major [R, F]) —
+    # the TPU expression of DataPartition + smaller-child scheduling
+    # (ref: serial_tree_learner.cpp:368-386, data_partition.hpp:22)
+    row_sched: str = "full"
+    # compact-mode histogram input dtype: float32 | bfloat16
+    hist_dtype: str = "float32"
+    # compact-mode histogram kernel: einsum (TPU) | scatter (CPU)
+    hist_rm_backend: str = "einsum"
+    # compact-mode segment partition primitive: scatter | sort
+    partition_mode: str = "scatter"
+    # smallest pow2 segment bucket (smaller leaves pad up to this)
+    min_bucket: int = 2048
+    # quantized-gradient training (ref: gradient_discretizer.{hpp,cpp},
+    # config use_quantized_grad): int8 grad/hess with stochastic rounding,
+    # EXACT int32 histogram accumulation on the MXU — deterministic sums
+    # regardless of reduction order (the "bit-identical splits" path) and
+    # 2x the bf16 matmul rate. Per-leaf 8/16-bit histogram narrowing is a
+    # CPU cache optimization with no TPU analogue (int32 is the MXU
+    # accumulator width) and is deliberately not carried over.
+    quantized: bool = False
+    quant_bins: int = 4          # ref: num_grad_quant_bins
+    stochastic_rounding: bool = True
     # feature_mask is [L, F] with one row per node (feature_fraction_bynode,
     # ref: col_sampler.hpp) instead of a single [F] row for the whole tree
     bynode_mask: bool = False
@@ -78,11 +103,53 @@ class GrowState(NamedTuple):
     path_mask: jnp.ndarray = None
     # forced-split sequence still on track (ForceSplits abort semantics)
     forced_ok: jnp.ndarray = None  # bool scalar
+    # compact row scheduling (row_sched="compact"): rows grouped by leaf
+    # (≡ DataPartition::indices_, data_partition.hpp:22)
+    order: jnp.ndarray = None       # i32 [R] row ids, leaf-contiguous
+    leaf_start: jnp.ndarray = None  # i32 [L] segment start per leaf
+    leaf_rows: jnp.ndarray = None   # i32 [L] RAW rows per leaf (incl.
+                                    # bagged-out rows riding along)
 
 
 def _set(arr, idx, val, cond):
     """arr[idx] = val if cond (guarded functional update)."""
     return arr.at[idx].set(jnp.where(cond, val, arr[idx]))
+
+
+def _bucket_sizes(num_rows: int, min_bucket: int) -> list:
+    """Descending static segment sizes: [R, pow2 < R, ..., min_bucket].
+
+    Dynamic leaf sizes are padded up to the next bucket so every gather /
+    partition in the split loop has a static shape; the pow2 ladder bounds
+    padding waste at 2x (the XLA answer to LightGBM's exact-size
+    DataPartition segments)."""
+    sizes = [num_rows]
+    p = 1
+    while p * 2 < num_rows:
+        p *= 2
+    while p >= max(min_bucket, 1) and p < num_rows:
+        sizes.append(p)
+        p //= 2
+    return sizes
+
+
+def _go_left_bins(col, thr, dl, f, pmeta: FeatureMeta, num_cat=None,
+                  cat_bins=None):
+    """Partition direction for a bin column (ref: dense_bin.hpp:317
+    SplitInner missing-type dispatch; categorical bitset membership per
+    dense_bin.hpp SplitCategoricalInner — bins not in the chosen set,
+    including bin 0 (NaN/unseen), go right)."""
+    nbin_f = pmeta.num_bin[f]
+    miss_f = pmeta.missing_type[f]
+    dflt_f = pmeta.default_bin[f]
+    go_left = col <= thr
+    is_nan_bin = (miss_f == 2) & (col == nbin_f - 1)
+    is_dflt_bin = (miss_f == 1) & (col == dflt_f)
+    go_left = jnp.where(is_nan_bin | is_dflt_bin, dl, go_left)
+    if num_cat is not None:
+        in_set = jnp.any(col[:, None] == cat_bins[None, :], axis=1)
+        go_left = jnp.where(num_cat > 0, in_set, go_left)
+    return go_left
 
 
 def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
@@ -134,10 +201,26 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     L = cfg.num_leaves
     B = cfg.num_bin
     hist_fn = make_hist_fn(cfg.hist_backend, B, cfg.block_rows)
+    compact = cfg.row_sched == "compact"
+    if compact:
+        hist_rm = functools.partial(hist_rowmajor, num_bin=B,
+                                    block_rows=cfg.block_rows,
+                                    dtype=cfg.hist_dtype,
+                                    backend=cfg.hist_rm_backend)
     # Distributed mode: the per-split histogram pass contains a collective
     # (psum over the mesh's data axis), which must not sit inside a lax.cond
     # branch — replaced by masking so every device executes it symmetrically.
     distributed = reduce_hist is not None
+    if compact and distributed:
+        raise ValueError("row_sched='compact' does not compose with "
+                         "distributed learner hooks yet; use 'full'")
+    quantized = cfg.quantized
+    if quantized and distributed:
+        raise ValueError("use_quantized_grad does not compose with "
+                         "distributed learner hooks yet")
+    hist_dtype = jnp.int32 if quantized else jnp.float32
+    has_cat = meta_has_categorical(meta)
+    MAXK = min(hp.max_cat_threshold, B) if has_cat else 0
     if reduce_hist is None:
         reduce_hist = lambda h, ctx=None: h
     if reduce_sums is None:
@@ -178,9 +261,111 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
 
     def grow(bins_t: jnp.ndarray, gh: jnp.ndarray,
              feature_mask: Optional[jnp.ndarray] = None,
-             cegb: Optional[tuple] = None
+             cegb: Optional[tuple] = None,
+             rng_key: Optional[jnp.ndarray] = None
              ) -> Tuple[TreeArrays, jnp.ndarray]:
-        F, R = bins_t.shape
+        # full mode takes feature-major [F, R] bins; compact mode takes
+        # ROW-major [R, F] (the gather-friendly layout)
+        if compact:
+            R, F = bins_t.shape
+        else:
+            F, R = bins_t.shape
+
+        if quantized:
+            # ref: GradientDiscretizer::DiscretizeGradients
+            # (gradient_discretizer.cpp:71-162): scale |g| to
+            # [-quant_bins/2, quant_bins/2] and h to [0, quant_bins] with
+            # stochastic rounding toward/away from zero; the mask channel
+            # is exact 0/1. All histogram sums then accumulate EXACTLY in
+            # int32 and are converted back via the scales at scan time.
+            g, h, m = gh[:, 0], gh[:, 1], gh[:, 2]
+            kq = max(cfg.quant_bins // 2, 1)
+            g_scale = jnp.maximum(jnp.max(jnp.abs(g)), 1e-30) / kq
+            h_scale = jnp.maximum(jnp.max(h), 1e-30) / cfg.quant_bins
+            if cfg.stochastic_rounding:
+                kg, kh = jax.random.split(
+                    rng_key if rng_key is not None else jax.random.PRNGKey(0))
+                ug = jax.random.uniform(kg, g.shape, jnp.float32)
+                uh = jax.random.uniform(kh, h.shape, jnp.float32)
+            else:
+                ug = uh = jnp.float32(0.5)
+            gq = jnp.trunc(g / g_scale + jnp.where(g >= 0, ug, -ug))
+            hq = jnp.trunc(h / h_scale + uh)
+            gh = jnp.stack([gq, hq, m], axis=1).astype(jnp.int8)
+            scale3 = jnp.stack([g_scale, h_scale, jnp.float32(1.0)])
+            conv = lambda hh: hh.astype(jnp.float32) * scale3
+        else:
+            conv = lambda hh: hh
+
+        if compact:
+            sizes = _bucket_sizes(R, cfg.min_bucket)
+            sizes_arr = jnp.asarray(sizes, jnp.int32)
+            flat_ok = R * F < 2 ** 31
+            bins_flat = bins_t.reshape(-1) if flat_ok else None
+
+            def bucket_branch(n):
+                """Index of the smallest bucket >= n (descending sizes)."""
+                return (jnp.sum(sizes_arr >= n) - 1).astype(jnp.int32)
+
+            def make_part(P):
+                def part(order, start, rows, f, thr, dl, ncat, cbins):
+                    """Stable two-way partition of the leaf's segment
+                    (≡ DataPartition::Split, data_partition.hpp:102)."""
+                    f = jnp.maximum(f, 0)
+                    start_c = jnp.clip(start, 0, max(R - P, 0))
+                    delta = start - start_c
+                    seg = lax.dynamic_slice(order, (start_c,), (P,))
+                    if flat_ok:
+                        col = bins_flat[seg * F + f].astype(jnp.int32)
+                    else:
+                        col = jnp.take(jnp.take(bins_t, seg, axis=0), f,
+                                       axis=1).astype(jnp.int32)
+                    go_left = _go_left_bins(
+                        col, thr, dl, f, pmeta,
+                        ncat if has_cat else None,
+                        cbins if has_cat else None)
+                    pos = jnp.arange(P, dtype=jnp.int32)
+                    valid = (pos >= delta) & (pos < delta + rows)
+                    lm = valid & go_left
+                    rmk = valid & ~go_left
+                    nL = jnp.sum(lm.astype(jnp.int32))
+                    if cfg.partition_mode == "sort":
+                        key = jnp.where(
+                            lm, 1, jnp.where(rmk, 2,
+                                             jnp.where(pos < delta, 0, 3))
+                        ).astype(jnp.int32)
+                        _, new_seg = lax.sort((key, seg), num_keys=1,
+                                              is_stable=True)
+                    else:
+                        dst_l = delta + jnp.cumsum(lm.astype(jnp.int32)) - 1
+                        dst_r = (delta + nL +
+                                 jnp.cumsum(rmk.astype(jnp.int32)) - 1)
+                        dest = jnp.where(lm, dst_l,
+                                         jnp.where(rmk, dst_r, pos))
+                        new_seg = jnp.zeros_like(seg).at[dest].set(
+                            seg, unique_indices=True)
+                    order = lax.dynamic_update_slice(order, new_seg,
+                                                     (start_c,))
+                    return order, nL
+                return part
+
+            def make_histb(S):
+                def hb(order, start, rows, ghv):
+                    """O(rows_in_leaf) histogram over the gathered segment
+                    (≡ indexed Bin::ConstructHistogram, dense_bin.hpp)."""
+                    start_c = jnp.clip(start, 0, max(R - S, 0))
+                    delta = start - start_c
+                    idx = lax.dynamic_slice(order, (start_c,), (S,))
+                    blk = jnp.take(bins_t, idx, axis=0)
+                    ghg = jnp.take(ghv, idx, axis=0)
+                    pos = jnp.arange(S, dtype=jnp.int32)
+                    w = ((pos >= delta) &
+                         (pos < delta + rows)).astype(ghg.dtype)
+                    return hist_rm(blk, ghg * w[:, None])
+                return hb
+
+            part_branches = [make_part(P) for P in sizes]
+            hist_branches = [make_histb(S) for S in sizes]
 
         if use_ic:
             # bool [G, F]: membership of each interaction group
@@ -209,23 +394,30 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             return fm
 
         # ---- root (ref: LeafSplits::Init + first FindBestSplits) ----
-        sums = reduce_sums(gh.sum(axis=0))            # [3]
+        if quantized:
+            sums = conv(reduce_sums(gh.sum(axis=0, dtype=jnp.int32)))
+        else:
+            sums = reduce_sums(gh.sum(axis=0))        # [3]
         root_g, root_h, root_c = sums[0], sums[1], sums[2]
         root_out = calculate_splitted_leaf_output(
             root_g, root_h + 2 * K_EPSILON, hp, root_c, jnp.float32(0.0))
         leaf_id0 = jnp.zeros(R, jnp.int32)
-        hist_root = reduce_hist(hist_fn(bins_t, gh),
-                                (root_g, root_h, root_c, root_out))
+        if compact:
+            hist_root = hist_rm(bins_t, gh)
+        else:
+            hist_root = reduce_hist(hist_fn(bins_t, gh),
+                                    (root_g, root_h, root_c, root_out))
         inf = jnp.float32(jnp.inf)
         root_path = jnp.zeros(F, bool)
-        best_root = best_of(hist_root, root_g, root_h, root_c, root_out,
-                            node_mask(0, root_path), leaf_range=(-inf, inf),
+        best_root = best_of(conv(hist_root), root_g, root_h, root_c,
+                            root_out, node_mask(0, root_path),
+                            leaf_range=(-inf, inf),
                             leaf_depth=jnp.int32(0), cegb=cegb)
 
-        hist_pool = jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(hist_root)
+        hist_pool = jnp.zeros((L, F, B, 3), hist_dtype).at[0].set(hist_root)
         zf = jnp.zeros(L, jnp.float32)
         zi = jnp.zeros(L, jnp.int32)
-        best0 = SplitRecord.invalid((L,))
+        best0 = SplitRecord.invalid((L,), max_cat=MAXK)
         best0 = jax.tree.map(lambda a, b: a.at[0].set(b), best0, best_root)
 
         state = GrowState(
@@ -239,13 +431,17 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             parent_node=jnp.full(L, -1, jnp.int32),
             is_right=jnp.zeros(L, bool),
             best=best0,
-            tree=TreeArrays.empty(L),
+            tree=TreeArrays.empty(L, max_cat=MAXK),
             num_leaves=jnp.asarray(1, jnp.int32),
             done=jnp.asarray(False),
             leaf_min=jnp.full(L, -jnp.inf, jnp.float32),
             leaf_max=jnp.full(L, jnp.inf, jnp.float32),
             path_mask=jnp.zeros((L, F), bool) if use_ic else None,
             forced_ok=jnp.asarray(True),
+            order=jnp.arange(R, dtype=jnp.int32) if compact else None,
+            leaf_start=jnp.zeros(L, jnp.int32) if compact else None,
+            leaf_rows=(jnp.zeros(L, jnp.int32).at[0].set(R)
+                       if compact else None),
         )
 
         def body(i, state: GrowState) -> GrowState:
@@ -267,9 +463,13 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 want_forced = forced_active[i] & state.forced_ok
                 slot_i = forced_slot[i]
                 frec = forced_split_record(
-                    state.hist[slot_i], forced_feat[i], forced_thr[i],
+                    conv(state.hist[slot_i]), forced_feat[i], forced_thr[i],
                     state.sum_g[slot_i], state.sum_h[slot_i],
                     state.count[slot_i], state.value[slot_i], meta, hp)
+                if has_cat:  # forced splits are numerical-only
+                    frec = frec._replace(
+                        num_cat=jnp.int32(0),
+                        cat_bins=jnp.full((MAXK,), -1, jnp.int32))
                 f_valid = frec.gain > 0.0
                 if cfg.max_depth > 0:  # forced prefix honors max_depth too
                     f_valid &= state.depth[slot_i] < cfg.max_depth
@@ -301,6 +501,11 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 left_child=_set(t.left_child, i, -(l + 1), proceed),
                 right_child=_set(t.right_child, i, -(new_leaf + 1), proceed),
             )
+            if has_cat:
+                t = t._replace(
+                    cat_count=_set(t.cat_count, i, rec.num_cat, proceed),
+                    cat_bins=t.cat_bins.at[i].set(
+                        jnp.where(proceed, rec.cat_bins, t.cat_bins[i])))
             # fix-up the parent's child pointer that pointed at leaf l
             p = state.parent_node[l]
             p_safe = jnp.maximum(p, 0)
@@ -323,19 +528,20 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             )
 
             # ---- partition rows (ref: dense_bin.hpp:317 SplitInner) --------
-            f = rec.feature
-            bin_col = fetch_bin_column(bins_t, f)
-            nbin_f = pmeta.num_bin[f]
-            miss_f = pmeta.missing_type[f]
-            dflt_f = pmeta.default_bin[f]
-            go_left = bin_col <= rec.threshold
-            is_nan_bin = (miss_f == 2) & (bin_col == nbin_f - 1)
-            is_dflt_bin = (miss_f == 1) & (bin_col == dflt_f)
-            go_left = jnp.where(is_nan_bin | is_dflt_bin, rec.default_left,
-                                go_left)
-            in_leaf = state.leaf_id == l
-            leaf_id = jnp.where(proceed & in_leaf & ~go_left,
-                                new_leaf, state.leaf_id)
+            if compact:
+                # segment partition + smaller-child gather happen together
+                # below (both need the updated order); leaf_id is rebuilt
+                # from the final segments after the loop
+                leaf_id = state.leaf_id
+            else:
+                bin_col = fetch_bin_column(bins_t, rec.feature)
+                go_left = _go_left_bins(
+                    bin_col, rec.threshold, rec.default_left, rec.feature,
+                    pmeta, rec.num_cat if has_cat else None,
+                    rec.cat_bins if has_cat else None)
+                in_leaf = state.leaf_id == l
+                leaf_id = jnp.where(proceed & in_leaf & ~go_left,
+                                    new_leaf, state.leaf_id)
 
             # ---- children stats --------------------------------------------
             sum_g = _set(_set(state.sum_g, l, rec.left_sum_gradient, proceed),
@@ -356,24 +562,62 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
 
             # ---- children histograms: smaller pass + subtraction -----------
             # (ref: serial_tree_learner.cpp:368-386 + FeatureHistogram::Subtract)
-            left_smaller = rec.left_count <= rec.right_count
-            small_leaf = jnp.where(left_smaller, l, new_leaf)
-            pick = lambda a, b: jnp.where(left_smaller, a, b)
-            small_ctx = (pick(rec.left_sum_gradient, rec.right_sum_gradient),
-                         pick(rec.left_sum_hessian, rec.right_sum_hessian),
-                         pick(rec.left_count, rec.right_count),
-                         pick(rec.left_output, rec.right_output))
-            if distributed:
-                # mask instead of branch: dead steps contribute psum(0)
-                gh_live = gh * proceed.astype(gh.dtype)
-                hist_small = leaf_hist(bins_t, gh_live, leaf_id, small_leaf,
-                                       small_ctx)
+            if compact:
+                # partition the leaf's segment, then one O(rows_in_smaller)
+                # gathered pass; the switch picks the static pow2 bucket
+                start_l = state.leaf_start[l]
+                rows_l = state.leaf_rows[l]
+
+                def do_part_hist():
+                    pb = bucket_branch(rows_l)
+                    ncat_a = rec.num_cat if has_cat else jnp.int32(0)
+                    cbins_a = rec.cat_bins if has_cat else \
+                        jnp.full((1,), -1, jnp.int32)
+                    order2, nL = lax.switch(
+                        pb, part_branches, state.order, start_l, rows_l,
+                        rec.feature, rec.threshold, rec.default_left,
+                        ncat_a, cbins_a)
+                    nR = rows_l - nL
+                    lsm = nL <= nR       # smaller child by RAW rows
+                    s_start = start_l + jnp.where(lsm, 0, nL)
+                    s_rows = jnp.minimum(nL, nR)
+                    sb = bucket_branch(s_rows)
+                    h = lax.switch(sb, hist_branches, order2, s_start,
+                                   s_rows, gh)
+                    return order2, nL, lsm, h
+
+                order, nL_raw, left_smaller, hist_small = lax.cond(
+                    proceed, do_part_hist,
+                    lambda: (state.order, jnp.int32(0), jnp.asarray(True),
+                             jnp.zeros((F, B, 3), hist_dtype)))
+                leaf_start = _set(state.leaf_start, new_leaf,
+                                  start_l + nL_raw, proceed)
+                leaf_rows = _set(_set(state.leaf_rows, l, nL_raw, proceed),
+                                 new_leaf, rows_l - nL_raw, proceed)
             else:
-                hist_small = lax.cond(
-                    proceed,
-                    lambda: leaf_hist(bins_t, gh, leaf_id, small_leaf,
-                                      small_ctx),
-                    lambda: jnp.zeros((F, B, 3), jnp.float32))
+                order = state.order
+                leaf_start = state.leaf_start
+                leaf_rows = state.leaf_rows
+                left_smaller = rec.left_count <= rec.right_count
+                small_leaf = jnp.where(left_smaller, l, new_leaf)
+                pick = lambda a, b: jnp.where(left_smaller, a, b)
+                small_ctx = (pick(rec.left_sum_gradient,
+                                  rec.right_sum_gradient),
+                             pick(rec.left_sum_hessian,
+                                  rec.right_sum_hessian),
+                             pick(rec.left_count, rec.right_count),
+                             pick(rec.left_output, rec.right_output))
+                if distributed:
+                    # mask instead of branch: dead steps contribute psum(0)
+                    gh_live = gh * proceed.astype(gh.dtype)
+                    hist_small = leaf_hist(bins_t, gh_live, leaf_id,
+                                           small_leaf, small_ctx)
+                else:
+                    hist_small = lax.cond(
+                        proceed,
+                        lambda: leaf_hist(bins_t, gh, leaf_id, small_leaf,
+                                          small_ctx),
+                        lambda: jnp.zeros((F, B, 3), hist_dtype))
             hist_parent = state.hist[l]
             hist_large = hist_parent - hist_small
             hist_left = jnp.where(left_smaller, hist_small, hist_large)
@@ -423,7 +667,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             # 2i+2 — siblings decorrelated, like ColSampler bynode)
             fm_l = node_mask(2 * i + 1, child_path)
             fm_r = node_mask(2 * i + 2, child_path)
-            hists2 = jnp.stack([hist_left, hist_right])
+            hists2 = conv(jnp.stack([hist_left, hist_right]))
             sg2 = jnp.stack([rec.left_sum_gradient, rec.right_sum_gradient])
             sh2 = jnp.stack([rec.left_sum_hessian, rec.right_sum_hessian])
             cn2 = jnp.stack([rec.left_count, rec.right_count])
@@ -455,9 +699,24 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 parent_node=parent_node, is_right=is_right, best=best,
                 tree=t, num_leaves=t.num_leaves, done=done | state.done,
                 leaf_min=leaf_min, leaf_max=leaf_max, path_mask=path_mask,
-                forced_ok=forced_ok)
+                forced_ok=forced_ok, order=order, leaf_start=leaf_start,
+                leaf_rows=leaf_rows)
 
         state = lax.fori_loop(0, L - 1, body, state)
+        if compact:
+            # rebuild per-row leaf ids from the final segments: mark each
+            # segment start with its leaf, forward-fill along positions,
+            # undo the ordering permutation
+            lar = jnp.arange(L, dtype=jnp.int32)
+            starts = jnp.where((lar < state.num_leaves) &
+                               (state.leaf_rows > 0), state.leaf_start, R)
+            marks = jnp.full(R, -1, jnp.int32).at[starts].set(
+                lar, mode="drop")
+            pos2leaf = lax.associative_scan(
+                lambda a, b: jnp.where(b >= 0, b, a), marks)
+            leaf_id = jnp.zeros(R, jnp.int32).at[state.order].set(
+                pos2leaf, unique_indices=True)
+            return state.tree, leaf_id
         return state.tree, state.leaf_id
 
     return grow

@@ -110,10 +110,10 @@ def _decision_path(t: HostTree, node: int, x: np.ndarray) -> bool:
     isnan = np.isnan(val)
     dl = bool(dt & 2)
     mtype = (dt >> 2) & 3
-    if dt & 1:  # categorical: interim ordered-bin decision
-        mapping = t.cat_value_to_bin.get(f, {})
-        b = mapping.get(-1 if isnan else int(0.0 if isnan else val), 0)
-        return b <= t.threshold_real[node]
+    if dt & 1:  # categorical: bitset membership on the raw value
+        return bool(t._cat_in_bitset(
+            np.asarray([node]), np.asarray([0.0 if isnan else val]),
+            np.asarray([isnan]))[0])
     if mtype == 2 and isnan:
         return dl
     v0 = 0.0 if isnan else val

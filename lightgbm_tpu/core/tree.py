@@ -39,9 +39,14 @@ class TreeArrays(NamedTuple):
     leaf_parent: jnp.ndarray      # i32 [L]
     num_leaves: jnp.ndarray       # i32 scalar
     shrinkage: jnp.ndarray        # f32 scalar
+    # categorical splits (None when the dataset has no categorical
+    # features; ref: tree.h cat_boundaries_inner_/cat_threshold_inner_ —
+    # stored here as a fixed-width padded set of category BINS per node)
+    cat_count: jnp.ndarray = None  # i32 [L-1]; 0 = numerical node
+    cat_bins: jnp.ndarray = None   # i32 [L-1, max_cat_threshold], -1 pad
 
     @staticmethod
-    def empty(max_leaves: int) -> "TreeArrays":
+    def empty(max_leaves: int, max_cat: int = 0) -> "TreeArrays":
         li = max_leaves - 1
         return TreeArrays(
             split_feature=jnp.zeros(li, jnp.int32),
@@ -59,6 +64,9 @@ class TreeArrays(NamedTuple):
             leaf_parent=jnp.full(max_leaves, -1, jnp.int32),
             num_leaves=jnp.asarray(1, jnp.int32),
             shrinkage=jnp.asarray(1.0, jnp.float32),
+            cat_count=jnp.zeros(li, jnp.int32) if max_cat else None,
+            cat_bins=(jnp.full((li, max_cat), -1, jnp.int32)
+                      if max_cat else None),
         )
 
     @property
@@ -73,7 +81,8 @@ class HostTree:
     """
 
     def __init__(self, arrays: TreeArrays, used_feature_map: np.ndarray):
-        a = {f: np.asarray(getattr(arrays, f)) for f in arrays._fields}
+        a = {f: np.asarray(getattr(arrays, f))
+             for f in arrays._fields if getattr(arrays, f) is not None}
         self.num_leaves = int(a["num_leaves"])
         n_int = max(self.num_leaves - 1, 0)
         self.split_feature_inner = a["split_feature"][:n_int].astype(np.int32)
@@ -94,14 +103,32 @@ class HostTree:
         self.leaf_count = a["leaf_count"][:L].astype(np.int64)
         self.leaf_parent = a["leaf_parent"][:L]
         self.shrinkage = float(a["shrinkage"])
+        # per-node category-BIN sets from the grower (inner representation,
+        # ref: cat_threshold_inner_); -1 padded, empty for numerical nodes
+        if "cat_bins" in a and n_int:
+            self.cat_bins_inner = a["cat_bins"][:n_int].astype(np.int32)
+            self.cat_count_inner = a["cat_count"][:n_int].astype(np.int32)
+        else:
+            self.cat_bins_inner = np.zeros((n_int, 0), np.int32)
+            self.cat_count_inner = np.zeros(n_int, np.int32)
         # filled by model IO
         self.threshold_real: np.ndarray = np.zeros(n_int, np.float64)
         self.decision_type: np.ndarray = np.zeros(n_int, np.int32)
         self.is_linear = False
         self.num_cat = 0
-        # original-feature-index -> {category value: bin} for categorical
-        # splits (interim ordered-bin representation; see gbdt._finalize_tree)
-        self.cat_value_to_bin: dict = {}
+        # bitset storage of RAW category values per cat node
+        # (ref: tree.h cat_boundaries_/cat_threshold_)
+        self.cat_boundaries: np.ndarray = np.zeros(1, np.int64)
+        self.cat_threshold: np.ndarray = np.zeros(0, np.uint32)
+        self._init_linear_fields()
+
+    def _init_linear_fields(self) -> None:
+        """Per-leaf linear models (ref: tree.h leaf_const_/leaf_coeff_/
+        leaf_features_), populated when is_linear."""
+        L = self.num_leaves
+        self.leaf_const = np.zeros(L, np.float64)
+        self.leaf_coeff: list = [np.zeros(0, np.float64)] * L
+        self.leaf_features: list = [[] for _ in range(L)]  # ORIGINAL idx
 
     @classmethod
     def constant(cls, value: float) -> "HostTree":
@@ -123,14 +150,21 @@ class HostTree:
         self.decision_type = np.zeros(0, np.int32)
         self.is_linear = False
         self.num_cat = 0
-        self.cat_value_to_bin = {}
+        self.cat_bins_inner = np.zeros((0, 0), np.int32)
+        self.cat_count_inner = np.zeros(0, np.int32)
+        self.cat_boundaries = np.zeros(1, np.int64)
+        self.cat_threshold = np.zeros(0, np.uint32)
+        self._init_linear_fields()
         return self
 
     def shrink(self, rate: float) -> None:
-        """ref: tree.h Tree::Shrinkage."""
+        """ref: tree.h Tree::Shrinkage (scales linear consts/coeffs too)."""
         self.leaf_value = self.leaf_value * rate
         self.internal_value = self.internal_value * rate
         self.shrinkage *= rate
+        if self.is_linear:
+            self.leaf_const = self.leaf_const * rate
+            self.leaf_coeff = [c * rate for c in self.leaf_coeff]
 
     def copy(self) -> "HostTree":
         """Deep copy (continued training keeps the source model intact)."""
@@ -145,6 +179,26 @@ class HostTree:
         score into the first tree so the saved model is self-contained."""
         self.leaf_value = self.leaf_value + val
         self.internal_value = self.internal_value + val
+        if self.is_linear:
+            self.leaf_const = self.leaf_const + val
+
+    def linear_output(self, X: np.ndarray, leaf: np.ndarray) -> np.ndarray:
+        """Per-row output of a LINEAR tree given raw features and leaf
+        routing (ref: tree.cpp PredictionFunLinear — NaN in any leaf
+        feature falls back to the leaf constant)."""
+        out = self.leaf_const[leaf]
+        for l in range(self.num_leaves):
+            feats = self.leaf_features[l]
+            if not feats:
+                continue
+            rows = leaf == l
+            if not rows.any():
+                continue
+            Xl = X[rows][:, feats].astype(np.float64)
+            lin = Xl @ self.leaf_coeff[l]
+            nan_rows = np.isnan(Xl).any(axis=1)
+            out[rows] += np.where(nan_rows, 0.0, lin)
+        return out
 
     def add_output(self, delta: np.ndarray) -> None:
         self.leaf_value = self.leaf_value + delta
@@ -160,9 +214,6 @@ class HostTree:
         active = np.ones(n, dtype=bool)
         # decision_type bits (ref: tree.h kCategoricalMask=1, kDefaultLeftMask=2,
         # missing type in bits 2-3)
-        cat_lut = {}
-        for f_orig, mapping in self.cat_value_to_bin.items():
-            cat_lut[f_orig] = mapping
         for _ in range(self.num_leaves):  # depth bound
             if not active.any():
                 break
@@ -176,17 +227,14 @@ class HostTree:
             x0 = np.where(isnan, 0.0, x)
             le = x0 <= thr
             if is_cat.any():
-                # categorical: compare the category's BIN to the threshold
-                # (train/serve consistency for the ordered-bin cat split)
-                xb = np.zeros(n)
-                for i in np.flatnonzero(is_cat & active):
-                    mapping = cat_lut.get(int(f[i]), {})
-                    xb[i] = mapping.get(-1 if isnan[i] else int(x0[i]), 0)
-                le = np.where(is_cat, xb <= thr, le)
+                # bitset membership on RAW category values, vectorized
+                # (ref: tree.h:375 CategoricalDecision + FindInBitset)
+                le = np.where(is_cat,
+                              self._cat_in_bitset(node, x0, isnan), le)
             # missing handling: 0 none (NaN->0), 1 zero, 2 nan
             miss = np.where(mtype == 2, isnan,
                             (mtype == 1) & (np.abs(x0) <= 1e-35))
-            miss = miss & ~is_cat  # cat NaN already routed to bin 0
+            miss = miss & ~is_cat  # cat NaN/unseen goes right (not in set)
             go_left = np.where(miss, dl, le)
             child = np.where(go_left, self.left_child[node],
                              self.right_child[node])
@@ -197,5 +245,36 @@ class HostTree:
             node = np.where(active, np.maximum(child, 0), node)
         return out
 
+    def cat_values(self, cat_idx: int) -> list:
+        """Decode one categorical node's bitset back to its raw category
+        values (ref: Common::FindInBitset layout — 32-bit words)."""
+        lo = int(self.cat_boundaries[cat_idx])
+        hi = int(self.cat_boundaries[min(cat_idx + 1,
+                                         len(self.cat_boundaries) - 1)])
+        return [w * 32 + b for w in range(hi - lo) for b in range(32)
+                if (int(self.cat_threshold[lo + w]) >> b) & 1]
+
+    def _cat_in_bitset(self, node: np.ndarray, x0: np.ndarray,
+                       isnan: np.ndarray) -> np.ndarray:
+        """Vectorized FindInBitset over per-node category bitsets
+        (ref: include/LightGBM/utils/common.h FindInBitset,
+        tree.h:375-391 CategoricalDecision). ``threshold_real`` of a cat
+        node holds its index into ``cat_boundaries``."""
+        cat_idx = self.threshold_real[node].astype(np.int64)
+        cat_idx = np.clip(cat_idx, 0, max(self.num_cat - 1, 0))
+        lo = self.cat_boundaries[cat_idx]
+        hi = self.cat_boundaries[np.minimum(cat_idx + 1,
+                                            len(self.cat_boundaries) - 1)]
+        v = np.where(isnan | (x0 < 0), -1, np.floor(x0)).astype(np.int64)
+        word = lo + (v >> 5)
+        ok = (v >= 0) & (word < hi)
+        word_c = np.clip(word, 0, max(len(self.cat_threshold) - 1, 0))
+        bits = (self.cat_threshold[word_c] if len(self.cat_threshold)
+                else np.zeros_like(word_c, np.uint32))
+        return ok & (((bits >> (v & 31).astype(np.uint32)) & 1) != 0)
+
     def predict(self, X: np.ndarray) -> np.ndarray:
-        return self.leaf_value[self.predict_leaf(X)]
+        leaf = self.predict_leaf(X)
+        if self.is_linear:
+            return self.linear_output(X, leaf)
+        return self.leaf_value[leaf]

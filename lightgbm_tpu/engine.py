@@ -59,6 +59,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     if not isinstance(train_set, Dataset):
         raise TypeError("train() only accepts Dataset object")
+    train_set._update_params(params)
     train_set.construct()
 
     # continued training (ref: engine.py:233-244)
@@ -272,6 +273,7 @@ def cv(params: Dict[str, Any], train_set: Dataset,
     if cfg_probe.objective not in ("binary", "multiclass", "multiclassova"):
         stratified = False
 
+    train_set._update_params(params)
     train_set.construct()
     folds = _make_n_folds(train_set, folds, nfold, params, seed, stratified,
                           shuffle)

@@ -106,6 +106,10 @@ class BinnedDataset:
         self.metadata: Optional[Metadata] = None
         self.feature_names: List[str] = []
         self.max_bin: int = 255
+        # raw feature matrix [N, F] f32, kept only when linear_tree needs
+        # it (ref: Dataset raw_data_ / raw_index, dataset.h — gated by
+        # Config::linear_tree in DatasetLoader)
+        self.raw: Optional[np.ndarray] = None
 
     # ------------------------------------------------------------------
     @classmethod
@@ -160,6 +164,9 @@ class BinnedDataset:
             np.copyto(col, data[:, feat_i])
             bins[out_i] = self.bin_mappers[feat_i].value_to_bin(col)
         self.bins = bins
+
+        if config.linear_tree:
+            self.raw = np.asarray(data, np.float32)
 
         meta = Metadata(num_data)
         if label is not None:
@@ -233,6 +240,7 @@ class BinnedDataset:
         """Row-subset copy (ref: Dataset::CopySubrow) — used by cv()."""
         out = BinnedDataset()
         out.bins = self.bins[:, row_indices] if self.bins is not None else None
+        out.raw = self.raw[row_indices] if self.raw is not None else None
         out.bin_mappers = self.bin_mappers
         out.used_feature_map = self.used_feature_map
         out.num_data = len(row_indices)

@@ -56,13 +56,19 @@ def _tree_to_string(t: HostTree) -> str:
         lines.append("cat_boundaries=" + _arr_to_str(t.cat_boundaries))
         lines.append("cat_threshold=" + _arr_to_str(t.cat_threshold))
     lines.append(f"is_linear={int(t.is_linear)}")
+    if t.is_linear:
+        # ref: Tree::ToString linear block (src/io/tree.cpp:385-399)
+        lines.append("leaf_const=" + " ".join(
+            repr(float(v)) for v in t.leaf_const[:n]))
+        lines.append("num_features=" + _arr_to_str(
+            [len(t.leaf_coeff[i]) for i in range(n)]))
+        lines.append("leaf_features=" + " ".join(
+            " ".join(str(f) for f in t.leaf_features[i])
+            for i in range(n) if len(t.leaf_features[i])))
+        lines.append("leaf_coeff=" + " ".join(
+            " ".join(repr(float(c)) for c in t.leaf_coeff[i])
+            for i in range(n) if len(t.leaf_coeff[i])))
     lines.append(f"shrinkage={t.shrinkage:g}")
-    # non-standard extension: interim ordered-bin categorical mapping
-    if t.cat_value_to_bin:
-        packed = ";".join(
-            f"{f}:" + ",".join(f"{c}={b}" for c, b in sorted(m.items()))
-            for f, m in sorted(t.cat_value_to_bin.items()))
-        lines.append(f"cat_value_to_bin={packed}")
     return "\n".join(lines) + "\n"
 
 
@@ -188,14 +194,26 @@ def _tree_from_block(block: Dict[str, str]) -> HostTree:
     t.is_linear = bool(int(block.get("is_linear", 0)))
     t.shrinkage = float(block.get("shrinkage", 1.0))
     t.leaf_parent = np.full(n, -1, np.int32)
-    if "cat_value_to_bin" in block and block["cat_value_to_bin"]:
-        maps = {}
-        for part in block["cat_value_to_bin"].split(";"):
-            fs, _, kvs = part.partition(":")
-            maps[int(fs)] = {
-                int(c): int(b) for c, b in
-                (kv.split("=") for kv in kvs.split(",") if kv)}
-        t.cat_value_to_bin = maps
+    if "cat_value_to_bin" in block:
+        # the pre-bitset interim categorical format cannot be served
+        # correctly anymore — fail loudly rather than mis-route rows
+        from ..utils import log
+        log.fatal("this model was saved with the removed interim "
+                  "categorical format (cat_value_to_bin); re-train it "
+                  "with the current version")
+    if t.is_linear:
+        t._init_linear_fields()
+        t.leaf_const = floats("leaf_const", n)
+        nf = ints("num_features", n)
+        flat_f = [int(float(x))
+                  for x in block.get("leaf_features", "").split()]
+        flat_c = [float(x) for x in block.get("leaf_coeff", "").split()]
+        pos = 0
+        for i in range(n):
+            k = int(nf[i])
+            t.leaf_features[i] = flat_f[pos:pos + k]
+            t.leaf_coeff[i] = np.asarray(flat_c[pos:pos + k], np.float64)
+            pos += k
     if t.num_cat > 0:
         t.cat_boundaries = ints("cat_boundaries", t.num_cat + 1)
         nthr = t.cat_boundaries[-1] if len(t.cat_boundaries) else 0

@@ -32,14 +32,14 @@ class DART(GBDT):
         leaf values; factor folds the Shrinkage(-1) style steps)."""
         t = self.models[tree_idx]
         self.score = self.score.at[k].add(
-            factor * self._tree_outputs(t, self.bins_dev))
+            factor * self._tree_outputs(t, self.bins_dev, self.train_set.raw))
 
     def _add_tree_score_valid(self, tree_idx: int, k: int,
                               factor: float) -> None:
         t = self.models[tree_idx]
         for vd in self.valid_sets:
             vd.score = vd.score.at[k].add(
-                factor * self._tree_outputs(t, vd.bins_dev))
+                factor * self._tree_outputs(t, vd.bins_dev, vd.dataset.raw))
 
     def _dropping_trees(self) -> None:
         """ref: dart.hpp:98 DroppingTrees."""

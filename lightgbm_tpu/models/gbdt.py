@@ -56,6 +56,14 @@ def _host_tree_to_arrays(t: HostTree, max_leaves: int) -> TreeArrays:
         out[:len(a)] = a
         return jnp.asarray(out)
 
+    cat_count = cat_bins = None
+    cci = getattr(t, "cat_count_inner", None)
+    if cci is not None and len(cci) and cci.any():
+        width = max(t.cat_bins_inner.shape[1], 1)
+        cb = np.full((li, width), -1, np.int32)
+        cb[:t.cat_bins_inner.shape[0]] = t.cat_bins_inner
+        cat_bins = jnp.asarray(cb)
+        cat_count = pad_i(cci, li)
     return TreeArrays(
         split_feature=pad_i(t.split_feature_inner, li),
         threshold_bin=pad_i(t.threshold_bin, li),
@@ -72,6 +80,8 @@ def _host_tree_to_arrays(t: HostTree, max_leaves: int) -> TreeArrays:
         leaf_parent=pad_i(t.leaf_parent, L),
         num_leaves=jnp.asarray(t.num_leaves, jnp.int32),
         shrinkage=jnp.asarray(t.shrinkage, jnp.float32),
+        cat_count=cat_count,
+        cat_bins=cat_bins,
     )
 
 
@@ -174,8 +184,12 @@ class GBDT:
         self.feature_meta = FeatureMeta.from_mappers(mappers, monotone) \
             if mappers else None
         self.num_bin_max = int(max((m.num_bin for m in mappers), default=2))
-        self.bins_dev = jnp.asarray(train.bins) if train.bins is not None \
-            else None
+        # the feature-major device copy is only needed by traversal paths
+        # (rollback, DART drops, continued training, valid replay) — it is
+        # materialized lazily so training doesn't hold a dead full-dataset
+        # copy in HBM next to bins_rf / bins_sharded
+        self._bins_fr_host = train.bins
+        self._bins_dev_cache = None
 
         K = self.num_tree_per_iteration
         self.score = jnp.zeros((K, self.num_data), jnp.float32)
@@ -200,7 +214,11 @@ class GBDT:
             min_gain_to_split=cfg.min_gain_to_split,
             max_delta_step=cfg.max_delta_step,
             path_smooth=cfg.path_smooth,
-            monotone_penalty=cfg.monotone_penalty)
+            monotone_penalty=cfg.monotone_penalty,
+            max_cat_threshold=int(cfg.max_cat_threshold),
+            cat_l2=float(cfg.cat_l2), cat_smooth=float(cfg.cat_smooth),
+            max_cat_to_onehot=int(cfg.max_cat_to_onehot),
+            min_data_per_group=int(cfg.min_data_per_group))
         backend = "xla"
         if cfg.tpu_use_pallas and jax.default_backend() == "tpu":
             backend = "pallas"
@@ -221,19 +239,103 @@ class GBDT:
                 tuple(orig2used[f] for f in grp if f in orig2used)
                 for grp in parsed)
         self._bynode = cfg.feature_fraction_bynode < 1.0
+        # compact row scheduling (O(rows_in_leaf) histogram passes) is the
+        # serial default; "full" keeps the masked full-pass program.
+        # tpu_hist_kernel=auto picks scatter-add on the CPU backend
+        # (einsum one-hot is pathologically slow there) and the MXU
+        # einsum kernel on TPU.
+        row_sched = cfg.tpu_row_scheduling
+        rm_backend = cfg.tpu_hist_kernel
+        if rm_backend == "auto":
+            rm_backend = ("scatter" if jax.default_backend() == "cpu"
+                          else "einsum")
+        hist_dtype = cfg.tpu_hist_dtype
         self.grower_cfg = GrowerConfig(
             num_leaves=cfg.num_leaves, max_depth=cfg.max_depth,
             num_bin=self.num_bin_max, hparams=hp, hist_backend=backend,
             block_rows=cfg.tpu_rows_per_block,
-            bynode_mask=self._bynode, interaction_groups=groups)
+            bynode_mask=self._bynode, interaction_groups=groups,
+            row_sched=row_sched, hist_dtype=hist_dtype,
+            hist_rm_backend=rm_backend,
+            partition_mode=cfg.tpu_partition_mode,
+            min_bucket=cfg.tpu_min_bucket,
+            quantized=bool(cfg.use_quantized_grad),
+            quant_bins=int(cfg.num_grad_quant_bins),
+            stochastic_rounding=bool(cfg.stochastic_rounding))
+        self._quant_rng = jax.random.PRNGKey(
+            cfg.seed if cfg.seed is not None else 0) \
+            if cfg.use_quantized_grad else None
+        # ---- tree learner selection (ref: tree_learner.cpp:17 factory) ----
+        # serial runs the single-program grower; data/voting shard rows and
+        # feature shards columns over a jax Mesh, with the FULL TrainOneIter
+        # (objectives, bagging, multiclass, ranking, eval) around them —
+        # the parallel learners are drop-in under boosting exactly like
+        # parallel_tree_learner.h:26-207
+        self._tree_learner = "serial"
+        self._mesh = None
+        self._row_pad = 0
+        self._feat_pad = 0
+        avail = len(jax.devices())
+        want = cfg.tpu_num_devices if cfg.tpu_num_devices > 0 else avail
+        self._n_dev = min(want, avail)
+        tl = cfg.tree_learner
+        # linear trees: serial only; objective/missing conflicts fatal
+        # (ref: config.cpp:426 CheckParamConflict linear_tree block)
+        self._linear = bool(cfg.linear_tree)
+        if self._linear:
+            if train.raw is None:
+                log.fatal("linear_tree requires the training Dataset to be "
+                          "constructed with linear_tree=true in its params "
+                          "(raw feature values are needed; datasets loaded "
+                          "from binary files do not carry them)")
+            if tl != "serial":
+                log.warning("Linear tree learner must be serial")
+                tl = "serial"
+            if cfg.zero_as_missing:
+                log.fatal("zero_as_missing must be false when fitting "
+                          "linear trees")
+            if self.objective is not None and \
+                    getattr(self.objective, "NAME", "") == "regression_l1":
+                log.fatal("Cannot use regression_l1 objective when fitting "
+                          "linear trees")
+        if tl in ("data", "voting", "feature"):
+            if self._n_dev > 1:
+                self._tree_learner = tl
+                # distributed growers run the full-pass program (the
+                # compact scheduler is serial-only for now); quantized
+                # histograms under the parallel learners land with the
+                # int-hist ReduceScatter equivalent
+                import dataclasses as _dc
+                if self.grower_cfg.quantized:
+                    log.warning("use_quantized_grad is not supported with "
+                                f"tree_learner={tl} yet; training fp32")
+                    self._quant_rng = None
+                self.grower_cfg = _dc.replace(self.grower_cfg,
+                                              row_sched="full",
+                                              quantized=False)
+            else:
+                cap = (f"tpu_num_devices={cfg.tpu_num_devices}"
+                       if 0 < cfg.tpu_num_devices < avail
+                       else f"only {avail} device(s) visible")
+                log.warning(f"tree_learner={tl} requested but {cap}; "
+                            "running serial")
+        self._compact = (self.grower_cfg.row_sched == "compact" and
+                         self._tree_learner == "serial")
+        self.bins_rf = None
+        if self._compact and train.bins is not None:
+            # row-major copy for the gather path; bins_dev keeps the
+            # feature-major layout used by prediction/traversal
+            self.bins_rf = jnp.asarray(np.ascontiguousarray(train.bins.T))
         forced = self._load_forced_splits(train)
         self._setup_cegb(train)
-        if self.feature_meta is not None:
+        if self.feature_meta is None:
+            self._grow = None
+        elif self._tree_learner == "serial":
             self._grow = jax.jit(
                 make_tree_grower(self.grower_cfg, self.feature_meta,
                                  forced=forced))
         else:
-            self._grow = None
+            self._setup_distributed(train, forced)
 
         # jitted gradient fn (device-resident labels/weights in the closure)
         if self.objective is not None and \
@@ -250,6 +352,103 @@ class GBDT:
         self._col_rng = np.random.default_rng(cfg.feature_fraction_seed)
         self.num_used_features = train.num_used_features
 
+    def _train_bins(self):
+        """Bins array the grower trains on (layout depends on the learner;
+        the distributed wrapper holds its own sharded copy)."""
+        if self._tree_learner != "serial":
+            return None
+        return self.bins_rf if self._compact else self.bins_dev
+
+    @property
+    def bins_dev(self):
+        """Feature-major [F, R] device bins for traversal paths, lazily
+        materialized (training reads bins_rf / bins_sharded instead)."""
+        if self._bins_dev_cache is None and self._bins_fr_host is not None:
+            self._bins_dev_cache = jnp.asarray(self._bins_fr_host)
+        return self._bins_dev_cache
+
+    # ------------------------------------------------------------------
+    def _setup_distributed(self, train: BinnedDataset, forced) -> None:
+        """Build the mesh + sharded grower for tree_learner=data/voting/
+        feature (ref: parallel_tree_learner.h — the learners are drop-in
+        replacements under the unchanged boosting loop; SURVEY.md §3.3).
+
+        Rows (data/voting) or features (feature) are padded to a multiple
+        of the mesh size; padding rows carry gh = 0 and padded features are
+        1-bin (never splittable), so they are invisible to training.
+        """
+        from ..parallel import (build_mesh, make_data_parallel_grower,
+                                make_feature_parallel_grower,
+                                make_voting_parallel_grower,
+                                pad_feature_meta, padded_features)
+        from ..parallel.mesh import (DATA_AXIS, FEATURE_AXIS, padded_rows,
+                                     row_sharding)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        cfg = self.config
+        tl = self._tree_learner
+        n_dev = self._n_dev
+        N = self.num_data
+        F = train.num_used_features
+        if forced is not None and tl != "data":
+            log.warning(f"forcedsplits_filename is not supported with "
+                        f"tree_learner={tl}; ignoring forced splits")
+            forced = None
+        if self.grower_cfg.interaction_groups and tl == "feature":
+            log.fatal("interaction_constraints are not supported with "
+                      "tree_learner=feature")
+
+        if tl in ("data", "voting"):
+            mesh = build_mesh(n_dev, axis_names=(DATA_AXIS,))
+            R_pad = padded_rows(N, n_dev)
+            self._row_pad = R_pad - N
+            bins = train.bins
+            if self._row_pad:
+                bins = np.pad(bins, ((0, 0), (0, self._row_pad)))
+            self.bins_sharded = jax.device_put(
+                bins, NamedSharding(mesh, P(None, DATA_AXIS)))
+            if tl == "data":
+                grow = make_data_parallel_grower(
+                    self.grower_cfg, self.feature_meta, mesh, forced=forced)
+            else:
+                grow = make_voting_parallel_grower(
+                    self.grower_cfg, self.feature_meta, mesh,
+                    top_k=int(cfg.top_k))
+            self._grow_dist = jax.jit(grow)
+        else:  # feature-parallel
+            mesh = build_mesh(n_dev, axis_names=(FEATURE_AXIS,))
+            Fp = padded_features(F, n_dev)
+            self._feat_pad = Fp - F
+            bins = train.bins
+            if self._feat_pad:
+                bins = np.pad(bins, ((0, self._feat_pad), (0, 0)))
+            self.bins_sharded = jax.device_put(
+                bins, NamedSharding(mesh, P(FEATURE_AXIS, None)))
+            meta_p = pad_feature_meta(self.feature_meta, Fp)
+            grow = make_feature_parallel_grower(self.grower_cfg, meta_p,
+                                                mesh)
+            self._grow_dist = jax.jit(grow)
+        self._mesh = mesh
+
+        def grow_wrapper(bins_unused, gh, fmask, cegb, rng_key=None):
+            if self._row_pad:
+                gh = jnp.pad(gh, ((0, self._row_pad), (0, 0)))
+            if self._feat_pad and fmask is not None:
+                pad_w = [(0, self._feat_pad)]
+                if fmask.ndim == 2:
+                    pad_w = [(0, 0)] + pad_w
+                fmask = jnp.pad(fmask, pad_w)
+            if self._feat_pad and cegb is not None:
+                cegb = (jnp.pad(cegb[0], (0, self._feat_pad)),
+                        jnp.pad(cegb[1], (0, self._feat_pad)))
+            tree, leaf_id = self._grow_dist(self.bins_sharded, gh, fmask,
+                                            cegb)
+            if self._row_pad:
+                leaf_id = leaf_id[:N]
+            return tree, leaf_id
+
+        self._grow = grow_wrapper
+
     # ------------------------------------------------------------------
     def add_valid_data(self, valid: BinnedDataset,
                        metrics: Optional[List[Metric]] = None,
@@ -260,6 +459,10 @@ class GBDT:
                 self.objective.NAME if self.objective else "custom")
         for m in metrics:
             m.init(valid.metadata, valid.num_data)
+        if getattr(self, "_linear", False) and valid.raw is None:
+            log.fatal("linear_tree validation data was constructed without "
+                      "raw features; pass the same params (incl. "
+                      "linear_tree) to the valid Dataset")
         vd = _ValidData(valid, metrics, self.num_tree_per_iteration,
                         name or f"valid_{len(self.valid_sets) + 1}")
         # replay existing model onto the new valid set (continued training)
@@ -267,7 +470,7 @@ class GBDT:
             for k in range(self.num_tree_per_iteration):
                 t = self.models[it * self.num_tree_per_iteration + k]
                 vd.score = vd.score.at[k].add(self._tree_outputs(
-                    t, vd.bins_dev))
+                    t, vd.bins_dev, vd.dataset.raw))
         self.valid_sets.append(vd)
 
     def add_train_metrics(self, metrics: List[Metric]) -> None:
@@ -307,6 +510,10 @@ class GBDT:
                             "stopping forced prefix here")
                 break
             mapper = train.bin_mappers[f_orig]
+            if mapper.bin_type == "categorical":
+                log.warning(f"forced split on categorical feature {f_orig} "
+                            "is not supported; stopping forced prefix here")
+                break
             # real threshold -> bin: the left side is value <= threshold,
             # i.e. bin(threshold) (ref: Dataset::BinThreshold)
             tb = int(mapper.value_to_bin(
@@ -462,12 +669,18 @@ class GBDT:
                 return init_score
         return 0.0
 
-    def _tree_outputs(self, t: HostTree, bins_dev) -> jnp.ndarray:
-        """Per-row output of a host tree over binned data."""
+    def _tree_outputs(self, t: HostTree, bins_dev,
+                      raw: Optional[np.ndarray] = None) -> jnp.ndarray:
+        """Per-row output of a host tree over binned data. Linear trees
+        route over bins but add raw-feature linear terms (ref: tree.cpp
+        PredictionFunLinear operates on binned decisions + raw pointers)."""
         arrs = _host_tree_to_arrays(t, self.config.num_leaves)
         leaf = tree_leaf_bins(arrs, bins_dev, self.feature_meta.num_bin,
                               self.feature_meta.missing_type,
                               self.feature_meta.default_bin)
+        if t.is_linear and raw is not None:
+            return jnp.asarray(
+                t.linear_output(raw, np.asarray(leaf)).astype(np.float32))
         return arrs.leaf_value[leaf]
 
     # ------------------------------------------------------------------
@@ -517,10 +730,18 @@ class GBDT:
                 ones = jnp.ones_like(g)
                 gh = jnp.stack([g, h, ones], axis=1)
             fmask = self._feature_mask()
+            train_bins = self._train_bins()
+            rng_key = None
+            if self._quant_rng is not None:
+                # fresh stochastic-rounding noise per tree (ref:
+                # gradient_discretizer.cpp random_values_use_start per iter)
+                rng_key = jax.random.fold_in(
+                    self._quant_rng, self.iter * K + k)
             with global_timer.section("TreeLearner::Train",
                                       sync=lambda: tree_dev.leaf_value):
-                tree_dev, leaf_id = self._grow(self.bins_dev, gh, fmask,
-                                               self._cegb_penalty())
+                tree_dev, leaf_id = self._grow(train_bins, gh, fmask,
+                                               self._cegb_penalty(),
+                                               rng_key)
             with global_timer.section("Tree::ToHost"):
                 host = HostTree(jax.tree.map(np.asarray, tree_dev),
                                 self.train_set.used_feature_map)
@@ -544,6 +765,29 @@ class GBDT:
             self._finalize_tree(host)
             leaf_np = np.asarray(leaf_id)
             self._cegb_after_tree(host, leaf_np, selected)
+
+            # -- linear leaves (ref: LinearTreeLearner::CalculateLinear) --
+            if self._linear:
+                w_np = (np.asarray(weight) * selected
+                        if sample is not None else None)
+                self._fit_linear_leaves(
+                    host, leaf_np, np.asarray(grad[k]), np.asarray(hess[k]),
+                    w_np,
+                    is_first_tree=(len(self.models) < K and
+                                   self.num_init_iteration == 0))
+
+            # -- quantized-gradient leaf renewal ------------------------
+            # (ref: GradientDiscretizer::RenewIntGradTreeOutput — refit
+            # leaf outputs from the TRUE fp32 grad/hess sums, no smoothing)
+            if (self._quant_rng is not None and
+                    self.config.quant_train_renew_leaf):
+                # use the full bagging/GOSS weights (incl. amplification),
+                # matching the gh the tree was grown with
+                w_np = (np.asarray(weight) * selected
+                        if sample is not None else None)
+                self._renew_quant_leaves(host, leaf_np,
+                                         np.asarray(grad[k]),
+                                         np.asarray(hess[k]), w_np)
 
             # -- RenewTreeOutput (L1-family percentile re-fit) ----------
             # (ref: gbdt.cpp:418 via tree_learner_->RenewTreeOutput)
@@ -570,16 +814,22 @@ class GBDT:
             host.shrink(self.shrinkage_rate)
             with global_timer.section("GBDT::UpdateScore",
                                       sync=lambda: self.score):
-                lv = np.zeros(self.config.num_leaves, np.float32)
-                lv[:host.num_leaves] = host.leaf_value[:host.num_leaves]
-                lv_dev = jnp.asarray(lv)
-                self.score = self.score.at[k].add(lv_dev[leaf_id])
+                if host.is_linear:
+                    self.score = self.score.at[k].add(jnp.asarray(
+                        host.linear_output(self.train_set.raw,
+                                           leaf_np).astype(np.float32)))
+                else:
+                    lv = np.zeros(self.config.num_leaves, np.float32)
+                    lv[:host.num_leaves] = host.leaf_value[:host.num_leaves]
+                    lv_dev = jnp.asarray(lv)
+                    self.score = self.score.at[k].add(lv_dev[leaf_id])
             with global_timer.section(
                     "GBDT::UpdateValidScore",
                     sync=lambda: [vd.score for vd in self.valid_sets]):
                 for vd in self.valid_sets:
                     vd.score = vd.score.at[k].add(
-                        self._tree_outputs(host, vd.bins_dev))
+                        self._tree_outputs(host, vd.bins_dev,
+                                           vd.dataset.raw))
             if abs(init_scores[k]) > K_EPSILON:
                 host.add_bias(init_scores[k])
             self.models.append(host)
@@ -593,6 +843,100 @@ class GBDT:
         self.iter += 1
         return False
 
+    def _fit_linear_leaves(self, host: HostTree, leaf_np: np.ndarray,
+                           grad: np.ndarray, hess: np.ndarray,
+                           weight: Optional[np.ndarray],
+                           is_first_tree: bool) -> None:
+        """Fit a ridge-regularized linear model in every leaf over the
+        NUMERICAL features on the leaf's path (ref: linear_tree_learner.cpp
+        CalculateLinear — coeffs = -(X'HX + lambda*I)^-1 X'g per Eq 3 of
+        arXiv:1802.05640; NaN rows excluded; leaves with too few usable
+        rows stay constant; |coef| <= 1e-35 dropped)."""
+        host.is_linear = True
+        host._init_linear_fields()
+        n = host.num_leaves
+        host.leaf_const[:] = host.leaf_value[:n]
+        if is_first_tree:
+            return
+        raw = self.train_set.raw
+        lam = float(self.config.linear_lambda)
+        mappers = self.train_set.bin_mappers
+
+        # numerical features on each leaf's path (sorted unique ORIGINAL
+        # indices, like branch_features + InnerFeatureIndex filtering);
+        # explicit stack — leaf-wise trees can be num_leaves deep
+        path_feats = {}
+        stack = [(0, [])]
+        while stack:
+            node, feats = stack.pop()
+            if node < 0:
+                path_feats[~node] = sorted(set(feats))
+                continue
+            f = int(host.split_feature[node])
+            nxt = feats + [f] if mappers[f].bin_type == "numerical" else feats
+            stack.append((int(host.left_child[node]), nxt))
+            stack.append((int(host.right_child[node]), nxt))
+
+        # group rows by leaf in one argsort pass (not O(N*L) scans)
+        order = np.argsort(leaf_np, kind="stable")
+        counts = np.bincount(leaf_np, minlength=n)
+        starts = np.zeros(n + 1, np.int64)
+        np.cumsum(counts, out=starts[1:])
+
+        g = grad.astype(np.float64)
+        h = hess.astype(np.float64)
+        if weight is not None:
+            g = g * weight
+            h = h * weight
+        for leaf, feats in path_feats.items():
+            if not feats:
+                continue
+            rows = order[starts[leaf]:starts[leaf + 1]]
+            if weight is not None:
+                rows = rows[weight[rows] > 0]
+            Xl = raw[np.ix_(rows, feats)].astype(np.float64)
+            ok = ~np.isnan(Xl).any(axis=1)
+            rows, Xl = rows[ok], Xl[ok]
+            if len(rows) < len(feats) + 1:
+                continue  # leaf stays constant
+            X1 = np.concatenate([Xl, np.ones((len(rows), 1))], axis=1)
+            hw = h[rows]
+            XTHX = (X1 * hw[:, None]).T @ X1
+            XTHX[np.arange(len(feats)), np.arange(len(feats))] += lam
+            XTg = X1.T @ g[rows]
+            try:
+                coeffs = -np.linalg.solve(XTHX, XTg)
+            except np.linalg.LinAlgError:
+                continue
+            if not np.isfinite(coeffs).all():
+                continue
+            keep = np.abs(coeffs[:-1]) > 1e-35
+            host.leaf_features[leaf] = [feats[j]
+                                        for j in np.flatnonzero(keep)]
+            host.leaf_coeff[leaf] = coeffs[:-1][keep]
+            host.leaf_const[leaf] = coeffs[-1]
+
+    def _renew_quant_leaves(self, host: HostTree, leaf_np: np.ndarray,
+                            grad: np.ndarray, hess: np.ndarray,
+                            weight: Optional[np.ndarray]) -> None:
+        """Refit leaf outputs from true fp32 gradient sums after quantized
+        growth (ref: gradient_discretizer.cpp RenewIntGradTreeOutput —
+        CalculateSplittedLeafOutput without path smoothing). ``weight`` is
+        the full bagging/GOSS row weight (amplification included)."""
+        cfg = self.config
+        n = host.num_leaves
+        w = weight.astype(np.float64) if weight is not None \
+            else np.ones_like(grad, np.float64)
+        sg = np.bincount(leaf_np, weights=grad * w, minlength=n)[:n]
+        sh = np.bincount(leaf_np, weights=hess * w, minlength=n)[:n]
+        l1, l2 = cfg.lambda_l1, cfg.lambda_l2
+        tg = np.sign(sg) * np.maximum(np.abs(sg) - l1, 0.0) if l1 > 0 else sg
+        out = -tg / (sh + l2 + K_EPSILON)
+        if cfg.max_delta_step > 0:
+            out = np.clip(out, -cfg.max_delta_step, cfg.max_delta_step)
+        host.leaf_value[:n] = np.where(np.isfinite(out), out,
+                                       host.leaf_value[:n])
+
     def _constant_tree(self, value: float) -> HostTree:
         """ref: Tree::AsConstantTree."""
         t = HostTree.constant(value)
@@ -602,26 +946,34 @@ class GBDT:
         """Resolve bin thresholds to real values and pack decision_type bits
         (ref: tree.h kCategoricalMask=1, kDefaultLeftMask=2, missing type in
         bits 2-3; Tree::Split stores RealThreshold = bin upper bound)."""
-        from ..io.binning import MISSING_NONE, MISSING_ZERO
         mappers = self.train_set.bin_mappers
         n_int = host.num_leaves - 1
         thr_real = np.zeros(n_int, np.float64)
         dtype_bits = np.zeros(n_int, np.int32)
         miss_enum = {"none": 0, "zero": 1, "nan": 2}
-        cat_maps = {}
+        cat_boundaries = [0]
+        cat_words: List[np.ndarray] = []
         for i in range(n_int):
             m = mappers[host.split_feature[i]]
             tb = int(host.threshold_bin[i])
             if m.bin_type == "categorical":
-                # interim ordered-bin categorical split: serve by mapping the
-                # raw category to its bin (train/serve consistent); the
-                # LightGBM bitset subset split lands with the categorical
-                # optimal-split work (ref: feature_histogram.hpp sorted-subset)
-                thr_real[i] = float(tb)
+                # categorical optimal split: translate the chosen BIN set
+                # into a bitset over RAW category values (ref: Tree::
+                # SplitCategorical cat_threshold_/cat_boundaries_,
+                # Common::ConstructBitset); threshold_real holds cat_idx
+                k = int(host.cat_count_inner[i])
+                bins_set = host.cat_bins_inner[i][:k]
+                cats = [m.bin_2_categorical[b] for b in bins_set
+                        if 0 < b < len(m.bin_2_categorical) and
+                        m.bin_2_categorical[b] >= 0]
+                n_words = (max(cats) // 32 + 1) if cats else 1
+                words = np.zeros(n_words, np.uint32)
+                for v in cats:
+                    words[v // 32] |= np.uint32(1) << np.uint32(v % 32)
+                thr_real[i] = float(len(cat_boundaries) - 1)  # cat_idx
+                cat_boundaries.append(cat_boundaries[-1] + n_words)
+                cat_words.append(words)
                 dtype_bits[i] |= 1
-                f_orig = int(host.split_feature[i])
-                if f_orig not in cat_maps:
-                    cat_maps[f_orig] = dict(m.categorical_2_bin)
             else:
                 thr_real[i] = m.bin_upper_bound[min(
                     tb, len(m.bin_upper_bound) - 1)]
@@ -630,7 +982,10 @@ class GBDT:
             dtype_bits[i] |= miss_enum[m.missing_type] << 2
         host.threshold_real = thr_real
         host.decision_type = dtype_bits
-        host.cat_value_to_bin = cat_maps
+        host.num_cat = len(cat_words)
+        host.cat_boundaries = np.asarray(cat_boundaries, np.int64)
+        host.cat_threshold = (np.concatenate(cat_words) if cat_words
+                              else np.zeros(0, np.uint32))
 
     def rollback_one_iter(self) -> None:
         """ref: gbdt.cpp:463 RollbackOneIter."""
@@ -641,10 +996,10 @@ class GBDT:
             t = self.models[len(self.models) - K + k]
             # subtract contribution from train & valid scores
             self.score = self.score.at[k].add(
-                -self._tree_outputs(t, self.bins_dev))
+                -self._tree_outputs(t, self.bins_dev, self.train_set.raw))
             for vd in self.valid_sets:
                 vd.score = vd.score.at[k].add(
-                    -self._tree_outputs(t, vd.bins_dev))
+                    -self._tree_outputs(t, vd.bins_dev, vd.dataset.raw))
         del self.models[-K:]
         self.iter -= 1
 
@@ -675,6 +1030,7 @@ class GBDT:
         for t in self.models:
             if not getattr(t, "from_text", False):
                 continue
+            cat_sets = {}
             for i in range(t.num_leaves - 1):
                 f = int(t.split_feature[i])
                 if f not in inner_of:
@@ -685,16 +1041,28 @@ class GBDT:
                 if m.bin_type == "numerical":
                     t.threshold_bin[i] = int(
                         m.value_to_bin(np.asarray([t.threshold_real[i]]))[0])
-                else:
-                    t.threshold_bin[i] = int(t.threshold_real[i])
+                elif (t.decision_type[i] & 1) and t.num_cat > 0:
+                    # decode the raw-category bitset back to this dataset's
+                    # BIN set so binned traversal replays correctly
+                    vals = t.cat_values(int(t.threshold_real[i]))
+                    cat_sets[i] = [m.categorical_2_bin[v] for v in vals
+                                   if v in m.categorical_2_bin]
+            if cat_sets:
+                width = max(len(s) for s in cat_sets.values())
+                ni = t.num_leaves - 1
+                t.cat_bins_inner = np.full((ni, width), -1, np.int32)
+                t.cat_count_inner = np.zeros(ni, np.int32)
+                for i, s in cat_sets.items():
+                    t.cat_bins_inner[i, :len(s)] = s
+                    t.cat_count_inner[i] = len(s)
             t.from_text = False
         for i, t in enumerate(self.models):
             k = i % K
             self.score = self.score.at[k].add(
-                self._tree_outputs(t, self.bins_dev))
+                self._tree_outputs(t, self.bins_dev, self.train_set.raw))
             for vd in self.valid_sets:
                 vd.score = vd.score.at[k].add(
-                    self._tree_outputs(t, vd.bins_dev))
+                    self._tree_outputs(t, vd.bins_dev, vd.dataset.raw))
 
     def _eval(self, metrics, score, data_name):
         out = []

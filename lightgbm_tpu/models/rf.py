@@ -79,9 +79,13 @@ class RF(GBDT):
             else:
                 gh = jnp.stack([g, h, jnp.ones_like(g)], axis=1)
             fmask = self._feature_mask()
-            tree_dev, leaf_id = self._grow(self.bins_dev, gh, fmask,
-                                           self._cegb_penalty())
             import jax
+            rng_key = None
+            if self._quant_rng is not None:
+                rng_key = jax.random.fold_in(self._quant_rng,
+                                             self.iter * K + k)
+            tree_dev, leaf_id = self._grow(self._train_bins(), gh, fmask,
+                                           self._cegb_penalty(), rng_key)
             host = HostTree(jax.tree.map(np.asarray, tree_dev),
                             self.train_set.used_feature_map)
             if host.num_leaves <= 1:
@@ -123,7 +127,7 @@ class RF(GBDT):
             for vd in self.valid_sets:
                 vd.score = vd.score.at[k].set(
                     (vd.score[k] * n_prev +
-                     self._tree_outputs(host, vd.bins_dev)) / (n_prev + 1))
+                     self._tree_outputs(host, vd.bins_dev, vd.dataset.raw)) / (n_prev + 1))
             self.models.append(host)
 
         if not should_continue:

@@ -48,8 +48,16 @@ def hist_xla(bins_t: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
     F, R = bins_t.shape
     C = gh.shape[1]
     iota = jnp.arange(num_bin, dtype=jnp.int32)
+    int8_mode = gh.dtype == jnp.int8
+    acc_dtype = jnp.int32 if int8_mode else jnp.float32
 
     def block_hist(bb, gb):
+        if int8_mode:
+            # quantized path: EXACT int32 accumulation on the int8 MXU
+            # (ref: bin.h:49-82 Int32HistogramSumReducer et al.)
+            onehot = (bb[:, :, None] == iota).astype(jnp.int8)
+            return jnp.einsum("frb,rc->fbc", onehot, gb,
+                              preferred_element_type=jnp.int32)
         onehot = (bb[:, :, None] == iota).astype(jnp.float32)  # [F, rb, B]
         # HIGHEST keeps true-f32 accumulation on the MXU (the one-hot side is
         # exact in bf16 but gradients are not)
@@ -59,7 +67,7 @@ def hist_xla(bins_t: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
 
     nb = R // block_rows
     main = nb * block_rows
-    acc = jnp.zeros((F, num_bin, C), jnp.float32)
+    acc = jnp.zeros((F, num_bin, C), acc_dtype)
     if nb > 0:
         bins_blk = bins_t[:, :main].reshape(F, nb, block_rows).transpose(1, 0, 2)
         gh_blk = gh[:main].reshape(nb, block_rows, C)
@@ -74,14 +82,75 @@ def hist_xla(bins_t: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
     return acc
 
 
+def hist_rowmajor(bins_rm: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
+                  block_rows: int = 4096, dtype: str = "float32",
+                  backend: str = "einsum") -> jnp.ndarray:
+    """Histogram over a ROW-MAJOR [S, F] bin block (the gathered-leaf layout
+    of the compact scheduler — rows of one leaf gathered contiguously, so a
+    leaf histogram costs O(rows_in_leaf) like the reference's
+    DataPartition-indexed construction, serial_tree_learner.cpp:368-386).
+
+    dtype: "float32" keeps exact f32 MXU accumulation (HIGHEST);
+    "bfloat16" rounds gh to bf16 (one-hot side is exact either way) with
+    f32 accumulation — the single-precision-style fast path, mirroring the
+    reference GPU backend's float histograms (doc: GPU-Performance.rst).
+    backend: "einsum" (one-hot matmul, the TPU path) or "scatter"
+    (true scatter-add, the natural CPU kernel).
+    Returns f32 [F, num_bin, C].
+    """
+    S, F = bins_rm.shape
+    C = gh.shape[1]
+    iota = jnp.arange(num_bin, dtype=jnp.int32)
+    bf16 = dtype in ("bfloat16", "bf16")
+    int8_mode = gh.dtype == jnp.int8
+    acc_dtype = jnp.int32 if int8_mode else jnp.float32
+
+    def block_hist(bb, gb):
+        if int8_mode:
+            onehot = (bb[:, :, None] == iota).astype(jnp.int8)
+            return jnp.einsum("rfb,rc->fbc", onehot, gb,
+                              preferred_element_type=jnp.int32)
+        if bf16:
+            onehot = (bb[:, :, None] == iota).astype(jnp.bfloat16)
+            gb = gb.astype(jnp.bfloat16)
+            return jnp.einsum("rfb,rc->fbc", onehot, gb,
+                              preferred_element_type=jnp.float32)
+        onehot = (bb[:, :, None] == iota).astype(jnp.float32)
+        return jnp.einsum("rfb,rc->fbc", onehot, gb,
+                          precision=lax.Precision.HIGHEST,
+                          preferred_element_type=jnp.float32)
+
+    if backend == "scatter":
+        # CPU-friendly path (tests); XLA fuses the transpose into the gather
+        return hist_scatter(bins_rm.T, gh, num_bin)
+
+    nb = S // block_rows
+    main = nb * block_rows
+    acc = jnp.zeros((F, num_bin, C), acc_dtype)
+    if nb > 0:
+        bins_blk = bins_rm[:main].reshape(nb, block_rows, F)
+        gh_blk = gh[:main].reshape(nb, block_rows, C)
+
+        def body(a, inp):
+            bb, gb = inp
+            return a + block_hist(bb, gb), None
+
+        acc, _ = lax.scan(body, acc, (bins_blk, gh_blk))
+    if main < S:
+        acc = acc + block_hist(bins_rm[main:], gh[main:])
+    return acc
+
+
 def hist_scatter(bins_t: jnp.ndarray, gh: jnp.ndarray,
                  num_bin: int) -> jnp.ndarray:
     """Histogram via scatter-add. Fastest on CPU backend (tests), slow on TPU."""
     F, R = bins_t.shape
     C = gh.shape[1]
-    out = jnp.zeros((F, num_bin, C), jnp.float32)
+    acc_dtype = jnp.int32 if gh.dtype == jnp.int8 else jnp.float32
+    out = jnp.zeros((F, num_bin, C), acc_dtype)
     f_idx = jnp.broadcast_to(jnp.arange(F, dtype=jnp.int32)[:, None], (F, R))
     b_idx = bins_t.astype(jnp.int32)
+    gh = gh.astype(acc_dtype)
     vals = jnp.broadcast_to(gh.T[None, :, :], (F, C, R)).transpose(0, 2, 1)
     return out.at[f_idx.reshape(-1), b_idx.reshape(-1)].add(
         vals.reshape(F * R, C))

@@ -59,6 +59,11 @@ def tree_leaf_bins(tree: TreeArrays, bins_t: jnp.ndarray,
         is_nan_bin = (miss == MISSING_ENUM["nan"]) & (b == nbin - 1)
         is_dflt_bin = (miss == MISSING_ENUM["zero"]) & (b == dflt)
         go_left = jnp.where(is_nan_bin | is_dflt_bin, dl, go_left)
+        if tree.cat_bins is not None:
+            # categorical set membership on bins (ref: dense_bin.hpp
+            # SplitCategoricalInner / tree.h CategoricalDecisionInner)
+            in_set = jnp.any(tree.cat_bins[node] == b[:, None], axis=1)
+            go_left = jnp.where(tree.cat_count[node] > 0, in_set, go_left)
         child = jnp.where(go_left, tree.left_child[node],
                           tree.right_child[node])
         hit_leaf = active & (child < 0)

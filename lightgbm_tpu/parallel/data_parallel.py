@@ -50,26 +50,38 @@ from .mesh import DATA_AXIS
 
 
 def make_data_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
-                              mesh: Mesh, data_axis: str = DATA_AXIS):
-    """Build `grow(bins_t, gh, feature_mask) -> (TreeArrays, leaf_id)` where
-    `bins_t` [F, R] and `gh` [R, 3] are sharded over `data_axis` on their row
-    dimension; R must be divisible by the axis size (pad upstream with
-    gh rows of zeros). The returned tree is replicated; `leaf_id` is sharded.
+                              mesh: Mesh, data_axis: str = DATA_AXIS,
+                              forced=None):
+    """Build `grow(bins_t, gh, feature_mask, cegb) -> (TreeArrays, leaf_id)`
+    where `bins_t` [F, R] and `gh` [R, 3] are sharded over `data_axis` on
+    their row dimension; R must be divisible by the axis size (pad upstream
+    with gh rows of zeros). The returned tree is replicated; `leaf_id` is
+    sharded. ``feature_mask``/``cegb`` match the serial grower's arguments
+    (replicated); ``forced`` bakes a forced-split prefix like the serial
+    grower (valid here because the histogram pool holds GLOBAL sums).
     """
     grow = make_tree_grower(
         cfg, meta,
         reduce_hist=lambda h, ctx=None: lax.psum(h, data_axis),
-        reduce_sums=lambda s: lax.psum(s, data_axis))
+        reduce_sums=lambda s: lax.psum(s, data_axis),
+        forced=forced)
+
+    def wrapped(bins_t, gh, feature_mask, cegb_const, cegb_count):
+        return grow(bins_t, gh, feature_mask, (cegb_const, cegb_count))
 
     sharded = _make_sharded(
-        grow, mesh,
-        in_specs=(P(None, data_axis), P(data_axis, None), P()),
+        wrapped, mesh,
+        in_specs=(P(None, data_axis), P(data_axis, None), P(), P(), P()),
         out_specs=(P(), P(data_axis)))
 
-    def grow_fn(bins_t, gh, feature_mask: Optional[jnp.ndarray] = None):
+    def grow_fn(bins_t, gh, feature_mask: Optional[jnp.ndarray] = None,
+                cegb=None):
+        F = bins_t.shape[0]
         if feature_mask is None:
-            feature_mask = jnp.ones(bins_t.shape[0], bool)
-        return sharded(bins_t, gh, feature_mask)
+            feature_mask = jnp.ones(F, bool)
+        if cegb is None:
+            cegb = (jnp.zeros(F, jnp.float32), jnp.zeros(F, jnp.float32))
+        return sharded(bins_t, gh, feature_mask, cegb[0], cegb[1])
 
     return grow_fn
 

@@ -116,16 +116,29 @@ def make_feature_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
             fetch_bin_column=fetch_bin_column,
             partition_meta=meta)
 
-    def sharded_grow(bins_t, gh):
+    def sharded_grow(bins_t, gh, feature_mask, cegb_const, cegb_count):
         grow = make_local_grow()
-        return grow(bins_t, gh, None)
+        return grow(bins_t, gh, feature_mask, (cegb_const, cegb_count))
 
+    # feature_mask / cegb are per-feature → sharded over the feature axis
+    # alongside the bins (each device masks/penalizes its own slice);
+    # bynode masks are [2L, F] so the feature dim moves to position 1
+    fm_spec = P(None, feature_axis) if cfg.bynode_mask else P(feature_axis)
     sharded = _make_sharded(
         sharded_grow, mesh,
-        in_specs=(P(feature_axis, None), P(None, None)),
+        in_specs=(P(feature_axis, None), P(None, None), fm_spec,
+                  P(feature_axis), P(feature_axis)),
         out_specs=(P(), P()))
 
-    def grow_fn(bins_t, gh):
-        return sharded(bins_t, gh)
+    def grow_fn(bins_t, gh, feature_mask: Optional[jnp.ndarray] = None,
+                cegb=None):
+        if feature_mask is None:
+            shape = (2 * cfg.num_leaves, F_total) if cfg.bynode_mask \
+                else (F_total,)
+            feature_mask = jnp.ones(shape, bool)
+        if cegb is None:
+            cegb = (jnp.zeros(F_total, jnp.float32),
+                    jnp.zeros(F_total, jnp.float32))
+        return sharded(bins_t, gh, feature_mask, cegb[0], cegb[1])
 
     return grow_fn

@@ -87,14 +87,20 @@ def make_voting_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
         reduce_sums=lambda s: lax.psum(s, data_axis),
         prepare_split_hist=prepare)
 
+    def wrapped(bins_t, gh, feature_mask, cegb_const, cegb_count):
+        return grow(bins_t, gh, feature_mask, (cegb_const, cegb_count))
+
     sharded = _make_sharded(
-        grow, mesh,
-        in_specs=(P(None, data_axis), P(data_axis, None), P()),
+        wrapped, mesh,
+        in_specs=(P(None, data_axis), P(data_axis, None), P(), P(), P()),
         out_specs=(P(), P(data_axis)))
 
-    def grow_fn(bins_t, gh, feature_mask: Optional[jnp.ndarray] = None):
+    def grow_fn(bins_t, gh, feature_mask: Optional[jnp.ndarray] = None,
+                cegb=None):
         if feature_mask is None:
             feature_mask = jnp.ones(bins_t.shape[0], bool)
-        return sharded(bins_t, gh, feature_mask)
+        if cegb is None:
+            cegb = (jnp.zeros(F, jnp.float32), jnp.zeros(F, jnp.float32))
+        return sharded(bins_t, gh, feature_mask, cegb[0], cegb[1])
 
     return grow_fn

@@ -1,0 +1,135 @@
+"""Compact (O(rows_in_leaf)) row scheduling vs the full masked-pass grower.
+
+The compact scheduler (grower.py row_sched="compact") must reproduce the
+full grower split-for-split: same features/thresholds/partitions — the same
+triangle the reference closes between its indexed histogram construction and
+a naive full scan (ref: src/treelearner/serial_tree_learner.cpp:368-386
+smaller-child scheduling, src/io/data_partition.hpp DataPartition).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset_core import BinnedDataset
+from lightgbm_tpu.ops.split import FeatureMeta, SplitHyperParams
+from lightgbm_tpu.core.grower import GrowerConfig, make_tree_grower
+from lightgbm_tpu.core.tree import HostTree
+
+
+def _make_data(rng, n=3000, f=6):
+    X = rng.normal(size=(n, f))
+    X[:, 1] = rng.integers(0, 12, size=n)
+    X[:, 2] = np.where(rng.random(n) < 0.7, 0.0, X[:, 2])
+    X[rng.random(n) < 0.15, 3] = np.nan
+    y = (X[:, 0] * 1.5 + np.sin(X[:, 1]) + np.nan_to_num(X[:, 2]) ** 2 * 0.3
+         + rng.normal(scale=0.1, size=n))
+    return X, y
+
+
+def _grow(ds, gh, num_leaves, hp, row_sched, partition_mode="scatter",
+          min_bucket=256, forced=None, monotone=None):
+    mappers = ds.used_bin_mappers()
+    meta = FeatureMeta.from_mappers(mappers, monotone)
+    B = int(max(m.num_bin for m in mappers))
+    gcfg = GrowerConfig(num_leaves=num_leaves, num_bin=B, hparams=hp,
+                        hist_backend="scatter", block_rows=512,
+                        row_sched=row_sched, hist_dtype="float32", hist_rm_backend="scatter",
+                        partition_mode=partition_mode, min_bucket=min_bucket)
+    grow = jax.jit(make_tree_grower(gcfg, meta, forced=forced))
+    bins = ds.bins if row_sched == "full" else \
+        np.ascontiguousarray(ds.bins.T)
+    tree, leaf_id = grow(jnp.asarray(bins), jnp.asarray(gh))
+    return (HostTree(jax.tree.map(np.asarray, tree), ds.used_feature_map),
+            np.asarray(leaf_id))
+
+
+def _assert_same_tree(a, b, num_leaves):
+    ha, la = a
+    hb, lb = b
+    assert ha.num_leaves == hb.num_leaves
+    np.testing.assert_array_equal(ha.split_feature_inner,
+                                  hb.split_feature_inner)
+    np.testing.assert_array_equal(ha.threshold_bin, hb.threshold_bin)
+    np.testing.assert_array_equal(ha.default_left, hb.default_left)
+    np.testing.assert_array_equal(la, lb)
+    np.testing.assert_allclose(ha.leaf_value[:num_leaves],
+                               hb.leaf_value[:num_leaves], rtol=1e-5)
+
+
+@pytest.mark.parametrize("partition_mode", ["scatter", "sort"])
+def test_compact_matches_full(rng, partition_mode):
+    X, y = _make_data(rng)
+    cfg = Config({"num_leaves": 16, "min_data_in_leaf": 5})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    hp = SplitHyperParams(min_data_in_leaf=5)
+    grad = -(y.astype(np.float32))
+    gh = np.stack([grad, np.ones_like(grad), np.ones_like(grad)], axis=1)
+    full = _grow(ds, gh, 16, hp, "full")
+    comp = _grow(ds, gh, 16, hp, "compact", partition_mode)
+    _assert_same_tree(full, comp, 16)
+
+
+def test_compact_with_bagging_mask(rng):
+    """Bagged-out rows ride along in segments with zero gh; masked counts
+    drive splits while raw counts drive scheduling."""
+    X, y = _make_data(rng, n=4000)
+    cfg = Config({"num_leaves": 12, "min_data_in_leaf": 5})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    hp = SplitHyperParams(min_data_in_leaf=5)
+    grad = -(y.astype(np.float32))
+    m = (rng.random(len(y)) < 0.7).astype(np.float32)
+    gh = np.stack([grad * m, m, m], axis=1)
+    full = _grow(ds, gh, 12, hp, "full")
+    comp = _grow(ds, gh, 12, hp, "compact")
+    _assert_same_tree(full, comp, 12)
+
+
+def test_compact_min_bucket_bigger_than_rows(rng):
+    """Tiny dataset: single bucket covering all rows."""
+    X, y = _make_data(rng, n=300)
+    cfg = Config({"num_leaves": 8, "min_data_in_leaf": 3})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    hp = SplitHyperParams(min_data_in_leaf=3)
+    grad = -(y.astype(np.float32))
+    gh = np.stack([grad, np.ones_like(grad), np.ones_like(grad)], axis=1)
+    full = _grow(ds, gh, 8, hp, "full")
+    comp = _grow(ds, gh, 8, hp, "compact", min_bucket=4096)
+    _assert_same_tree(full, comp, 8)
+
+
+def test_compact_forced_splits(rng):
+    X, y = _make_data(rng)
+    cfg = Config({"num_leaves": 8, "min_data_in_leaf": 5})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    hp = SplitHyperParams(min_data_in_leaf=5)
+    grad = -(y.astype(np.float32))
+    gh = np.stack([grad, np.ones_like(grad), np.ones_like(grad)], axis=1)
+    L = 8
+    active = np.zeros(L - 1, bool)
+    slot = np.zeros(L - 1, np.int32)
+    feat = np.zeros(L - 1, np.int32)
+    thr = np.zeros(L - 1, np.int32)
+    active[0], slot[0], feat[0], thr[0] = True, 0, 1, 3
+    active[1], slot[1], feat[1], thr[1] = True, 1, 0, 10
+    forced = (active, slot, feat, thr)
+    full = _grow(ds, gh, L, hp, "full", forced=forced)
+    comp = _grow(ds, gh, L, hp, "compact", forced=forced)
+    _assert_same_tree(full, comp, L)
+    assert full[0].split_feature_inner[0] == 1
+
+
+def test_compact_monotone(rng):
+    X, y = _make_data(rng)
+    cfg = Config({"num_leaves": 12, "min_data_in_leaf": 5})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    hp = SplitHyperParams(min_data_in_leaf=5)
+    grad = -(y.astype(np.float32))
+    gh = np.stack([grad, np.ones_like(grad), np.ones_like(grad)], axis=1)
+    mono = np.zeros(ds.num_used_features, np.int32)
+    mono[0] = 1
+    full = _grow(ds, gh, 12, hp, "full", monotone=mono)
+    comp = _grow(ds, gh, 12, hp, "compact", monotone=mono)
+    _assert_same_tree(full, comp, 12)

@@ -1,0 +1,105 @@
+"""End-to-end distributed boosting through the public train() API.
+
+Mirrors the reference's distributed test triangle
+(ref: tests/distributed/_test_distributed.py DistributedMockup — N workers
+on localhost, distributed model ≈ centralized accuracy & predict parity):
+here the "workers" are the 8 virtual CPU devices of the test mesh and
+tree_learner=data/voting/feature routes through the sharded growers under
+the FULL boosting loop (bagging, multiclass, ranking, eval).
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _binary_data(rng, n=3001, f=10):
+    X = rng.normal(size=(n, f))
+    logit = X[:, 0] * 2 - X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+    y = (logit + rng.normal(scale=0.5, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+def _train(X, y, params, extra=None, rounds=15, **ds_kw):
+    p = {"verbose": -1, "num_leaves": 15, "min_data_in_leaf": 5,
+         "seed": 7, "deterministic": True}
+    p.update(params)
+    if extra:
+        p.update(extra)
+    ds = lgb.Dataset(X, label=y, **ds_kw)
+    return lgb.train(p, ds, num_boost_round=rounds)
+
+
+@pytest.mark.parametrize("tl", ["data", "voting", "feature"])
+def test_distributed_binary_parity(rng, tl):
+    X, y = _binary_data(rng)
+    serial = _train(X, y, {"objective": "binary"})
+    dist = _train(X, y, {"objective": "binary", "tree_learner": tl,
+                         "top_k": 4})
+    ps = serial.predict(X)
+    pd_ = dist.predict(X)
+    acc_s = np.mean((ps > 0.5) == y)
+    acc_d = np.mean((pd_ > 0.5) == y)
+    # distributed ≈ centralized accuracy (exact tree parity is not
+    # guaranteed across different f32 reduction orders; voting is lossy
+    # by design)
+    assert acc_d > acc_s - 0.03, (acc_s, acc_d)
+    if tl == "data":
+        # data-parallel finds the same splits up to f32 reduction order
+        np.testing.assert_allclose(ps, pd_, atol=5e-2)
+
+
+def test_distributed_multiclass(rng):
+    n = 2005
+    X = rng.normal(size=(n, 8))
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)
+    serial = _train(X, y, {"objective": "multiclass", "num_class": 3})
+    dist = _train(X, y, {"objective": "multiclass", "num_class": 3,
+                         "tree_learner": "data"})
+    ps = serial.predict(X)
+    pd_ = dist.predict(X)
+    acc_s = np.mean(ps.argmax(1) == y)
+    acc_d = np.mean(pd_.argmax(1) == y)
+    assert acc_d > acc_s - 0.03, (acc_s, acc_d)
+
+
+def test_distributed_lambdarank(rng):
+    n_query, per_q = 80, 25
+    n = n_query * per_q
+    X = rng.normal(size=(n, 6))
+    rel = (X[:, 0] + 0.5 * rng.normal(size=n))
+    y = np.clip(np.digitize(rel, [-0.5, 0.5, 1.5]), 0, 3).astype(np.float64)
+    group = np.full(n_query, per_q)
+    serial = _train(X, y, {"objective": "lambdarank", "metric": "ndcg",
+                           "ndcg_eval_at": [5]}, group=group)
+    dist = _train(X, y, {"objective": "lambdarank", "metric": "ndcg",
+                         "ndcg_eval_at": [5], "tree_learner": "data"},
+                  group=group)
+    ps = serial.predict(X)
+    pd_ = dist.predict(X)
+
+    def ndcg5(score):
+        tot = 0.0
+        for q in range(n_query):
+            s = slice(q * per_q, (q + 1) * per_q)
+            order = np.argsort(-score[s])
+            gains = (2.0 ** y[s][order][:5] - 1) / np.log2(
+                np.arange(2, 7))
+            ideal = (2.0 ** np.sort(y[s])[::-1][:5] - 1) / np.log2(
+                np.arange(2, 7))
+            tot += gains.sum() / max(ideal.sum(), 1e-12)
+        return tot / n_query
+
+    assert ndcg5(pd_) > ndcg5(ps) - 0.03, (ndcg5(ps), ndcg5(pd_))
+
+
+def test_distributed_bagging_goss(rng):
+    X, y = _binary_data(rng, n=2531)
+    dist = _train(X, y, {"objective": "binary", "tree_learner": "data",
+                         "bagging_fraction": 0.6, "bagging_freq": 1})
+    acc = np.mean((dist.predict(X) > 0.5) == y)
+    assert acc > 0.8
+    goss = _train(X, y, {"objective": "binary", "tree_learner": "voting",
+                         "data_sample_strategy": "goss", "top_k": 4})
+    acc_g = np.mean((goss.predict(X) > 0.5) == y)
+    assert acc_g > 0.8

@@ -1,0 +1,93 @@
+"""Linear trees (linear_tree=true).
+
+Ref: src/treelearner/linear_tree_learner.{h,cpp} — per-leaf ridge fit
+coeffs = -(X'HX + lambda*I)^-1 X'g over the leaf's path features
+(arXiv:1802.05640 Eq 3), NaN rows fall back to the leaf constant, model
+text carries leaf_const/num_features/leaf_features/leaf_coeff.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _pw_linear(rng, n=4000, f=5):
+    X = rng.normal(size=(n, f))
+    y = np.where(X[:, 0] > 0, 2.0 * X[:, 1] + 1, -1.5 * X[:, 2]) \
+        + rng.normal(scale=0.1, size=n)
+    return X, y
+
+
+def test_linear_beats_constant_on_piecewise_linear(rng):
+    X, y = _pw_linear(rng)
+    params = {"objective": "regression", "num_leaves": 8, "verbose": -1,
+              "learning_rate": 0.2}
+    const = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=20)
+    lin = lgb.train({**params, "linear_tree": True},
+                    lgb.Dataset(X, label=y), num_boost_round=20)
+    mse_c = np.mean((const.predict(X) - y) ** 2)
+    mse_l = np.mean((lin.predict(X) - y) ** 2)
+    assert mse_l < mse_c * 0.6, (mse_c, mse_l)
+
+
+def test_linear_model_roundtrip(rng):
+    X, y = _pw_linear(rng, n=2000)
+    lin = lgb.train({"objective": "regression", "num_leaves": 6,
+                     "verbose": -1, "linear_tree": True},
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    s = lin.model_to_string()
+    assert "leaf_coeff=" in s and "is_linear=1" in s
+    p1 = lin.predict(X)
+    p2 = lgb.Booster(model_str=s).predict(X)
+    np.testing.assert_allclose(p1, p2, rtol=1e-6, atol=1e-8)
+
+
+def test_linear_nan_rows_fall_back_to_const(rng):
+    X, y = _pw_linear(rng, n=2500)
+    lin = lgb.train({"objective": "regression", "num_leaves": 6,
+                     "verbose": -1, "linear_tree": True},
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    Xn = X[:50].copy()
+    Xn[:, 1] = np.nan
+    p = lin.predict(Xn)
+    assert np.isfinite(p).all()
+
+
+def test_linear_train_serve_consistency(rng):
+    X, y = _pw_linear(rng, n=3000)
+    lin = lgb.train({"objective": "regression", "num_leaves": 8,
+                     "verbose": -1, "linear_tree": True},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    ts = lin.predict(X, raw_score=True)
+    np.testing.assert_allclose(ts, np.asarray(lin._engine.score[0]),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_linear_valid_set_and_early_stopping(rng):
+    X, y = _pw_linear(rng, n=3000)
+    Xv, yv = _pw_linear(rng, n=800)
+    rec = {}
+    lgb.train({"objective": "regression", "num_leaves": 6, "verbose": -1,
+               "linear_tree": True, "metric": "l2"},
+              lgb.Dataset(X, label=y), num_boost_round=10,
+              valid_sets=[lgb.Dataset(Xv, label=yv)],
+              valid_names=["v"],
+              callbacks=[lgb.record_evaluation(rec)])
+    l2s = rec["v"]["l2"]
+    assert l2s[-1] < l2s[0] * 0.7  # valid scores track the LINEAR model
+
+
+def test_linear_cv_subset(rng):
+    X, y = _pw_linear(rng, n=1200)
+    out = lgb.cv({"objective": "regression", "num_leaves": 6,
+                  "verbose": -1, "linear_tree": True, "metric": "l2"},
+                 lgb.Dataset(X, label=y), num_boost_round=5, nfold=3)
+    assert len(out["valid l2-mean"]) == 5
+
+
+def test_linear_l1_objective_rejected(rng):
+    X, y = _pw_linear(rng, n=500)
+    with pytest.raises(Exception):
+        lgb.train({"objective": "regression_l1", "verbose": -1,
+                   "linear_tree": True},
+                  lgb.Dataset(X, label=y), num_boost_round=2)
