@@ -16,9 +16,31 @@ Prints ONE JSON line:
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
+
+# Watchdog: if the device/tunnel wedges (or compile stalls pathologically),
+# emit an honest zero-result line instead of hanging the driver forever.
+BENCH_WATCHDOG_SEC = int(os.environ.get("BENCH_WATCHDOG_SEC", 3000))
+
+
+def _arm_watchdog():
+    def fire():
+        print(json.dumps({
+            "metric": "higgs_synth_iters_per_sec",
+            "value": 0.0,
+            "unit": "iters/sec",
+            "vs_baseline": 0.0,
+            "note": f"watchdog: no result within {BENCH_WATCHDOG_SEC}s "
+                    "(device unavailable or compile stalled)",
+        }), flush=True)
+        os._exit(3)
+    t = threading.Timer(BENCH_WATCHDOG_SEC, fire)
+    t.daemon = True
+    t.start()
+    return t
 
 N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
 N_FEATURES = 28
@@ -40,6 +62,7 @@ def synth_higgs(n, f, seed=0):
 
 
 def main():
+    watchdog = _arm_watchdog()
     import lightgbm_tpu as lgb
 
     X, y = synth_higgs(N_ROWS, N_FEATURES)
@@ -67,6 +90,7 @@ def main():
     dt = time.perf_counter() - t0
 
     ips = TIMED_ITERS / dt
+    watchdog.cancel()
     if global_timer.enabled:
         print(global_timer.table(), file=sys.stderr)
     ref_ips_at_n = REF_HIGGS_IPS * (REF_HIGGS_ROWS / N_ROWS)

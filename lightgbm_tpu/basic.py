@@ -514,11 +514,44 @@ class Booster:
             from .core.shap import predict_contrib
             return predict_contrib(eng, X, start_iteration, end_iteration)
 
+        # prediction early stopping (ref: src/boosting/
+        # prediction_early_stop.cpp + gbdt_prediction.cpp:16 PredictRaw):
+        # every `freq` iterations rows whose margin clears the threshold
+        # stop accumulating further trees. binary margin = 2|p|;
+        # multiclass margin = top1 - top2.
+        es = bool(kwargs.get("pred_early_stop",
+                             self.params.get("pred_early_stop", False)))
+        es_freq = int(kwargs.get("pred_early_stop_freq",
+                                 self.params.get("pred_early_stop_freq", 10)))
+        es_margin = float(kwargs.get(
+            "pred_early_stop_margin",
+            self.params.get("pred_early_stop_margin", 10.0)))
+        obj_name = getattr(eng.objective, "NAME", "") if eng.objective \
+            else ""
+        es = es and not raw_score and (K > 1 or obj_name == "binary")
+
         raw = np.zeros((X.shape[0], K), dtype=np.float64)
+        active = np.ones(X.shape[0], bool) if es else None
+        Xa = X
+        rounds_since_check = 0
         for it in range(start_iteration, end_iteration):
             for k in range(K):
                 t = eng.models[it * K + k]
-                raw[:, k] += t.predict(X)
+                if active is None:
+                    raw[:, k] += t.predict(X)
+                elif len(Xa):
+                    raw[active, k] += t.predict(Xa)
+            if active is not None:
+                rounds_since_check += 1
+                if rounds_since_check == es_freq:
+                    rounds_since_check = 0
+                    if K > 1:
+                        part = np.partition(raw, K - 2, axis=1)
+                        margin = part[:, K - 1] - part[:, K - 2]
+                    else:
+                        margin = 2.0 * np.abs(raw[:, 0])
+                    active &= margin <= es_margin
+                    Xa = X[active]
         if getattr(eng, "average_output", False) and end_iteration > 0:
             raw /= (end_iteration - start_iteration)
         if not raw_score and eng.objective is not None:

@@ -140,6 +140,7 @@ _reg("bin_construct_sample_cnt", int, 200000, ("subsample_for_bin",),
 _reg("data_random_seed", int, 1, ("data_seed",))
 _reg("is_enable_sparse", bool, True, ("is_sparse", "enable_sparse", "sparse"))
 _reg("enable_bundle", bool, True, ("is_enable_bundle", "bundle"))
+_reg("max_conflict_rate", float, 0.0, (), (0.0, 1.0, True, False))
 _reg("use_missing", bool, True, ())
 _reg("zero_as_missing", bool, False, ())
 _reg("feature_pre_filter", bool, True, ())
@@ -365,8 +366,6 @@ def _parse_list(value: Any, elem_type: Any) -> List[Any]:
 # the setting would require an unimplemented feature. Entries are removed as
 # the features land.
 _UNIMPLEMENTED_WHEN = {
-    "enable_bundle": lambda v: bool(v),   # EFB not implemented; default True
-                                          # behaves as no-bundling
     "tpu_donate_state": lambda v: True,
 }
 

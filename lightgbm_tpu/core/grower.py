@@ -61,6 +61,14 @@ class GrowerConfig:
     partition_mode: str = "scatter"
     # smallest pow2 segment bucket (smaller leaves pad up to this)
     min_bucket: int = 2048
+    # histogram memory policy: "full" keeps the [L, F, B, 3] per-leaf pool
+    # (sibling subtraction, fastest); "none" keeps NO pool and computes
+    # both children's histograms per split from their gathered rows —
+    # O(F*B) memory so wide data (Allstate-class F) fits HBM. The XLA
+    # answer to the reference's LRU HistogramPool recompute-on-miss
+    # (ref: feature_histogram.hpp:1368, serial_tree_learner.cpp:144-165).
+    # Requires row_sched="compact"; forced splits need the pool.
+    hist_pool: str = "full"
     # quantized-gradient training (ref: gradient_discretizer.{hpp,cpp},
     # config use_quantized_grad): int8 grad/hess with stochastic rounding,
     # EXACT int32 histogram accumulation on the MXU — deterministic sums
@@ -159,7 +167,8 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                      prepare_split_hist: Optional[Callable] = None,
                      select_best: Optional[Callable] = None,
                      fetch_bin_column: Optional[Callable] = None,
-                     partition_meta: Optional[FeatureMeta] = None):
+                     partition_meta: Optional[FeatureMeta] = None,
+                     bundle=None):
     """Build the tree-growing function for a fixed dataset geometry.
 
     Returns ``grow(bins_t, gh, feature_mask, cegb) -> (TreeArrays, leaf_id)``
@@ -221,6 +230,49 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     hist_dtype = jnp.int32 if quantized else jnp.float32
     has_cat = meta_has_categorical(meta)
     MAXK = min(hp.max_cat_threshold, B) if has_cat else 0
+    pool_none = cfg.hist_pool == "none"
+    if pool_none and not compact:
+        raise ValueError("hist_pool='none' requires row_sched='compact'")
+    if pool_none and forced is not None:
+        raise ValueError("forced splits need the histogram pool; use "
+                         "hist_pool='full'")
+
+    # EFB (ref: dataset.cpp FindGroups/FastFeatureBundling + FixHistogram):
+    # histograms are built over PHYSICAL bundled columns and expanded to
+    # logical features at scan time; the default bin is reconstructed from
+    # the leaf totals.
+    bundled = bundle is not None
+    if bundled:
+        if distributed:
+            raise ValueError("EFB bundling does not compose with "
+                             "distributed learner hooks yet")
+        b_gmap = jnp.asarray(bundle["gather_map"], jnp.int32)     # [F, B]
+        b_group = jnp.asarray(bundle["group"], jnp.int32)         # [F]
+        b_offset = jnp.asarray(bundle["offset"], jnp.int32)       # [F]
+        b_default = jnp.asarray(bundle["default_bin"], jnp.int32)  # [F]
+        b_nbin = jnp.asarray(bundle["num_bin"], jnp.int32)        # [F]
+
+        def expand_hist(hist_g, sg, sh, cnt):
+            """[G, B, 3] group hist -> [F, B, 3] logical hist; the default
+            bin's row = leaf totals - sum(stored bins) (FixHistogram)."""
+            flat = hist_g.reshape(-1, hist_g.shape[-1])
+            h = jnp.where(b_gmap[..., None] >= 0,
+                          flat[jnp.maximum(b_gmap, 0)], 0.0)
+            totals = jnp.stack([sg, sh, cnt])
+            rest = h.sum(axis=1)                                  # [F, 3]
+            dmask = (jnp.arange(h.shape[1])[None, :] ==
+                     b_default[:, None])
+            return h + dmask[..., None] * (totals[None, None, :] -
+                                           rest[:, None, :])
+
+        def decode_bin(col_phys, f):
+            """Physical group column -> logical bin of feature f."""
+            off = b_offset[f]
+            nb = b_nbin[f]
+            d = b_default[f]
+            rel = col_phys - off
+            act = (rel >= 0) & (rel < nb - 1)
+            return jnp.where(act, rel + (rel >= d), d)
     if reduce_hist is None:
         reduce_hist = lambda h, ctx=None: h
     if reduce_sums is None:
@@ -265,11 +317,14 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
              rng_key: Optional[jnp.ndarray] = None
              ) -> Tuple[TreeArrays, jnp.ndarray]:
         # full mode takes feature-major [F, R] bins; compact mode takes
-        # ROW-major [R, F] (the gather-friendly layout)
+        # ROW-major [R, F] (the gather-friendly layout). With EFB the
+        # stored columns are PHYSICAL bundles (Fp) while masks/paths/the
+        # split scan stay per LOGICAL feature (F).
         if compact:
-            R, F = bins_t.shape
+            R, Fp = bins_t.shape
         else:
-            F, R = bins_t.shape
+            Fp, R = bins_t.shape
+        F = int(meta.num_bin.shape[0]) if bundled else Fp
 
         if quantized:
             # ref: GradientDiscretizer::DiscretizeGradients
@@ -300,7 +355,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         if compact:
             sizes = _bucket_sizes(R, cfg.min_bucket)
             sizes_arr = jnp.asarray(sizes, jnp.int32)
-            flat_ok = R * F < 2 ** 31
+            flat_ok = R * Fp < 2 ** 31
             bins_flat = bins_t.reshape(-1) if flat_ok else None
 
             def bucket_branch(n):
@@ -315,11 +370,14 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                     start_c = jnp.clip(start, 0, max(R - P, 0))
                     delta = start - start_c
                     seg = lax.dynamic_slice(order, (start_c,), (P,))
+                    col_idx = b_group[f] if bundled else f
                     if flat_ok:
-                        col = bins_flat[seg * F + f].astype(jnp.int32)
+                        col = bins_flat[seg * Fp + col_idx].astype(jnp.int32)
                     else:
-                        col = jnp.take(jnp.take(bins_t, seg, axis=0), f,
-                                       axis=1).astype(jnp.int32)
+                        col = jnp.take(jnp.take(bins_t, seg, axis=0),
+                                       col_idx, axis=1).astype(jnp.int32)
+                    if bundled:
+                        col = decode_bin(col, f)
                     go_left = _go_left_bins(
                         col, thr, dl, f, pmeta,
                         ncat if has_cat else None,
@@ -409,12 +467,17 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                                     (root_g, root_h, root_c, root_out))
         inf = jnp.float32(jnp.inf)
         root_path = jnp.zeros(F, bool)
-        best_root = best_of(conv(hist_root), root_g, root_h, root_c,
+        hist_root_l = conv(hist_root)
+        if bundled:
+            hist_root_l = expand_hist(hist_root_l, root_g, root_h, root_c)
+        best_root = best_of(hist_root_l, root_g, root_h, root_c,
                             root_out, node_mask(0, root_path),
                             leaf_range=(-inf, inf),
                             leaf_depth=jnp.int32(0), cegb=cegb)
 
-        hist_pool = jnp.zeros((L, F, B, 3), hist_dtype).at[0].set(hist_root)
+        hist_pool = (None if pool_none else
+                     jnp.zeros((L, Fp, B, 3), hist_dtype).at[0].set(
+                         hist_root))
         zf = jnp.zeros(L, jnp.float32)
         zi = jnp.zeros(L, jnp.int32)
         best0 = SplitRecord.invalid((L,), max_cat=MAXK)
@@ -462,8 +525,13 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 # (ref: serial_tree_learner.cpp ForceSplits + abort path)
                 want_forced = forced_active[i] & state.forced_ok
                 slot_i = forced_slot[i]
+                fhist = conv(state.hist[slot_i])
+                if bundled:
+                    fhist = expand_hist(fhist, state.sum_g[slot_i],
+                                        state.sum_h[slot_i],
+                                        state.count[slot_i])
                 frec = forced_split_record(
-                    conv(state.hist[slot_i]), forced_feat[i], forced_thr[i],
+                    fhist, forced_feat[i], forced_thr[i],
                     state.sum_g[slot_i], state.sum_h[slot_i],
                     state.count[slot_i], state.value[slot_i], meta, hp)
                 if has_cat:  # forced splits are numerical-only
@@ -534,7 +602,12 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 # from the final segments after the loop
                 leaf_id = state.leaf_id
             else:
-                bin_col = fetch_bin_column(bins_t, rec.feature)
+                if bundled:
+                    fsafe = jnp.maximum(rec.feature, 0)
+                    bin_col = decode_bin(
+                        fetch_bin_column(bins_t, b_group[fsafe]), fsafe)
+                else:
+                    bin_col = fetch_bin_column(bins_t, rec.feature)
                 go_left = _go_left_bins(
                     bin_col, rec.threshold, rec.default_left, rec.feature,
                     pmeta, rec.num_cat if has_cat else None,
@@ -563,33 +636,58 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             # ---- children histograms: smaller pass + subtraction -----------
             # (ref: serial_tree_learner.cpp:368-386 + FeatureHistogram::Subtract)
             if compact:
-                # partition the leaf's segment, then one O(rows_in_smaller)
-                # gathered pass; the switch picks the static pow2 bucket
+                # partition the leaf's segment, then gathered hist passes;
+                # the switch picks the static pow2 bucket. With the pool,
+                # one O(rows_in_smaller) pass + sibling subtraction; pool
+                # "none" gathers BOTH children (O(rows_in_parent) work,
+                # O(F*B) memory).
                 start_l = state.leaf_start[l]
                 rows_l = state.leaf_rows[l]
 
-                def do_part_hist():
+                def do_partition():
                     pb = bucket_branch(rows_l)
                     ncat_a = rec.num_cat if has_cat else jnp.int32(0)
                     cbins_a = rec.cat_bins if has_cat else \
                         jnp.full((1,), -1, jnp.int32)
-                    order2, nL = lax.switch(
+                    return lax.switch(
                         pb, part_branches, state.order, start_l, rows_l,
                         rec.feature, rec.threshold, rec.default_left,
                         ncat_a, cbins_a)
-                    nR = rows_l - nL
-                    lsm = nL <= nR       # smaller child by RAW rows
-                    s_start = start_l + jnp.where(lsm, 0, nL)
-                    s_rows = jnp.minimum(nL, nR)
-                    sb = bucket_branch(s_rows)
-                    h = lax.switch(sb, hist_branches, order2, s_start,
-                                   s_rows, gh)
-                    return order2, nL, lsm, h
 
-                order, nL_raw, left_smaller, hist_small = lax.cond(
-                    proceed, do_part_hist,
-                    lambda: (state.order, jnp.int32(0), jnp.asarray(True),
-                             jnp.zeros((F, B, 3), hist_dtype)))
+                if pool_none:
+                    def do_part_hist2():
+                        order2, nL = do_partition()
+                        nR = rows_l - nL
+                        hl = lax.switch(bucket_branch(nL), hist_branches,
+                                        order2, start_l, nL, gh)
+                        hr = lax.switch(bucket_branch(nR), hist_branches,
+                                        order2, start_l + nL, nR, gh)
+                        return order2, nL, hl, hr
+
+                    order, nL_raw, hist_left_c, hist_right_c = lax.cond(
+                        proceed, do_part_hist2,
+                        lambda: (state.order, jnp.int32(0),
+                                 jnp.zeros((Fp, B, 3), hist_dtype),
+                                 jnp.zeros((Fp, B, 3), hist_dtype)))
+                    left_smaller = jnp.asarray(True)  # unused downstream
+                    hist_small = None
+                else:
+                    def do_part_hist():
+                        order2, nL = do_partition()
+                        nR = rows_l - nL
+                        lsm = nL <= nR   # smaller child by RAW rows
+                        s_start = start_l + jnp.where(lsm, 0, nL)
+                        s_rows = jnp.minimum(nL, nR)
+                        sb = bucket_branch(s_rows)
+                        h = lax.switch(sb, hist_branches, order2, s_start,
+                                       s_rows, gh)
+                        return order2, nL, lsm, h
+
+                    order, nL_raw, left_smaller, hist_small = lax.cond(
+                        proceed, do_part_hist,
+                        lambda: (state.order, jnp.int32(0),
+                                 jnp.asarray(True),
+                                 jnp.zeros((Fp, B, 3), hist_dtype)))
                 leaf_start = _set(state.leaf_start, new_leaf,
                                   start_l + nL_raw, proceed)
                 leaf_rows = _set(_set(state.leaf_rows, l, nL_raw, proceed),
@@ -617,15 +715,19 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                         proceed,
                         lambda: leaf_hist(bins_t, gh, leaf_id, small_leaf,
                                           small_ctx),
-                        lambda: jnp.zeros((F, B, 3), hist_dtype))
-            hist_parent = state.hist[l]
-            hist_large = hist_parent - hist_small
-            hist_left = jnp.where(left_smaller, hist_small, hist_large)
-            hist_right = jnp.where(left_smaller, hist_large, hist_small)
-            hist = state.hist.at[l].set(
-                jnp.where(proceed, hist_left, state.hist[l]))
-            hist = hist.at[new_leaf].set(
-                jnp.where(proceed, hist_right, hist[new_leaf]))
+                        lambda: jnp.zeros((Fp, B, 3), hist_dtype))
+            if pool_none:
+                hist_left, hist_right = hist_left_c, hist_right_c
+                hist = None
+            else:
+                hist_parent = state.hist[l]
+                hist_large = hist_parent - hist_small
+                hist_left = jnp.where(left_smaller, hist_small, hist_large)
+                hist_right = jnp.where(left_smaller, hist_large, hist_small)
+                hist = state.hist.at[l].set(
+                    jnp.where(proceed, hist_left, state.hist[l]))
+                hist = hist.at[new_leaf].set(
+                    jnp.where(proceed, hist_right, hist[new_leaf]))
 
             # ---- monotone constraint propagation ---------------------------
             # (ref: monotone_constraints.hpp:488-504 BasicLeafConstraints::
@@ -667,10 +769,12 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             # 2i+2 — siblings decorrelated, like ColSampler bynode)
             fm_l = node_mask(2 * i + 1, child_path)
             fm_r = node_mask(2 * i + 2, child_path)
-            hists2 = conv(jnp.stack([hist_left, hist_right]))
             sg2 = jnp.stack([rec.left_sum_gradient, rec.right_sum_gradient])
             sh2 = jnp.stack([rec.left_sum_hessian, rec.right_sum_hessian])
             cn2 = jnp.stack([rec.left_count, rec.right_count])
+            hists2 = conv(jnp.stack([hist_left, hist_right]))
+            if bundled:
+                hists2 = jax.vmap(expand_hist)(hists2, sg2, sh2, cn2)
             ou2 = jnp.stack([rec.left_output, rec.right_output])
             mn2 = jnp.stack([l_min, r_min])
             mx2 = jnp.stack([l_max, r_max])

@@ -742,6 +742,9 @@ class LambdarankNDCG(_RankingObjective):
         lg = list(config.label_gain)
         self.label_gain = (np.asarray(lg, np.float64) if lg
                            else default_label_gain())
+        self._bias_reg = float(config.lambdarank_position_bias_regularization)
+        self._bias_lr = float(config.learning_rate)
+        self.positions = None
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
@@ -760,10 +763,38 @@ class LambdarankNDCG(_RankingObjective):
         self._inv_max_dcg = jnp.asarray(inv, jnp.float32)
         self._gain_q = jnp.asarray(
             self.label_gain[np.asarray(self._label_q, np.int64)], jnp.float32)
+        # position bias (ref: rank_objective.hpp:44-57 positions_/pos_biases_)
+        if metadata.position is not None:
+            self.positions = metadata.position.astype(np.int64)
+            self.num_position_ids = int(self.positions.max()) + 1
+            self.pos_biases = np.zeros(self.num_position_ids, np.float64)
+            self._positions_dev = jnp.asarray(self.positions, jnp.int32)
+            log.info(f"Using position bias correction with "
+                     f"{self.num_position_ids} position ids")
 
-    def get_gradients(self, score):
+    @property
+    def uses_position_bias(self) -> bool:
+        return self.positions is not None
+
+    def update_position_bias(self, lambdas: np.ndarray,
+                             hessians: np.ndarray) -> None:
+        """Newton-Raphson update of per-position bias factors
+        (ref: rank_objective.hpp:303 UpdatePositionBiasFactors)."""
+        n = self.num_position_ids
+        first = -np.bincount(self.positions, weights=lambdas, minlength=n)
+        second = -np.bincount(self.positions, weights=hessians, minlength=n)
+        counts = np.bincount(self.positions, minlength=n)
+        first -= self.pos_biases * self._bias_reg * counts
+        second -= self._bias_reg * counts
+        self.pos_biases += self._bias_lr * first / (np.abs(second) + 0.001)
+
+    def get_gradients(self, score, pos_biases=None):
         """Padded all-pairs lambdas (ref: rank_objective.hpp:181
-        GetGradientsForOneQuery, exact sigmoid instead of the lookup table)."""
+        GetGradientsForOneQuery, exact sigmoid instead of the lookup table).
+        ``pos_biases`` (f32 [num_position_ids]) adjusts scores before the
+        pairwise computation (ref: rank_objective.hpp:69-74)."""
+        if pos_biases is not None and self.positions is not None:
+            score = score + pos_biases[self._positions_dev]
         Q, M = self._qidx.shape
         s = jnp.where(self._qvalid, score[self._qidx], -jnp.inf)  # [Q, M]
         lbl = self._label_q

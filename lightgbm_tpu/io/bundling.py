@@ -1,0 +1,168 @@
+"""Exclusive Feature Bundling (EFB).
+
+TPU-native equivalent of the reference's feature bundling
+(ref: src/io/dataset.cpp:112 FindGroups greedy graph coloring, :251
+FastFeatureBundling; include/LightGBM/feature_group.h FeatureGroup;
+docs/Features.rst "Optimization in Network Communication" EFB section).
+
+Sparse/one-hot features that are rarely non-default simultaneously share
+one physical packed column:
+
+- each bundle (group) has bin 0 = "every member at its default bin" and a
+  contiguous non-default bin range per member feature;
+- histograms are built per GROUP ([G, B, 3] — the compression), then
+  expanded to per-LOGICAL-feature histograms at split-scan time via a
+  static gather map; the default bin's row is reconstructed as
+  leaf_totals - sum(other bins) (ref: Dataset::FixHistogram,
+  include/LightGBM/dataset.h:778);
+- conflicts (rows active in >1 member) are capped by max_conflict_rate and
+  lose the overwritten feature's value into its default bin — the
+  reference's accepted EFB approximation.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class BundleInfo:
+    """Static packing description (host numpy; device copies in grower)."""
+    # per logical feature
+    group: np.ndarray        # i32 [F] physical group index
+    offset: np.ndarray       # i32 [F] start of f's non-default range
+    default_bin: np.ndarray  # i32 [F] the bin NOT stored physically
+    num_bin: np.ndarray      # i32 [F] logical bin count
+    # per group
+    group_num_bin: np.ndarray  # i32 [G]
+    num_groups: int = 0
+    # gather map [F, B]: flat index into [G*B] group-hist rows, -1 where
+    # the logical bin is the default bin (reconstructed) or out of range
+    gather_map: Optional[np.ndarray] = None
+
+    def build_gather_map(self, B: int) -> None:
+        F = len(self.group)
+        gmap = np.full((F, B), -1, np.int64)
+        for f in range(F):
+            g, off, d, nb = (int(self.group[f]), int(self.offset[f]),
+                             int(self.default_bin[f]), int(self.num_bin[f]))
+            pos = off
+            for b in range(nb):
+                if b == d:
+                    continue
+                gmap[f, b] = g * B + pos
+                pos += 1
+        self.gather_map = gmap
+
+
+def most_frequent_bins(bins: np.ndarray, num_bins: np.ndarray,
+                       sample: int = 100_000) -> np.ndarray:
+    """Per-feature most frequent bin over a row sample (ref: BinMapper
+    GetMostFreqBin — the EFB 'default' that is not stored physically)."""
+    F, R = bins.shape
+    step = max(1, R // sample)
+    sub = bins[:, ::step]
+    out = np.zeros(F, np.int32)
+    for f in range(F):
+        out[f] = np.bincount(sub[f], minlength=int(num_bins[f])).argmax()
+    return out
+
+
+def find_bundles(bins: np.ndarray, num_bins: np.ndarray,
+                 max_conflict_rate: float = 0.0,
+                 max_group_bins: int = 256,
+                 sample: int = 50_000) -> Optional[BundleInfo]:
+    """Greedy conflict-bounded grouping (ref: Dataset::FindGroups).
+
+    Returns None when bundling would not reduce the physical feature count.
+    """
+    F, R = bins.shape
+    dflt = most_frequent_bins(bins, num_bins)
+    step = max(1, R // sample)
+    active = bins[:, ::step] != dflt[:, None]        # bool [F, S]
+    S = active.shape[1]
+    budget = int(max_conflict_rate * S)
+    active_frac = active.mean(axis=1)
+    # only SPARSE features can bundle (a feature active on most rows
+    # conflicts with everything) — the reference likewise only considers
+    # sparse features for bundling; dense ones go straight to their own
+    # group, avoiding an O(F^2) search on dense data
+    sparse_cutoff = 0.5
+    is_sparse = active_frac <= sparse_cutoff
+    # sparse features with many active rows first (hardest to place — same
+    # motivation as the reference's ordering by non-zero counts)
+    order = np.argsort(-active.sum(axis=1), kind="stable")
+
+    group_masks: List[np.ndarray] = []
+    group_bins: List[int] = []
+    group_feats: List[List[int]] = []
+    conflicts: List[int] = []
+    solo_feats: List[int] = []
+    for f in order:
+        if not is_sparse[f]:
+            solo_feats.append(int(f))
+            continue
+        nb_extra = int(num_bins[f]) - 1
+        placed = False
+        for g in range(len(group_masks)):
+            if group_bins[g] + nb_extra >= max_group_bins:
+                continue
+            c = int(np.count_nonzero(group_masks[g] & active[f]))
+            if conflicts[g] + c <= budget:
+                group_masks[g] |= active[f]
+                group_bins[g] += nb_extra
+                group_feats[g].append(int(f))
+                conflicts[g] += c
+                placed = True
+                break
+        if not placed:
+            group_masks.append(active[f].copy())
+            group_bins.append(1 + nb_extra)
+            group_feats.append([int(f)])
+            conflicts.append(0)
+    for f in solo_feats:
+        group_feats.append([f])
+        group_bins.append(int(num_bins[f]))
+
+    G = len(group_feats)
+    if G >= F:  # no compression
+        return None
+
+    info = BundleInfo(
+        group=np.zeros(F, np.int32),
+        offset=np.zeros(F, np.int32),
+        default_bin=dflt.astype(np.int32),
+        num_bin=np.asarray(num_bins, np.int32),
+        group_num_bin=np.asarray(group_bins, np.int32),
+        num_groups=G,
+    )
+    for g, feats in enumerate(group_feats):
+        pos = 1  # group bin 0 = all-default
+        for f in feats:
+            info.group[f] = g
+            info.offset[f] = pos
+            pos += int(num_bins[f]) - 1
+    return info
+
+
+def pack_bins(bins: np.ndarray, info: BundleInfo) -> np.ndarray:
+    """Pack logical binned columns into physical group columns [G, R].
+
+    Later members overwrite earlier ones on conflict rows (bounded by
+    max_conflict_rate at bundle-construction time).
+    """
+    F, R = bins.shape
+    dtype = np.uint8 if info.group_num_bin.max() <= 256 else np.uint16
+    out = np.zeros((info.num_groups, R), dtype)
+    for f in range(F):
+        g = int(info.group[f])
+        d = int(info.default_bin[f])
+        b = bins[f].astype(np.int64)
+        act = b != d
+        # non-default bins map to a contiguous range, skipping the default
+        shifted = b - (b > d)  # bins above the default shift down by one
+        vals = info.offset[f] + shifted
+        out[g, act] = vals[act].astype(dtype)
+    return out

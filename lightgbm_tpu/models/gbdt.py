@@ -15,6 +15,7 @@ mirroring models_ in the reference.
 """
 from __future__ import annotations
 
+import dataclasses
 import math
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -305,14 +306,12 @@ class GBDT:
                 # compact scheduler is serial-only for now); quantized
                 # histograms under the parallel learners land with the
                 # int-hist ReduceScatter equivalent
-                import dataclasses as _dc
                 if self.grower_cfg.quantized:
                     log.warning("use_quantized_grad is not supported with "
                                 f"tree_learner={tl} yet; training fp32")
                     self._quant_rng = None
-                self.grower_cfg = _dc.replace(self.grower_cfg,
-                                              row_sched="full",
-                                              quantized=False)
+                self.grower_cfg = dataclasses.replace(
+                    self.grower_cfg, row_sched="full", quantized=False)
             else:
                 cap = (f"tpu_num_devices={cfg.tpu_num_devices}"
                        if 0 < cfg.tpu_num_devices < avail
@@ -321,27 +320,96 @@ class GBDT:
                             "running serial")
         self._compact = (self.grower_cfg.row_sched == "compact" and
                          self._tree_learner == "serial")
+
+        # ---- EFB bundling (ref: dataset.cpp:112 FindGroups) -----------
+        self._bundle = None
+        train_bins_host = train.bins
+        if (cfg.enable_bundle and self._tree_learner == "serial" and
+                train.bins is not None and train.num_used_features > 1):
+            from ..io.bundling import find_bundles, pack_bins
+            nb_used = np.asarray([m.num_bin for m in mappers], np.int64)
+            info = find_bundles(train.bins, nb_used,
+                                max_conflict_rate=cfg.max_conflict_rate)
+            if info is not None:
+                B_all = int(max(self.num_bin_max,
+                                info.group_num_bin.max()))
+                info.build_gather_map(B_all)
+                train_bins_host = pack_bins(train.bins, info)
+                self.num_bin_max = B_all
+                self.grower_cfg = dataclasses.replace(self.grower_cfg,
+                                                      num_bin=B_all)
+                self._bundle = dict(
+                    gather_map=info.gather_map, group=info.group,
+                    offset=info.offset, default_bin=info.default_bin,
+                    num_bin=info.num_bin, num_groups=info.num_groups)
+                log.info(
+                    f"EFB bundled {train.num_used_features} features into "
+                    f"{info.num_groups} groups")
+
         self.bins_rf = None
-        if self._compact and train.bins is not None:
+        self._bins_packed_dev = None
+        if self._compact and train_bins_host is not None:
             # row-major copy for the gather path; bins_dev keeps the
             # feature-major layout used by prediction/traversal
-            self.bins_rf = jnp.asarray(np.ascontiguousarray(train.bins.T))
+            self.bins_rf = jnp.asarray(
+                np.ascontiguousarray(train_bins_host.T))
+        elif self._bundle is not None:
+            self._bins_packed_dev = jnp.asarray(train_bins_host)
         forced = self._load_forced_splits(train)
+        # histogram pool policy (ref: histogram_pool_size / LRU
+        # HistogramPool, feature_histogram.hpp:1368): when the [L, F, B, 3]
+        # pool would blow the budget (wide data), drop the pool and compute
+        # both children histograms per split instead
+        if self._compact:
+            n_phys = (self._bundle["num_groups"] if self._bundle is not None
+                      else train.num_used_features)
+            pool_bytes = (cfg.num_leaves * n_phys *
+                          self.num_bin_max * 3 * 4)
+            limit_bytes = (cfg.histogram_pool_size * (1 << 20)
+                           if cfg.histogram_pool_size >= 0 else 4 << 30)
+            if pool_bytes > limit_bytes:
+                if forced is not None:
+                    log.warning(
+                        "histogram pool exceeds the budget but forced "
+                        "splits need it; keeping the full pool")
+                else:
+                    self.grower_cfg = dataclasses.replace(
+                        self.grower_cfg, hist_pool="none")
+                    log.info(
+                        f"histogram pool ({pool_bytes >> 20} MB) exceeds "
+                        "the budget; computing per-split child histograms "
+                        "without a pool")
         self._setup_cegb(train)
         if self.feature_meta is None:
             self._grow = None
         elif self._tree_learner == "serial":
+            if self._bundle is not None and forced is not None:
+                log.warning("forced splits with EFB bundling are untested; "
+                            "disabling bundling")
+                self._bundle = None
+                # fall back to unbundled layouts
+                if self._compact:
+                    self.bins_rf = jnp.asarray(
+                        np.ascontiguousarray(train.bins.T))
+                self._bins_packed_dev = None
             self._grow = jax.jit(
                 make_tree_grower(self.grower_cfg, self.feature_meta,
-                                 forced=forced))
+                                 forced=forced, bundle=self._bundle))
         else:
             self._setup_distributed(train, forced)
 
         # jitted gradient fn (device-resident labels/weights in the closure)
+        self._pos_bias = False
         if self.objective is not None and \
                 not isinstance(self.objective, CustomObjective):
             obj = self.objective
-            if K == 1:
+            if getattr(obj, "uses_position_bias", False):
+                # biases are a traced argument so the host-side Newton
+                # update (ref: UpdatePositionBiasFactors) feeds back in
+                self._pos_bias = True
+                self._gh_fn = jax.jit(
+                    lambda s, b: obj.get_gradients(s[0], b))
+            elif K == 1:
                 self._gh_fn = jax.jit(lambda s: obj.get_gradients(s[0]))
             else:
                 self._gh_fn = jax.jit(lambda s: obj.get_gradients(s))
@@ -357,7 +425,11 @@ class GBDT:
         the distributed wrapper holds its own sharded copy)."""
         if self._tree_learner != "serial":
             return None
-        return self.bins_rf if self._compact else self.bins_dev
+        if self._compact:
+            return self.bins_rf
+        if self._bins_packed_dev is not None:
+            return self._bins_packed_dev
+        return self.bins_dev
 
     @property
     def bins_dev(self):
@@ -696,7 +768,15 @@ class GBDT:
                 init_scores[k] = self._boost_from_average(k)
             with global_timer.section("GBDT::Boosting",
                                       sync=lambda: grad):
-                grad, hess = self._gh_fn(self.score)
+                if self._pos_bias:
+                    grad, hess = self._gh_fn(
+                        self.score,
+                        jnp.asarray(self.objective.pos_biases, jnp.float32))
+                    self.objective.update_position_bias(
+                        np.asarray(grad, np.float64),
+                        np.asarray(hess, np.float64))
+                else:
+                    grad, hess = self._gh_fn(self.score)
             if K == 1:
                 grad = grad[None, :]
                 hess = hess[None, :]

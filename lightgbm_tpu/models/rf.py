@@ -44,7 +44,12 @@ class RF(GBDT):
             const_score = jnp.asarray(
                 np.repeat(np.asarray(self.init_scores, np.float32)[:, None],
                           self.num_data, axis=1))
-            grad, hess = self._gh_fn(const_score)
+            if self._pos_bias:
+                import jax.numpy as _jnp
+                grad, hess = self._gh_fn(const_score, _jnp.asarray(
+                    self.objective.pos_biases, _jnp.float32))
+            else:
+                grad, hess = self._gh_fn(const_score)
             if K == 1:
                 grad, hess = grad[None, :], hess[None, :]
             self._grad_const = grad
