@@ -63,6 +63,8 @@ def synth_higgs(n, f, seed=0):
 
 def main():
     watchdog = _arm_watchdog()
+    from lightgbm_tpu.utils.jit_cache import enable_persistent_cache
+    enable_persistent_cache()
     import lightgbm_tpu as lgb
 
     X, y = synth_higgs(N_ROWS, N_FEATURES)

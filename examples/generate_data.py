@@ -1,0 +1,95 @@
+"""Write the synthetic train/test files used by every example directory.
+
+The reference ships real excerpts of its benchmark datasets; we generate
+shape-compatible synthetic data instead (same file formats: label-first TSV
+for regression/classification, plus `.query` files for the ranking tasks —
+ref: docs/Parameters.rst data format notes, examples/lambdarank/README.md).
+"""
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _write_tsv(path, y, X, fmt="%.6g"):
+    arr = np.column_stack([y, X])
+    np.savetxt(path, arr, delimiter="\t", fmt=fmt)
+    print(f"wrote {path}  [{arr.shape[0]} rows x {X.shape[1]} features]")
+
+
+def regression(rng, n_train=500, n_test=100, f=20):
+    X = rng.normal(size=(n_train + n_test, f))
+    y = (X[:, 0] * 2.0 + np.sin(X[:, 1] * 3.0) + 0.5 * X[:, 2] * X[:, 3]
+         + 0.1 * rng.normal(size=len(X)))
+    d = os.path.join(HERE, "regression")
+    _write_tsv(os.path.join(d, "regression.train"), y[:n_train], X[:n_train])
+    _write_tsv(os.path.join(d, "regression.test"), y[n_train:], X[n_train:])
+
+
+def binary(rng, n_train=700, n_test=150, f=28):
+    X = rng.normal(size=(n_train + n_test, f))
+    logits = X[:, 0] - 0.6 * X[:, 1] * X[:, 2] + 0.3 * X[:, 3] ** 2
+    y = (logits + 0.5 * rng.normal(size=len(X)) > 0).astype(int)
+    d = os.path.join(HERE, "binary_classification")
+    _write_tsv(os.path.join(d, "binary.train"), y[:n_train], X[:n_train])
+    _write_tsv(os.path.join(d, "binary.test"), y[n_train:], X[n_train:])
+    # per-row training weights (ref: <data>.weight sidecar convention)
+    w = rng.uniform(0.5, 1.5, size=n_train)
+    np.savetxt(os.path.join(d, "binary.train.weight"), w, fmt="%.4f")
+
+
+def multiclass(rng, n_train=800, n_test=200, f=20, k=5):
+    centers = rng.normal(scale=2.0, size=(k, f))
+    y = rng.integers(0, k, size=n_train + n_test)
+    X = centers[y] + rng.normal(size=(n_train + n_test, f))
+    d = os.path.join(HERE, "multiclass_classification")
+    _write_tsv(os.path.join(d, "multiclass.train"), y[:n_train], X[:n_train])
+    _write_tsv(os.path.join(d, "multiclass.test"), y[n_train:], X[n_train:])
+
+
+def ranking(rng, dirname, n_queries=60, f=16):
+    rows, labels, qsizes = [], [], []
+    for _ in range(n_queries):
+        m = int(rng.integers(5, 25))
+        Xq = rng.normal(size=(m, f))
+        rel = Xq[:, 0] + 0.5 * Xq[:, 1] + 0.3 * rng.normal(size=m)
+        # graded relevance 0..4 by within-query quantile
+        grades = np.searchsorted(np.quantile(rel, [0.5, 0.75, 0.9, 0.97]),
+                                 rel)
+        rows.append(Xq)
+        labels.append(grades)
+        qsizes.append(m)
+    X = np.concatenate(rows)
+    y = np.concatenate(labels)
+    d = os.path.join(HERE, dirname)
+    n_train_q = int(0.8 * n_queries)
+    split = int(np.sum(qsizes[:n_train_q]))
+    _write_tsv(os.path.join(d, "rank.train"), y[:split], X[:split], fmt="%.5g")
+    _write_tsv(os.path.join(d, "rank.test"), y[split:], X[split:], fmt="%.5g")
+    np.savetxt(os.path.join(d, "rank.train.query"), qsizes[:n_train_q],
+               fmt="%d")
+    np.savetxt(os.path.join(d, "rank.test.query"), qsizes[n_train_q:],
+               fmt="%d")
+
+
+def parallel(rng, n_train=2000, f=24):
+    X = rng.normal(size=(n_train, f))
+    logits = X[:, 0] + 0.4 * X[:, 1] * X[:, 2]
+    y = (logits > 0).astype(int)
+    d = os.path.join(HERE, "parallel_learning")
+    _write_tsv(os.path.join(d, "parallel.train"), y, X)
+
+
+def main():
+    rng = np.random.default_rng(7)
+    regression(rng)
+    binary(rng)
+    multiclass(rng)
+    ranking(rng, "lambdarank")
+    ranking(rng, "xendcg", n_queries=50)
+    parallel(rng)
+
+
+if __name__ == "__main__":
+    main()

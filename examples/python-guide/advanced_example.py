@@ -1,0 +1,70 @@
+"""Advanced API tour: weights, categorical features, model JSON dump,
+continued training, per-tree learning-rate decay, custom objective/metric,
+SHAP contributions and refit (counterpart of the reference python-guide
+advanced example, exercising the same surface on this framework)."""
+import json
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+rng = np.random.default_rng(0)
+n = 1500
+X = rng.normal(size=(n, 12)).astype(np.float32)
+# an integer categorical column
+X[:, 5] = rng.integers(0, 8, size=n)
+logits = X[:, 0] + (X[:, 5] == 3) * 1.5 - 0.4 * X[:, 1]
+y = (logits + 0.5 * rng.normal(size=n) > 0).astype(np.float32)
+w = rng.uniform(0.5, 1.5, size=n).astype(np.float32)
+
+X_train, y_train, w_train = X[:1200], y[:1200], w[:1200]
+X_test, y_test = X[1200:], y[1200:]
+
+train_data = lgb.Dataset(X_train, label=y_train, weight=w_train,
+                         categorical_feature=[5])
+valid_data = train_data.create_valid(X_test, label=y_test)
+
+params = {"objective": "binary", "metric": "auc", "num_leaves": 31,
+          "verbose": 0}
+
+print("Training with categorical feature + weights...")
+bst = lgb.train(params, train_data, num_boost_round=30,
+                valid_sets=[valid_data])
+
+print("Dumping model to JSON...")
+model_json = bst.dump_model()
+print(f"  tree_info has {len(model_json['tree_info'])} trees")
+
+print("Continued training with learning-rate decay...")
+bst = lgb.train(params, train_data, num_boost_round=30,
+                init_model=bst, valid_sets=[valid_data],
+                callbacks=[lgb.reset_parameter(
+                    learning_rate=lambda it: 0.05 * (0.99 ** it))])
+
+print("Custom objective (logistic) + custom metric...")
+
+
+def loglikelihood(preds, train_dataset):
+    labels = train_dataset.get_label()
+    p = 1.0 / (1.0 + np.exp(-preds))
+    return p - labels, p * (1.0 - p)
+
+
+def binary_error(preds, eval_dataset):
+    labels = eval_dataset.get_label()
+    p = 1.0 / (1.0 + np.exp(-preds))
+    return "error", float(np.mean(labels != (p > 0.5))), False
+
+
+bst2 = lgb.train({"objective": loglikelihood, "num_leaves": 31,
+                  "verbose": 0}, train_data, num_boost_round=20,
+                 feval=binary_error, valid_sets=[valid_data])
+
+print("SHAP-style feature contributions on 5 rows...")
+contrib = bst.predict(X_test[:5], pred_contrib=True)
+print(f"  contrib shape: {np.asarray(contrib).shape}")
+
+print("Refitting the existing structure on new data...")
+bst_refit = bst.refit(X_test, y_test)
+print(f"  refit model has {bst_refit.num_trees()} trees")
+print("Done.")

@@ -1,0 +1,45 @@
+"""Plotting API tour: importance, split values, tree digraph/plot, metric
+curves during training (requires matplotlib; graphviz optional)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(1000, 10)).astype(np.float32)
+y = X[:, 0] * 2 + X[:, 1] ** 2 + 0.1 * rng.normal(size=1000)
+
+train_data = lgb.Dataset(X[:800], label=y[:800])
+valid_data = train_data.create_valid(X[800:], label=y[800:])
+
+evals_result = {}
+bst = lgb.train({"objective": "regression", "metric": "l2", "verbose": -1},
+                train_data, num_boost_round=50, valid_sets=[valid_data],
+                callbacks=[lgb.record_evaluation(evals_result)])
+
+try:
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+except ImportError:
+    raise SystemExit("matplotlib is required for plot_example.py")
+
+print("Plotting feature importances...")
+ax = lgb.plot_importance(bst, max_num_features=10)
+plt.savefig("importance.png")
+
+print("Plotting split value histogram...")
+ax = lgb.plot_split_value_histogram(bst, feature=0)
+plt.savefig("split_value.png")
+
+print("Plotting metric during training...")
+ax = lgb.plot_metric(evals_result, metric="l2")
+plt.savefig("metric.png")
+
+print("Plotting tree 0...")
+try:
+    ax = lgb.plot_tree(bst, tree_index=0, show_info=["split_gain"])
+    plt.savefig("tree.png")
+    print("Wrote importance.png split_value.png metric.png tree.png")
+except Exception as e:  # graphviz binary not installed
+    print(f"plot_tree skipped ({type(e).__name__}: graphviz 'dot' needed)")
+    print("Wrote importance.png split_value.png metric.png")

@@ -1,0 +1,35 @@
+"""Minimal train/validate/save/predict loop with the native API
+(counterpart of the reference's python-guide simple example)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(1200, 20)).astype(np.float32)
+y = X[:, 0] * 2 + np.sin(3 * X[:, 1]) + 0.1 * rng.normal(size=1200)
+X_train, y_train = X[:1000], y[:1000]
+X_test, y_test = X[1000:], y[1000:]
+
+train_data = lgb.Dataset(X_train, label=y_train)
+valid_data = train_data.create_valid(X_test, label=y_test)
+
+params = {
+    "objective": "regression",
+    "metric": "l2",
+    "num_leaves": 31,
+    "learning_rate": 0.05,
+    "verbose": 0,
+}
+
+print("Starting training...")
+bst = lgb.train(params, train_data, num_boost_round=100,
+                valid_sets=[valid_data],
+                callbacks=[lgb.early_stopping(stopping_rounds=10)])
+
+print("Saving model...")
+bst.save_model("model.txt")
+
+print("Starting predicting...")
+y_pred = bst.predict(X_test, num_iteration=bst.best_iteration)
+rmse = float(np.sqrt(np.mean((y_pred - y_test) ** 2)))
+print(f"The RMSE of prediction is: {rmse:.5f}")

@@ -1,0 +1,36 @@
+"""scikit-learn estimator API: fit/predict, early stopping, grid search."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(1200, 20)).astype(np.float32)
+y = X[:, 0] * 2 + np.sin(3 * X[:, 1]) + 0.1 * rng.normal(size=1200)
+X_train, y_train = X[:1000], y[:1000]
+X_test, y_test = X[1000:], y[1000:]
+
+print("Starting training...")
+gbm = lgb.LGBMRegressor(num_leaves=31, learning_rate=0.05,
+                        n_estimators=200)
+gbm.fit(X_train, y_train, eval_set=[(X_test, y_test)], eval_metric="l2",
+        callbacks=[lgb.early_stopping(stopping_rounds=10)])
+
+print("Starting predicting...")
+y_pred = gbm.predict(X_test, num_iteration=gbm.best_iteration_)
+rmse = float(np.sqrt(np.mean((y_pred - y_test) ** 2)))
+print(f"The RMSE of prediction is: {rmse:.5f}")
+
+print(f"Feature importances: {list(gbm.feature_importances_[:5])} ...")
+
+try:
+    from sklearn.model_selection import GridSearchCV
+except ImportError:
+    GridSearchCV = None
+if GridSearchCV is not None:
+    print("Grid searching...")
+    estimator = lgb.LGBMRegressor(num_leaves=31)
+    gbm = GridSearchCV(estimator,
+                       {"learning_rate": [0.01, 0.1],
+                        "n_estimators": [20, 40]})
+    gbm.fit(X_train, y_train)
+    print(f"Best parameters found by grid search are: {gbm.best_params_}")

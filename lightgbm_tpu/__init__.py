@@ -2,7 +2,19 @@
 
 Public surface mirrors python-package/lightgbm/__init__.py of the reference:
 Dataset, Booster, train, cv, callbacks, sklearn estimators, plotting.
+
+``LIGHTGBM_TPU_PLATFORM=cpu|tpu`` pins the jax backend before first use
+(useful to run CLI/examples on a CPU host or to opt out of a busy
+accelerator); unset, jax picks its default platform.
 """
+import os as _os
+
+if _os.environ.get("LIGHTGBM_TPU_PLATFORM"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms",
+                       _os.environ["LIGHTGBM_TPU_PLATFORM"])
+
 from .basic import Booster, Dataset, LightGBMError
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        record_evaluation, reset_parameter)

@@ -324,7 +324,14 @@ class GBDT:
         # ---- EFB bundling (ref: dataset.cpp:112 FindGroups) -----------
         self._bundle = None
         train_bins_host = train.bins
-        if (cfg.enable_bundle and self._tree_learner == "serial" and
+        forced = self._load_forced_splits(train)
+        if forced is not None and cfg.enable_bundle:
+            # forced splits need per-feature partition columns the bundled
+            # layout doesn't expose; skip bundling BEFORE it inflates
+            # num_bin_max / runs the O(F*R) conflict scan
+            log.warning("forced splits with EFB bundling are untested; "
+                        "disabling bundling")
+        elif (cfg.enable_bundle and self._tree_learner == "serial" and
                 train.bins is not None and train.num_used_features > 1):
             from ..io.bundling import find_bundles, pack_bins
             nb_used = np.asarray([m.num_bin for m in mappers], np.int64)
@@ -355,7 +362,6 @@ class GBDT:
                 np.ascontiguousarray(train_bins_host.T))
         elif self._bundle is not None:
             self._bins_packed_dev = jnp.asarray(train_bins_host)
-        forced = self._load_forced_splits(train)
         # histogram pool policy (ref: histogram_pool_size / LRU
         # HistogramPool, feature_histogram.hpp:1368): when the [L, F, B, 3]
         # pool would blow the budget (wide data), drop the pool and compute
@@ -383,15 +389,6 @@ class GBDT:
         if self.feature_meta is None:
             self._grow = None
         elif self._tree_learner == "serial":
-            if self._bundle is not None and forced is not None:
-                log.warning("forced splits with EFB bundling are untested; "
-                            "disabling bundling")
-                self._bundle = None
-                # fall back to unbundled layouts
-                if self._compact:
-                    self.bins_rf = jnp.asarray(
-                        np.ascontiguousarray(train.bins.T))
-                self._bins_packed_dev = None
             self._grow = jax.jit(
                 make_tree_grower(self.grower_cfg, self.feature_meta,
                                  forced=forced, bundle=self._bundle))

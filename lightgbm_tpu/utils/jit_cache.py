@@ -1,0 +1,30 @@
+"""Persistent XLA compilation cache setup (shared by bench/tests/CLI).
+
+The grower programs for realistic shapes take minutes to compile on TPU;
+a warm on-disk cache turns that into a file read. One helper so the cache
+directory convention and tuning thresholds live in one place.
+"""
+from __future__ import annotations
+
+import os
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> str:
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    Resolution order: explicit argument, ``LGBM_TPU_JIT_CACHE`` env var,
+    ``<repo>/.jax_cache`` next to the package. Returns the directory used.
+    """
+    import jax
+
+    if cache_dir is None:
+        cache_dir = os.environ.get("LGBM_TPU_JIT_CACHE")
+    if cache_dir is None:
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), ".jax_cache")
+    cache_dir = os.path.abspath(cache_dir)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    return cache_dir

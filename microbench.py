@@ -1,0 +1,153 @@
+"""Primitive-op microbenchmarks on the current backend.
+
+Measures the building blocks the grower's schedule is made of, so kernel
+choices (einsum dtype, partition primitive, block size) are driven by
+device numbers instead of guesses. Run on the real chip:
+
+    python microbench.py            # all suites
+    python microbench.py hist part  # chosen suites
+"""
+import sys
+import time
+
+import numpy as np
+
+
+def timeit(fn, *args, iters=20, warmup=3):
+    import jax
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_hist():
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.histogram import hist_rowmajor, hist_xla
+
+    rng = np.random.default_rng(0)
+    R, F, B = 1_048_576, 28, 256
+    bins_rm = jnp.asarray(rng.integers(0, B - 1, (R, F), dtype=np.uint8))
+    gh = jnp.asarray(rng.normal(size=(R, 3)).astype(np.float32))
+    ghq = jnp.asarray(rng.integers(-8, 8, (R, 3), dtype=np.int8))
+    for S in (16384, 131072, 1_048_576):
+        for blk in (4096, 8192, 16384):
+            for name, g, dt in (("f32", gh, "float32"),
+                                ("bf16", gh, "bfloat16"),
+                                ("int8", ghq, "float32")):
+                f = jax.jit(lambda b, g, dt=dt, blk=blk: hist_rowmajor(
+                    b, g, num_bin=B, block_rows=blk, dtype=dt))
+                dt_s = timeit(f, bins_rm[:S], g[:S])
+                gbps = S * F * (B * (4 if name == "f32" else
+                                     2 if name == "bf16" else 1)) / dt_s / 1e9
+                print(f"hist_rm S={S:8d} blk={blk:6d} {name}: "
+                      f"{dt_s*1e3:8.3f} ms  ({S/dt_s/1e9:.2f} Grows/s, "
+                      f"onehot {gbps:.0f} GB/s)", flush=True)
+    f = jax.jit(lambda b, g: hist_xla(b, g, num_bin=B, block_rows=8192))
+    dt_s = timeit(f, bins_rm.T.copy(), gh)
+    print(f"hist_xla(F-major) R={R}: {dt_s*1e3:8.3f} ms", flush=True)
+
+
+def bench_pallas():
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.hist_pallas import hist_pallas
+
+    rng = np.random.default_rng(0)
+    R, F, B = 1_048_576, 28, 256
+    bins_t = jnp.asarray(rng.integers(0, B - 1, (F, R), dtype=np.uint8))
+    gh = jnp.asarray(rng.normal(size=(R, 3)).astype(np.float32))
+    for S in (16384, 131072, 1_048_576):
+        for blk in (1024, 2048, 4096):
+            for ft in (4, 7, 14, 28):
+                try:
+                    f = jax.jit(lambda b, g, blk=blk, ft=ft: hist_pallas(
+                        b, g, num_bin=B, block_rows=blk, feature_tile=ft))
+                    dt_s = timeit(f, bins_t[:, :S], gh[:S])
+                    print(f"hist_pallas S={S:8d} blk={blk:5d} ft={ft:2d}: "
+                          f"{dt_s*1e3:8.3f} ms  ({S/dt_s/1e9:.2f} Grows/s)",
+                          flush=True)
+                except Exception as e:
+                    print(f"hist_pallas S={S} blk={blk} ft={ft}: FAIL "
+                          f"{type(e).__name__}: {str(e)[:120]}", flush=True)
+
+
+def bench_part():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    rng = np.random.default_rng(0)
+    R = 1_048_576
+    seg = jnp.asarray(rng.permutation(R).astype(np.int32))
+    go_left = jnp.asarray(rng.integers(0, 2, R).astype(bool))
+    vals = jnp.asarray(rng.normal(size=(R,)).astype(np.float32))
+
+    def part_scatter(seg, lm):
+        pos = jnp.arange(R, dtype=jnp.int32)
+        dst_l = jnp.cumsum(lm.astype(jnp.int32)) - 1
+        nL = dst_l[-1] + 1
+        dst_r = nL + jnp.cumsum((~lm).astype(jnp.int32)) - 1
+        dest = jnp.where(lm, dst_l, dst_r)
+        return jnp.zeros_like(seg).at[dest].set(seg, unique_indices=True)
+
+    def part_sort(seg, lm):
+        key = (~lm).astype(jnp.int32)
+        _, out = lax.sort((key, seg), num_keys=1, is_stable=True)
+        return out
+
+    for name, f in (("scatter", part_scatter), ("sort", part_sort)):
+        dt_s = timeit(jax.jit(f), seg, go_left)
+        print(f"partition/{name} R={R}: {dt_s*1e3:8.3f} ms", flush=True)
+
+    def gather_rows(seg, v):
+        return jnp.take(v, seg, axis=0)
+
+    dt_s = timeit(jax.jit(gather_rows), seg, vals)
+    print(f"gather f32[R] R={R}: {dt_s*1e3:8.3f} ms", flush=True)
+
+    bins_rm = jnp.asarray(rng.integers(0, 255, (R, 28), dtype=np.uint8))
+    dt_s = timeit(jax.jit(lambda s, b: jnp.take(b, s, axis=0)), seg, bins_rm)
+    print(f"gather u8[R,28] R={R}: {dt_s*1e3:8.3f} ms", flush=True)
+
+    dt_s = timeit(jax.jit(lambda s, b: b.reshape(-1)[s * 28 + 3]),
+                  seg, bins_rm)
+    print(f"gather-flat u8 col R={R}: {dt_s*1e3:8.3f} ms", flush=True)
+
+
+def bench_fullpass():
+    """One masked full-row pass (the round-1 design's per-split cost)."""
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.histogram import hist_xla
+
+    rng = np.random.default_rng(0)
+    R, F, B = 1_048_576, 28, 256
+    bins_t = jnp.asarray(rng.integers(0, B - 1, (F, R), dtype=np.uint8))
+    gh = jnp.asarray(rng.normal(size=(R, 3)).astype(np.float32))
+    leaf = jnp.asarray(rng.integers(0, 255, R).astype(np.int32))
+
+    def masked(b, g, lid):
+        m = (lid == 3).astype(g.dtype)
+        return hist_xla(b, g * m[:, None], num_bin=B, block_rows=8192)
+
+    dt_s = timeit(jax.jit(masked), bins_t, gh, leaf)
+    print(f"masked full pass R={R}: {dt_s*1e3:8.3f} ms", flush=True)
+
+
+SUITES = {"hist": bench_hist, "pallas": bench_pallas, "part": bench_part,
+          "fullpass": bench_fullpass}
+
+if __name__ == "__main__":
+    picks = sys.argv[1:] or list(SUITES)
+    import jax
+    print(f"backend={jax.default_backend()} devices={jax.devices()}",
+          flush=True)
+    for p in picks:
+        print(f"== {p} ==", flush=True)
+        SUITES[p]()

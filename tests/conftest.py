@@ -24,6 +24,15 @@ import jax  # noqa: E402
 _test_platform = os.environ.get("LGBM_TPU_TEST_DEVICE", "cpu")
 jax.config.update("jax_platforms", _test_platform)
 
+# Persistent compilation cache: the suite re-jits the same grower shapes
+# every run; warm-cache runs skip most XLA compile time.
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from lightgbm_tpu.utils.jit_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
