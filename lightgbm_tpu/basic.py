@@ -43,9 +43,35 @@ def _to_2d_numpy(data) -> Tuple[np.ndarray, Optional[List[str]]]:
 
 
 def _to_1d_numpy(data, dtype=np.float32) -> np.ndarray:
-    if hasattr(data, "values"):
+    if _is_arrow_array(data):
+        data = data.to_numpy(zero_copy_only=False)
+    elif hasattr(data, "values"):
         data = data.values
     return np.ascontiguousarray(np.asarray(data).reshape(-1), dtype=dtype)
+
+
+def _is_scipy_sparse(data) -> bool:
+    try:
+        import scipy.sparse as sp
+    except ImportError:
+        return False
+    return sp.issparse(data)
+
+
+def _is_arrow_table(data) -> bool:
+    try:
+        import pyarrow as pa
+    except ImportError:
+        return False
+    return isinstance(data, (pa.Table, pa.RecordBatch))
+
+
+def _is_arrow_array(data) -> bool:
+    try:
+        import pyarrow as pa
+    except ImportError:
+        return False
+    return isinstance(data, (pa.Array, pa.ChunkedArray))
 
 
 class Dataset:
@@ -83,6 +109,24 @@ class Dataset:
                 self.params.setdefault(k, v)
         return self
 
+    def _finish_prebinned(self) -> "Dataset":
+        """Apply explicit metadata overrides to an already-binned dataset
+        (binary-file and two_round loading exits)."""
+        md = self._binned.metadata
+        if self.label is not None:
+            md.set_label(_to_1d_numpy(self.label))
+        if self.weight is not None:
+            md.set_weight(_to_1d_numpy(self.weight))
+        if self.group is not None:
+            md.set_query(_to_1d_numpy(self.group, np.int64))
+        if self.init_score is not None:
+            md.set_init_score(_to_1d_numpy(self.init_score, np.float64))
+        if self.position is not None:
+            md.set_position(_to_1d_numpy(self.position, np.int32))
+        if self.free_raw_data:
+            self.data = None
+        return self
+
     def construct(self) -> "Dataset":
         if self._binned is not None:
             return self
@@ -103,23 +147,18 @@ class Dataset:
             from .io.binary_io import is_binary_dataset_file, load_binary
             if is_binary_dataset_file(str(self.data)):
                 self._binned = load_binary(str(self.data))
-                md = self._binned.metadata
-                if self.label is not None:
-                    md.set_label(_to_1d_numpy(self.label))
-                if self.weight is not None:
-                    md.set_weight(_to_1d_numpy(self.weight))
-                if self.group is not None:
-                    md.set_query(_to_1d_numpy(self.group, np.int64))
-                if self.init_score is not None:
-                    md.set_init_score(_to_1d_numpy(self.init_score,
-                                                   np.float64))
-                if self.position is not None:
-                    md.set_position(_to_1d_numpy(self.position, np.int32))
-                if self.free_raw_data:
-                    self.data = None
-                return self
-            from .io.file_loader import load_svm_or_csv
+                return self._finish_prebinned()
             cfg = Config(self.params)
+            if cfg.two_round:
+                # streaming two-pass load: bounded memory, binned in place
+                # (ref: dataset_loader.cpp:266 two_round branch)
+                from .io.stream_loader import load_binned_two_round
+                self._binned = load_binned_two_round(
+                    str(self.data), cfg,
+                    categorical_feature=self.categorical_feature,
+                    reference=ref_binned)
+                return self._finish_prebinned()
+            from .io.file_loader import load_svm_or_csv
             X, y, w, grp = load_svm_or_csv(str(self.data), cfg)
             if self.label is None:
                 self.label = y
@@ -128,6 +167,13 @@ class Dataset:
             if self.group is None:
                 self.group = grp
             data, inferred_names = X, None
+        elif _is_scipy_sparse(self.data):
+            from .io.dataset_core import SparseColumns
+            data, inferred_names = SparseColumns(self.data), None
+        elif _is_arrow_table(self.data):
+            from .io.dataset_core import ArrowColumns
+            data = ArrowColumns(self.data)
+            inferred_names = data.column_names()
         else:
             data, inferred_names = _to_2d_numpy(self.data)
 
@@ -158,7 +204,11 @@ class Dataset:
         position = (_to_1d_numpy(self.position, np.int32)
                     if self.position is not None else None)
 
-        self._binned = BinnedDataset.from_matrix(
+        from .io.dataset_core import ColumnSource
+        builder = (BinnedDataset.from_columns
+                   if isinstance(data, ColumnSource)
+                   else BinnedDataset.from_matrix)
+        self._binned = builder(
             data, cfg, label=label, weight=weight, group=group,
             init_score=init_score, position=position,
             feature_names=feature_names, categorical_features=cats,
@@ -490,7 +540,13 @@ class Booster:
                 pred_contrib: bool = False, validate_features: bool = False,
                 **kwargs) -> np.ndarray:
         """ref: basic.py:4625 Booster.predict -> Predictor (predictor.hpp)."""
-        X, _ = _to_2d_numpy(data)
+        if _is_scipy_sparse(data):
+            X = np.asarray(data.todense(), dtype=np.float64)
+        elif _is_arrow_table(data):
+            from .io.dataset_core import ArrowColumns
+            X = ArrowColumns(data).to_dense_f32().astype(np.float64)
+        else:
+            X, _ = _to_2d_numpy(data)
         eng = self._engine
         K = eng.num_tree_per_iteration
         n_total_iter = len(eng.models) // max(K, 1)

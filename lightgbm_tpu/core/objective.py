@@ -693,11 +693,37 @@ def default_label_gain(max_label: int = 31) -> np.ndarray:
     return (np.power(2.0, np.arange(max_label + 1)) - 1.0)
 
 
+class _QueryBucket:
+    """One length-bucket of queries padded to a shared width."""
+
+    def __init__(self, qids: np.ndarray, qb: np.ndarray, width: int,
+                 label: np.ndarray):
+        self.qids = qids                       # i64 [Qb] original query ids
+        counts = (qb[qids + 1] - qb[qids]).astype(np.int64)
+        Qb = len(qids)
+        idx = np.zeros((Qb, width), np.int64)
+        valid = np.zeros((Qb, width), bool)
+        for r, q in enumerate(qids):
+            c = counts[r]
+            idx[r, :c] = np.arange(qb[q], qb[q + 1])
+            valid[r, :c] = True
+        self.idx = jnp.asarray(idx)            # [Qb, Mb]
+        self.valid = jnp.asarray(valid)        # [Qb, Mb]
+        self.label_q = jnp.asarray(
+            np.where(valid, label[idx], 0.0), jnp.float32)
+
+
 class _RankingObjective(ObjectiveFunction):
-    """Shared padded-query machinery. Queries are padded to a common
-    max length so the per-query pairwise computation becomes one dense
-    [Q, M, M] masked tensor op — the TPU-native shape of the reference's
-    per-query OMP loop (ref: rank_objective.hpp:56 GetGradients)."""
+    """Shared padded-query machinery. Queries are grouped into pow2
+    LENGTH BUCKETS and padded to the bucket width, so the per-query
+    pairwise computation becomes a few dense [Qb, Mb, Mb] masked tensor
+    ops — the TPU-native shape of the reference's per-query OMP loop
+    (ref: rank_objective.hpp:56 GetGradients). Bucketing bounds both the
+    padding waste (<2x rows) and the pairwise memory: one 10k-doc query
+    no longer inflates every query's pair tensor to 10k x 10k
+    (SURVEY.md §7 flagged the single-max-width formulation)."""
+
+    MIN_BUCKET_WIDTH = 16
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
@@ -708,25 +734,22 @@ class _RankingObjective(ObjectiveFunction):
         self.num_queries = len(qb) - 1
         counts = np.diff(qb)
         self.max_query = int(counts.max())
-        Q, M = self.num_queries, self.max_query
-        # row index per (query, slot); padded slots point at row 0 & masked
-        idx = np.zeros((Q, M), dtype=np.int64)
-        valid = np.zeros((Q, M), dtype=bool)
-        for q in range(Q):
-            c = counts[q]
-            idx[q, :c] = np.arange(qb[q], qb[q + 1])
-            valid[q, :c] = True
-        self._qidx = jnp.asarray(idx)
-        self._qvalid = jnp.asarray(valid)
         self._qcounts = counts
-        self._label_q = jnp.asarray(
-            np.where(valid, self.label[idx], 0.0), jnp.float32)
+        # pow2 ceiling per query -> one bucket per distinct ceiling
+        widths = np.maximum(self.MIN_BUCKET_WIDTH,
+                            2 ** np.ceil(np.log2(np.maximum(counts, 1)))
+                            .astype(np.int64))
+        self.buckets = [
+            _QueryBucket(np.flatnonzero(widths == w), qb, int(w), self.label)
+            for w in np.unique(widths)]
 
-    def scatter_back(self, padded: jnp.ndarray) -> jnp.ndarray:
-        """[Q, M] padded per-doc values -> [N] flat (padded slots dropped)."""
+    def scatter_back(self, parts) -> jnp.ndarray:
+        """Per-bucket [Qb, Mb] padded doc values -> [N] flat."""
         flat = jnp.zeros(self.num_data, jnp.float32)
-        return flat.at[self._qidx.reshape(-1)].add(
-            jnp.where(self._qvalid, padded, 0.0).reshape(-1))
+        for bk, padded in zip(self.buckets, parts):
+            flat = flat.at[bk.idx.reshape(-1)].add(
+                jnp.where(bk.valid, padded, 0.0).reshape(-1))
+        return flat
 
 
 class LambdarankNDCG(_RankingObjective):
@@ -760,9 +783,11 @@ class LambdarankNDCG(_RankingObjective):
             dcg = np.sum(gains[lbl.astype(np.int64)] /
                          np.log2(np.arange(len(lbl)) + 2.0))
             inv[q] = 1.0 / dcg if dcg > 0 else 0.0
-        self._inv_max_dcg = jnp.asarray(inv, jnp.float32)
-        self._gain_q = jnp.asarray(
-            self.label_gain[np.asarray(self._label_q, np.int64)], jnp.float32)
+        for bk in self.buckets:
+            bk.inv_max_dcg = jnp.asarray(inv[bk.qids], jnp.float32)
+            bk.gain_q = jnp.asarray(
+                self.label_gain[np.asarray(bk.label_q, np.int64)],
+                jnp.float32)
         # position bias (ref: rank_objective.hpp:44-57 positions_/pos_biases_)
         if metadata.position is not None:
             self.positions = metadata.position.astype(np.int64)
@@ -788,26 +813,23 @@ class LambdarankNDCG(_RankingObjective):
         second -= self._bias_reg * counts
         self.pos_biases += self._bias_lr * first / (np.abs(second) + 0.001)
 
-    def get_gradients(self, score, pos_biases=None):
-        """Padded all-pairs lambdas (ref: rank_objective.hpp:181
-        GetGradientsForOneQuery, exact sigmoid instead of the lookup table).
-        ``pos_biases`` (f32 [num_position_ids]) adjusts scores before the
-        pairwise computation (ref: rank_objective.hpp:69-74)."""
-        if pos_biases is not None and self.positions is not None:
-            score = score + pos_biases[self._positions_dev]
-        Q, M = self._qidx.shape
-        s = jnp.where(self._qvalid, score[self._qidx], -jnp.inf)  # [Q, M]
-        lbl = self._label_q
-        gain = self._gain_q
+    def _bucket_gradients(self, bk: _QueryBucket, score):
+        """All-pairs lambdas for one length bucket (ref:
+        rank_objective.hpp:181 GetGradientsForOneQuery, exact sigmoid
+        instead of the lookup table)."""
+        Q, M = bk.idx.shape
+        valid = bk.valid
+        s = jnp.where(valid, score[bk.idx], -jnp.inf)          # [Q, M]
+        lbl = bk.label_q
+        gain = bk.gain_q
 
         # rank of each doc within its query by descending score (stable)
-        order = jnp.argsort(-jnp.where(self._qvalid, s, -jnp.inf),
-                            axis=1, stable=True)              # [Q, M] doc slot at rank r
+        order = jnp.argsort(-jnp.where(valid, s, -jnp.inf),
+                            axis=1, stable=True)   # [Q, M] doc slot at rank r
         rank = jnp.zeros_like(order).at[
             jnp.arange(Q)[:, None], order].set(jnp.arange(M)[None, :])
         discount = 1.0 / jnp.log2(rank.astype(jnp.float32) + 2.0)
 
-        valid = self._qvalid
         pair_valid = (valid[:, :, None] & valid[:, None, :] &
                       (lbl[:, :, None] != lbl[:, None, :]))
         # truncation: pair needs at least one doc ranked < truncation_level
@@ -820,7 +842,7 @@ class LambdarankNDCG(_RankingObjective):
         dcg_gap = gain[:, :, None] - gain[:, None, :]
         paired_discount = jnp.abs(discount[:, :, None] - discount[:, None, :])
         delta_ndcg = jnp.abs(dcg_gap) * paired_discount * \
-            self._inv_max_dcg[:, None, None]
+            bk.inv_max_dcg[:, None, None]
 
         if self.norm:
             best = jnp.max(jnp.where(valid, s, -jnp.inf), axis=1)
@@ -832,7 +854,7 @@ class LambdarankNDCG(_RankingObjective):
 
         # signed delta from high to low: use delta for (high, low) pair
         hl_delta = jnp.where(high_is_i, delta_score, -delta_score)
-        p = jax.nn.sigmoid(-self.sigmoid * hl_delta)           # 1/(1+e^{s_h-s_l})
+        p = jax.nn.sigmoid(-self.sigmoid * hl_delta)       # 1/(1+e^{s_h-s_l})
         p_lambda = -self.sigmoid * delta_ndcg * p
         p_hess = self.sigmoid * self.sigmoid * delta_ndcg * p * (1.0 - p)
 
@@ -851,8 +873,17 @@ class LambdarankNDCG(_RankingObjective):
                            jnp.maximum(sum_lambdas, K_EPSILON), 1.0)
             lambdas = lambdas * nf[:, None]
             hess = hess * nf[:, None]
+        return lambdas, hess
 
-        return self.scatter_back(lambdas), self.scatter_back(hess)
+    def get_gradients(self, score, pos_biases=None):
+        """Bucketed all-pairs lambdas. ``pos_biases`` (f32
+        [num_position_ids]) adjusts scores before the pairwise computation
+        (ref: rank_objective.hpp:69-74)."""
+        if pos_biases is not None and self.positions is not None:
+            score = score + pos_biases[self._positions_dev]
+        parts = [self._bucket_gradients(bk, score) for bk in self.buckets]
+        return (self.scatter_back([p[0] for p in parts]),
+                self.scatter_back([p[1] for p in parts]))
 
     def to_string(self):
         return self.NAME
@@ -868,32 +899,37 @@ class RankXENDCG(_RankingObjective):
         self.seed = int(config.objective_seed)
         self._iter = 0
 
-    def get_gradients(self, score):
-        Q, M = self._qidx.shape
-        valid = self._qvalid
-        s = jnp.where(valid, score[self._qidx], -jnp.inf)
-        # fresh gumbel noise per call (ref: Rands in GetGradientsForOneQuery)
-        self._iter += 1
-        key = jax.random.PRNGKey(self.seed + self._iter)
+    def _bucket_gradients(self, bk: _QueryBucket, score, key):
+        Q, M = bk.idx.shape
+        valid = bk.valid
+        s = jnp.where(valid, score[bk.idx], -jnp.inf)
         rho = jax.nn.softmax(jnp.where(valid, s, -jnp.inf), axis=1)
         rho = jnp.where(valid, rho, 0.0)
         # terms: phi(label, gumbel) = 2^label - gumbel
         gumbel = jax.random.gumbel(key, (Q, M))
-        phi = jnp.power(2.0, self._label_q) - gumbel
+        phi = jnp.power(2.0, bk.label_q) - gumbel
         phi = jnp.where(valid, phi, 0.0)
         phi_sum = jnp.maximum(phi.sum(axis=1, keepdims=True), K_EPSILON)
         ys = phi / phi_sum
         l1 = rho - ys
         # second-order correction terms (ref: rank_objective.hpp:400-430)
-        rho_sq = rho * rho
         l2_denom = jnp.maximum(1.0 - rho, K_EPSILON)
         params = (ys + l1 * rho / l2_denom)
-        l2 = params.sum(axis=1, keepdims=True) * rho - l1 * rho / l2_denom - ys * rho / l2_denom * 0
         lambdas = l1 + rho * (params.sum(axis=1, keepdims=True) - params)
         hess = rho * (1.0 - rho)
         lambdas = jnp.where(valid, lambdas, 0.0)
         hess = jnp.where(valid, hess, 0.0)
-        return self.scatter_back(lambdas), self.scatter_back(hess)
+        return lambdas, hess
+
+    def get_gradients(self, score):
+        # fresh gumbel noise per call (ref: Rands in GetGradientsForOneQuery)
+        self._iter += 1
+        keys = jax.random.split(jax.random.PRNGKey(self.seed + self._iter),
+                                len(self.buckets))
+        parts = [self._bucket_gradients(bk, score, k)
+                 for bk, k in zip(self.buckets, keys)]
+        return (self.scatter_back([p[0] for p in parts]),
+                self.scatter_back([p[1] for p in parts]))
 
     def to_string(self):
         return self.NAME

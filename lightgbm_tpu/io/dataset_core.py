@@ -23,6 +23,111 @@ from .binning import (BIN_CATEGORICAL, BIN_NUMERICAL, MISSING_NAN,
                       MISSING_NONE, MISSING_ZERO, BinMapper)
 
 
+class ColumnSource:
+    """Column-addressable view of a 2-D feature container.
+
+    The ingestion boundary: every input format (numpy, pandas, scipy
+    sparse, Arrow) exposes float64 columns on demand so binning never
+    materializes a full dense float copy of sparse/columnar data
+    (the role of the reference's Parser/ArrowChunkedArray adapters)."""
+
+    num_data: int
+    num_features: int
+
+    def get_col(self, f: int) -> np.ndarray:      # f64 [N]
+        raise NotImplementedError
+
+    def get_col_sample(self, f: int, rows: np.ndarray) -> np.ndarray:
+        """f64 [len(rows)] — override when sampling beats full conversion."""
+        return self.get_col(f)[rows]
+
+    def column_names(self) -> Optional[List[str]]:
+        return None
+
+    def to_dense_f32(self) -> Optional[np.ndarray]:
+        """Dense [N, F] f32 when cheaply available (linear trees)."""
+        return None
+
+
+class DenseColumns(ColumnSource):
+    def __init__(self, data: np.ndarray):
+        self.data = np.asarray(data)
+        self.num_data, self.num_features = self.data.shape
+
+    def get_col(self, f: int) -> np.ndarray:
+        return np.ascontiguousarray(self.data[:, f], dtype=np.float64)
+
+    def get_col_sample(self, f: int, rows: np.ndarray) -> np.ndarray:
+        return np.asarray(self.data[rows, f], dtype=np.float64)
+
+    def to_dense_f32(self) -> np.ndarray:
+        return np.asarray(self.data, np.float32)
+
+
+class SparseColumns(ColumnSource):
+    """scipy CSR/CSC/COO — densified one column at a time.
+
+    The quantized output is the same dense u8/u16 bin matrix (1-2 bytes
+    per cell vs 8 for float64); EFB bundling (io/bundling.py) then packs
+    mutually-exclusive sparse columns into shared physical groups — the
+    TPU answer to the reference's SparseBin + MultiValBin storage
+    (ref: src/io/sparse_bin.hpp:28, src/io/multi_val_sparse_bin.hpp)."""
+
+    def __init__(self, mat):
+        import scipy.sparse as sp
+        self.csc = sp.csc_matrix(mat) if not sp.issparse(mat) \
+            else mat.tocsc()
+        self.num_data, self.num_features = self.csc.shape
+        self._buf = np.zeros(self.num_data, np.float64)
+
+    def get_col(self, f: int) -> np.ndarray:
+        lo, hi = self.csc.indptr[f], self.csc.indptr[f + 1]
+        self._buf[:] = 0.0
+        self._buf[self.csc.indices[lo:hi]] = self.csc.data[lo:hi]
+        return self._buf
+
+    def get_col_sample(self, f: int, rows: np.ndarray) -> np.ndarray:
+        # O(nnz_f) intersection with the (sorted) sample rows — no full
+        # column densification during bin finding
+        lo, hi = self.csc.indptr[f], self.csc.indptr[f + 1]
+        idx = self.csc.indices[lo:hi]
+        vals = self.csc.data[lo:hi]
+        out = np.zeros(len(rows), np.float64)
+        pos = np.searchsorted(rows, idx)
+        ok = (pos < len(rows))
+        hit = ok & (rows[np.minimum(pos, len(rows) - 1)] == idx)
+        out[pos[hit]] = vals[hit]
+        return out
+
+
+class ArrowColumns(ColumnSource):
+    """pyarrow Table/RecordBatch — per-column conversion, no dense copy
+    (ref: include/LightGBM/arrow.h ArrowTable ingestion)."""
+
+    def __init__(self, table):
+        import pyarrow as pa
+        if isinstance(table, pa.RecordBatch):
+            table = pa.Table.from_batches([table])
+        self.table = table
+        self.num_data = table.num_rows
+        self.num_features = table.num_columns
+
+    def get_col(self, f: int) -> np.ndarray:
+        col = self.table.column(int(f))
+        # nulls become NaN (the reference maps Arrow nulls to NaN too)
+        return np.asarray(col.to_numpy(zero_copy_only=False),
+                          dtype=np.float64)
+
+    def column_names(self) -> List[str]:
+        return [str(n) for n in self.table.column_names]
+
+    def to_dense_f32(self) -> np.ndarray:
+        out = np.empty((self.num_data, self.num_features), np.float32)
+        for f in range(self.num_features):
+            out[:, f] = self.get_col(f)
+        return out
+
+
 class Metadata:
     """label/weight/init_score/query storage (ref: dataset.h:49)."""
 
@@ -114,15 +219,7 @@ class BinnedDataset:
     # ------------------------------------------------------------------
     @classmethod
     def from_matrix(cls, data: np.ndarray, config: Config,
-                    label: Optional[Sequence[float]] = None,
-                    weight: Optional[Sequence[float]] = None,
-                    group: Optional[Sequence[int]] = None,
-                    init_score: Optional[Sequence[float]] = None,
-                    position: Optional[Sequence[int]] = None,
-                    feature_names: Optional[List[str]] = None,
-                    categorical_features: Sequence[int] = (),
-                    reference: Optional["BinnedDataset"] = None,
-                    ) -> "BinnedDataset":
+                    **kwargs) -> "BinnedDataset":
         """Build from a dense [N, F] float matrix.
 
         (ref: DatasetLoader::ConstructFromSampleData dataset_loader.cpp:601;
@@ -132,13 +229,36 @@ class BinnedDataset:
         data = np.asarray(data)
         if data.ndim != 2:
             log.fatal("data must be 2-dimensional")
-        num_data, num_features = data.shape
+        return cls.from_columns(DenseColumns(data), config, **kwargs)
+
+    @classmethod
+    def from_columns(cls, source: "ColumnSource", config: Config,
+                     label: Optional[Sequence[float]] = None,
+                     weight: Optional[Sequence[float]] = None,
+                     group: Optional[Sequence[int]] = None,
+                     init_score: Optional[Sequence[float]] = None,
+                     position: Optional[Sequence[int]] = None,
+                     feature_names: Optional[List[str]] = None,
+                     categorical_features: Sequence[int] = (),
+                     reference: Optional["BinnedDataset"] = None,
+                     ) -> "BinnedDataset":
+        """Build from any column-addressable source (dense numpy, scipy
+        CSR/CSC, Arrow tables) WITHOUT materializing a dense float copy:
+        one float64 column at a time feeds bin finding + quantization.
+        The TPU translation of the reference's Bin/SparseBin/Arrow ingest
+        zoo (ref: src/io/sparse_bin.hpp, include/LightGBM/arrow.h) — all
+        sources quantize into the same feature-major u8/u16 matrix; EFB
+        bundling then compresses sparse groups physically."""
+        num_data, num_features = source.num_data, source.num_features
         self = cls()
         self.num_data = num_data
         self.num_total_features = num_features
         self.max_bin = config.max_bin
-        self.feature_names = (list(feature_names) if feature_names
-                              else [f"Column_{i}" for i in range(num_features)])
+        src_names = source.column_names()
+        self.feature_names = (
+            list(feature_names) if feature_names
+            else src_names if src_names
+            else [f"Column_{i}" for i in range(num_features)])
 
         if reference is not None:
             # align to reference's bin mappers (validation data path)
@@ -148,7 +268,7 @@ class BinnedDataset:
             self.feature_names = reference.feature_names
         else:
             self.bin_mappers = cls._find_bin_mappers(
-                data, config, categorical_features)
+                source, config, categorical_features)
             self.used_feature_map = np.asarray(
                 [i for i, m in enumerate(self.bin_mappers) if not m.is_trivial],
                 dtype=np.int32)
@@ -159,14 +279,18 @@ class BinnedDataset:
                            for i in self.used_feature_map), default=2)
         dtype = np.uint8 if max_num_bin <= 256 else np.uint16
         bins = np.empty((n_used, num_data), dtype=dtype)
-        col = np.empty(num_data, dtype=np.float64)
         for out_i, feat_i in enumerate(self.used_feature_map):
-            np.copyto(col, data[:, feat_i])
-            bins[out_i] = self.bin_mappers[feat_i].value_to_bin(col)
+            bins[out_i] = self.bin_mappers[feat_i].value_to_bin(
+                source.get_col(feat_i))
         self.bins = bins
 
         if config.linear_tree:
-            self.raw = np.asarray(data, np.float32)
+            raw = source.to_dense_f32()
+            if raw is None:
+                log.fatal("linear_tree requires raw feature values; "
+                          "sparse inputs are not supported with "
+                          "linear_tree=true")
+            self.raw = raw
 
         meta = Metadata(num_data)
         if label is not None:
@@ -180,13 +304,21 @@ class BinnedDataset:
 
     # ------------------------------------------------------------------
     @staticmethod
-    def _find_bin_mappers(data: np.ndarray, config: Config,
+    def _find_bin_mappers(source: "ColumnSource", config: Config,
                           categorical_features: Sequence[int],
                           sample_indices: Optional[np.ndarray] = None,
+                          total_rows: Optional[int] = None,
                           ) -> List[BinMapper]:
-        """Sample rows and find per-feature bin boundaries
-        (ref: dataset_loader.cpp:1080 ConstructBinMappersFromTextData)."""
-        num_data, num_features = data.shape
+        """Sample rows (``sample_indices`` must be sorted) and find
+        per-feature bin boundaries
+        (ref: dataset_loader.cpp:1080 ConstructBinMappersFromTextData).
+        ``total_rows`` overrides the population size when ``source`` holds
+        only a pre-drawn sample of a larger dataset (two_round loading)."""
+        if isinstance(source, np.ndarray):
+            source = DenseColumns(source)
+        num_data, num_features = source.num_data, source.num_features
+        if total_rows is None:
+            total_rows = num_data
         sample_cnt = min(config.bin_construct_sample_cnt, num_data)
         if sample_indices is None:
             if sample_cnt < num_data:
@@ -195,19 +327,19 @@ class BinnedDataset:
                                                     replace=False))
             else:
                 sample_indices = np.arange(num_data)
-        sample = np.asarray(data[sample_indices], dtype=np.float64)
         cat_set = set(int(c) for c in categorical_features)
 
         # pre-filter needs the split constraint (ref: dataset_loader.cpp
         # filter_cnt computation)
         filter_cnt = int(max(
-            config.min_data_in_leaf * len(sample_indices) / max(num_data, 1),
+            config.min_data_in_leaf * len(sample_indices)
+            / max(total_rows, 1),
             config.min_data_in_bin))
 
         mappers: List[BinMapper] = []
         max_bin_by_feature = config.max_bin_by_feature
         for f in range(num_features):
-            col = sample[:, f]
+            col = source.get_col_sample(f, sample_indices)
             bin_type = BIN_CATEGORICAL if f in cat_set else BIN_NUMERICAL
             mb = (max_bin_by_feature[f] if f < len(max_bin_by_feature)
                   else config.max_bin)

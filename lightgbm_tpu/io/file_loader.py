@@ -109,7 +109,16 @@ def load_svm_or_csv(path: str, config: Config
         keep = [j for j in range(ncol) if j not in drop]
         X = mat[:, keep]
 
-    # side files (ref: Metadata::Init — <data>.weight, <data>.query)
+    weight, group = load_side_files(path, weight, group_raw)
+    return X, y, weight, group
+
+
+def load_side_files(path: str, weight: Optional[np.ndarray],
+                    group_raw: Optional[np.ndarray]
+                    ) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+    """Sidecar metadata files + group-column conversion, shared by the
+    in-memory and two_round loaders (ref: metadata.cpp Metadata::Init —
+    <data>.weight, <data>.query/.group files)."""
     if weight is None and os.path.exists(path + ".weight"):
         weight = np.loadtxt(path + ".weight", dtype=np.float64).reshape(-1)
     group = None
@@ -125,7 +134,7 @@ def load_svm_or_csv(path: str, config: Config
         group = np.diff(starts)
         if len(np.unique(group_raw)) != len(group):
             log.fatal("Query ids in the group column must be contiguous")
-    return X, y, weight, group
+    return weight, group
 
 
 def _parse_libsvm(lines: List[str]) -> Tuple[np.ndarray, np.ndarray]:

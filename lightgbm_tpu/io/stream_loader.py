@@ -1,0 +1,300 @@
+"""Two-round streaming dataset loading with bounded memory.
+
+TPU-native equivalent of the reference's ``two_round`` loading path
+(ref: src/io/dataset_loader.cpp:266 LoadFromFile two_round branch, config
+``two_round``/``pre_partition`` docs/Parameters.rst): round one streams the
+file to count rows and collect the label/weight/group columns plus a
+row sample for bin finding; round two streams again and quantizes each
+chunk straight into the feature-major bin matrix. Peak memory is
+O(chunk + sample + bins) — the raw float matrix is never materialized,
+and the LibSVM path works from (row, col, value) triplets without ever
+densifying a chunk to full feature width.
+
+Byte-level parsing runs in the native C++ kernels (native/parser.cpp)
+when available.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..native import (iter_file_chunks, parse_dense_chunk,
+                      parse_libsvm_chunk)
+from ..utils import log
+from .dataset_core import BinnedDataset, DenseColumns, Metadata
+from .file_loader import _detect_format, _parse_column_spec, load_side_files
+
+
+def _read_head(path: str, n_lines: int = 20) -> List[str]:
+    out = []
+    with open(path, "rb") as f:
+        for _ in range(n_lines):
+            ln = f.readline()
+            if not ln:
+                break
+            out.append(ln.decode("utf-8", "replace").rstrip("\n"))
+    return out
+
+
+class _Reservoir:
+    """Vectorized Algorithm-R row reservoir (bin-finding sample)."""
+
+    def __init__(self, k: int, n_cols: int, seed: int):
+        self.k = k
+        self.buf = np.empty((k, n_cols), np.float64)
+        self.seen = 0
+        self.rng = np.random.default_rng(seed)
+
+    def offer(self, chunk: np.ndarray) -> None:
+        m = len(chunk)
+        if m == 0:
+            return
+        take = min(max(self.k - self.seen, 0), m)
+        if take:
+            self.buf[self.seen:self.seen + take] = chunk[:take]
+        if take < m:
+            rest = chunk[take:]
+            idx = self.seen + take + np.arange(len(rest))
+            draws = self.rng.integers(0, idx + 1)
+            sel = np.flatnonzero(draws < self.k)
+            # sequential overwrite semantics: later rows win
+            self.buf[draws[sel]] = rest[sel]
+        self.seen += m
+
+    def sample(self) -> np.ndarray:
+        return self.buf[:min(self.seen, self.k)]
+
+
+def _resolve_categoricals(categorical_feature, config: Config,
+                          feature_names: Optional[List[str]]) -> List[int]:
+    """Same semantics as the in-memory construct() path: ints index the
+    FEATURE columns; strings match feature names; config fallback."""
+    cats: List[int] = []
+    if isinstance(categorical_feature, (list, tuple)):
+        for c in categorical_feature:
+            if isinstance(c, int):
+                cats.append(c)
+            elif feature_names and c in feature_names:
+                cats.append(feature_names.index(c))
+            else:
+                log.warning(f"categorical_feature {c!r} not found in "
+                            "feature names; ignored")
+    elif config.categorical_feature:
+        cats = [int(c) for c in str(config.categorical_feature).split(",")
+                if c.strip() != ""]
+    return cats
+
+
+def _quantize_sparse_chunk(bins: np.ndarray, lo: int, n_chunk_rows: int,
+                           r: np.ndarray, c: np.ndarray, v: np.ndarray,
+                           used: np.ndarray, mappers,
+                           zero_bins: np.ndarray) -> None:
+    """Quantize a LibSVM chunk from triplets: implicit zeros take each
+    feature's precomputed zero bin; explicit values are binned per feature
+    (grouped by column — O(nnz log nnz), no dense [rows, F] buffer)."""
+    bins[:, lo:lo + n_chunk_rows] = zero_bins[:, None]
+    if len(c) == 0:
+        return
+    order = np.argsort(c, kind="stable")
+    cs, rs, vs = c[order], r[order], v[order]
+    # used[i] is the original feature id of output row i
+    starts = np.searchsorted(cs, used, side="left")
+    ends = np.searchsorted(cs, used, side="right")
+    for out_i, (fi, s, e) in enumerate(zip(used, starts, ends)):
+        if e > s:
+            bins[out_i, lo + rs[s:e]] = mappers[fi].value_to_bin(
+                np.ascontiguousarray(vs[s:e]))
+
+
+def load_binned_two_round(path: str, config: Config,
+                          categorical_feature=None,
+                          reference: Optional[BinnedDataset] = None,
+                          chunk_bytes: int = 32 << 20) -> BinnedDataset:
+    """Stream ``path`` and return a fully binned dataset.
+
+    ``reference`` reuses an existing dataset's bin mappers (validation
+    data must live in the training set's bin space, ref:
+    Dataset::CreateValid).
+    """
+    if not os.path.exists(path):
+        log.fatal(f"Data file {path} does not exist")
+    head = _read_head(path)
+    if not head:
+        log.fatal(f"Data file {path} is empty")
+    fmt = _detect_format(head)
+    header_names: Optional[List[str]] = None
+    skip = 0
+    sep = "," if fmt == "csv" else "\t"
+    if config.header and fmt in ("csv", "tsv"):
+        header_names = [t.strip() for t in head[0].split(sep)]
+        skip = 1
+    if fmt in ("csv", "tsv") and len(head) <= skip:
+        log.fatal(f"Data file {path} has no data rows")
+
+    label_col = _parse_column_spec(config.label_column or "0", header_names)
+    weight_col = (_parse_column_spec(config.weight_column, header_names)
+                  if config.weight_column else -1)
+    group_col = (_parse_column_spec(config.group_column, header_names)
+                 if config.group_column else -1)
+    ignore_cols = set()
+    if config.ignore_column:
+        for c in str(config.ignore_column).split(","):
+            if c.strip():
+                ignore_cols.add(_parse_column_spec(c.strip(), header_names))
+
+    sample_cnt = int(config.bin_construct_sample_cnt)
+    seed = int(config.data_random_seed)
+    if config.linear_tree:
+        log.fatal("linear_tree requires in-memory loading; "
+                  "set two_round=false")
+
+    sample_rows: Optional[np.ndarray] = None     # libsvm sample (csc)
+    if fmt == "libsvm":
+        # LibSVM's width is data-dependent: one extra streaming pass
+        # resolves (labels, row count, max feature id); the sample is then
+        # collected as TRIPLETS of pre-drawn rows — never densified
+        y_parts = []
+        max_col = -1
+        n_rows = 0
+        for chunk in iter_file_chunks(path, skip, chunk_bytes):
+            lab, r, c, v, mc = parse_libsvm_chunk(chunk)
+            max_col = max(max_col, mc)
+            y_parts.append(lab)
+            n_rows += len(lab)
+        if n_rows == 0:
+            log.fatal(f"Data file {path} has no data rows")
+        F = max_col + 1
+        y = np.concatenate(y_parts)
+        k = min(sample_cnt, n_rows)
+        rng = np.random.default_rng(seed)
+        sample_rows = (np.sort(rng.choice(n_rows, size=k, replace=False))
+                       if k < n_rows else np.arange(n_rows))
+        s_r, s_c, s_v = [], [], []
+        base = 0
+        for chunk in iter_file_chunks(path, skip, chunk_bytes):
+            lab, r, c, v, _ = parse_libsvm_chunk(chunk)
+            g = base + r.astype(np.int64)           # global row ids
+            pos = np.searchsorted(sample_rows, g)
+            ok = pos < len(sample_rows)
+            hit = ok & (sample_rows[np.minimum(pos, len(sample_rows) - 1)]
+                        == g)
+            s_r.append(pos[hit])
+            s_c.append(c[hit])
+            s_v.append(v[hit])
+            base += len(lab)
+        import scipy.sparse as sp
+        sample_mat = sp.csc_matrix(
+            (np.concatenate(s_v) if s_v else np.zeros(0),
+             (np.concatenate(s_r) if s_r else np.zeros(0, np.int64),
+              np.concatenate(s_c) if s_c else np.zeros(0, np.int64))),
+            shape=(len(sample_rows), F))
+        from .dataset_core import SparseColumns
+        sample_source = SparseColumns(sample_mat)
+        feat_cols = list(range(F))
+        weight = None
+        group_raw = None
+        n_cols = 0
+    else:
+        n_cols = len(head[skip].split(sep))
+        drop = {label_col} | ignore_cols
+        if weight_col >= 0:
+            drop.add(weight_col)
+        if group_col >= 0:
+            drop.add(group_col)
+        feat_cols = [j for j in range(n_cols) if j not in drop]
+        F = len(feat_cols)
+        # ---- round 1: count/labels/metadata + reservoir sample ---------
+        y_parts, w_parts, g_parts = [], [], []
+        n_rows = 0
+        res = _Reservoir(sample_cnt, F, seed)
+        for chunk in iter_file_chunks(path, skip, chunk_bytes):
+            mat = parse_dense_chunk(chunk, sep, n_cols)
+            n_rows += len(mat)
+            y_parts.append(mat[:, label_col].copy())
+            if weight_col >= 0:
+                w_parts.append(mat[:, weight_col].copy())
+            if group_col >= 0:
+                g_parts.append(mat[:, group_col].copy())
+            res.offer(mat[:, feat_cols])
+        if n_rows == 0:
+            log.fatal(f"Data file {path} has no data rows")
+        y = np.concatenate(y_parts)
+        weight = np.concatenate(w_parts) if w_parts else None
+        group_raw = np.concatenate(g_parts) if g_parts else None
+        sample_source = DenseColumns(res.sample())
+
+    feature_names = None
+    if header_names is not None:
+        feature_names = [header_names[j] for j in feat_cols]
+
+    # ---- bin mappers (fresh from the sample, or the reference's) -------
+    if reference is not None:
+        mappers = reference.bin_mappers
+        used = reference.used_feature_map
+        feature_names = reference.feature_names
+        if len(mappers) != F:
+            log.fatal(f"Validation file {path} has {F} features but the "
+                      f"reference dataset has {len(mappers)}")
+    else:
+        cats = _resolve_categoricals(categorical_feature, config,
+                                     feature_names)
+        mappers = BinnedDataset._find_bin_mappers(
+            sample_source, config, cats,
+            sample_indices=np.arange(sample_source.num_data),
+            total_rows=n_rows)
+        used = np.asarray(
+            [i for i, m in enumerate(mappers) if not m.is_trivial],
+            np.int32)
+
+    max_num_bin = max((mappers[i].num_bin for i in used), default=2)
+    dtype = np.uint8 if max_num_bin <= 256 else np.uint16
+    bins = np.empty((len(used), n_rows), dtype)
+
+    # ---- round 2: quantize chunk-by-chunk ------------------------------
+    lo = 0
+    if fmt == "libsvm":
+        zero_bins = np.asarray(
+            [mappers[fi].value_to_bin(np.zeros(1))[0] for fi in used],
+            dtype)
+        for chunk in iter_file_chunks(path, skip, chunk_bytes):
+            lab, r, c, v, _ = parse_libsvm_chunk(chunk)
+            keep = c < F
+            _quantize_sparse_chunk(bins, lo, len(lab), r[keep], c[keep],
+                                   v[keep], used, mappers, zero_bins)
+            lo += len(lab)
+    else:
+        for chunk in iter_file_chunks(path, skip, chunk_bytes):
+            mat = parse_dense_chunk(chunk, sep, n_cols)
+            feat = mat[:, feat_cols]
+            hi = lo + len(feat)
+            for out_i, fi in enumerate(used):
+                bins[out_i, lo:hi] = mappers[fi].value_to_bin(
+                    np.ascontiguousarray(feat[:, fi], np.float64))
+            lo = hi
+
+    ds = BinnedDataset()
+    ds.num_data = n_rows
+    ds.num_total_features = F
+    ds.max_bin = config.max_bin if reference is None else reference.max_bin
+    ds.bin_mappers = mappers
+    ds.used_feature_map = used
+    ds.bins = bins
+    ds.feature_names = (feature_names if feature_names
+                        else [f"Column_{i}" for i in range(F)])
+
+    # ---- metadata + side files (shared helper) -------------------------
+    meta = Metadata(n_rows)
+    meta.set_label(y.astype(np.float32))
+    weight, group = load_side_files(path, weight, group_raw)
+    if weight is not None:
+        meta.set_weight(weight)
+    if group is not None:
+        meta.set_query(group)
+    if os.path.exists(path + ".position"):
+        meta.set_position(np.loadtxt(path + ".position",
+                                     dtype=np.int64).reshape(-1))
+    ds.metadata = meta
+    return ds

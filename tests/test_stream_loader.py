@@ -1,0 +1,169 @@
+"""Two-round streaming loader + native parser: parity with the in-memory
+loader on CSV/TSV/LibSVM, side files, tiny chunk sizes (many chunks), and
+the native-vs-fallback parser kernels (ref: dataset_loader.cpp two_round)."""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.stream_loader import load_binned_two_round
+from lightgbm_tpu.native import (get_lib, parse_dense_chunk,
+                                 parse_libsvm_chunk)
+
+
+def _write_csv(path, X, y, weight=None, query=None):
+    arr = np.column_stack([y, X])
+    np.savetxt(path, arr, delimiter=",", fmt="%.8g")
+    if weight is not None:
+        np.savetxt(str(path) + ".weight", weight, fmt="%.6f")
+    if query is not None:
+        np.savetxt(str(path) + ".query", query, fmt="%d")
+
+
+def test_native_lib_builds():
+    assert get_lib() is not None, "g++ toolchain present; native must build"
+
+
+def test_parse_dense_native_matches_fallback(monkeypatch):
+    chunk = b"1.5,2.5,na\n-3,,7e2\nnan,8,9\n"
+    a = parse_dense_chunk(chunk, ",", 3)
+    import lightgbm_tpu.native as nat
+    monkeypatch.setattr(nat, "_lib", None)
+    monkeypatch.setattr(nat, "_tried", True)
+    b = parse_dense_chunk(chunk, ",", 3)
+    np.testing.assert_array_equal(np.isnan(a), np.isnan(b))
+    np.testing.assert_allclose(np.nan_to_num(a), np.nan_to_num(b))
+
+
+def test_parse_libsvm_qid_skipped():
+    lab, r, c, v, mc = parse_libsvm_chunk(b"2 qid:7 1:0.5 3:1\n")
+    assert lab[0] == 2.0
+    np.testing.assert_array_equal(c, [1, 3])
+    assert mc == 3
+
+
+def test_stream_matches_inmemory_csv(rng, tmp_path):
+    X = rng.normal(size=(800, 6))
+    y = (X[:, 0] > 0).astype(np.float64)
+    path = str(tmp_path / "d.csv")
+    _write_csv(path, X, y)
+    params = {"objective": "binary", "verbose": -1, "min_data_in_leaf": 5}
+    ds_mem = lgb.Dataset(path, params=params).construct()
+    ds_str = lgb.Dataset(path, params=dict(params, two_round=True)
+                         ).construct()
+    np.testing.assert_array_equal(ds_mem.binned.bins, ds_str.binned.bins)
+    np.testing.assert_array_equal(ds_mem.binned.metadata.label,
+                                  ds_str.binned.metadata.label)
+
+
+def test_stream_tiny_chunks(rng, tmp_path):
+    # chunk smaller than a line's worth of data exercises the carry logic
+    X = rng.normal(size=(200, 4))
+    y = rng.normal(size=200)
+    path = str(tmp_path / "d.csv")
+    _write_csv(path, X, y)
+    cfg = Config({"two_round": True})
+    ds_small = load_binned_two_round(path, cfg, chunk_bytes=256)
+    ds_big = load_binned_two_round(path, cfg, chunk_bytes=32 << 20)
+    np.testing.assert_array_equal(ds_small.bins, ds_big.bins)
+    assert ds_small.num_data == 200
+
+
+def test_stream_side_files_and_training(rng, tmp_path):
+    sizes = rng.integers(5, 15, size=20)
+    n = int(sizes.sum())
+    X = rng.normal(size=(n, 5))
+    y = rng.integers(0, 3, size=n).astype(np.float64)
+    w = rng.uniform(0.5, 1.5, size=n)
+    path = str(tmp_path / "rank.csv")
+    _write_csv(path, X, y, weight=w, query=sizes)
+    bst = lgb.train({"objective": "lambdarank", "verbose": -1,
+                     "two_round": True, "min_data_in_leaf": 3},
+                    lgb.Dataset(path), num_boost_round=5)
+    assert bst.num_trees() == 5
+
+
+def test_stream_libsvm(rng, tmp_path):
+    n, f = 300, 8
+    X = np.zeros((n, f))
+    mask = rng.uniform(size=(n, f)) < 0.3
+    X[mask] = rng.normal(size=int(mask.sum()))
+    y = (X[:, 0] > 0).astype(int)
+    path = str(tmp_path / "d.svm")
+    with open(path, "w") as fh:
+        for i in range(n):
+            nz = np.flatnonzero(X[i])
+            fields = " ".join(f"{j}:{X[i, j]:.6g}" for j in nz)
+            fh.write(f"{y[i]} {fields}\n")
+    params = {"objective": "binary", "verbose": -1, "min_data_in_leaf": 5}
+    ds_mem = lgb.Dataset(path, params=params).construct()
+    ds_str = lgb.Dataset(path, params=dict(params, two_round=True)
+                         ).construct()
+    assert ds_str.binned.num_data == n
+    np.testing.assert_array_equal(ds_mem.binned.metadata.label,
+                                  ds_str.binned.metadata.label)
+    np.testing.assert_array_equal(ds_mem.binned.bins, ds_str.binned.bins)
+
+
+def test_stream_valid_set_uses_reference_mappers(rng, tmp_path):
+    # validation data must be quantized with the TRAIN set's bin mappers
+    X_tr = rng.normal(size=(400, 5))
+    y_tr = rng.normal(size=400)
+    X_va = rng.normal(scale=3.0, size=(100, 5))   # different distribution
+    y_va = rng.normal(size=100)
+    p_tr = str(tmp_path / "tr.csv")
+    p_va = str(tmp_path / "va.csv")
+    _write_csv(p_tr, X_tr, y_tr)
+    _write_csv(p_va, X_va, y_va)
+    params = {"objective": "regression", "verbose": -1, "two_round": True,
+              "min_data_in_leaf": 5}
+    train = lgb.Dataset(p_tr, params=params)
+    valid = lgb.Dataset(p_va, params=params, reference=train)
+    valid.construct()
+    tb, vb = train.binned, valid.binned
+    for mt, mv in zip(tb.bin_mappers, vb.bin_mappers):
+        np.testing.assert_array_equal(mt.bin_upper_bound, mv.bin_upper_bound)
+    # and the eval loop runs in the shared bin space
+    evals = {}
+    lgb.train(params, train, num_boost_round=5, valid_sets=[valid],
+              callbacks=[lgb.record_evaluation(evals)])
+    assert "valid_0" in evals
+
+
+def test_stream_libsvm_wide_sparse_bounded(rng, tmp_path):
+    # feature ids up to ~20k with tiny rows: the loader must not densify
+    # chunks to full width (chunk x F would be ~1.6 GB at float64)
+    n, f_hi = 400, 20000
+    path = str(tmp_path / "wide.svm")
+    with open(path, "w") as fh:
+        for i in range(n):
+            cols = np.sort(rng.choice(f_hi, size=3, replace=False))
+            fields = " ".join(f"{j}:{rng.normal():.4g}" for j in cols)
+            fh.write(f"{i % 2} {fields}\n")
+    cfg = Config({"two_round": True, "min_data_in_bin": 1,
+                  "min_data_in_leaf": 1, "feature_pre_filter": False})
+    ds = load_binned_two_round(path, cfg, chunk_bytes=4096)
+    assert ds.num_data == n
+    assert ds.num_total_features == 20000 or ds.num_total_features > 10000
+
+
+def test_stream_header_and_columns(rng, tmp_path):
+    n = 150
+    X = rng.normal(size=(n, 3))
+    y = rng.normal(size=n)
+    w = rng.uniform(1, 2, size=n)
+    path = str(tmp_path / "h.csv")
+    with open(path, "w") as fh:
+        fh.write("target,a,b,wcol,c\n")
+        for i in range(n):
+            fh.write(f"{y[i]:.6g},{X[i,0]:.6g},{X[i,1]:.6g},"
+                     f"{w[i]:.6g},{X[i,2]:.6g}\n")
+    cfg = Config({"header": True, "label_column": "name:target",
+                  "weight_column": "name:wcol", "two_round": True})
+    ds = load_binned_two_round(path, cfg)
+    assert ds.num_data == n
+    assert ds.feature_names == ["a", "b", "c"]
+    np.testing.assert_allclose(ds.metadata.weight, w, rtol=1e-5)
+    np.testing.assert_allclose(ds.metadata.label, y, rtol=1e-5)
