@@ -20,7 +20,8 @@ from ..utils import log
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _BUILD_DIR = os.path.join(_HERE, "_build")
 _SO_PATH = os.path.join(_BUILD_DIR, "lgbm_native.so")
-_SRC = os.path.join(_HERE, "parser.cpp")
+_SRCS = [os.path.join(_HERE, "parser.cpp"),
+         os.path.join(_HERE, "c_api.cpp")]
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -30,9 +31,10 @@ _tried = False
 def _build() -> Optional[str]:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     if (os.path.exists(_SO_PATH) and
-            os.path.getmtime(_SO_PATH) >= os.path.getmtime(_SRC)):
+            os.path.getmtime(_SO_PATH) >= max(os.path.getmtime(s)
+                                              for s in _SRCS)):
         return _SO_PATH
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", *_SRCS,
            "-o", _SO_PATH + ".tmp"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)

@@ -1,0 +1,492 @@
+// Native C API: model loading + prediction without Python/JAX.
+//
+// Serving-side counterpart of the reference's C ABI
+// (ref: include/LightGBM/c_api.h, src/c_api.cpp:170 Booster wrapper,
+// src/io/tree.cpp:761 Tree::Split decision semantics). The training path
+// in this framework is JAX/XLA and is reached through the Python API; the
+// C API covers the deployment surface — load a saved model.txt and predict
+// from C/C++/any FFI with no interpreter in the process.
+//
+// ABI compatibility: the exported LGBM_* signatures match the reference's
+// c_api.h for the implemented subset (Createfromodelfile / LoadModelFromString
+// / Free / GetNumClasses / GetNumFeature / GetCurrentIteration /
+// NumModelPerIteration / PredictForMat / GetLastError), so FFI callers can
+// switch by swapping the shared library. Unimplemented entry points
+// (training, SHAP) return -1 with a descriptive LGBM_GetLastError message.
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error = "everything is fine";
+
+void SetError(const std::string& msg) { g_last_error = msg; }
+
+struct Tree {
+  int num_leaves = 1;
+  int num_cat = 0;
+  bool is_linear = false;
+  std::vector<int> split_feature;
+  std::vector<double> threshold;
+  std::vector<int8_t> decision_type;
+  std::vector<int> left_child, right_child;
+  std::vector<double> leaf_value;
+  std::vector<int64_t> cat_boundaries;
+  std::vector<uint32_t> cat_threshold;
+  // linear trees (ref: tree.cpp:385 linear block)
+  std::vector<double> leaf_const;
+  std::vector<std::vector<int>> leaf_features;
+  std::vector<std::vector<double>> leaf_coeff;
+
+  bool CatInBitset(int cat_idx, double x) const {
+    if (std::isnan(x) || x < 0) return false;
+    int64_t v = static_cast<int64_t>(std::floor(x));
+    int64_t lo = cat_boundaries[cat_idx];
+    int64_t hi = cat_boundaries[cat_idx + 1];
+    int64_t word = lo + (v / 32);
+    if (word >= hi ||
+        word >= static_cast<int64_t>(cat_threshold.size()))
+      return false;
+    return (cat_threshold[word] >> (v % 32)) & 1u;
+  }
+
+  int PredictLeaf(const double* row) const {
+    if (num_leaves <= 1) return 0;
+    int node = 0;
+    while (true) {
+      int8_t dt = decision_type[node];
+      double x = row[split_feature[node]];
+      bool go_left;
+      if (dt & 1) {  // categorical (bitset membership; NaN/unseen right)
+        go_left = CatInBitset(static_cast<int>(threshold[node]), x);
+      } else {
+        // numerical: bit1 default_left, bits2-3 missing type
+        // (semantics mirror core/tree.py predict_leaf exactly)
+        bool dl = dt & 2;
+        int mtype = (dt >> 2) & 3;
+        bool is_nan = std::isnan(x);
+        double x0 = is_nan ? 0.0 : x;
+        bool miss = (mtype == 2) ? is_nan
+                                 : (mtype == 1 && std::fabs(x0) <= 1e-35);
+        go_left = miss ? dl : (x0 <= threshold[node]);
+      }
+      int child = go_left ? left_child[node] : right_child[node];
+      if (child < 0) return ~child;
+      node = child;
+    }
+  }
+
+  double Predict(const double* row) const {
+    int leaf = PredictLeaf(row);
+    if (!is_linear) return leaf_value[leaf];
+    // linear leaf: const + <coeff, x>; NaN in any used feature falls
+    // back to the constant (ref: tree.cpp PredictionFunLinear)
+    double out = leaf_const[leaf];
+    const auto& feats = leaf_features[leaf];
+    const auto& coef = leaf_coeff[leaf];
+    double lin = 0.0;
+    bool has_nan = false;
+    for (size_t i = 0; i < feats.size(); ++i) {
+      double x = row[feats[i]];
+      if (std::isnan(x)) { has_nan = true; break; }
+      lin += coef[i] * x;
+    }
+    return has_nan ? out : out + lin;
+  }
+};
+
+enum class Transform { kNone, kSigmoid, kExp, kSoftmax, kSigmoidPerClass,
+                       kLog1pExp, kSqrtSquare };
+
+struct Model {
+  int num_class = 1;
+  int num_tree_per_iteration = 1;
+  int max_feature_idx = 0;
+  double sigmoid = 1.0;
+  bool average_output = false;
+  Transform transform = Transform::kNone;
+  std::string objective;
+  std::vector<Tree> trees;
+
+  int NumIterations() const {
+    return num_tree_per_iteration > 0
+               ? static_cast<int>(trees.size()) / num_tree_per_iteration
+               : 0;
+  }
+};
+
+// ---- parsing --------------------------------------------------------------
+
+std::vector<double> ParseDoubles(const std::string& s) {
+  std::vector<double> out;
+  const char* p = s.c_str();
+  char* e = nullptr;
+  while (*p) {
+    while (*p == ' ' || *p == '\t') ++p;
+    if (!*p) break;
+    double v = std::strtod(p, &e);
+    if (e == p) break;
+    out.push_back(v);
+    p = e;
+  }
+  return out;
+}
+
+std::vector<int64_t> ParseInts(const std::string& s) {
+  std::vector<int64_t> out;
+  for (double v : ParseDoubles(s)) out.push_back(static_cast<int64_t>(v));
+  return out;
+}
+
+bool ParseTreeBlock(const std::map<std::string, std::string>& kv, Tree* t) {
+  auto get = [&](const char* k) -> const std::string& {
+    static const std::string kEmpty;
+    auto it = kv.find(k);
+    return it == kv.end() ? kEmpty : it->second;
+  };
+  t->num_leaves = static_cast<int>(std::atoll(get("num_leaves").c_str()));
+  t->num_cat = static_cast<int>(std::atoll(get("num_cat").c_str()));
+  int n = t->num_leaves, ni = n - 1;
+  t->leaf_value = ParseDoubles(get("leaf_value"));
+  if (static_cast<int>(t->leaf_value.size()) != n) return false;
+  if (ni > 0) {
+    auto sf = ParseInts(get("split_feature"));
+    t->threshold = ParseDoubles(get("threshold"));
+    auto dt = ParseInts(get("decision_type"));
+    auto lc = ParseInts(get("left_child"));
+    auto rc = ParseInts(get("right_child"));
+    if (static_cast<int>(sf.size()) != ni ||
+        static_cast<int>(t->threshold.size()) != ni ||
+        static_cast<int>(lc.size()) != ni ||
+        static_cast<int>(rc.size()) != ni)
+      return false;
+    t->split_feature.assign(sf.begin(), sf.end());
+    t->decision_type.resize(ni);
+    for (int i = 0; i < ni; ++i)
+      t->decision_type[i] =
+          static_cast<int8_t>(i < static_cast<int>(dt.size()) ? dt[i] : 0);
+    t->left_child.assign(lc.begin(), lc.end());
+    t->right_child.assign(rc.begin(), rc.end());
+    // children indices must stay in range (leaf refs are ~idx < 0)
+    for (int i = 0; i < ni; ++i) {
+      if (t->left_child[i] >= ni || t->left_child[i] < -n ||
+          t->right_child[i] >= ni || t->right_child[i] < -n)
+        return false;
+    }
+  }
+  if (t->num_cat > 0) {
+    t->cat_boundaries = ParseInts(get("cat_boundaries"));
+    auto ct = ParseInts(get("cat_threshold"));
+    t->cat_threshold.assign(ct.begin(), ct.end());
+    // every categorical node's threshold is an index into cat_boundaries
+    for (int i = 0; i < ni; ++i) {
+      if (!(t->decision_type[i] & 1)) continue;
+      int64_t ci = static_cast<int64_t>(t->threshold[i]);
+      if (ci < 0 ||
+          ci + 1 >= static_cast<int64_t>(t->cat_boundaries.size()))
+        return false;
+    }
+  }
+  if (t->num_cat > 0) {
+    // categorical tables must be self-consistent or traversal would read
+    // out of bounds (CatInBitset indexes by node threshold)
+    if (t->cat_boundaries.size() < 2 ||
+        t->cat_boundaries.front() != 0 ||
+        static_cast<int64_t>(t->cat_threshold.size()) !=
+            t->cat_boundaries.back())
+      return false;
+    for (size_t i = 1; i < t->cat_boundaries.size(); ++i)
+      if (t->cat_boundaries[i] < t->cat_boundaries[i - 1]) return false;
+  }
+  t->is_linear = std::atoi(get("is_linear").c_str()) != 0;
+  if (t->is_linear) {
+    t->leaf_const = ParseDoubles(get("leaf_const"));
+    auto nf = ParseInts(get("num_features"));
+    auto ff = ParseInts(get("leaf_features"));
+    auto cc = ParseDoubles(get("leaf_coeff"));
+    if (static_cast<int>(t->leaf_const.size()) != n ||
+        static_cast<int>(nf.size()) != n || ff.size() != cc.size())
+      return false;
+    int64_t total = 0;
+    for (auto k : nf) total += k;
+    if (total != static_cast<int64_t>(ff.size())) return false;
+    t->leaf_features.resize(n);
+    t->leaf_coeff.resize(n);
+    size_t pos = 0;
+    for (int i = 0; i < n; ++i) {
+      size_t k = static_cast<size_t>(nf[i]);
+      for (size_t j = 0; j < k; ++j) {
+        t->leaf_features[i].push_back(static_cast<int>(ff[pos + j]));
+        t->leaf_coeff[i].push_back(cc[pos + j]);
+      }
+      pos += k;
+    }
+  }
+  return true;
+}
+
+Model* ParseModelString(const std::string& text) {
+  auto model = std::make_unique<Model>();
+  std::istringstream in(text);
+  std::string line;
+  std::map<std::string, std::string> kv;
+  bool in_tree = false;
+  bool saw_magic = false;
+
+  auto flush_tree = [&]() -> bool {
+    if (!in_tree) return true;
+    Tree t;
+    if (!ParseTreeBlock(kv, &t)) return false;
+    model->trees.push_back(std::move(t));
+    kv.clear();
+    return true;
+  };
+
+  while (std::getline(in, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    if (line.empty()) continue;
+    if (!saw_magic) {
+      // the format begins with the literal magic line "tree"
+      // (ref: gbdt_model_text.cpp SaveModelToString header)
+      if (line != "tree") return nullptr;
+      saw_magic = true;
+      continue;
+    }
+    if (line == "average_output") {
+      model->average_output = true;
+      continue;
+    }
+    if (line.rfind("Tree=", 0) == 0) {
+      if (!flush_tree()) return nullptr;
+      in_tree = true;
+      continue;
+    }
+    if (line == "end of trees") {
+      if (!flush_tree()) return nullptr;
+      in_tree = false;
+      continue;
+    }
+    auto eq = line.find('=');
+    if (eq == std::string::npos) continue;
+    std::string key = line.substr(0, eq);
+    std::string val = line.substr(eq + 1);
+    if (in_tree) {
+      kv[key] = val;
+    } else if (key == "num_class") {
+      model->num_class = std::atoi(val.c_str());
+    } else if (key == "num_tree_per_iteration") {
+      model->num_tree_per_iteration = std::atoi(val.c_str());
+    } else if (key == "max_feature_idx") {
+      model->max_feature_idx = std::atoi(val.c_str());
+    } else if (key == "objective") {
+      model->objective = val;
+      std::string name = val.substr(0, val.find(' '));
+      auto sp = val.find("sigmoid:");
+      if (sp != std::string::npos)
+        model->sigmoid = std::atof(val.c_str() + sp + 8);
+      if (name == "binary" || name == "cross_entropy") {
+        model->transform = Transform::kSigmoid;
+      } else if (name == "cross_entropy_lambda") {
+        // ref: CrossEntropyLambda::ConvertOutput = log1p(exp(raw))
+        model->transform = Transform::kLog1pExp;
+      } else if (name == "poisson" || name == "gamma" ||
+                 name == "tweedie") {
+        model->transform = Transform::kExp;
+      } else if (name == "multiclass" || name == "softmax") {
+        model->transform = Transform::kSoftmax;
+      } else if (name == "multiclassova" || name == "multiclass_ova") {
+        model->transform = Transform::kSigmoidPerClass;
+      } else if (name == "regression" &&
+                 val.find(" sqrt") != std::string::npos) {
+        // reg_sqrt: labels trained in sqrt space
+        model->transform = Transform::kSqrtSquare;
+      }
+    }
+  }
+  if (!flush_tree()) return nullptr;
+  if (!saw_magic) return nullptr;
+  return model.release();
+}
+
+void TransformRow(const Model& m, double* scores) {
+  switch (m.transform) {
+    case Transform::kNone:
+      break;
+    case Transform::kSigmoid:
+      scores[0] = 1.0 / (1.0 + std::exp(-m.sigmoid * scores[0]));
+      break;
+    case Transform::kExp:
+      scores[0] = std::exp(scores[0]);
+      break;
+    case Transform::kSigmoidPerClass:
+      for (int k = 0; k < m.num_class; ++k)
+        scores[k] = 1.0 / (1.0 + std::exp(-m.sigmoid * scores[k]));
+      break;
+    case Transform::kLog1pExp:
+      scores[0] = std::log1p(std::exp(scores[0]));
+      break;
+    case Transform::kSqrtSquare:
+      scores[0] = std::copysign(scores[0] * scores[0], scores[0]);
+      break;
+    case Transform::kSoftmax: {
+      double mx = scores[0];
+      for (int k = 1; k < m.num_class; ++k)
+        if (scores[k] > mx) mx = scores[k];
+      double sum = 0.0;
+      for (int k = 0; k < m.num_class; ++k) {
+        scores[k] = std::exp(scores[k] - mx);
+        sum += scores[k];
+      }
+      for (int k = 0; k < m.num_class; ++k) scores[k] /= sum;
+      break;
+    }
+  }
+}
+
+inline void FillRow(const void* data, int data_type, int64_t r, int32_t ncol,
+                    int is_row_major, int64_t nrow, double* row) {
+  if (data_type == 0) {  // C_API_DTYPE_FLOAT32
+    const float* d = static_cast<const float*>(data);
+    for (int32_t c = 0; c < ncol; ++c)
+      row[c] = is_row_major ? d[r * ncol + c] : d[c * nrow + r];
+  } else {  // C_API_DTYPE_FLOAT64
+    const double* d = static_cast<const double*>(data);
+    for (int32_t c = 0; c < ncol; ++c)
+      row[c] = is_row_major ? d[r * ncol + c] : d[c * nrow + r];
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef void* BoosterHandle;
+
+const char* LGBM_GetLastError() { return g_last_error.c_str(); }
+
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out) {
+  std::ifstream f(filename);
+  if (!f) {
+    SetError(std::string("could not open model file ") + filename);
+    return -1;
+  }
+  std::stringstream ss;
+  ss << f.rdbuf();
+  Model* m = ParseModelString(ss.str());
+  if (!m) {
+    SetError(std::string("could not parse model file ") + filename);
+    return -1;
+  }
+  *out_num_iterations = m->NumIterations();
+  *out = m;
+  return 0;
+}
+
+int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out) {
+  Model* m = ParseModelString(model_str);
+  if (!m) {
+    SetError("could not parse model string");
+    return -1;
+  }
+  *out_num_iterations = m->NumIterations();
+  *out = m;
+  return 0;
+}
+
+int LGBM_BoosterFree(BoosterHandle handle) {
+  delete static_cast<Model*>(handle);
+  return 0;
+}
+
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len) {
+  *out_len = static_cast<Model*>(handle)->num_class;
+  return 0;
+}
+
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len) {
+  *out_len = static_cast<Model*>(handle)->max_feature_idx + 1;
+  return 0;
+}
+
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out) {
+  *out = static_cast<Model*>(handle)->NumIterations();
+  return 0;
+}
+
+int LGBM_BoosterNumModelPerIteration(BoosterHandle handle, int* out) {
+  *out = static_cast<Model*>(handle)->num_tree_per_iteration;
+  return 0;
+}
+
+// predict_type: 0 normal, 1 raw score, 2 leaf index (contrib is served by
+// the Python API's pred_contrib; returns -1 here).
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char* /*parameter*/, int64_t* out_len,
+                              double* out_result) {
+  Model* m = static_cast<Model*>(handle);
+  if (data_type != 0 && data_type != 1) {
+    SetError("only float32 (0) / float64 (1) data are supported");
+    return -1;
+  }
+  if (ncol < m->max_feature_idx + 1) {
+    SetError("input has fewer columns than the model's features");
+    return -1;
+  }
+  int total_iter = m->NumIterations();
+  int end_iter = (num_iteration <= 0)
+                     ? total_iter
+                     : std::min(total_iter, start_iteration + num_iteration);
+  int K = m->num_tree_per_iteration;
+  std::vector<double> row(ncol);
+
+  if (predict_type == 2) {  // leaf indices, [nrow, num_trees_used]
+    int n_used = (end_iter - start_iteration) * K;
+    for (int64_t r = 0; r < nrow; ++r) {
+      FillRow(data, data_type, r, ncol, is_row_major, nrow, row.data());
+      double* out = out_result + r * n_used;
+      int j = 0;
+      for (int it = start_iteration; it < end_iter; ++it)
+        for (int k = 0; k < K; ++k)
+          out[j++] = m->trees[it * K + k].PredictLeaf(row.data());
+    }
+    *out_len = static_cast<int64_t>(nrow) * n_used;
+    return 0;
+  }
+  if (predict_type != 0 && predict_type != 1) {
+    SetError("predict_type must be 0 (normal), 1 (raw) or 2 (leaf index); "
+             "SHAP contributions are available via the Python API");
+    return -1;
+  }
+  int n_iter_used = end_iter - start_iteration;
+  for (int64_t r = 0; r < nrow; ++r) {
+    FillRow(data, data_type, r, ncol, is_row_major, nrow, row.data());
+    double* out = out_result + r * K;
+    for (int k = 0; k < K; ++k) out[k] = 0.0;
+    for (int it = start_iteration; it < end_iter; ++it)
+      for (int k = 0; k < K; ++k)
+        out[k] += m->trees[it * K + k].Predict(row.data());
+    if (m->average_output && n_iter_used > 0)
+      for (int k = 0; k < K; ++k) out[k] /= n_iter_used;  // rf averaging
+    if (predict_type == 0) TransformRow(*m, out);
+  }
+  *out_len = static_cast<int64_t>(nrow) * K;
+  return 0;
+}
+
+}  // extern "C"
