@@ -230,6 +230,11 @@ _reg("tpu_min_bucket", int, 2048, ())        # smallest pow2 segment bucket
 _reg("tpu_use_pallas", bool, False, ())      # Pallas histogram kernel (off until tuned)
 _reg("tpu_rows_per_block", int, 1024, ())    # row tile for histogram kernels
 _reg("tpu_donate_state", bool, True, ())     # donate training state buffers
+# device tracing (SURVEY §5 tracing: jax.profiler traces + the named-
+# section wall-clock table ≡ the reference's USE_TIMETAG global_timer).
+# Set to a directory to capture a jax.profiler trace of the training loop
+# (view with tensorboard or xprof).
+_reg("tpu_profile_dir", str, "", ())
 
 # objective alias names accepted for each canonical objective
 OBJECTIVE_ALIASES = {

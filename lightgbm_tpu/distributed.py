@@ -1,0 +1,87 @@
+"""Multi-host distributed training entry points.
+
+Role-equivalent of the reference's cluster integrations — the Dask
+interface (ref: python-package/lightgbm/dask.py:442 _train) and the
+machines/machine-list-file socket setup (ref: src/network/linkers_socket.cpp,
+config machines/num_machines/local_listen_port). The TPU translation is
+SPMD: every host runs THE SAME program over one global
+``jax.sharding.Mesh`` that spans all hosts' devices; jax's runtime routes
+the grower's ``psum``/``all_gather`` collectives over ICI/DCN, so there is
+no per-framework socket/MPI layer to configure — ``init_distributed`` is
+the only cluster-shaped call, and it wraps ``jax.distributed.initialize``.
+
+Single-host multi-device needs none of this: ``tree_learner=data`` with
+``tpu_num_devices`` already shards over local devices.
+
+Typical multi-host launch (one process per host, same script):
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.distributed import init_distributed
+
+    init_distributed(coordinator_address="host0:8476",
+                     num_processes=4, process_id=RANK)
+    bst = lgb.train({"tree_learner": "data", ...}, lgb.Dataset(X, y))
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from .utils import log
+
+_initialized = False
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     local_device_ids: Optional[Sequence[int]] = None
+                     ) -> int:
+    """Join (or start) the multi-host world. Returns this process' index.
+
+    Maps the reference's ``machines``/``num_machines``/``machine_list_file``
+    network config onto ``jax.distributed.initialize``: the coordinator
+    address replaces the machine list (every process dials the same
+    coordinator), ``num_processes`` replaces ``num_machines`` and
+    ``process_id`` replaces the rank derived from the list. With no
+    arguments, jax's auto-detection (TPU pod metadata, SLURM, etc.) is
+    used — the common TPU-pod case needs zero configuration.
+    """
+    global _initialized
+    import jax
+
+    if _initialized:
+        log.warning("init_distributed called twice; ignoring")
+        return jax.process_index()
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+    _initialized = True
+    n = jax.process_count()
+    log.info(f"Distributed world initialized: process "
+             f"{jax.process_index()}/{n}, "
+             f"{len(jax.local_devices())} local / "
+             f"{len(jax.devices())} global devices")
+    return jax.process_index()
+
+
+def shutdown_distributed() -> None:
+    """Leave the multi-host world (ref: Network::Dispose)."""
+    global _initialized
+    if not _initialized:
+        return
+    import jax
+
+    jax.distributed.shutdown()
+    _initialized = False
+
+
+def num_processes() -> int:
+    import jax
+    return jax.process_count()
+
+
+def process_index() -> int:
+    import jax
+    return jax.process_index()

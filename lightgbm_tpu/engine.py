@@ -106,34 +106,48 @@ def train(params: Dict[str, Any], train_set: Dataset,
     booster.best_iteration = -1
     evaluation_result_list = []
 
-    for i in range(init_iteration, init_iteration + num_boost_round):
-        for cb in callbacks_before:
-            cb(CallbackEnv(model=booster, params=params, iteration=i,
-                           begin_iteration=init_iteration,
-                           end_iteration=init_iteration + num_boost_round,
-                           evaluation_result_list=None))
-        finished = booster.update(fobj=fobj)
+    import jax
 
-        evaluation_result_list = []
-        if eval_train_name is not None or \
-                booster._engine.config.is_provide_training_metric:
-            name = eval_train_name or "training"
-            evaluation_result_list.extend(
-                (name, n, v, h) for _, n, v, h in booster.eval_train(feval))
-        if booster.valid_sets:
-            evaluation_result_list.extend(booster.eval_valid(feval))
-        try:
-            for cb in callbacks_after:
+    profile_dir = str(booster._engine.config.tpu_profile_dir or "")
+    if profile_dir:
+        # device trace of the whole boosting loop (SURVEY §5: the TPU
+        # counterpart of USE_TIMETAG; open the capture with xprof)
+        jax.profiler.start_trace(profile_dir)
+    try:
+        for i in range(init_iteration, init_iteration + num_boost_round):
+            for cb in callbacks_before:
                 cb(CallbackEnv(model=booster, params=params, iteration=i,
                                begin_iteration=init_iteration,
                                end_iteration=init_iteration + num_boost_round,
-                               evaluation_result_list=evaluation_result_list))
-        except EarlyStopException as earlyStopException:
-            booster.best_iteration = earlyStopException.best_iteration + 1
-            evaluation_result_list = earlyStopException.best_score
-            break
-        if finished:
-            break
+                               evaluation_result_list=None))
+            finished = booster.update(fobj=fobj)
+
+            evaluation_result_list = []
+            if eval_train_name is not None or \
+                    booster._engine.config.is_provide_training_metric:
+                name = eval_train_name or "training"
+                evaluation_result_list.extend(
+                    (name, n, v, h)
+                    for _, n, v, h in booster.eval_train(feval))
+            if booster.valid_sets:
+                evaluation_result_list.extend(booster.eval_valid(feval))
+            try:
+                for cb in callbacks_after:
+                    cb(CallbackEnv(
+                        model=booster, params=params, iteration=i,
+                        begin_iteration=init_iteration,
+                        end_iteration=init_iteration + num_boost_round,
+                        evaluation_result_list=evaluation_result_list))
+            except EarlyStopException as earlyStopException:
+                booster.best_iteration = \
+                    earlyStopException.best_iteration + 1
+                evaluation_result_list = earlyStopException.best_score
+                break
+            if finished:
+                break
+    finally:
+        if profile_dir:
+            jax.profiler.stop_trace()
 
     booster.best_score = collections.defaultdict(collections.OrderedDict)
     for item in evaluation_result_list:
