@@ -221,8 +221,8 @@ _reg("num_gpu", int, 1, (), (0, None, False, False))
 _reg("tpu_num_devices", int, 0, ())          # 0 = use all visible devices
 _reg("tpu_hist_dtype", str, "float32", ())   # histogram input dtype:
                                              # float32 | bfloat16
-_reg("tpu_hist_kernel", str, "auto", ())     # auto | einsum | scatter
-                                             # (auto: einsum on TPU,
+_reg("tpu_hist_kernel", str, "auto", ())     # auto | einsum | scatter |
+                                             # pallas (auto: einsum on TPU,
                                              #  scatter-add on CPU)
 _reg("tpu_row_scheduling", str, "compact", ())  # compact | full
 _reg("tpu_partition_mode", str, "scatter", ())  # scatter | sort

@@ -11,9 +11,14 @@ with explicit VMEM residency:
   maps to the SAME output block, so the [C, FT*B] accumulator stays pinned
   in VMEM across the whole row loop — zero HBM traffic for partial
   histograms (XLA's scan materializes the [F, B, C] carry each step).
-- per step: build the one-hot expansion of a [FT, RB] bin tile in VMEM and
-  contract gh_t [C, RB] @ onehot [RB, FT*B] on the MXU with f32
+- per step: build the one-hot expansion of the bin tile in VMEM and
+  contract gh_t [C, RB] @ onehot [RB, FT*Bp] on the MXU with f32
   accumulation.
+
+One kernel serves both layouts: feature-major [F, R] tiles (full-pass
+scheduling) and row-major [S, F] tiles (the compact scheduler's
+gathered-leaf layout) — the only difference is which axis of the bins
+tile is the feature axis.
 
 Gradients/hessians enter pre-masked by leaf (gh rows of other leaves are
 zero), so a leaf histogram is one pass over the row blocks; the sibling
@@ -31,12 +36,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _hist_kernel(bins_ref, gh_ref, out_ref, *, feature_tile: int,
-                 num_bin_padded: int):
+                 num_bin_padded: int, row_major: bool):
     """One (feature-tile, row-block) grid step.
 
-    bins_ref: int32 [FT, RB]   — bin indices for this tile
-    gh_ref:   f32  [C, RB]     — transposed, leaf-masked (grad, hess, count)
-    out_ref:  f32  [C, FT*Bp]  — accumulator, pinned across row blocks
+    bins_ref: int32 [FT, RB] (feature-major) or [RB, FT] (row-major)
+    gh_ref:   f32  [C, RB]   — transposed, leaf-masked (grad, hess, count)
+    out_ref:  f32  [C, FT*Bp] — accumulator, pinned across row blocks
     """
     j = pl.program_id(1)
 
@@ -44,15 +49,17 @@ def _hist_kernel(bins_ref, gh_ref, out_ref, *, feature_tile: int,
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    bins = bins_ref[:].astype(jnp.int32)            # [FT, RB]
+    bins = bins_ref[:].astype(jnp.int32)
     gh = gh_ref[:]                                  # [C, RB]
-    rb = bins.shape[1]
+    rb = bins.shape[0] if row_major else bins.shape[1]
     iota_b = lax.broadcasted_iota(jnp.int32, (rb, num_bin_padded), 1)
 
     # one-hot expansion, feature-major columns: col = f * Bp + b
+    cols = [bins[:, f] if row_major else bins[f, :]
+            for f in range(feature_tile)]
     onehot = jnp.concatenate(
-        [(bins[f, :][:, None] == iota_b).astype(jnp.float32)
-         for f in range(feature_tile)], axis=1)     # [RB, FT*Bp]
+        [(c[:, None] == iota_b).astype(jnp.float32) for c in cols],
+        axis=1)                                     # [RB, FT*Bp]
 
     out_ref[:] += lax.dot_general(
         gh, onehot, (((1,), (0,)), ((), ())),
@@ -64,34 +71,46 @@ def _pad_to(n: int, m: int) -> int:
 
 
 @functools.partial(jax.jit, static_argnames=("num_bin", "block_rows",
-                                             "feature_tile", "interpret"))
-def _hist_pallas_impl(bins_t: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
-                      block_rows: int, feature_tile: int,
-                      interpret: bool) -> jnp.ndarray:
-    F, R = bins_t.shape
+                                             "feature_tile", "interpret",
+                                             "row_major"))
+def _hist_pallas_impl(bins: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
+                      block_rows: int, feature_tile: int, interpret: bool,
+                      row_major: bool) -> jnp.ndarray:
+    if row_major:
+        R, F = bins.shape
+    else:
+        F, R = bins.shape
     C = gh.shape[1]
     Bp = _pad_to(num_bin, 128)            # lane-align the bin axis
     Fp = _pad_to(F, feature_tile)
     Rp = _pad_to(R, block_rows)
 
-    if Fp != F:
-        bins_t = jnp.pad(bins_t, ((0, Fp - F), (0, 0)))
+    f_axis, r_axis = (1, 0) if row_major else (0, 1)
+    pad = [[0, 0], [0, 0]]
+    pad[f_axis][1] = Fp - F               # dead columns, sliced off below
+    pad[r_axis][1] = Rp - R               # padded rows carry gh = 0
+    if Fp != F or Rp != R:
+        bins = jnp.pad(bins, pad)
     if Rp != R:
-        # padded rows carry gh = 0 → contribute nothing to any bin
-        bins_t = jnp.pad(bins_t, ((0, 0), (0, Rp - R)))
         gh = jnp.pad(gh, ((0, Rp - R), (0, 0)))
     gh_t = gh.T                            # [C, Rp]
 
     grid = (Fp // feature_tile, Rp // block_rows)
     kernel = functools.partial(_hist_kernel, feature_tile=feature_tile,
-                               num_bin_padded=Bp)
+                               num_bin_padded=Bp, row_major=row_major)
+    if row_major:
+        bins_spec = pl.BlockSpec((block_rows, feature_tile),
+                                 lambda i, j: (j, i),
+                                 memory_space=pltpu.VMEM)
+    else:
+        bins_spec = pl.BlockSpec((feature_tile, block_rows),
+                                 lambda i, j: (i, j),
+                                 memory_space=pltpu.VMEM)
     out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((feature_tile, block_rows),
-                         lambda i, j: (i, j),
-                         memory_space=pltpu.VMEM),
+            bins_spec,
             pl.BlockSpec((C, block_rows), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
         ],
@@ -99,17 +118,29 @@ def _hist_pallas_impl(bins_t: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((C, Fp * Bp), jnp.float32),
         interpret=interpret,
-    )(bins_t.astype(jnp.int32), gh_t)
+    )(bins.astype(jnp.int32), gh_t)
 
     # [C, Fp*Bp] -> [Fp, Bp, C] -> [F, num_bin, C]
     hist = out.reshape(C, Fp, Bp).transpose(1, 2, 0)
     return hist[:F, :num_bin, :]
 
 
+def fit_feature_tile(feature_tile: int, num_bin: int,
+                     block_rows: int) -> int:
+    """Shrink the feature tile so the in-kernel one-hot stays within the
+    VMEM budget (~16 MB/core, keep the expansion ≤ 4 MB f32 to leave room
+    for double buffering)."""
+    budget_elems = (4 << 20) // 4
+    Bp = _pad_to(num_bin, 128)
+    while feature_tile > 1 and block_rows * feature_tile * Bp > budget_elems:
+        feature_tile //= 2
+    return max(feature_tile, 1)
+
+
 def hist_pallas(bins_t: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
                 block_rows: int = 1024, feature_tile: int = 8,
                 interpret: bool | None = None) -> jnp.ndarray:
-    """Histogram [F, num_bin, C] of leaf-masked gh over binned features.
+    """Histogram [F, num_bin, C] over feature-major [F, R] bins.
 
     Same contract as hist_xla (ops/histogram.py). `interpret=None` picks
     compiled mode on TPU and the Pallas interpreter elsewhere (tests run
@@ -117,5 +148,18 @@ def hist_pallas(bins_t: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    feature_tile = fit_feature_tile(feature_tile, num_bin, block_rows)
     return _hist_pallas_impl(bins_t, gh, num_bin, block_rows, feature_tile,
-                             bool(interpret))
+                             bool(interpret), row_major=False)
+
+
+def hist_pallas_rm(bins_rm: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
+                   block_rows: int = 512, feature_tile: int = 8,
+                   interpret: bool | None = None) -> jnp.ndarray:
+    """Row-major histogram [F, num_bin, C] over a gathered [S, F] block —
+    the compact scheduler's layout (same contract as hist_rowmajor)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    feature_tile = fit_feature_tile(feature_tile, num_bin, block_rows)
+    return _hist_pallas_impl(bins_rm, gh, num_bin, block_rows, feature_tile,
+                             bool(interpret), row_major=True)

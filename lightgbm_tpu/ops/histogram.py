@@ -123,6 +123,22 @@ def hist_rowmajor(bins_rm: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
     if backend == "scatter":
         # CPU-friendly path (tests); XLA fuses the transpose into the gather
         return hist_scatter(bins_rm.T, gh, num_bin)
+    if backend == "pallas":
+        # VMEM-resident one-hot kernel (no HBM traffic for the expansion)
+        from .hist_pallas import hist_pallas_rm
+        if int8_mode:
+            # exact int32 accumulation is einsum-only for now
+            raise ValueError("hist pallas backend does not support "
+                             "quantized gradients yet; use einsum")
+        if bf16:
+            # match the einsum bf16 path's numerics: gh rounded to bf16,
+            # accumulation in f32 (the one-hot side is exact either way)
+            gh = gh.astype(jnp.bfloat16).astype(jnp.float32)
+        return hist_pallas_rm(bins_rm, gh, num_bin,
+                              block_rows=min(block_rows, 512))
+    if backend != "einsum":
+        raise ValueError(f"unknown hist_rowmajor backend {backend!r}; "
+                         "expected einsum | scatter | pallas")
 
     nb = S // block_rows
     main = nb * block_rows

@@ -53,6 +53,30 @@ def bench_hist():
     print(f"hist_xla(F-major) R={R}: {dt_s*1e3:8.3f} ms", flush=True)
 
 
+def bench_pallas_rm():
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.hist_pallas import hist_pallas_rm
+
+    rng = np.random.default_rng(0)
+    R, F, B = 1_048_576, 28, 256
+    bins_rm = jnp.asarray(rng.integers(0, B - 1, (R, F), dtype=np.uint8))
+    gh = jnp.asarray(rng.normal(size=(R, 3)).astype(np.float32))
+    for S in (16384, 131072, 1_048_576):
+        for blk in (256, 512, 1024):
+            for ft in (4, 7, 14, 28):
+                try:
+                    f = jax.jit(lambda b, g, blk=blk, ft=ft: hist_pallas_rm(
+                        b, g, num_bin=B, block_rows=blk, feature_tile=ft))
+                    dt_s = timeit(f, bins_rm[:S], gh[:S])
+                    print(f"hist_pallas_rm S={S:8d} blk={blk:5d} ft={ft:2d}:"
+                          f" {dt_s*1e3:8.3f} ms ({S/dt_s/1e9:.2f} Grows/s)",
+                          flush=True)
+                except Exception as e:
+                    print(f"hist_pallas_rm S={S} blk={blk} ft={ft}: FAIL "
+                          f"{type(e).__name__}: {str(e)[:100]}", flush=True)
+
+
 def bench_pallas():
     import jax
     import jax.numpy as jnp
@@ -140,7 +164,8 @@ def bench_fullpass():
     print(f"masked full pass R={R}: {dt_s*1e3:8.3f} ms", flush=True)
 
 
-SUITES = {"hist": bench_hist, "pallas": bench_pallas, "part": bench_part,
+SUITES = {"hist": bench_hist, "pallas": bench_pallas,
+          "pallas_rm": bench_pallas_rm, "part": bench_part,
           "fullpass": bench_fullpass}
 
 if __name__ == "__main__":
