@@ -79,7 +79,17 @@ def _load_train_data(cfg: Config, params: Dict) -> Tuple[Dataset,
 def task_train(cfg: Config, params: Dict) -> None:
     """ref: application.cpp InitTrain/Train."""
     train_set, valid_sets, valid_names = _load_train_data(cfg, params)
+    if cfg.save_binary:
+        # persist the freshly-binned dataset next to the text file
+        # (ref: config save_binary, dataset_loader.cpp SaveBinaryFile)
+        train_set.save_binary(str(cfg.data) + ".bin")
     callbacks = []
+    if cfg.metric_freq > 0 and (valid_sets or
+                                cfg.is_provide_training_metric):
+        # per-iteration metric printing every metric_freq rounds
+        # (ref: application.cpp OutputMetric cadence, gbdt.cpp:486)
+        from .callback import log_evaluation
+        callbacks.append(log_evaluation(period=int(cfg.metric_freq)))
     if cfg.snapshot_freq > 0:
         out_model = cfg.output_model
 
@@ -95,7 +105,10 @@ def task_train(cfg: Config, params: Dict) -> None:
         valid_sets=valid_sets or None, valid_names=valid_names or None,
         init_model=cfg.input_model or None,
         callbacks=callbacks or None)
-    booster.save_model(cfg.output_model)
+    # 0 = split counts, 1 = total gains (ref: config
+    # saved_feature_importance_type; gbdt_model_text.cpp FeatureImportance)
+    imp_type = "gain" if cfg.saved_feature_importance_type == 1 else "split"
+    booster.save_model(cfg.output_model, importance_type=imp_type)
     log.info(f"Finished training; model saved to {cfg.output_model}")
 
 
@@ -110,6 +123,7 @@ def task_predict(cfg: Config, params: Dict) -> None:
     X, _, _, _ = load_svm_or_csv(cfg.data, cfg)
     result = booster.predict(
         X,
+        start_iteration=max(int(cfg.start_iteration_predict), 0),
         num_iteration=cfg.num_iteration_predict
         if cfg.num_iteration_predict > 0 else None,
         raw_score=cfg.predict_raw_score,
@@ -130,6 +144,10 @@ def task_convert_model(cfg: Config, params: Dict) -> None:
     src/boosting/gbdt_model_text.cpp ModelToIfElse)."""
     if not cfg.input_model:
         raise LightGBMError("task=convert_model needs input_model=<file>")
+    if cfg.convert_model_language not in ("", "cpp"):
+        log.warning(f"convert_model_language="
+                    f"{cfg.convert_model_language!r} is not supported; "
+                    "only 'cpp' codegen exists — emitting cpp")
     booster = Booster(model_file=cfg.input_model)
     from .io.codegen import model_to_cpp_ifelse
     src = model_to_cpp_ifelse(booster._engine, booster.config)

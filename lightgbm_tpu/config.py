@@ -374,6 +374,28 @@ _UNIMPLEMENTED_WHEN = {
     "tpu_donate_state": lambda v: True,
 }
 
+# Parameters that exist in the reference but map to a DIFFERENT mechanism
+# here; when set explicitly, point the user at the TPU-native equivalent
+# instead of silently ignoring them.
+_REDIRECTED_PARAMS = {
+    "machines": "multi-host runs use "
+                "lightgbm_tpu.distributed.init_distributed (SPMD over a "
+                "global jax mesh); no machine list is needed",
+    "machine_list_filename": "see lightgbm_tpu.distributed.init_distributed",
+    "num_machines": "the process count comes from jax.distributed "
+                    "(lightgbm_tpu.distributed.init_distributed)",
+    "local_listen_port": "jax's coordinator handles transport; no port "
+                         "configuration is needed",
+    "time_out": "jax's collectives manage their own timeouts",
+    "gpu_platform_id": "this framework targets TPU via XLA; the OpenCL "
+                       "backend does not exist",
+    "gpu_device_id": "device selection follows jax.devices()",
+    "gpu_use_dp": "histogram precision is tpu_hist_dtype",
+    "num_gpu": "device count is tpu_num_devices over the jax mesh",
+    "num_threads": "host threading is managed by XLA; the parameter has "
+                   "no effect on device execution",
+}
+
 
 class Config:
     """Resolved parameter set with attribute access.
@@ -476,6 +498,14 @@ class Config:
                 log.warning(
                     f"{name}={self._values[name]} is not implemented in "
                     "lightgbm_tpu yet; the parameter has no effect")
+        for name, hint in _REDIRECTED_PARAMS.items():
+            if not self.is_default(name):
+                log.warning(f"{name} has no effect here: {hint}")
+        dev = str(self._values.get("device_type", "tpu")).lower()
+        if dev in ("gpu", "cuda", "opencl"):
+            log.warning(f"device_type={dev} is not available; this "
+                        "framework runs on TPU (or CPU) through jax — "
+                        "set LIGHTGBM_TPU_PLATFORM to pin a backend")
 
     # -- internals -------------------------------------------------------
     def _post_process(self) -> None:

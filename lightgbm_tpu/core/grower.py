@@ -79,6 +79,10 @@ class GrowerConfig:
     quantized: bool = False
     quant_bins: int = 4          # ref: num_grad_quant_bins
     stochastic_rounding: bool = True
+    # extremely randomized trees (ref: config extra_trees / extra_seed;
+    # feature_histogram.hpp USE_RAND): one random numerical threshold per
+    # (node, feature) instead of the full scan
+    extra_trees: bool = False
     # feature_mask is [L, F] with one row per node (feature_fraction_bynode,
     # ref: col_sampler.hpp) instead of a single [F] row for the whole tree
     bynode_mask: bool = False
@@ -298,8 +302,23 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         mask = (leaf_id == target_leaf).astype(gh.dtype)
         return reduce_hist(hist_fn(bins_t, gh * mask[:, None]), ctx)
 
+    use_rand = cfg.extra_trees
+    if use_rand and distributed:
+        raise ValueError("extra_trees does not compose with distributed "
+                         "learner hooks yet")
+
+    def rand_thresholds(key):
+        """One random threshold bin per feature in [0, num_bin - 2]
+        (ref: feature_histogram.hpp:205 rand.NextInt(0, num_bin - 2))."""
+        F_ = int(meta.num_bin.shape[0])
+        u = jax.random.uniform(key, (F_,))
+        hi_b = jnp.maximum(meta.num_bin - 2, 1).astype(jnp.float32)
+        return jnp.minimum((u * hi_b).astype(jnp.int32),
+                           meta.num_bin - 2)
+
     def best_of(hist, sg, sh, cnt, parent_out, feature_mask,
-                leaf_range=None, leaf_depth=None, cegb=None):
+                leaf_range=None, leaf_depth=None, cegb=None,
+                rand_bins=None):
         hist, extra_mask = prepare_split_hist(
             hist, (sg, sh, cnt, parent_out), feature_mask)
         if extra_mask is not None:
@@ -308,7 +327,8 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         gp = None if cegb is None else cegb[0] + cegb[1] * cnt
         rec = best_split_for_leaf(hist, sg, sh, cnt, parent_out, meta, hp,
                                   feature_mask, leaf_range=leaf_range,
-                                  leaf_depth=leaf_depth, gain_penalty=gp)
+                                  leaf_depth=leaf_depth, gain_penalty=gp,
+                                  rand_bins=rand_bins)
         return select_best(rec)
 
     def grow(bins_t: jnp.ndarray, gh: jnp.ndarray,
@@ -470,10 +490,18 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         hist_root_l = conv(hist_root)
         if bundled:
             hist_root_l = expand_hist(hist_root_l, root_g, root_h, root_c)
+        if use_rand:
+            et_key = jax.random.fold_in(
+                rng_key if rng_key is not None else jax.random.PRNGKey(0),
+                7919)
+            root_rand = rand_thresholds(jax.random.fold_in(et_key, 2 ** 20))
+        else:
+            root_rand = None
         best_root = best_of(hist_root_l, root_g, root_h, root_c,
                             root_out, node_mask(0, root_path),
                             leaf_range=(-inf, inf),
-                            leaf_depth=jnp.int32(0), cegb=cegb)
+                            leaf_depth=jnp.int32(0), cegb=cegb,
+                            rand_bins=root_rand)
 
         hist_pool = (None if pool_none else
                      jnp.zeros((L, Fp, B, 3), hist_dtype).at[0].set(
@@ -779,19 +807,26 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             mn2 = jnp.stack([l_min, r_min])
             mx2 = jnp.stack([l_max, r_max])
             dp2 = jnp.stack([child_depth, child_depth])
+            if use_rand:
+                ki = jax.random.fold_in(et_key, i)
+                rb2 = jnp.stack([
+                    rand_thresholds(jax.random.fold_in(ki, 1)),
+                    rand_thresholds(jax.random.fold_in(ki, 2))])
+            else:
+                rb2 = None
             if fm_l is None:
                 best2 = jax.vmap(
-                    lambda hh, a, b, c, d, mn, mx, dp: best_of(
+                    lambda hh, a, b, c, d, mn, mx, dp, rb: best_of(
                         hh, a, b, c, d, None, leaf_range=(mn, mx),
-                        leaf_depth=dp, cegb=cegb)
-                )(hists2, sg2, sh2, cn2, ou2, mn2, mx2, dp2)
+                        leaf_depth=dp, cegb=cegb, rand_bins=rb)
+                )(hists2, sg2, sh2, cn2, ou2, mn2, mx2, dp2, rb2)
             else:
                 fm2 = jnp.stack([fm_l, fm_r])
                 best2 = jax.vmap(
-                    lambda hh, a, b, c, d, mn, mx, dp, fm: best_of(
+                    lambda hh, a, b, c, d, mn, mx, dp, fm, rb: best_of(
                         hh, a, b, c, d, fm, leaf_range=(mn, mx),
-                        leaf_depth=dp, cegb=cegb)
-                )(hists2, sg2, sh2, cn2, ou2, mn2, mx2, dp2, fm2)
+                        leaf_depth=dp, cegb=cegb, rand_bins=rb)
+                )(hists2, sg2, sh2, cn2, ou2, mn2, mx2, dp2, fm2, rb2)
             best = jax.tree.map(
                 lambda cur, nb: _set(_set(cur, l, nb[0], proceed),
                                      new_leaf, nb[1], proceed),

@@ -329,6 +329,22 @@ class BinnedDataset:
                 sample_indices = np.arange(num_data)
         cat_set = set(int(c) for c in categorical_features)
 
+        # user-forced bin upper bounds (ref: config forcedbins_filename,
+        # dataset_loader.cpp DatasetLoader::GetForcedBins JSON format:
+        # [{"feature": i, "bin_upper_bound": [..]}, ...])
+        forced_bounds: Dict[int, List[float]] = {}
+        if config.forcedbins_filename:
+            import json
+            try:
+                with open(config.forcedbins_filename) as fh:
+                    for entry in json.load(fh):
+                        forced_bounds[int(entry["feature"])] = [
+                            float(v) for v in entry["bin_upper_bound"]]
+            except (OSError, ValueError, KeyError, TypeError,
+                    IndexError) as e:
+                log.fatal(f"could not read forcedbins_filename="
+                          f"{config.forcedbins_filename}: {e}")
+
         # pre-filter needs the split constraint (ref: dataset_loader.cpp
         # filter_cnt computation)
         filter_cnt = int(max(
@@ -347,7 +363,8 @@ class BinnedDataset:
                 col, len(sample_indices), mb, config.min_data_in_bin,
                 filter_cnt, pre_filter=config.feature_pre_filter,
                 bin_type=bin_type, use_missing=config.use_missing,
-                zero_as_missing=config.zero_as_missing))
+                zero_as_missing=config.zero_as_missing,
+                forced_upper_bounds=forced_bounds.get(f, ())))
         n_trivial = sum(m.is_trivial for m in mappers)
         if n_trivial:
             log.info(f"{n_trivial} trivial feature(s) removed")

@@ -182,8 +182,17 @@ class GBDT:
                         f"monotone_constraints_method="
                         f"{cfg.monotone_constraints_method} not implemented; "
                         "using 'basic'")
-        self.feature_meta = FeatureMeta.from_mappers(mappers, monotone) \
-            if mappers else None
+        contri = None
+        if cfg.feature_contri:
+            fc_in = np.asarray(cfg.feature_contri, np.float64)
+            if len(fc_in) != train.num_total_features:
+                log.fatal(
+                    f"feature_contri has {len(fc_in)} entries but the "
+                    f"dataset has {train.num_total_features} features")
+            if np.any(fc_in != 1.0):
+                contri = fc_in[train.used_feature_map]
+        self.feature_meta = FeatureMeta.from_mappers(
+            mappers, monotone, penalty=contri) if mappers else None
         self.num_bin_max = int(max((m.num_bin for m in mappers), default=2))
         # the feature-major device copy is only needed by traversal paths
         # (rollback, DART drops, continued training, valid replay) — it is
@@ -262,10 +271,16 @@ class GBDT:
             min_bucket=cfg.tpu_min_bucket,
             quantized=bool(cfg.use_quantized_grad),
             quant_bins=int(cfg.num_grad_quant_bins),
-            stochastic_rounding=bool(cfg.stochastic_rounding))
-        self._quant_rng = jax.random.PRNGKey(
-            cfg.seed if cfg.seed is not None else 0) \
-            if cfg.use_quantized_grad else None
+            stochastic_rounding=bool(cfg.stochastic_rounding),
+            extra_trees=bool(cfg.extra_trees))
+        # per-tree PRNG: stochastic rounding + extra_trees thresholds
+        # (extra_seed falls back to seed, ref: config.h extra_seed)
+        need_rng = bool(cfg.use_quantized_grad) or bool(cfg.extra_trees)
+        rng_seed = (cfg.extra_seed if cfg.extra_trees and
+                    cfg.extra_seed is not None
+                    else (cfg.seed if cfg.seed is not None else 0))
+        self._grow_rng = (jax.random.PRNGKey(int(rng_seed))
+                          if need_rng else None)
         # ---- tree learner selection (ref: tree_learner.cpp:17 factory) ----
         # serial runs the single-program grower; data/voting shard rows and
         # feature shards columns over a jax Mesh, with the FULL TrainOneIter
@@ -309,9 +324,13 @@ class GBDT:
                 if self.grower_cfg.quantized:
                     log.warning("use_quantized_grad is not supported with "
                                 f"tree_learner={tl} yet; training fp32")
-                    self._quant_rng = None
+                if self.grower_cfg.extra_trees:
+                    log.warning("extra_trees is not supported with "
+                                f"tree_learner={tl} yet; full scans")
+                self._grow_rng = None
                 self.grower_cfg = dataclasses.replace(
-                    self.grower_cfg, row_sched="full", quantized=False)
+                    self.grower_cfg, row_sched="full", quantized=False,
+                    extra_trees=False)
             else:
                 cap = (f"tpu_num_devices={cfg.tpu_num_devices}"
                        if 0 < cfg.tpu_num_devices < avail
@@ -809,11 +828,12 @@ class GBDT:
             fmask = self._feature_mask()
             train_bins = self._train_bins()
             rng_key = None
-            if self._quant_rng is not None:
-                # fresh stochastic-rounding noise per tree (ref:
-                # gradient_discretizer.cpp random_values_use_start per iter)
+            if self._grow_rng is not None:
+                # fresh per-tree noise: stochastic rounding (ref:
+                # gradient_discretizer.cpp random_values_use_start) and/or
+                # extra_trees random thresholds
                 rng_key = jax.random.fold_in(
-                    self._quant_rng, self.iter * K + k)
+                    self._grow_rng, self.iter * K + k)
             with global_timer.section("TreeLearner::Train",
                                       sync=lambda: tree_dev.leaf_value):
                 tree_dev, leaf_id = self._grow(train_bins, gh, fmask,
@@ -856,7 +876,7 @@ class GBDT:
             # -- quantized-gradient leaf renewal ------------------------
             # (ref: GradientDiscretizer::RenewIntGradTreeOutput — refit
             # leaf outputs from the TRUE fp32 grad/hess sums, no smoothing)
-            if (self._quant_rng is not None and
+            if (self.grower_cfg.quantized and
                     self.config.quant_train_renew_leaf):
                 # use the full bagging/GOSS weights (incl. amplification),
                 # matching the gh the tree was grown with

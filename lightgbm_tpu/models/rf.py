@@ -86,8 +86,8 @@ class RF(GBDT):
             fmask = self._feature_mask()
             import jax
             rng_key = None
-            if self._quant_rng is not None:
-                rng_key = jax.random.fold_in(self._quant_rng,
+            if self._grow_rng is not None:
+                rng_key = jax.random.fold_in(self._grow_rng,
                                              self.iter * K + k)
             tree_dev, leaf_id = self._grow(self._train_bins(), gh, fmask,
                                            self._cegb_penalty(), rng_key)

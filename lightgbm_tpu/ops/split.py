@@ -75,9 +75,14 @@ class FeatureMeta(NamedTuple):
     # i8 in {-1, 0, +1} per feature, or None when no constraints anywhere
     # (ref: config monotone_constraints; feature_histogram.hpp:766)
     monotone: jnp.ndarray = None
+    # f32 per-feature split-gain multiplier, or None when all 1.0
+    # (ref: config feature_contri -> meta_->penalty,
+    # feature_histogram.hpp:175 "output->gain *= meta_->penalty")
+    penalty: jnp.ndarray = None
 
     @staticmethod
-    def from_mappers(mappers, monotone=None) -> "FeatureMeta":
+    def from_mappers(mappers, monotone=None,
+                     penalty=None) -> "FeatureMeta":
         return FeatureMeta(
             num_bin=jnp.asarray([m.num_bin for m in mappers], jnp.int32),
             missing_type=jnp.asarray(
@@ -87,6 +92,8 @@ class FeatureMeta(NamedTuple):
                 [m.bin_type == "categorical" for m in mappers], bool),
             monotone=(None if monotone is None
                       else jnp.asarray(monotone, jnp.int32)),
+            penalty=(None if penalty is None
+                     else jnp.asarray(penalty, jnp.float32)),
         )
 
 
@@ -192,7 +199,8 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
                         hp: SplitHyperParams,
                         feature_mask: jnp.ndarray = None,
                         leaf_range=None, leaf_depth=None,
-                        gain_penalty: jnp.ndarray = None) -> SplitRecord:
+                        gain_penalty: jnp.ndarray = None,
+                        rand_bins: jnp.ndarray = None) -> SplitRecord:
     """Find the best split over all features for one leaf.
 
     Parameters
@@ -210,6 +218,12 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
     gain_penalty : optional f32 [F] — per-feature penalty subtracted from
         the net gain before the cross-feature argmax (CEGB DeltaGain,
         cost_effective_gradient_boosting.hpp:81-98).
+    rand_bins : optional i32 [F] — extremely-randomized mode
+        (config extra_trees): numerical candidates are restricted to this
+        one random threshold bin per feature (ref: USE_RAND template,
+        feature_histogram.hpp:195-205 "rand.NextInt(0, num_bin - 2)" and
+        :897 the candidate filter). Categorical features keep the full
+        subset scan, as in the reference.
 
     Returns a scalar-per-field SplitRecord.
 
@@ -218,7 +232,8 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
     (ref: feature_histogram.hpp:172 FindBestThreshold call site).
     """
     scan = _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
-                             parent_output, meta, hp, leaf_range)
+                             parent_output, meta, hp, leaf_range,
+                             rand_bins=rand_bins)
     cat = None
     if meta_has_categorical(meta):
         cat = _categorical_scan(hist, sum_gradient,
@@ -230,7 +245,7 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
 
 def _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
                       parent_output, meta: FeatureMeta, hp: SplitHyperParams,
-                      leaf_range=None) -> dict:
+                      leaf_range=None, rand_bins=None) -> dict:
     """The two-direction cumulative scan; returns per-feature best arrays
     (gain/threshold/side-sums [F]) plus the scalars the selection needs."""
     F, B, _ = hist.shape
@@ -320,6 +335,9 @@ def _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
     thr_ok_rev = (bin_idx <= hi - 1) & (bin_idx >= 0) & in_range
     # skip-default applies to the *iteration* t=thr+1 in the reference loop
     thr_ok_rev &= ~(skip_default & ((bin_idx + 1) == dflt))
+    if rand_bins is not None:
+        # extra_trees: only the one random threshold per feature competes
+        thr_ok_rev &= bin_idx == rand_bins[:, None]
     gains_rev = jnp.where(valid_rev & thr_ok_rev, gains_rev, K_MIN_SCORE)
 
     # ---------------- FORWARD scan: left side accumulates 0..t -------------
@@ -332,6 +350,8 @@ def _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
                                               rg_fwd, rh_fwd, rc_fwd)
     thr_ok_fwd = (bin_idx <= nbin - 2) & in_range & run_forward
     thr_ok_fwd &= ~(skip_default & (bin_idx == dflt))
+    if rand_bins is not None:
+        thr_ok_fwd &= bin_idx == rand_bins[:, None]
     gains_fwd = jnp.where(valid_fwd & thr_ok_fwd, gains_fwd, K_MIN_SCORE)
 
     # ---------------- per-feature best, then across features ---------------
@@ -575,6 +595,13 @@ def _select_across_features(scan: dict, meta: FeatureMeta,
             cat_net = jnp.where(feature_mask, cat_net, K_MIN_SCORE)
         net_gain = jnp.where(iscat, cat_net, net_gain)
         valid_any = jnp.where(iscat, cat_net > K_MIN_SCORE, valid_any)
+    if meta.penalty is not None:
+        # feature_contri multiplier on the per-feature best gain
+        # (ref: feature_histogram.hpp:175 before serial_tree_learner's
+        # CEGB/monotone adjustments)
+        net_gain = jnp.where(valid_any, net_gain * meta.penalty, net_gain)
+        valid_any = valid_any & (net_gain > 0.0)
+        net_gain = jnp.where(valid_any, net_gain, K_MIN_SCORE)
     if gain_penalty is not None:
         net_gain = jnp.where(valid_any, net_gain - gain_penalty, net_gain)
     if use_mc and hp.monotone_penalty > 0.0:
@@ -659,6 +686,12 @@ def per_feature_net_gains(hist, sum_gradient, sum_hessian, num_data,
                                 sum_hessian + 2 * K_EPSILON, num_data,
                                 parent_output, meta, hp)
         net = jnp.where(meta.is_categorical, cat["net_gain"], net)
+        valid = net > K_MIN_SCORE
+    if meta.penalty is not None:
+        # feature_contri applies before the vote, like the reference where
+        # FindBestThreshold's output gains already carry the penalty
+        net = jnp.where(valid & (net * meta.penalty > 0.0),
+                        net * meta.penalty, K_MIN_SCORE)
     return net
 
 

@@ -60,6 +60,7 @@ def pad_feature_meta(meta: FeatureMeta, target_f: int) -> FeatureMeta:
         default_bin=pad1(meta.default_bin, 0),
         is_categorical=pad1(meta.is_categorical, False),
         monotone=pad1(meta.monotone, 0),
+        penalty=pad1(meta.penalty, 1.0),
     )
 
 

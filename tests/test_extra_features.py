@@ -1,0 +1,106 @@
+"""extra_trees, feature_contri, forcedbins_filename and the smaller CLI
+knobs (save_binary flag, saved_feature_importance_type,
+start_iteration_predict) — the last of the silently-unread parameters."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+
+
+def _data(rng, n=800, f=8):
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = X[:, 0] * 2 + np.sin(X[:, 1] * 2) + 0.1 * rng.normal(size=n)
+    return X, y
+
+
+def test_extra_trees_differs_and_learns(rng):
+    X, y = _data(rng)
+    base = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+            "min_data_in_leaf": 5, "seed": 1}
+    bst_full = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=20)
+    bst_et = lgb.train(dict(base, extra_trees=True, extra_seed=11),
+                       lgb.Dataset(X, label=y), num_boost_round=20)
+    p_full = bst_full.predict(X)
+    p_et = bst_et.predict(X)
+    # randomized thresholds -> different trees
+    assert not np.allclose(p_full, p_et)
+    # ...but still learns the signal
+    mse_et = float(np.mean((p_et - y) ** 2))
+    assert mse_et < float(y.var()) * 0.5
+    # different extra_seed -> different randomization
+    bst_et2 = lgb.train(dict(base, extra_trees=True, extra_seed=99),
+                        lgb.Dataset(X, label=y), num_boost_round=20)
+    assert not np.allclose(p_et, bst_et2.predict(X))
+    # same extra_seed -> deterministic
+    bst_et3 = lgb.train(dict(base, extra_trees=True, extra_seed=11),
+                        lgb.Dataset(X, label=y), num_boost_round=20)
+    np.testing.assert_allclose(p_et, bst_et3.predict(X))
+
+
+def test_feature_contri_suppresses_feature(rng):
+    X, y = _data(rng)
+    contri = [1.0] * X.shape[1]
+    contri[0] = 0.0        # kill the dominant feature's split gains
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbose": -1, "min_data_in_leaf": 5,
+                     "feature_contri": contri},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    imp = bst.feature_importance(importance_type="split")
+    assert imp[0] == 0
+    assert imp[1] > 0
+
+
+def test_forcedbins_filename(rng, tmp_path):
+    X, y = _data(rng, n=500)
+    bounds = [-1.0, 0.0, 1.0]
+    fb = str(tmp_path / "forced.json")
+    with open(fb, "w") as f:
+        json.dump([{"feature": 0, "bin_upper_bound": bounds}], f)
+    ds = lgb.Dataset(X, label=y,
+                     params={"forcedbins_filename": fb}).construct()
+    ub = ds.binned.bin_mappers[0].bin_upper_bound
+    for b in bounds:
+        assert np.any(np.isclose(ub, b)), f"forced bound {b} missing"
+
+
+def test_cli_save_binary_and_importance(rng, tmp_path):
+    X, y = _data(rng, n=300, f=4)
+    data = str(tmp_path / "t.csv")
+    np.savetxt(data, np.column_stack([y, X]), delimiter=",", fmt="%.6g")
+    model = str(tmp_path / "m.txt")
+    from lightgbm_tpu.cli import run as cli_run
+    assert cli_run(
+        ["task=train", f"data={data}", f"output_model={model}",
+         "num_trees=3", "verbose=-1", "save_binary=true",
+         "saved_feature_importance_type=1", "min_data_in_leaf=5"]) in (0,
+                                                                       None)
+    assert os.path.exists(data + ".bin")
+    txt = open(model).read()
+    assert "feature_importances" in txt
+    # gain importances are floats (split counts would be integers)
+    imp_line = [ln for ln in txt.splitlines()
+                if ln.startswith("Column_")][0]
+    assert "." in imp_line.split("=")[1]
+
+    # start_iteration_predict skips the early trees
+    out = str(tmp_path / "p.txt")
+    cli_run(["task=predict", f"data={data}", f"input_model={model}",
+             f"output_result={out}", "start_iteration_predict=2",
+             "predict_raw_score=true"])
+    got = np.loadtxt(out)
+    bst = lgb.Booster(model_file=model)
+    expect = bst.predict(X, raw_score=True, start_iteration=2)
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_redirected_params_warn(capsys):
+    cfg = Config({"machines": "a:1,b:2", "num_threads": 4})
+    cfg.warn_unimplemented()
+    err = capsys.readouterr().err
+    assert "machines" in err and "init_distributed" in err
+    assert "num_threads" in err
