@@ -307,18 +307,15 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         raise ValueError("extra_trees does not compose with distributed "
                          "learner hooks yet")
 
-    def rand_thresholds(key):
-        """One random threshold bin per feature in [0, num_bin - 2]
-        (ref: feature_histogram.hpp:205 rand.NextInt(0, num_bin - 2))."""
-        F_ = int(meta.num_bin.shape[0])
-        u = jax.random.uniform(key, (F_,))
-        hi_b = jnp.maximum(meta.num_bin - 2, 1).astype(jnp.float32)
-        return jnp.minimum((u * hi_b).astype(jnp.int32),
-                           meta.num_bin - 2)
+    def rand_uniforms(key):
+        """One uniform draw per feature — the split scan derives the
+        random numerical threshold / categorical candidate from it
+        (ref: meta_->rand draws, feature_histogram.hpp:205)."""
+        return jax.random.uniform(key, (int(meta.num_bin.shape[0]),))
 
     def best_of(hist, sg, sh, cnt, parent_out, feature_mask,
                 leaf_range=None, leaf_depth=None, cegb=None,
-                rand_bins=None):
+                rand_u=None):
         hist, extra_mask = prepare_split_hist(
             hist, (sg, sh, cnt, parent_out), feature_mask)
         if extra_mask is not None:
@@ -328,7 +325,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         rec = best_split_for_leaf(hist, sg, sh, cnt, parent_out, meta, hp,
                                   feature_mask, leaf_range=leaf_range,
                                   leaf_depth=leaf_depth, gain_penalty=gp,
-                                  rand_bins=rand_bins)
+                                  rand_u=rand_u)
         return select_best(rec)
 
     def grow(bins_t: jnp.ndarray, gh: jnp.ndarray,
@@ -494,14 +491,14 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             et_key = jax.random.fold_in(
                 rng_key if rng_key is not None else jax.random.PRNGKey(0),
                 7919)
-            root_rand = rand_thresholds(jax.random.fold_in(et_key, 2 ** 20))
+            root_rand = rand_uniforms(jax.random.fold_in(et_key, 2 ** 20))
         else:
             root_rand = None
         best_root = best_of(hist_root_l, root_g, root_h, root_c,
                             root_out, node_mask(0, root_path),
                             leaf_range=(-inf, inf),
                             leaf_depth=jnp.int32(0), cegb=cegb,
-                            rand_bins=root_rand)
+                            rand_u=root_rand)
 
         hist_pool = (None if pool_none else
                      jnp.zeros((L, Fp, B, 3), hist_dtype).at[0].set(
@@ -810,22 +807,22 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             if use_rand:
                 ki = jax.random.fold_in(et_key, i)
                 rb2 = jnp.stack([
-                    rand_thresholds(jax.random.fold_in(ki, 1)),
-                    rand_thresholds(jax.random.fold_in(ki, 2))])
+                    rand_uniforms(jax.random.fold_in(ki, 1)),
+                    rand_uniforms(jax.random.fold_in(ki, 2))])
             else:
                 rb2 = None
             if fm_l is None:
                 best2 = jax.vmap(
                     lambda hh, a, b, c, d, mn, mx, dp, rb: best_of(
                         hh, a, b, c, d, None, leaf_range=(mn, mx),
-                        leaf_depth=dp, cegb=cegb, rand_bins=rb)
+                        leaf_depth=dp, cegb=cegb, rand_u=rb)
                 )(hists2, sg2, sh2, cn2, ou2, mn2, mx2, dp2, rb2)
             else:
                 fm2 = jnp.stack([fm_l, fm_r])
                 best2 = jax.vmap(
                     lambda hh, a, b, c, d, mn, mx, dp, fm, rb: best_of(
                         hh, a, b, c, d, fm, leaf_range=(mn, mx),
-                        leaf_depth=dp, cegb=cegb, rand_bins=rb)
+                        leaf_depth=dp, cegb=cegb, rand_u=rb)
                 )(hists2, sg2, sh2, cn2, ou2, mn2, mx2, dp2, fm2, rb2)
             best = jax.tree.map(
                 lambda cur, nb: _set(_set(cur, l, nb[0], proceed),

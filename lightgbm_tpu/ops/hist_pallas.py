@@ -36,12 +36,17 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _hist_kernel(bins_ref, gh_ref, out_ref, *, feature_tile: int,
-                 num_bin_padded: int, row_major: bool):
+                 num_bin_padded: int, row_major: bool,
+                 int8_mode: bool = False):
     """One (feature-tile, row-block) grid step.
 
     bins_ref: int32 [FT, RB] (feature-major) or [RB, FT] (row-major)
-    gh_ref:   f32  [C, RB]   — transposed, leaf-masked (grad, hess, count)
-    out_ref:  f32  [C, FT*Bp] — accumulator, pinned across row blocks
+    gh_ref:   f32/int8 [C, RB] — transposed, leaf-masked (grad, hess, count)
+    out_ref:  f32/int32 [C, FT*Bp] — accumulator, pinned across row blocks
+
+    ``int8_mode`` is the quantized-gradient path: the one-hot stays int8
+    and the contraction accumulates EXACTLY in int32 on the MXU
+    (ref: bin.h:49-82 integer histogram reducers).
     """
     j = pl.program_id(1)
 
@@ -54,16 +59,18 @@ def _hist_kernel(bins_ref, gh_ref, out_ref, *, feature_tile: int,
     rb = bins.shape[0] if row_major else bins.shape[1]
     iota_b = lax.broadcasted_iota(jnp.int32, (rb, num_bin_padded), 1)
 
+    onehot_dtype = jnp.int8 if int8_mode else jnp.float32
+    acc_dtype = jnp.int32 if int8_mode else jnp.float32
     # one-hot expansion, feature-major columns: col = f * Bp + b
     cols = [bins[:, f] if row_major else bins[f, :]
             for f in range(feature_tile)]
     onehot = jnp.concatenate(
-        [(c[:, None] == iota_b).astype(jnp.float32) for c in cols],
+        [(c[:, None] == iota_b).astype(onehot_dtype) for c in cols],
         axis=1)                                     # [RB, FT*Bp]
 
     out_ref[:] += lax.dot_general(
         gh, onehot, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        preferred_element_type=acc_dtype)
 
 
 def _pad_to(n: int, m: int) -> int:
@@ -81,6 +88,8 @@ def _hist_pallas_impl(bins: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
     else:
         F, R = bins.shape
     C = gh.shape[1]
+    int8_mode = gh.dtype == jnp.int8
+    acc_dtype = jnp.int32 if int8_mode else jnp.float32
     Bp = _pad_to(num_bin, 128)            # lane-align the bin axis
     Fp = _pad_to(F, feature_tile)
     Rp = _pad_to(R, block_rows)
@@ -97,7 +106,8 @@ def _hist_pallas_impl(bins: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
 
     grid = (Fp // feature_tile, Rp // block_rows)
     kernel = functools.partial(_hist_kernel, feature_tile=feature_tile,
-                               num_bin_padded=Bp, row_major=row_major)
+                               num_bin_padded=Bp, row_major=row_major,
+                               int8_mode=int8_mode)
     if row_major:
         bins_spec = pl.BlockSpec((block_rows, feature_tile),
                                  lambda i, j: (j, i),
@@ -116,7 +126,7 @@ def _hist_pallas_impl(bins: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
         ],
         out_specs=pl.BlockSpec((C, feature_tile * Bp), lambda i, j: (0, i),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((C, Fp * Bp), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((C, Fp * Bp), acc_dtype),
         interpret=interpret,
     )(bins.astype(jnp.int32), gh_t)
 

@@ -127,9 +127,9 @@ def hist_rowmajor(bins_rm: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
         # VMEM-resident one-hot kernel (no HBM traffic for the expansion)
         from .hist_pallas import hist_pallas_rm
         if int8_mode:
-            # exact int32 accumulation is einsum-only for now
-            raise ValueError("hist pallas backend does not support "
-                             "quantized gradients yet; use einsum")
+            # quantized path: int8 MXU contraction, exact int32 accumulation
+            return hist_pallas_rm(bins_rm, gh, num_bin,
+                                  block_rows=min(block_rows, 512))
         if bf16:
             # match the einsum bf16 path's numerics: gh rounded to bf16,
             # accumulation in f32 (the one-hot side is exact either way)

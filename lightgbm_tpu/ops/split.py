@@ -200,7 +200,7 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
                         feature_mask: jnp.ndarray = None,
                         leaf_range=None, leaf_depth=None,
                         gain_penalty: jnp.ndarray = None,
-                        rand_bins: jnp.ndarray = None) -> SplitRecord:
+                        rand_u: jnp.ndarray = None) -> SplitRecord:
     """Find the best split over all features for one leaf.
 
     Parameters
@@ -218,12 +218,13 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
     gain_penalty : optional f32 [F] — per-feature penalty subtracted from
         the net gain before the cross-feature argmax (CEGB DeltaGain,
         cost_effective_gradient_boosting.hpp:81-98).
-    rand_bins : optional i32 [F] — extremely-randomized mode
-        (config extra_trees): numerical candidates are restricted to this
-        one random threshold bin per feature (ref: USE_RAND template,
-        feature_histogram.hpp:195-205 "rand.NextInt(0, num_bin - 2)" and
-        :897 the candidate filter). Categorical features keep the full
-        subset scan, as in the reference.
+    rand_u : optional f32 [F] in [0, 1) — extremely-randomized mode
+        (config extra_trees): one random candidate per feature. Numerical
+        scans restrict to threshold bin floor(u * (num_bin - 2)) (ref:
+        USE_RAND, feature_histogram.hpp:205 "rand.NextInt(0, num_bin - 2)"
+        half-open + :897 filter); categorical one-hot picks one random
+        bin and the sorted-subset scan one random prefix length (ref:
+        feature_histogram.cpp:191,272 with the :218,:321 filters).
 
     Returns a scalar-per-field SplitRecord.
 
@@ -231,6 +232,11 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
     seeding: accumulating side starts at kEpsilon, parent hessian has +2eps
     (ref: feature_histogram.hpp:172 FindBestThreshold call site).
     """
+    rand_bins = None
+    if rand_u is not None:
+        span = jnp.maximum(meta.num_bin - 2, 1).astype(jnp.float32)
+        rand_bins = jnp.minimum((rand_u * span).astype(jnp.int32),
+                                meta.num_bin - 2)
     scan = _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
                              parent_output, meta, hp, leaf_range,
                              rand_bins=rand_bins)
@@ -238,7 +244,8 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
     if meta_has_categorical(meta):
         cat = _categorical_scan(hist, sum_gradient,
                                 sum_hessian + 2 * K_EPSILON, num_data,
-                                parent_output, meta, hp, leaf_range)
+                                parent_output, meta, hp, leaf_range,
+                                rand_u=rand_u)
     return _select_across_features(scan, meta, hp, feature_mask, leaf_depth,
                                    gain_penalty, parent_output, cat=cat)
 
@@ -385,7 +392,8 @@ def _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
 
 def _categorical_scan(hist, sum_gradient, sum_hessian, num_data,
                       parent_output, meta: FeatureMeta,
-                      hp: SplitHyperParams, leaf_range=None) -> dict:
+                      hp: SplitHyperParams, leaf_range=None,
+                      rand_u=None) -> dict:
     """Best categorical split per feature.
 
     Mirror of FindBestThresholdCategoricalInner
@@ -461,6 +469,13 @@ def _categorical_scan(hist, sum_gradient, sum_hessian, num_data,
               (h >= hp.min_sum_hessian_in_leaf) &
               (rc1 >= hp.min_data_in_leaf) &
               (rh1 >= hp.min_sum_hessian_in_leaf) & ok1)
+    if rand_u is not None:
+        # extra_trees one-hot: one random category bin per feature
+        # (ref: feature_histogram.cpp:191 NextInt(bin_start, bin_end))
+        span1 = jnp.maximum(nbin[:, 0] - 1, 1).astype(jnp.float32)
+        rand1 = 1 + jnp.minimum((rand_u * span1).astype(jnp.int32),
+                                nbin[:, 0] - 2)
+        valid1 &= bin_idx == rand1[:, None]
     gain1 = jnp.where(valid1 & (gain1 > min_gain_shift), gain1, K_MIN_SCORE)
     t1 = jnp.argmax(gain1, axis=1).astype(jnp.int32)  # ties -> smaller bin
     take1 = lambda a: jnp.take_along_axis(a, t1[:, None], axis=1)[:, 0]
@@ -515,6 +530,16 @@ def _categorical_scan(hist, sum_gradient, sum_hessian, num_data,
         step, (jnp.zeros((F, 2), jnp.float32), jnp.ones((F, 2), bool)),
         jnp.arange(KK))
     cand = jnp.moveaxis(cand_seq, 0, 2) & within            # [F, 2, KK]
+    if rand_u is not None:
+        # extra_trees sorted-subset: one random prefix length, shared by
+        # both scan directions (ref: feature_histogram.cpp:272
+        # NextInt(0, max_threshold) drawn before the direction loop, :321)
+        max_thr = jnp.maximum(jnp.minimum(max_num_cat, used_bin) - 1, 0)
+        rand_p = jnp.minimum((rand_u * jnp.maximum(
+            max_thr, 1).astype(jnp.float32)).astype(jnp.int32),
+            jnp.maximum(max_thr - 1, 0))
+        cand &= (jnp.arange(KK, dtype=jnp.int32)[None, None, :] ==
+                 rand_p[:, None, None])
     gain2, lo2, ro2, ok2 = gains_mc(Lg, Lh, Lc, Rg, Rh, Rc, hp_cat, mono2)
     gain2 = jnp.where(cand & ok2 & (gain2 > min_gain_shift), gain2,
                       K_MIN_SCORE)

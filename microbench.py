@@ -62,19 +62,24 @@ def bench_pallas_rm():
     R, F, B = 1_048_576, 28, 256
     bins_rm = jnp.asarray(rng.integers(0, B - 1, (R, F), dtype=np.uint8))
     gh = jnp.asarray(rng.normal(size=(R, 3)).astype(np.float32))
+    ghq = jnp.asarray(rng.integers(-8, 8, (R, 3), dtype=np.int8))
     for S in (16384, 131072, 1_048_576):
         for blk in (256, 512, 1024):
             for ft in (4, 7, 14, 28):
-                try:
-                    f = jax.jit(lambda b, g, blk=blk, ft=ft: hist_pallas_rm(
-                        b, g, num_bin=B, block_rows=blk, feature_tile=ft))
-                    dt_s = timeit(f, bins_rm[:S], gh[:S])
-                    print(f"hist_pallas_rm S={S:8d} blk={blk:5d} ft={ft:2d}:"
-                          f" {dt_s*1e3:8.3f} ms ({S/dt_s/1e9:.2f} Grows/s)",
-                          flush=True)
-                except Exception as e:
-                    print(f"hist_pallas_rm S={S} blk={blk} ft={ft}: FAIL "
-                          f"{type(e).__name__}: {str(e)[:100]}", flush=True)
+                for name, g in (("f32", gh), ("int8", ghq)):
+                    try:
+                        f = jax.jit(
+                            lambda b, g, blk=blk, ft=ft: hist_pallas_rm(
+                                b, g, num_bin=B, block_rows=blk,
+                                feature_tile=ft))
+                        dt_s = timeit(f, bins_rm[:S], g[:S])
+                        print(f"hist_pallas_rm S={S:8d} blk={blk:5d} "
+                              f"ft={ft:2d} {name}: {dt_s*1e3:8.3f} ms "
+                              f"({S/dt_s/1e9:.2f} Grows/s)", flush=True)
+                    except Exception as e:
+                        print(f"hist_pallas_rm S={S} blk={blk} ft={ft} "
+                              f"{name}: FAIL {type(e).__name__}: "
+                              f"{str(e)[:100]}", flush=True)
 
 
 def bench_pallas():

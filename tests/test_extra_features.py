@@ -104,3 +104,23 @@ def test_redirected_params_warn(capsys):
     err = capsys.readouterr().err
     assert "machines" in err and "init_distributed" in err
     assert "num_threads" in err
+
+
+def test_extra_trees_categorical_randomized(rng):
+    # categorical candidates must be randomized too (USE_RAND applies to
+    # one-hot and sorted-subset categorical scans in the reference)
+    n = 600
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    X[:, 1] = rng.integers(0, 12, size=n)
+    y = (X[:, 1] % 3 == 0).astype(np.float32) + 0.1 * X[:, 0]
+    base = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+            "min_data_in_leaf": 5, "seed": 3}
+    ds = lambda: lgb.Dataset(X, label=y, categorical_feature=[1])
+    p_full = lgb.train(base, ds(), num_boost_round=10).predict(X)
+    p_et1 = lgb.train(dict(base, extra_trees=True, extra_seed=5), ds(),
+                      num_boost_round=10).predict(X)
+    p_et2 = lgb.train(dict(base, extra_trees=True, extra_seed=6), ds(),
+                      num_boost_round=10).predict(X)
+    assert not np.allclose(p_et1, p_full)
+    assert not np.allclose(p_et1, p_et2)
+    assert np.isfinite(p_et1).all()

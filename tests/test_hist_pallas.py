@@ -69,3 +69,18 @@ def test_hist_rowmajor_pallas_backend(rng):
     out = np.asarray(hist_rowmajor(jnp.asarray(bins), jnp.asarray(gh),
                                    num_bin=B, backend="pallas"))
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
+
+
+def test_hist_pallas_rm_int8_exact(rng):
+    """Quantized path: int8 contraction accumulates exactly in int32."""
+    from lightgbm_tpu.ops.histogram import hist_rowmajor
+
+    S, F, B = 700, 5, 64
+    bins = rng.integers(0, B, size=(S, F)).astype(np.uint8)
+    ghq = rng.integers(-8, 8, size=(S, 3)).astype(np.int8)
+    ref = np.asarray(hist_rowmajor(jnp.asarray(bins), jnp.asarray(ghq),
+                                   num_bin=B, backend="einsum"))
+    out = np.asarray(hist_rowmajor(jnp.asarray(bins), jnp.asarray(ghq),
+                                   num_bin=B, backend="pallas"))
+    assert out.dtype == np.int32 and ref.dtype == np.int32
+    np.testing.assert_array_equal(out, ref)
