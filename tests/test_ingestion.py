@@ -90,3 +90,18 @@ def test_sparse_with_efb(rng):
     assert bst._engine._bundle["num_groups"] < k
     bst.update()
     assert np.isfinite(bst.predict(X.toarray())).all()
+
+
+def test_sklearn_sparse_fit_predict(rng):
+    X, y = _sparse_data(rng)
+    yb = (y > 0).astype(int)
+    sp = scipy_sparse.csr_matrix(X)
+    clf = lgb.LGBMClassifier(n_estimators=8, num_leaves=15,
+                             min_child_samples=5, verbose=-1)
+    clf.fit(sp, yb)
+    p_sp = clf.predict_proba(sp)
+    clf_d = lgb.LGBMClassifier(n_estimators=8, num_leaves=15,
+                               min_child_samples=5, verbose=-1)
+    clf_d.fit(X, yb)
+    np.testing.assert_allclose(p_sp, clf_d.predict_proba(X),
+                               rtol=1e-6, atol=1e-7)
