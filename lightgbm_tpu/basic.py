@@ -220,8 +220,11 @@ class Dataset:
     # -- setters (ref: set_field paths) ---------------------------------
     def set_label(self, label) -> "Dataset":
         self.label = label
-        if self._binned is not None and label is not None:
-            self._binned.metadata.set_label(_to_1d_numpy(label))
+        if self._binned is not None:
+            if label is None:
+                self._binned.metadata.label = None   # unset, like set_field
+            else:
+                self._binned.metadata.set_label(_to_1d_numpy(label))
         return self
 
     def set_weight(self, weight) -> "Dataset":
@@ -280,6 +283,143 @@ class Dataset:
         if self.data is not None and hasattr(self.data, "shape"):
             return int(self.data.shape[1])
         raise LightGBMError("Dataset not constructed")
+
+    def set_position(self, position) -> "Dataset":
+        self.position = position
+        if self._binned is not None:
+            self._binned.metadata.set_position(
+                _to_1d_numpy(position, np.int32)
+                if position is not None else None)
+        return self
+
+    def get_position(self):
+        if self._binned is not None:
+            return self._binned.metadata.position
+        return self.position
+
+    # generic field access (ref: basic.py Dataset.set_field/get_field)
+    _FIELDS = {"label": ("set_label", "get_label"),
+               "weight": ("set_weight", "get_weight"),
+               "group": ("set_group", "get_group"),
+               "init_score": ("set_init_score", "get_init_score"),
+               "position": ("set_position", "get_position")}
+
+    def set_field(self, field_name: str, data) -> "Dataset":
+        if field_name not in self._FIELDS:
+            raise LightGBMError(f"Unknown field name: {field_name}")
+        getattr(self, self._FIELDS[field_name][0])(data)
+        return self
+
+    def get_field(self, field_name: str):
+        if field_name not in self._FIELDS:
+            raise LightGBMError(f"Unknown field name: {field_name}")
+        if field_name == "group" and self._binned is not None:
+            # the FIELD is the cumulative boundaries array (ref: basic.py
+            # get_field('group') -> [0, n1, n1+n2, ...]); get_group()
+            # returns the per-query sizes
+            return self._binned.metadata.query_boundaries
+        return getattr(self, self._FIELDS[field_name][1])()
+
+    def get_data(self):
+        """The raw data this Dataset was built from (None once freed by
+        free_raw_data=True construction, like the reference)."""
+        return self.data
+
+    def get_feature_name(self) -> List[str]:
+        return list(self.construct()._binned.feature_names)
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        if feature_name is not None and feature_name != "auto":
+            names = [str(f) for f in feature_name]
+            self.feature_name = names
+            if self._binned is not None:
+                if len(names) != self._binned.num_total_features:
+                    raise LightGBMError(
+                        f"Length of feature names ({len(names)}) does not "
+                        "equal the number of features "
+                        f"({self._binned.num_total_features})")
+                self._binned.feature_names = names
+        return self
+
+    def feature_num_bin(self, feature: Union[int, str]) -> int:
+        """Number of bins of one feature (ref: basic.py feature_num_bin /
+        LGBM_DatasetGetFeatureNumBin)."""
+        binned = self.construct()._binned
+        if isinstance(feature, str):
+            if feature not in binned.feature_names:
+                raise LightGBMError(f"Unknown feature name: {feature!r}")
+            feature = binned.feature_names.index(feature)
+        return int(binned.bin_mappers[int(feature)].num_bin)
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        """Bin this dataset in ``reference``'s bin space
+        (ref: basic.py set_reference — merges the reference's dataset
+        params first, no-ops on the same reference, and refuses to change
+        it after construction)."""
+        self._update_params(reference.params)
+        if self.reference is reference:
+            return self
+        if self._binned is not None:
+            raise LightGBMError(
+                "Cannot set reference after the dataset was constructed")
+        self.reference = reference
+        return self
+
+    def get_ref_chain(self, ref_limit: int = 100) -> set:
+        """The chain of reference datasets (ref: basic.py get_ref_chain)."""
+        head = self
+        ref_chain: set = set()
+        while len(ref_chain) < ref_limit:
+            if isinstance(head, Dataset):
+                ref_chain.add(head)
+                if head.reference is not None and \
+                        head.reference not in ref_chain:
+                    head = head.reference
+                else:
+                    break
+            else:
+                break
+        return ref_chain
+
+    def get_params(self) -> Dict[str, Any]:
+        """The dataset-relevant parameters this Dataset carries
+        (ref: basic.py get_params returns the _PARAMETER_ALIASES subset)."""
+        return copy.deepcopy(self.params)
+
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Append ``other``'s features to this dataset in place
+        (ref: basic.py add_features_from / Dataset::AddFeaturesFrom —
+        both datasets must be constructed with the same row count; this
+        dataset keeps its metadata)."""
+        a = self.construct()._binned
+        b = other.construct()._binned
+        if a.num_data != b.num_data:
+            raise LightGBMError(
+                f"Cannot add features from a dataset with {b.num_data} "
+                f"rows to one with {a.num_data} rows")
+        off = a.num_total_features
+        a.bin_mappers = list(a.bin_mappers) + list(b.bin_mappers)
+        a.used_feature_map = np.concatenate(
+            [a.used_feature_map, b.used_feature_map + off]).astype(np.int32)
+        if a.bins is not None and b.bins is not None:
+            dtype = (np.uint16 if (a.bins.dtype == np.uint16 or
+                                   b.bins.dtype == np.uint16) else np.uint8)
+            a.bins = np.concatenate([a.bins.astype(dtype),
+                                     b.bins.astype(dtype)], axis=0)
+        a.num_total_features += b.num_total_features
+        # de-duplicate colliding default names like the reference warns
+        merged = list(a.feature_names) + list(b.feature_names)
+        if len(set(merged)) != len(merged):
+            merged = (list(a.feature_names) +
+                      [f"D{off + i}_{n}"
+                       for i, n in enumerate(b.feature_names)])
+        a.feature_names = merged
+        if a.raw is not None and b.raw is not None:
+            a.raw = np.concatenate([a.raw, b.raw], axis=1)
+        else:
+            a.raw = None
+        a.max_bin = max(a.max_bin, b.max_bin)
+        return self
 
     def subset(self, used_indices: Sequence[int],
                params: Optional[Dict] = None) -> "Dataset":
@@ -658,6 +798,103 @@ class Booster:
                                num_iteration=num_iteration,
                                start_iteration=start_iteration,
                                importance_type=importance_type)
+
+    def model_from_string(self, model_str: str) -> "Booster":
+        """Replace this handle's model with one parsed from a string
+        (ref: basic.py Booster.model_from_string)."""
+        from .io.model_io import load_model_string
+        self._engine, self.config = load_model_string(model_str)
+        return self
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        self.train_data_name = name
+        return self
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """ref: Booster.get_leaf_output / LGBM_BoosterGetLeafValue."""
+        return float(self._engine.models[tree_id].leaf_value[leaf_id])
+
+    def set_leaf_output(self, tree_id: int, leaf_id: int,
+                        value: float) -> "Booster":
+        """ref: Booster.set_leaf_output / Tree::SetLeafOutput."""
+        t = self._engine.models[tree_id]
+        t.leaf_value = np.asarray(t.leaf_value, np.float64).copy()
+        t.leaf_value[leaf_id] = float(value)
+        return self
+
+    def trees_to_dataframe(self):
+        """Flatten the model into a pandas DataFrame, one row per node
+        (ref: basic.py Booster.trees_to_dataframe column schema)."""
+        import pandas as pd
+
+        names = self.feature_name()
+        rows = []
+        for tree_idx, t in enumerate(self._engine.models):
+
+            def node_row(parent, depth, is_leaf, idx):
+                if is_leaf:
+                    return {
+                        "tree_index": tree_idx, "node_depth": depth,
+                        "node_index": f"{tree_idx}-L{idx}",
+                        "left_child": None, "right_child": None,
+                        "parent_index": parent, "split_feature": None,
+                        "split_gain": None, "threshold": None,
+                        "decision_type": None, "missing_direction": None,
+                        "missing_type": None,
+                        "value": float(t.leaf_value[idx]),
+                        "weight": float(t.leaf_weight[idx]),
+                        "count": int(t.leaf_count[idx])}
+                f = int(t.split_feature[idx])
+                is_cat = bool(t.decision_type[idx] & 1)
+                dl = bool(t.decision_type[idx] & 2)
+                mtype = (int(t.decision_type[idx]) >> 2) & 3
+                if is_cat:
+                    # the reference emits the ||-joined category values;
+                    # threshold_real of a cat node is its cat_boundaries
+                    # index (core/tree.py:263)
+                    thr = "||".join(
+                        str(v)
+                        for v in t.cat_values(int(t.threshold_real[idx])))
+                else:
+                    thr = float(t.threshold_real[idx])
+                return {
+                    "tree_index": tree_idx, "node_depth": depth,
+                    "node_index": f"{tree_idx}-S{idx}",
+                    "left_child": None, "right_child": None,
+                    "parent_index": parent,
+                    "split_feature": names[f] if f < len(names) else f,
+                    "split_gain": float(t.split_gain[idx]),
+                    "threshold": thr,
+                    "decision_type": "==" if is_cat else "<=",
+                    "missing_direction": "left" if dl else "right",
+                    "missing_type": ["None", "Zero", "NaN"][mtype],
+                    "value": float(t.internal_value[idx]),
+                    "weight": float(t.internal_weight[idx]),
+                    "count": int(t.internal_count[idx])}
+
+            if t.num_leaves <= 1:
+                rows.append(node_row(None, 1, True, 0))
+                continue
+
+            # explicit stack — leaf-wise trees can be num_leaves deep
+            stack = [(0, None, 1)]
+            while stack:
+                node, parent, depth = stack.pop()
+                if node < 0:
+                    rows.append(node_row(parent, depth, True, ~node))
+                    continue
+                row = node_row(parent, depth, False, node)
+                rows.append(row)
+                me = row["node_index"]
+                lc, rc = int(t.left_child[node]), int(t.right_child[node])
+                row["left_child"] = (f"{tree_idx}-S{lc}" if lc >= 0
+                                     else f"{tree_idx}-L{~lc}")
+                row["right_child"] = (f"{tree_idx}-S{rc}" if rc >= 0
+                                      else f"{tree_idx}-L{~rc}")
+                # push right first so the left subtree is emitted first
+                stack.append((rc, me, depth + 1))
+                stack.append((lc, me, depth + 1))
+        return pd.DataFrame(rows)
 
     # -- introspection --------------------------------------------------
     def feature_name(self) -> List[str]:

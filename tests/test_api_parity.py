@@ -1,0 +1,138 @@
+"""Python-API parity methods (ref: python-package/lightgbm/basic.py):
+Dataset field access, feature helpers, reference chains,
+add_features_from; Booster model_from_string, leaf output access,
+trees_to_dataframe."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _ds(rng, n=400, f=5):
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    return X, y
+
+
+def test_dataset_fields(rng):
+    X, y = _ds(rng)
+    w = rng.uniform(0.5, 1.5, size=len(y)).astype(np.float32)
+    ds = lgb.Dataset(X, label=y).construct()
+    ds.set_field("weight", w)
+    np.testing.assert_allclose(ds.get_field("weight"), w, rtol=1e-6)
+    np.testing.assert_allclose(ds.get_field("label"), y)
+    with pytest.raises(lgb.LightGBMError):
+        ds.get_field("nope")
+
+
+def test_dataset_feature_helpers(rng):
+    X, y = _ds(rng)
+    ds = lgb.Dataset(X, label=y, feature_name=[f"f{i}" for i in range(5)])
+    assert ds.get_feature_name() == ["f0", "f1", "f2", "f3", "f4"]
+    assert ds.feature_num_bin(0) > 1
+    assert ds.feature_num_bin("f1") == ds.feature_num_bin(1)
+    ds.set_feature_name([f"g{i}" for i in range(5)])
+    assert ds.get_feature_name()[0] == "g0"
+
+
+def test_dataset_ref_chain_and_reference(rng):
+    X, y = _ds(rng)
+    train = lgb.Dataset(X, label=y)
+    valid = lgb.Dataset(X[:100], label=y[:100])
+    valid.set_reference(train)
+    assert valid.reference is train
+    chain = valid.get_ref_chain()
+    assert train in chain and valid in chain
+    valid.construct()
+    # idempotent re-set of the SAME reference is a no-op (ref semantics)
+    assert valid.set_reference(train) is valid
+    other = lgb.Dataset(X, label=y)
+    with pytest.raises(lgb.LightGBMError):
+        valid.set_reference(other)
+
+
+def test_add_features_from(rng):
+    X, y = _ds(rng)
+    X2 = rng.normal(size=(400, 3)).astype(np.float32)
+    a = lgb.Dataset(X, label=y, free_raw_data=False).construct()
+    b = lgb.Dataset(X2, free_raw_data=False).construct()
+    a.add_features_from(b)
+    assert a.num_feature() == 8
+    bst = lgb.Booster({"objective": "binary", "verbose": -1,
+                       "min_data_in_leaf": 5}, a)
+    bst.update()
+    assert np.isfinite(
+        bst.predict(np.hstack([X, X2]))).all()
+
+
+def test_booster_model_from_string_and_leaf_output(rng):
+    X, y = _ds(rng)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "min_data_in_leaf": 5}, lgb.Dataset(X, label=y),
+                    num_boost_round=3)
+    s = bst.model_to_string()
+    other = lgb.train({"objective": "regression", "verbose": -1,
+                       "min_data_in_leaf": 5},
+                      lgb.Dataset(X, label=y), num_boost_round=1)
+    other.model_from_string(s)
+    np.testing.assert_allclose(other.predict(X), bst.predict(X),
+                               rtol=1e-9, atol=1e-12)
+    v = bst.get_leaf_output(0, 1)
+    bst.set_leaf_output(0, 1, v + 0.25)
+    assert bst.get_leaf_output(0, 1) == pytest.approx(v + 0.25)
+    assert bst.set_train_data_name("tr") is bst
+    assert bst.train_data_name == "tr"
+
+
+def test_trees_to_dataframe(rng):
+    pd = pytest.importorskip("pandas")
+    X, y = _ds(rng)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "min_data_in_leaf": 5}, lgb.Dataset(X, label=y),
+                    num_boost_round=2)
+    df = bst.trees_to_dataframe()
+    assert isinstance(df, pd.DataFrame)
+    assert set(df["tree_index"].unique()) == {0, 1}
+    internal = df[df["split_feature"].notna()]
+    leaves = df[df["split_feature"].isna()]
+    assert len(leaves) == len(internal) + 2  # leaves = splits + 1 per tree
+    # child pointers resolve to rows of the same tree
+    some = internal.iloc[0]
+    assert some["left_child"] in set(df["node_index"])
+    assert some["right_child"] in set(df["node_index"])
+    # root count equals dataset rows
+    roots = df[(df["node_depth"] == 1)]
+    assert (roots["count"] == 400).all()
+
+
+def test_get_field_group_is_boundaries(rng):
+    X, y = _ds(rng)
+    sizes = np.asarray([100, 150, 150])
+    ds = lgb.Dataset(X, label=y, group=sizes).construct()
+    np.testing.assert_array_equal(ds.get_field("group"), [0, 100, 250, 400])
+    np.testing.assert_array_equal(ds.get_group(), sizes)
+
+
+def test_set_field_label_none_unsets(rng):
+    X, y = _ds(rng)
+    ds = lgb.Dataset(X, label=y).construct()
+    ds.set_field("label", None)
+    assert ds.get_field("label") is None
+
+
+def test_trees_to_dataframe_categorical(rng):
+    pd = pytest.importorskip("pandas")
+    n = 500
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    X[:, 1] = rng.integers(0, 8, size=n)
+    y = (X[:, 1] % 2 == 0).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y, categorical_feature=[1]),
+                    num_boost_round=2)
+    df = bst.trees_to_dataframe()
+    cat_rows = df[df["decision_type"] == "=="]
+    assert len(cat_rows) > 0
+    # category sets are ||-joined ints, not slot indices
+    assert all("||" in str(v) or str(v).isdigit()
+               for v in cat_rows["threshold"])
