@@ -313,7 +313,10 @@ class Dataset:
     def get_field(self, field_name: str):
         if field_name not in self._FIELDS:
             raise LightGBMError(f"Unknown field name: {field_name}")
-        if field_name == "group" and self._binned is not None:
+        if self._binned is None:
+            # ref: basic.py get_field raises before construction
+            raise LightGBMError("Cannot get fields before construct Dataset")
+        if field_name == "group":
             # the FIELD is the cumulative boundaries array (ref: basic.py
             # get_field('group') -> [0, n1, n1+n2, ...]); get_group()
             # returns the per-query sizes
@@ -419,6 +422,18 @@ class Dataset:
         else:
             a.raw = None
         a.max_bin = max(a.max_bin, b.max_bin)
+        # keep Dataset-level state consistent with the merged binned view
+        # (ref: add_features_from concatenates self.data or drops it)
+        if self.data is not None and other.data is not None and \
+                hasattr(self.data, "shape") and hasattr(other.data, "shape"):
+            self.data = np.hstack([np.asarray(self.data),
+                                   np.asarray(other.data)])
+        elif self.data is not None:
+            log.warning("Cannot keep raw data after add_features_from "
+                        "(one side was freed); set free_raw_data=False on "
+                        "both datasets to keep it")
+            self.data = None
+        self.feature_name = list(a.feature_names)
         return self
 
     def subset(self, used_indices: Sequence[int],

@@ -164,9 +164,50 @@ class CVBooster:
     def __init__(self, model_file: Optional[str] = None):
         self.boosters: List[Booster] = []
         self.best_iteration = -1
+        if model_file is not None:
+            import json
+            with open(model_file) as f:
+                self._from_dict(json.load(f))
 
     def _append(self, booster: Booster) -> None:
         self.boosters.append(booster)
+
+    def _to_dict(self, num_iteration, start_iteration, importance_type):
+        """ref: CVBooster._to_dict — per-fold model strings + metadata."""
+        return {"boosters": [
+                    b.model_to_string(num_iteration=num_iteration,
+                                      start_iteration=start_iteration,
+                                      importance_type=importance_type)
+                    for b in self.boosters],
+                "best_iteration": self.best_iteration}
+
+    def _from_dict(self, models: dict) -> None:
+        self.best_iteration = models.get("best_iteration", -1)
+        self.boosters = [Booster(model_str=s)
+                         for s in models.get("boosters", [])]
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0,
+                        importance_type: str = "split") -> str:
+        """All folds as one JSON string (ref: CVBooster.model_to_string)."""
+        import json
+        return json.dumps(self._to_dict(num_iteration, start_iteration,
+                                        importance_type))
+
+    def model_from_string(self, model_str: str) -> "CVBooster":
+        """Load the folds back from a JSON string."""
+        import json
+        self._from_dict(json.loads(model_str))
+        return self
+
+    def save_model(self, filename, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> "CVBooster":
+        """ref: CVBooster.save_model."""
+        with open(str(filename), "w") as f:
+            f.write(self.model_to_string(num_iteration, start_iteration,
+                                         importance_type))
+        return self
 
     def __getattr__(self, name: str):
         if name.startswith("__"):  # keep copy/pickle/introspection sane

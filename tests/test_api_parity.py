@@ -58,6 +58,7 @@ def test_add_features_from(rng):
     b = lgb.Dataset(X2, free_raw_data=False).construct()
     a.add_features_from(b)
     assert a.num_feature() == 8
+    assert a.get_data().shape == (400, 8)   # raw data merged too
     bst = lgb.Booster({"objective": "binary", "verbose": -1,
                        "min_data_in_leaf": 5}, a)
     bst.update()
@@ -108,7 +109,10 @@ def test_trees_to_dataframe(rng):
 def test_get_field_group_is_boundaries(rng):
     X, y = _ds(rng)
     sizes = np.asarray([100, 150, 150])
-    ds = lgb.Dataset(X, label=y, group=sizes).construct()
+    ds = lgb.Dataset(X, label=y, group=sizes)
+    with pytest.raises(lgb.LightGBMError):   # ref: raises pre-construct
+        ds.get_field("group")
+    ds.construct()
     np.testing.assert_array_equal(ds.get_field("group"), [0, 100, 250, 400])
     np.testing.assert_array_equal(ds.get_group(), sizes)
 
@@ -136,3 +140,31 @@ def test_trees_to_dataframe_categorical(rng):
     # category sets are ||-joined ints, not slot indices
     assert all("||" in str(v) or str(v).isdigit()
                for v in cat_rows["threshold"])
+
+
+def test_cvbooster_save_load(rng, tmp_path):
+    X, y = _ds(rng)
+    res = lgb.cv({"objective": "binary", "verbose": -1,
+                  "min_data_in_leaf": 5}, lgb.Dataset(X, label=y),
+                 num_boost_round=5, nfold=3, return_cvbooster=True)
+    cvb = res["cvbooster"]
+    path = str(tmp_path / "cv.json")
+    cvb.save_model(path)
+    loaded = lgb.CVBooster(model_file=path)
+    assert len(loaded.boosters) == 3
+    for a, b in zip(cvb.boosters, loaded.boosters):
+        np.testing.assert_allclose(a.predict(X[:50]), b.predict(X[:50]),
+                                   rtol=1e-9, atol=1e-12)
+    rt = lgb.CVBooster().model_from_string(cvb.model_to_string())
+    assert len(rt.boosters) == 3
+
+
+def test_sklearn_feature_names_in(rng):
+    pd = pytest.importorskip("pandas")
+    X, y = _ds(rng)
+    df = pd.DataFrame(X, columns=[f"c{i}" for i in range(5)])
+    reg = lgb.LGBMRegressor(n_estimators=3, min_child_samples=5,
+                            verbose=-1)
+    reg.fit(df, y)
+    np.testing.assert_array_equal(reg.feature_names_in_,
+                                  ["c0", "c1", "c2", "c3", "c4"])
