@@ -50,6 +50,27 @@ def _to_1d_numpy(data, dtype=np.float32) -> np.ndarray:
     return np.ascontiguousarray(np.asarray(data).reshape(-1), dtype=dtype)
 
 
+def _hstack_any(a, b):
+    """Column-concatenate two raw-data containers of possibly different
+    types (dense/list/pandas/scipy); None when no sensible merge exists."""
+    if _is_scipy_sparse(a) or _is_scipy_sparse(b):
+        import scipy.sparse as sp
+        if _is_scipy_sparse(a) and _is_scipy_sparse(b):
+            return sp.hstack([a, b], format="csr")
+        return None
+    if hasattr(a, "columns") and hasattr(b, "columns"):  # both pandas
+        import pandas as pd
+        return pd.concat([a.reset_index(drop=True),
+                          b.reset_index(drop=True)], axis=1)
+    try:
+        aa, bb = np.asarray(a), np.asarray(b)
+        if aa.ndim == 2 and bb.ndim == 2 and aa.shape[0] == bb.shape[0]:
+            return np.hstack([aa, bb])
+    except Exception:
+        pass
+    return None
+
+
 def _is_scipy_sparse(data) -> bool:
     try:
         import scipy.sparse as sp
@@ -424,14 +445,15 @@ class Dataset:
         a.max_bin = max(a.max_bin, b.max_bin)
         # keep Dataset-level state consistent with the merged binned view
         # (ref: add_features_from concatenates self.data or drops it)
-        if self.data is not None and other.data is not None and \
-                hasattr(self.data, "shape") and hasattr(other.data, "shape"):
-            self.data = np.hstack([np.asarray(self.data),
-                                   np.asarray(other.data)])
+        if self.data is not None and other.data is not None:
+            self.data = _hstack_any(self.data, other.data)
+            if self.data is None:
+                log.warning("Cannot merge raw data of these input types "
+                            "after add_features_from; raw data dropped")
         elif self.data is not None:
             log.warning("Cannot keep raw data after add_features_from "
-                        "(one side was freed); set free_raw_data=False on "
-                        "both datasets to keep it")
+                        "(the other dataset was constructed with "
+                        "free_raw_data=True)")
             self.data = None
         self.feature_name = list(a.feature_names)
         return self

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import collections
 import copy
+import json
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import numpy as np
@@ -165,7 +166,6 @@ class CVBooster:
         self.boosters: List[Booster] = []
         self.best_iteration = -1
         if model_file is not None:
-            import json
             with open(model_file) as f:
                 self._from_dict(json.load(f))
 
@@ -190,13 +190,11 @@ class CVBooster:
                         start_iteration: int = 0,
                         importance_type: str = "split") -> str:
         """All folds as one JSON string (ref: CVBooster.model_to_string)."""
-        import json
         return json.dumps(self._to_dict(num_iteration, start_iteration,
                                         importance_type))
 
     def model_from_string(self, model_str: str) -> "CVBooster":
         """Load the folds back from a JSON string."""
-        import json
         self._from_dict(json.loads(model_str))
         return self
 

@@ -168,3 +168,25 @@ def test_sklearn_feature_names_in(rng):
     reg.fit(df, y)
     np.testing.assert_array_equal(reg.feature_names_in_,
                                   ["c0", "c1", "c2", "c3", "c4"])
+
+
+def test_add_features_from_sparse_and_pandas(rng):
+    scipy_sparse = pytest.importorskip("scipy.sparse")
+    pd = pytest.importorskip("pandas")
+    X, y = _ds(rng)
+    # sparse + sparse -> sparse hstack
+    a = lgb.Dataset(scipy_sparse.csr_matrix(X), label=y,
+                    free_raw_data=False).construct()
+    b = lgb.Dataset(scipy_sparse.csr_matrix(X[:, :2]),
+                    free_raw_data=False).construct()
+    a.add_features_from(b)
+    assert scipy_sparse.issparse(a.get_data())
+    assert a.get_data().shape == (400, 7)
+    # pandas + pandas -> DataFrame concat keeping names
+    dfa = pd.DataFrame(X, columns=[f"a{i}" for i in range(5)])
+    dfb = pd.DataFrame(X[:, :2], columns=["b0", "b1"])
+    c = lgb.Dataset(dfa, label=y, free_raw_data=False).construct()
+    d = lgb.Dataset(dfb, free_raw_data=False).construct()
+    c.add_features_from(d)
+    assert list(c.get_data().columns) == \
+        ["a0", "a1", "a2", "a3", "a4", "b0", "b1"]
