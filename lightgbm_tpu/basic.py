@@ -450,6 +450,10 @@ class Dataset:
             if self.data is None:
                 log.warning("Cannot merge raw data of these input types "
                             "after add_features_from; raw data dropped")
+            elif (hasattr(self.data, "columns") and
+                  len(a.feature_names) == self.data.shape[1]):
+                # keep columns aligned with the (possibly deduped) names
+                self.data.columns = list(a.feature_names)
         elif self.data is not None:
             log.warning("Cannot keep raw data after add_features_from "
                         "(the other dataset was constructed with "

@@ -370,9 +370,7 @@ def _parse_list(value: Any, elem_type: Any) -> List[Any]:
 # Each entry maps name -> predicate over the resolved value that is True when
 # the setting would require an unimplemented feature. Entries are removed as
 # the features land.
-_UNIMPLEMENTED_WHEN = {
-    "tpu_donate_state": lambda v: True,
-}
+_UNIMPLEMENTED_WHEN: Dict[str, Any] = {}
 
 # Parameters that exist in the reference but map to a DIFFERENT mechanism
 # here; when set explicitly, point the user at the TPU-native equivalent

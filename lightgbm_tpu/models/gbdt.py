@@ -281,6 +281,7 @@ class GBDT:
                     else (cfg.seed if cfg.seed is not None else 0))
         self._grow_rng = (jax.random.PRNGKey(int(rng_seed))
                           if need_rng else None)
+        self._score_add_fn = None
         # ---- tree learner selection (ref: tree_learner.cpp:17 factory) ----
         # serial runs the single-program grower; data/voting shard rows and
         # feature shards columns over a jax Mesh, with the FULL TrainOneIter
@@ -742,6 +743,20 @@ class GBDT:
         init = self.objective.boost_from_score(k) if self.objective else 0.0
         return float(init)
 
+    def _score_add(self, score, delta, k: int):
+        """score[k] += delta, donating the old score buffer when
+        tpu_donate_state is on (the [K, N] score array is the largest
+        training-state buffer; donation lets XLA update it in place
+        instead of holding both generations in HBM)."""
+        if self._score_add_fn is None:
+            if self.config.tpu_donate_state:
+                self._score_add_fn = jax.jit(
+                    lambda s, d, kk: s.at[kk].add(d),
+                    donate_argnums=(0,))
+            else:
+                self._score_add_fn = jax.jit(lambda s, d, kk: s.at[kk].add(d))
+        return self._score_add_fn(score, delta, k)
+
     def _boost_from_average(self, k: int) -> float:
         """ref: gbdt.cpp:328 BoostFromAverage."""
         if (not self.models and not self.has_init_score and
@@ -912,14 +927,16 @@ class GBDT:
             with global_timer.section("GBDT::UpdateScore",
                                       sync=lambda: self.score):
                 if host.is_linear:
-                    self.score = self.score.at[k].add(jnp.asarray(
+                    delta = jnp.asarray(
                         host.linear_output(self.train_set.raw,
-                                           leaf_np).astype(np.float32)))
+                                           leaf_np).astype(np.float32))
+                    self.score = self._score_add(self.score, delta, k)
                 else:
                     lv = np.zeros(self.config.num_leaves, np.float32)
                     lv[:host.num_leaves] = host.leaf_value[:host.num_leaves]
                     lv_dev = jnp.asarray(lv)
-                    self.score = self.score.at[k].add(lv_dev[leaf_id])
+                    self.score = self._score_add(self.score,
+                                                 lv_dev[leaf_id], k)
             with global_timer.section(
                     "GBDT::UpdateValidScore",
                     sync=lambda: [vd.score for vd in self.valid_sets]):

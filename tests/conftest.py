@@ -40,3 +40,18 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "data", "golden")
+
+
+def load_golden_csv(name):
+    """Parse a golden CSV (label first; empty fields = missing) ->
+    (labels, X). Shared by the consistency and codegen suites."""
+    rows = []
+    with open(os.path.join(GOLDEN_DIR, name)) as fh:
+        for line in fh:
+            rows.append([np.nan if v == "" else float(v)
+                         for v in line.rstrip("\n").split(",")])
+    arr = np.asarray(rows, np.float64)
+    return arr[:, 0], arr[:, 1:]

@@ -15,7 +15,7 @@ from lightgbm_tpu.io.codegen import model_to_cpp_ifelse
 pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
                                 reason="no C++ compiler")
 
-GOLDEN = os.path.join(os.path.dirname(__file__), "data", "golden")
+from conftest import GOLDEN_DIR as GOLDEN, load_golden_csv
 
 _MAIN = r"""
 #include <cstdio>
@@ -42,6 +42,8 @@ int main(int argc, char** argv) {
       else row.push_back(strtod(p, nullptr));
       p = (*e == ',') ? e + 1 : e;
     }
+    // a trailing comma means the LAST field was empty (NaN)
+    if (p > line && p[-1] == ',') row.push_back(NAN);
     if (row.empty()) continue;
     PredictRaw(row.data(), out);
     for (int k = 0; k < kNumClass; ++k)
@@ -50,16 +52,6 @@ int main(int argc, char** argv) {
   return 0;
 }
 """
-
-
-def _load_csv(name):
-    rows = []
-    with open(os.path.join(GOLDEN, name)) as fh:
-        for line in fh:
-            rows.append([np.nan if v == "" else float(v)
-                         for v in line.rstrip("\n").split(",")])
-    arr = np.asarray(rows, np.float64)
-    return arr[:, 0], arr[:, 1:]
 
 
 def _compile_and_run(src, X, tmp_path):
@@ -80,7 +72,7 @@ def _compile_and_run(src, X, tmp_path):
 def test_codegen_matches_reference_golden(tmp_path):
     """Generated C++ for the reference-trained golden model reproduces the
     Python raw scores on the golden test set (incl. categorical + NaN)."""
-    _, X = _load_csv("test.csv")
+    _, X = load_golden_csv("test.csv")
     bst = lgb.Booster(model_file=os.path.join(GOLDEN, "model.txt"))
     src = model_to_cpp_ifelse(bst._engine, bst.config)
     got = _compile_and_run(src, X, tmp_path)[:, 0]

@@ -17,14 +17,7 @@ import lightgbm_tpu as lgb
 GOLDEN = os.path.join(os.path.dirname(__file__), "data", "golden")
 
 
-def _load_csv(name):
-    rows = []
-    with open(os.path.join(GOLDEN, name)) as fh:
-        for line in fh:
-            rows.append([np.nan if v == "" else float(v)
-                         for v in line.rstrip("\n").split(",")])
-    arr = np.asarray(rows, np.float64)
-    return arr[:, 0], arr[:, 1:]
+from conftest import load_golden_csv as _load_csv
 
 
 def test_reference_binary_model_predict_parity():
