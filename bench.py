@@ -126,7 +126,12 @@ def main() -> int:
                 [sys.executable, os.path.abspath(__file__)],
                 env=env, timeout=slot, capture_output=True, text=True,
                 cwd=os.path.dirname(os.path.abspath(__file__)))
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
+            for stream in (e.stderr, e.stdout):
+                if stream:
+                    if isinstance(stream, bytes):
+                        stream = stream.decode("utf-8", "replace")
+                    sys.stderr.write(stream[-2000:])
             last_note = (f"sched={sched} exceeded its {slot:.0f}s slot of "
                          f"the {BENCH_WATCHDOG_SEC}s watchdog "
                          "(device unavailable or compile stalled)")

@@ -220,13 +220,14 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                                     block_rows=cfg.block_rows,
                                     dtype=cfg.hist_dtype,
                                     backend=cfg.hist_rm_backend)
-    # Distributed mode: the per-split histogram pass contains a collective
-    # (psum over the mesh's data axis), which must not sit inside a lax.cond
-    # branch — replaced by masking so every device executes it symmetrically.
+    # Distributed mode: collectives (psum over the mesh's data axis) must
+    # not sit inside divergent control flow. In full mode the per-split
+    # histogram pass is masked instead of branched; in compact mode the
+    # partition/gather/hist inside the cond are LOCAL-only (the reduce is
+    # applied to the cond's result), and the predicate is replicated —
+    # every device computes the identical best split from the reduced
+    # histograms, so the branch is uniform across the mesh.
     distributed = reduce_hist is not None
-    if compact and distributed:
-        raise ValueError("row_sched='compact' does not compose with "
-                         "distributed learner hooks yet; use 'full'")
     quantized = cfg.quantized
     if quantized and distributed:
         raise ValueError("use_quantized_grad does not compose with "
@@ -478,7 +479,8 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             root_g, root_h + 2 * K_EPSILON, hp, root_c, jnp.float32(0.0))
         leaf_id0 = jnp.zeros(R, jnp.int32)
         if compact:
-            hist_root = hist_rm(bins_t, gh)
+            hist_root = reduce_hist(hist_rm(bins_t, gh),
+                                    (root_g, root_h, root_c, root_out))
         else:
             hist_root = reduce_hist(hist_fn(bins_t, gh),
                                     (root_g, root_h, root_c, root_out))
@@ -679,6 +681,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                         rec.feature, rec.threshold, rec.default_left,
                         ncat_a, cbins_a)
 
+                small_ctx = None
                 if pool_none:
                     def do_part_hist2():
                         order2, nL = do_partition()
@@ -694,15 +697,32 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                         lambda: (state.order, jnp.int32(0),
                                  jnp.zeros((Fp, B, 3), hist_dtype),
                                  jnp.zeros((Fp, B, 3), hist_dtype)))
+                    if distributed:
+                        # collectives live OUTSIDE the (uniform) branch
+                        lctx = (rec.left_sum_gradient, rec.left_sum_hessian,
+                                rec.left_count, rec.left_output)
+                        rctx = (rec.right_sum_gradient,
+                                rec.right_sum_hessian,
+                                rec.right_count, rec.right_output)
+                        hist_left_c = reduce_hist(hist_left_c, lctx)
+                        hist_right_c = reduce_hist(hist_right_c, rctx)
                     left_smaller = jnp.asarray(True)  # unused downstream
                     hist_small = None
                 else:
+                    if distributed:
+                        # the smaller side must be agreed mesh-wide: pick
+                        # by the REPLICATED split record's global counts
+                        # (local raw segment sizes differ per shard)
+                        lsm_global = rec.left_count <= rec.right_count
+
                     def do_part_hist():
                         order2, nL = do_partition()
                         nR = rows_l - nL
-                        lsm = nL <= nR   # smaller child by RAW rows
+                        # smaller child by RAW rows (locally) or by the
+                        # replicated global counts (distributed)
+                        lsm = lsm_global if distributed else (nL <= nR)
                         s_start = start_l + jnp.where(lsm, 0, nL)
-                        s_rows = jnp.minimum(nL, nR)
+                        s_rows = jnp.where(lsm, nL, nR)
                         sb = bucket_branch(s_rows)
                         h = lax.switch(sb, hist_branches, order2, s_start,
                                        s_rows, gh)
@@ -713,6 +733,16 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                         lambda: (state.order, jnp.int32(0),
                                  jnp.asarray(True),
                                  jnp.zeros((Fp, B, 3), hist_dtype)))
+                    if distributed:
+                        pick = lambda a, b: jnp.where(left_smaller, a, b)
+                        small_ctx = (pick(rec.left_sum_gradient,
+                                          rec.right_sum_gradient),
+                                     pick(rec.left_sum_hessian,
+                                          rec.right_sum_hessian),
+                                     pick(rec.left_count, rec.right_count),
+                                     pick(rec.left_output,
+                                          rec.right_output))
+                        hist_small = reduce_hist(hist_small, small_ctx)
                 leaf_start = _set(state.leaf_start, new_leaf,
                                   start_l + nL_raw, proceed)
                 leaf_rows = _set(_set(state.leaf_rows, l, nL_raw, proceed),

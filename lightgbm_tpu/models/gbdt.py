@@ -318,10 +318,8 @@ class GBDT:
         if tl in ("data", "voting", "feature"):
             if self._n_dev > 1:
                 self._tree_learner = tl
-                # distributed growers run the full-pass program (the
-                # compact scheduler is serial-only for now); quantized
-                # histograms under the parallel learners land with the
-                # int-hist ReduceScatter equivalent
+                # quantized histograms under the parallel learners land
+                # with the int-hist ReduceScatter equivalent
                 if self.grower_cfg.quantized:
                     log.warning("use_quantized_grad is not supported with "
                                 f"tree_learner={tl} yet; training fp32")
@@ -329,8 +327,17 @@ class GBDT:
                     log.warning("extra_trees is not supported with "
                                 f"tree_learner={tl} yet; full scans")
                 self._grow_rng = None
+                # compact O(rows_in_leaf) scheduling composes with the
+                # row-sharded learners (data/voting); feature-parallel
+                # shards columns and needs the full-pass layout
+                sched = self.grower_cfg.row_sched
+                if tl == "feature" and sched == "compact":
+                    log.warning("tpu_row_scheduling=compact is not "
+                                "supported with tree_learner=feature; "
+                                "using the full-pass scheduler")
+                    sched = "full"
                 self.grower_cfg = dataclasses.replace(
-                    self.grower_cfg, row_sched="full", quantized=False,
+                    self.grower_cfg, row_sched=sched, quantized=False,
                     extra_trees=False)
             else:
                 cap = (f"tpu_num_devices={cfg.tpu_num_devices}"
@@ -338,8 +345,7 @@ class GBDT:
                        else f"only {avail} device(s) visible")
                 log.warning(f"tree_learner={tl} requested but {cap}; "
                             "running serial")
-        self._compact = (self.grower_cfg.row_sched == "compact" and
-                         self._tree_learner == "serial")
+        self._compact = self.grower_cfg.row_sched == "compact"
 
         # ---- EFB bundling (ref: dataset.cpp:112 FindGroups) -----------
         self._bundle = None
@@ -375,9 +381,11 @@ class GBDT:
 
         self.bins_rf = None
         self._bins_packed_dev = None
-        if self._compact and train_bins_host is not None:
+        if (self._compact and self._tree_learner == "serial" and
+                train_bins_host is not None):
             # row-major copy for the gather path; bins_dev keeps the
-            # feature-major layout used by prediction/traversal
+            # feature-major layout used by prediction/traversal (the
+            # distributed learners shard their own row-major copy)
             self.bins_rf = jnp.asarray(
                 np.ascontiguousarray(train_bins_host.T))
         elif self._bundle is not None:
@@ -494,8 +502,14 @@ class GBDT:
             bins = train.bins
             if self._row_pad:
                 bins = np.pad(bins, ((0, 0), (0, self._row_pad)))
-            self.bins_sharded = jax.device_put(
-                bins, NamedSharding(mesh, P(None, DATA_AXIS)))
+            if self._compact:
+                # row-major layout for the gathered O(rows_in_leaf) passes
+                self.bins_sharded = jax.device_put(
+                    np.ascontiguousarray(bins.T),
+                    NamedSharding(mesh, P(DATA_AXIS, None)))
+            else:
+                self.bins_sharded = jax.device_put(
+                    bins, NamedSharding(mesh, P(None, DATA_AXIS)))
             if tl == "data":
                 grow = make_data_parallel_grower(
                     self.grower_cfg, self.feature_meta, mesh, forced=forced)

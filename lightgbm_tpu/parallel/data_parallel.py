@@ -69,14 +69,19 @@ def make_data_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
     def wrapped(bins_t, gh, feature_mask, cegb_const, cegb_count):
         return grow(bins_t, gh, feature_mask, (cegb_const, cegb_count))
 
+    # compact scheduling takes ROW-major [R, F] bins (rows sharded on dim
+    # 0); full mode takes feature-major [F, R] (rows sharded on dim 1)
+    bins_spec = (P(data_axis, None) if cfg.row_sched == "compact"
+                 else P(None, data_axis))
     sharded = _make_sharded(
         wrapped, mesh,
-        in_specs=(P(None, data_axis), P(data_axis, None), P(), P(), P()),
+        in_specs=(bins_spec, P(data_axis, None), P(), P(), P()),
         out_specs=(P(), P(data_axis)))
+
+    F = int(meta.num_bin.shape[0])
 
     def grow_fn(bins_t, gh, feature_mask: Optional[jnp.ndarray] = None,
                 cegb=None):
-        F = bins_t.shape[0]
         if feature_mask is None:
             feature_mask = jnp.ones(F, bool)
         if cegb is None:

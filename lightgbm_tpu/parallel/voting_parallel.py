@@ -90,15 +90,17 @@ def make_voting_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
     def wrapped(bins_t, gh, feature_mask, cegb_const, cegb_count):
         return grow(bins_t, gh, feature_mask, (cegb_const, cegb_count))
 
+    bins_spec = (P(data_axis, None) if cfg.row_sched == "compact"
+                 else P(None, data_axis))
     sharded = _make_sharded(
         wrapped, mesh,
-        in_specs=(P(None, data_axis), P(data_axis, None), P(), P(), P()),
+        in_specs=(bins_spec, P(data_axis, None), P(), P(), P()),
         out_specs=(P(), P(data_axis)))
 
     def grow_fn(bins_t, gh, feature_mask: Optional[jnp.ndarray] = None,
                 cegb=None):
         if feature_mask is None:
-            feature_mask = jnp.ones(bins_t.shape[0], bool)
+            feature_mask = jnp.ones(F, bool)
         if cegb is None:
             cegb = (jnp.zeros(F, jnp.float32), jnp.zeros(F, jnp.float32))
         return sharded(bins_t, gh, feature_mask, cegb[0], cegb[1])

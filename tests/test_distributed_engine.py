@@ -7,6 +7,7 @@ here the "workers" are the 8 virtual CPU devices of the test mesh and
 tree_learner=data/voting/feature routes through the sharded growers under
 the FULL boosting loop (bagging, multiclass, ranking, eval).
 """
+import jax
 import numpy as np
 import pytest
 
@@ -103,3 +104,23 @@ def test_distributed_bagging_goss(rng):
                          "data_sample_strategy": "goss", "top_k": 4})
     acc_g = np.mean((goss.predict(X) > 0.5) == y)
     assert acc_g > 0.8
+
+
+@pytest.mark.parametrize("tl", ["data", "voting"])
+def test_distributed_compact_matches_full(rng, tl):
+    """The O(rows_in_leaf) compact scheduler under the row-sharded
+    learners must reproduce the full-pass scheduler's model exactly."""
+    n = 64 * len(jax.devices()) + 9
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(np.float64)
+    preds = {}
+    for sched in ("compact", "full"):
+        params = {"objective": "binary", "num_leaves": 15,
+                  "min_data_in_leaf": 3, "verbose": -1,
+                  "tree_learner": tl, "top_k": 3,
+                  "tpu_row_scheduling": sched}
+        bst = lgb.train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=4)
+        preds[sched] = bst.predict(X)
+    np.testing.assert_allclose(preds["compact"], preds["full"],
+                               rtol=1e-5, atol=1e-6)
