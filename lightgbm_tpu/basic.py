@@ -986,7 +986,38 @@ class Booster:
         return self
 
     def __copy__(self):
-        return self
+        # ref: Booster.__copy__ delegates to __deepcopy__ — a copy is an
+        # independent serving handle, never an alias
+        return self.__deepcopy__(None)
+
+    # -- pickling (ref: basic.py Booster.__getstate__/__setstate__:
+    # the live engine holds jitted closures and device buffers, so the
+    # pickled form carries the model TEXT; unpickling yields a serving
+    # handle, exactly like the reference) ------------------------------
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        for heavy in ("_engine", "train_set", "valid_sets",
+                      "_train_metrics"):
+            state.pop(heavy, None)
+        state["_model_str"] = (self.model_to_string()
+                               if self._engine is not None else None)
+        return state
+
+    def __setstate__(self, state):
+        model_str = state.pop("_model_str", None)
+        self.__dict__.update(state)
+        self.train_set = None
+        self.valid_sets = []
+        self._engine = None
+        if model_str is not None:
+            self.model_from_string(model_str)
+
+    def __deepcopy__(self, memo):
+        out = type(self).__new__(type(self))
+        if memo is not None:
+            memo[id(self)] = out
+        out.__setstate__(copy.deepcopy(self.__getstate__(), memo or {}))
+        return out
 
     def free_dataset(self) -> "Booster":
         self.train_set = None

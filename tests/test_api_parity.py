@@ -190,3 +190,39 @@ def test_add_features_from_sparse_and_pandas(rng):
     c.add_features_from(d)
     assert list(c.get_data().columns) == \
         ["a0", "a1", "a2", "a3", "a4", "b0", "b1"]
+
+
+def test_booster_pickle_and_deepcopy(rng):
+    import copy
+    import pickle
+    X, y = _ds(rng)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "min_data_in_leaf": 5}, lgb.Dataset(X, label=y),
+                    num_boost_round=3)
+    for other in (pickle.loads(pickle.dumps(bst)), copy.deepcopy(bst)):
+        np.testing.assert_allclose(other.predict(X), bst.predict(X),
+                                   rtol=1e-9, atol=1e-12)
+        assert other.num_trees() == bst.num_trees()
+
+
+def test_sklearn_pickle(rng):
+    import pickle
+    X, y = _ds(rng)
+    reg = lgb.LGBMRegressor(n_estimators=3, min_child_samples=5,
+                            verbose=-1).fit(X, y)
+    r2 = pickle.loads(pickle.dumps(reg))
+    np.testing.assert_allclose(r2.predict(X), reg.predict(X),
+                               rtol=1e-9, atol=1e-12)
+
+
+def test_booster_copy_is_independent(rng):
+    import copy
+    X, y = _ds(rng)
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "min_data_in_leaf": 5}, lgb.Dataset(X, label=y),
+                    num_boost_round=2)
+    c = copy.copy(bst)
+    assert c is not bst
+    v = bst.get_leaf_output(0, 0)
+    c.set_leaf_output(0, 0, v + 1.0)
+    assert bst.get_leaf_output(0, 0) == pytest.approx(v)  # original intact
