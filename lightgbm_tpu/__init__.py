@@ -16,6 +16,7 @@ if _os.environ.get("LIGHTGBM_TPU_PLATFORM"):
                        _os.environ["LIGHTGBM_TPU_PLATFORM"])
 
 from .basic import Booster, Dataset, LightGBMError
+from .io.sequence import Sequence
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        record_evaluation, reset_parameter)
 from .engine import CVBooster, cv, train
@@ -24,7 +25,7 @@ from .utils.log import register_logger
 __version__ = "0.1.0"
 
 __all__ = [
-    "Dataset", "Booster", "LightGBMError",
+    "Dataset", "Booster", "LightGBMError", "Sequence",
     "train", "cv", "CVBooster",
     "early_stopping", "log_evaluation", "record_evaluation",
     "reset_parameter", "EarlyStopException",

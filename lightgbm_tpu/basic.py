@@ -71,6 +71,14 @@ def _hstack_any(a, b):
     return None
 
 
+def _is_sequence_input(data) -> bool:
+    from .io.sequence import Sequence
+    if isinstance(data, Sequence):
+        return True
+    return (isinstance(data, (list, tuple)) and len(data) > 0 and
+            all(isinstance(s, Sequence) for s in data))
+
+
 def _is_scipy_sparse(data) -> bool:
     try:
         import scipy.sparse as sp
@@ -188,6 +196,20 @@ class Dataset:
             if self.group is None:
                 self.group = grp
             data, inferred_names = X, None
+        elif _is_sequence_input(self.data):
+            from .io.sequence import build_from_sequences
+            from .io.stream_loader import _resolve_categoricals
+            seqs = (list(self.data) if isinstance(self.data, (list, tuple))
+                    else [self.data])
+            cfg = Config(self.params)
+            names = ([str(f) for f in self.feature_name]
+                     if isinstance(self.feature_name, list) else None)
+            cats = _resolve_categoricals(self.categorical_feature, cfg,
+                                         names)
+            self._binned = build_from_sequences(
+                seqs, cfg, categorical_features=cats, reference=ref_binned,
+                feature_names=names)
+            return self._finish_prebinned()
         elif _is_scipy_sparse(self.data):
             from .io.dataset_core import SparseColumns
             data, inferred_names = SparseColumns(self.data), None
