@@ -91,9 +91,15 @@ def train(params: Dict[str, Any], train_set: Dataset,
         raise ValueError("num_boost_round must be greater than 0")
     cbs = set(callbacks or [])
     if early_stopping_round is not None and early_stopping_round > 0:
+        verbosity = 1
+        for alias in _ConfigAliases.get("verbosity"):
+            if params.get(alias) is not None:
+                verbosity = int(params[alias])
+        min_delta = params.get("early_stopping_min_delta")
         cbs.add(callback_module.early_stopping(
             early_stopping_round, first_metric_only,
-            verbose=bool(params.get("verbosity", 1) >= 1)))
+            verbose=verbosity >= 1,
+            min_delta=float(min_delta) if min_delta is not None else 0.0))
     callbacks_before = [cb for cb in cbs
                         if getattr(cb, "before_iteration", False)]
     callbacks_after = [cb for cb in cbs
@@ -345,9 +351,11 @@ def cv(params: Dict[str, Any], train_set: Dataset,
 
     cbs = set(callbacks or [])
     if early_stopping_round is not None and early_stopping_round > 0:
+        min_delta = params.get("early_stopping_min_delta")
         cbs.add(callback_module.early_stopping(
             early_stopping_round,
-            bool(params.get("first_metric_only", False)), verbose=False))
+            bool(params.get("first_metric_only", False)), verbose=False,
+            min_delta=float(min_delta) if min_delta is not None else 0.0))
     callbacks_before = sorted(
         [cb for cb in cbs if getattr(cb, "before_iteration", False)],
         key=lambda cb: getattr(cb, "order", 0))

@@ -230,3 +230,19 @@ def test_lambdarank(rng):
     ndcg = record["train"]["ndcg@5"]
     assert ndcg[-1] > ndcg[0]
     assert ndcg[-1] > 0.8
+
+
+def test_early_stopping_min_delta_param(rng):
+    """params-driven early_stopping_min_delta: a large delta stops sooner
+    than delta=0 on slowly-improving validation metrics."""
+    X = rng.normal(size=(600, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.3 * rng.normal(size=600) > 0).astype(np.float32)
+    tr = lgb.Dataset(X[:400], label=y[:400])
+    va = tr.create_valid(X[400:], label=y[400:])
+    common = {"objective": "binary", "verbose": -1, "min_data_in_leaf": 5,
+              "early_stopping_round": 5}
+    b0 = lgb.train(dict(common), tr, num_boost_round=200, valid_sets=[va])
+    b_delta = lgb.train(dict(common, early_stopping_min_delta=0.05),
+                        tr, num_boost_round=200, valid_sets=[va])
+    assert b_delta.best_iteration <= b0.best_iteration
+    assert b_delta.current_iteration() < 200
