@@ -750,6 +750,23 @@ class Booster:
             X = ArrowColumns(data).to_dense_f32().astype(np.float64)
         else:
             X, _ = _to_2d_numpy(data)
+        disable_check = bool(kwargs.get(
+            "predict_disable_shape_check",
+            self.params.get("predict_disable_shape_check", False)))
+        n_feat = self._engine.max_feature_idx + 1
+        if X.shape[1] != n_feat and not disable_check:
+            # ref: config predict_disable_shape_check + Predictor's fatal
+            raise LightGBMError(
+                f"The number of features in data ({X.shape[1]}) is not the "
+                f"same as it was in training data ({n_feat}).\nYou can set "
+                "predict_disable_shape_check=true to discard this error, "
+                "but please be aware what you are doing.")
+        if X.shape[1] < n_feat:
+            # disabled check: the reference's Predictor zero-initializes
+            # its per-row buffer, so absent trailing features read as 0.0
+            # (predictor.hpp) — match that, not the NaN/missing routing
+            X = np.concatenate(
+                [X, np.zeros((X.shape[0], n_feat - X.shape[1]))], axis=1)
         eng = self._engine
         K = eng.num_tree_per_iteration
         n_total_iter = len(eng.models) // max(K, 1)

@@ -392,6 +392,18 @@ _REDIRECTED_PARAMS = {
     "num_gpu": "device count is tpu_num_devices over the jax mesh",
     "num_threads": "host threading is managed by XLA; the parameter has "
                    "no effect on device execution",
+    "force_col_wise": "the histogram layout is fixed by tpu_row_scheduling "
+                      "(compact = row-wise gathers, full = feature-major "
+                      "passes); there is no col/row-wise cost probe",
+    "force_row_wise": "see force_col_wise",
+    "is_enable_sparse": "sparse inputs (scipy) are detected and binned "
+                        "column-wise automatically; EFB handles bundling",
+    "pre_partition": "row sharding over the mesh is automatic "
+                     "(tree_learner=data/voting)",
+    "precise_float_parser": "the native parser always uses full-precision "
+                            "strtod",
+    "parser_config_file": "parser plugins are not supported; CSV/TSV/"
+                          "LibSVM are auto-detected",
 }
 
 
@@ -504,6 +516,12 @@ class Config:
             log.warning(f"device_type={dev} is not available; this "
                         "framework runs on TPU (or CPU) through jax — "
                         "set LIGHTGBM_TPU_PLATFORM to pin a backend")
+        if self._values.get("deterministic"):
+            log.info("deterministic=true: XLA programs are already "
+                     "deterministic run-to-run on a fixed device count; "
+                     "for bit-identical splits independent of reduction "
+                     "order (multi-chip), use use_quantized_grad=true "
+                     "(exact int32 histogram accumulation)")
 
     # -- internals -------------------------------------------------------
     def _post_process(self) -> None:

@@ -124,3 +124,20 @@ def test_extra_trees_categorical_randomized(rng):
     assert not np.allclose(p_et1, p_full)
     assert not np.allclose(p_et1, p_et2)
     assert np.isfinite(p_et1).all()
+
+
+def test_predict_shape_check(rng):
+    X, y = _data(rng, n=300, f=6)
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "min_data_in_leaf": 5}, lgb.Dataset(X, label=y),
+                    num_boost_round=3)
+    import pytest as _pytest
+    with _pytest.raises(lgb.LightGBMError, match="number of features"):
+        bst.predict(X[:, :4])
+    # disabled: short rows pad with NaN (missing routing)
+    out = bst.predict(X[:, :4], predict_disable_shape_check=True)
+    assert np.isfinite(out).all()
+    # extra columns are allowed when disabled
+    wide = np.hstack([X, X[:, :1]])
+    out2 = bst.predict(wide, predict_disable_shape_check=True)
+    np.testing.assert_allclose(out2, bst.predict(X), rtol=1e-9)
