@@ -187,7 +187,7 @@ class Dataset:
                     categorical_feature=self.categorical_feature,
                     reference=ref_binned)
                 return self._finish_prebinned()
-            from .io.file_loader import load_svm_or_csv
+            from .io.file_loader import load_position_file, load_svm_or_csv
             X, y, w, grp = load_svm_or_csv(str(self.data), cfg)
             if self.label is None:
                 self.label = y
@@ -195,6 +195,8 @@ class Dataset:
                 self.weight = w
             if self.group is None:
                 self.group = grp
+            if self.position is None:
+                self.position = load_position_file(str(self.data))
             data, inferred_names = X, None
         elif _is_sequence_input(self.data):
             from .io.sequence import build_from_sequences
@@ -742,7 +744,24 @@ class Booster:
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, validate_features: bool = False,
                 **kwargs) -> np.ndarray:
-        """ref: basic.py:4625 Booster.predict -> Predictor (predictor.hpp)."""
+        """ref: basic.py:4625 Booster.predict -> Predictor (predictor.hpp).
+        ``data`` may also be a text file path (CSV/TSV/LibSVM), like the
+        reference; ``data_has_header=True`` in kwargs skips its header."""
+        if isinstance(data, (str, Path)):
+            from .io.file_loader import load_svm_or_csv
+            # parse prediction files with the SAME column schema as
+            # training (weight/group/ignore columns and aliases included)
+            pcfg = dict(self.params)
+            pcfg["header"] = bool(kwargs.get("data_has_header", False))
+            data, _, _, _ = load_svm_or_csv(str(data), Config(pcfg))
+            n_feat_model = self._engine.max_feature_idx + 1
+            if data.shape[1] < n_feat_model:
+                # LibSVM files legitimately omit trailing all-zero
+                # features; size to the model like the reference parser
+                data = np.concatenate(
+                    [data, np.zeros((data.shape[0],
+                                     n_feat_model - data.shape[1]))],
+                    axis=1)
         if _is_scipy_sparse(data):
             X = np.asarray(data.todense(), dtype=np.float64)
         elif _is_arrow_table(data):

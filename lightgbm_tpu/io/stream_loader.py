@@ -25,7 +25,8 @@ from ..native import (iter_file_chunks, parse_dense_chunk,
                       parse_libsvm_chunk)
 from ..utils import log
 from .dataset_core import BinnedDataset, DenseColumns, Metadata
-from .file_loader import _detect_format, _parse_column_spec, load_side_files
+from .file_loader import (_detect_format, _parse_column_spec,
+                          load_position_file, load_side_files)
 
 
 def _read_head(path: str, n_lines: int = 20) -> List[str]:
@@ -293,8 +294,8 @@ def load_binned_two_round(path: str, config: Config,
         meta.set_weight(weight)
     if group is not None:
         meta.set_query(group)
-    if os.path.exists(path + ".position"):
-        meta.set_position(np.loadtxt(path + ".position",
-                                     dtype=np.int64).reshape(-1))
+    pos = load_position_file(path)
+    if pos is not None:
+        meta.set_position(pos)
     ds.metadata = meta
     return ds

@@ -226,3 +226,29 @@ def test_booster_copy_is_independent(rng):
     v = bst.get_leaf_output(0, 0)
     c.set_leaf_output(0, 0, v + 1.0)
     assert bst.get_leaf_output(0, 0) == pytest.approx(v)  # original intact
+
+
+def test_predict_from_file(rng, tmp_path):
+    X, y = _ds(rng)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "min_data_in_leaf": 5}, lgb.Dataset(X, label=y),
+                    num_boost_round=3)
+    path = str(tmp_path / "pred.csv")
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.8g")
+    np.testing.assert_allclose(bst.predict(path), bst.predict(X),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_position_side_file(rng, tmp_path):
+    sizes = rng.integers(5, 12, size=15)
+    n = int(sizes.sum())
+    X = rng.normal(size=(n, 4))
+    y = rng.integers(0, 3, size=n).astype(np.float64)
+    pos = np.concatenate([np.arange(s) for s in sizes])
+    path = str(tmp_path / "rank.csv")
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.6g")
+    np.savetxt(path + ".query", sizes, fmt="%d")
+    np.savetxt(path + ".position", pos, fmt="%d")
+    ds = lgb.Dataset(path, params={"objective": "lambdarank",
+                                   "verbose": -1}).construct()
+    np.testing.assert_array_equal(ds.binned.metadata.position, pos)

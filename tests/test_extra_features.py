@@ -134,7 +134,8 @@ def test_predict_shape_check(rng):
     import pytest as _pytest
     with _pytest.raises(lgb.LightGBMError, match="number of features"):
         bst.predict(X[:, :4])
-    # disabled: short rows pad with NaN (missing routing)
+    # disabled: absent trailing features read as 0.0 (reference
+    # Predictor's zero-initialized buffer)
     out = bst.predict(X[:, :4], predict_disable_shape_check=True)
     assert np.isfinite(out).all()
     # extra columns are allowed when disabled
