@@ -1,0 +1,51 @@
+"""Cross-feature interaction smoke matrix: combinations of quantized
+gradients, extra_trees, EFB, DART/RF, GOSS, constraints, poolless
+histograms and distributed learners must train, predict finitely, and
+round-trip through the model text format."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+COMBOS = [
+    dict(use_quantized_grad=True, extra_trees=True),
+    dict(use_quantized_grad=True, enable_bundle=True, boosting="dart"),
+    dict(extra_trees=True, boosting="rf", bagging_freq=1,
+         bagging_fraction=0.7),
+    dict(use_quantized_grad=True,
+         monotone_constraints=[1, 0, 0, 0, 0, 0, 0, 0, 0, 0]),
+    dict(extra_trees=True, feature_fraction=0.7,
+         feature_fraction_bynode=0.8),
+    dict(use_quantized_grad=True, data_sample_strategy="goss"),
+    dict(use_quantized_grad=True, max_depth=4,
+         interaction_constraints="[0,1,2],[3,4,5,6,7,8,9]"),
+    dict(extra_trees=True, tree_learner="data", tpu_num_devices=-1),
+    dict(use_quantized_grad=True, histogram_pool_size=0.0001),  # poolless
+]
+
+
+@pytest.fixture(scope="module")
+def combo_data():
+    rng = np.random.default_rng(5)
+    n = 700
+    X = rng.normal(size=(n, 10)).astype(np.float32)
+    X[:, 3] = rng.integers(0, 7, size=n)            # categorical
+    X[rng.uniform(size=n) < 0.08, 0] = np.nan       # missing
+    y = ((X[:, 3] % 2 == 0) |
+         (np.nan_to_num(X[:, 0]) > 1)).astype(np.float32)
+    w = rng.uniform(0.5, 1.5, size=n).astype(np.float32)
+    return X, y, w
+
+
+@pytest.mark.parametrize("extra", COMBOS,
+                         ids=lambda c: "+".join(sorted(c))[:50])
+def test_feature_combo(combo_data, extra):
+    X, y, w = combo_data
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 5, "seed": 1, **extra}
+    ds = lgb.Dataset(X, label=y, weight=w, categorical_feature=[3])
+    bst = lgb.train(params, ds, num_boost_round=4)
+    p = bst.predict(X)
+    assert np.isfinite(p).all()
+    p2 = lgb.Booster(model_str=bst.model_to_string()).predict(X)
+    np.testing.assert_allclose(p, p2, rtol=1e-6, atol=1e-7)
