@@ -31,6 +31,11 @@ def combo_data():
     X = rng.normal(size=(n, 10)).astype(np.float32)
     X[:, 3] = rng.integers(0, 7, size=n)            # categorical
     X[rng.uniform(size=n) < 0.08, 0] = np.nan       # missing
+    # columns 6-9: mutually-exclusive one-hots so enable_bundle combos
+    # actually trigger EFB (dense columns never bundle)
+    onehot = rng.integers(0, 4, size=n)
+    X[:, 6:10] = 0.0
+    X[np.arange(n), 6 + onehot] = 1.0
     y = ((X[:, 3] % 2 == 0) |
          (np.nan_to_num(X[:, 0]) > 1)).astype(np.float32)
     w = rng.uniform(0.5, 1.5, size=n).astype(np.float32)
