@@ -26,7 +26,13 @@ import numpy as np
 
 # Watchdog: if the device/tunnel wedges (or compile stalls pathologically),
 # emit an honest zero-result line instead of hanging the driver forever.
-BENCH_WATCHDOG_SEC = int(os.environ.get("BENCH_WATCHDOG_SEC", 3000))
+# Sized UNDER the driver's kill budget (round-2 postmortem: a 3000 s default
+# outlived the driver and turned a wedged tunnel into a silent rc=124).
+BENCH_WATCHDOG_SEC = int(os.environ.get("BENCH_WATCHDOG_SEC", 1800))
+# Pre-flight device probe: a tiny jit must complete before we attempt the
+# full-size program. Generous (tunnel claims can take minutes when the relay
+# is recovering) but bounded well under the watchdog.
+BENCH_PROBE_SEC = int(os.environ.get("BENCH_PROBE_SEC", 420))
 
 N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
 N_FEATURES = 28
@@ -63,6 +69,7 @@ def synth_higgs(n, f, seed=0):
 
 def run_child(sched: str) -> None:
     """Measure one scheduling mode and print the JSON result line."""
+    _apply_platform_override()
     from lightgbm_tpu.utils.jit_cache import enable_persistent_cache
     enable_persistent_cache()
     import lightgbm_tpu as lgb
@@ -105,33 +112,109 @@ def run_child(sched: str) -> None:
     }), flush=True)
 
 
+def _apply_platform_override() -> None:
+    """Honor BENCH_PLATFORM=cpu for hardware-free testing.
+
+    The image's sitecustomize force-sets JAX_PLATFORMS=axon before user code
+    runs, so an env var alone cannot opt out; the in-process config update is
+    the reliable switch (same trick as tests/conftest.py).
+    """
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
+
+def run_probe() -> None:
+    """Tiny end-to-end sanity: device claim + a small jitted train step."""
+    _apply_platform_override()
+    from lightgbm_tpu.utils.jit_cache import enable_persistent_cache
+    enable_persistent_cache()
+    import jax
+    devs = jax.devices()
+    import lightgbm_tpu as lgb
+    X, y = synth_higgs(4096, N_FEATURES)
+    ds = lgb.Dataset(X, label=y)
+    booster = lgb.Booster({"objective": "binary", "num_leaves": 7,
+                           "max_bin": 63, "verbose": -1}, ds)
+    booster.update()
+    jax.block_until_ready(booster._engine.score)
+    print(json.dumps({"probe_ok": True, "devices": [str(d) for d in devs]}),
+          flush=True)
+
+
+def _spawn(env_extra: dict, timeout: float) -> subprocess.CompletedProcess:
+    """Run this script as a child with extra env, shared argv/capture/cwd."""
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=dict(os.environ, **env_extra),
+        timeout=timeout, capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+
+
+def _dump_timeout_streams(e: subprocess.TimeoutExpired) -> None:
+    for stream in (e.stderr, e.stdout):
+        if stream:
+            if isinstance(stream, bytes):
+                stream = stream.decode("utf-8", "replace")
+            sys.stderr.write(stream[-2000:])
+
+
 def main() -> int:
+    if os.environ.get("_LGBM_BENCH_PROBE"):
+        run_probe()
+        return 0
     if os.environ.get("_LGBM_BENCH_CHILD"):
         run_child(os.environ["_LGBM_BENCH_CHILD"])
         return 0
 
     deadline = time.time() + BENCH_WATCHDOG_SEC
+
+    # Stage 0: fail fast (and loudly) if the device is unreachable. A wedged
+    # tunnel must produce the honest zero line, never an rc=124.
+    #
+    # Tradeoff (documented tunnel behavior: recovery claims can take tens of
+    # minutes): a probe killed at the deadline may be a false "unreachable" on
+    # a recovering tunnel. Accepted, because (a) a device that cannot claim
+    # within BENCH_PROBE_SEC cannot claim+compile+run within the driver's
+    # budget either, and (b) killing a claim-WAITER is the benign case — the
+    # machine-wide wedge came from killing a client holding the grant
+    # mid-compile, which is exactly what probing first avoids.
+    probe_slot = min(BENCH_PROBE_SEC, BENCH_WATCHDOG_SEC * 0.4)
+    try:
+        probe = _spawn({"_LGBM_BENCH_PROBE": "1"}, probe_slot)
+    except subprocess.TimeoutExpired as e:
+        _dump_timeout_streams(e)
+        print(_fail_line(
+            f"device probe (tiny jit) did not complete in {probe_slot:.0f}s "
+            "— backend/tunnel unreachable"), flush=True)
+        return 3
+    if '"probe_ok"' not in probe.stdout:
+        sys.stderr.write(probe.stderr[-2000:])
+        print(_fail_line(
+            f"device probe failed rc={probe.returncode}: "
+            f"{probe.stderr[-300:]!r}"), flush=True)
+        return 3
+    sys.stderr.write(f"[bench] probe ok: {probe.stdout.strip()[:200]}\n")
+
     last_note = "no scheduling mode completed"
     for i, sched in enumerate(SCHED_MODES):
         budget = deadline - time.time()
         if budget <= 5:
             last_note = f"watchdog exhausted before trying sched={sched}"
             break
-        # split the remaining budget over the remaining modes so a wedged
-        # first mode cannot starve its fallbacks
-        slot = max(budget / (len(SCHED_MODES) - i), 5.0)
-        env = dict(os.environ, _LGBM_BENCH_CHILD=sched.strip())
+        # Weight the preferred (first) mode: give it up to 70% of the
+        # remaining budget so a cold-cache compile isn't killed mid-flight,
+        # while still reserving a slot for the fallback mode.
+        remaining_modes = len(SCHED_MODES) - i
+        if remaining_modes > 1:
+            slot = max(budget * 0.7, 5.0)
+        else:
+            slot = max(budget - 5.0, 5.0)
         try:
-            out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env, timeout=slot, capture_output=True, text=True,
-                cwd=os.path.dirname(os.path.abspath(__file__)))
+            out = _spawn({"_LGBM_BENCH_CHILD": sched.strip()}, slot)
         except subprocess.TimeoutExpired as e:
-            for stream in (e.stderr, e.stdout):
-                if stream:
-                    if isinstance(stream, bytes):
-                        stream = stream.decode("utf-8", "replace")
-                    sys.stderr.write(stream[-2000:])
+            _dump_timeout_streams(e)
             last_note = (f"sched={sched} exceeded its {slot:.0f}s slot of "
                          f"the {BENCH_WATCHDOG_SEC}s watchdog "
                          "(device unavailable or compile stalled)")
