@@ -172,7 +172,9 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                      select_best: Optional[Callable] = None,
                      fetch_bin_column: Optional[Callable] = None,
                      partition_meta: Optional[FeatureMeta] = None,
-                     bundle=None):
+                     bundle=None,
+                     reduce_max: Optional[Callable] = None,
+                     localize_key: Optional[Callable] = None):
     """Build the tree-growing function for a fixed dataset geometry.
 
     Returns ``grow(bins_t, gh, feature_mask, cegb) -> (TreeArrays, leaf_id)``
@@ -229,9 +231,12 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     # histograms, so the branch is uniform across the mesh.
     distributed = reduce_hist is not None
     quantized = cfg.quantized
-    if quantized and distributed:
-        raise ValueError("use_quantized_grad does not compose with "
-                         "distributed learner hooks yet")
+    # Quantized + distributed (≡ the reference's int-histogram
+    # ReduceScatter variants, data_parallel_tree_learner.cpp:285-299):
+    # the discretization scales are made GLOBAL via reduce_max (pmax over
+    # the data axis), so every device quantizes with identical scales and
+    # the int32 histogram psum accumulates exactly — the deterministic
+    # bit-identical-splits path survives sharding.
     hist_dtype = jnp.int32 if quantized else jnp.float32
     has_cat = meta_has_categorical(meta)
     MAXK = min(hp.max_cat_threshold, B) if has_cat else 0
@@ -282,6 +287,10 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         reduce_hist = lambda h, ctx=None: h
     if reduce_sums is None:
         reduce_sums = lambda s: s
+    if reduce_max is None:
+        reduce_max = lambda x: x
+    if localize_key is None:
+        localize_key = lambda k: k
     if prepare_split_hist is None:
         prepare_split_hist = lambda h, ctx=None, fm=None: (h, None)
     if select_best is None:
@@ -303,10 +312,10 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         mask = (leaf_id == target_leaf).astype(gh.dtype)
         return reduce_hist(hist_fn(bins_t, gh * mask[:, None]), ctx)
 
+    # extra_trees composes with the row-sharded learners: the random
+    # thresholds derive from the REPLICATED per-tree key, so every device
+    # draws identical uniforms and selects the identical split.
     use_rand = cfg.extra_trees
-    if use_rand and distributed:
-        raise ValueError("extra_trees does not compose with distributed "
-                         "learner hooks yet")
 
     def rand_uniforms(key):
         """One uniform draw per feature — the split scan derives the
@@ -353,11 +362,17 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             # int32 and are converted back via the scales at scan time.
             g, h, m = gh[:, 0], gh[:, 1], gh[:, 2]
             kq = max(cfg.quant_bins // 2, 1)
-            g_scale = jnp.maximum(jnp.max(jnp.abs(g)), 1e-30) / kq
-            h_scale = jnp.maximum(jnp.max(h), 1e-30) / cfg.quant_bins
+            # reduce_max makes the scales global under row sharding so the
+            # downstream int32 psum is exact (identity when serial)
+            g_scale = jnp.maximum(reduce_max(jnp.max(jnp.abs(g))),
+                                  1e-30) / kq
+            h_scale = jnp.maximum(reduce_max(jnp.max(h)),
+                                  1e-30) / cfg.quant_bins
             if cfg.stochastic_rounding:
-                kg, kh = jax.random.split(
-                    rng_key if rng_key is not None else jax.random.PRNGKey(0))
+                # localize_key decorrelates the rounding noise across row
+                # shards (each row is rounded once, on its owning device)
+                kg, kh = jax.random.split(localize_key(
+                    rng_key if rng_key is not None else jax.random.PRNGKey(0)))
                 ug = jax.random.uniform(kg, g.shape, jnp.float32)
                 uh = jax.random.uniform(kh, h.shape, jnp.float32)
             else:

@@ -318,15 +318,12 @@ class GBDT:
         if tl in ("data", "voting", "feature"):
             if self._n_dev > 1:
                 self._tree_learner = tl
-                # quantized histograms under the parallel learners land
-                # with the int-hist ReduceScatter equivalent
-                if self.grower_cfg.quantized:
-                    log.warning("use_quantized_grad is not supported with "
-                                f"tree_learner={tl} yet; training fp32")
-                if self.grower_cfg.extra_trees:
-                    log.warning("extra_trees is not supported with "
-                                f"tree_learner={tl} yet; full scans")
-                self._grow_rng = None
+                # quantized int8 gradients compose with all three learners
+                # (global scales via pmax + exact int32 hist psum ≡ the
+                # reference's int-histogram ReduceScatter variants,
+                # data_parallel_tree_learner.cpp:285-299), as does
+                # extra_trees (replicated per-tree key → identical random
+                # thresholds on every device)
                 # compact O(rows_in_leaf) scheduling composes with the
                 # row-sharded learners (data/voting); feature-parallel
                 # shards columns and needs the full-pass layout
@@ -337,8 +334,7 @@ class GBDT:
                                 "using the full-pass scheduler")
                     sched = "full"
                 self.grower_cfg = dataclasses.replace(
-                    self.grower_cfg, row_sched=sched, quantized=False,
-                    extra_trees=False)
+                    self.grower_cfg, row_sched=sched)
             else:
                 cap = (f"tpu_num_devices={cfg.tpu_num_devices}"
                        if 0 < cfg.tpu_num_devices < avail
@@ -545,7 +541,7 @@ class GBDT:
                 cegb = (jnp.pad(cegb[0], (0, self._feat_pad)),
                         jnp.pad(cegb[1], (0, self._feat_pad)))
             tree, leaf_id = self._grow_dist(self.bins_sharded, gh, fmask,
-                                            cegb)
+                                            cegb, rng_key)
             if self._row_pad:
                 leaf_id = leaf_id[:N]
             return tree, leaf_id

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
@@ -64,10 +65,16 @@ def make_data_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
         cfg, meta,
         reduce_hist=lambda h, ctx=None: lax.psum(h, data_axis),
         reduce_sums=lambda s: lax.psum(s, data_axis),
+        # global quantization scales + per-shard rounding noise (see
+        # grower.py quantized block)
+        reduce_max=lambda x: lax.pmax(x, data_axis),
+        localize_key=lambda k: jax.random.fold_in(
+            k, lax.axis_index(data_axis)),
         forced=forced)
 
-    def wrapped(bins_t, gh, feature_mask, cegb_const, cegb_count):
-        return grow(bins_t, gh, feature_mask, (cegb_const, cegb_count))
+    def wrapped(bins_t, gh, feature_mask, cegb_const, cegb_count, rng_key):
+        return grow(bins_t, gh, feature_mask, (cegb_const, cegb_count),
+                    rng_key)
 
     # compact scheduling takes ROW-major [R, F] bins (rows sharded on dim
     # 0); full mode takes feature-major [F, R] (rows sharded on dim 1)
@@ -75,18 +82,20 @@ def make_data_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
                  else P(None, data_axis))
     sharded = _make_sharded(
         wrapped, mesh,
-        in_specs=(bins_spec, P(data_axis, None), P(), P(), P()),
+        in_specs=(bins_spec, P(data_axis, None), P(), P(), P(), P()),
         out_specs=(P(), P(data_axis)))
 
     F = int(meta.num_bin.shape[0])
 
     def grow_fn(bins_t, gh, feature_mask: Optional[jnp.ndarray] = None,
-                cegb=None):
+                cegb=None, rng_key=None):
         if feature_mask is None:
             feature_mask = jnp.ones(F, bool)
         if cegb is None:
             cegb = (jnp.zeros(F, jnp.float32), jnp.zeros(F, jnp.float32))
-        return sharded(bins_t, gh, feature_mask, cegb[0], cegb[1])
+        if rng_key is None:
+            rng_key = jax.random.PRNGKey(0)
+        return sharded(bins_t, gh, feature_mask, cegb[0], cegb[1], rng_key)
 
     return grow_fn
 

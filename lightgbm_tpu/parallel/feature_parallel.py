@@ -117,9 +117,14 @@ def make_feature_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
             fetch_bin_column=fetch_bin_column,
             partition_meta=meta)
 
-    def sharded_grow(bins_t, gh, feature_mask, cegb_const, cegb_count):
+    def sharded_grow(bins_t, gh, feature_mask, cegb_const, cegb_count,
+                     rng_key):
+        # quantization scales need no reduce here: rows are REPLICATED, so
+        # every device computes identical scales (and, with the replicated
+        # key, identical quantized gh) from the full gradient vector
         grow = make_local_grow()
-        return grow(bins_t, gh, feature_mask, (cegb_const, cegb_count))
+        return grow(bins_t, gh, feature_mask, (cegb_const, cegb_count),
+                    rng_key)
 
     # feature_mask / cegb are per-feature → sharded over the feature axis
     # alongside the bins (each device masks/penalizes its own slice);
@@ -128,11 +133,11 @@ def make_feature_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
     sharded = _make_sharded(
         sharded_grow, mesh,
         in_specs=(P(feature_axis, None), P(None, None), fm_spec,
-                  P(feature_axis), P(feature_axis)),
+                  P(feature_axis), P(feature_axis), P()),
         out_specs=(P(), P()))
 
     def grow_fn(bins_t, gh, feature_mask: Optional[jnp.ndarray] = None,
-                cegb=None):
+                cegb=None, rng_key=None):
         if feature_mask is None:
             shape = (2 * cfg.num_leaves, F_total) if cfg.bynode_mask \
                 else (F_total,)
@@ -140,6 +145,8 @@ def make_feature_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
         if cegb is None:
             cegb = (jnp.zeros(F_total, jnp.float32),
                     jnp.zeros(F_total, jnp.float32))
-        return sharded(bins_t, gh, feature_mask, cegb[0], cegb[1])
+        if rng_key is None:
+            rng_key = jax.random.PRNGKey(0)
+        return sharded(bins_t, gh, feature_mask, cegb[0], cegb[1], rng_key)
 
     return grow_fn

@@ -85,24 +85,30 @@ def make_voting_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
         cfg, meta,
         reduce_hist=lambda h, ctx=None: h,      # pool stays LOCAL
         reduce_sums=lambda s: lax.psum(s, data_axis),
+        reduce_max=lambda x: lax.pmax(x, data_axis),
+        localize_key=lambda k: jax.random.fold_in(
+            k, lax.axis_index(data_axis)),
         prepare_split_hist=prepare)
 
-    def wrapped(bins_t, gh, feature_mask, cegb_const, cegb_count):
-        return grow(bins_t, gh, feature_mask, (cegb_const, cegb_count))
+    def wrapped(bins_t, gh, feature_mask, cegb_const, cegb_count, rng_key):
+        return grow(bins_t, gh, feature_mask, (cegb_const, cegb_count),
+                    rng_key)
 
     bins_spec = (P(data_axis, None) if cfg.row_sched == "compact"
                  else P(None, data_axis))
     sharded = _make_sharded(
         wrapped, mesh,
-        in_specs=(bins_spec, P(data_axis, None), P(), P(), P()),
+        in_specs=(bins_spec, P(data_axis, None), P(), P(), P(), P()),
         out_specs=(P(), P(data_axis)))
 
     def grow_fn(bins_t, gh, feature_mask: Optional[jnp.ndarray] = None,
-                cegb=None):
+                cegb=None, rng_key=None):
         if feature_mask is None:
             feature_mask = jnp.ones(F, bool)
         if cegb is None:
             cegb = (jnp.zeros(F, jnp.float32), jnp.zeros(F, jnp.float32))
-        return sharded(bins_t, gh, feature_mask, cegb[0], cegb[1])
+        if rng_key is None:
+            rng_key = jax.random.PRNGKey(0)
+        return sharded(bins_t, gh, feature_mask, cegb[0], cegb[1], rng_key)
 
     return grow_fn

@@ -124,3 +124,52 @@ def test_distributed_compact_matches_full(rng, tl):
         preds[sched] = bst.predict(X)
     np.testing.assert_allclose(preds["compact"], preds["full"],
                                rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("tl", ["data", "voting", "feature"])
+def test_distributed_quantized(rng, tl):
+    """Quantized int8 gradients under the distributed learners: global
+    scales (pmax) + exact int32 histogram psum ≡ the reference's
+    int-histogram ReduceScatter (data_parallel_tree_learner.cpp:285-299).
+    With deterministic rounding, data-parallel must reproduce SERIAL
+    quantized training exactly (the int32 sums are order-independent)."""
+    X, y = _binary_data(rng, n=2407)
+    q = {"use_quantized_grad": True, "stochastic_rounding": False,
+         "num_grad_quant_bins": 16}
+    serial = _train(X, y, {"objective": "binary"}, extra=q)
+    dist = _train(X, y, {"objective": "binary", "tree_learner": tl,
+                         "top_k": 4}, extra=q)
+    ps = serial.predict(X)
+    pd_ = dist.predict(X)
+    acc_s = np.mean((ps > 0.5) == y)
+    acc_d = np.mean((pd_ > 0.5) == y)
+    assert acc_d > acc_s - 0.03, (acc_s, acc_d)
+    if tl in ("data", "feature"):
+        # exact int32 accumulation -> identical splits, identical model
+        np.testing.assert_allclose(ps, pd_, rtol=1e-6, atol=1e-7)
+
+
+def test_distributed_quantized_stochastic(rng):
+    """Stochastic rounding under sharding trains fine (noise is local to
+    each row's owning device; scales stay global)."""
+    X, y = _binary_data(rng, n=2051)
+    bst = _train(X, y, {"objective": "binary", "tree_learner": "data",
+                        "use_quantized_grad": True,
+                        "stochastic_rounding": True})
+    acc = np.mean((bst.predict(X) > 0.5) == y)
+    assert acc > 0.8
+
+
+def test_distributed_extra_trees(rng):
+    """extra_trees composes with the row-sharded learners: the random
+    thresholds come from the replicated per-tree key, so the sharded run
+    must match a serial run with the same seed exactly."""
+    X, y = _binary_data(rng, n=2407)
+    e = {"extra_trees": True, "extra_seed": 13}
+    serial = _train(X, y, {"objective": "binary"}, extra=e)
+    dist = _train(X, y, {"objective": "binary", "tree_learner": "data"},
+                  extra=e)
+    np.testing.assert_allclose(serial.predict(X), dist.predict(X),
+                               atol=5e-2)
+    acc = np.mean((dist.predict(X) > 0.5) == y)
+    assert acc > 0.8
