@@ -1,0 +1,62 @@
+"""Worker for the multi-process distributed test (run via subprocess).
+
+The process-level analogue of the reference's DistributedMockup worker
+(ref: tests/distributed/_test_distributed.py:1 — N CLI processes on
+localhost exercising the real socket stack): here each process joins a
+`jax.distributed.initialize` world over localhost and trains
+`tree_learner=data` on the GLOBAL mesh spanning both processes' CPU
+devices, proving the collectives path end-to-end without TPU hardware.
+
+Usage: python mp_worker.py <coordinator> <num_procs> <rank> <out.npy>
+"""
+import os
+import sys
+
+# 2 virtual CPU devices per process -> a 4-device global mesh across 2 procs
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # opt out of the axon plugin
+
+import numpy as np  # noqa: E402
+
+
+def synth(n=2001, f=8, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] * 2 - X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+         + rng.normal(scale=0.5, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+def main():
+    coord, nproc, rank, out = (sys.argv[1], int(sys.argv[2]),
+                               int(sys.argv[3]), sys.argv[4])
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from lightgbm_tpu.distributed import init_distributed
+
+    init_distributed(coordinator_address=coord, num_processes=nproc,
+                     process_id=rank)
+    assert jax.process_count() == nproc
+    assert len(jax.devices()) == 2 * nproc
+
+    import lightgbm_tpu as lgb
+
+    X, y = synth()
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbose": -1, "seed": 7,
+              "deterministic": True, "tree_learner": "data"}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10)
+    pred = bst.predict(X)
+    if rank == 0:
+        np.save(out, pred)
+    print(f"rank {rank} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()
