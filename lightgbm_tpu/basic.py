@@ -763,7 +763,26 @@ class Booster:
                                      n_feat_model - data.shape[1]))],
                     axis=1)
         if _is_scipy_sparse(data):
-            X = np.asarray(data.todense(), dtype=np.float64)
+            # Row-blocked sparse prediction (≡ PredictForCSR's row-wise
+            # iteration, c_api.cpp — never densify the full matrix): each
+            # block densifies at most ~256 MB and reuses the dense path,
+            # so wide-sparse inputs don't hit a memory cliff.
+            csr = data.tocsr()
+            n_rows = csr.shape[0]
+            block = int(kwargs.get(
+                "predict_sparse_block_rows",
+                max(1024, (1 << 25) // max(csr.shape[1], 1))))
+            if n_rows > block:
+                outs = [
+                    self.predict(
+                        csr[i:i + block], start_iteration=start_iteration,
+                        num_iteration=num_iteration, raw_score=raw_score,
+                        pred_leaf=pred_leaf, pred_contrib=pred_contrib,
+                        validate_features=validate_features, **kwargs)
+                    for i in range(0, n_rows, block)
+                ]
+                return np.concatenate(outs, axis=0)
+            X = csr.toarray().astype(np.float64)
         elif _is_arrow_table(data):
             from .io.dataset_core import ArrowColumns
             X = ArrowColumns(data).to_dense_f32().astype(np.float64)
