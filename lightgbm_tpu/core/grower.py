@@ -83,6 +83,17 @@ class GrowerConfig:
     # feature_histogram.hpp USE_RAND): one random numerical threshold per
     # (node, feature) instead of the full scan
     extra_trees: bool = False
+    # monotone constraint method (ref: config monotone_constraints_method;
+    # monotone_constraints.hpp BasicLeafConstraints:466 /
+    # IntermediateLeafConstraints:517). "basic" bounds children by the
+    # split mid-point; "intermediate" bounds them by the sibling outputs
+    # AND tightens other contiguous leaves. The reference's recursive
+    # GoUp/GoDownToFindLeavesToUpdate tree walk is re-derived here as
+    # vectorized feature-space geometry: each leaf carries its bin
+    # hyper-rectangle [L, F, 2]; "contiguous" = overlapping in every
+    # non-split feature; affected leaves are found with one [L] mask and
+    # re-scanned under a lax.cond only when a bound actually tightened.
+    mc_method: str = "basic"
     # feature_mask is [L, F] with one row per node (feature_fraction_bynode,
     # ref: col_sampler.hpp) instead of a single [F] row for the whole tree
     bynode_mask: bool = False
@@ -121,6 +132,11 @@ class GrowState(NamedTuple):
     leaf_start: jnp.ndarray = None  # i32 [L] segment start per leaf
     leaf_rows: jnp.ndarray = None   # i32 [L] RAW rows per leaf (incl.
                                     # bagged-out rows riding along)
+    # intermediate monotone mode: per-leaf bin hyper-rectangle + the
+    # feature_mask node row that leaf's best split was scanned with
+    leaf_flo: jnp.ndarray = None    # i32 [L, F] inclusive low bin
+    leaf_fhi: jnp.ndarray = None    # i32 [L, F] inclusive high bin
+    leaf_node_row: jnp.ndarray = None  # i32 [L]
 
 
 def _set(arr, idx, val, cond):
@@ -230,6 +246,8 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     # every device computes the identical best split from the reduced
     # histograms, so the branch is uniform across the mesh.
     distributed = reduce_hist is not None
+    has_scan_hooks = (prepare_split_hist is not None or
+                      select_best is not None)
     quantized = cfg.quantized
     # Quantized + distributed (≡ the reference's int-histogram
     # ReduceScatter variants, data_parallel_tree_learner.cpp:285-299):
@@ -301,6 +319,19 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     pmeta = partition_meta if partition_meta is not None else meta
 
     use_mc = meta.monotone is not None
+    use_mc_inter = use_mc and cfg.mc_method == "intermediate"
+    if use_mc_inter:
+        if pool_none:
+            raise ValueError("monotone_constraints_method=intermediate "
+                             "re-scans affected leaves from the histogram "
+                             "pool; use hist_pool='full'")
+        if cfg.extra_trees:
+            raise ValueError("monotone_constraints_method=intermediate "
+                             "does not compose with extra_trees")
+        if has_scan_hooks:
+            raise ValueError("monotone_constraints_method=intermediate "
+                             "is supported with the serial and data "
+                             "learners only")
     use_ic = cfg.interaction_groups is not None
     if forced is not None:
         forced_active = jnp.asarray(forced[0], bool)
@@ -547,6 +578,13 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             leaf_start=jnp.zeros(L, jnp.int32) if compact else None,
             leaf_rows=(jnp.zeros(L, jnp.int32).at[0].set(R)
                        if compact else None),
+            leaf_flo=(jnp.zeros((L, F), jnp.int32) if use_mc_inter
+                      else None),
+            leaf_fhi=(jnp.broadcast_to(
+                meta.num_bin.astype(jnp.int32)[None, :] - 1,
+                (L, F)).copy() if use_mc_inter else None),
+            leaf_node_row=(jnp.zeros(L, jnp.int32) if use_mc_inter
+                           else None),
         )
 
         def body(i, state: GrowState) -> GrowState:
@@ -801,17 +839,26 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
 
             # ---- monotone constraint propagation ---------------------------
             # (ref: monotone_constraints.hpp:488-504 BasicLeafConstraints::
-            # Update — mid-point bound tightening on the split children)
+            # Update — mid-point bound tightening on the split children;
+            # :546 IntermediateLeafConstraints::UpdateConstraintsWithOutputs
+            # — sibling-output bounds, looser on the children, with other
+            # contiguous leaves tightened below)
             p_min, p_max = state.leaf_min[l], state.leaf_max[l]
             if use_mc:
                 mono_f = jnp.where(rec.feature >= 0,
                                    pmeta.monotone[jnp.maximum(rec.feature, 0)],
                                    0)
-                mid = (rec.left_output + rec.right_output) * 0.5
-                l_min = jnp.where(mono_f < 0, jnp.maximum(p_min, mid), p_min)
-                l_max = jnp.where(mono_f > 0, jnp.minimum(p_max, mid), p_max)
-                r_min = jnp.where(mono_f > 0, jnp.maximum(p_min, mid), p_min)
-                r_max = jnp.where(mono_f < 0, jnp.minimum(p_max, mid), p_max)
+                is_num = (rec.num_cat == 0) if has_cat else jnp.bool_(True)
+                mono_f = jnp.where(is_num, mono_f, 0)
+                if use_mc_inter:
+                    bl = rec.right_output   # left child's bound source
+                    br = rec.left_output    # right child's bound source
+                else:
+                    bl = br = (rec.left_output + rec.right_output) * 0.5
+                l_min = jnp.where(mono_f < 0, jnp.maximum(p_min, bl), p_min)
+                l_max = jnp.where(mono_f > 0, jnp.minimum(p_max, bl), p_max)
+                r_min = jnp.where(mono_f > 0, jnp.maximum(p_min, br), p_min)
+                r_max = jnp.where(mono_f < 0, jnp.minimum(p_max, br), p_max)
             else:
                 l_min = r_min = p_min
                 l_max = r_max = p_max
@@ -874,6 +921,117 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                                      new_leaf, nb[1], proceed),
                 state.best, best2)
 
+            # ---- intermediate mode: tighten contiguous leaves --------------
+            # (ref: monotone_constraints.hpp:625 GoUpToFindLeavesToUpdate /
+            # :700 GoDownToFindLeavesToUpdate + serial_tree_learner's
+            # re-FindBestSplits over leaves_to_update_). The recursive walk
+            # enumerates exactly the leaves whose region overlaps the new
+            # children in every non-split feature; here that set comes from
+            # one vectorized hyper-rectangle test, and the affected leaves
+            # are re-scanned from the (global) histogram pool only when a
+            # bound actually tightened.
+            if use_mc_inter:
+                fsafe = jnp.maximum(rec.feature, 0)
+                flo_p = state.leaf_flo[l]
+                fhi_p = state.leaf_fhi[l]
+                left_fhi = jnp.where(is_num,
+                                     fhi_p.at[fsafe].set(rec.threshold),
+                                     fhi_p)
+                right_flo = jnp.where(is_num,
+                                      flo_p.at[fsafe].set(rec.threshold + 1),
+                                      flo_p)
+                leaf_flo = _set(state.leaf_flo, new_leaf, right_flo, proceed)
+                leaf_fhi = _set(_set(state.leaf_fhi, l, left_fhi, proceed),
+                                new_leaf, fhi_p, proceed)
+                leaf_node_row = _set(
+                    _set(state.leaf_node_row, l, 2 * i + 1, proceed),
+                    new_leaf, 2 * i + 2, proceed)
+
+                lar = jnp.arange(L)
+                updatable = ((lar < t.num_leaves) & (lar != l) &
+                             (lar != new_leaf) &
+                             (best.gain > K_MIN_SCORE))
+                # A constraint links leaf j to child c iff exactly ONE
+                # feature separates their boxes and that feature is
+                # monotone (points can then move between the regions by
+                # changing only that feature). This is the same leaf set
+                # the reference's GoUp walk reaches: the separating
+                # feature is the monotone ancestor split it checks
+                # (monotone_constraints.hpp:655 monotone_type != 0), and
+                # ShouldKeepGoingLeftRight's threshold pruning is the
+                # box-overlap test.
+                c_flo = jnp.stack([flo_p, right_flo])       # [2, F]
+                c_fhi = jnp.stack([left_fhi, fhi_p])
+                c_out = jnp.stack([rec.left_output, rec.right_output])
+                ov = ((leaf_flo[:, None, :] <= c_fhi[None, :, :]) &
+                      (leaf_fhi[:, None, :] >= c_flo[None, :, :]))
+                n_sep = jnp.sum(~ov, axis=2)                # [L, 2]
+                sep = jnp.argmax(~ov, axis=2)               # [L, 2]
+                msep = pmeta.monotone[sep]                  # [L, 2]
+                linked = (n_sep == 1) & (msep != 0)
+                j_lo = jnp.take_along_axis(leaf_flo, sep, axis=1)  # [L, 2]
+                j_hi = jnp.take_along_axis(leaf_fhi, sep, axis=1)
+                c_lo = jnp.take_along_axis(
+                    jnp.broadcast_to(c_flo[None], (L, 2, F)),
+                    sep[..., None], axis=2)[..., 0]
+                c_hi = jnp.take_along_axis(
+                    jnp.broadcast_to(c_fhi[None], (L, 2, F)),
+                    sep[..., None], axis=2)[..., 0]
+                below = j_hi < c_lo                          # [L, 2]
+                above = j_lo > c_hi
+                inc = msep > 0
+                # increasing: j below a child => out_j <= child out (max
+                # bound); j above => min bound. Decreasing: mirrored.
+                ub_sel = linked & jnp.where(inc, below, above)
+                lb_sel = linked & jnp.where(inc, above, below)
+                cand_max = jnp.min(
+                    jnp.where(ub_sel, c_out[None, :], jnp.inf), axis=1)
+                cand_min = jnp.max(
+                    jnp.where(lb_sel, c_out[None, :], -jnp.inf), axis=1)
+                okj = proceed & updatable
+                nmax = jnp.where(okj, jnp.minimum(leaf_max, cand_max),
+                                 leaf_max)
+                nmin = jnp.where(okj, jnp.maximum(leaf_min, cand_min),
+                                 leaf_min)
+                changed = (nmax < leaf_max) | (nmin > leaf_min)
+                leaf_min, leaf_max = nmin, nmax
+
+                def _rescan(best_in):
+                    hp_all = conv(hist)
+                    if bundled:
+                        hp_all = jax.vmap(expand_hist)(hp_all, sum_g,
+                                                       sum_h, count)
+
+                    def one(hh, sg_, sh_, cn_, out_, mn_, mx_, dp_, nrow,
+                            pj):
+                        fm = feature_mask
+                        if cfg.bynode_mask and fm is not None:
+                            fm = fm[jnp.minimum(nrow, fm.shape[0] - 1)]
+                        if use_ic:
+                            al = allowed_features(pj)
+                            fm = al if fm is None else fm & al
+                        return best_of(hh, sg_, sh_, cn_, out_, fm,
+                                       leaf_range=(mn_, mx_),
+                                       leaf_depth=dp_, cegb=cegb)
+
+                    pj_arg = (path_mask if use_ic
+                              else jnp.zeros((L, 1), bool))
+                    new_recs = jax.vmap(one)(
+                        hp_all, sum_g, sum_h, count, value, leaf_min,
+                        leaf_max, depth, leaf_node_row, pj_arg)
+                    return jax.tree.map(
+                        lambda cur, nb: jnp.where(
+                            changed.reshape(
+                                changed.shape + (1,) * (cur.ndim - 1)),
+                            nb, cur), best_in, new_recs)
+
+                best = lax.cond(jnp.any(changed), _rescan,
+                                lambda b: b, best)
+            else:
+                leaf_flo = state.leaf_flo
+                leaf_fhi = state.leaf_fhi
+                leaf_node_row = state.leaf_node_row
+
             return GrowState(
                 leaf_id=leaf_id, hist=hist, sum_g=sum_g, sum_h=sum_h,
                 count=count, value=value, depth=depth,
@@ -881,7 +1039,8 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 tree=t, num_leaves=t.num_leaves, done=done | state.done,
                 leaf_min=leaf_min, leaf_max=leaf_max, path_mask=path_mask,
                 forced_ok=forced_ok, order=order, leaf_start=leaf_start,
-                leaf_rows=leaf_rows)
+                leaf_rows=leaf_rows, leaf_flo=leaf_flo, leaf_fhi=leaf_fhi,
+                leaf_node_row=leaf_node_row)
 
         state = lax.fori_loop(0, L - 1, body, state)
         if compact:

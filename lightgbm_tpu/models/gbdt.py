@@ -177,11 +177,22 @@ class GBDT:
                     f"dataset has {train.num_total_features} features")
             if np.any(mc_in != 0):
                 monotone = mc_in[train.used_feature_map]
-                if cfg.monotone_constraints_method not in ("basic",):
-                    log.warning(
-                        f"monotone_constraints_method="
-                        f"{cfg.monotone_constraints_method} not implemented; "
-                        "using 'basic'")
+        mc_method = cfg.monotone_constraints_method
+        if monotone is not None:
+            if mc_method == "advanced":
+                # intermediate is the strongest implemented mode (the
+                # reference's advanced per-threshold refinement,
+                # monotone_constraints.hpp:859, is not carried over)
+                log.warning("monotone_constraints_method=advanced not "
+                            "implemented; using 'intermediate'")
+                mc_method = "intermediate"
+            if mc_method == "intermediate" and (
+                    cfg.extra_trees or
+                    cfg.tree_learner in ("voting", "feature")):
+                log.warning("monotone_constraints_method=intermediate is "
+                            "supported with the serial/data learners and "
+                            "without extra_trees; using 'basic'")
+                mc_method = "basic"
         contri = None
         if cfg.feature_contri:
             fc_in = np.asarray(cfg.feature_contri, np.float64)
@@ -272,7 +283,8 @@ class GBDT:
             quantized=bool(cfg.use_quantized_grad),
             quant_bins=int(cfg.num_grad_quant_bins),
             stochastic_rounding=bool(cfg.stochastic_rounding),
-            extra_trees=bool(cfg.extra_trees))
+            extra_trees=bool(cfg.extra_trees),
+            mc_method=mc_method)
         # per-tree PRNG: stochastic rounding + extra_trees thresholds
         # (extra_seed falls back to seed, ref: config.h extra_seed)
         need_rng = bool(cfg.use_quantized_grad) or bool(cfg.extra_trees)
@@ -402,6 +414,13 @@ class GBDT:
                     log.warning(
                         "histogram pool exceeds the budget but forced "
                         "splits need it; keeping the full pool")
+                elif self.grower_cfg.mc_method == "intermediate" and \
+                        self.feature_meta is not None and \
+                        self.feature_meta.monotone is not None:
+                    log.warning(
+                        "histogram pool exceeds the budget but "
+                        "monotone_constraints_method=intermediate re-scans "
+                        "from it; keeping the full pool")
                 else:
                     self.grower_cfg = dataclasses.replace(
                         self.grower_cfg, hist_pool="none")

@@ -219,3 +219,54 @@ def test_monotone_constraints_aliases(rng):
               "monotonic_cst": [1, 0, 0]}
     bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10)
     assert _is_monotone(bst, X, 0, +1)
+
+
+@pytest.mark.parametrize("method", ["intermediate", "advanced"])
+def test_monotone_intermediate_enforced(rng, method):
+    """Intermediate mode (ref: monotone_constraints.hpp:517
+    IntermediateLeafConstraints): monotonicity must hold, and the looser
+    child bounds should fit at least as well as basic mode."""
+    X, y = _make_data(rng)
+    params = {"objective": "regression", "num_leaves": 31,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "monotone_constraints": [1, -1, 0],
+              "monotone_constraints_method": method}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=30)
+    assert _is_monotone(bst, X, 0, +1)
+    assert _is_monotone(bst, X, 1, -1)
+    pred = bst.predict(X)
+    r2_inter = 1 - np.var(y - pred) / np.var(y)
+    assert r2_inter > 0.7
+
+    basic = lgb.train({**params, "monotone_constraints_method": "basic"},
+                      lgb.Dataset(X, label=y), num_boost_round=30)
+    r2_basic = 1 - np.var(y - basic.predict(X)) / np.var(y)
+    # intermediate's whole point: less over-constraining than basic
+    assert r2_inter > r2_basic - 0.02, (r2_inter, r2_basic)
+
+
+def test_monotone_intermediate_data_parallel(rng):
+    """Intermediate mode composes with the data-parallel learner (the
+    pool holds GLOBAL histograms, so the re-scan is collective-free)."""
+    X, y = _make_data(rng, n=900)
+    params = {"objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "monotone_constraints": [1, -1, 0],
+              "monotone_constraints_method": "intermediate",
+              "tree_learner": "data"}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=15)
+    assert _is_monotone(bst, X, 0, +1)
+    assert _is_monotone(bst, X, 1, -1)
+
+
+def test_monotone_intermediate_compact_sched(rng):
+    """Intermediate mode under the compact O(rows_in_leaf) scheduler."""
+    X, y = _make_data(rng)
+    params = {"objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "monotone_constraints": [1, -1, 0],
+              "monotone_constraints_method": "intermediate",
+              "tpu_row_scheduling": "compact"}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=15)
+    assert _is_monotone(bst, X, 0, +1)
+    assert _is_monotone(bst, X, 1, -1)
