@@ -1,0 +1,82 @@
+# Minimal lgb.Booster (role of reference R-package/R/lgb.Booster.R).
+#
+# The booster is the LightGBM v4 model text -- the portable contract
+# shared by the reference, this framework's Python API, its native C
+# serving library and this R layer.
+
+#' Load a model from a LightGBM model text file
+lgb.load <- function(filename) {
+  if (!file.exists(filename)) stop("model file not found: ", filename)
+  bst <- list(model_file = filename,
+              model_str = paste(readLines(filename), collapse = "\n"))
+  class(bst) <- "lgb.Booster"
+  bst
+}
+
+#' Save a booster's model text to a file
+lgb.save <- function(booster, filename) {
+  if (!inherits(booster, "lgb.Booster")) stop("not an lgb.Booster")
+  writeLines(booster$model_str, filename)
+  invisible(filename)
+}
+
+#' Dump the model structure as a JSON string
+lgb.dump <- function(booster) {
+  if (!inherits(booster, "lgb.Booster")) stop("not an lgb.Booster")
+  out <- tempfile(fileext = ".json")
+  f <- .lgb_booster_file(booster)
+  code <- paste0(
+    "import json, lightgbm_tpu as lgb;",
+    "json.dump(lgb.Booster(model_file=", deparse(f), ").dump_model(),",
+    "open(", deparse(out), ", 'w'))")
+  rc <- system2(.lgb_python(), c("-c", shQuote(code)))
+  if (rc != 0) stop("model dump failed (rc=", rc, ")")
+  paste(readLines(out), collapse = "\n")
+}
+
+.lgb_booster_file <- function(booster) {
+  if (file.exists(booster$model_file)) return(booster$model_file)
+  f <- tempfile(fileext = ".txt")
+  writeLines(booster$model_str, f)
+  f
+}
+
+#' Predict with an lgb.Booster
+#'
+#' @param object the booster.
+#' @param newdata numeric matrix / data.frame, or a path to a data file.
+#' @param rawscore return raw margins instead of transformed scores.
+#' @param predleaf return per-tree leaf indices.
+#' @param predcontrib return SHAP feature contributions.
+predict.lgb.Booster <- function(object, newdata, rawscore = FALSE,
+                                predleaf = FALSE, predcontrib = FALSE,
+                                ...) {
+  if (is.character(newdata)) {
+    data_file <- newdata
+  } else {
+    # prediction files follow the training layout: label column first
+    # (dropped by the parser), features after -- prepend a dummy label
+    mat <- as.matrix(newdata)
+    data_file <- tempfile(fileext = ".csv")
+    utils::write.table(cbind(0, mat), data_file, sep = ",",
+                       row.names = FALSE, col.names = FALSE)
+  }
+  out <- tempfile(fileext = ".txt")
+  lines <- c("task = predict",
+             paste0("data = ", data_file),
+             paste0("input_model = ", .lgb_booster_file(object)),
+             paste0("output_result = ", out),
+             "header = false")
+  if (rawscore) lines <- c(lines, "predict_raw_score = true")
+  if (predleaf) lines <- c(lines, "predict_leaf_index = true")
+  if (predcontrib) lines <- c(lines, "predict_contrib = true")
+  .lgb_cli(lines)
+  res <- utils::read.table(out, sep = "\t", header = FALSE)
+  if (ncol(res) == 1) res[[1]] else as.matrix(res)
+}
+
+print.lgb.Booster <- function(x, ...) {
+  n_tree <- length(grep("^Tree=", strsplit(x$model_str, "\n")[[1]]))
+  cat("lgb.Booster (lightgbm-tpu):", n_tree, "trees\n")
+  invisible(x)
+}

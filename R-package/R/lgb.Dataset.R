@@ -1,0 +1,42 @@
+# Minimal lgb.Dataset (role of reference R-package/R/lgb.Dataset.R).
+#
+# The dataset is materialized as a CSV file with the label in the first
+# column -- the framework CLI's native ingestion format (header=false,
+# label column 0). Weights / groups ride along as the reference's
+# .weight / .query sidecar files (io/file_loader.py picks them up by
+# path convention).
+
+#' Construct a dataset for lgb.train
+#'
+#' @param data numeric matrix or data.frame of features, or a path to an
+#'   existing CSV/TSV/LibSVM file (used as-is).
+#' @param label numeric vector of targets (ignored when `data` is a path).
+#' @param weight optional per-row weights.
+#' @param group optional query sizes for ranking.
+#' @param params named list of dataset parameters (e.g. max_bin),
+#'   forwarded to the trainer config.
+lgb.Dataset <- function(data, label = NULL, weight = NULL, group = NULL,
+                        params = list()) {
+  ds <- list(params = params)
+  if (is.character(data)) {
+    ds$file <- data
+    ds$owned <- FALSE
+  } else {
+    if (is.null(label)) stop("lgb.Dataset: label is required for matrix data")
+    mat <- as.matrix(data)
+    if (nrow(mat) != length(label))
+      stop("lgb.Dataset: nrow(data) != length(label)")
+    f <- tempfile(fileext = ".csv")
+    utils::write.table(cbind(label, mat), f, sep = ",",
+                       row.names = FALSE, col.names = FALSE)
+    if (!is.null(weight))
+      writeLines(as.character(weight), paste0(f, ".weight"))
+    if (!is.null(group))
+      writeLines(as.character(group), paste0(f, ".query"))
+    ds$file <- f
+    ds$owned <- TRUE
+    ds$ncol <- ncol(mat)
+  }
+  class(ds) <- "lgb.Dataset"
+  ds
+}

@@ -1,0 +1,67 @@
+# Minimal lgb.train (role of reference R-package/R/lgb.train.R:44).
+#
+# Drives the framework's CLI (python -m lightgbm_tpu.cli) with a
+# generated config file -- the same train task the reference CLI runs --
+# and wraps the resulting LightGBM v4 model.txt in an lgb.Booster.
+
+.lgb_python <- function() {
+  Sys.getenv("LIGHTGBM_TPU_PYTHON", unset = "python3")
+}
+
+.lgb_cli <- function(conf_lines) {
+  conf <- tempfile(fileext = ".conf")
+  writeLines(conf_lines, conf)
+  rc <- system2(.lgb_python(), c("-m", "lightgbm_tpu.cli",
+                                 paste0("config=", conf)))
+  if (rc != 0) stop("lightgbm_tpu CLI failed (rc=", rc, ")")
+  invisible(NULL)
+}
+
+.lgb_param_lines <- function(params) {
+  vapply(names(params), function(k) {
+    v <- params[[k]]
+    if (is.logical(v)) v <- ifelse(v, "true", "false")
+    paste0(k, " = ", paste(v, collapse = ","))
+  }, character(1))
+}
+
+#' Train a gradient-boosted model
+#'
+#' @param params named list of training parameters (reference names and
+#'   aliases all work -- the config registry resolves them).
+#' @param data an lgb.Dataset.
+#' @param nrounds number of boosting iterations.
+#' @param valids named list of lgb.Dataset objects for evaluation.
+#' @param early_stopping_rounds optional early-stopping patience.
+#' @param verbose verbosity passed through.
+#' @return an lgb.Booster.
+lgb.train <- function(params = list(), data, nrounds = 100L,
+                      valids = list(), early_stopping_rounds = NULL,
+                      verbose = 1L) {
+  if (!inherits(data, "lgb.Dataset")) stop("data must be an lgb.Dataset")
+  model_file <- tempfile(fileext = ".txt")
+  lines <- c("task = train",
+             paste0("data = ", data$file),
+             paste0("num_iterations = ", as.integer(nrounds)),
+             paste0("output_model = ", model_file),
+             paste0("verbosity = ", as.integer(verbose)),
+             .lgb_param_lines(data$params),
+             .lgb_param_lines(params))
+  if (length(valids) > 0) {
+    vfiles <- vapply(valids, function(v) v$file, character(1))
+    lines <- c(lines, paste0("valid = ", paste(vfiles, collapse = ",")))
+  }
+  if (!is.null(early_stopping_rounds))
+    lines <- c(lines, paste0("early_stopping_round = ",
+                             as.integer(early_stopping_rounds)))
+  .lgb_cli(lines)
+  lgb.load(model_file)
+}
+
+#' Simplified training entry point (role of reference lightgbm.R)
+lightgbm <- function(data, label = NULL, params = list(),
+                     nrounds = 100L, ...) {
+  ds <- if (inherits(data, "lgb.Dataset")) data
+        else lgb.Dataset(data, label = label)
+  lgb.train(params = params, data = ds, nrounds = nrounds, ...)
+}

@@ -198,6 +198,12 @@ def run(argv: List[str]) -> int:
     try:
         params = parse_args(argv)
         cfg = Config(dict(params))
+        # device_type=cpu pins the jax platform before first backend use
+        # (ref: config.h device_type cpu/gpu/cuda — here: cpu vs tpu);
+        # effective only if no jax computation ran yet in this process
+        if str(cfg.device_type).lower() == "cpu":
+            import jax
+            jax.config.update("jax_platforms", "cpu")
         task = _TASKS.get(cfg.task)
         if task is None:
             raise LightGBMError(
