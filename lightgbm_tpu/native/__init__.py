@@ -21,7 +21,8 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _BUILD_DIR = os.path.join(_HERE, "_build")
 _SO_PATH = os.path.join(_BUILD_DIR, "lgbm_native.so")
 _SRCS = [os.path.join(_HERE, "parser.cpp"),
-         os.path.join(_HERE, "c_api.cpp")]
+         os.path.join(_HERE, "c_api.cpp"),
+         os.path.join(_HERE, "c_api_train.cpp")]
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -35,7 +36,7 @@ def _build() -> Optional[str]:
                                               for s in _SRCS)):
         return _SO_PATH
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", *_SRCS,
-           "-o", _SO_PATH + ".tmp"]
+           "-ldl", "-o", _SO_PATH + ".tmp"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(_SO_PATH + ".tmp", _SO_PATH)

@@ -373,6 +373,18 @@ typedef void* BoosterHandle;
 
 const char* LGBM_GetLastError() { return g_last_error.c_str(); }
 
+// training side (c_api_train.cpp) shares the error slot and owns its
+// handle registry; serving entry points route training handles there
+void LgbmTrainSetError(const char* msg) { SetError(msg ? msg : ""); }
+int LgbmTrainOwns(void* handle);
+int LgbmTrainBoosterFree(void* handle);
+int LgbmTrainBoosterIntProp(void* handle, const char* prop, int* out);
+int LgbmTrainBoosterPredictForMat(void* handle, const void* data,
+                                  int data_type, int32_t nrow,
+                                  int32_t ncol, int is_row_major,
+                                  int predict_type, int num_iteration,
+                                  int64_t* out_len, double* out_result);
+
 int LGBM_BoosterCreateFromModelfile(const char* filename,
                                     int* out_num_iterations,
                                     BoosterHandle* out) {
@@ -407,26 +419,37 @@ int LGBM_BoosterLoadModelFromString(const char* model_str,
 }
 
 int LGBM_BoosterFree(BoosterHandle handle) {
+  if (LgbmTrainOwns(handle)) return LgbmTrainBoosterFree(handle);
   delete static_cast<Model*>(handle);
   return 0;
 }
 
 int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len) {
+  if (LgbmTrainOwns(handle))
+    return LgbmTrainBoosterIntProp(
+        handle, "b.num_model_per_iteration()", out_len);
   *out_len = static_cast<Model*>(handle)->num_class;
   return 0;
 }
 
 int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len) {
+  if (LgbmTrainOwns(handle))
+    return LgbmTrainBoosterIntProp(handle, "b.num_feature()", out_len);
   *out_len = static_cast<Model*>(handle)->max_feature_idx + 1;
   return 0;
 }
 
 int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out) {
+  if (LgbmTrainOwns(handle))
+    return LgbmTrainBoosterIntProp(handle, "b.current_iteration()", out);
   *out = static_cast<Model*>(handle)->NumIterations();
   return 0;
 }
 
 int LGBM_BoosterNumModelPerIteration(BoosterHandle handle, int* out) {
+  if (LgbmTrainOwns(handle))
+    return LgbmTrainBoosterIntProp(
+        handle, "b.num_model_per_iteration()", out);
   *out = static_cast<Model*>(handle)->num_tree_per_iteration;
   return 0;
 }
@@ -439,6 +462,11 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
                               int start_iteration, int num_iteration,
                               const char* /*parameter*/, int64_t* out_len,
                               double* out_result) {
+  if (LgbmTrainOwns(handle))
+    return LgbmTrainBoosterPredictForMat(handle, data, data_type, nrow,
+                                         ncol, is_row_major, predict_type,
+                                         num_iteration, out_len,
+                                         out_result);
   Model* m = static_cast<Model*>(handle);
   if (data_type != 0 && data_type != 1) {
     SetError("only float32 (0) / float64 (1) data are supported");

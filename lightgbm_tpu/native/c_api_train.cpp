@@ -1,0 +1,408 @@
+// Training-side C API: Dataset creation + boosting from C callers.
+//
+// Counterpart of the reference's training ABI
+// (ref: include/LightGBM/c_api.h:186 LGBM_DatasetCreateFromMat, :810
+// LGBM_BoosterUpdateOneIter, src/c_api.cpp Booster::TrainOneIter). The
+// compute path of this framework is JAX/XLA, so these entry points embed
+// a Python interpreter (lazily, via dlopen of libpython — the serving
+// surface in c_api.cpp stays interpreter-free) and drive the same engine
+// the Python API uses. State lives in the embedded interpreter; handles
+// carry an id into it.
+//
+// Threading: calls must come from one thread (the embedding keeps the
+// GIL of the initializing thread). This matches the CLI-style training
+// usage the surface targets.
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <set>
+#include <string>
+
+namespace {
+
+void SetTrainError(const std::string& msg);  // fwd; shared with c_api.cpp
+
+// ---- embedded python ---------------------------------------------------
+typedef int (*PyRun_t)(const char*);
+typedef void (*PyInit_t)(int);
+typedef int (*PyIsInit_t)();
+
+PyRun_t g_pyrun = nullptr;
+
+bool EnsurePython() {
+  if (g_pyrun) return true;
+  const char* names[] = {"libpython3.12.so.1.0", "libpython3.12.so",
+                         "libpython3.so",        "libpython3.11.so.1.0",
+                         "libpython3.11.so",     nullptr};
+  const char* env = std::getenv("LGBM_TPU_LIBPYTHON");
+  void* lib = env ? dlopen(env, RTLD_NOW | RTLD_GLOBAL) : nullptr;
+  for (int i = 0; !lib && names[i]; ++i)
+    lib = dlopen(names[i], RTLD_NOW | RTLD_GLOBAL);
+  if (!lib) {
+    SetTrainError("training C API: could not dlopen libpython (set "
+                  "LGBM_TPU_LIBPYTHON to its path)");
+    return false;
+  }
+  auto is_init = reinterpret_cast<PyIsInit_t>(dlsym(lib, "Py_IsInitialized"));
+  auto init = reinterpret_cast<PyInit_t>(dlsym(lib, "Py_InitializeEx"));
+  g_pyrun = reinterpret_cast<PyRun_t>(dlsym(lib, "PyRun_SimpleString"));
+  if (!is_init || !init || !g_pyrun) {
+    SetTrainError("training C API: libpython is missing required symbols");
+    g_pyrun = nullptr;
+    return false;
+  }
+  if (!is_init()) init(0);
+
+  // bootstrap: make the package importable from the .so's own location
+  // (<repo>/lightgbm_tpu/native/_build/lgbm_native.so -> <repo>)
+  Dl_info info;
+  std::string root;
+  if (dladdr(reinterpret_cast<void*>(&EnsurePython), &info) &&
+      info.dli_fname) {
+    root = info.dli_fname;
+    for (int up = 0; up < 4; ++up) {
+      size_t pos = root.find_last_of('/');
+      if (pos == std::string::npos) break;
+      root.resize(pos);
+    }
+  }
+  std::string code =
+      "import sys\n"
+      "sys.path.insert(0, '" + root + "')\n"
+      "import numpy as _np, ctypes as _ct\n"
+      "import lightgbm_tpu as _lgb\n"
+      "_lgbm_capi = {'next': 1, 'obj': {}}\n"
+      "def _lgbm_capi_call(fn, rc_addr, err_addr):\n"
+      "    try:\n"
+      "        fn()\n"
+      "        _ct.c_int.from_address(rc_addr).value = 0\n"
+      "    except Exception as e:\n"
+      "        m = str(e).encode()[:4000] + b'\\0'\n"
+      "        _ct.memmove(err_addr, m, len(m))\n"
+      "        _ct.c_int.from_address(rc_addr).value = 1\n";
+  if (g_pyrun(code.c_str()) != 0) {
+    SetTrainError("training C API: interpreter bootstrap failed (is "
+                  "lightgbm_tpu importable next to the shared library?)");
+    g_pyrun = nullptr;
+    return false;
+  }
+  return true;
+}
+
+// Run `body` (python statements operating on _lgbm_capi) under the
+// error-capture harness. Returns 0 on success, -1 with the python
+// exception message in the shared error slot otherwise.
+int RunGuarded(const std::string& body) {
+  if (!EnsurePython()) return -1;
+  static int rc_slot;
+  static char err_slot[4096];
+  rc_slot = -9;
+  err_slot[0] = '\0';
+  char head[256];
+  std::snprintf(head, sizeof(head),
+                "def _lgbm_tmp_fn():\n");
+  std::string indented;
+  size_t start = 0;
+  while (start <= body.size()) {
+    size_t end = body.find('\n', start);
+    if (end == std::string::npos) end = body.size();
+    indented += "    " + body.substr(start, end - start) + "\n";
+    start = end + 1;
+  }
+  char tail[256];
+  std::snprintf(tail, sizeof(tail),
+                "_lgbm_capi_call(_lgbm_tmp_fn, %llu, %llu)\n",
+                static_cast<unsigned long long>(
+                    reinterpret_cast<uintptr_t>(&rc_slot)),
+                static_cast<unsigned long long>(
+                    reinterpret_cast<uintptr_t>(err_slot)));
+  std::string code = std::string(head) + indented + tail;
+  if (g_pyrun(code.c_str()) != 0 || rc_slot != 0) {
+    SetTrainError(err_slot[0] ? err_slot
+                              : "training C API: python execution failed");
+    return -1;
+  }
+  return 0;
+}
+
+// ---- handle registry ---------------------------------------------------
+struct TrainHandle {
+  uint64_t id;
+  bool is_booster;
+};
+
+std::mutex g_handles_mu;
+std::set<TrainHandle*> g_handles;
+uint64_t g_next_id = 1;
+
+TrainHandle* NewHandle(bool is_booster) {
+  std::lock_guard<std::mutex> lk(g_handles_mu);
+  auto* h = new TrainHandle{g_next_id++, is_booster};
+  g_handles.insert(h);
+  return h;
+}
+
+TrainHandle* AsTrainHandle(void* p) {
+  std::lock_guard<std::mutex> lk(g_handles_mu);
+  auto it = g_handles.find(static_cast<TrainHandle*>(p));
+  return it == g_handles.end() ? nullptr : *it;
+}
+
+void DropHandle(TrainHandle* h) {
+  std::lock_guard<std::mutex> lk(g_handles_mu);
+  g_handles.erase(h);
+  delete h;
+}
+
+std::string Addr(const void* p) {
+  char buf[32];
+  std::snprintf(buf, sizeof(buf), "%llu",
+                static_cast<unsigned long long>(
+                    reinterpret_cast<uintptr_t>(p)));
+  return buf;
+}
+
+std::string PyStr(const char* s) {
+  std::string out = "'";
+  for (const char* c = s ? s : ""; *c; ++c) {
+    if (*c == '\'' || *c == '\\') out += '\\';
+    if (*c == '\n') { out += "\\n"; continue; }
+    out += *c;
+  }
+  out += "'";
+  return out;
+}
+
+}  // namespace
+
+// hooks shared with c_api.cpp (serving side routes through these)
+extern "C" {
+
+// 1 if `handle` belongs to the training registry.
+int LgbmTrainOwns(void* handle) { return AsTrainHandle(handle) ? 1 : 0; }
+
+void LgbmTrainSetError(const char* msg);  // provided by c_api.cpp
+
+int LGBM_DatasetCreateFromMat(const void* data, int data_type,
+                              int32_t nrow, int32_t ncol,
+                              int is_row_major, const char* parameters,
+                              const void* reference, void** out) {
+  (void)reference;  // shared bin mappers not needed: binning re-runs
+  if (!data || !out) {
+    LgbmTrainSetError("DatasetCreateFromMat: null argument");
+    return -1;
+  }
+  // C_API_DTYPE_FLOAT32 = 0, C_API_DTYPE_FLOAT64 = 1 (ref: c_api.h:33)
+  const char* ct = data_type == 0 ? "_ct.c_float" : "_ct.c_double";
+  TrainHandle* h = NewHandle(false);
+  char idbuf[32];
+  std::snprintf(idbuf, sizeof(idbuf), "%llu",
+                static_cast<unsigned long long>(h->id));
+  std::string body =
+      std::string("n, f = ") + std::to_string(nrow) + ", " +
+      std::to_string(ncol) + "\n" +
+      "buf = (" + ct + " * (n * f)).from_address(" + Addr(data) + ")\n" +
+      "a = _np.ctypeslib.as_array(buf).astype(_np.float64).copy()\n" +
+      "a = a.reshape(n, f)" +
+      (is_row_major ? "\n" : " if False else a.reshape(f, n).T.copy()\n") +
+      "p = dict(kv.split('=', 1) for kv in " + PyStr(parameters) +
+      ".replace(',', ' ').split() if '=' in kv)\n" +
+      "_lgbm_capi['obj'][" + idbuf + "] = {'X': a, 'params': p, "
+      "'fields': {}}\n";
+  if (RunGuarded(body) != 0) {
+    DropHandle(h);
+    return -1;
+  }
+  *out = h;
+  return 0;
+}
+
+int LGBM_DatasetSetField(void* handle, const char* field_name,
+                         const void* field_data, int32_t num_element,
+                         int data_type) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || h->is_booster) {
+    LgbmTrainSetError("DatasetSetField: not a training Dataset handle");
+    return -1;
+  }
+  // C_API_DTYPE: 0=f32 1=f64 2=i32 3=i64 (ref: c_api.h:33-41)
+  const char* ct = data_type == 0   ? "_ct.c_float"
+                   : data_type == 1 ? "_ct.c_double"
+                   : data_type == 2 ? "_ct.c_int32"
+                                    : "_ct.c_int64";
+  std::string body =
+      std::string("buf = (") + ct + " * " + std::to_string(num_element) +
+      ").from_address(" + Addr(field_data) + ")\n" +
+      "v = _np.ctypeslib.as_array(buf).copy()\n" +
+      "_lgbm_capi['obj'][" + std::to_string(h->id) + "]['fields'][" +
+      PyStr(field_name) + "] = v\n";
+  return RunGuarded(body);
+}
+
+int LGBM_DatasetGetNumData(void* handle, int32_t* out) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || h->is_booster || !out) {
+    LgbmTrainSetError("DatasetGetNumData: not a training Dataset handle");
+    return -1;
+  }
+  std::string body =
+      "_ct.c_int32.from_address(" + Addr(out) + ").value = "
+      "_lgbm_capi['obj'][" + std::to_string(h->id) + "]['X'].shape[0]\n";
+  return RunGuarded(body);
+}
+
+int LGBM_DatasetGetNumFeature(void* handle, int32_t* out) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || h->is_booster || !out) {
+    LgbmTrainSetError("DatasetGetNumFeature: not a training Dataset handle");
+    return -1;
+  }
+  std::string body =
+      "_ct.c_int32.from_address(" + Addr(out) + ").value = "
+      "_lgbm_capi['obj'][" + std::to_string(h->id) + "]['X'].shape[1]\n";
+  return RunGuarded(body);
+}
+
+int LGBM_DatasetFree(void* handle) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || h->is_booster) {
+    LgbmTrainSetError("DatasetFree: not a training Dataset handle");
+    return -1;
+  }
+  std::string body = "_lgbm_capi['obj'].pop(" + std::to_string(h->id) +
+                     ", None)\n";
+  int rc = RunGuarded(body);
+  DropHandle(h);
+  return rc;
+}
+
+int LGBM_BoosterCreate(void* train_data, const char* parameters,
+                       void** out) {
+  TrainHandle* d = AsTrainHandle(train_data);
+  if (!d || d->is_booster || !out) {
+    LgbmTrainSetError("BoosterCreate: train_data is not a training "
+                      "Dataset handle");
+    return -1;
+  }
+  TrainHandle* h = NewHandle(true);
+  std::string did = std::to_string(d->id), bid = std::to_string(h->id);
+  std::string body =
+      "d = _lgbm_capi['obj'][" + did + "]\n" +
+      "p = dict(d['params'])\n" +
+      "p.update(kv.split('=', 1) for kv in " + PyStr(parameters) +
+      ".replace(',', ' ').split() if '=' in kv)\n" +
+      "fl = d['fields']\n" +
+      "grp = fl.get('group')\n" +
+      "if grp is not None and grp.dtype != _np.int32:\n" +
+      "    grp = grp.astype(_np.int32)\n" +
+      "ds = _lgb.Dataset(d['X'], label=fl.get('label'), "
+      "weight=fl.get('weight'), group=grp, "
+      "init_score=fl.get('init_score'), params=p)\n" +
+      "_lgbm_capi['obj'][" + bid + "] = {'booster': _lgb.Booster(p, ds), "
+      "'finished': False}\n";
+  if (RunGuarded(body) != 0) {
+    DropHandle(h);
+    return -1;
+  }
+  *out = h;
+  return 0;
+}
+
+int LGBM_BoosterUpdateOneIter(void* handle, int* is_finished) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !is_finished) {
+    LgbmTrainSetError("BoosterUpdateOneIter: not a training Booster handle");
+    return -1;
+  }
+  std::string body =
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n" +
+      "fin = b['booster'].update()\n" +
+      "b['finished'] = bool(fin)\n" +
+      "_ct.c_int.from_address(" + Addr(is_finished) +
+      ").value = 1 if fin else 0\n";
+  return RunGuarded(body);
+}
+
+int LGBM_BoosterSaveModel(void* handle, int start_iteration,
+                          int num_iteration, int feature_importance_type,
+                          const char* filename) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster) {
+    LgbmTrainSetError("BoosterSaveModel: not a training Booster handle");
+    return -1;
+  }
+  (void)start_iteration;
+  std::string body =
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
+      "b.save_model(" + PyStr(filename) + ", num_iteration=" +
+      (num_iteration > 0 ? std::to_string(num_iteration) : "None") +
+      ", importance_type=" +
+      (feature_importance_type == 1 ? "'gain'" : "'split'") + ")\n";
+  return RunGuarded(body);
+}
+
+// ---- training-handle implementations used by c_api.cpp routers ---------
+
+int LgbmTrainBoosterFree(void* handle) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h) return -1;
+  std::string body = "_lgbm_capi['obj'].pop(" + std::to_string(h->id) +
+                     ", None)\n";
+  int rc = RunGuarded(body);
+  DropHandle(h);
+  return rc;
+}
+
+int LgbmTrainBoosterIntProp(void* handle, const char* prop, int* out) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !out) return -1;
+  std::string body =
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
+      "_ct.c_int.from_address(" + Addr(out) + ").value = int(" + prop +
+      ")\n";
+  return RunGuarded(body);
+}
+
+int LgbmTrainBoosterPredictForMat(void* handle, const void* data,
+                                  int data_type, int32_t nrow,
+                                  int32_t ncol, int is_row_major,
+                                  int predict_type, int num_iteration,
+                                  int64_t* out_len, double* out_result) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !out_len || !out_result) return -1;
+  const char* ct = data_type == 0 ? "_ct.c_float" : "_ct.c_double";
+  // C_API_PREDICT_NORMAL=0 RAW_SCORE=1 LEAF_INDEX=2 CONTRIB=3
+  std::string kw = predict_type == 1   ? "raw_score=True"
+                   : predict_type == 2 ? "pred_leaf=True"
+                   : predict_type == 3 ? "pred_contrib=True"
+                                       : "";
+  std::string body =
+      std::string("n, f = ") + std::to_string(nrow) + ", " +
+      std::to_string(ncol) + "\n" +
+      "buf = (" + ct + " * (n * f)).from_address(" + Addr(data) + ")\n" +
+      "a = _np.ctypeslib.as_array(buf).astype(_np.float64).copy()\n" +
+      "a = a.reshape(n, f)" +
+      (is_row_major ? "\n" : " if False else a.reshape(f, n).T.copy()\n") +
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
+      "pred = _np.ascontiguousarray(b.predict(a" +
+      (num_iteration > 0
+           ? ", num_iteration=" + std::to_string(num_iteration)
+           : "") +
+      (kw.empty() ? "" : ", " + kw) + "), dtype=_np.float64)\n" +
+      "_ct.c_int64.from_address(" + Addr(out_len) +
+      ").value = pred.size\n" +
+      "_ct.memmove(" + Addr(out_result) +
+      ", pred.ctypes.data, pred.size * 8)\n";
+  return RunGuarded(body);
+}
+
+}  // extern "C"
+
+namespace {
+void SetTrainError(const std::string& msg) {
+  LgbmTrainSetError(msg.c_str());
+}
+}  // namespace
