@@ -248,6 +248,10 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     distributed = reduce_hist is not None
     has_scan_hooks = (prepare_split_hist is not None or
                       select_best is not None)
+    # feature-sharded layout (feature-parallel): bins hold a LOCAL column
+    # slice; the partition column comes from the owner via the
+    # fetch_bin_column hook (one [R] psum per split, outside control flow)
+    feat_sharded = fetch_bin_column is not None
     quantized = cfg.quantized
     # Quantized + distributed (≡ the reference's int-histogram
     # ReduceScatter variants, data_parallel_tree_learner.cpp:285-299):
@@ -427,21 +431,29 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 return (jnp.sum(sizes_arr >= n) - 1).astype(jnp.int32)
 
             def make_part(P):
-                def part(order, start, rows, f, thr, dl, ncat, cbins):
+                def part(order, start, rows, f, thr, dl, ncat, cbins,
+                         colv):
                     """Stable two-way partition of the leaf's segment
-                    (≡ DataPartition::Split, data_partition.hpp:102)."""
+                    (≡ DataPartition::Split, data_partition.hpp:102).
+                    ``colv`` is the replicated [R] global bin column of the
+                    split feature when features are sharded (gathered once
+                    per split via fetch_bin_column), else a dummy."""
                     f = jnp.maximum(f, 0)
                     start_c = jnp.clip(start, 0, max(R - P, 0))
                     delta = start - start_c
                     seg = lax.dynamic_slice(order, (start_c,), (P,))
-                    col_idx = b_group[f] if bundled else f
-                    if flat_ok:
-                        col = bins_flat[seg * Fp + col_idx].astype(jnp.int32)
+                    if feat_sharded:
+                        col = jnp.take(colv, seg).astype(jnp.int32)
                     else:
-                        col = jnp.take(jnp.take(bins_t, seg, axis=0),
-                                       col_idx, axis=1).astype(jnp.int32)
-                    if bundled:
-                        col = decode_bin(col, f)
+                        col_idx = b_group[f] if bundled else f
+                        if flat_ok:
+                            col = bins_flat[seg * Fp + col_idx].astype(
+                                jnp.int32)
+                        else:
+                            col = jnp.take(jnp.take(bins_t, seg, axis=0),
+                                           col_idx, axis=1).astype(jnp.int32)
+                        if bundled:
+                            col = decode_bin(col, f)
                     go_left = _go_left_bins(
                         col, thr, dl, f, pmeta,
                         ncat if has_cat else None,
@@ -724,6 +736,14 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 start_l = state.leaf_start[l]
                 rows_l = state.leaf_rows[l]
 
+                if feat_sharded:
+                    # owner-column broadcast OUTSIDE the (uniform) branch
+                    # so the collective runs unconditionally every step
+                    # (≡ feature_parallel_tree_learner.cpp:62-75)
+                    colv = fetch_bin_column(bins_t, rec.feature)
+                else:
+                    colv = jnp.zeros((1,), jnp.int32)
+
                 def do_partition():
                     pb = bucket_branch(rows_l)
                     ncat_a = rec.num_cat if has_cat else jnp.int32(0)
@@ -732,7 +752,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                     return lax.switch(
                         pb, part_branches, state.order, start_l, rows_l,
                         rec.feature, rec.threshold, rec.default_left,
-                        ncat_a, cbins_a)
+                        ncat_a, cbins_a, colv)
 
                 small_ctx = None
                 if pool_none:

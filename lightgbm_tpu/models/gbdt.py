@@ -336,17 +336,10 @@ class GBDT:
                 # data_parallel_tree_learner.cpp:285-299), as does
                 # extra_trees (replicated per-tree key → identical random
                 # thresholds on every device)
-                # compact O(rows_in_leaf) scheduling composes with the
-                # row-sharded learners (data/voting); feature-parallel
-                # shards columns and needs the full-pass layout
-                sched = self.grower_cfg.row_sched
-                if tl == "feature" and sched == "compact":
-                    log.warning("tpu_row_scheduling=compact is not "
-                                "supported with tree_learner=feature; "
-                                "using the full-pass scheduler")
-                    sched = "full"
-                self.grower_cfg = dataclasses.replace(
-                    self.grower_cfg, row_sched=sched)
+                # compact O(rows_in_leaf) scheduling composes with all
+                # three learners; under feature-parallel the partition
+                # column arrives via the once-per-split owner broadcast
+                # (feature_parallel.py fetch_bin_column)
             else:
                 cap = (f"tpu_num_devices={cfg.tpu_num_devices}"
                        if 0 < cfg.tpu_num_devices < avail
@@ -540,8 +533,13 @@ class GBDT:
             bins = train.bins
             if self._feat_pad:
                 bins = np.pad(bins, ((0, self._feat_pad), (0, 0)))
-            self.bins_sharded = jax.device_put(
-                bins, NamedSharding(mesh, P(FEATURE_AXIS, None)))
+            if self._compact:
+                self.bins_sharded = jax.device_put(
+                    np.ascontiguousarray(bins.T),
+                    NamedSharding(mesh, P(None, FEATURE_AXIS)))
+            else:
+                self.bins_sharded = jax.device_put(
+                    bins, NamedSharding(mesh, P(FEATURE_AXIS, None)))
             meta_p = pad_feature_meta(self.feature_meta, Fp)
             grow = make_feature_parallel_grower(self.grower_cfg, meta_p,
                                                 mesh)

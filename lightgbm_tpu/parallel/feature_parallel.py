@@ -67,9 +67,11 @@ def pad_feature_meta(meta: FeatureMeta, target_f: int) -> FeatureMeta:
 def make_feature_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
                                  mesh: Mesh,
                                  feature_axis: str = FEATURE_AXIS):
-    """Build grow(bins_t, gh) with bins_t [F, R] sharded on the FEATURE dim
-    over `feature_axis` (F must divide the axis size — pad with
-    pad_feature_meta / zero bin rows). gh is replicated. Returns a
+    """Build grow(bins_t, gh) with bins sharded on the FEATURE dim over
+    `feature_axis` (F must divide the axis size — pad with
+    pad_feature_meta / zero bin rows): [F, R] in full mode, row-major
+    [R, F] under compact scheduling (the partition column then arrives
+    via the once-per-split owner broadcast). gh is replicated. Returns a
     replicated tree and leaf_id.
     """
     D = mesh.shape[feature_axis]
@@ -104,8 +106,11 @@ def make_feature_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
             offset = lax.axis_index(feature_axis) * Fd
             f_local = f_global - offset
             own = (f_local >= 0) & (f_local < Fd) & (f_global >= 0)
+            # full mode stores [F_local, R]; compact stores row-major
+            # [R, F_local]
+            axis = 1 if cfg.row_sched == "compact" else 0
             col = jnp.take(bins_local, jnp.clip(f_local, 0, Fd - 1),
-                           axis=0).astype(jnp.int32)
+                           axis=axis).astype(jnp.int32)
             col = jnp.where(own, col, 0)
             # owner broadcast (≡ "no broadcast needed" in the reference
             # because all rows are local — only the column is exchanged)
@@ -130,9 +135,11 @@ def make_feature_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
     # alongside the bins (each device masks/penalizes its own slice);
     # bynode masks are [2L, F] so the feature dim moves to position 1
     fm_spec = P(None, feature_axis) if cfg.bynode_mask else P(feature_axis)
+    bins_spec = (P(None, feature_axis) if cfg.row_sched == "compact"
+                 else P(feature_axis, None))
     sharded = _make_sharded(
         sharded_grow, mesh,
-        in_specs=(P(feature_axis, None), P(None, None), fm_spec,
+        in_specs=(bins_spec, P(None, None), fm_spec,
                   P(feature_axis), P(feature_axis), P()),
         out_specs=(P(), P()))
 

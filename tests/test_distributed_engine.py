@@ -106,7 +106,7 @@ def test_distributed_bagging_goss(rng):
     assert acc_g > 0.8
 
 
-@pytest.mark.parametrize("tl", ["data", "voting"])
+@pytest.mark.parametrize("tl", ["data", "voting", "feature"])
 def test_distributed_compact_matches_full(rng, tl):
     """The O(rows_in_leaf) compact scheduler under the row-sharded
     learners must reproduce the full-pass scheduler's model exactly."""
