@@ -352,19 +352,60 @@ class BinnedDataset:
             / max(total_rows, 1),
             config.min_data_in_bin))
 
-        mappers: List[BinMapper] = []
+        # distributed bin finding (ref: dataset_loader.cpp:1175-1219):
+        # with N processes and no pre-partition, each process runs FindBin
+        # only on its contiguous feature slice and the BinMappers are
+        # allgathered, so multi-host loads bin each feature exactly once
+        rank, n_proc = 0, 1
+        if not config.pre_partition:
+            try:
+                import jax
+                n_proc = jax.process_count()
+                rank = jax.process_index()
+            except Exception:
+                n_proc = 1
+        f_lo, f_hi = 0, num_features
+        if n_proc > 1:
+            step = max((num_features + n_proc - 1) // n_proc, 1)
+            f_lo = min(rank * step, num_features)
+            f_hi = min(f_lo + step, num_features)
+
         max_bin_by_feature = config.max_bin_by_feature
-        for f in range(num_features):
+
+        def _bin_one(f):
             col = source.get_col_sample(f, sample_indices)
             bin_type = BIN_CATEGORICAL if f in cat_set else BIN_NUMERICAL
             mb = (max_bin_by_feature[f] if f < len(max_bin_by_feature)
                   else config.max_bin)
-            mappers.append(BinMapper.find_bin(
+            return BinMapper.find_bin(
                 col, len(sample_indices), mb, config.min_data_in_bin,
                 filter_cnt, pre_filter=config.feature_pre_filter,
                 bin_type=bin_type, use_missing=config.use_missing,
                 zero_as_missing=config.zero_as_missing,
-                forced_upper_bounds=forced_bounds.get(f, ())))
+                forced_upper_bounds=forced_bounds.get(f, ()))
+
+        local = [_bin_one(f) for f in range(f_lo, f_hi)]
+        if n_proc > 1:
+            # allgather the per-slice mappers (≡ Network::Allgather of the
+            # serialized BinMappers, dataset_loader.cpp:1221-1260)
+            import pickle
+
+            from jax.experimental import multihost_utils
+
+            blob = np.frombuffer(pickle.dumps(local), np.uint8)
+            lens = np.asarray(multihost_utils.process_allgather(
+                np.asarray([blob.size], np.int64))).reshape(-1)
+            buf = np.zeros(int(lens.max()), np.uint8)
+            buf[:blob.size] = blob
+            gathered = np.asarray(
+                multihost_utils.process_allgather(buf))
+            mappers = []
+            for r in range(n_proc):
+                mappers.extend(pickle.loads(
+                    gathered[r, :int(lens[r])].tobytes()))
+            assert len(mappers) == num_features
+        else:
+            mappers = local
         n_trivial = sum(m.is_trivial for m in mappers)
         if n_trivial:
             log.info(f"{n_trivial} trivial feature(s) removed")

@@ -42,6 +42,18 @@ def _parse_column_spec(spec: str, header_names: Optional[List[str]]) -> int:
     return int(spec)
 
 
+# Custom parser plugin registry (≡ ParserReflector,
+# ref: include/LightGBM/dataset.h:468 + parser_reflector member): a
+# plugin claims a file by content/extension and parses it itself.
+# register_parser(detect, parse) with detect(path, sample_lines) -> bool
+# and parse(lines) -> (X [n, f] float, label [n] or None).
+_PARSER_PLUGINS: List[Tuple] = []
+
+
+def register_parser(detect, parse) -> None:
+    _PARSER_PLUGINS.append((detect, parse))
+
+
 def load_svm_or_csv(path: str, config: Config
                     ) -> Tuple[np.ndarray, Optional[np.ndarray],
                                Optional[np.ndarray], Optional[np.ndarray]]:
@@ -58,6 +70,14 @@ def load_svm_or_csv(path: str, config: Config
     lines = [ln for ln in lines if ln.strip() != ""]
     if not lines:
         log.fatal(f"Data file {path} is empty")
+
+    for detect, parse in _PARSER_PLUGINS:
+        if detect(path, lines[:20]):
+            X, y = parse(lines)
+            X = np.asarray(X, np.float64)
+            y = None if y is None else np.asarray(y, np.float64)
+            weight, group = load_side_files(path, None, None)
+            return X, y, weight, group
 
     fmt = _detect_format(lines[:20])
     header_names: Optional[List[str]] = None

@@ -167,3 +167,40 @@ def test_stream_header_and_columns(rng, tmp_path):
     assert ds.feature_names == ["a", "b", "c"]
     np.testing.assert_allclose(ds.metadata.weight, w, rtol=1e-5)
     np.testing.assert_allclose(ds.metadata.label, y, rtol=1e-5)
+
+
+def test_parser_plugin_registry(tmp_path, rng):
+    """Custom parser plugins claim files by content (≡ ParserReflector,
+    ref: include/LightGBM/dataset.h:468)."""
+    from lightgbm_tpu.io import file_loader
+    import lightgbm_tpu as lgb
+
+    path = tmp_path / "data.custom"
+    n = 300
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] > 0).astype(float)
+    with open(path, "w") as f:
+        f.write("#CUSTOMv1\n")
+        for i in range(n):
+            f.write(";".join([str(y[i])] + [f"{v:.6f}" for v in X[i]])
+                    + "\n")
+
+    def detect(p, sample):
+        return sample and sample[0].startswith("#CUSTOMv1")
+
+    def parse(lines):
+        rows = [ln.split(";") for ln in lines[1:]]
+        a = np.asarray(rows, np.float64)
+        return a[:, 1:], a[:, 0]
+
+    file_loader._PARSER_PLUGINS.clear()
+    try:
+        file_loader.register_parser(detect, parse)
+        ds = lgb.Dataset(str(path))
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbose": -1, "min_data_in_leaf": 5}, ds,
+                        num_boost_round=5)
+        acc = np.mean((bst.predict(X) > 0.5) == y)
+        assert acc > 0.8
+    finally:
+        file_loader._PARSER_PLUGINS.clear()
