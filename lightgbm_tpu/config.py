@@ -225,6 +225,10 @@ _reg("tpu_hist_kernel", str, "auto", ())     # auto | einsum | scatter |
                                              # pallas (auto: einsum on TPU,
                                              #  scatter-add on CPU)
 _reg("tpu_row_scheduling", str, "compact", ())  # compact | full
+# sparse bin storage (≡ SparseBin/MultiValSparseBin, sparse_bin.hpp:858):
+# dense packs every cell; multival stores only nonzero bins row-wise
+# [R, K]; auto picks multival for sufficiently sparse scipy inputs
+_reg("tpu_sparse_storage", str, "auto", ())  # auto | dense | multival
 _reg("tpu_partition_mode", str, "scatter", ())  # scatter | sort
 _reg("tpu_min_bucket", int, 2048, ())        # smallest pow2 segment bucket
 _reg("tpu_use_pallas", bool, False, ())      # Pallas histogram kernel (off until tuned)

@@ -204,6 +204,9 @@ class BinnedDataset:
 
     def __init__(self) -> None:
         self.bins: Optional[np.ndarray] = None
+        # multi-value sparse storage: (idx [R, K], binv [R, K]) host
+        # arrays over USED features, or None (dense `bins` used instead)
+        self.bins_mv: Optional[tuple] = None
         self.bin_mappers: List[BinMapper] = []
         self.used_feature_map: np.ndarray = np.zeros(0, dtype=np.int32)
         self.num_data: int = 0
@@ -273,16 +276,57 @@ class BinnedDataset:
                 [i for i, m in enumerate(self.bin_mappers) if not m.is_trivial],
                 dtype=np.int32)
 
-        # quantize: feature-major u8/u16 matrix
+        # quantize: feature-major u8/u16 matrix, or row-wise multi-value
+        # sparse storage (≡ SparseBin/MultiValSparseBin,
+        # src/io/sparse_bin.hpp:858) when the source is sparse enough —
+        # only nonzero bins are stored, [R, K] with K = max nnz per row
         n_used = len(self.used_feature_map)
-        max_num_bin = max((self.bin_mappers[i].num_bin
-                           for i in self.used_feature_map), default=2)
-        dtype = np.uint8 if max_num_bin <= 256 else np.uint16
-        bins = np.empty((n_used, num_data), dtype=dtype)
-        for out_i, feat_i in enumerate(self.used_feature_map):
-            bins[out_i] = self.bin_mappers[feat_i].value_to_bin(
-                source.get_col(feat_i))
-        self.bins = bins
+        use_mv = False
+        if (isinstance(source, SparseColumns) and reference is None
+                and n_used >= 2):
+            mode = str(config.tpu_sparse_storage).lower()
+            if mode == "multival":
+                use_mv = True
+            elif mode == "auto":
+                nnz = source.csc.nnz
+                density = nnz / max(num_data * n_used, 1)
+                if density < 0.25 and n_used >= 32 and n_used <= 8192:
+                    # storage bytes/row: dense-after-EFB ~G (u8 groups)
+                    # vs multival ~8*K ([R,K] int32 id+bin pairs). Probe
+                    # bundleability on a row sample (find_bundles only
+                    # reads presence patterns) and pick the cheaper one —
+                    # one-hot-ish data stays dense for EFB, high-conflict
+                    # wide-sparse goes multival.
+                    from .bundling import find_bundles
+                    csr = source.csc.tocsr()
+                    K_max = int(np.diff(csr.indptr).max()) if nnz else 1
+                    S = min(num_data, 2000)
+                    rs = np.linspace(0, num_data - 1, S).astype(np.int64)
+                    sub = (csr[rs][:, self.used_feature_map] != 0)
+                    presence = np.asarray(sub.todense(), np.uint8).T
+                    nb_used = np.asarray(
+                        [self.bin_mappers[i].num_bin
+                         for i in self.used_feature_map], np.int64)
+                    probe = (find_bundles(presence, nb_used,
+                                          config.max_conflict_rate)
+                             if config.enable_bundle else None)
+                    G = probe.num_groups if probe is not None else n_used
+                    use_mv = 8 * max(K_max, 1) < G
+        if use_mv:
+            self.bins = None
+            self.bins_mv = cls._quantize_sparse(source, self.bin_mappers,
+                                                self.used_feature_map)
+            log.info(f"multi-value sparse bin storage: {n_used} features, "
+                     f"K={self.bins_mv[0].shape[1]} max nonzeros/row")
+        else:
+            max_num_bin = max((self.bin_mappers[i].num_bin
+                               for i in self.used_feature_map), default=2)
+            dtype = np.uint8 if max_num_bin <= 256 else np.uint16
+            bins = np.empty((n_used, num_data), dtype=dtype)
+            for out_i, feat_i in enumerate(self.used_feature_map):
+                bins[out_i] = self.bin_mappers[feat_i].value_to_bin(
+                    source.get_col(feat_i))
+            self.bins = bins
 
         if config.linear_tree:
             raw = source.to_dense_f32()
@@ -301,6 +345,38 @@ class BinnedDataset:
         meta.set_position(position)
         self.metadata = meta
         return self
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _quantize_sparse(source: "SparseColumns", bin_mappers,
+                         used_feature_map) -> tuple:
+        """Bin only the stored nonzeros of a sparse source into host
+        [R, K] (used-feature-id, bin) arrays (ref: sparse_bin.hpp Push /
+        multi_val_sparse_bin.hpp row-pointer layout). Absent entries ARE
+        each feature's default bin and are reconstructed at scan time."""
+        import scipy.sparse as sp
+        csc = source.csc
+        R = source.num_data
+        cols, rows_l, data_l = [], [], []
+        for out_i, feat_i in enumerate(used_feature_map):
+            lo, hi = csc.indptr[feat_i], csc.indptr[feat_i + 1]
+            r = csc.indices[lo:hi]
+            b = bin_mappers[feat_i].value_to_bin(
+                np.asarray(csc.data[lo:hi], np.float64))
+            rows_l.append(r)
+            cols.append(np.full(len(r), out_i, np.int32))
+            data_l.append(np.asarray(b, np.int32) + 1)  # +1: keep explicit
+        n_used = len(used_feature_map)
+        coo = sp.coo_matrix(
+            (np.concatenate(data_l) if data_l else np.zeros(0, np.int32),
+             (np.concatenate(rows_l) if rows_l else np.zeros(0, np.int64),
+              np.concatenate(cols) if cols else np.zeros(0, np.int64))),
+            shape=(R, n_used))
+        csr = coo.tocsr()
+        csr.data -= 1  # undo the keep-explicit offset
+        from ..ops.hist_multival import pack_csr_bins
+        sb = pack_csr_bins(csr, n_used)
+        return (np.asarray(sb.idx), np.asarray(sb.binv))
 
     # ------------------------------------------------------------------
     @staticmethod

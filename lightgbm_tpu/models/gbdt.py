@@ -346,6 +346,26 @@ class GBDT:
                        else f"only {avail} device(s) visible")
                 log.warning(f"tree_learner={tl} requested but {cap}; "
                             "running serial")
+        # ---- multi-value sparse storage (≡ SparseBin/MultiValSparseBin,
+        # sparse_bin.hpp:858): serial full-pass scatter histogram over the
+        # stored nonzeros; default-bin mass reconstructed at scan time
+        self._multival = train.bins_mv is not None
+        if self._multival:
+            fallback = []
+            if self._tree_learner != "serial":
+                fallback.append(f"tree_learner={self._tree_learner}")
+                self._tree_learner = "serial"
+            if self.grower_cfg.row_sched != "full":
+                fallback.append("tpu_row_scheduling=compact")
+            if self.grower_cfg.mc_method != "basic":
+                fallback.append("monotone intermediate")
+            if fallback:
+                log.warning("multi-value sparse storage runs the serial "
+                            "full-pass scheduler (basic monotone mode); "
+                            "overriding: " + ", ".join(fallback))
+            self.grower_cfg = dataclasses.replace(
+                self.grower_cfg, row_sched="full", mc_method="basic",
+                hist_backend="multival")
         self._compact = self.grower_cfg.row_sched == "compact"
 
         # ---- EFB bundling (ref: dataset.cpp:112 FindGroups) -----------
@@ -422,8 +442,27 @@ class GBDT:
                         "the budget; computing per-split child histograms "
                         "without a pool")
         self._setup_cegb(train)
+        self._bins_mv_dev = None
         if self.feature_meta is None:
             self._grow = None
+        elif self._multival:
+            from ..ops.hist_multival import (SparseBins,
+                                             make_default_bin_fix,
+                                             make_fetch_bin_column)
+            if forced is not None:
+                log.warning("forced splits are not supported with "
+                            "multi-value sparse storage; ignoring")
+                forced = None
+            idx_h, binv_h = train.bins_mv
+            self._bins_mv_dev = SparseBins(jnp.asarray(idx_h),
+                                           jnp.asarray(binv_h),
+                                           train.num_used_features)
+            dflt = np.asarray([m.default_bin for m in mappers], np.int32)
+            self._grow = jax.jit(make_tree_grower(
+                self.grower_cfg, self.feature_meta,
+                fetch_bin_column=make_fetch_bin_column(dflt),
+                prepare_split_hist=make_default_bin_fix(
+                    dflt, self.num_bin_max)))
         elif self._tree_learner == "serial":
             self._grow = jax.jit(
                 make_tree_grower(self.grower_cfg, self.feature_meta,
@@ -456,6 +495,8 @@ class GBDT:
     def _train_bins(self):
         """Bins array the grower trains on (layout depends on the learner;
         the distributed wrapper holds its own sharded copy)."""
+        if self._multival:
+            return self._bins_mv_dev
         if self._tree_learner != "serial":
             return None
         if self._compact:
@@ -467,8 +508,29 @@ class GBDT:
     @property
     def bins_dev(self):
         """Feature-major [F, R] device bins for traversal paths, lazily
-        materialized (training reads bins_rf / bins_sharded instead)."""
-        if self._bins_dev_cache is None and self._bins_fr_host is not None:
+        materialized (training reads bins_rf / bins_sharded instead).
+        With multi-value sparse storage the dense matrix is reconstructed
+        on demand — only rollback/DART/continued-training traversal needs
+        it, and it costs the dense footprint (warned once)."""
+        if self._bins_dev_cache is None and self._bins_fr_host is None \
+                and getattr(self, "_bins_mv_dev", None) is not None:
+            sb = self._bins_mv_dev
+            log.warning("densifying multi-value sparse bins for a "
+                        "traversal path (rollback/DART/continued "
+                        "training) — this costs the dense bin footprint")
+            idx = np.asarray(sb.idx)
+            binv = np.asarray(sb.binv)
+            F, R = sb.shape
+            dflt = np.asarray(
+                [m.default_bin for m in self.train_set.used_bin_mappers()],
+                np.int32)
+            dense = np.broadcast_to(dflt[:, None], (F, R)).copy()
+            valid = idx >= 0
+            rr = np.repeat(np.arange(R), idx.shape[1])[valid.reshape(-1)]
+            dense[idx[valid], rr] = binv[valid]
+            self._bins_dev_cache = jnp.asarray(dense)
+        elif (self._bins_dev_cache is None and
+                self._bins_fr_host is not None):
             self._bins_dev_cache = jnp.asarray(self._bins_fr_host)
         return self._bins_dev_cache
 

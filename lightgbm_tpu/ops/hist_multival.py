@@ -1,0 +1,115 @@
+"""Row-wise multi-value sparse bin storage + histogram kernel.
+
+TPU-native equivalent of the reference's MultiValSparseBin row-pointer
+storage and its ConstructHistograms scatter
+(ref: src/io/multi_val_sparse_bin.hpp:449, src/io/sparse_bin.hpp:858,
+src/treelearner/multi_val_bin_wrapper.cpp): a CSR matrix packs
+LOSSLESSLY into two static-shape [R, K] arrays (K = max nonzeros per
+row) of feature ids and bin values — the compiler-friendly reformulation
+of variable-length row pointers. Absent entries are each feature's
+default bin (the bin of 0.0) and are NOT stored; their histogram row is
+reconstructed from the leaf totals at scan time, exactly like EFB's
+FixHistogram (grower.py expand_hist).
+
+Memory: R*K*(4+4) bytes vs R*F bytes dense — wins whenever the density
+is below ~1/2 even against uint8 dense packing, and keeps the histogram
+pass O(R*K) instead of O(R*F).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+class SparseBins:
+    """Static-shape sparse binned matrix: idx [R, K] feature ids (-1
+    padding), binv [R, K] bin values. Presents the grower's expected
+    ``.shape == (F, R)`` so it can flow through make_tree_grower's
+    full-mode path untouched."""
+
+    def __init__(self, idx, binv, num_features: int):
+        self.idx = idx
+        self.binv = binv
+        self.num_features = int(num_features)
+
+    @property
+    def shape(self):
+        return (self.num_features, self.idx.shape[0])
+
+    def tree_flatten(self):
+        return (self.idx, self.binv), self.num_features
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+
+def pack_csr_bins(csr_bins, num_features: int) -> SparseBins:
+    """Pack a scipy CSR matrix of BIN VALUES (data = bin index per
+    stored nonzero, column = used-feature index) into [R, K] arrays."""
+    indptr = np.asarray(csr_bins.indptr)
+    counts = np.diff(indptr)
+    K = max(int(counts.max()) if counts.size else 1, 1)
+    R = csr_bins.shape[0]
+    idx = np.full((R, K), -1, np.int32)
+    binv = np.zeros((R, K), np.int32)
+    # vectorized ragged->padded: position of each nonzero within its row
+    rows = np.repeat(np.arange(R), counts)
+    pos = np.arange(len(rows)) - np.repeat(indptr[:-1], counts)
+    idx[rows, pos] = np.asarray(csr_bins.indices, np.int32)
+    binv[rows, pos] = np.asarray(csr_bins.data, np.int32)
+    return SparseBins(idx, binv, num_features)  # host arrays; jnp at use
+
+
+def hist_multival(sb: SparseBins, gh: jnp.ndarray,
+                  num_bin: int) -> jnp.ndarray:
+    """[F, B, C] histogram of the STORED entries by scatter-add.
+
+    The default-bin mass of each feature (rows where it is absent) is
+    intentionally missing — reconstructed at scan time from leaf totals
+    via make_default_bin_fix (≡ FixHistogram, feature_histogram.hpp).
+    int8 gh accumulates exactly in int32 (quantized-gradient path)."""
+    F = sb.num_features
+    valid = sb.idx >= 0
+    flat = jnp.where(valid, sb.idx * num_bin + sb.binv, F * num_bin)
+    acc_dtype = jnp.int32 if gh.dtype == jnp.int8 else gh.dtype
+    out = jnp.zeros((F * num_bin + 1, gh.shape[1]), acc_dtype)
+    out = out.at[flat].add(gh[:, None, :].astype(acc_dtype))
+    return out[:-1].reshape(F, num_bin, gh.shape[1])
+
+
+def make_fetch_bin_column(default_bin: np.ndarray):
+    """Partition-column accessor: bin of feature f per row, with absent
+    rows reading the feature's default bin (≡ SparseBin::SplitInner's
+    implicit-default routing, sparse_bin.hpp)."""
+    dflt = jnp.asarray(default_bin, jnp.int32)
+
+    def fetch(sb: SparseBins, f):
+        f = jnp.maximum(f, 0)
+        hit = sb.idx == f
+        present = jnp.any(hit, axis=1)
+        val = jnp.sum(jnp.where(hit, sb.binv, 0), axis=1)  # <=1 hit/row
+        return jnp.where(present, val, dflt[f]).astype(jnp.int32)
+
+    return fetch
+
+
+def make_default_bin_fix(default_bin: np.ndarray, num_bin: int):
+    """prepare_split_hist hook: add (leaf totals - stored mass) to each
+    feature's default-bin row (≡ FixHistogram; same algebra as EFB's
+    expand_hist default-bin reconstruction)."""
+    dmask = (np.arange(num_bin)[None, :] ==
+             np.asarray(default_bin)[:, None])
+    dmask_j = jnp.asarray(dmask)
+
+    def prepare(hist, ctx, feature_mask=None):
+        sg, sh, cnt, _ = ctx
+        totals = jnp.stack([sg, sh, cnt])                  # [3]
+        rest = hist.sum(axis=1)                            # [F, 3]
+        fixed = hist + dmask_j[..., None] * (totals[None, None, :] -
+                                             rest[:, None, :])
+        return fixed, None
+
+    return prepare

@@ -1,0 +1,84 @@
+"""Multi-value sparse bin storage (≡ SparseBin/MultiValSparseBin,
+ref: src/io/sparse_bin.hpp:858, multi_val_sparse_bin.hpp:449): the
+[R, K] nonzero packing must reproduce the dense path's model EXACTLY —
+the stored-bins histogram plus default-bin reconstruction is the same
+algebra, so splits are identical."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+scipy_sparse = pytest.importorskip("scipy.sparse")
+
+
+def _sparse_data(rng, n=900, f=40, density=0.08):
+    X = np.zeros((n, f))
+    mask = rng.uniform(size=(n, f)) < density
+    X[mask] = rng.normal(size=int(mask.sum())) + 1.0  # nonzero values
+    y = (X[:, 0] - X[:, 1] + 0.5 * X[:, 2] > 0).astype(np.float64)
+    return X, y
+
+
+def _train(X, y, params, rounds=10):
+    p = {"verbose": -1, "num_leaves": 15, "min_data_in_leaf": 5,
+         "seed": 3}
+    p.update(params)
+    return lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=rounds)
+
+
+@pytest.mark.parametrize("objective", ["binary", "regression"])
+def test_multival_matches_dense(rng, objective):
+    X, y = _sparse_data(rng)
+    sp_mat = scipy_sparse.csr_matrix(X)
+    dense = _train(X, y, {"objective": objective,
+                          "tpu_sparse_storage": "dense",
+                          "enable_bundle": False})
+    mv = _train(sp_mat, y, {"objective": objective,
+                            "tpu_sparse_storage": "multival"})
+    # identical splits; leaf values drift by f32 accumulation order
+    # (scatter-add vs einsum)
+    np.testing.assert_allclose(mv.predict(X), dense.predict(X),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_multival_auto_engages(rng):
+    # high-conflict wide-sparse: bundling fails (random co-occurrence),
+    # multival storage is ~8*K bytes/row vs F dense -> auto picks it
+    X, y = _sparse_data(rng, n=3000, f=1000, density=0.08)
+    sp_mat = scipy_sparse.csr_matrix(X)
+    bst = _train(sp_mat, y, {"objective": "binary"})
+    assert bst._engine._multival, \
+        "auto mode should pick multival for high-conflict 8%-dense F=1000"
+    ds = bst._engine.train_set
+    assert ds.bins is None and ds.bins_mv is not None
+    # K is bounded by the densest row, far below F
+    assert ds.bins_mv[0].shape[1] < 130  # K = densest row, far below F
+    acc = np.mean((bst.predict(X) > 0.5) == y)
+    assert acc > 0.85
+
+
+def test_multival_quantized(rng):
+    """int8 gradients scatter-accumulate exactly in int32 over the
+    stored nonzeros."""
+    X, y = _sparse_data(rng)
+    sp_mat = scipy_sparse.csr_matrix(X)
+    q = {"objective": "binary", "use_quantized_grad": True,
+         "stochastic_rounding": False, "tpu_sparse_storage": "multival"}
+    mv = _train(sp_mat, y, q)
+    dense = _train(X, y, {**q, "tpu_sparse_storage": "dense",
+                          "enable_bundle": False})
+    np.testing.assert_allclose(mv.predict(X), dense.predict(X),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_multival_monotone_and_sampling(rng):
+    X, y = _sparse_data(rng)
+    sp_mat = scipy_sparse.csr_matrix(X)
+    mono = [1] + [0] * (X.shape[1] - 1)
+    bst = _train(sp_mat, y, {"objective": "binary",
+                             "tpu_sparse_storage": "multival",
+                             "monotone_constraints": mono,
+                             "feature_fraction": 0.8,
+                             "bagging_fraction": 0.7, "bagging_freq": 1})
+    acc = np.mean((bst.predict(X) > 0.5) == y)
+    assert acc > 0.8
