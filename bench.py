@@ -85,6 +85,19 @@ def run_child(sched: str) -> None:
         "tpu_row_scheduling": sched,
     }
     ds = lgb.Dataset(X, label=y)
+    if os.environ.get("BENCH_PROBE_COMPILE", "1") == "1":
+        # staged compile: a num_leaves-reduced program at the full data
+        # shape first, so a compiler that chokes on the 255-leaf program
+        # fails fast (and cheap) instead of wedging the full compile
+        # (round-1/2 postmortem: oversized remote compiles stalled)
+        t0 = time.perf_counter()
+        probe_b = lgb.Booster(dict(params, num_leaves=31), ds)
+        probe_b.update()
+        import jax
+        jax.block_until_ready(probe_b._engine.score)
+        print(f"[bench] 31-leaf probe compile+step ok "
+              f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
+        del probe_b
     booster = lgb.Booster(params, ds)
     for _ in range(WARMUP_ITERS):      # compile + cache warm
         booster.update()

@@ -275,9 +275,14 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     # the leaf totals.
     bundled = bundle is not None
     if bundled:
-        if distributed:
-            raise ValueError("EFB bundling does not compose with "
-                             "distributed learner hooks yet")
+        # EFB composes with data-parallel (group hists psum across row
+        # shards; the scan-time expansion is replicated). Voting ranks
+        # per-LOGICAL-feature gains on the local hist and feature-
+        # parallel shards logical columns — both incompatible with the
+        # physical-group layout.
+        if has_scan_hooks or feat_sharded:
+            raise ValueError("EFB bundling does not compose with the "
+                             "voting/feature learners")
         b_gmap = jnp.asarray(bundle["gather_map"], jnp.int32)     # [F, B]
         b_group = jnp.asarray(bundle["group"], jnp.int32)         # [F]
         b_offset = jnp.asarray(bundle["offset"], jnp.int32)       # [F]

@@ -378,7 +378,8 @@ class GBDT:
             # num_bin_max / runs the O(F*R) conflict scan
             log.warning("forced splits with EFB bundling are untested; "
                         "disabling bundling")
-        elif (cfg.enable_bundle and self._tree_learner == "serial" and
+        elif (cfg.enable_bundle and
+                self._tree_learner in ("serial", "data") and
                 train.bins is not None and train.num_used_features > 1):
             from ..io.bundling import find_bundles, pack_bins
             nb_used = np.asarray([m.num_bin for m in mappers], np.int64)
@@ -468,7 +469,7 @@ class GBDT:
                 make_tree_grower(self.grower_cfg, self.feature_meta,
                                  forced=forced, bundle=self._bundle))
         else:
-            self._setup_distributed(train, forced)
+            self._setup_distributed(train, forced, train_bins_host)
 
         # jitted gradient fn (device-resident labels/weights in the closure)
         self._pos_bias = False
@@ -535,7 +536,8 @@ class GBDT:
         return self._bins_dev_cache
 
     # ------------------------------------------------------------------
-    def _setup_distributed(self, train: BinnedDataset, forced) -> None:
+    def _setup_distributed(self, train: BinnedDataset, forced,
+                           bins_host=None) -> None:
         """Build the mesh + sharded grower for tree_learner=data/voting/
         feature (ref: parallel_tree_learner.h — the learners are drop-in
         replacements under the unchanged boosting loop; SURVEY.md §3.3).
@@ -565,11 +567,13 @@ class GBDT:
             log.fatal("interaction_constraints are not supported with "
                       "tree_learner=feature")
 
+        if bins_host is None:
+            bins_host = train.bins
         if tl in ("data", "voting"):
             mesh = build_mesh(n_dev, axis_names=(DATA_AXIS,))
             R_pad = padded_rows(N, n_dev)
             self._row_pad = R_pad - N
-            bins = train.bins
+            bins = bins_host  # EFB-packed groups when bundling engaged
             if self._row_pad:
                 bins = np.pad(bins, ((0, 0), (0, self._row_pad)))
             if self._compact:
@@ -582,7 +586,8 @@ class GBDT:
                     bins, NamedSharding(mesh, P(None, DATA_AXIS)))
             if tl == "data":
                 grow = make_data_parallel_grower(
-                    self.grower_cfg, self.feature_meta, mesh, forced=forced)
+                    self.grower_cfg, self.feature_meta, mesh, forced=forced,
+                    bundle=self._bundle)
             else:
                 grow = make_voting_parallel_grower(
                     self.grower_cfg, self.feature_meta, mesh,
@@ -592,7 +597,7 @@ class GBDT:
             mesh = build_mesh(n_dev, axis_names=(FEATURE_AXIS,))
             Fp = padded_features(F, n_dev)
             self._feat_pad = Fp - F
-            bins = train.bins
+            bins = bins_host
             if self._feat_pad:
                 bins = np.pad(bins, ((0, self._feat_pad), (0, 0)))
             if self._compact:

@@ -52,7 +52,7 @@ from .mesh import DATA_AXIS
 
 def make_data_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
                               mesh: Mesh, data_axis: str = DATA_AXIS,
-                              forced=None):
+                              forced=None, bundle=None):
     """Build `grow(bins_t, gh, feature_mask, cegb) -> (TreeArrays, leaf_id)`
     where `bins_t` [F, R] and `gh` [R, 3] are sharded over `data_axis` on
     their row dimension; R must be divisible by the axis size (pad upstream
@@ -70,7 +70,7 @@ def make_data_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
         reduce_max=lambda x: lax.pmax(x, data_axis),
         localize_key=lambda k: jax.random.fold_in(
             k, lax.axis_index(data_axis)),
-        forced=forced)
+        forced=forced, bundle=bundle)
 
     def wrapped(bins_t, gh, feature_mask, cegb_const, cegb_count, rng_key):
         return grow(bins_t, gh, feature_mask, (cegb_const, cegb_count),

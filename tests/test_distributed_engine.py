@@ -173,3 +173,32 @@ def test_distributed_extra_trees(rng):
                                atol=5e-2)
     acc = np.mean((dist.predict(X) > 0.5) == y)
     assert acc > 0.8
+
+
+def test_distributed_efb_bundling(rng):
+    """EFB composes with data-parallel: group histograms psum across row
+    shards, the scan-time logical expansion is replicated, so the model
+    matches serial EFB training."""
+    n, groups, width = 64 * len(jax.devices()) + 13, 12, 8
+    f = groups * width
+    cat = rng.integers(0, width + 2, size=(n, groups))
+    rr, gg = np.nonzero(cat < width)
+    X = np.zeros((n, f))
+    X[rr, gg * width + cat[rr, gg]] = 1.0
+    y = (X[:, 0] + X[:, 8] - X[:, 16] +
+         0.2 * rng.normal(size=n) > 0).astype(np.float64)
+    preds = {}
+    boosters = {}
+    for tl in ("serial", "data"):
+        params = {"objective": "binary", "num_leaves": 15,
+                  "min_data_in_leaf": 5, "verbose": -1,
+                  "enable_bundle": True, "tree_learner": tl}
+        bst = lgb.train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=6)
+        boosters[tl] = bst
+        preds[tl] = bst.predict(X)
+    # bundling actually engaged on both paths
+    assert boosters["serial"]._engine._bundle is not None
+    assert boosters["data"]._engine._bundle is not None
+    np.testing.assert_allclose(preds["data"], preds["serial"],
+                               rtol=1e-4, atol=1e-5)
