@@ -233,11 +233,21 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     B = cfg.num_bin
     hist_fn = make_hist_fn(cfg.hist_backend, B, cfg.block_rows)
     compact = cfg.row_sched == "compact"
+    # multi-value sparse storage: bins are a SparseBins [R, K] pytree;
+    # histograms scatter only stored nonzeros (O(rows*K)) and compact
+    # gathers its leaf segments from the same layout
+    mv_mode = cfg.hist_backend == "multival"
     if compact:
-        hist_rm = functools.partial(hist_rowmajor, num_bin=B,
-                                    block_rows=cfg.block_rows,
-                                    dtype=cfg.hist_dtype,
-                                    backend=cfg.hist_rm_backend)
+        if mv_mode:
+            from ..ops.hist_multival import hist_multival as _hist_mv
+
+            def hist_rm(sb, ghv):
+                return _hist_mv(sb, ghv, B)
+        else:
+            hist_rm = functools.partial(hist_rowmajor, num_bin=B,
+                                        block_rows=cfg.block_rows,
+                                        dtype=cfg.hist_dtype,
+                                        backend=cfg.hist_rm_backend)
     # Distributed mode: collectives (psum over the mesh's data axis) must
     # not sit inside divergent control flow. In full mode the per-split
     # histogram pass is masked instead of branched; in compact mode the
@@ -386,11 +396,12 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         # full mode takes feature-major [F, R] bins; compact mode takes
         # ROW-major [R, F] (the gather-friendly layout). With EFB the
         # stored columns are PHYSICAL bundles (Fp) while masks/paths/the
-        # split scan stay per LOGICAL feature (F).
-        if compact:
-            R, Fp = bins_t.shape
-        else:
+        # split scan stay per LOGICAL feature (F). SparseBins reports
+        # (F, R) in either mode (its layout is row-major by nature).
+        if mv_mode or not compact:
             Fp, R = bins_t.shape
+        else:
+            R, Fp = bins_t.shape
         F = int(meta.num_bin.shape[0]) if bundled else Fp
 
         if quantized:
@@ -428,7 +439,9 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         if compact:
             sizes = _bucket_sizes(R, cfg.min_bucket)
             sizes_arr = jnp.asarray(sizes, jnp.int32)
-            flat_ok = R * Fp < 2 ** 31
+            # feat_sharded/multival partitions read the fetched column
+            # vector instead of the bins matrix
+            flat_ok = R * Fp < 2 ** 31 and not feat_sharded
             bins_flat = bins_t.reshape(-1) if flat_ok else None
 
             def bucket_branch(n):
@@ -491,11 +504,17 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             def make_histb(S):
                 def hb(order, start, rows, ghv):
                     """O(rows_in_leaf) histogram over the gathered segment
-                    (≡ indexed Bin::ConstructHistogram, dense_bin.hpp)."""
+                    (≡ indexed Bin::ConstructHistogram, dense_bin.hpp;
+                    multival: O(rows_in_leaf * K) over stored nonzeros,
+                    ≡ multi_val_sparse_bin.hpp ConstructHistogram)."""
                     start_c = jnp.clip(start, 0, max(R - S, 0))
                     delta = start - start_c
                     idx = lax.dynamic_slice(order, (start_c,), (S,))
-                    blk = jnp.take(bins_t, idx, axis=0)
+                    if mv_mode:
+                        from ..ops.hist_multival import take_rows
+                        blk = take_rows(bins_t, idx)
+                    else:
+                        blk = jnp.take(bins_t, idx, axis=0)
                     ghg = jnp.take(ghv, idx, axis=0)
                     pos = jnp.arange(S, dtype=jnp.int32)
                     w = ((pos >= delta) &

@@ -355,16 +355,14 @@ class GBDT:
             if self._tree_learner != "serial":
                 fallback.append(f"tree_learner={self._tree_learner}")
                 self._tree_learner = "serial"
-            if self.grower_cfg.row_sched != "full":
-                fallback.append("tpu_row_scheduling=compact")
             if self.grower_cfg.mc_method != "basic":
                 fallback.append("monotone intermediate")
             if fallback:
-                log.warning("multi-value sparse storage runs the serial "
-                            "full-pass scheduler (basic monotone mode); "
-                            "overriding: " + ", ".join(fallback))
+                log.warning("multi-value sparse storage is serial-only "
+                            "with basic monotone mode; overriding: "
+                            + ", ".join(fallback))
             self.grower_cfg = dataclasses.replace(
-                self.grower_cfg, row_sched="full", mc_method="basic",
+                self.grower_cfg, mc_method="basic",
                 hist_backend="multival")
         self._compact = self.grower_cfg.row_sched == "compact"
 

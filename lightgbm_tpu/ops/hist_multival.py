@@ -113,3 +113,9 @@ def make_default_bin_fix(default_bin: np.ndarray, num_bin: int):
         return fixed, None
 
     return prepare
+
+
+def take_rows(sb: SparseBins, idx) -> SparseBins:
+    """Gather a row block (the compact scheduler's leaf segment)."""
+    return SparseBins(jnp.take(sb.idx, idx, axis=0),
+                      jnp.take(sb.binv, idx, axis=0), sb.num_features)

@@ -26,15 +26,17 @@ def _train(X, y, params, rounds=10):
     return lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=rounds)
 
 
+@pytest.mark.parametrize("sched", ["compact", "full"])
 @pytest.mark.parametrize("objective", ["binary", "regression"])
-def test_multival_matches_dense(rng, objective):
+def test_multival_matches_dense(rng, objective, sched):
     X, y = _sparse_data(rng)
     sp_mat = scipy_sparse.csr_matrix(X)
     dense = _train(X, y, {"objective": objective,
                           "tpu_sparse_storage": "dense",
                           "enable_bundle": False})
     mv = _train(sp_mat, y, {"objective": objective,
-                            "tpu_sparse_storage": "multival"})
+                            "tpu_sparse_storage": "multival",
+                            "tpu_row_scheduling": sched})
     # identical splits; leaf values drift by f32 accumulation order
     # (scatter-add vs einsum)
     np.testing.assert_allclose(mv.predict(X), dense.predict(X),
