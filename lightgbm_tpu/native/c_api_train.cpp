@@ -279,6 +279,36 @@ int LGBM_DatasetFree(void* handle) {
   return rc;
 }
 
+int LGBM_DatasetCreateFromFile(const char* filename,
+                               const char* parameters,
+                               const void* reference, void** out) {
+  (void)reference;
+  if (!filename || !out) {
+    LgbmTrainSetError("DatasetCreateFromFile: null argument");
+    return -1;
+  }
+  TrainHandle* h = NewHandle(false);
+  std::string body =
+      "from lightgbm_tpu.io.file_loader import load_svm_or_csv\n"
+      "from lightgbm_tpu.config import Config\n"
+      "p = dict(kv.split('=', 1) for kv in " + PyStr(parameters) +
+      ".replace(',', ' ').split() if '=' in kv)\n"
+      "X, y, w, g = load_svm_or_csv(" + PyStr(filename) +
+      ", Config(dict(p)))\n"
+      "fl = {}\n"
+      "if y is not None: fl['label'] = y\n"
+      "if w is not None: fl['weight'] = w\n"
+      "if g is not None: fl['group'] = g\n"
+      "_lgbm_capi['obj'][" + std::to_string(h->id) + "] = "
+      "{'X': X, 'params': p, 'fields': fl}\n";
+  if (RunGuarded(body) != 0) {
+    DropHandle(h);
+    return -1;
+  }
+  *out = h;
+  return 0;
+}
+
 int LGBM_BoosterCreate(void* train_data, const char* parameters,
                        void** out) {
   TrainHandle* d = AsTrainHandle(train_data);
@@ -323,6 +353,52 @@ int LGBM_BoosterUpdateOneIter(void* handle, int* is_finished) {
       "b['finished'] = bool(fin)\n" +
       "_ct.c_int.from_address(" + Addr(is_finished) +
       ").value = 1 if fin else 0\n";
+  return RunGuarded(body);
+}
+
+int LGBM_BoosterAddValidData(void* handle, void* valid_data) {
+  TrainHandle* h = AsTrainHandle(handle);
+  TrainHandle* d = AsTrainHandle(valid_data);
+  if (!h || !h->is_booster || !d || d->is_booster) {
+    LgbmTrainSetError("BoosterAddValidData: bad handle(s)");
+    return -1;
+  }
+  std::string body =
+      "v = _lgbm_capi['obj'][" + std::to_string(d->id) + "]\n" +
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n" +
+      "fl = v['fields']\n" +
+      "grp = fl.get('group')\n" +
+      "if grp is not None and grp.dtype != _np.int32:\n" +
+      "    grp = grp.astype(_np.int32)\n" +
+      "ds = _lgb.Dataset(v['X'], label=fl.get('label'), "
+      "weight=fl.get('weight'), group=grp, "
+      "reference=b['booster'].train_set)\n" +
+      "b['booster'].add_valid(ds, 'valid_' + str(len(b.setdefault("
+      "'valids', [])) ))\n" +
+      "b['valids'].append(ds)\n";
+  return RunGuarded(body);
+}
+
+int LGBM_BoosterGetEval(void* handle, int data_idx, int* out_len,
+                        double* out_results) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !out_len || !out_results) {
+    LgbmTrainSetError("BoosterGetEval: not a training Booster handle");
+    return -1;
+  }
+  std::string body =
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
+      "res = (b.eval_train() if " + std::to_string(data_idx) +
+      " == 0 else b.eval_valid())\n" +
+      "want = " + std::to_string(data_idx) + "\n" +
+      "vals = [r[2] for r in res if want == 0 or "
+      "r[0] == 'valid_' + str(want - 1) or r[0].startswith('valid')]\n" +
+      "a = _np.asarray(vals, _np.float64)\n" +
+      "_ct.c_int.from_address(" + Addr(out_len) +
+      ").value = a.size\n" +
+      "if a.size:\n" +
+      "    _ct.memmove(" + Addr(out_results) +
+      ", a.ctypes.data, a.size * 8)\n";
   return RunGuarded(body);
 }
 

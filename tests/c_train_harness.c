@@ -30,6 +30,10 @@ extern int LGBM_BoosterPredictForMat(void*, const void*, int, int32_t,
                                      const char*, int64_t*, double*);
 extern int LGBM_BoosterFree(void*);
 extern int LGBM_BoosterCreateFromModelfile(const char*, int*, void**);
+extern int LGBM_BoosterAddValidData(void*, void*);
+extern int LGBM_BoosterGetEval(void*, int, int*, double*);
+extern int LGBM_DatasetCreateFromFile(const char*, const char*,
+                                      const void*, void**);
 
 #define CHECK(call)                                                   \
   do {                                                                \
@@ -70,11 +74,23 @@ int main(int argc, char** argv) {
   CHECK(LGBM_BoosterCreate(
       ds,
       "objective=regression num_leaves=15 min_data_in_leaf=5 "
-      "verbosity=-1 device_type=cpu",
+      "verbosity=-1 device_type=cpu metric=l2",
       &bst));
+  /* validation data: reuse the training rows (eval wiring check) */
+  void* vds = NULL;
+  CHECK(LGBM_DatasetCreateFromMat(X, 1, n, f, 1, "", NULL, &vds));
+  CHECK(LGBM_DatasetSetField(vds, "label", y, n, 0));
+  CHECK(LGBM_BoosterAddValidData(bst, vds));
   int finished = 0;
   for (int it = 0; it < rounds && !finished; ++it)
     CHECK(LGBM_BoosterUpdateOneIter(bst, &finished));
+  double evals[8];
+  int n_eval = 0;
+  CHECK(LGBM_BoosterGetEval(bst, 1, &n_eval, evals));
+  if (n_eval < 1 || !(evals[0] >= 0)) {
+    fprintf(stderr, "FAIL: GetEval n=%d v=%g\n", n_eval, evals[0]);
+    return 1;
+  }
   int cur = 0;
   CHECK(LGBM_BoosterGetCurrentIteration(bst, &cur));
   if (cur < 1) {
@@ -123,6 +139,26 @@ int main(int argc, char** argv) {
     return 1;
   }
 
+  /* file-based dataset creation (label-first CSV, the CLI layout) */
+  char csv_path[512];
+  snprintf(csv_path, sizeof(csv_path), "%s.csv", model_path);
+  FILE* fp = fopen(csv_path, "w");
+  for (int i = 0; i < 200; ++i) {
+    fprintf(fp, "%g", (double)y[i]);
+    for (int j = 0; j < f; ++j) fprintf(fp, ",%g", X[i * f + j]);
+    fprintf(fp, "\n");
+  }
+  fclose(fp);
+  void* fds = NULL;
+  CHECK(LGBM_DatasetCreateFromFile(csv_path, "", NULL, &fds));
+  int32_t fn = 0;
+  CHECK(LGBM_DatasetGetNumData(fds, &fn));
+  if (fn != 200) {
+    fprintf(stderr, "FAIL: file dataset rows %d\n", fn);
+    return 1;
+  }
+  CHECK(LGBM_DatasetFree(fds));
+  CHECK(LGBM_DatasetFree(vds));
   CHECK(LGBM_BoosterFree(srv));
   CHECK(LGBM_BoosterFree(bst));
   CHECK(LGBM_DatasetFree(ds));
