@@ -355,7 +355,8 @@ class GBDT:
             if self._tree_learner != "serial":
                 fallback.append(f"tree_learner={self._tree_learner}")
                 self._tree_learner = "serial"
-            if self.grower_cfg.mc_method != "basic":
+            if self.grower_cfg.mc_method != "basic" and \
+                    monotone is not None:
                 fallback.append("monotone intermediate")
             if fallback:
                 log.warning("multi-value sparse storage is serial-only "

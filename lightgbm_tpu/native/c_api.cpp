@@ -382,8 +382,9 @@ int LgbmTrainBoosterIntProp(void* handle, const char* prop, int* out);
 int LgbmTrainBoosterPredictForMat(void* handle, const void* data,
                                   int data_type, int32_t nrow,
                                   int32_t ncol, int is_row_major,
-                                  int predict_type, int num_iteration,
-                                  int64_t* out_len, double* out_result);
+                                  int predict_type, int start_iteration,
+                                  int num_iteration, int64_t* out_len,
+                                  double* out_result);
 
 int LGBM_BoosterCreateFromModelfile(const char* filename,
                                     int* out_num_iterations,
@@ -465,8 +466,8 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
   if (LgbmTrainOwns(handle))
     return LgbmTrainBoosterPredictForMat(handle, data, data_type, nrow,
                                          ncol, is_row_major, predict_type,
-                                         num_iteration, out_len,
-                                         out_result);
+                                         start_iteration, num_iteration,
+                                         out_len, out_result);
   Model* m = static_cast<Model*>(handle);
   if (data_type != 0 && data_type != 1) {
     SetError("only float32 (0) / float64 (1) data are supported");

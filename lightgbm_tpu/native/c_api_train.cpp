@@ -196,6 +196,11 @@ int LGBM_DatasetCreateFromMat(const void* data, int data_type,
     return -1;
   }
   // C_API_DTYPE_FLOAT32 = 0, C_API_DTYPE_FLOAT64 = 1 (ref: c_api.h:33)
+  if (data_type != 0 && data_type != 1) {
+    LgbmTrainSetError("DatasetCreateFromMat: only float32 (0) / "
+                      "float64 (1) data are supported");
+    return -1;
+  }
   const char* ct = data_type == 0 ? "_ct.c_float" : "_ct.c_double";
   TrainHandle* h = NewHandle(false);
   char idbuf[32];
@@ -206,8 +211,8 @@ int LGBM_DatasetCreateFromMat(const void* data, int data_type,
       std::to_string(ncol) + "\n" +
       "buf = (" + ct + " * (n * f)).from_address(" + Addr(data) + ")\n" +
       "a = _np.ctypeslib.as_array(buf).astype(_np.float64).copy()\n" +
-      "a = a.reshape(n, f)" +
-      (is_row_major ? "\n" : " if False else a.reshape(f, n).T.copy()\n") +
+      (is_row_major ? "a = a.reshape(n, f)\n"
+                    : "a = a.reshape(f, n).T.copy()\n") +
       "p = dict(kv.split('=', 1) for kv in " + PyStr(parameters) +
       ".replace(',', ' ').split() if '=' in kv)\n" +
       "_lgbm_capi['obj'][" + idbuf + "] = {'X': a, 'params': p, "
@@ -392,7 +397,7 @@ int LGBM_BoosterGetEval(void* handle, int data_idx, int* out_len,
       " == 0 else b.eval_valid())\n" +
       "want = " + std::to_string(data_idx) + "\n" +
       "vals = [r[2] for r in res if want == 0 or "
-      "r[0] == 'valid_' + str(want - 1) or r[0].startswith('valid')]\n" +
+      "r[0] == 'valid_' + str(want - 1)]\n" +
       "a = _np.asarray(vals, _np.float64)\n" +
       "_ct.c_int.from_address(" + Addr(out_len) +
       ").value = a.size\n" +
@@ -410,11 +415,13 @@ int LGBM_BoosterSaveModel(void* handle, int start_iteration,
     LgbmTrainSetError("BoosterSaveModel: not a training Booster handle");
     return -1;
   }
-  (void)start_iteration;
   std::string body =
       "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
       "b.save_model(" + PyStr(filename) + ", num_iteration=" +
       (num_iteration > 0 ? std::to_string(num_iteration) : "None") +
+      ", start_iteration=" + std::to_string(start_iteration > 0
+                                                ? start_iteration
+                                                : 0) +
       ", importance_type=" +
       (feature_importance_type == 1 ? "'gain'" : "'split'") + ")\n";
   return RunGuarded(body);
@@ -445,10 +452,16 @@ int LgbmTrainBoosterIntProp(void* handle, const char* prop, int* out) {
 int LgbmTrainBoosterPredictForMat(void* handle, const void* data,
                                   int data_type, int32_t nrow,
                                   int32_t ncol, int is_row_major,
-                                  int predict_type, int num_iteration,
-                                  int64_t* out_len, double* out_result) {
+                                  int predict_type, int start_iteration,
+                                  int num_iteration, int64_t* out_len,
+                                  double* out_result) {
   TrainHandle* h = AsTrainHandle(handle);
   if (!h || !h->is_booster || !out_len || !out_result) return -1;
+  if (data_type != 0 && data_type != 1) {
+    LgbmTrainSetError("PredictForMat: only float32 (0) / float64 (1) "
+                      "data are supported");
+    return -1;
+  }
   const char* ct = data_type == 0 ? "_ct.c_float" : "_ct.c_double";
   // C_API_PREDICT_NORMAL=0 RAW_SCORE=1 LEAF_INDEX=2 CONTRIB=3
   std::string kw = predict_type == 1   ? "raw_score=True"
@@ -460,10 +473,12 @@ int LgbmTrainBoosterPredictForMat(void* handle, const void* data,
       std::to_string(ncol) + "\n" +
       "buf = (" + ct + " * (n * f)).from_address(" + Addr(data) + ")\n" +
       "a = _np.ctypeslib.as_array(buf).astype(_np.float64).copy()\n" +
-      "a = a.reshape(n, f)" +
-      (is_row_major ? "\n" : " if False else a.reshape(f, n).T.copy()\n") +
+      (is_row_major ? "a = a.reshape(n, f)\n"
+                    : "a = a.reshape(f, n).T.copy()\n") +
       "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
       "pred = _np.ascontiguousarray(b.predict(a" +
+      ", start_iteration=" + std::to_string(
+          start_iteration > 0 ? start_iteration : 0) +
       (num_iteration > 0
            ? ", num_iteration=" + std::to_string(num_iteration)
            : "") +
