@@ -506,6 +506,10 @@ class BinnedDataset:
         """Row-subset copy (ref: Dataset::CopySubrow) — used by cv()."""
         out = BinnedDataset()
         out.bins = self.bins[:, row_indices] if self.bins is not None else None
+        if self.bins_mv is not None:
+            # multi-value storage is row-major: subsetting is a row gather
+            out.bins_mv = (self.bins_mv[0][row_indices],
+                           self.bins_mv[1][row_indices])
         out.raw = self.raw[row_indices] if self.raw is not None else None
         out.bin_mappers = self.bin_mappers
         out.used_feature_map = self.used_feature_map

@@ -111,7 +111,17 @@ class _ValidData:
         self.dataset = dataset
         self.metrics = metrics
         self.name = name
-        self.bins_dev = jnp.asarray(dataset.bins)
+        if dataset.bins is None and dataset.bins_mv is not None:
+            # valid-set eval traverses feature-major dense bins; densify
+            # the multi-value packing (valid folds are the smaller side)
+            from ..ops.hist_multival import densify
+            dflt = np.asarray([m.default_bin
+                               for m in dataset.used_bin_mappers()],
+                              np.int32)
+            self.bins_dev = jnp.asarray(
+                densify(dataset.bins_mv[0], dataset.bins_mv[1], dflt))
+        else:
+            self.bins_dev = jnp.asarray(dataset.bins)
         self.score = jnp.zeros((num_class, dataset.num_data), jnp.float32)
         if dataset.metadata.init_score is not None:
             init = dataset.metadata.init_score.reshape(
@@ -514,21 +524,16 @@ class GBDT:
         it, and it costs the dense footprint (warned once)."""
         if self._bins_dev_cache is None and self._bins_fr_host is None \
                 and getattr(self, "_bins_mv_dev", None) is not None:
+            from ..ops.hist_multival import densify
             sb = self._bins_mv_dev
             log.warning("densifying multi-value sparse bins for a "
                         "traversal path (rollback/DART/continued "
                         "training) — this costs the dense bin footprint")
-            idx = np.asarray(sb.idx)
-            binv = np.asarray(sb.binv)
-            F, R = sb.shape
             dflt = np.asarray(
                 [m.default_bin for m in self.train_set.used_bin_mappers()],
                 np.int32)
-            dense = np.broadcast_to(dflt[:, None], (F, R)).copy()
-            valid = idx >= 0
-            rr = np.repeat(np.arange(R), idx.shape[1])[valid.reshape(-1)]
-            dense[idx[valid], rr] = binv[valid]
-            self._bins_dev_cache = jnp.asarray(dense)
+            self._bins_dev_cache = jnp.asarray(
+                densify(sb.idx, sb.binv, dflt))
         elif (self._bins_dev_cache is None and
                 self._bins_fr_host is not None):
             self._bins_dev_cache = jnp.asarray(self._bins_fr_host)

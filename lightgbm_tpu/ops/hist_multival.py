@@ -119,3 +119,19 @@ def take_rows(sb: SparseBins, idx) -> SparseBins:
     """Gather a row block (the compact scheduler's leaf segment)."""
     return SparseBins(jnp.take(sb.idx, idx, axis=0),
                       jnp.take(sb.binv, idx, axis=0), sb.num_features)
+
+
+def densify(idx: np.ndarray, binv: np.ndarray,
+            default_bin: np.ndarray) -> np.ndarray:
+    """[F, R] dense bins from the [R, K] packing (traversal/valid-eval
+    paths that want the feature-major layout; costs the dense footprint)."""
+    idx = np.asarray(idx)
+    binv = np.asarray(binv)
+    R, K = idx.shape
+    F = len(default_bin)
+    dense = np.broadcast_to(
+        np.asarray(default_bin, np.int32)[:, None], (F, R)).copy()
+    valid = idx >= 0
+    rr = np.repeat(np.arange(R), K)[valid.reshape(-1)]
+    dense[idx[valid], rr] = binv[valid]
+    return dense

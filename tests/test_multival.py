@@ -84,3 +84,18 @@ def test_multival_monotone_and_sampling(rng):
                              "bagging_fraction": 0.7, "bagging_freq": 1})
     acc = np.mean((bst.predict(X) > 0.5) == y)
     assert acc > 0.8
+
+
+def test_multival_cv(rng):
+    """cv() row-subsets the multival storage directly (CopySubrow on the
+    [R, K] layout) -- sparse users keep cross-validation."""
+    X, y = _sparse_data(rng, n=700)
+    sp_mat = scipy_sparse.csr_matrix(X)
+    res = lgb.cv({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                  "min_data_in_leaf": 5, "tpu_sparse_storage": "multival",
+                  "metric": "binary_logloss"},
+                 lgb.Dataset(sp_mat, label=y), num_boost_round=5,
+                 nfold=3)
+    key = [k for k in res if "logloss" in k][0]
+    assert len(res[key]) == 5
+    assert res[key][-1] < res[key][0] + 1e-9
