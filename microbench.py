@@ -169,9 +169,45 @@ def bench_fullpass():
     print(f"masked full pass R={R}: {dt_s*1e3:8.3f} ms", flush=True)
 
 
+def bench_multival():
+    """Sparse [R, K] histogram strategies: scatter-add vs sort+segment
+    (drives the multival kernel choice on device — ref role:
+    multi_val_bin_wrapper.cpp picking dense/sparse row-wise bins)."""
+    import jax
+    import jax.numpy as jnp
+
+    R, K, F, B = 200_000, 32, 1000, 64
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, F, size=(R, K)).astype(np.int32)
+    idx[rng.uniform(size=(R, K)) < 0.2] = -1          # padding
+    binv = rng.integers(0, B, size=(R, K)).astype(np.int32)
+    gh = rng.normal(size=(R, 3)).astype(np.float32)
+    idx_d, binv_d, gh_d = map(jnp.asarray, (idx, binv, gh))
+
+    def scatter(i, b, g):
+        valid = i >= 0
+        flat = jnp.where(valid, i * B + b, F * B)
+        out = jnp.zeros((F * B + 1, 3), jnp.float32)
+        return out.at[flat].add(g[:, None, :])[:-1].reshape(F, B, 3)
+
+    def sort_seg(i, b, g):
+        valid = (i >= 0).reshape(-1)
+        flat = jnp.where(valid, (i * B + b).reshape(-1), F * B)
+        gr = jnp.repeat(g, K, axis=0) * valid[:, None]
+        order = jnp.argsort(flat)
+        return jax.ops.segment_sum(
+            gr[order], flat[order], num_segments=F * B + 1,
+            indices_are_sorted=True)[:-1].reshape(F, B, 3)
+
+    for name, fn in (("scatter", scatter), ("sort+segsum", sort_seg)):
+        dt = timeit(jax.jit(fn), idx_d, binv_d, gh_d)
+        print(f"multival {name} R={R} K={K} F={F} B={B}: "
+              f"{dt*1e3:8.3f} ms", flush=True)
+
+
 SUITES = {"hist": bench_hist, "pallas": bench_pallas,
           "pallas_rm": bench_pallas_rm, "part": bench_part,
-          "fullpass": bench_fullpass}
+          "fullpass": bench_fullpass, "multival": bench_multival}
 
 if __name__ == "__main__":
     picks = sys.argv[1:] or list(SUITES)
