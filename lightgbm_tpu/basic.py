@@ -844,6 +844,29 @@ class Booster:
             else ""
         es = es and not raw_score and (K > 1 or obj_name == "binary")
 
+        # opt-in device prediction (predict(..., device=True)): bin with
+        # the training mappers + one jitted all-trees traversal — exact
+        # vs the host walk (thresholds ARE bin boundaries); linear trees
+        # and prediction early stop fall back to the host path
+        if (kwargs.get("device") and not es):
+            try:
+                raw = eng.predict_device(X, start_iteration, end_iteration)
+            except ValueError as e:
+                from .utils import log
+                log.warning(f"device prediction unavailable ({e}); "
+                            "using the host path")
+            else:
+                if getattr(eng, "average_output", False) and \
+                        end_iteration > start_iteration:
+                    raw /= (end_iteration - start_iteration)
+                if not raw_score and eng.objective is not None:
+                    if K > 1:
+                        raw = eng.objective.convert_output(raw)
+                    else:
+                        raw[:, 0] = np.asarray(
+                            eng.objective.convert_output(raw[:, 0]))
+                return raw[:, 0] if K == 1 else raw
+
         raw = np.zeros((X.shape[0], K), dtype=np.float64)
         active = np.ones(X.shape[0], bool) if es else None
         Xa = X

@@ -30,7 +30,7 @@ from ..core.objective import ObjectiveFunction, CustomObjective, K_EPSILON
 from ..core.tree import HostTree, TreeArrays
 from ..io.dataset_core import BinnedDataset
 from ..ops.split import FeatureMeta, SplitHyperParams
-from ..ops.predict import tree_leaf_bins
+from ..ops.predict import tree_leaf_bins, tree_output_bins
 from ..utils import log
 from ..utils.timer import global_timer
 from .sample_strategy import SampleStrategy
@@ -883,6 +883,72 @@ class GBDT:
             return jnp.asarray(
                 t.linear_output(raw, np.asarray(leaf)).astype(np.float32))
         return arrs.leaf_value[leaf]
+
+    # ------------------------------------------------------------------
+    def predict_device(self, X: np.ndarray, start_iteration: int,
+                       end_iteration: int) -> np.ndarray:
+        """Batched TPU prediction: bin the raw input with the TRAINING
+        BinMappers and traverse all trees in one jitted program
+        (≡ the CUDA predictor's batched AddPredictionToScore,
+        cuda_tree.cu; the reference CPU predictor walks rows under OMP).
+
+        Split decisions are exact by construction: threshold_real is
+        the left bin's upper bound, so `x <= threshold_real` and
+        `bin(x) <= threshold_bin` decide identically for every x; only
+        the leaf-value accumulation differs (f32 on device vs f64 on
+        host). Requires the in-session training mappers; linear trees
+        fall back to the host path.
+        """
+        K = self.num_tree_per_iteration
+        models = self.models[start_iteration * K:end_iteration * K]
+        if (self.train_set is None or not self.train_set.bin_mappers or
+                any(t.is_linear for t in models)):
+            raise ValueError("device prediction needs in-session bin "
+                             "mappers and non-linear trees")
+        used = self.train_set.used_feature_map
+        mappers = self.train_set.used_bin_mappers()
+        R = X.shape[0]
+        bins = np.empty((len(used), R), np.int32)
+        for i, (fi, m) in enumerate(zip(used, mappers)):
+            bins[i] = m.value_to_bin(np.asarray(X[:, fi], np.float64))
+        bins_dev = jnp.asarray(bins)
+
+        arrs = [_host_tree_to_arrays(t, self.config.num_leaves)
+                for t in models]
+        # normalize categorical fields so heterogeneous trees stack
+        widths = [a.cat_bins.shape[1] for a in arrs
+                  if a.cat_bins is not None]
+        if widths:
+            W = max(widths)
+            li = self.config.num_leaves - 1
+
+            def with_cat(a):
+                if a.cat_bins is None:
+                    return a._replace(
+                        cat_count=jnp.zeros(li, jnp.int32),
+                        cat_bins=jnp.full((li, W), -1, jnp.int32))
+                if a.cat_bins.shape[1] < W:
+                    pad = jnp.full((li, W - a.cat_bins.shape[1]), -1,
+                                   jnp.int32)
+                    return a._replace(
+                        cat_bins=jnp.concatenate([a.cat_bins, pad], 1))
+                return a
+
+            arrs = [with_cat(a) for a in arrs]
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *arrs)
+
+        meta = self.feature_meta
+
+        @jax.jit
+        def run(st, bd):
+            outs = jax.vmap(
+                lambda tr: tree_output_bins(tr, bd, meta.num_bin,
+                                            meta.missing_type,
+                                            meta.default_bin))(st)
+            T = outs.shape[0]
+            return outs.reshape(T // K, K, R).sum(axis=0)
+
+        return np.asarray(run(stacked, bins_dev), np.float64).T  # [R, K]
 
     # ------------------------------------------------------------------
     def train_one_iter(self, gradients: Optional[np.ndarray] = None,

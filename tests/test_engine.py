@@ -246,3 +246,28 @@ def test_early_stopping_min_delta_param(rng):
                         tr, num_boost_round=200, valid_sets=[va])
     assert b_delta.best_iteration <= b0.best_iteration
     assert b_delta.current_iteration() < 200
+
+
+def test_device_predict_matches_host(rng):
+    """predict(device=True): binned device traversal decides every
+    split identically to the host walk (thresholds are bin boundaries);
+    outputs differ only by f32-vs-f64 accumulation of leaf values.
+    Covers categorical splits, multiclass and NaNs."""
+    n = 900
+    X = rng.normal(size=(n, 6))
+    X[:, 3] = rng.integers(0, 8, size=n)          # categorical
+    X[rng.uniform(size=(n, 6)) < 0.05] = np.nan   # missing
+    y = ((np.nan_to_num(X[:, 0]) > 0).astype(int)
+         + (X[:, 3] % 2 == 0).astype(int))
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "verbose": -1, "num_leaves": 15,
+                     "min_data_in_leaf": 5}, 
+                    lgb.Dataset(X, label=y, categorical_feature=[3]),
+                    num_boost_round=8)
+    host = bst.predict(X)
+    dev = bst.predict(X, device=True)
+    np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
+    # raw scores too
+    np.testing.assert_allclose(bst.predict(X, device=True, raw_score=True),
+                               bst.predict(X, raw_score=True),
+                               rtol=1e-5, atol=1e-6)
