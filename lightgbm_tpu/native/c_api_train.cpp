@@ -407,6 +407,56 @@ int LGBM_BoosterGetEval(void* handle, int data_idx, int* out_len,
   return RunGuarded(body);
 }
 
+int LGBM_BoosterGetEvalCounts(void* handle, int* out_len) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !out_len) {
+    LgbmTrainSetError("BoosterGetEvalCounts: not a training Booster "
+                      "handle");
+    return -1;
+  }
+  std::string body =
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
+      "_ct.c_int.from_address(" + Addr(out_len) +
+      ").value = len(b.eval_train())\n";
+  return RunGuarded(body);
+}
+
+int LGBM_BoosterGetEvalNames(void* handle, const int len,
+                             int* out_len, const size_t buffer_len,
+                             size_t* out_buffer_len, char** out_strs) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !out_len || !out_buffer_len) {
+    LgbmTrainSetError("BoosterGetEvalNames: not a training Booster "
+                      "handle");
+    return -1;
+  }
+  // gather the names through a bounded scratch buffer, then copy into
+  // the caller's string array (reference two-call sizing protocol)
+  static char scratch[8192];
+  static int n_names;
+  std::string body =
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
+      "names = [r[1] for r in b.eval_train()]\n" +
+      "blob = b'\\0'.join(n.encode() for n in names)[:8190] + b'\\0\\0'\n" +
+      "_ct.memmove(" + Addr(scratch) + ", blob, len(blob))\n" +
+      "_ct.c_int.from_address(" + Addr(&n_names) +
+      ").value = len(names)\n";
+  if (RunGuarded(body) != 0) return -1;
+  *out_len = n_names;
+  size_t max_needed = 1;
+  const char* p = scratch;
+  for (int i = 0; i < n_names; ++i) {
+    size_t l = std::strlen(p);
+    if (l + 1 > max_needed) max_needed = l + 1;
+    if (out_strs && i < len && out_strs[i]) {
+      std::snprintf(out_strs[i], buffer_len, "%s", p);
+    }
+    p += l + 1;
+  }
+  *out_buffer_len = max_needed;
+  return 0;
+}
+
 int LGBM_BoosterSaveModel(void* handle, int start_iteration,
                           int num_iteration, int feature_importance_type,
                           const char* filename) {

@@ -91,6 +91,21 @@ int main(int argc, char** argv) {
     fprintf(stderr, "FAIL: GetEval n=%d v=%g\n", n_eval, evals[0]);
     return 1;
   }
+  int n_metrics = 0;
+  CHECK(LGBM_BoosterGetEvalCounts(bst, &n_metrics));
+  char name_buf[4][64];
+  char* name_ptrs[4] = {name_buf[0], name_buf[1], name_buf[2],
+                        name_buf[3]};
+  int got_names = 0;
+  size_t need = 0;
+  CHECK(LGBM_BoosterGetEvalNames(bst, 4, &got_names, 64, &need,
+                                 name_ptrs));
+  if (n_metrics != n_eval || got_names != n_metrics ||
+      name_buf[0][0] == '\0') {
+    fprintf(stderr, "FAIL: eval names n=%d got=%d first='%s'\n",
+            n_metrics, got_names, name_buf[0]);
+    return 1;
+  }
   int cur = 0;
   CHECK(LGBM_BoosterGetCurrentIteration(bst, &cur));
   if (cur < 1) {
