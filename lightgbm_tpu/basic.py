@@ -767,6 +767,7 @@ class Booster:
             # iteration, c_api.cpp — never densify the full matrix): each
             # block densifies at most ~256 MB and reuses the dense path,
             # so wide-sparse inputs don't hit a memory cliff.
+            import scipy.sparse as sp
             csr = data.tocsr()
             n_rows = csr.shape[0]
             block = int(kwargs.get(
@@ -781,7 +782,20 @@ class Booster:
                         validate_features=validate_features, **kwargs)
                     for i in range(0, n_rows, block)
                 ]
+                if pred_contrib:
+                    return sp.vstack(outs, format="csr")
                 return np.concatenate(outs, axis=0)
+            if pred_contrib:
+                # sparse input -> sparse SHAP output (≡ the reference's
+                # PredictSparseCSR contrib path, c_api.cpp — most
+                # contributions of wide-sparse rows are exactly zero)
+                dense = self.predict(
+                    csr.toarray().astype(np.float64),
+                    start_iteration=start_iteration,
+                    num_iteration=num_iteration, raw_score=raw_score,
+                    pred_leaf=False, pred_contrib=True,
+                    validate_features=validate_features, **kwargs)
+                return sp.csr_matrix(dense)
             X = csr.toarray().astype(np.float64)
         elif _is_arrow_table(data):
             from .io.dataset_core import ArrowColumns

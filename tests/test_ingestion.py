@@ -127,7 +127,9 @@ def test_sparse_predict_row_blocked(rng):
     cw = bst.predict(X, pred_contrib=True)
     cb = bst.predict(sp_mat, pred_contrib=True,
                      predict_sparse_block_rows=64)
-    np.testing.assert_allclose(cb, cw, rtol=1e-5, atol=1e-6)
+    # sparse input -> sparse SHAP output (reference PredictSparseCSR)
+    assert scipy_sparse.issparse(cb)
+    np.testing.assert_allclose(cb.toarray(), cw, rtol=1e-5, atol=1e-6)
 
 
 def test_wide_sparse_efb_trains_bounded(rng):
