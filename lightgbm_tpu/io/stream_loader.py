@@ -252,11 +252,58 @@ def load_binned_two_round(path: str, config: Config,
 
     max_num_bin = max((mappers[i].num_bin for i in used), default=2)
     dtype = np.uint8 if max_num_bin <= 256 else np.uint16
-    bins = np.empty((len(used), n_rows), dtype)
+    # multi-value sparse storage straight from the stream (explicit
+    # tpu_sparse_storage=multival): only stored nonzeros are binned and
+    # kept as triplets — the [F, R] dense bin matrix (the remaining
+    # memory cliff for Bosch-class LibSVM width) is never allocated
+    use_mv = (fmt == "libsvm" and reference is None and
+              str(config.tpu_sparse_storage).lower() == "multival" and
+              len(used) >= 2)
+    bins = None if use_mv else np.empty((len(used), n_rows), dtype)
 
     # ---- round 2: quantize chunk-by-chunk ------------------------------
     lo = 0
-    if fmt == "libsvm":
+    if fmt == "libsvm" and use_mv:
+        inv = np.full(F, -1, np.int64)
+        inv[used] = np.arange(len(used))
+        mv_r, mv_c, mv_b = [], [], []
+        for chunk in iter_file_chunks(path, skip, chunk_bytes):
+            lab, r, c, v, _ = parse_libsvm_chunk(chunk)
+            keep = c < F
+            r, c, v = r[keep], c[keep], v[keep]
+            cu = inv[c]
+            keep2 = cu >= 0
+            r, cu, v = r[keep2], cu[keep2], v[keep2]
+            if len(cu):
+                order = np.argsort(cu, kind="stable")
+                cs, rs, vs = cu[order], r[order], v[order]
+                b = np.empty(len(cs), np.int32)
+                starts = np.searchsorted(cs, np.arange(len(used)), "left")
+                ends = np.searchsorted(cs, np.arange(len(used)), "right")
+                for out_i, (s, e) in enumerate(zip(starts, ends)):
+                    if e > s:
+                        b[s:e] = mappers[used[out_i]].value_to_bin(
+                            np.ascontiguousarray(vs[s:e]))
+                mv_r.append(lo + rs.astype(np.int64))
+                mv_c.append(cs)
+                mv_b.append(b)
+            lo += len(lab)
+        import scipy.sparse as sp
+        coo = sp.coo_matrix(
+            ((np.concatenate(mv_b) + 1 if mv_b
+              else np.zeros(0, np.int32)),
+             (np.concatenate(mv_r) if mv_r else np.zeros(0, np.int64),
+              np.concatenate(mv_c) if mv_c else np.zeros(0, np.int64))),
+            shape=(n_rows, len(used)))
+        csr = coo.tocsr()
+        csr.data -= 1          # undo the keep-explicit-zero offset
+        from ..ops.hist_multival import pack_csr_bins
+        sb = pack_csr_bins(csr, len(used))
+        bins_mv = (np.asarray(sb.idx), np.asarray(sb.binv))
+        log.info(f"multi-value sparse bin storage from stream: "
+                 f"{len(used)} features, K={bins_mv[0].shape[1]} max "
+                 "nonzeros/row")
+    elif fmt == "libsvm":
         zero_bins = np.asarray(
             [mappers[fi].value_to_bin(np.zeros(1))[0] for fi in used],
             dtype)
@@ -283,6 +330,8 @@ def load_binned_two_round(path: str, config: Config,
     ds.bin_mappers = mappers
     ds.used_feature_map = used
     ds.bins = bins
+    if use_mv:
+        ds.bins_mv = bins_mv
     ds.feature_names = (feature_names if feature_names
                         else [f"Column_{i}" for i in range(F)])
 
