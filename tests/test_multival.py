@@ -99,3 +99,23 @@ def test_multival_cv(rng):
     key = [k for k in res if "logloss" in k][0]
     assert len(res[key]) == 5
     assert res[key][-1] < res[key][0] + 1e-9
+
+
+def test_multival_goss_dart_constraints(rng):
+    """Sampling strategies and boosting variants over multival storage:
+    GOSS (row weights), DART (tree drops densify lazily for traversal),
+    interaction constraints."""
+    X, y = _sparse_data(rng)
+    sp_mat = scipy_sparse.csr_matrix(X)
+    goss = _train(sp_mat, y, {"objective": "binary",
+                              "tpu_sparse_storage": "multival",
+                              "data_sample_strategy": "goss"})
+    assert np.mean((goss.predict(X) > 0.5) == y) > 0.8
+    dart = _train(sp_mat, y, {"objective": "binary", "boosting": "dart",
+                              "tpu_sparse_storage": "multival",
+                              "drop_rate": 0.3})
+    assert np.isfinite(dart.predict(X)).all()
+    ic = _train(sp_mat, y, {"objective": "binary",
+                            "tpu_sparse_storage": "multival",
+                            "interaction_constraints": "[0,1,2],[3,4,5]"})
+    assert np.isfinite(ic.predict(X)).all()
