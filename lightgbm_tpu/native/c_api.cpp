@@ -352,6 +352,51 @@ void TransformRow(const Model& m, double* scores) {
   }
 }
 
+template <typename FillFn>
+int PredictRows(Model* m, FillFn fill, int64_t nrow, int64_t ncol,
+                int predict_type, int start_iteration, int num_iteration,
+                int64_t* out_len, double* out_result) {
+  int total_iter = m->NumIterations();
+  int end_iter = (num_iteration <= 0)
+                     ? total_iter
+                     : std::min(total_iter, start_iteration + num_iteration);
+  int K = m->num_tree_per_iteration;
+  std::vector<double> row(ncol);
+
+  if (predict_type == 2) {  // leaf indices, [nrow, num_trees_used]
+    int n_used = (end_iter - start_iteration) * K;
+    for (int64_t r = 0; r < nrow; ++r) {
+      fill(r, row.data());
+      double* out = out_result + r * n_used;
+      int j = 0;
+      for (int it = start_iteration; it < end_iter; ++it)
+        for (int k = 0; k < K; ++k)
+          out[j++] = m->trees[it * K + k].PredictLeaf(row.data());
+    }
+    *out_len = static_cast<int64_t>(nrow) * n_used;
+    return 0;
+  }
+  if (predict_type != 0 && predict_type != 1) {
+    SetError("predict_type must be 0 (normal), 1 (raw) or 2 (leaf index); "
+             "SHAP contributions are available via the Python API");
+    return -1;
+  }
+  int n_iter_used = end_iter - start_iteration;
+  for (int64_t r = 0; r < nrow; ++r) {
+    fill(r, row.data());
+    double* out = out_result + r * K;
+    for (int k = 0; k < K; ++k) out[k] = 0.0;
+    for (int it = start_iteration; it < end_iter; ++it)
+      for (int k = 0; k < K; ++k)
+        out[k] += m->trees[it * K + k].Predict(row.data());
+    if (m->average_output && n_iter_used > 0)
+      for (int k = 0; k < K; ++k) out[k] /= n_iter_used;  // rf averaging
+    if (predict_type == 0) TransformRow(*m, out);
+  }
+  *out_len = static_cast<int64_t>(nrow) * K;
+  return 0;
+}
+
 inline void FillRow(const void* data, int data_type, int64_t r, int32_t ncol,
                     int is_row_major, int64_t nrow, double* row) {
   if (data_type == 0) {  // C_API_DTYPE_FLOAT32
@@ -385,6 +430,13 @@ int LgbmTrainBoosterPredictForMat(void* handle, const void* data,
                                   int predict_type, int start_iteration,
                                   int num_iteration, int64_t* out_len,
                                   double* out_result);
+int LgbmTrainBoosterPredictForCSR(void* handle, const void* indptr,
+                                  int indptr_type, const int32_t* indices,
+                                  const void* data, int data_type,
+                                  int64_t nindptr, int64_t nelem,
+                                  int64_t num_col, int predict_type,
+                                  int start_iteration, int num_iteration,
+                                  int64_t* out_len, double* out_result);
 
 int LGBM_BoosterCreateFromModelfile(const char* filename,
                                     int* out_num_iterations,
@@ -477,45 +529,59 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
     SetError("input has fewer columns than the model's features");
     return -1;
   }
-  int total_iter = m->NumIterations();
-  int end_iter = (num_iteration <= 0)
-                     ? total_iter
-                     : std::min(total_iter, start_iteration + num_iteration);
-  int K = m->num_tree_per_iteration;
-  std::vector<double> row(ncol);
+  auto fill = [&](int64_t r, double* row) {
+    FillRow(data, data_type, r, ncol, is_row_major, nrow, row);
+  };
+  return PredictRows(m, fill, nrow, ncol, predict_type, start_iteration,
+                     num_iteration, out_len, out_result);
+}
 
-  if (predict_type == 2) {  // leaf indices, [nrow, num_trees_used]
-    int n_used = (end_iter - start_iteration) * K;
-    for (int64_t r = 0; r < nrow; ++r) {
-      FillRow(data, data_type, r, ncol, is_row_major, nrow, row.data());
-      double* out = out_result + r * n_used;
-      int j = 0;
-      for (int it = start_iteration; it < end_iter; ++it)
-        for (int k = 0; k < K; ++k)
-          out[j++] = m->trees[it * K + k].PredictLeaf(row.data());
-    }
-    *out_len = static_cast<int64_t>(nrow) * n_used;
-    return 0;
-  }
-  if (predict_type != 0 && predict_type != 1) {
-    SetError("predict_type must be 0 (normal), 1 (raw) or 2 (leaf index); "
-             "SHAP contributions are available via the Python API");
+// CSR prediction without densifying the matrix (≡ the reference's
+// PredictForCSR row iteration, src/c_api.cpp RowFunctionFromCSR): each
+// row's dense buffer is filled from its index slice only.
+int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char* /*parameter*/, int64_t* out_len,
+                              double* out_result) {
+  (void)nelem;
+  if (LgbmTrainOwns(handle))
+    return LgbmTrainBoosterPredictForCSR(
+        handle, indptr, indptr_type, indices, data, data_type, nindptr,
+        nelem, num_col, predict_type, start_iteration, num_iteration,
+        out_len, out_result);
+  Model* m = static_cast<Model*>(handle);
+  if (data_type != 0 && data_type != 1) {
+    SetError("only float32 (0) / float64 (1) data are supported");
     return -1;
   }
-  int n_iter_used = end_iter - start_iteration;
-  for (int64_t r = 0; r < nrow; ++r) {
-    FillRow(data, data_type, r, ncol, is_row_major, nrow, row.data());
-    double* out = out_result + r * K;
-    for (int k = 0; k < K; ++k) out[k] = 0.0;
-    for (int it = start_iteration; it < end_iter; ++it)
-      for (int k = 0; k < K; ++k)
-        out[k] += m->trees[it * K + k].Predict(row.data());
-    if (m->average_output && n_iter_used > 0)
-      for (int k = 0; k < K; ++k) out[k] /= n_iter_used;  // rf averaging
-    if (predict_type == 0) TransformRow(*m, out);
+  if (indptr_type != 2 && indptr_type != 3) {
+    SetError("indptr_type must be int32 (2) or int64 (3)");
+    return -1;
   }
-  *out_len = static_cast<int64_t>(nrow) * K;
-  return 0;
+  if (num_col < m->max_feature_idx + 1) {
+    SetError("input has fewer columns than the model's features");
+    return -1;
+  }
+  int64_t nrow = nindptr - 1;
+  auto ptr_at = [&](int64_t i) -> int64_t {
+    return indptr_type == 2
+               ? static_cast<const int32_t*>(indptr)[i]
+               : static_cast<const int64_t*>(indptr)[i];
+  };
+  auto fill = [&](int64_t r, double* row) {
+    for (int64_t c = 0; c < num_col; ++c) row[c] = 0.0;
+    for (int64_t k = ptr_at(r); k < ptr_at(r + 1); ++k) {
+      double v = data_type == 0 ? static_cast<const float*>(data)[k]
+                                : static_cast<const double*>(data)[k];
+      if (indices[k] < num_col) row[indices[k]] = v;
+    }
+  };
+  return PredictRows(m, fill, nrow, num_col, predict_type,
+                     start_iteration, num_iteration, out_len, out_result);
 }
 
 }  // extern "C"

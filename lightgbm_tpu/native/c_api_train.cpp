@@ -314,6 +314,65 @@ int LGBM_DatasetCreateFromFile(const char* filename,
   return 0;
 }
 
+namespace {
+
+// shared python snippet: rebuild a scipy CSR from raw buffers
+std::string CsrFromBuffers(const void* indptr, int indptr_type,
+                           const int32_t* indices, const void* data,
+                           int data_type, int64_t nindptr, int64_t nelem,
+                           int64_t num_col) {
+  const char* it = indptr_type == 2 ? "_ct.c_int32" : "_ct.c_int64";
+  const char* dt = data_type == 0 ? "_ct.c_float" : "_ct.c_double";
+  return std::string("import scipy.sparse as _sp\n") +
+         "ip = _np.ctypeslib.as_array((" + it + " * " +
+         std::to_string(nindptr) + ").from_address(" + Addr(indptr) +
+         ")).copy()\n" +
+         "ix = _np.ctypeslib.as_array((_ct.c_int32 * " +
+         std::to_string(nelem) + ").from_address(" + Addr(indices) +
+         ")).copy()\n" +
+         "dv = _np.ctypeslib.as_array((" + dt + " * " +
+         std::to_string(nelem) + ").from_address(" + Addr(data) +
+         ")).astype(_np.float64).copy()\n" +
+         "csr = _sp.csr_matrix((dv, ix, ip), shape=(" +
+         std::to_string(nindptr - 1) + ", " + std::to_string(num_col) +
+         "))\n";
+}
+
+}  // namespace
+
+int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t nindptr,
+                              int64_t nelem, int64_t num_col,
+                              const char* parameters,
+                              const void* reference, void** out) {
+  (void)reference;
+  if (!indptr || !indices || !data || !out) {
+    LgbmTrainSetError("DatasetCreateFromCSR: null argument");
+    return -1;
+  }
+  if ((indptr_type != 2 && indptr_type != 3) ||
+      (data_type != 0 && data_type != 1)) {
+    LgbmTrainSetError("DatasetCreateFromCSR: indptr must be int32/int64 "
+                      "(2/3), data float32/float64 (0/1)");
+    return -1;
+  }
+  TrainHandle* h = NewHandle(false);
+  std::string body =
+      CsrFromBuffers(indptr, indptr_type, indices, data, data_type,
+                     nindptr, nelem, num_col) +
+      "p = dict(kv.split('=', 1) for kv in " + PyStr(parameters) +
+      ".replace(',', ' ').split() if '=' in kv)\n" +
+      "_lgbm_capi['obj'][" + std::to_string(h->id) + "] = "
+      "{'X': csr, 'params': p, 'fields': {}}\n";
+  if (RunGuarded(body) != 0) {
+    DropHandle(h);
+    return -1;
+  }
+  *out = h;
+  return 0;
+}
+
 int LGBM_BoosterCreate(void* train_data, const char* parameters,
                        void** out) {
   TrainHandle* d = AsTrainHandle(train_data);
@@ -533,6 +592,44 @@ int LgbmTrainBoosterPredictForMat(void* handle, const void* data,
            ? ", num_iteration=" + std::to_string(num_iteration)
            : "") +
       (kw.empty() ? "" : ", " + kw) + "), dtype=_np.float64)\n" +
+      "_ct.c_int64.from_address(" + Addr(out_len) +
+      ").value = pred.size\n" +
+      "_ct.memmove(" + Addr(out_result) +
+      ", pred.ctypes.data, pred.size * 8)\n";
+  return RunGuarded(body);
+}
+
+int LgbmTrainBoosterPredictForCSR(void* handle, const void* indptr,
+                                  int indptr_type, const int32_t* indices,
+                                  const void* data, int data_type,
+                                  int64_t nindptr, int64_t nelem,
+                                  int64_t num_col, int predict_type,
+                                  int start_iteration, int num_iteration,
+                                  int64_t* out_len, double* out_result) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !out_len || !out_result) return -1;
+  if ((indptr_type != 2 && indptr_type != 3) ||
+      (data_type != 0 && data_type != 1)) {
+    LgbmTrainSetError("PredictForCSR: indptr must be int32/int64 (2/3), "
+                      "data float32/float64 (0/1)");
+    return -1;
+  }
+  std::string kw = predict_type == 1   ? "raw_score=True"
+                   : predict_type == 2 ? "pred_leaf=True"
+                   : predict_type == 3 ? "pred_contrib=True"
+                                       : "";
+  std::string body =
+      CsrFromBuffers(indptr, indptr_type, indices, data, data_type,
+                     nindptr, nelem, num_col) +
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
+      "pred = b.predict(csr, start_iteration=" +
+      std::to_string(start_iteration > 0 ? start_iteration : 0) +
+      (num_iteration > 0
+           ? ", num_iteration=" + std::to_string(num_iteration)
+           : "") +
+      (kw.empty() ? "" : ", " + kw) + ")\n" +
+      "if _sp.issparse(pred): pred = pred.toarray()\n" +
+      "pred = _np.ascontiguousarray(pred, dtype=_np.float64)\n" +
       "_ct.c_int64.from_address(" + Addr(out_len) +
       ").value = pred.size\n" +
       "_ct.memmove(" + Addr(out_result) +

@@ -170,3 +170,41 @@ def test_reference_golden_model():
     expect = np.loadtxt(os.path.join(golden, "pred.txt"))
     nb = _native(os.path.join(golden, "model.txt"))
     np.testing.assert_allclose(nb.predict(X), expect, rtol=1e-9, atol=1e-12)
+
+
+def test_predict_for_csr(rng, tmp_path):
+    """Native CSR prediction (no densify): parity with the dense path
+    (ref: c_api.cpp PredictForCSR / RowFunctionFromCSR)."""
+    import ctypes
+    import scipy.sparse as sp
+
+    X = np.zeros((300, 12))
+    mask = rng.uniform(size=X.shape) < 0.2
+    X[mask] = rng.normal(size=int(mask.sum()))
+    y = (X[:, 0] - X[:, 1] > 0).astype(np.float64)
+    bst, path = _train_save(tmp_path, {"objective": "binary"}, X, y)
+
+    lib = get_lib()
+    handle = ctypes.c_void_p()
+    n_iter = ctypes.c_int()
+    assert lib.LGBM_BoosterCreateFromModelfile(
+        path.encode(), ctypes.byref(n_iter), ctypes.byref(handle)) == 0
+    csr = sp.csr_matrix(X)
+    indptr = np.asarray(csr.indptr, np.int32)
+    indices = np.asarray(csr.indices, np.int32)
+    data = np.asarray(csr.data, np.float64)
+    out = np.zeros(300, np.float64)
+    out_len = ctypes.c_int64()
+    rc = lib.LGBM_BoosterPredictForCSR(
+        handle,
+        indptr.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(2),
+        indices.ctypes.data_as(ctypes.c_void_p),
+        data.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(data)),
+        ctypes.c_int64(X.shape[1]), ctypes.c_int(0), ctypes.c_int(0),
+        ctypes.c_int(0), b"", ctypes.byref(out_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    assert rc == 0
+    assert out_len.value == 300
+    np.testing.assert_allclose(out, bst.predict(X), rtol=1e-6, atol=1e-9)
+    lib.LGBM_BoosterFree(handle)
