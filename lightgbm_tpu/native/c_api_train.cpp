@@ -536,6 +536,64 @@ int LGBM_BoosterSaveModel(void* handle, int start_iteration,
   return RunGuarded(body);
 }
 
+int LGBM_BoosterRollbackOneIter(void* handle) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster) {
+    LgbmTrainSetError("BoosterRollbackOneIter: not a training Booster "
+                      "handle");
+    return -1;
+  }
+  std::string body =
+      "_lgbm_capi['obj'][" + std::to_string(h->id) +
+      "]['booster'].rollback_one_iter()\n";
+  return RunGuarded(body);
+}
+
+int LgbmTrainBoosterIntProp(void* handle, const char* prop, int* out);
+
+int LGBM_BoosterNumberOfTotalModel(void* handle, int* out_models) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !out_models) {
+    LgbmTrainSetError("BoosterNumberOfTotalModel: not a training Booster "
+                      "handle");
+    return -1;
+  }
+  return LgbmTrainBoosterIntProp(handle, "b.num_trees()", out_models);
+}
+
+int LGBM_BoosterSaveModelToString(void* handle, int start_iteration,
+                                  int num_iteration,
+                                  int feature_importance_type,
+                                  int64_t buffer_len, int64_t* out_len,
+                                  char* out_str) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !out_len) {
+    LgbmTrainSetError("BoosterSaveModelToString: not a training Booster "
+                      "handle");
+    return -1;
+  }
+  std::string body =
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
+      "s = b.model_to_string(num_iteration=" +
+      (num_iteration > 0 ? std::to_string(num_iteration) : "None") +
+      ", start_iteration=" + std::to_string(
+          start_iteration > 0 ? start_iteration : 0) +
+      ", importance_type=" +
+      (feature_importance_type == 1 ? "'gain'" : "'split'") +
+      ").encode() + b'\\0'\n" +
+      "_ct.c_int64.from_address(" + Addr(out_len) +
+      ").value = len(s)\n" +
+      (out_str ? std::string("_ct.memmove(") + Addr(out_str) +
+                     ", s, min(len(s), " + std::to_string(buffer_len) +
+                     "))\n"
+               : std::string()) +
+      (out_str && buffer_len > 0
+           ? "_ct.c_char.from_address(" +
+                 Addr(out_str + (buffer_len - 1)) + ").value = b'\\0'\n"
+           : std::string());
+  return RunGuarded(body);
+}
+
 // ---- training-handle implementations used by c_api.cpp routers ---------
 
 int LgbmTrainBoosterFree(void* handle) {

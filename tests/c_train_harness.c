@@ -34,6 +34,13 @@ extern int LGBM_BoosterAddValidData(void*, void*);
 extern int LGBM_BoosterGetEval(void*, int, int*, double*);
 extern int LGBM_DatasetCreateFromFile(const char*, const char*,
                                       const void*, void**);
+extern int LGBM_BoosterGetEvalCounts(void*, int*);
+extern int LGBM_BoosterGetEvalNames(void*, const int, int*,
+                                    const size_t, size_t*, char**);
+extern int LGBM_BoosterRollbackOneIter(void*);
+extern int LGBM_BoosterNumberOfTotalModel(void*, int*);
+extern int LGBM_BoosterSaveModelToString(void*, int, int, int,
+                                         long long, long long*, char*);
 
 #define CHECK(call)                                                   \
   do {                                                                \
@@ -151,6 +158,25 @@ int main(int argc, char** argv) {
   }
   if (!(maxd < 1e-6)) {
     fprintf(stderr, "FAIL: train/serve mismatch %g\n", maxd);
+    return 1;
+  }
+
+  /* rollback + model-string (after the parity check used 12 trees) */
+  int n_total = 0;
+  CHECK(LGBM_BoosterNumberOfTotalModel(bst, &n_total));
+  CHECK(LGBM_BoosterRollbackOneIter(bst));
+  int n_after = 0;
+  CHECK(LGBM_BoosterNumberOfTotalModel(bst, &n_after));
+  if (n_after != n_total - 1) {
+    fprintf(stderr, "FAIL rollback: %d -> %d\n", n_total, n_after);
+    return 1;
+  }
+  static char model_str[1 << 20];
+  long long str_len = 0;
+  CHECK(LGBM_BoosterSaveModelToString(bst, 0, -1, 0, sizeof(model_str),
+                                      &str_len, model_str));
+  if (str_len < 100 || model_str[0] == '\0') {
+    fprintf(stderr, "FAIL model string len=%lld\n", str_len);
     return 1;
   }
 
