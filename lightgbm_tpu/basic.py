@@ -860,8 +860,10 @@ class Booster:
 
         # opt-in device prediction (predict(..., device=True)): bin with
         # the training mappers + one jitted all-trees traversal — exact
-        # vs the host walk (thresholds ARE bin boundaries); linear trees
-        # and prediction early stop fall back to the host path
+        # vs the host walk (thresholds ARE bin boundaries); linear trees,
+        # empty ranges and prediction early stop fall back to the host
+        # path. On success `raw` falls through to the shared output tail.
+        raw = None
         if (kwargs.get("device") and not es):
             try:
                 raw = eng.predict_device(X, start_iteration, end_iteration)
@@ -869,40 +871,30 @@ class Booster:
                 from .utils import log
                 log.warning(f"device prediction unavailable ({e}); "
                             "using the host path")
-            else:
-                if getattr(eng, "average_output", False) and \
-                        end_iteration > start_iteration:
-                    raw /= (end_iteration - start_iteration)
-                if not raw_score and eng.objective is not None:
-                    if K > 1:
-                        raw = eng.objective.convert_output(raw)
-                    else:
-                        raw[:, 0] = np.asarray(
-                            eng.objective.convert_output(raw[:, 0]))
-                return raw[:, 0] if K == 1 else raw
 
-        raw = np.zeros((X.shape[0], K), dtype=np.float64)
-        active = np.ones(X.shape[0], bool) if es else None
-        Xa = X
-        rounds_since_check = 0
-        for it in range(start_iteration, end_iteration):
-            for k in range(K):
-                t = eng.models[it * K + k]
-                if active is None:
-                    raw[:, k] += t.predict(X)
-                elif len(Xa):
-                    raw[active, k] += t.predict(Xa)
-            if active is not None:
-                rounds_since_check += 1
-                if rounds_since_check == es_freq:
-                    rounds_since_check = 0
-                    if K > 1:
-                        part = np.partition(raw, K - 2, axis=1)
-                        margin = part[:, K - 1] - part[:, K - 2]
-                    else:
-                        margin = 2.0 * np.abs(raw[:, 0])
-                    active &= margin <= es_margin
-                    Xa = X[active]
+        if raw is None:
+            raw = np.zeros((X.shape[0], K), dtype=np.float64)
+            active = np.ones(X.shape[0], bool) if es else None
+            Xa = X
+            rounds_since_check = 0
+            for it in range(start_iteration, end_iteration):
+                for k in range(K):
+                    t = eng.models[it * K + k]
+                    if active is None:
+                        raw[:, k] += t.predict(X)
+                    elif len(Xa):
+                        raw[active, k] += t.predict(Xa)
+                if active is not None:
+                    rounds_since_check += 1
+                    if rounds_since_check == es_freq:
+                        rounds_since_check = 0
+                        if K > 1:
+                            part = np.partition(raw, K - 2, axis=1)
+                            margin = part[:, K - 1] - part[:, K - 2]
+                        else:
+                            margin = 2.0 * np.abs(raw[:, 0])
+                        active &= margin <= es_margin
+                        Xa = X[active]
         if getattr(eng, "average_output", False) and end_iteration > 0:
             raw /= (end_iteration - start_iteration)
         if not raw_score and eng.objective is not None:

@@ -289,12 +289,19 @@ def load_binned_two_round(path: str, config: Config,
                 mv_b.append(b)
             lo += len(lab)
         import scipy.sparse as sp
-        coo = sp.coo_matrix(
-            ((np.concatenate(mv_b) + 1 if mv_b
-              else np.zeros(0, np.int32)),
-             (np.concatenate(mv_r) if mv_r else np.zeros(0, np.int64),
-              np.concatenate(mv_c) if mv_c else np.zeros(0, np.int64))),
-            shape=(n_rows, len(used)))
+        rr = np.concatenate(mv_r) if mv_r else np.zeros(0, np.int64)
+        cc = np.concatenate(mv_c) if mv_c else np.zeros(0, np.int64)
+        bb = np.concatenate(mv_b) if mv_b else np.zeros(0, np.int32)
+        if len(rr):
+            # duplicate feature ids on one LibSVM line: keep the LAST
+            # value, matching the dense path's overwrite (coo.tocsr()
+            # would SUM them into out-of-range bins)
+            key = rr * len(used) + cc
+            _, first_rev = np.unique(key[::-1], return_index=True)
+            keep = len(key) - 1 - first_rev
+            rr, cc, bb = rr[keep], cc[keep], bb[keep]
+        coo = sp.coo_matrix((bb + 1, (rr, cc)),
+                            shape=(n_rows, len(used)))
         csr = coo.tocsr()
         csr.data -= 1          # undo the keep-explicit-zero offset
         from ..ops.hist_multival import pack_csr_bins

@@ -901,10 +901,12 @@ class GBDT:
         """
         K = self.num_tree_per_iteration
         models = self.models[start_iteration * K:end_iteration * K]
-        if (self.train_set is None or not self.train_set.bin_mappers or
+        if (not models or self.train_set is None or
+                not self.train_set.bin_mappers or
                 any(t.is_linear for t in models)):
-            raise ValueError("device prediction needs in-session bin "
-                             "mappers and non-linear trees")
+            raise ValueError("device prediction needs a non-empty tree "
+                             "range, in-session bin mappers and "
+                             "non-linear trees")
         used = self.train_set.used_feature_map
         mappers = self.train_set.used_bin_mappers()
         R = X.shape[0]
@@ -913,40 +915,49 @@ class GBDT:
             bins[i] = m.value_to_bin(np.asarray(X[:, fi], np.float64))
         bins_dev = jnp.asarray(bins)
 
-        arrs = [_host_tree_to_arrays(t, self.config.num_leaves)
-                for t in models]
-        # normalize categorical fields so heterogeneous trees stack
-        widths = [a.cat_bins.shape[1] for a in arrs
-                  if a.cat_bins is not None]
-        if widths:
-            W = max(widths)
-            li = self.config.num_leaves - 1
+        # stacked trees + jitted runner are cached per model window so
+        # serving loops with stable shapes hit the XLA cache instead of
+        # re-tracing every call
+        cache_key = (start_iteration, end_iteration, len(self.models))
+        cached = getattr(self, "_dev_pred_cache", None)
+        if cached is not None and cached[0] == cache_key:
+            stacked, run = cached[1], cached[2]
+        else:
+            arrs = [_host_tree_to_arrays(t, self.config.num_leaves)
+                    for t in models]
+            # normalize categorical fields so heterogeneous trees stack
+            widths = [a.cat_bins.shape[1] for a in arrs
+                      if a.cat_bins is not None]
+            if widths:
+                W = max(widths)
+                li = self.config.num_leaves - 1
 
-            def with_cat(a):
-                if a.cat_bins is None:
-                    return a._replace(
-                        cat_count=jnp.zeros(li, jnp.int32),
-                        cat_bins=jnp.full((li, W), -1, jnp.int32))
-                if a.cat_bins.shape[1] < W:
-                    pad = jnp.full((li, W - a.cat_bins.shape[1]), -1,
-                                   jnp.int32)
-                    return a._replace(
-                        cat_bins=jnp.concatenate([a.cat_bins, pad], 1))
-                return a
+                def with_cat(a):
+                    if a.cat_bins is None:
+                        return a._replace(
+                            cat_count=jnp.zeros(li, jnp.int32),
+                            cat_bins=jnp.full((li, W), -1, jnp.int32))
+                    if a.cat_bins.shape[1] < W:
+                        pad = jnp.full((li, W - a.cat_bins.shape[1]), -1,
+                                       jnp.int32)
+                        return a._replace(
+                            cat_bins=jnp.concatenate([a.cat_bins, pad], 1))
+                    return a
 
-            arrs = [with_cat(a) for a in arrs]
-        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *arrs)
+                arrs = [with_cat(a) for a in arrs]
+            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *arrs)
+            meta = self.feature_meta
 
-        meta = self.feature_meta
+            @jax.jit
+            def run(st, bd):
+                outs = jax.vmap(
+                    lambda tr: tree_output_bins(tr, bd, meta.num_bin,
+                                                meta.missing_type,
+                                                meta.default_bin))(st)
+                T = outs.shape[0]
+                return outs.reshape(T // K, K, -1).sum(axis=0)
 
-        @jax.jit
-        def run(st, bd):
-            outs = jax.vmap(
-                lambda tr: tree_output_bins(tr, bd, meta.num_bin,
-                                            meta.missing_type,
-                                            meta.default_bin))(st)
-            T = outs.shape[0]
-            return outs.reshape(T // K, K, R).sum(axis=0)
+            self._dev_pred_cache = (cache_key, stacked, run)
 
         return np.asarray(run(stacked, bins_dev), np.float64).T  # [R, K]
 

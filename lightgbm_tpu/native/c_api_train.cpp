@@ -96,6 +96,11 @@ bool EnsurePython() {
 // error-capture harness. Returns 0 on success, -1 with the python
 // exception message in the shared error slot otherwise.
 int RunGuarded(const std::string& body) {
+  // serialize embedded-interpreter entry: the training ABI is documented
+  // single-threaded, but a stray concurrent call must not corrupt the
+  // static result slots
+  static std::mutex mu;
+  std::lock_guard<std::mutex> lk(mu);
   if (!EnsurePython()) return -1;
   static int rc_slot;
   static char err_slot[4096];
@@ -490,13 +495,17 @@ int LGBM_BoosterGetEvalNames(void* handle, const int len,
     return -1;
   }
   // gather the names through a bounded scratch buffer, then copy into
-  // the caller's string array (reference two-call sizing protocol)
-  static char scratch[8192];
+  // the caller's string array (reference two-call sizing protocol).
+  // Calls must come from one thread (file-header contract); the blob is
+  // rejected loudly if it ever exceeds the scratch capacity.
+  static char scratch[65536];
   static int n_names;
   std::string body =
       "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
       "names = [r[1] for r in b.eval_train()]\n" +
-      "blob = b'\\0'.join(n.encode() for n in names)[:8190] + b'\\0\\0'\n" +
+      "blob = b'\\0'.join(n.encode() for n in names) + b'\\0\\0'\n" +
+      "if len(blob) > 65534:\n" +
+      "    raise ValueError('eval metric names exceed 64 KiB')\n" +
       "_ct.memmove(" + Addr(scratch) + ", blob, len(blob))\n" +
       "_ct.c_int.from_address(" + Addr(&n_names) +
       ").value = len(names)\n";

@@ -242,3 +242,25 @@ def test_stream_libsvm_multival(rng, tmp_path):
             g.train_one_iter()
         preds.append(np.asarray(g.score[0]))
     np.testing.assert_allclose(preds[0], preds[1], rtol=1e-4, atol=1e-5)
+
+
+def test_stream_libsvm_multival_duplicate_ids(rng, tmp_path):
+    """Duplicate feature ids on one LibSVM line: multival keeps the LAST
+    value exactly like the dense path (never sums bins)."""
+    path = str(tmp_path / "dup.svm")
+    with open(path, "w") as fh:
+        for i in range(120):
+            fh.write(f"{i % 2} 0:{rng.normal():.4g} 1:1.5 1:9.9 "
+                     f"2:{rng.normal():.4g}\n")
+    base = {"two_round": True, "min_data_in_bin": 1,
+            "min_data_in_leaf": 1, "feature_pre_filter": False}
+    ds_mv = load_binned_two_round(
+        path, Config({**base, "tpu_sparse_storage": "multival"}))
+    ds_dn = load_binned_two_round(
+        path, Config({**base, "tpu_sparse_storage": "dense"}))
+    from lightgbm_tpu.ops.hist_multival import densify
+    dflt = np.asarray([m.default_bin for m in
+                       (ds_mv.bin_mappers[i]
+                        for i in ds_mv.used_feature_map)], np.int32)
+    dense_from_mv = densify(ds_mv.bins_mv[0], ds_mv.bins_mv[1], dflt)
+    np.testing.assert_array_equal(dense_from_mv, ds_dn.bins)
