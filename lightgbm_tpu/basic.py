@@ -263,6 +263,26 @@ class Dataset:
         return self
 
     # -- setters (ref: set_field paths) ---------------------------------
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        """Change the categorical features (ref: basic.py
+        Dataset.set_categorical_feature): a no-op when unchanged;
+        otherwise the dataset re-bins on next construct (requires the
+        raw data to still be around)."""
+        if self.categorical_feature == categorical_feature:
+            return self
+        if self._binned is not None:
+            if self.data is None:
+                raise LightGBMError(
+                    "Cannot set categorical feature after freeing raw "
+                    "data; set free_raw_data=False when constructing "
+                    "the Dataset")
+            from .utils import log
+            log.warning("categorical_feature changed after construction; "
+                        "the dataset will be re-binned")
+            self._binned = None
+        self.categorical_feature = categorical_feature
+        return self
+
     def set_label(self, label) -> "Dataset":
         self.label = label
         if self._binned is not None:
@@ -705,6 +725,21 @@ class Booster:
         return self._engine.num_tree_per_iteration
 
     # -- evaluation -----------------------------------------------------
+    def eval(self, data: "Dataset", name: str, feval=None):
+        """Evaluate on a previously-registered dataset (ref: basic.py:4245
+        Booster.eval — the data must be the training set or one added via
+        add_valid, like the reference's data_idx lookup)."""
+        if data is self.train_set:
+            return self.eval_train(feval)
+        for vs, vname in zip(self.valid_sets, self.name_valid_sets):
+            if data is vs:
+                return [(name, n, v, h)
+                        for n_d, n, v, h in self.eval_valid(feval)
+                        if n_d == vname]
+        raise LightGBMError(
+            "Data for eval must be the training set or have been added "
+            "with add_valid")
+
     def eval_train(self, feval=None):
         results = self._engine.eval_train()
         out = [(d, n, v, h) for d, n, v, h in results]
@@ -1070,6 +1105,69 @@ class Booster:
         if importance_type == "split":
             return out.astype(np.int64)  # counts, like the reference
         return out
+
+    def get_split_value_histogram(self, feature, bins=None,
+                                  xgboost_style: bool = False):
+        """Histogram of REAL threshold values used for `feature` across
+        all trees (ref: basic.py:5044 get_split_value_histogram /
+        c_api.cpp BoosterGetLeafValue..GetSplitValueHistogram role)."""
+        eng = self._engine
+        if isinstance(feature, str):
+            if feature not in eng.feature_names:
+                raise LightGBMError(f"Unknown feature name {feature!r}")
+            feature = eng.feature_names.index(feature)
+        values = []
+        for t in eng.models:
+            for i in range(t.num_leaves - 1):
+                if (int(t.split_feature[i]) == feature and
+                        not (t.decision_type[i] & 1)):  # numerical only
+                    values.append(float(t.threshold_real[i]))
+        values = np.asarray(values, np.float64)
+        if bins is None or (isinstance(bins, str) and bins == "auto"):
+            n_unique = len(np.unique(values))
+            bins = max(min(n_unique, 10), 1) if len(values) else 1
+        hist, edges = np.histogram(values, bins=bins)
+        if xgboost_style:
+            ret = np.column_stack((edges[1:], hist))
+            return ret[ret[:, 1] > 0]
+        return hist, edges
+
+    def shuffle_models(self, start_iteration: int = 0,
+                       end_iteration: int = -1) -> "Booster":
+        """Randomly permute the trees of the given iteration window
+        (ref: basic.py:4416 shuffle_models; used before refit)."""
+        eng = self._engine
+        K = eng.num_tree_per_iteration
+        n_iter = len(eng.models) // max(K, 1)
+        end = n_iter if end_iteration <= 0 else min(end_iteration, n_iter)
+        idx = np.arange(start_iteration, end)
+        if len(idx) > 1:
+            perm = np.random.permutation(idx)
+            blocks = [eng.models[i * K:(i + 1) * K] for i in range(n_iter)]
+            reordered = list(blocks)
+            for dst, src in zip(idx, perm):
+                reordered[dst] = blocks[src]
+            eng.models = [t for b in reordered for t in b]
+        return self
+
+    def set_network(self, machines, local_listen_port: int = 12400,
+                    listen_time_out: int = 120,
+                    num_machines: int = 1) -> "Booster":
+        """Map the reference's socket network config (basic.py:3725) onto
+        the jax.distributed world: the first machine acts as coordinator.
+        Prefer lightgbm_tpu.distributed.init_distributed directly — it
+        also needs this process' rank, which the machine list alone does
+        not determine."""
+        from .utils import log
+        if num_machines <= 1:
+            log.warning("set_network with num_machines<=1 is a no-op")
+            return self
+        log.warning(
+            "set_network: use lightgbm_tpu.distributed.init_distributed("
+            f"coordinator_address=..., num_processes={num_machines}, "
+            "process_id=<rank>) — the machine list alone cannot "
+            "determine this process' rank; no network was configured")
+        return self
 
     def lower_bound(self) -> float:
         eng = self._engine

@@ -252,3 +252,41 @@ def test_position_side_file(rng, tmp_path):
     ds = lgb.Dataset(path, params={"objective": "lambdarank",
                                    "verbose": -1}).construct()
     np.testing.assert_array_equal(ds.binned.metadata.position, pos)
+
+
+def test_booster_eval_and_histogram(rng):
+    """Booster.eval / get_split_value_histogram / shuffle_models /
+    Dataset.set_categorical_feature (ref: basic.py:4245,5044,4416)."""
+    X = rng.normal(size=(500, 5))
+    y = (X[:, 0] > 0).astype(np.float64)
+    tr = lgb.Dataset(X, label=y, free_raw_data=False)
+    va = lgb.Dataset(X[:200], label=y[:200], reference=tr)
+    # keep the dataset-bound booster (train() frees dataset refs like the
+    # reference's free_dataset); eval() needs registered datasets
+    bst = lgb.Booster({"objective": "binary", "num_leaves": 7,
+                       "verbose": -1, "min_data_in_leaf": 5,
+                       "metric": "binary_logloss"}, tr)
+    bst.add_valid(va, "va")
+    for _ in range(6):
+        bst.update()
+    res = bst.eval(va, "custom_name")
+    assert res and res[0][0] == "custom_name"
+    res_t = bst.eval(tr, "train")
+    assert res_t and res_t[0][1]
+
+    hist, edges = bst.get_split_value_histogram(0)
+    assert hist.sum() > 0 and len(edges) == len(hist) + 1
+    xh = bst.get_split_value_histogram(0, xgboost_style=True)
+    assert xh.ndim == 2
+
+    before = bst.predict(X)
+    bst.shuffle_models()
+    np.testing.assert_allclose(bst.predict(X), before, rtol=1e-9)
+
+    ds = lgb.Dataset(X, label=y, free_raw_data=False).construct()
+    ds.set_categorical_feature([1])
+    assert ds._binned is None  # re-bins lazily
+    bst2 = lgb.train({"objective": "binary", "num_leaves": 7,
+                      "verbose": -1, "min_data_in_leaf": 5}, ds,
+                     num_boost_round=2)
+    assert np.isfinite(bst2.predict(X)).all()
