@@ -375,6 +375,30 @@ class LGBMModel(_SKBase):
         return self._Booster.feature_name()
 
     @property
+    def feature_names_in_(self) -> np.ndarray:
+        """sklearn-compatible feature names (ref: sklearn.py:1368);
+        raises AttributeError for anonymous (Column_N) features so
+        sklearn's hasattr-based checks behave like the reference."""
+        self._check_fitted()
+        names = self._Booster.feature_name()
+        if all(n.startswith("Column_") for n in names):
+            raise AttributeError(
+                "feature_names_in_ is only available when training data "
+                "had feature names")
+        return np.asarray(names, dtype=object)
+
+    @feature_names_in_.setter
+    def feature_names_in_(self, value) -> None:
+        # sklearn's validate_data assigns this on fit; the canonical
+        # names live in the Booster (ref: sklearn.py:1380 opt-out)
+        pass
+
+    @feature_names_in_.deleter
+    def feature_names_in_(self) -> None:
+        # sklearn deletes it for name-less refits; same opt-out
+        pass
+
+    @property
     def n_estimators_(self) -> int:
         self._check_fitted()
         return self._Booster.num_trees() // max(
@@ -478,6 +502,16 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
         else:
             class_index = (result > 0.5).astype(np.int64)
         return self._classes[class_index]
+
+    def decision_function(self, X, *, start_iteration: int = 0,
+                          num_iteration: Optional[int] = None,
+                          validate_features: bool = False, **kwargs):
+        """Raw margin score per sample (ref: sklearn.py:1769
+        decision_function — sklearn's standard margin accessor)."""
+        return self.predict_proba(
+            X, raw_score=True, start_iteration=start_iteration,
+            num_iteration=num_iteration,
+            validate_features=validate_features, **kwargs)
 
     def predict_proba(self, X, raw_score: bool = False,
                       start_iteration: int = 0,

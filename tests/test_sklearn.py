@@ -152,3 +152,27 @@ def test_plot_importance_and_metric(rng):
     assert len(ax3.patches) > 0
     import matplotlib.pyplot as plt
     plt.close("all")
+
+
+def test_decision_function_and_feature_names_in(rng):
+    """sklearn conveniences: decision_function == raw margins;
+    feature_names_in_ raises for anonymous features, returns names for
+    pandas input (ref: sklearn.py:1769, :1368)."""
+    import pandas as pd
+    X = rng.normal(size=(300, 4))
+    y = (X[:, 0] > 0).astype(int)
+    clf = lgb.LGBMClassifier(n_estimators=5, num_leaves=7,
+                             min_child_samples=5, verbose=-1)
+    clf.fit(X, y)
+    margins = clf.decision_function(X)
+    np.testing.assert_allclose(
+        margins, clf.predict_proba(X, raw_score=True), rtol=1e-9)
+    import pytest as _pytest
+    with _pytest.raises(AttributeError):
+        _ = clf.feature_names_in_
+
+    df = pd.DataFrame(X, columns=["a", "b", "c", "d"])
+    clf2 = lgb.LGBMClassifier(n_estimators=3, num_leaves=7,
+                              min_child_samples=5, verbose=-1)
+    clf2.fit(df, y)
+    assert list(clf2.feature_names_in_) == ["a", "b", "c", "d"]
