@@ -156,6 +156,7 @@ bool ParseTreeBlock(const std::map<std::string, std::string>& kv, Tree* t) {
   t->num_leaves = static_cast<int>(std::atoll(get("num_leaves").c_str()));
   t->num_cat = static_cast<int>(std::atoll(get("num_cat").c_str()));
   int n = t->num_leaves, ni = n - 1;
+  if (n < 1) return false;  // an empty/garbled block must not parse
   t->leaf_value = ParseDoubles(get("leaf_value"));
   if (static_cast<int>(t->leaf_value.size()) != n) return false;
   if (ni > 0) {
@@ -314,6 +315,20 @@ Model* ParseModelString(const std::string& text) {
   }
   if (!flush_tree()) return nullptr;
   if (!saw_magic) return nullptr;
+  // every split feature must stay inside the declared feature range —
+  // traversal reads row[split_feature[node]] from a caller buffer of
+  // max_feature_idx+1 doubles, so an out-of-range id in a corrupted
+  // file would read (or crash) outside it
+  for (const Tree& t : model->trees) {
+    for (int f : t.split_feature) {
+      if (f < 0 || f > model->max_feature_idx) return nullptr;
+    }
+    for (const auto& feats : t.leaf_features) {
+      for (int f : feats) {
+        if (f < 0 || f > model->max_feature_idx) return nullptr;
+      }
+    }
+  }
   return model.release();
 }
 

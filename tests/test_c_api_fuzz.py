@@ -1,0 +1,100 @@
+"""Crash-safety fuzz of the native model-text parser.
+
+The serving library parses untrusted model files; mutated/truncated
+inputs must produce rc=-1 (with an error message) or a valid load —
+never a crash. Runs in a SUBPROCESS so a segfault fails the test
+instead of killing the pytest process.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.native import get_lib
+
+pytestmark = pytest.mark.skipif(get_lib() is None,
+                                reason="no native toolchain")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_FUZZ_CODE = r"""
+import ctypes, random, sys
+import numpy as np
+
+so_path, model_path = sys.argv[1], sys.argv[2]
+lib = ctypes.CDLL(so_path)
+lib.LGBM_GetLastError.restype = ctypes.c_char_p
+model = open(model_path).read()
+rng = random.Random(1234)
+
+def try_load(s):
+    handle = ctypes.c_void_p()
+    n = ctypes.c_int()
+    rc = lib.LGBM_BoosterLoadModelFromString(
+        s.encode("utf-8", "replace"), ctypes.byref(n),
+        ctypes.byref(handle))
+    if rc == 0:
+        # a parsed model must also survive a prediction call
+        X = np.zeros((4, 64), np.float64)
+        out = np.zeros(4 * 16, np.float64)
+        out_len = ctypes.c_int64()
+        lib.LGBM_BoosterPredictForMat(
+            handle, X.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+            ctypes.c_int32(4), ctypes.c_int32(64), ctypes.c_int(1),
+            ctypes.c_int(1), ctypes.c_int(0), ctypes.c_int(0), b"",
+            ctypes.byref(out_len),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        lib.LGBM_BoosterFree(handle)
+
+# truncations
+for frac in (0.1, 0.3, 0.5, 0.7, 0.9, 0.99):
+    try_load(model[: int(len(model) * frac)])
+# line deletions / duplications
+lines = model.split("\n")
+for _ in range(60):
+    mutated = list(lines)
+    op = rng.randrange(3)
+    i = rng.randrange(len(mutated))
+    if op == 0:
+        del mutated[i]
+    elif op == 1:
+        mutated.insert(i, mutated[i])
+    else:
+        # corrupt numbers on the line
+        mutated[i] = mutated[i].replace("1", "999999999").replace(
+            "2", "-7")
+    try_load("\n".join(mutated))
+# byte noise
+for _ in range(40):
+    b = list(model)
+    for _ in range(10):
+        b[rng.randrange(len(b))] = chr(rng.randrange(32, 127))
+    try_load("".join(b))
+print("FUZZ-OK")
+"""
+
+
+def test_model_parser_fuzz(rng, tmp_path):
+    X = rng.normal(size=(400, 6))
+    X[:, 2] = rng.integers(0, 5, size=400)
+    y = (X[:, 0] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y, categorical_feature=[2]),
+                    num_boost_round=4)
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+
+    so_path = os.path.join(REPO, "lightgbm_tpu", "native", "_build",
+                           "lgbm_native.so")
+    script = tmp_path / "fuzz.py"
+    script.write_text(_FUZZ_CODE)
+    out = subprocess.run([sys.executable, str(script), so_path, path],
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, (
+        f"parser fuzz crashed (rc={out.returncode}):\n"
+        f"{out.stderr[-1500:]}")
+    assert "FUZZ-OK" in out.stdout
