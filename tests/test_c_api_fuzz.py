@@ -98,3 +98,59 @@ def test_model_parser_fuzz(rng, tmp_path):
         f"parser fuzz crashed (rc={out.returncode}):\n"
         f"{out.stderr[-1500:]}")
     assert "FUZZ-OK" in out.stdout
+
+
+_PY_FUZZ_CODE = r"""
+import random, resource, sys
+resource.setrlimit(resource.RLIMIT_AS, (4 << 30, 4 << 30))
+sys.path.insert(0, sys.argv[3])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import lightgbm_tpu as lgb
+
+model = open(sys.argv[1]).read()
+rng = random.Random(99)
+
+def try_load(s):
+    try:
+        b = lgb.Booster(model_str=s)
+        b.predict([[0.0] * 8])
+    except MemoryError:
+        pass      # rlimit tripped on a pathological size: acceptable
+    except Exception:
+        pass      # graceful rejection
+
+for frac in (0.2, 0.5, 0.8, 0.95):
+    try_load(model[: int(len(model) * frac)])
+lines = model.split("\n")
+for _ in range(40):
+    mutated = list(lines)
+    op = rng.randrange(3)
+    i = rng.randrange(len(mutated))
+    if op == 0:
+        del mutated[i]
+    elif op == 1:
+        mutated.insert(i, mutated[i])
+    else:
+        mutated[i] = mutated[i].replace("1", "987654321")
+    try_load("\n".join(mutated))
+print("PY-FUZZ-OK")
+"""
+
+
+def test_python_model_loader_fuzz(rng, tmp_path):
+    """The Python model loader must reject corrupt model text with an
+    exception (never crash/hang/absurd allocation past the rlimit)."""
+    X = rng.normal(size=(300, 8))
+    y = X[:, 0] * 2 + rng.normal(scale=0.1, size=300)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbose": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+    script = tmp_path / "pyfuzz.py"
+    script.write_text(_PY_FUZZ_CODE)
+    out = subprocess.run([sys.executable, str(script), path, "-", REPO],
+                         capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "PY-FUZZ-OK" in out.stdout
