@@ -106,3 +106,32 @@ def test_hist_backends_agree(rng):
                                   host2.split_feature_inner)
     np.testing.assert_array_equal(host1.threshold_bin, host2.threshold_bin)
     np.testing.assert_array_equal(l1, l2)
+
+
+def test_split_parity_randomized(rng):
+    """Property sweep: random hyper-parameter combinations must stay
+    split-for-split identical to the numpy oracle (broadens the fixed
+    configs above across the L1/L2/depth/min-data/smoothing space)."""
+    for trial in range(8):
+        trng = np.random.default_rng(1000 + trial)
+        params = {
+            "num_leaves": int(trng.choice([4, 8, 15, 31])),
+            "max_depth": int(trng.choice([-1, 3, 5])),
+            "min_data_in_leaf": int(trng.choice([1, 5, 25, 80])),
+            "lambda_l1": float(trng.choice([0.0, 0.3, 2.0])),
+            "lambda_l2": float(trng.choice([0.0, 1.0, 10.0])),
+            "min_gain_to_split": float(trng.choice([0.0, 0.05])),
+            "max_delta_step": float(trng.choice([0.0, 0.5])),
+            "path_smooth": float(trng.choice([0.0, 1.0])),
+            "max_bin": int(trng.choice([15, 63, 255])),
+        }
+        X, y = _make_data(trng, n=1200, f=5,
+                          with_nan=bool(trng.integers(0, 2)))
+        host, leaf_id, ref_tree, ref_leaf_id = _grow_both(X, y, params)
+        assert host.num_leaves - 1 == len(ref_tree.split_seq), \
+            (trial, params)
+        for i, (node, f, thr, dl) in enumerate(ref_tree.split_seq):
+            assert host.split_feature_inner[i] == f, (trial, params, i)
+            assert host.threshold_bin[i] == thr, (trial, params, i)
+        np.testing.assert_array_equal(leaf_id, ref_leaf_id,
+                                      err_msg=str((trial, params)))
