@@ -730,7 +730,8 @@ class Booster:
         Booster.eval — the data must be the training set or one added via
         add_valid, like the reference's data_idx lookup)."""
         if data is self.train_set:
-            return self.eval_train(feval)
+            return [(name, n, v, h)
+                    for _d, n, v, h in self.eval_train(feval)]
         for vs, vname in zip(self.valid_sets, self.name_valid_sets):
             if data is vs:
                 return [(name, n, v, h)
@@ -1002,6 +1003,7 @@ class Booster:
         t = self._engine.models[tree_id]
         t.leaf_value = np.asarray(t.leaf_value, np.float64).copy()
         t.leaf_value[leaf_id] = float(value)
+        self._engine._dev_pred_cache = None  # stacked trees are stale
         return self
 
     def trees_to_dataframe(self):
@@ -1137,6 +1139,7 @@ class Booster:
         """Randomly permute the trees of the given iteration window
         (ref: basic.py:4416 shuffle_models; used before refit)."""
         eng = self._engine
+        eng._dev_pred_cache = None  # stacked-tree cache is order-sensitive
         K = eng.num_tree_per_iteration
         n_iter = len(eng.models) // max(K, 1)
         end = n_iter if end_iteration <= 0 else min(end_iteration, n_iter)

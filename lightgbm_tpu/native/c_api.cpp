@@ -592,7 +592,7 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
     for (int64_t k = ptr_at(r); k < ptr_at(r + 1); ++k) {
       double v = data_type == 0 ? static_cast<const float*>(data)[k]
                                 : static_cast<const double*>(data)[k];
-      if (indices[k] < num_col) row[indices[k]] = v;
+      if (indices[k] >= 0 && indices[k] < num_col) row[indices[k]] = v;
     }
   };
   return PredictRows(m, fill, nrow, num_col, predict_type,

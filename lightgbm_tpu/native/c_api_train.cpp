@@ -181,6 +181,13 @@ std::string PyStr(const char* s) {
   return out;
 }
 
+// python snippet: parse a "k=v k2=v2" / comma-separated parameter
+// string into dict `p` (single definition — keep call sites in sync)
+std::string ParamsDict(const char* parameters) {
+  return "p = dict(kv.split('=', 1) for kv in " + PyStr(parameters) +
+         ".replace(',', ' ').split() if '=' in kv)\n";
+}
+
 }  // namespace
 
 // hooks shared with c_api.cpp (serving side routes through these)
@@ -218,8 +225,7 @@ int LGBM_DatasetCreateFromMat(const void* data, int data_type,
       "a = _np.ctypeslib.as_array(buf).astype(_np.float64).copy()\n" +
       (is_row_major ? "a = a.reshape(n, f)\n"
                     : "a = a.reshape(f, n).T.copy()\n") +
-      "p = dict(kv.split('=', 1) for kv in " + PyStr(parameters) +
-      ".replace(',', ' ').split() if '=' in kv)\n" +
+      ParamsDict(parameters) +
       "_lgbm_capi['obj'][" + idbuf + "] = {'X': a, 'params': p, "
       "'fields': {}}\n";
   if (RunGuarded(body) != 0) {
@@ -366,8 +372,7 @@ int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
   std::string body =
       CsrFromBuffers(indptr, indptr_type, indices, data, data_type,
                      nindptr, nelem, num_col) +
-      "p = dict(kv.split('=', 1) for kv in " + PyStr(parameters) +
-      ".replace(',', ' ').split() if '=' in kv)\n" +
+      ParamsDict(parameters) +
       "_lgbm_capi['obj'][" + std::to_string(h->id) + "] = "
       "{'X': csr, 'params': p, 'fields': {}}\n";
   if (RunGuarded(body) != 0) {
@@ -580,8 +585,8 @@ int LGBM_BoosterRefit(void* handle, const double* leaf_preds,
                       int32_t nrow, int32_t ncol) {
   // the reference refits from externally computed leaf predictions
   // (c_api.h:821); this engine refits from the booster's own training
-  // data (Booster.refit semantics) — leaf_preds is validated for shape
-  // but the traversal is recomputed internally
+  // data (Booster.refit semantics), recomputing the traversal itself —
+  // the leaf_preds buffer and its shape are ignored
   (void)leaf_preds;
   (void)nrow;
   (void)ncol;

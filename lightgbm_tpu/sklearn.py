@@ -377,9 +377,12 @@ class LGBMModel(_SKBase):
     @property
     def feature_names_in_(self) -> np.ndarray:
         """sklearn-compatible feature names (ref: sklearn.py:1368);
-        raises AttributeError for anonymous (Column_N) features so
-        sklearn's hasattr-based checks behave like the reference."""
-        self._check_fitted()
+        raises AttributeError when unfitted or for anonymous (Column_N)
+        features so sklearn's hasattr-based checks behave like the
+        reference."""
+        if self._Booster is None:
+            raise AttributeError(
+                "No feature_names_in_ found. Need to call fit beforehand.")
         names = self._Booster.feature_name()
         if all(n.startswith("Column_") for n in names):
             raise AttributeError(

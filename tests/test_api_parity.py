@@ -290,3 +290,20 @@ def test_booster_eval_and_histogram(rng):
                       "verbose": -1, "min_data_in_leaf": 5}, ds,
                      num_boost_round=2)
     assert np.isfinite(bst2.predict(X)).all()
+
+
+def test_device_predict_cache_invalidation(rng):
+    """Mutating the model (set_leaf_output / shuffle_models) must not
+    serve stale device-predict caches."""
+    X = rng.normal(size=(300, 4))
+    y = X[:, 0] * 2 + rng.normal(scale=0.1, size=300)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbose": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    before = bst.predict(X, device=True)
+    old = bst.get_leaf_output(0, 1)
+    bst.set_leaf_output(0, 1, old + 5.0)
+    after = bst.predict(X, device=True)
+    host = bst.predict(X)
+    np.testing.assert_allclose(after, host, rtol=1e-5, atol=1e-6)
+    assert np.abs(after - before).max() > 1e-3  # the mutation is visible
