@@ -338,7 +338,11 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     pmeta = partition_meta if partition_meta is not None else meta
 
     use_mc = meta.monotone is not None
-    use_mc_inter = use_mc and cfg.mc_method == "intermediate"
+    # intermediate machinery (leaf boxes + contiguous-leaf tightening +
+    # gated rescan) underpins BOTH refined modes; advanced additionally
+    # recomputes child bounds from geometry at split time
+    use_mc_inter = use_mc and cfg.mc_method in ("intermediate", "advanced")
+    use_mc_adv = use_mc and cfg.mc_method == "advanced"
     if use_mc_inter:
         if pool_none:
             raise ValueError("monotone_constraints_method=intermediate "
@@ -894,15 +898,76 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                                    0)
                 is_num = (rec.num_cat == 0) if has_cat else jnp.bool_(True)
                 mono_f = jnp.where(is_num, mono_f, 0)
+                if use_mc_adv:
+                    # advanced: each child's bounds are RECOMPUTED from
+                    # the full current-leaf geometry instead of inherited
+                    # from the parent's scalars (ref role:
+                    # AdvancedLeafConstraints' per-threshold refinement,
+                    # monotone_constraints.hpp:859 — a leaf linked to the
+                    # parent through the half that became the OTHER child
+                    # no longer constrains this one). The pairwise test
+                    # below enumerates the complete constraint set, so
+                    # direct enforcement stays sound while bounds only
+                    # get looser (= more accurate) than intermediate's.
+                    fsafe_a = jnp.maximum(rec.feature, 0)
+                    flo_pa = state.leaf_flo[l]
+                    fhi_pa = state.leaf_fhi[l]
+                    a_left_fhi = jnp.where(
+                        is_num, fhi_pa.at[fsafe_a].set(rec.threshold),
+                        fhi_pa)
+                    a_right_flo = jnp.where(
+                        is_num, flo_pa.at[fsafe_a].set(rec.threshold + 1),
+                        flo_pa)
+                    ac_flo = jnp.stack([flo_pa, a_right_flo])   # [2, F]
+                    ac_fhi = jnp.stack([a_left_fhi, fhi_pa])
+                    lar_a = jnp.arange(L)
+                    exists_j = (lar_a < state.num_leaves) & (lar_a != l)
+                    ov_a = ((state.leaf_flo[:, None, :] <=
+                             ac_fhi[None, :, :]) &
+                            (state.leaf_fhi[:, None, :] >=
+                             ac_flo[None, :, :]))
+                    n_sep_a = jnp.sum(~ov_a, axis=2)            # [L, 2]
+                    sep_a = jnp.argmax(~ov_a, axis=2)
+                    msep_a = pmeta.monotone[sep_a]
+                    linked_a = ((n_sep_a == 1) & (msep_a != 0) &
+                                exists_j[:, None])
+                    jl = jnp.take_along_axis(state.leaf_flo, sep_a, axis=1)
+                    jh = jnp.take_along_axis(state.leaf_fhi, sep_a, axis=1)
+                    cl = jnp.take_along_axis(
+                        jnp.broadcast_to(ac_flo[None], (L, 2, F)),
+                        sep_a[..., None], axis=2)[..., 0]
+                    ch = jnp.take_along_axis(
+                        jnp.broadcast_to(ac_fhi[None], (L, 2, F)),
+                        sep_a[..., None], axis=2)[..., 0]
+                    j_below = jh < cl      # j below the child
+                    j_above = jl > ch
+                    inc_a = msep_a > 0
+                    # j ABOVE bounds the child's max when increasing
+                    ub_on_c = linked_a & jnp.where(inc_a, j_above, j_below)
+                    lb_on_c = linked_a & jnp.where(inc_a, j_below, j_above)
+                    jout = state.value[:, None]
+                    geo_max = jnp.min(
+                        jnp.where(ub_on_c, jout, jnp.inf), axis=0)  # [2]
+                    geo_min = jnp.max(
+                        jnp.where(lb_on_c, jout, -jnp.inf), axis=0)
+                    base_lmin, base_lmax = geo_min[0], geo_max[0]
+                    base_rmin, base_rmax = geo_min[1], geo_max[1]
+                else:
+                    base_lmin = base_rmin = p_min
+                    base_lmax = base_rmax = p_max
                 if use_mc_inter:
                     bl = rec.right_output   # left child's bound source
                     br = rec.left_output    # right child's bound source
                 else:
                     bl = br = (rec.left_output + rec.right_output) * 0.5
-                l_min = jnp.where(mono_f < 0, jnp.maximum(p_min, bl), p_min)
-                l_max = jnp.where(mono_f > 0, jnp.minimum(p_max, bl), p_max)
-                r_min = jnp.where(mono_f > 0, jnp.maximum(p_min, br), p_min)
-                r_max = jnp.where(mono_f < 0, jnp.minimum(p_max, br), p_max)
+                l_min = jnp.where(mono_f < 0,
+                                  jnp.maximum(base_lmin, bl), base_lmin)
+                l_max = jnp.where(mono_f > 0,
+                                  jnp.minimum(base_lmax, bl), base_lmax)
+                r_min = jnp.where(mono_f > 0,
+                                  jnp.maximum(base_rmin, br), base_rmin)
+                r_max = jnp.where(mono_f < 0,
+                                  jnp.minimum(base_rmax, br), base_rmax)
             else:
                 l_min = r_min = p_min
                 l_max = r_max = p_max

@@ -189,17 +189,10 @@ class GBDT:
                 monotone = mc_in[train.used_feature_map]
         mc_method = cfg.monotone_constraints_method
         if monotone is not None:
-            if mc_method == "advanced":
-                # intermediate is the strongest implemented mode (the
-                # reference's advanced per-threshold refinement,
-                # monotone_constraints.hpp:859, is not carried over)
-                log.warning("monotone_constraints_method=advanced not "
-                            "implemented; using 'intermediate'")
-                mc_method = "intermediate"
-            if mc_method == "intermediate" and (
+            if mc_method in ("intermediate", "advanced") and (
                     cfg.extra_trees or
                     cfg.tree_learner in ("voting", "feature")):
-                log.warning("monotone_constraints_method=intermediate is "
+                log.warning(f"monotone_constraints_method={mc_method} is "
                             "supported with the serial/data learners and "
                             "without extra_trees; using 'basic'")
                 mc_method = "basic"
@@ -437,7 +430,8 @@ class GBDT:
                     log.warning(
                         "histogram pool exceeds the budget but forced "
                         "splits need it; keeping the full pool")
-                elif self.grower_cfg.mc_method == "intermediate" and \
+                elif self.grower_cfg.mc_method in ("intermediate",
+                                                   "advanced") and \
                         self.feature_meta is not None and \
                         self.feature_meta.monotone is not None:
                     log.warning(

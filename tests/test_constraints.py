@@ -270,3 +270,22 @@ def test_monotone_intermediate_compact_sched(rng):
     bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=15)
     assert _is_monotone(bst, X, 0, +1)
     assert _is_monotone(bst, X, 1, -1)
+
+
+def test_monotone_advanced_at_least_intermediate(rng):
+    """Advanced mode (geometric child-bound recompute) must keep
+    monotonicity and fit at least as well as intermediate (its bounds
+    are provably looser-or-equal)."""
+    X, y = _make_data(rng, n=900)
+    base = {"objective": "regression", "num_leaves": 31,
+            "min_data_in_leaf": 5, "verbosity": -1,
+            "monotone_constraints": [1, -1, 0]}
+    fits = {}
+    for method in ("intermediate", "advanced"):
+        bst = lgb.train({**base, "monotone_constraints_method": method},
+                        lgb.Dataset(X, label=y), num_boost_round=25)
+        assert _is_monotone(bst, X, 0, +1), method
+        assert _is_monotone(bst, X, 1, -1), method
+        pred = bst.predict(X)
+        fits[method] = 1 - np.var(y - pred) / np.var(y)
+    assert fits["advanced"] > fits["intermediate"] - 0.02, fits
