@@ -550,6 +550,53 @@ int LGBM_BoosterSaveModel(void* handle, int start_iteration,
   return RunGuarded(body);
 }
 
+namespace {
+
+// shared python snippet: the engine-side raw score of train (idx 0) or
+// the (idx-1)-th valid set, flattened [K*N] f64 as variable `sc`
+std::string ScoreSnippet(uint64_t id, int data_idx) {
+  std::string eng = "_e = _lgbm_capi['obj'][" + std::to_string(id) +
+                    "]['booster']._engine\n";
+  if (data_idx == 0)
+    return eng + "sc = _np.asarray(_e.score, _np.float64).reshape(-1)\n";
+  return eng + "sc = _np.asarray(_e.valid_sets[" +
+         std::to_string(data_idx - 1) +
+         "].score, _np.float64).reshape(-1)\n";
+}
+
+}  // namespace
+
+int LGBM_BoosterGetNumPredict(void* handle, int data_idx,
+                              int64_t* out_len) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !out_len) {
+    LgbmTrainSetError("BoosterGetNumPredict: not a training Booster "
+                      "handle");
+    return -1;
+  }
+  std::string body =
+      ScoreSnippet(h->id, data_idx) +
+      "_ct.c_int64.from_address(" + Addr(out_len) +
+      ").value = sc.size\n";
+  return RunGuarded(body);
+}
+
+int LGBM_BoosterGetPredict(void* handle, int data_idx, int64_t* out_len,
+                           double* out_result) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !out_len || !out_result) {
+    LgbmTrainSetError("BoosterGetPredict: not a training Booster handle");
+    return -1;
+  }
+  std::string body =
+      ScoreSnippet(h->id, data_idx) +
+      "_ct.c_int64.from_address(" + Addr(out_len) +
+      ").value = sc.size\n" +
+      "_ct.memmove(" + Addr(out_result) +
+      ", _np.ascontiguousarray(sc).ctypes.data, sc.size * 8)\n";
+  return RunGuarded(body);
+}
+
 int LGBM_BoosterGetLeafValue(void* handle, int tree_idx, int leaf_idx,
                              double* out_val) {
   TrainHandle* h = AsTrainHandle(handle);

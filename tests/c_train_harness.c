@@ -39,6 +39,8 @@ extern int LGBM_BoosterGetEvalNames(void*, const int, int*,
                                     const size_t, size_t*, char**);
 extern int LGBM_BoosterRollbackOneIter(void*);
 extern int LGBM_BoosterGetLeafValue(void*, int, int, double*);
+extern int LGBM_BoosterGetNumPredict(void*, int, int64_t*);
+extern int LGBM_BoosterGetPredict(void*, int, int64_t*, double*);
 extern int LGBM_BoosterSetLeafValue(void*, int, int, double);
 extern int LGBM_BoosterNumberOfTotalModel(void*, int*);
 extern int LGBM_BoosterSaveModelToString(void*, int, int, int,
@@ -162,6 +164,27 @@ int main(int argc, char** argv) {
     fprintf(stderr, "FAIL: train/serve mismatch %g\n", maxd);
     return 1;
   }
+
+  /* training-score retrieval (inner predict) */
+  int64_t np_len = 0;
+  CHECK(LGBM_BoosterGetNumPredict(bst, 0, &np_len));
+  if (np_len != n) {
+    fprintf(stderr, "FAIL GetNumPredict: %lld\n", (long long)np_len);
+    return 1;
+  }
+  double* inner = malloc(sizeof(double) * np_len);
+  CHECK(LGBM_BoosterGetPredict(bst, 0, &np_len, inner));
+  /* raw training scores track the model's predictions */
+  double dmax = 0;
+  for (int i = 0; i < n; ++i) {
+    double d = fabs(inner[i] - pred[i]);
+    if (d > dmax) dmax = d;
+  }
+  if (!(dmax < 1e-3)) {
+    fprintf(stderr, "FAIL GetPredict drift: %g\n", dmax);
+    return 1;
+  }
+  free(inner);
 
   /* leaf get/set round-trip */
   double lv = 0;

@@ -289,3 +289,19 @@ def test_monotone_advanced_at_least_intermediate(rng):
         pred = bst.predict(X)
         fits[method] = 1 - np.var(y - pred) / np.var(y)
     assert fits["advanced"] > fits["intermediate"] - 0.02, fits
+
+
+@pytest.mark.parametrize("method", ["intermediate", "advanced"])
+def test_monotone_refined_with_quantized(rng, method):
+    """Refined monotone modes compose with quantized int8 gradients
+    (the rescan converts the int32 pool through the shared scales)."""
+    X, y = _make_data(rng)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "min_data_in_leaf": 5, "verbosity": -1,
+                     "monotone_constraints": [1, -1, 0],
+                     "monotone_constraints_method": method,
+                     "use_quantized_grad": True,
+                     "stochastic_rounding": False},
+                    lgb.Dataset(X, label=y), num_boost_round=15)
+    assert _is_monotone(bst, X, 0, +1)
+    assert _is_monotone(bst, X, 1, -1)
