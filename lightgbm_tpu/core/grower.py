@@ -190,7 +190,8 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                      partition_meta: Optional[FeatureMeta] = None,
                      bundle=None,
                      reduce_max: Optional[Callable] = None,
-                     localize_key: Optional[Callable] = None):
+                     localize_key: Optional[Callable] = None,
+                     prepare_is_pure: bool = False):
     """Build the tree-growing function for a fixed dataset geometry.
 
     Returns ``grow(bins_t, gh, feature_mask, cegb) -> (TreeArrays, leaf_id)``
@@ -256,7 +257,11 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     # every device computes the identical best split from the reduced
     # histograms, so the branch is uniform across the mesh.
     distributed = reduce_hist is not None
-    has_scan_hooks = (prepare_split_hist is not None or
+    # "pure" prepare hooks (multival's default-bin fix) are plain local
+    # transforms, safe to re-apply in the refined-monotone rescan;
+    # voting's vote/psum and feature-parallel's select are not
+    has_scan_hooks = ((prepare_split_hist is not None and
+                       not prepare_is_pure) or
                       select_best is not None)
     # feature-sharded layout (feature-parallel): bins hold a LOCAL column
     # slice; the partition column comes from the owner via the

@@ -358,16 +358,11 @@ class GBDT:
             if self._tree_learner != "serial":
                 fallback.append(f"tree_learner={self._tree_learner}")
                 self._tree_learner = "serial"
-            if self.grower_cfg.mc_method != "basic" and \
-                    monotone is not None:
-                fallback.append("monotone intermediate")
             if fallback:
-                log.warning("multi-value sparse storage is serial-only "
-                            "with basic monotone mode; overriding: "
-                            + ", ".join(fallback))
+                log.warning("multi-value sparse storage is serial-only; "
+                            "overriding: " + ", ".join(fallback))
             self.grower_cfg = dataclasses.replace(
-                self.grower_cfg, mc_method="basic",
-                hist_backend="multival")
+                self.grower_cfg, hist_backend="multival")
         self._compact = self.grower_cfg.row_sched == "compact"
 
         # ---- EFB bundling (ref: dataset.cpp:112 FindGroups) -----------
@@ -466,7 +461,8 @@ class GBDT:
                 self.grower_cfg, self.feature_meta,
                 fetch_bin_column=make_fetch_bin_column(dflt),
                 prepare_split_hist=make_default_bin_fix(
-                    dflt, self.num_bin_max)))
+                    dflt, self.num_bin_max),
+                prepare_is_pure=True))
         elif self._tree_learner == "serial":
             self._grow = jax.jit(
                 make_tree_grower(self.grower_cfg, self.feature_meta,

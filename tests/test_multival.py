@@ -79,6 +79,7 @@ def test_multival_monotone_and_sampling(rng):
     mono = [1] + [0] * (X.shape[1] - 1)
     bst = _train(sp_mat, y, {"objective": "binary",
                              "tpu_sparse_storage": "multival",
+                             "monotone_constraints_method": "intermediate",
                              "monotone_constraints": mono,
                              "feature_fraction": 0.8,
                              "bagging_fraction": 0.7, "bagging_freq": 1})
