@@ -900,7 +900,9 @@ class Booster:
         # empty ranges and prediction early stop fall back to the host
         # path. On success `raw` falls through to the shared output tail.
         raw = None
-        if (kwargs.get("device") and not es):
+        use_device = kwargs.get(
+            "device", self.params.get("tpu_predict_device", False))
+        if (use_device and not es):
             try:
                 raw = eng.predict_device(X, start_iteration, end_iteration)
             except ValueError as e:

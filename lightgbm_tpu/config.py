@@ -234,6 +234,8 @@ _reg("tpu_min_bucket", int, 2048, ())        # smallest pow2 segment bucket
 _reg("tpu_use_pallas", bool, False, ())      # Pallas histogram kernel (off until tuned)
 _reg("tpu_rows_per_block", int, 1024, ())    # row tile for histogram kernels
 _reg("tpu_donate_state", bool, True, ())     # donate training state buffers
+_reg("tpu_predict_device", bool, False, ())  # batched device prediction
+                                             # (predict(..., device=True))
 # device tracing (SURVEY §5 tracing: jax.profiler traces + the named-
 # section wall-clock table ≡ the reference's USE_TIMETAG global_timer).
 # Set to a directory to capture a jax.profiler trace of the training loop
