@@ -1,0 +1,129 @@
+/* C ABI of lgbm_native.so — the implemented subset of the reference's
+ * include/LightGBM/c_api.h, signature-compatible so FFI callers can
+ * switch by swapping the shared library.
+ *
+ * Serving entry points (model loading + prediction) are pure C++ with
+ * no interpreter in the process. Training entry points lazily embed a
+ * Python interpreter (dlopen of libpython at first call; set
+ * LGBM_TPU_LIBPYTHON if it is not on the default search path) and
+ * drive the JAX engine; training calls must come from ONE thread.
+ *
+ * Every function returns 0 on success and -1 on failure;
+ * LGBM_GetLastError() describes the most recent failure.
+ */
+#ifndef LGBM_TPU_C_API_H_
+#define LGBM_TPU_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+/* dtype codes (ref: c_api.h C_API_DTYPE_*) */
+#define C_API_DTYPE_FLOAT32 (0)
+#define C_API_DTYPE_FLOAT64 (1)
+#define C_API_DTYPE_INT32 (2)
+#define C_API_DTYPE_INT64 (3)
+
+/* predict_type codes */
+#define C_API_PREDICT_NORMAL (0)
+#define C_API_PREDICT_RAW_SCORE (1)
+#define C_API_PREDICT_LEAF_INDEX (2)
+#define C_API_PREDICT_CONTRIB (3)
+
+const char* LGBM_GetLastError(void);
+
+/* ---- serving (interpreter-free) ---------------------------------- */
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out);
+int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out);
+int LGBM_BoosterFree(BoosterHandle handle);
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len);
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out);
+int LGBM_BoosterNumModelPerIteration(BoosterHandle handle, int* out);
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char* parameter, int64_t* out_len,
+                              double* out_result);
+int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char* parameter, int64_t* out_len,
+                              double* out_result);
+
+/* ---- training (embedded engine; single-threaded) ------------------ */
+int LGBM_DatasetCreateFromMat(const void* data, int data_type,
+                              int32_t nrow, int32_t ncol,
+                              int is_row_major, const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out);
+int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t nindptr,
+                              int64_t nelem, int64_t num_col,
+                              const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out);
+int LGBM_DatasetCreateFromFile(const char* filename,
+                               const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out);
+int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                         const void* field_data, int32_t num_element,
+                         int data_type);
+int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out);
+int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out);
+int LGBM_DatasetFree(DatasetHandle handle);
+
+int LGBM_BoosterCreate(DatasetHandle train_data, const char* parameters,
+                       BoosterHandle* out);
+int LGBM_BoosterAddValidData(BoosterHandle handle,
+                             DatasetHandle valid_data);
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
+int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
+int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle,
+                                   int* out_models);
+int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len);
+int LGBM_BoosterGetEvalNames(BoosterHandle handle, const int len,
+                             int* out_len, const size_t buffer_len,
+                             size_t* out_buffer_len, char** out_strs);
+int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
+                        double* out_results);
+int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                              int64_t* out_len);
+int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                           int64_t* out_len, double* out_result);
+int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double* out_val);
+int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double val);
+int LGBM_BoosterRefit(BoosterHandle handle, const double* leaf_preds,
+                      int32_t nrow, int32_t ncol);
+int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, int feature_importance_type,
+                          const char* filename);
+int LGBM_BoosterSaveModelToString(BoosterHandle handle,
+                                  int start_iteration, int num_iteration,
+                                  int feature_importance_type,
+                                  int64_t buffer_len, int64_t* out_len,
+                                  char* out_str);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* LGBM_TPU_C_API_H_ */

@@ -12,39 +12,7 @@
 #include <stdio.h>
 #include <stdlib.h>
 
-extern const char* LGBM_GetLastError(void);
-extern int LGBM_DatasetCreateFromMat(const void*, int, int32_t, int32_t,
-                                     int, const char*, const void*,
-                                     void**);
-extern int LGBM_DatasetSetField(void*, const char*, const void*, int32_t,
-                                int);
-extern int LGBM_DatasetGetNumData(void*, int32_t*);
-extern int LGBM_DatasetGetNumFeature(void*, int32_t*);
-extern int LGBM_DatasetFree(void*);
-extern int LGBM_BoosterCreate(void*, const char*, void**);
-extern int LGBM_BoosterUpdateOneIter(void*, int*);
-extern int LGBM_BoosterSaveModel(void*, int, int, int, const char*);
-extern int LGBM_BoosterGetCurrentIteration(void*, int*);
-extern int LGBM_BoosterPredictForMat(void*, const void*, int, int32_t,
-                                     int32_t, int, int, int, int,
-                                     const char*, int64_t*, double*);
-extern int LGBM_BoosterFree(void*);
-extern int LGBM_BoosterCreateFromModelfile(const char*, int*, void**);
-extern int LGBM_BoosterAddValidData(void*, void*);
-extern int LGBM_BoosterGetEval(void*, int, int*, double*);
-extern int LGBM_DatasetCreateFromFile(const char*, const char*,
-                                      const void*, void**);
-extern int LGBM_BoosterGetEvalCounts(void*, int*);
-extern int LGBM_BoosterGetEvalNames(void*, const int, int*,
-                                    const size_t, size_t*, char**);
-extern int LGBM_BoosterRollbackOneIter(void*);
-extern int LGBM_BoosterGetLeafValue(void*, int, int, double*);
-extern int LGBM_BoosterGetNumPredict(void*, int, int64_t*);
-extern int LGBM_BoosterGetPredict(void*, int, int64_t*, double*);
-extern int LGBM_BoosterSetLeafValue(void*, int, int, double);
-extern int LGBM_BoosterNumberOfTotalModel(void*, int*);
-extern int LGBM_BoosterSaveModelToString(void*, int, int, int,
-                                         long long, long long*, char*);
+#include "lgbm_c_api.h"
 
 #define CHECK(call)                                                   \
   do {                                                                \

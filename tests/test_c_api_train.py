@@ -28,7 +28,9 @@ def test_c_train_harness(tmp_path):
     assert os.path.exists(so_path)
     exe = str(tmp_path / "c_train")
     subprocess.run(
-        ["gcc", "-O1", os.path.join(REPO, "tests", "c_train_harness.c"),
+        ["gcc", "-O1",
+         "-I", os.path.join(REPO, "lightgbm_tpu", "native"),
+         os.path.join(REPO, "tests", "c_train_harness.c"),
          so_path, "-lm", "-o", exe],
         check=True, capture_output=True, timeout=120)
 
