@@ -1,0 +1,53 @@
+/* Minimal C consumer of the native ABI (lgbm_c_api.h).
+ *
+ * Build (the shared library self-builds on first python import):
+ *   python -c "from lightgbm_tpu.native import get_lib; get_lib()"
+ *   gcc -O2 -I ../../lightgbm_tpu/native train_and_predict.c \
+ *       ../../lightgbm_tpu/native/_build/lgbm_native.so -lm -o demo
+ *   LIGHTGBM_TPU_PLATFORM=cpu ./demo      # cpu pin for laptops
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "lgbm_c_api.h"
+
+int main(void) {
+  const int n = 500, f = 4;
+  double* X = malloc(sizeof(double) * n * f);
+  float* y = malloc(sizeof(float) * n);
+  unsigned s = 7;
+  for (int i = 0; i < n; ++i) {
+    for (int j = 0; j < f; ++j) {
+      s = s * 1664525u + 1013904223u;
+      X[i * f + j] = (double)(s >> 8) / (1u << 24) - 0.5;
+    }
+    y[i] = (float)(2.0 * X[i * f] - X[i * f + 1]);
+  }
+
+  DatasetHandle ds;
+  BoosterHandle bst;
+  if (LGBM_DatasetCreateFromMat(X, C_API_DTYPE_FLOAT64, n, f, 1, "",
+                                NULL, &ds) ||
+      LGBM_DatasetSetField(ds, "label", y, n, C_API_DTYPE_FLOAT32) ||
+      LGBM_BoosterCreate(ds, "objective=regression num_leaves=15 "
+                             "min_data_in_leaf=5 verbosity=-1", &bst)) {
+    fprintf(stderr, "setup failed: %s\n", LGBM_GetLastError());
+    return 1;
+  }
+  int finished = 0;
+  for (int it = 0; it < 20 && !finished; ++it)
+    LGBM_BoosterUpdateOneIter(bst, &finished);
+
+  double pred[4];
+  int64_t len = 0;
+  LGBM_BoosterPredictForMat(bst, X, C_API_DTYPE_FLOAT64, 1, f, 1,
+                            C_API_PREDICT_NORMAL, 0, 0, "", &len, pred);
+  printf("prediction for row 0: %g (label %g)\n", pred[0], y[0]);
+
+  LGBM_BoosterSaveModel(bst, 0, -1, 0, "model.txt");
+  LGBM_BoosterFree(bst);
+  LGBM_DatasetFree(ds);
+  printf("model saved to model.txt (servable with zero Python via "
+         "LGBM_BoosterCreateFromModelfile)\n");
+  return 0;
+}
