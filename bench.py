@@ -36,10 +36,13 @@ BENCH_PROBE_SEC = int(os.environ.get("BENCH_PROBE_SEC", 420))
 
 N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
 N_FEATURES = 28
-NUM_LEAVES = 255
+NUM_LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
 MAX_BIN = 255
 WARMUP_ITERS = 3
 TIMED_ITERS = int(os.environ.get("BENCH_ITERS", 20))
+# extra params merged into the training config (JSON), e.g.
+# BENCH_EXTRA='{"tpu_hist_dtype":"bfloat16"}' or '{"use_quantized_grad":true}'
+BENCH_EXTRA = json.loads(os.environ.get("BENCH_EXTRA", "{}"))
 REF_HIGGS_IPS = 500.0 / 130.094     # docs/Experiments.rst:113
 REF_HIGGS_ROWS = 10_500_000
 
@@ -48,14 +51,40 @@ REF_HIGGS_ROWS = 10_500_000
 SCHED_MODES = os.environ.get("BENCH_SCHEDS", "compact,full").split(",")
 
 
+# non-default configs (leaves ladder, dtype modes) are labeled so their
+# numbers can't masquerade as the headline metric
+_SUFFIX = ""
+if NUM_LEAVES != 255:
+    _SUFFIX += f"_L{NUM_LEAVES}"
+if BENCH_EXTRA:
+    _SUFFIX += "_" + "_".join(
+        f"{k}={v}" for k, v in sorted(BENCH_EXTRA.items()))
+
+
 def _fail_line(note: str) -> str:
     return json.dumps({
-        "metric": f"higgs_synth_{N_ROWS}x{N_FEATURES}_iters_per_sec",
+        "metric": f"higgs_synth_{N_ROWS}x{N_FEATURES}"
+                  f"_iters_per_sec{_SUFFIX}",
         "value": 0.0,
         "unit": "iters/sec",
         "vs_baseline": 0.0,
         "note": note,
     })
+
+
+def _force_sync(arr) -> float:
+    """Barrier that actually waits for device completion.
+
+    On the tunneled axon backend `jax.block_until_ready` returns immediately
+    (async dispatch; the handle is "ready" before the computation ran), which
+    would let the timed loop measure dispatch instead of execution. Fetching a
+    scalar reduction to host is the only reliable barrier: device programs on
+    a single chip execute in dispatch order, so transferring the last output
+    proves everything before it finished. Costs one tunnel round-trip
+    (~70 ms measured), amortized over the timed iterations.
+    """
+    import jax.numpy as jnp
+    return float(jnp.sum(arr))
 
 
 def synth_higgs(n, f, seed=0):
@@ -83,6 +112,7 @@ def run_child(sched: str) -> None:
         "min_data_in_leaf": 20,
         "verbose": -1,
         "tpu_row_scheduling": sched,
+        **BENCH_EXTRA,
     }
     ds = lgb.Dataset(X, label=y)
     if os.environ.get("BENCH_PROBE_COMPILE", "1") == "1":
@@ -93,8 +123,7 @@ def run_child(sched: str) -> None:
         t0 = time.perf_counter()
         probe_b = lgb.Booster(dict(params, num_leaves=31), ds)
         probe_b.update()
-        import jax
-        jax.block_until_ready(probe_b._engine.score)
+        _force_sync(probe_b._engine.score)
         print(f"[bench] 31-leaf probe compile+step ok "
               f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
         del probe_b
@@ -102,22 +131,43 @@ def run_child(sched: str) -> None:
     for _ in range(WARMUP_ITERS):      # compile + cache warm
         booster.update()
 
-    import jax
-    jax.block_until_ready(booster._engine.score)
+    _force_sync(booster._engine.score)
     from lightgbm_tpu.utils.timer import global_timer
     global_timer.reset()  # drop warmup/compile time from the table
     t0 = time.perf_counter()
     for _ in range(TIMED_ITERS):
         booster.update()
-    jax.block_until_ready(booster._engine.score)
+    _force_sync(booster._engine.score)
     dt = time.perf_counter() - t0
 
     ips = TIMED_ITERS / dt
     if global_timer.enabled:
         print(global_timer.table(), file=sys.stderr)
+    # quality line (stderr): lets dtype/kernel modes prove they didn't
+    # trade accuracy for speed — same data, same iteration count
+    try:
+        pred = booster._engine.score[0]
+        import jax.numpy as jnp
+        p = 1.0 / (1.0 + jnp.exp(-pred))
+        eps = 1e-7
+        ll = -jnp.mean(y * jnp.log(p + eps) +
+                       (1 - y) * jnp.log(1 - p + eps))
+        order = jnp.argsort(pred)
+        ranks = jnp.zeros_like(pred).at[order].set(
+            jnp.arange(1, pred.shape[0] + 1, dtype=pred.dtype))
+        n_pos = float(y.sum())
+        n_neg = float(len(y) - n_pos)
+        auc = (float(jnp.sum(ranks * y)) -
+               n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+        print(f"[bench] quality after {booster.current_iteration()} iters: "
+              f"train_logloss={float(ll):.5f} train_auc={auc:.5f}",
+              file=sys.stderr)
+    except Exception as e:          # quality line must never kill the bench
+        print(f"[bench] quality line failed: {e!r}", file=sys.stderr)
     ref_ips_at_n = REF_HIGGS_IPS * (REF_HIGGS_ROWS / N_ROWS)
     print(json.dumps({
-        "metric": f"higgs_synth_{N_ROWS}x{N_FEATURES}_iters_per_sec",
+        "metric": f"higgs_synth_{N_ROWS}x{N_FEATURES}"
+                  f"_iters_per_sec{_SUFFIX}",
         "value": round(ips, 4),
         "unit": "iters/sec",
         "vs_baseline": round(ips / ref_ips_at_n, 4),
@@ -151,7 +201,7 @@ def run_probe() -> None:
     booster = lgb.Booster({"objective": "binary", "num_leaves": 7,
                            "max_bin": 63, "verbose": -1}, ds)
     booster.update()
-    jax.block_until_ready(booster._engine.score)
+    _force_sync(booster._engine.score)
     print(json.dumps({"probe_ok": True, "devices": [str(d) for d in devs]}),
           flush=True)
 

@@ -229,11 +229,24 @@ _reg("tpu_row_scheduling", str, "compact", ())  # compact | full
 # dense packs every cell; multival stores only nonzero bins row-wise
 # [R, K]; auto picks multival for sufficiently sparse scipy inputs
 _reg("tpu_sparse_storage", str, "auto", ())  # auto | dense | multival
-_reg("tpu_partition_mode", str, "scatter", ())  # scatter | sort
+_reg("tpu_partition_mode", str, "auto", ())  # auto | scatter | sort
+# (auto: sort on TPU — measured 1.77 ms vs 5.17 ms scatter at 1M rows on
+#  v5e, docs/TPU_RUNBOOK.md; scatter on CPU)
 _reg("tpu_min_bucket", int, 2048, ())        # smallest pow2 segment bucket
 _reg("tpu_use_pallas", bool, False, ())      # Pallas histogram kernel (off until tuned)
 _reg("tpu_rows_per_block", int, 1024, ())    # row tile for histogram kernels
 _reg("tpu_donate_state", bool, True, ())     # donate training state buffers
+# async boosting: keep grown trees on device and defer host
+# materialization (HostTree build, threshold resolution) until a consumer
+# needs them. Hides host<->device transfer latency — essential when the
+# device is behind a high-latency tunnel (~70 ms/round-trip measured).
+# auto = on for TPU backends, off on CPU; true/false force.
+_reg("tpu_async_boosting", str, "auto", ())  # auto | true | false
+# with async boosting, the "no more leaves to split" stop condition is
+# checked every this many iterations (each check costs one device
+# round-trip); detection is exact — extra trees past the stop point are
+# rolled back so the final model matches the synchronous path
+_reg("tpu_stop_check_interval", int, 16, ())
 _reg("tpu_predict_device", bool, False, ())  # batched device prediction
                                              # (predict(..., device=True))
 # device tracing (SURVEY §5 tracing: jax.profiler traces + the named-

@@ -12,12 +12,26 @@ score update reuses the grower's per-row leaf_id (no traversal needed);
 valid scores update via batched device traversal over binned data.
 Host keeps the canonical model list (HostTree) for IO/serving, exactly
 mirroring models_ in the reference.
+
+Async boosting (tpu_async_boosting): when the device sits behind a
+high-latency transport (the tunneled TPU measures ~70 ms per host
+round-trip), any per-iteration host<->device sync caps throughput at
+~14 iters/s no matter how fast the chip is. The fast path therefore keeps
+every per-iteration product on device: grown trees accumulate as
+TreeArrays in ``_pending``; train/valid score updates read leaf values
+straight from the device tree; HostTree materialization (threshold
+resolution, shrinkage, model-list append) is deferred until a consumer
+touches ``models``. The "no more splits" stop condition is checked in
+batches (one scalar fetch per tpu_stop_check_interval iterations) and is
+exact: on detection the affected iterations are rolled back (scores
+subtracted, sampler RNG restored) and replayed through the synchronous
+path, so the final model matches the sync path tree-for-tree.
 """
 from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +48,17 @@ from ..ops.predict import tree_leaf_bins, tree_output_bins
 from ..utils import log
 from ..utils.timer import global_timer
 from .sample_strategy import SampleStrategy
+
+
+class _PendingTree(NamedTuple):
+    """A grown-but-not-yet-materialized tree (async boosting fast path)."""
+    tree: TreeArrays          # device arrays from the grower
+    k: int                    # class index within the iteration
+    it: int                   # boosting iteration that grew it
+    shrinkage: float          # rate to apply at materialization
+    bias: float               # init score to fold into leaf values
+    rng_state: Optional[dict]      # sampler RNG before this iteration
+    col_rng_state: Optional[dict]  # column-sampler RNG before this tree
 
 
 def _host_tree_to_arrays(t: HostTree, max_leaves: int) -> TreeArrays:
@@ -139,6 +164,13 @@ class GBDT:
         self.config = config
         self.train_set = train_set
         self.objective = objective
+        # async-boosting state must exist before the `models` setter runs
+        self._pending: List[_PendingTree] = []
+        self._stop_checked = 0        # pending entries already stop-checked
+        self._async_mode: Optional[bool] = None   # resolved lazily
+        self._async_disabled = False  # set on stop-rollback / fallbacks
+        self._async_upd_fn = None
+        self._async_trav_fn = None
         self.models: List[HostTree] = []
         self.iter = 0
         self.num_init_iteration = 0
@@ -160,6 +192,265 @@ class GBDT:
 
         if train_set is not None:
             self._setup_train(train_set)
+
+    # ---- async boosting: deferred host materialization ----------------
+    @property
+    def models(self) -> List[HostTree]:
+        """Canonical host model list. Materializes any trees still living
+        on device (async fast path) before returning, so every consumer —
+        IO, eval on models, SHAP, refit, DART drops — sees the full
+        ensemble. The returned list is the live internal list (callers
+        append/del in place, mirroring models_ in the reference)."""
+        self._flush_pending()
+        return self._models
+
+    @models.setter
+    def models(self, value: List[HostTree]) -> None:
+        self._flush_pending()   # never silently drop device-side trees
+        self._models = value
+
+    def _n_models_total(self) -> int:
+        """Model count including not-yet-materialized device trees."""
+        return len(self._models) + len(self._pending)
+
+    def _async_on(self) -> bool:
+        """Resolve (once) whether the sync-free fast path applies.
+
+        Requirements: plain GBDT boosting with the serial learner and no
+        per-iteration host feedback — no linear leaves (host lstsq), no
+        CEGB bookkeeping, no quantized leaf renewal, no L1-style
+        RenewTreeOutput, no position bias Newton step, and a sampler that
+        never reads gradients (bagging qualifies, GOSS does not)."""
+        if self._async_disabled:
+            return False
+        if self._async_mode is None:
+            mode = str(self.config.tpu_async_boosting).lower()
+            want = (jax.default_backend() != "cpu" if mode == "auto"
+                    else mode in ("true", "1", "yes", "on"))
+            self._async_mode = bool(
+                want and self.NAME == "gbdt"
+                and self._grow is not None
+                and self._gh_fn is not None
+                and self._tree_learner == "serial"
+                and not self._linear
+                and not self._cegb_enabled
+                and not (self.grower_cfg.quantized and
+                         self.config.quant_train_renew_leaf)
+                and (self.objective is None or
+                     not self.objective.is_renew_tree_output())
+                and not self._pos_bias
+                and not self.sample_strategy.needs_grad
+                and all(self.class_need_train))
+            if want and not self._async_mode:
+                log.info("tpu_async_boosting: falling back to the "
+                         "synchronous path (a per-iteration host step is "
+                         "required by the active features)")
+        return self._async_mode
+
+    def _flush_pending(self) -> None:
+        """Materialize pending device trees into HostTrees (batched).
+
+        One jnp.stack per tree field + one device_get of the stacked
+        pytree keeps the transfer count independent of how many trees are
+        pending (each transfer costs a full tunnel round-trip). The stop
+        check runs first so degenerate iterations are rolled back before
+        they could be materialized — a flush between periodic checks must
+        not let the 'no more splits' condition slip through."""
+        if not self._pending:
+            return
+        self._async_stop_check()
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        self._stop_checked = 0
+        with global_timer.section("Tree::ToHost"):
+            stacked = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                   *[p.tree for p in pending])
+            host_stacked = jax.device_get(stacked)
+        for i, p in enumerate(pending):
+            arrs = jax.tree.map(lambda x: x[i], host_stacked)
+            host = HostTree(arrs, self.train_set.used_feature_map)
+            if host.num_leaves <= 1:
+                # a per-class degenerate tree in an iteration where other
+                # classes still split (the all-degenerate case was rolled
+                # back by the stop check above): the device update masked
+                # its score contribution, so a constant tree keeps the
+                # model list aligned (ref: gbdt.cpp TrainOneIter appends
+                # a zero tree for classes with no valid split)
+                self._models.append(self._constant_tree(p.bias))
+                continue
+            self._finalize_tree(host)
+            host.shrink(p.shrinkage)
+            if abs(p.bias) > K_EPSILON:
+                host.add_bias(p.bias)
+            self._models.append(host)
+
+    def _async_stop_check(self) -> bool:
+        """Batched 'no more leaves to split' detection (exact).
+
+        Fetches num_leaves over the pending window in one round-trip.
+        An iteration stops training only when ALL K class trees are
+        degenerate (≡ should_continue in the sync path); a single
+        degenerate class among splitting ones just becomes a constant
+        tree at flush. The engine's first iteration is the exception —
+        its degenerate branch carries init-score side effects — so any
+        degenerate tree there rolls back too. On detection: roll back
+        every iteration from the stopping one (subtract score
+        contributions, restore sampler RNG), disable the fast path, and
+        let the caller's next train_one_iter replay those iterations
+        synchronously — the sync path then reproduces the reference's
+        stop behavior exactly."""
+        if self._stop_checked >= len(self._pending):
+            return False
+        new = self._pending[self._stop_checked:]
+        with global_timer.section("GBDT::StopCheck"):
+            nls = np.asarray(jax.device_get(
+                jnp.stack([p.tree.num_leaves for p in new])))
+        self._stop_checked = len(self._pending)
+        K = self.num_tree_per_iteration
+        degen_by_it: Dict[int, int] = {}
+        for p, nl in zip(new, nls):
+            if nl <= 1:
+                degen_by_it[p.it] = degen_by_it.get(p.it, 0) + 1
+        first_model_it = (self._pending[0].it
+                          if len(self._models) == 0 else -1)
+        stop_its = [it for it, cnt in degen_by_it.items()
+                    if cnt >= K or it == first_model_it]
+        if not stop_its:
+            return False
+        first_it = min(stop_its)
+        log.debug(f"async boosting: degenerate iteration {first_it}; "
+                  "rolling back and replaying synchronously")
+        self._async_rollback_from(first_it)
+        self._async_disabled = True
+        # Replay the first rolled-back iteration through the sync path NOW
+        # (not on the caller's next train_one_iter — a terminal flush from
+        # predict/save has no next iteration, which would silently drop
+        # the sync path's degenerate-iteration side effects, e.g. the
+        # first-iteration boost-from-average constant tree). Recursion is
+        # safe: _async_disabled is set, and the kept pending entries are
+        # already stop-checked, so the sync path's entry flush
+        # materializes them without re-entering this check.
+        return bool(self.train_one_iter())
+
+    def _async_traverse_add(self, score, tree_dev: TreeArrays, bins_dev,
+                            rate: float, k: int):
+        """score[k] += rate * tree(bins) with degenerate trees masked —
+        the one jitted traversal shared by valid-set updates (+rate) and
+        rollback (-rate); jax.jit caches per bins/score shape."""
+        if self._async_trav_fn is None:
+            meta = self.feature_meta
+
+            @jax.jit
+            def fn(score, tree, bins, rate, kk):
+                leaf = tree_leaf_bins(tree, bins, meta.num_bin,
+                                      meta.missing_type, meta.default_bin)
+                delta = jnp.where(tree.num_leaves > 1,
+                                  tree.leaf_value[leaf] * rate,
+                                  jnp.float32(0.0))
+                return score.at[kk].add(delta)
+
+            self._async_trav_fn = fn
+        return self._async_trav_fn(score, tree_dev, bins_dev,
+                                   jnp.float32(rate), k)
+
+    def _async_rollback_from(self, it0: int) -> None:
+        """Undo every pending iteration >= it0: subtract each tree's score
+        contribution (device traversal — the grower's leaf assignment and
+        tree_leaf_bins decide splits identically), undo any init score the
+        iteration's _boost_from_average added (the sync replay re-adds
+        it), and restore the sampler RNG states captured when the
+        iteration started."""
+        keep = [p for p in self._pending if p.it < it0]
+        drop = [p for p in self._pending if p.it >= it0]
+        for p in drop:
+            self.score = self._async_traverse_add(
+                self.score, p.tree, self.bins_dev, -p.shrinkage, p.k)
+            if abs(p.bias) > K_EPSILON:
+                self.score = self.score.at[p.k].add(-p.bias)
+            for vd in self.valid_sets:
+                vd.score = self._async_traverse_add(
+                    vd.score, p.tree, vd.bins_dev, -p.shrinkage, p.k)
+                if abs(p.bias) > K_EPSILON:
+                    vd.score = vd.score.at[p.k].add(-p.bias)
+        for p in drop:
+            if p.it == it0:
+                if p.rng_state is not None:
+                    self.sample_strategy.rng.bit_generator.state = \
+                        p.rng_state
+                if p.col_rng_state is not None:
+                    self._col_rng.bit_generator.state = p.col_rng_state
+                break
+        self._pending = keep
+        self._stop_checked = min(self._stop_checked, len(keep))
+        self.iter = it0
+
+    def _train_one_iter_async(self) -> bool:
+        """Sync-free TrainOneIter: every product stays on device; the only
+        host work is RNG draws and dispatch (see module docstring)."""
+        K = self.num_tree_per_iteration
+        init_scores = [0.0] * K
+        for k in range(K):
+            init_scores[k] = self._boost_from_average(k)
+        # RNG snapshots for exact rollback on deferred stop detection
+        samp_state = (self.sample_strategy.rng.bit_generator.state
+                      if getattr(self.sample_strategy, "rng", None)
+                      is not None else None)
+        with global_timer.section("GBDT::Boosting"):
+            grad, hess = self._gh_fn(self.score)
+            if K == 1:
+                grad = grad[None, :]
+                hess = hess[None, :]
+        sample = self.sample_strategy.sample(self.iter)
+        if sample is not None:
+            sel_dev = jnp.asarray(sample[0])
+            w_dev = jnp.asarray(sample[1])
+
+        if self._async_upd_fn is None:
+            donate = (0,) if self.config.tpu_donate_state else ()
+
+            def upd(score, lv, nl, leaf, rate, kk):
+                delta = jnp.where(nl > 1, lv[leaf] * rate, jnp.float32(0.0))
+                return score.at[kk].add(delta)
+
+            self._async_upd_fn = jax.jit(upd, donate_argnums=donate,
+                                         static_argnums=(5,))
+
+        for k in range(K):
+            col_state = self._col_rng.bit_generator.state
+            g, h = grad[k], hess[k]
+            if sample is not None:
+                gh = jnp.stack([g * w_dev, h * w_dev, sel_dev], axis=1)
+            else:
+                gh = jnp.stack([g, h, jnp.ones_like(g)], axis=1)
+            fmask = self._feature_mask()
+            rng_key = None
+            if self._grow_rng is not None:
+                rng_key = jax.random.fold_in(
+                    self._grow_rng, self.iter * K + k)
+            with global_timer.section("TreeLearner::Train"):
+                tree_dev, leaf_id = self._grow(
+                    self._train_bins(), gh, fmask,
+                    self._cegb_penalty(), rng_key)
+            rate = jnp.float32(self.shrinkage_rate)
+            with global_timer.section("GBDT::UpdateScore"):
+                self.score = self._async_upd_fn(
+                    self.score, tree_dev.leaf_value, tree_dev.num_leaves,
+                    leaf_id, rate, k)
+            for vd in self.valid_sets:
+                vd.score = self._async_traverse_add(
+                    vd.score, tree_dev, vd.bins_dev,
+                    self.shrinkage_rate, k)
+            self._pending.append(_PendingTree(
+                tree=tree_dev, k=k, it=self.iter,
+                shrinkage=self.shrinkage_rate, bias=init_scores[k],
+                rng_state=samp_state if k == 0 else None,
+                col_rng_state=col_state))
+        self.iter += 1
+        interval = max(1, int(self.config.tpu_stop_check_interval))
+        if self.iter % interval == 0:
+            return self._async_stop_check()
+        return False
 
     # ------------------------------------------------------------------
     def _setup_train(self, train: BinnedDataset) -> None:
@@ -269,11 +560,28 @@ class GBDT:
         # (einsum one-hot is pathologically slow there) and the MXU
         # einsum kernel on TPU.
         row_sched = cfg.tpu_row_scheduling
+        hist_dtype = cfg.tpu_hist_dtype
         rm_backend = cfg.tpu_hist_kernel
         if rm_backend == "auto":
-            rm_backend = ("scatter" if jax.default_backend() == "cpu"
-                          else "einsum")
-        hist_dtype = cfg.tpu_hist_dtype
+            if jax.default_backend() == "cpu":
+                rm_backend = "scatter"
+            elif (cfg.use_quantized_grad or
+                    hist_dtype in ("bfloat16", "bf16")):
+                # measured on v5e at 1M rows (docs/TPU_RUNBOOK.md): the
+                # VMEM-resident Pallas kernel does bf16 in 6.0 ms / int8
+                # in 5.6 ms vs the einsum's 16.5 / 16.3 ms
+                rm_backend = "pallas"
+            else:
+                # f32: einsum+HIGHEST measured 24 ms vs 34 ms for the
+                # in-kernel HIGHEST path; the bf16-triple kernel path is
+                # projected faster but flips only once device-measured
+                rm_backend = "einsum"
+        part_mode = cfg.tpu_partition_mode
+        if part_mode == "auto":
+            # measured on TPU v5e at 1M rows: sort 1.77 ms vs scatter
+            # 5.17 ms (docs/TPU_RUNBOOK.md); CPU favors scatter
+            part_mode = ("scatter" if jax.default_backend() == "cpu"
+                         else "sort")
         self.grower_cfg = GrowerConfig(
             num_leaves=cfg.num_leaves, max_depth=cfg.max_depth,
             num_bin=self.num_bin_max, hparams=hp, hist_backend=backend,
@@ -281,7 +589,7 @@ class GBDT:
             bynode_mask=self._bynode, interaction_groups=groups,
             row_sched=row_sched, hist_dtype=hist_dtype,
             hist_rm_backend=rm_backend,
-            partition_mode=cfg.tpu_partition_mode,
+            partition_mode=part_mode,
             min_bucket=cfg.tpu_min_bucket,
             quantized=bool(cfg.use_quantized_grad),
             quant_bins=int(cfg.num_grad_quant_bins),
@@ -847,7 +1155,7 @@ class GBDT:
 
     def _boost_from_average(self, k: int) -> float:
         """ref: gbdt.cpp:328 BoostFromAverage."""
-        if (not self.models and not self.has_init_score and
+        if (self._n_models_total() == 0 and not self.has_init_score and
                 self.objective is not None and
                 (self.config.boost_from_average or
                  self.num_used_features == 0)):
@@ -956,6 +1264,9 @@ class GBDT:
                        hessians: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration (ref: gbdt.cpp:353 TrainOneIter).
         Returns True when training should stop (no more valid splits)."""
+        if gradients is None and hessians is None and self._async_on():
+            return self._train_one_iter_async()
+        self._flush_pending()
         K = self.num_tree_per_iteration
         init_scores = [0.0] * K
 
@@ -983,8 +1294,13 @@ class GBDT:
                 np.asarray(hessians, np.float32).reshape(K, self.num_data))
 
         # -- bagging / GOSS (host decision, device apply) ---------------
-        sample = self.sample_strategy.sample(
-            self.iter, np.asarray(grad), np.asarray(hess))
+        # only GOSS reads gradients; skip the [K, N] device->host pull
+        # for RNG-only strategies (it costs a full tunnel round-trip)
+        if self.sample_strategy.needs_grad:
+            sample = self.sample_strategy.sample(
+                self.iter, np.asarray(grad), np.asarray(hess))
+        else:
+            sample = self.sample_strategy.sample(self.iter)
         if sample is not None:
             selected, weight = sample
             sel_dev = jnp.asarray(selected)

@@ -25,6 +25,11 @@ from ..utils import log
 class SampleStrategy:
     """Base: no sampling."""
 
+    # whether sample() reads grad/hess. Bagging decides from RNG alone, so
+    # the caller can skip the device->host gradient pull entirely (each
+    # pull is a full [K, N] transfer through the device tunnel per iter)
+    needs_grad = False
+
     def __init__(self, config: Config, num_data: int,
                  num_tree_per_iteration: int = 1):
         self.config = config
@@ -111,6 +116,8 @@ class GOSSStrategy(SampleStrategy):
     ``top_rate`` rows by sum_k |g_k * h_k|, randomly keep ``other_rate`` of
     the rest with g/h amplified by (n - top_k)/other_k. Starts after
     1/learning_rate iterations (ref: goss.hpp:33)."""
+
+    needs_grad = True
 
     def __init__(self, config: Config, num_data: int,
                  num_tree_per_iteration: int = 1):

@@ -126,16 +126,12 @@ def hist_rowmajor(bins_rm: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
     if backend == "pallas":
         # VMEM-resident one-hot kernel (no HBM traffic for the expansion)
         from .hist_pallas import hist_pallas_rm
-        if int8_mode:
-            # quantized path: int8 MXU contraction, exact int32 accumulation
-            return hist_pallas_rm(bins_rm, gh, num_bin,
-                                  block_rows=min(block_rows, 512))
-        if bf16:
-            # match the einsum bf16 path's numerics: gh rounded to bf16,
-            # accumulation in f32 (the one-hot side is exact either way)
-            gh = gh.astype(jnp.bfloat16).astype(jnp.float32)
-        return hist_pallas_rm(bins_rm, gh, num_bin,
-                              block_rows=min(block_rows, 512))
+        if bf16 and not int8_mode:
+            # native bf16 kernel path: gh rounded to bf16, one-hot exact,
+            # f32 accumulation (f32 inputs take the exact bf16-triple
+            # decomposition inside the kernel instead)
+            gh = gh.astype(jnp.bfloat16)
+        return hist_pallas_rm(bins_rm, gh, num_bin, block_rows=block_rows)
     if backend != "einsum":
         raise ValueError(f"unknown hist_rowmajor backend {backend!r}; "
                          "expected einsum | scatter | pallas")

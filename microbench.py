@@ -13,16 +13,33 @@ import time
 import numpy as np
 
 
-def timeit(fn, *args, iters=20, warmup=3):
+def _sync(out):
+    """Real device barrier: fetch a scalar from the last output.
+
+    `jax.block_until_ready` is a no-op through the axon tunnel (async
+    dispatch); a host transfer is the only honest barrier. Single-chip
+    programs run in dispatch order, so syncing the last output syncs all.
+    """
     import jax
+    import jax.numpy as jnp
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return float(jnp.sum(leaf.astype(jnp.float32)))
+
+
+def timeit(fn, *args, iters=20, warmup=3):
     for _ in range(warmup):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _sync(out)
+    # null-sync baseline: the one tunnel round-trip inside the timed loop
+    # (~70 ms) would otherwise bias per-iter times by round_trip/iters
+    t0 = time.perf_counter()
+    _sync(out)
+    rt = time.perf_counter() - t0
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+    _sync(out)
+    return max((time.perf_counter() - t0) - rt, 1e-9) / iters
 
 
 def bench_hist():
@@ -63,10 +80,11 @@ def bench_pallas_rm():
     bins_rm = jnp.asarray(rng.integers(0, B - 1, (R, F), dtype=np.uint8))
     gh = jnp.asarray(rng.normal(size=(R, 3)).astype(np.float32))
     ghq = jnp.asarray(rng.integers(-8, 8, (R, 3), dtype=np.int8))
-    for S in (16384, 131072, 1_048_576):
+    ghb = gh.astype(jnp.bfloat16)
+    for S in (131072, 1_048_576):
         for blk in (256, 512, 1024):
-            for ft in (4, 7, 14, 28):
-                for name, g in (("f32", gh), ("int8", ghq)):
+            for ft in (8, 16, 32):
+                for name, g in (("f32", gh), ("bf16", ghb), ("int8", ghq)):
                     try:
                         f = jax.jit(
                             lambda b, g, blk=blk, ft=ft: hist_pallas_rm(

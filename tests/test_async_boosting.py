@@ -1,0 +1,190 @@
+"""Async boosting fast path (tpu_async_boosting) vs the synchronous path.
+
+The async path keeps grown trees on device and defers HostTree
+materialization (models/gbdt.py _train_one_iter_async). It must produce
+the same ensemble as the sync path — same splits, same structure — with
+only f32 score-rounding drift in values (the sync path folds shrinkage
+into the score update on host in f64; the async path applies the f32
+rate on device), and stop conditions must be detected exactly despite
+the batched check.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=2000, f=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] +
+         0.1 * rng.normal(size=n) > 0).astype(np.float32)
+    return X, y
+
+
+def _train_pair(extra, n_round=30, n=2000, seed=0):
+    X, y = _data(n=n, seed=seed)
+    base = dict(objective="binary", num_leaves=15, learning_rate=0.1,
+                verbose=-1, seed=7, **extra)
+    m_sync = lgb.train(dict(base, tpu_async_boosting="false"),
+                       lgb.Dataset(X, label=y), num_boost_round=n_round)
+    m_async = lgb.train(dict(base, tpu_async_boosting="true"),
+                        lgb.Dataset(X, label=y), num_boost_round=n_round)
+    return X, m_sync, m_async
+
+
+def _structure(model):
+    """Split structure only (feature, threshold, counts) — excludes the
+    f32-rounding-sensitive value fields."""
+    out = []
+    for t in model._engine.models:
+        out.append((t.num_leaves, t.split_feature.tolist(),
+                    t.threshold_bin.tolist(), t.leaf_count.tolist()))
+    return out
+
+
+def test_async_matches_sync_plain():
+    X, m_sync, m_async = _train_pair({})
+    assert _structure(m_sync) == _structure(m_async)
+    np.testing.assert_allclose(m_sync.predict(X), m_async.predict(X),
+                               atol=1e-5)
+
+
+def test_async_matches_sync_bagging_feature_fraction():
+    X, m_sync, m_async = _train_pair(dict(
+        bagging_fraction=0.8, bagging_freq=1, feature_fraction=0.9))
+    assert _structure(m_sync) == _structure(m_async)
+    np.testing.assert_allclose(m_sync.predict(X), m_async.predict(X),
+                               atol=1e-5)
+
+
+def test_async_matches_sync_multiclass():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(1500, 8)).astype(np.float32)
+    y = (np.digitize(X[:, 0] + 0.3 * X[:, 1],
+                     [-0.5, 0.5])).astype(np.float32)
+    base = dict(objective="multiclass", num_class=3, num_leaves=7,
+                learning_rate=0.1, verbose=-1)
+    m_sync = lgb.train(dict(base, tpu_async_boosting="false"),
+                       lgb.Dataset(X, label=y), num_boost_round=10)
+    m_async = lgb.train(dict(base, tpu_async_boosting="true"),
+                        lgb.Dataset(X, label=y), num_boost_round=10)
+    assert _structure(m_sync) == _structure(m_async)
+    np.testing.assert_allclose(m_sync.predict(X), m_async.predict(X),
+                               atol=1e-5)
+
+
+def test_async_valid_set_eval_matches():
+    X, y = _data()
+    Xv, yv = _data(n=600, seed=1)
+    evals = {}
+    base = dict(objective="binary", num_leaves=15, learning_rate=0.1,
+                verbose=-1)
+    r = {}
+    for mode in ("false", "true"):
+        ds = lgb.Dataset(X, label=y)
+        rec = {}
+        lgb.train(dict(base, tpu_async_boosting=mode), ds,
+                  num_boost_round=15,
+                  valid_sets=[lgb.Dataset(Xv, label=yv, reference=ds)],
+                  valid_names=["v"],
+                  callbacks=[lgb.record_evaluation(rec)])
+        r[mode] = rec["v"]["binary_logloss"]
+    np.testing.assert_allclose(r["false"], r["true"], atol=1e-5)
+
+
+def test_async_stop_detection_exact():
+    """Training that runs out of splits mid-window must stop with the
+    same model as the sync path (rollback + sync replay)."""
+    rng = np.random.default_rng(5)
+    # tiny discrete dataset: only a handful of distinct split points, so
+    # boosting exhausts valid splits quickly (min_gain filters the rest)
+    X = rng.integers(0, 3, size=(200, 3)).astype(np.float32)
+    y = (X[:, 0] > 1).astype(np.float32)
+    base = dict(objective="binary", num_leaves=4, learning_rate=0.5,
+                min_data_in_leaf=5, min_gain_to_split=1e-3, verbose=-1,
+                tpu_stop_check_interval=7)
+    m_sync = lgb.train(dict(base, tpu_async_boosting="false"),
+                       lgb.Dataset(X, label=y), num_boost_round=60)
+    m_async = lgb.train(dict(base, tpu_async_boosting="true"),
+                        lgb.Dataset(X, label=y), num_boost_round=60)
+    assert m_sync.num_trees() == m_async.num_trees()
+    assert _structure(m_sync) == _structure(m_async)
+    np.testing.assert_allclose(m_sync.predict(X), m_async.predict(X),
+                               atol=1e-5)
+
+
+def test_async_stop_detected_via_flush():
+    """A consumer flushing models between periodic checks must not let
+    degenerate iterations slip through as constant trees."""
+    rng = np.random.default_rng(5)
+    X = rng.integers(0, 3, size=(200, 3)).astype(np.float32)
+    y = (X[:, 0] > 1).astype(np.float32)
+    base = dict(objective="binary", num_leaves=4, learning_rate=0.5,
+                min_data_in_leaf=5, min_gain_to_split=1e-3, verbose=-1,
+                tpu_stop_check_interval=1000)   # never checks periodically
+    counts = {}
+    for mode in ("false", "true"):
+        b = lgb.Booster(dict(base, tpu_async_boosting=mode),
+                        lgb.Dataset(X, label=y))
+        for _ in range(60):
+            b.update()
+            n = b.num_trees()        # flushes pending every iteration
+        counts[mode] = n
+    assert counts["true"] == counts["false"]
+
+
+def test_async_first_iteration_degenerate_terminal_flush():
+    """No valid split at iteration 0 + the flush happening only AFTER
+    training (predict/save) must still keep the sync path's
+    boost-from-average constant tree."""
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(400, 4)).astype(np.float32)
+    y = (rng.uniform(size=400) < 0.75).astype(np.float32)
+    base = dict(objective="binary", num_leaves=4, verbose=-1,
+                min_gain_to_split=1e6)     # nothing can split
+    out = {}
+    for mode in ("false", "true"):
+        b = lgb.train(dict(base, tpu_async_boosting=mode),
+                      lgb.Dataset(X, label=y), num_boost_round=10)
+        out[mode] = (b.num_trees(), float(b.predict(X[:1])[0]))
+    assert out["true"] == out["false"]
+    assert abs(out["false"][1] - 0.75) < 0.05   # base rate, not 0.5
+
+
+def test_async_model_io_roundtrip():
+    X, _, m_async = _train_pair({}, n_round=12)
+    s = m_async.model_to_string()
+    m2 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(m_async.predict(X), m2.predict(X),
+                               atol=1e-6)
+
+
+def test_async_fallback_features_use_sync():
+    """Features requiring per-iteration host work silently fall back."""
+    X, y = _data()
+    for extra in (dict(data_sample_strategy="goss", top_rate=0.3,
+                       other_rate=0.3),
+                  dict(linear_tree=True),
+                  dict(boosting="dart")):
+        params = dict(objective="binary", num_leaves=7, verbose=-1,
+                      tpu_async_boosting="true", **extra)
+        b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=25)
+        assert b.num_trees() > 0
+        eng = b._engine
+        assert not eng._pending  # nothing left on device
+
+
+def test_async_rollback_one_iter():
+    X, y = _data()
+    params = dict(objective="binary", num_leaves=15, verbose=-1,
+                  tpu_async_boosting="true")
+    ds = lgb.Dataset(X, label=y)
+    b = lgb.Booster(params, ds)
+    for _ in range(5):
+        b.update()
+    p5 = np.asarray(b._engine.score)   # copy: update() donates the buffer
+    b.update()
+    b.rollback_one_iter()
+    assert b.current_iteration() == 5
+    np.testing.assert_allclose(p5, np.asarray(b._engine.score), atol=1e-6)
