@@ -235,6 +235,11 @@ _reg("tpu_partition_mode", str, "auto", ())  # auto | scatter | sort
 _reg("tpu_min_bucket", int, 2048, ())        # smallest pow2 segment bucket
 _reg("tpu_use_pallas", bool, False, ())      # Pallas histogram kernel (off until tuned)
 _reg("tpu_rows_per_block", int, 1024, ())    # row tile for histogram kernels
+# bit-pack 4 uint8 bins per uint32 word for the compact scheduler's
+# per-leaf row gathers (TPU gathers cost per element; packing quarters
+# them). auto = off until device-measured; true/false force. Requires
+# all (possibly bundled) bins to fit uint8.
+_reg("tpu_packed_bins", str, "auto", ())     # auto | true | false
 _reg("tpu_donate_state", bool, True, ())     # donate training state buffers
 # async boosting: keep grown trees on device and defer host
 # materialization (HostTree build, threshold resolution) until a consumer

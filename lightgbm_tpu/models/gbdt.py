@@ -216,11 +216,15 @@ class GBDT:
     def _async_on(self) -> bool:
         """Resolve (once) whether the sync-free fast path applies.
 
-        Requirements: plain GBDT boosting with the serial learner and no
-        per-iteration host feedback — no linear leaves (host lstsq), no
-        CEGB bookkeeping, no quantized leaf renewal, no L1-style
-        RenewTreeOutput, no position bias Newton step, and a sampler that
-        never reads gradients (bagging qualifies, GOSS does not)."""
+        Requirements: plain GBDT boosting with no per-iteration host
+        feedback — no linear leaves (host lstsq), no CEGB bookkeeping,
+        no quantized leaf renewal, no L1-style RenewTreeOutput, no
+        position bias Newton step, and a sampler that never reads
+        gradients (bagging qualifies, GOSS does not). Any tree learner
+        qualifies: the distributed learners' collectives live inside the
+        jitted grower program, and the device trees they return are
+        replicated, so the deferred-materialization machinery is
+        learner-agnostic."""
         if self._async_disabled:
             return False
         if self._async_mode is None:
@@ -231,7 +235,6 @@ class GBDT:
                 want and self.NAME == "gbdt"
                 and self._grow is not None
                 and self._gh_fn is not None
-                and self._tree_learner == "serial"
                 and not self._linear
                 and not self._cegb_enabled
                 and not (self.grower_cfg.quantized and
@@ -319,19 +322,30 @@ class GBDT:
         if not stop_its:
             return False
         first_it = min(stop_its)
+        rolled_back = self.iter - first_it
         log.debug(f"async boosting: degenerate iteration {first_it}; "
-                  "rolling back and replaying synchronously")
+                  f"rolling back {rolled_back} iteration(s) and replaying "
+                  "synchronously")
         self._async_rollback_from(first_it)
         self._async_disabled = True
-        # Replay the first rolled-back iteration through the sync path NOW
-        # (not on the caller's next train_one_iter — a terminal flush from
-        # predict/save has no next iteration, which would silently drop
-        # the sync path's degenerate-iteration side effects, e.g. the
-        # first-iteration boost-from-average constant tree). Recursion is
-        # safe: _async_disabled is set, and the kept pending entries are
-        # already stop-checked, so the sync path's entry flush
-        # materializes them without re-entering this check.
-        return bool(self.train_one_iter())
+        # Replay EVERY rolled-back iteration through the sync path NOW —
+        # not on the caller's future train_one_iter calls: a terminal
+        # flush from predict/save has no next iteration (which would drop
+        # the sync path's degenerate side effects, e.g. the
+        # first-iteration boost-from-average constant tree), and the
+        # engine's fixed-round loop would otherwise end short by however
+        # many iterations the window held. The sync path stops the replay
+        # the moment the degeneracy is real for ALL classes, exactly like
+        # an all-sync run. Recursion is safe: _async_disabled is set, and
+        # the kept pending entries are already stop-checked, so the sync
+        # path's entry flush materializes them without re-entering this
+        # check.
+        finished = False
+        for _ in range(rolled_back):
+            finished = bool(self.train_one_iter())
+            if finished:
+                break
+        return finished
 
     def _async_traverse_add(self, score, tree_dev: TreeArrays, bins_dev,
                             rate: float, k: int):
@@ -577,11 +591,12 @@ class GBDT:
                 # projected faster but flips only once device-measured
                 rm_backend = "einsum"
         part_mode = cfg.tpu_partition_mode
-        if part_mode == "auto":
-            # measured on TPU v5e at 1M rows: sort 1.77 ms vs scatter
-            # 5.17 ms (docs/TPU_RUNBOOK.md); CPU favors scatter
-            part_mode = ("scatter" if jax.default_backend() == "cpu"
-                         else "sort")
+        if part_mode == "auto" and jax.default_backend() == "cpu":
+            # CPU favors scatter at every size; on TPU "auto" passes
+            # through to the grower, which picks sort for big buckets
+            # (1.77 vs 5.17 ms at 1M rows, docs/TPU_RUNBOOK.md) and
+            # scatter for small ones (lax.sort's fixed bitonic cost)
+            part_mode = "scatter"
         self.grower_cfg = GrowerConfig(
             num_leaves=cfg.num_leaves, max_depth=cfg.max_depth,
             num_bin=self.num_bin_max, hparams=hp, hist_backend=backend,
@@ -708,15 +723,42 @@ class GBDT:
 
         self.bins_rf = None
         self._bins_packed_dev = None
+        self._packed_cols = 0
         if (self._compact and self._tree_learner == "serial" and
                 train_bins_host is not None):
             # row-major copy for the gather path; bins_dev keeps the
             # feature-major layout used by prediction/traversal (the
             # distributed learners shard their own row-major copy)
-            self.bins_rf = jnp.asarray(
-                np.ascontiguousarray(train_bins_host.T))
+            pb = str(cfg.tpu_packed_bins).lower()
+            want_pack = (pb in ("true", "1", "yes", "on") or
+                         (pb == "auto" and False))  # auto: off until
+            #                          device measurements pick a default
+            if want_pack and self.num_bin_max <= 255:
+                # bit-pack 4 uint8 bins per uint32 word: quarters the
+                # element count of the compact scheduler's per-leaf row
+                # gathers (grower unpacks with shifts post-gather)
+                rm = np.ascontiguousarray(
+                    train_bins_host.T).astype(np.uint8)
+                Rn, Fn = rm.shape
+                W = (Fn + 3) // 4
+                full = np.zeros((Rn, W * 4), np.uint8)
+                full[:, :Fn] = rm
+                self.bins_rf = jnp.asarray(
+                    np.ascontiguousarray(full).view(np.uint32)
+                    .reshape(Rn, W))
+                self._packed_cols = Fn
+            else:
+                if want_pack:
+                    log.warning("tpu_packed_bins: bins exceed uint8 "
+                                f"(num_bin_max={self.num_bin_max}); "
+                                "storing unpacked")
+                self.bins_rf = jnp.asarray(
+                    np.ascontiguousarray(train_bins_host.T))
         elif self._bundle is not None:
             self._bins_packed_dev = jnp.asarray(train_bins_host)
+        if self._packed_cols:
+            self.grower_cfg = dataclasses.replace(
+                self.grower_cfg, packed_cols=self._packed_cols)
         # histogram pool policy (ref: histogram_pool_size / LRU
         # HistogramPool, feature_histogram.hpp:1368): when the [L, F, B, 3]
         # pool would blow the budget (wide data), drop the pool and compute

@@ -172,21 +172,38 @@ def _hist_pallas_impl(bins_fm: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
     return hist[:, :, :C]
 
 
-def fit_feature_tile(feature_tile: int, num_bin: int,
-                     block_rows: int) -> int:
-    """Shrink the feature tile so the kernel's VMEM residents (bins tile
-    + pinned accumulator + one [Bp, RB] one-hot at a time) stay within
-    ~4 MB, leaving room for double buffering in the ~16 MB/core VMEM.
-    Tiles stay multiples of 8 (sublane rule)."""
+def fit_tiles(feature_tile: int, num_bin: int,
+              block_rows: int) -> tuple:
+    """Shrink (feature_tile, block_rows) so the kernel's VMEM residents
+    (bins tile + pinned accumulator + one [Bp, RB] one-hot at a time)
+    stay within ~4 MB, leaving room for double buffering in the
+    ~16 MB/core VMEM. feature_tile stays a multiple of 8 (sublane rule),
+    block_rows a multiple of 128 (lane rule); feature_tile shrinks
+    first, then block_rows — the one-hot term Bp*block_rows is
+    feature-tile-independent, so a large tpu_rows_per_block must clamp
+    rows, not just features."""
     budget_elems = (4 << 20) // 4
     Bp = _pad_to(num_bin, 128)
     feature_tile = max(8, _pad_to(feature_tile, 8))
+    block_rows = max(128, _pad_to(block_rows, 128))
+
+    def resident(ft, br):
+        return (ft * br                 # bins tile
+                + 32 * ft * Bp          # accumulator (Cp<=32)
+                + Bp * br)              # one-hot
     while feature_tile > 8 and \
-            (feature_tile * block_rows            # bins tile
-             + 32 * feature_tile * Bp             # accumulator (Cp<=32)
-             + Bp * block_rows) > budget_elems:   # one-hot
+            resident(feature_tile, block_rows) > budget_elems:
         feature_tile //= 2
-    return max(feature_tile, 8)
+    while block_rows > 128 and \
+            resident(feature_tile, block_rows) > budget_elems:
+        block_rows //= 2
+    return max(feature_tile, 8), max(block_rows, 128)
+
+
+def fit_feature_tile(feature_tile: int, num_bin: int,
+                     block_rows: int) -> int:
+    """Back-compat wrapper: feature-tile part of fit_tiles."""
+    return fit_tiles(feature_tile, num_bin, block_rows)[0]
 
 
 def hist_pallas(bins_t: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
@@ -200,7 +217,7 @@ def hist_pallas(bins_t: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    feature_tile = fit_feature_tile(feature_tile, num_bin, block_rows)
+    feature_tile, block_rows = fit_tiles(feature_tile, num_bin, block_rows)
     return _hist_pallas_impl(bins_t, gh, num_bin, block_rows, feature_tile,
                              bool(interpret))
 
@@ -217,6 +234,6 @@ def hist_pallas_rm(bins_rm: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    feature_tile = fit_feature_tile(feature_tile, num_bin, block_rows)
+    feature_tile, block_rows = fit_tiles(feature_tile, num_bin, block_rows)
     return _hist_pallas_impl(bins_rm.T, gh, num_bin, block_rows,
                              feature_tile, bool(interpret))

@@ -152,6 +152,29 @@ def bench_part():
         dt_s = timeit(jax.jit(f), seg, go_left)
         print(f"partition/{name} R={R}: {dt_s*1e3:8.3f} ms", flush=True)
 
+    # small-bucket fixed costs decide the partition_mode=auto threshold
+    # (the compact scheduler's lax.switch buckets go down to min_bucket)
+    for n in (2048, 8192, 32768, 131072):
+        segn = seg[:n]
+        lmn = go_left[:n]
+
+        def part_scatter_n(seg, lm, n=n):
+            dst_l = jnp.cumsum(lm.astype(jnp.int32)) - 1
+            nL = dst_l[-1] + 1
+            dst_r = nL + jnp.cumsum((~lm).astype(jnp.int32)) - 1
+            dest = jnp.where(lm, dst_l, dst_r)
+            return jnp.zeros_like(seg).at[dest].set(
+                seg, unique_indices=True)
+
+        def part_sort_n(seg, lm):
+            key = (~lm).astype(jnp.int32)
+            _, out = lax.sort((key, seg), num_keys=1, is_stable=True)
+            return out
+
+        for name, f in (("scatter", part_scatter_n), ("sort", part_sort_n)):
+            dt_s = timeit(jax.jit(f), segn, lmn)
+            print(f"partition/{name} n={n}: {dt_s*1e3:8.3f} ms", flush=True)
+
     def gather_rows(seg, v):
         return jnp.take(v, seg, axis=0)
 
@@ -165,6 +188,29 @@ def bench_part():
     dt_s = timeit(jax.jit(lambda s, b: b.reshape(-1)[s * 28 + 3]),
                   seg, bins_rm)
     print(f"gather-flat u8 col R={R}: {dt_s*1e3:8.3f} ms", flush=True)
+
+    # packed-row gather candidates: if gather cost is per-ELEMENT, packing
+    # 4 u8 bins per i32 word should cut the compact scheduler's per-leaf
+    # row gather ~4x (28 u8 -> 7 i32 words per row)
+    packed = jnp.asarray(
+        np.ascontiguousarray(
+            rng.integers(0, 255, (R, 28), dtype=np.uint8)
+            .reshape(R, 7, 4)).view(np.uint32).reshape(R, 7))
+    dt_s = timeit(jax.jit(lambda s, p: jnp.take(p, s, axis=0)), seg, packed)
+    print(f"gather u32packed[R,7] R={R}: {dt_s*1e3:8.3f} ms", flush=True)
+
+    def gather_unpack(s, p):
+        w = jnp.take(p, s, axis=0)                       # [R, 7] u32
+        parts = [(w >> (8 * k)) & jnp.uint32(0xFF) for k in range(4)]
+        return jnp.stack(parts, axis=2).reshape(R, 28).astype(jnp.uint8)
+
+    dt_s = timeit(jax.jit(gather_unpack), seg, packed)
+    print(f"gather+unpack u32->u8[R,28] R={R}: {dt_s*1e3:8.3f} ms",
+          flush=True)
+
+    bins32 = bins_rm.astype(jnp.int32)
+    dt_s = timeit(jax.jit(lambda s, b: jnp.take(b, s, axis=0)), seg, bins32)
+    print(f"gather i32[R,28] R={R}: {dt_s*1e3:8.3f} ms", flush=True)
 
 
 def bench_fullpass():

@@ -1,0 +1,39 @@
+#!/bin/sh
+# One patient TPU measurement session — run when the tunnel is healthy.
+# Stages run SEQUENTIALLY (one claim at a time, nothing killed
+# mid-compile; see docs/TPU_RUNBOOK.md for why). Each stage logs to
+# bench_logs/. Decisions each stage informs are listed inline.
+set -x
+mkdir -p bench_logs
+cd "$(dirname "$0")/.."
+
+# 0. health (fast fail if the backend is still recovering)
+python -c "import jax; print(jax.devices())" || exit 3
+
+# 1. kernel/primitive microbenches:
+#    - gather u8 vs packed u32 vs i32  -> tpu_packed_bins default
+#    - partition sort vs scatter by size -> grower auto threshold (32768)
+#    - pallas_rm f32-triple vs bf16 vs int8 -> tpu_hist_kernel auto for f32
+python microbench.py part pallas_rm 2>&1 | tee bench_logs/micro_part_pallas.log
+
+# 2. engine A/B at 100k (fast turnaround, fixed-cost dominated):
+for extra in '{}' '{"tpu_packed_bins":"true"}' '{"tpu_hist_kernel":"pallas"}' \
+             '{"tpu_packed_bins":"true","tpu_hist_kernel":"pallas"}' \
+             '{"tpu_min_bucket":8192}' '{"tpu_hist_dtype":"bfloat16"}' \
+             '{"use_quantized_grad":true}'; do
+  BENCH_ROWS=100000 BENCH_ITERS=30 BENCH_EXTRA="$extra" BENCH_WATCHDOG_SEC=1500 \
+    python bench.py 2>&1 | tee -a bench_logs/ab_100k.log
+done
+
+# 3. leaves ladder at 1M -> per-split fixed-cost curve
+for lv in 31 63 127 255; do
+  BENCH_ROWS=1000000 BENCH_ITERS=15 BENCH_LEAVES=$lv BENCH_WATCHDOG_SEC=1700 \
+    python bench.py 2>&1 | tee -a bench_logs/ladder_1m.log
+done
+
+# 4. best-config 1M + full Higgs scale with the winning extras
+# (edit BENCH_EXTRA to the stage-2 winner before running)
+BENCH_ROWS=1000000 BENCH_ITERS=20 BENCH_WATCHDOG_SEC=1700 \
+  python bench.py 2>&1 | tee -a bench_logs/final_1m.log
+BENCH_ROWS=10500000 BENCH_ITERS=10 BENCH_WATCHDOG_SEC=1700 \
+  python bench.py 2>&1 | tee -a bench_logs/final_10m.log

@@ -175,6 +175,48 @@ def test_async_fallback_features_use_sync():
         assert not eng._pending  # nothing left on device
 
 
+import pytest
+
+
+@pytest.mark.parametrize("learner", ["data", "voting", "feature"])
+def test_async_distributed_learners_match_serial_sync(learner):
+    """Async composes with every sharded learner: async on the 8-device
+    mesh must match serial sync structure-for-structure (the learners'
+    collectives live inside the jitted grower; the returned device trees
+    are replicated, so deferred materialization is learner-agnostic)."""
+    X, y = _data(n=4000)
+    base = dict(objective="binary", num_leaves=15, learning_rate=0.1,
+                verbose=-1, min_data_in_leaf=20)
+    m_ref = lgb.train(dict(base, tpu_async_boosting="false"),
+                      lgb.Dataset(X, label=y), num_boost_round=12)
+    m_dp = lgb.train(dict(base, tpu_async_boosting="true",
+                          tree_learner=learner),
+                     lgb.Dataset(X, label=y), num_boost_round=12)
+    assert _structure(m_ref) == _structure(m_dp)   # flushes pending
+    np.testing.assert_allclose(m_ref.predict(X), m_dp.predict(X),
+                               atol=1e-5)
+
+
+def test_async_partial_degenerate_multiclass_keeps_iteration_budget():
+    """A first-iteration per-class degeneracy must not cost the fixed
+    boosting-round budget: async ends with the same tree count as sync
+    (regression: the stop-check replayed only ONE of the rolled-back
+    window's iterations)."""
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(1200, 6)).astype(np.float32)
+    y = rng.integers(0, 3, size=1200).astype(np.float32)
+    base = dict(objective="multiclass", num_class=3, num_leaves=4,
+                min_data_in_leaf=590, min_gain_to_split=5.0, verbose=-1,
+                tpu_stop_check_interval=16)
+    out = {}
+    for mode in ("false", "true"):
+        b = lgb.train(dict(base, tpu_async_boosting=mode),
+                      lgb.Dataset(X, label=y), num_boost_round=30)
+        out[mode] = (b.num_trees(),
+                     np.asarray(b.predict(X[:5])).round(6).tolist())
+    assert out["true"] == out["false"]
+
+
 def test_async_rollback_one_iter():
     X, y = _data()
     params = dict(objective="binary", num_leaves=15, verbose=-1,
