@@ -115,6 +115,7 @@ struct Model {
   bool average_output = false;
   Transform transform = Transform::kNone;
   std::string objective;
+  std::vector<std::string> feature_names;  // model-text feature_names=
   std::vector<Tree> trees;
 
   int NumIterations() const {
@@ -288,6 +289,16 @@ Model* ParseModelString(const std::string& text) {
       model->num_tree_per_iteration = std::atoi(val.c_str());
     } else if (key == "max_feature_idx") {
       model->max_feature_idx = std::atoi(val.c_str());
+    } else if (key == "feature_names") {
+      model->feature_names.clear();
+      size_t start = 0;
+      while (start < val.size()) {
+        size_t sp = val.find(' ', start);
+        if (sp == std::string::npos) sp = val.size();
+        if (sp > start)
+          model->feature_names.push_back(val.substr(start, sp - start));
+        start = sp + 1;
+      }
     } else if (key == "objective") {
       model->objective = val;
       std::string name = val.substr(0, val.find(' '));
@@ -452,6 +463,19 @@ int LgbmTrainBoosterPredictForCSR(void* handle, const void* indptr,
                                   int64_t num_col, int predict_type,
                                   int start_iteration, int num_iteration,
                                   int64_t* out_len, double* out_result);
+int LgbmTrainBoosterCalcNumPredict(void* handle, int num_row,
+                                   int predict_type, int start_iteration,
+                                   int num_iteration, int64_t* out_len);
+int LgbmTrainBoosterGetFeatureNames(void* handle, const int len,
+                                    int* out_len, const size_t buffer_len,
+                                    size_t* out_buffer_len,
+                                    char** out_strs);
+int LgbmTrainBoosterPredictForFile(void* handle,
+                                   const char* data_filename,
+                                   int data_has_header, int predict_type,
+                                   int start_iteration, int num_iteration,
+                                   const char* parameter,
+                                   const char* result_filename);
 
 int LGBM_BoosterCreateFromModelfile(const char* filename,
                                     int* out_num_iterations,
@@ -549,6 +573,153 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
   };
   return PredictRows(m, fill, nrow, ncol, predict_type, start_iteration,
                      num_iteration, out_len, out_result);
+}
+
+int LGBM_BoosterPredictForMatSingleRow(
+    BoosterHandle handle, const void* data, int data_type, int ncol,
+    int is_row_major, int predict_type, int start_iteration,
+    int num_iteration, const char* parameter, int64_t* out_len,
+    double* out_result) {
+  // ref: c_api.cpp LGBM_BoosterPredictForMatSingleRow — the one-row
+  // serving hot path; identical semantics to PredictForMat with nrow=1
+  return LGBM_BoosterPredictForMat(handle, data, data_type, 1, ncol,
+                                   is_row_major, predict_type,
+                                   start_iteration, num_iteration,
+                                   parameter, out_len, out_result);
+}
+
+int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                               int predict_type, int start_iteration,
+                               int num_iteration, int64_t* out_len) {
+  // ref: c_api.cpp LGBM_BoosterCalcNumPredict
+  if (LgbmTrainOwns(handle))
+    return LgbmTrainBoosterCalcNumPredict(handle, num_row, predict_type,
+                                          start_iteration, num_iteration,
+                                          out_len);
+  Model* m = static_cast<Model*>(handle);
+  if (!out_len) {
+    SetError("CalcNumPredict: null out_len");
+    return -1;
+  }
+  int n_it = m->NumIterations();
+  int si = std::min(std::max(start_iteration, 0), n_it);
+  int ni = num_iteration <= 0 ? n_it - si
+                              : std::min(num_iteration, n_it - si);
+  if (ni < 0) ni = 0;
+  int K = std::max(m->num_tree_per_iteration, 1);
+  int64_t per_row = predict_type == 2   ? int64_t(K) * ni
+                    : predict_type == 3 ? int64_t(m->max_feature_idx + 2) * K
+                                        : K;
+  *out_len = int64_t(num_row) * per_row;
+  return 0;
+}
+
+int LGBM_BoosterGetFeatureNames(BoosterHandle handle, const int len,
+                                int* out_len, const size_t buffer_len,
+                                size_t* out_buffer_len, char** out_strs) {
+  // ref: c_api.cpp LGBM_BoosterGetFeatureNames (two-call sizing)
+  if (LgbmTrainOwns(handle))
+    return LgbmTrainBoosterGetFeatureNames(handle, len, out_len,
+                                           buffer_len, out_buffer_len,
+                                           out_strs);
+  Model* m = static_cast<Model*>(handle);
+  if (!out_len || !out_buffer_len) {
+    SetError("GetFeatureNames: null output argument");
+    return -1;
+  }
+  int nf = m->max_feature_idx + 1;
+  *out_len = nf;
+  size_t max_needed = 1;
+  for (int i = 0; i < nf; ++i) {
+    std::string name = i < static_cast<int>(m->feature_names.size())
+                           ? m->feature_names[i]
+                           : "Column_" + std::to_string(i);
+    if (name.size() + 1 > max_needed) max_needed = name.size() + 1;
+    if (out_strs && i < len && out_strs[i])
+      std::snprintf(out_strs[i], buffer_len, "%s", name.c_str());
+  }
+  *out_buffer_len = max_needed;
+  return 0;
+}
+
+int LGBM_BoosterPredictForFile(BoosterHandle handle,
+                               const char* data_filename,
+                               int data_has_header, int predict_type,
+                               int start_iteration, int num_iteration,
+                               const char* parameter,
+                               const char* result_filename) {
+  // ref: c_api.cpp LGBM_BoosterPredictForFile. Serving handles parse a
+  // simple numeric CSV/TSV themselves (interpreter-free): one prediction
+  // line per data row, tab-separated; a leading extra column (the CLI's
+  // label-first layout) is skipped when the file has exactly
+  // num_features+1 columns.
+  if (LgbmTrainOwns(handle))
+    return LgbmTrainBoosterPredictForFile(
+        handle, data_filename, data_has_header, predict_type,
+        start_iteration, num_iteration, parameter, result_filename);
+  Model* m = static_cast<Model*>(handle);
+  if (!data_filename || !result_filename) {
+    SetError("PredictForFile: null filename");
+    return -1;
+  }
+  std::ifstream in(data_filename);
+  if (!in) {
+    SetError(std::string("could not open data file ") + data_filename);
+    return -1;
+  }
+  std::ofstream outf(result_filename);
+  if (!outf) {
+    SetError(std::string("could not open result file ") +
+             result_filename);
+    return -1;
+  }
+  int nf = m->max_feature_idx + 1;
+  std::string line;
+  bool first = true;
+  std::vector<double> row;
+  while (std::getline(in, line)) {
+    if (first && data_has_header) {
+      first = false;
+      continue;
+    }
+    first = false;
+    if (line.empty()) continue;
+    row.clear();
+    const char* p = line.c_str();
+    char* e = nullptr;
+    while (*p) {
+      while (*p == ',' || *p == '\t' || *p == ' ') ++p;
+      if (!*p) break;
+      double v = std::strtod(p, &e);
+      if (e == p) break;  // non-numeric tail
+      row.push_back(v);
+      p = e;
+    }
+    size_t off = row.size() == static_cast<size_t>(nf) + 1 ? 1 : 0;
+    std::vector<double> feats(nf, 0.0);
+    for (int j = 0; j < nf && off + j < row.size(); ++j)
+      feats[j] = row[off + j];
+    auto fill = [&](int64_t, double* dst) {
+      for (int j = 0; j < nf; ++j) dst[j] = feats[j];
+    };
+    int64_t out_len = 0;
+    std::vector<double> pred(
+        static_cast<size_t>(std::max(m->num_tree_per_iteration, 1)) *
+        std::max(m->NumIterations(), 1) *
+        static_cast<size_t>(m->max_feature_idx + 2));
+    if (PredictRows(m, fill, 1, nf, predict_type, start_iteration,
+                    num_iteration, &out_len, pred.data()) != 0)
+      return -1;
+    for (int64_t j = 0; j < out_len; ++j) {
+      if (j) outf << '\t';
+      char buf[32];
+      std::snprintf(buf, sizeof(buf), "%.17g", pred[j]);
+      outf << buf;
+    }
+    outf << '\n';
+  }
+  (void)parameter;
+  return 0;
 }
 
 // CSR prediction without densifying the matrix (≡ the reference's

@@ -404,7 +404,8 @@ int LGBM_BoosterCreate(void* train_data, const char* parameters,
       "    grp = grp.astype(_np.int32)\n" +
       "ds = _lgb.Dataset(d['X'], label=fl.get('label'), "
       "weight=fl.get('weight'), group=grp, "
-      "init_score=fl.get('init_score'), params=p)\n" +
+      "init_score=fl.get('init_score'), "
+      "feature_name=d.get('feature_names', 'auto'), params=p)\n" +
       "_lgbm_capi['obj'][" + bid + "] = {'booster': _lgb.Booster(p, ds), "
       "'finished': False}\n";
   if (RunGuarded(body) != 0) {
@@ -810,6 +811,265 @@ int LgbmTrainBoosterPredictForCSR(void* handle, const void* indptr,
       ").value = pred.size\n" +
       "_ct.memmove(" + Addr(out_result) +
       ", pred.ctypes.data, pred.size * 8)\n";
+  return RunGuarded(body);
+}
+
+int LGBM_DatasetGetField(void* handle, const char* field_name,
+                         int* out_len, const void** out_ptr,
+                         int* out_type) {
+  // ref: c_api.cpp LGBM_DatasetGetField — the returned buffer is owned
+  // by the Dataset (here: pinned in the embedded interpreter under
+  // 'fields_c') and stays valid until the handle is freed
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || h->is_booster || !out_len || !out_ptr || !out_type) {
+    LgbmTrainSetError("DatasetGetField: not a training Dataset handle");
+    return -1;
+  }
+  static int64_t ptr_slot;
+  static int32_t len_slot, type_slot;
+  std::string body =
+      "d = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n" +
+      "fn = " + PyStr(field_name) + "\n" +
+      "cache = d.setdefault('fields_c', {})\n" +
+      // the cached conversion is REUSED so previously returned pointers
+      // stay valid until the handle is freed (reference buffer-ownership
+      // semantics); rebuilding each call would free the old buffer under
+      // a caller still holding it
+      "if fn not in cache:\n" +
+      "    v = d['fields'].get(fn)\n" +
+      "    if v is None: raise KeyError('field not set: ' + fn)\n" +
+      // reference field dtypes: label/weight f32, group int32
+      // boundaries, init_score f64 (C_API_DTYPE codes 0/2/1). 'group'
+      // is SET as per-query sizes but READ as cumulative boundaries of
+      // length num_queries+1 (ref: c_api.cpp DatasetGetField -> "
+      // query boundaries; the reference python wrapper np.diff()s it)
+      "    if fn == 'init_score': v = v.astype(_np.float64); t = 1\n" +
+      "    elif fn == 'group':\n" +
+      "        v = _np.concatenate([[0], _np.cumsum(v)])"
+      ".astype(_np.int32); t = 2\n" +
+      "    else: v = v.astype(_np.float32); t = 0\n" +
+      "    cache[fn] = (_np.ascontiguousarray(v), t)\n" +
+      "v, t = cache[fn]\n" +
+      "_ct.c_int64.from_address(" + Addr(&ptr_slot) +
+      ").value = v.ctypes.data\n" +
+      "_ct.c_int32.from_address(" + Addr(&len_slot) +
+      ").value = v.size\n" +
+      "_ct.c_int32.from_address(" + Addr(&type_slot) + ").value = t\n";
+  if (RunGuarded(body) != 0) return -1;
+  *out_ptr = reinterpret_cast<const void*>(
+      static_cast<uintptr_t>(ptr_slot));
+  *out_len = len_slot;
+  *out_type = type_slot;
+  return 0;
+}
+
+int LGBM_DatasetSetFeatureNames(void* handle, const char** feature_names,
+                                int num_feature_names) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || h->is_booster || !feature_names) {
+    LgbmTrainSetError("DatasetSetFeatureNames: bad handle");
+    return -1;
+  }
+  std::string names = "[";
+  for (int i = 0; i < num_feature_names; ++i)
+    names += PyStr(feature_names[i]) + ",";
+  names += "]";
+  std::string body =
+      "_lgbm_capi['obj'][" + std::to_string(h->id) +
+      "]['feature_names'] = " + names + "\n";
+  return RunGuarded(body);
+}
+
+namespace {
+
+// two-call sizing protocol shared by the *NameLists (ref: c_api.cpp
+// LGBM_DatasetGetFeatureNames / BoosterGetFeatureNames). One interpreter
+// pass gathers all names into a scratch blob (the GetEvalNames pattern);
+// the C side copies into the caller's string array.
+int CopyNameList(const std::string& names_expr, uint64_t obj_id,
+                 const int len, int* out_len, const size_t buffer_len,
+                 size_t* out_buffer_len, char** out_strs) {
+  static char scratch[262144];
+  static int32_t n_slot;
+  std::string body =
+      "o = _lgbm_capi['obj'][" + std::to_string(obj_id) + "]\n" +
+      "names = " + names_expr + "\n" +
+      "blob = b'\\0'.join(n.encode() for n in names) + b'\\0\\0'\n" +
+      "if len(blob) > 262142:\n" +
+      "    raise ValueError('name list exceeds 256 KiB')\n" +
+      "_ct.memmove(" + Addr(scratch) + ", blob, len(blob))\n" +
+      "_ct.c_int32.from_address(" + Addr(&n_slot) +
+      ").value = len(names)\n";
+  if (RunGuarded(body) != 0) return -1;
+  *out_len = n_slot;
+  size_t max_needed = 1;
+  const char* p = scratch;
+  for (int i = 0; i < n_slot; ++i) {
+    size_t l = std::strlen(p);
+    if (l + 1 > max_needed) max_needed = l + 1;
+    if (out_strs && i < len && out_strs[i])
+      std::snprintf(out_strs[i], buffer_len, "%s", p);
+    p += l + 1;
+  }
+  *out_buffer_len = max_needed;
+  return 0;
+}
+
+}  // namespace
+
+int LGBM_DatasetGetFeatureNames(void* handle, const int len,
+                                int* out_len, const size_t buffer_len,
+                                size_t* out_buffer_len, char** out_strs) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || h->is_booster || !out_len || !out_buffer_len) {
+    LgbmTrainSetError("DatasetGetFeatureNames: bad handle");
+    return -1;
+  }
+  return CopyNameList(
+      "o.get('feature_names') or ['Column_' + str(i) for i in "
+      "range(o['X'].shape[1])]",
+      h->id, len, out_len, buffer_len, out_buffer_len, out_strs);
+}
+
+int LgbmTrainBoosterGetFeatureNames(void* handle, const int len,
+                                    int* out_len,
+                                    const size_t buffer_len,
+                                    size_t* out_buffer_len,
+                                    char** out_strs) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !out_len || !out_buffer_len) {
+    LgbmTrainSetError("BoosterGetFeatureNames: not a training Booster");
+    return -1;
+  }
+  return CopyNameList("list(o['booster'].feature_name())", h->id, len,
+                      out_len, buffer_len, out_buffer_len, out_strs);
+}
+
+int LGBM_DatasetSaveBinary(void* handle, const char* filename) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || h->is_booster || !filename) {
+    LgbmTrainSetError("DatasetSaveBinary: bad handle");
+    return -1;
+  }
+  std::string body =
+      "d = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n" +
+      "fl = d['fields']\n" +
+      "grp = fl.get('group')\n" +
+      "if grp is not None and grp.dtype != _np.int32:\n" +
+      "    grp = grp.astype(_np.int32)\n" +
+      "ds = _lgb.Dataset(d['X'], label=fl.get('label'), "
+      "weight=fl.get('weight'), group=grp, "
+      "init_score=fl.get('init_score'), "
+      "feature_name=d.get('feature_names', 'auto'), "
+      "params=dict(d['params']))\n" +
+      "ds.save_binary(" + PyStr(filename) + ")\n";
+  return RunGuarded(body);
+}
+
+int LGBM_BoosterUpdateOneIterCustom(void* handle, const float* grad,
+                                    const float* hess, int* is_finished) {
+  // ref: c_api.h:823 — one boosting step from caller-supplied
+  // gradients/hessians (size num_data * num_models_per_iteration)
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !grad || !hess || !is_finished) {
+    LgbmTrainSetError("BoosterUpdateOneIterCustom: bad argument(s)");
+    return -1;
+  }
+  std::string body =
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n" +
+      "eng = b['booster']._engine\n" +
+      "n = eng.num_data * eng.num_tree_per_iteration\n" +
+      "g = _np.ctypeslib.as_array((_ct.c_float * n).from_address(" +
+      Addr(grad) + ")).copy()\n" +
+      "hs = _np.ctypeslib.as_array((_ct.c_float * n).from_address(" +
+      Addr(hess) + ")).copy()\n" +
+      "fin = eng.train_one_iter(g, hs)\n" +
+      "b['finished'] = bool(fin)\n" +
+      "_ct.c_int.from_address(" + Addr(is_finished) +
+      ").value = 1 if fin else 0\n";
+  return RunGuarded(body);
+}
+
+int LGBM_BoosterResetParameter(void* handle, const char* parameters) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster) {
+    LgbmTrainSetError("BoosterResetParameter: not a training Booster");
+    return -1;
+  }
+  std::string body =
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n" +
+      ParamsDict(parameters) +
+      "b['booster'].reset_parameter(p)\n";
+  return RunGuarded(body);
+}
+
+int LgbmTrainBoosterCalcNumPredict(void* handle, int num_row,
+                                   int predict_type, int start_iteration,
+                                   int num_iteration, int64_t* out_len) {
+  // ref: c_api.cpp LGBM_BoosterCalcNumPredict — result buffer size for
+  // a PredictForMat call with these settings
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !out_len) {
+    LgbmTrainSetError("BoosterCalcNumPredict: not a training Booster");
+    return -1;
+  }
+  std::string body =
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
+      "K = max(b._engine.num_tree_per_iteration, 1)\n" +
+      "n_it = b.current_iteration()\n" +
+      "si = min(max(" + std::to_string(start_iteration) +
+      ", 0), n_it)\n" +
+      "ni = " + std::to_string(num_iteration) + "\n" +
+      "ni = n_it - si if ni <= 0 else min(ni, n_it - si)\n" +
+      "ni = max(ni, 0)\n" +
+      "nf = b.num_feature()\n" +
+      "pt = " + std::to_string(predict_type) + "\n" +
+      "per_row = (K * ni if pt == 2 else (nf + 1) * K if pt == 3 "
+      "else K)\n" +
+      "_ct.c_int64.from_address(" + Addr(out_len) + ").value = " +
+      std::to_string(num_row) + " * per_row\n";
+  return RunGuarded(body);
+}
+
+int LgbmTrainBoosterPredictForFile(void* handle,
+                                   const char* data_filename,
+                                   int data_has_header, int predict_type,
+                                   int start_iteration, int num_iteration,
+                                   const char* parameter,
+                                   const char* result_filename) {
+  // ref: c_api.cpp LGBM_BoosterPredictForFile — tab-separated rows,
+  // matching the reference's Predictor output convention
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !data_filename || !result_filename) {
+    LgbmTrainSetError("BoosterPredictForFile: bad argument(s)");
+    return -1;
+  }
+  std::string kw = predict_type == 1   ? ", raw_score=True"
+                   : predict_type == 2 ? ", pred_leaf=True"
+                   : predict_type == 3 ? ", pred_contrib=True"
+                                       : "";
+  std::string body =
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
+      // prediction parameters (e.g. predict_disable_shape_check) flow
+      // through predict's **kwargs like the reference's config string
+      ParamsDict(parameter) +
+      (data_has_header ? "p['data_has_header'] = True\n" : "") +
+      "pred = b.predict(" + PyStr(data_filename) +
+      ", start_iteration=" +
+      std::to_string(start_iteration > 0 ? start_iteration : 0) +
+      (num_iteration > 0
+           ? ", num_iteration=" + std::to_string(num_iteration)
+           : "") +
+      kw + ", **p)\n" +
+      // one output line per INPUT row: 1-D predictions become a column;
+      // 2-D (multiclass / leaf / contrib) keep their row structure
+      "pred = _np.asarray(pred)\n" +
+      "pred = (pred.reshape(pred.shape[0], -1) if pred.ndim > 1 "
+      "else pred.reshape(-1, 1))\n" +
+      "with open(" + PyStr(result_filename) + ", 'w') as f:\n" +
+      "    for row in pred:\n" +
+      "        f.write('\\t'.join(repr(float(v)) for v in row) + "
+      "'\\n')\n";
   return RunGuarded(body);
 }
 

@@ -85,6 +85,16 @@ int LGBM_DatasetCreateFromFile(const char* filename,
 int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
                          const void* field_data, int32_t num_element,
                          int data_type);
+int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
+                         int* out_len, const void** out_ptr,
+                         int* out_type);
+int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                const char** feature_names,
+                                int num_feature_names);
+int LGBM_DatasetGetFeatureNames(DatasetHandle handle, const int len,
+                                int* out_len, const size_t buffer_len,
+                                size_t* out_buffer_len, char** out_strs);
+int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename);
 int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out);
 int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out);
 int LGBM_DatasetFree(DatasetHandle handle);
@@ -94,6 +104,28 @@ int LGBM_BoosterCreate(DatasetHandle train_data, const char* parameters,
 int LGBM_BoosterAddValidData(BoosterHandle handle,
                              DatasetHandle valid_data);
 int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
+int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle,
+                                    const float* grad, const float* hess,
+                                    int* is_finished);
+int LGBM_BoosterResetParameter(BoosterHandle handle,
+                               const char* parameters);
+int LGBM_BoosterGetFeatureNames(BoosterHandle handle, const int len,
+                                int* out_len, const size_t buffer_len,
+                                size_t* out_buffer_len, char** out_strs);
+int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                               int predict_type, int start_iteration,
+                               int num_iteration, int64_t* out_len);
+int LGBM_BoosterPredictForFile(BoosterHandle handle,
+                               const char* data_filename,
+                               int data_has_header, int predict_type,
+                               int start_iteration, int num_iteration,
+                               const char* parameter,
+                               const char* result_filename);
+int LGBM_BoosterPredictForMatSingleRow(
+    BoosterHandle handle, const void* data, int data_type, int ncol,
+    int is_row_major, int predict_type, int start_iteration,
+    int num_iteration, const char* parameter, int64_t* out_len,
+    double* out_result);
 int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
 int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle,
                                    int* out_models);

@@ -49,6 +49,35 @@ int main(int argc, char** argv) {
     return 1;
   }
 
+  /* field read-back (ref: LGBM_DatasetGetField buffer ownership) */
+  {
+    int fl_len = 0, fl_type = -1;
+    const void* fl_ptr = NULL;
+    CHECK(LGBM_DatasetGetField(ds, "label", &fl_len, &fl_ptr, &fl_type));
+    const float* lab = (const float*)fl_ptr;
+    if (fl_len != n || fl_type != 0 || fabs(lab[3] - y[3]) > 1e-6) {
+      fprintf(stderr, "FAIL GetField: len=%d type=%d\n", fl_len, fl_type);
+      return 1;
+    }
+  }
+
+  /* feature-name round trip (two-call sizing) */
+  {
+    const char* fnames[5] = {"fa", "fb", "fc", "fd", "fe"};
+    CHECK(LGBM_DatasetSetFeatureNames(ds, fnames, f));
+    char nb[5][32];
+    char* nptr[5] = {nb[0], nb[1], nb[2], nb[3], nb[4]};
+    int n_names = 0;
+    size_t need_len = 0;
+    CHECK(LGBM_DatasetGetFeatureNames(ds, 5, &n_names, 32, &need_len,
+                                      nptr));
+    if (n_names != f || nb[2][0] != 'f' || nb[2][1] != 'c') {
+      fprintf(stderr, "FAIL feature names: n=%d third='%s'\n", n_names,
+              nb[2]);
+      return 1;
+    }
+  }
+
   void* bst = NULL;
   CHECK(LGBM_BoosterCreate(
       ds,
@@ -94,11 +123,44 @@ int main(int argc, char** argv) {
 
   double* pred = malloc(sizeof(double) * n);
   int64_t out_len = 0;
+  int64_t calc_len = 0;
+  CHECK(LGBM_BoosterCalcNumPredict(bst, n, 0, 0, -1, &calc_len));
+  if (calc_len != n) {
+    fprintf(stderr, "FAIL CalcNumPredict: %lld\n", (long long)calc_len);
+    return 1;
+  }
   CHECK(LGBM_BoosterPredictForMat(bst, X, 1, n, f, 1, 0 /*normal*/, 0, 0,
                                   "", &out_len, pred));
   if (out_len != n) {
     fprintf(stderr, "FAIL: out_len %lld\n", (long long)out_len);
     return 1;
+  }
+
+  /* single-row serving entry must agree with the batch path */
+  {
+    double one = 0;
+    int64_t one_len = 0;
+    CHECK(LGBM_BoosterPredictForMatSingleRow(bst, X, 1, f, 1, 0, 0, 0,
+                                             "", &one_len, &one));
+    if (one_len != 1 || fabs(one - pred[0]) > 1e-9) {
+      fprintf(stderr, "FAIL SingleRow: %g vs %g\n", one, pred[0]);
+      return 1;
+    }
+  }
+
+  /* booster feature names flow through from the Dataset */
+  {
+    char nb[5][32];
+    char* nptr[5] = {nb[0], nb[1], nb[2], nb[3], nb[4]};
+    int n_names = 0;
+    size_t need_len = 0;
+    CHECK(LGBM_BoosterGetFeatureNames(bst, 5, &n_names, 32, &need_len,
+                                      nptr));
+    if (n_names != f || nb[0][0] != 'f' || nb[0][1] != 'a') {
+      fprintf(stderr, "FAIL booster names: n=%d first='%s'\n", n_names,
+              nb[0]);
+      return 1;
+    }
   }
   double mse = 0, var = 0, mean = 0;
   for (int i = 0; i < n; ++i) mean += y[i];
@@ -204,6 +266,92 @@ int main(int argc, char** argv) {
     return 1;
   }
   CHECK(LGBM_DatasetFree(fds));
+
+  /* file-in, file-out prediction (CLI-style serving) */
+  {
+    char out_path[512];
+    snprintf(out_path, sizeof(out_path), "%s.pred", model_path);
+    CHECK(LGBM_BoosterPredictForFile(bst, csv_path, 0, 0, 0, -1, "",
+                                     out_path));
+    FILE* pf = fopen(out_path, "r");
+    double v0 = 1e99;
+    if (!pf || fscanf(pf, "%lf", &v0) != 1 || !(fabs(v0) < 1e6)) {
+      fprintf(stderr, "FAIL PredictForFile\n");
+      return 1;
+    }
+    fclose(pf);
+
+    /* the same three entry points must work on SERVING handles too
+     * (interpreter-free dispatch side) and agree with the trained one */
+    char out2_path[512];
+    snprintf(out2_path, sizeof(out2_path), "%s.pred2", model_path);
+    CHECK(LGBM_BoosterPredictForFile(srv, csv_path, 0, 0, 0, -1, "",
+                                     out2_path));
+    /* row 0 of the csv is X row 0: the serving file path must agree
+     * with the serving batch path (bst has mutated since the save, so
+     * v0 is only checked for finiteness above) */
+    FILE* p2 = fopen(out2_path, "r");
+    double w0 = 1e99;
+    if (!p2 || fscanf(p2, "%lf", &w0) != 1 ||
+        !(fabs(w0 - pred2[0]) < 1e-6)) {
+      fprintf(stderr, "FAIL serving PredictForFile: %g vs %g\n", w0,
+              pred2[0]);
+      return 1;
+    }
+    fclose(p2);
+    int64_t srv_calc = 0;
+    CHECK(LGBM_BoosterCalcNumPredict(srv, 7, 0, 0, -1, &srv_calc));
+    if (srv_calc != 7) {
+      fprintf(stderr, "FAIL serving CalcNumPredict: %lld\n",
+              (long long)srv_calc);
+      return 1;
+    }
+    char snb[5][32];
+    char* snptr[5] = {snb[0], snb[1], snb[2], snb[3], snb[4]};
+    int sn = 0;
+    size_t sneed = 0;
+    CHECK(LGBM_BoosterGetFeatureNames(srv, 5, &sn, 32, &sneed, snptr));
+    if (sn != f || snb[1][0] != 'f' || snb[1][1] != 'b') {
+      fprintf(stderr, "FAIL serving names: n=%d second='%s'\n", sn,
+              snb[1]);
+      return 1;
+    }
+  }
+
+  /* custom-objective step: hand-computed l2 gradients shrink train mse */
+  {
+    float* grad = malloc(sizeof(float) * n);
+    float* hess = malloc(sizeof(float) * n);
+    int64_t sl = 0;
+    CHECK(LGBM_BoosterGetNumPredict(bst, 0, &sl));
+    double* score = malloc(sizeof(double) * sl);
+    CHECK(LGBM_BoosterGetPredict(bst, 0, &sl, score));
+    for (int i = 0; i < n; ++i) {
+      grad[i] = (float)(score[i] - y[i]);
+      hess[i] = 1.0f;
+    }
+    int fin2 = 0;
+    CHECK(LGBM_BoosterUpdateOneIterCustom(bst, grad, hess, &fin2));
+    free(grad);
+    free(hess);
+    free(score);
+  }
+
+  /* parameter reset is accepted (learning-rate decay pattern) */
+  CHECK(LGBM_BoosterResetParameter(bst, "learning_rate=0.05"));
+
+  /* binary dataset save produces a loadable artifact */
+  {
+    char bin_path[512];
+    snprintf(bin_path, sizeof(bin_path), "%s.bin", model_path);
+    CHECK(LGBM_DatasetSaveBinary(ds, bin_path));
+    FILE* bf = fopen(bin_path, "rb");
+    if (!bf) {
+      fprintf(stderr, "FAIL DatasetSaveBinary: no file\n");
+      return 1;
+    }
+    fclose(bf);
+  }
   CHECK(LGBM_DatasetFree(vds));
   CHECK(LGBM_BoosterFree(srv));
   CHECK(LGBM_BoosterFree(bst));
