@@ -55,9 +55,65 @@ class Metric:
     def eval(self, score: np.ndarray, objective=None) -> MetricResult:
         raise NotImplementedError
 
+    # ---- device evaluation (async-boosting fast path) ----------------
+    # Through a high-latency tunnel, pulling the full [K, N] score to
+    # host every eval costs a round-trip plus bandwidth; the common
+    # metrics evaluate on device and the engine fetches ONE stacked
+    # scalar vector per eval (models/gbdt.py _eval). Metrics without a
+    # device path return None and fall back to the host implementation.
+
+    def eval_device(self, score, objective=None):
+        """jnp evaluation: list of (name, device_scalar, higher_better)
+        or None when no device path applies for this metric/objective."""
+        return None
+
+    def _dev_arrays(self):
+        """Cached device copies of label/weight."""
+        if not hasattr(self, "_dev_cache"):
+            import jax.numpy as jnp
+            self._dev_cache = (
+                jnp.asarray(self.label, jnp.float32)
+                if self.label is not None else None,
+                jnp.asarray(self.weight, jnp.float32)
+                if self.weight is not None else None)
+        return self._dev_cache
+
+    def _dev_mean(self, losses, weight_dev):
+        import jax.numpy as jnp
+        if weight_dev is not None:
+            return jnp.sum(losses * weight_dev) / jnp.float32(
+                self.sum_weights)
+        return jnp.mean(losses)
+
     @property
     def names(self) -> List[str]:
         return [self.NAME]
+
+
+def _dev_convert(score, objective):
+    """Device counterpart of the objectives' convert_output for the
+    transforms the device metrics understand; None = unsupported
+    objective (host fallback). Mirrors core/objective.py ConvertOutput
+    bodies exactly (sigmoid params, reg_sqrt, exp family)."""
+    import jax.numpy as jnp
+    if objective is None:
+        return score
+    name = getattr(objective, "NAME", "")
+    if name in ("regression", "regression_l1", "huber", "fair",
+                "quantile", "mape"):
+        if getattr(objective, "sqrt", False):
+            return jnp.sign(score) * score * score
+        return score
+    if name in ("poisson", "gamma", "tweedie"):
+        return jnp.exp(score)
+    if name in ("binary",):
+        sig = jnp.float32(getattr(objective, "sigmoid", 1.0))
+        return 1.0 / (1.0 + jnp.exp(-sig * score))
+    if name in ("cross_entropy", "xentropy"):
+        return 1.0 / (1.0 + jnp.exp(-score))
+    if name in ("cross_entropy_lambda", "xentlambda"):
+        return jnp.log1p(jnp.exp(score))
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -87,11 +143,38 @@ class _PointwiseMetric(Metric):
     def finalize(self, value: float) -> float:
         return value
 
+    # subclasses with a jnp point loss opt into the device path
+    def point_loss_dev(self, pred, label):
+        return None
+
+    def finalize_dev(self, value):
+        return value
+
+    def transform_dev(self, score, objective):
+        return _dev_convert(score, objective)
+
+    def eval_device(self, score, objective=None):
+        label, weight = self._dev_arrays()
+        if label is None:
+            return None
+        pred = self.transform_dev(score, objective)
+        if pred is None:
+            return None
+        losses = self.point_loss_dev(pred, label)
+        if losses is None:
+            return None
+        value = self.finalize_dev(self._dev_mean(losses, weight))
+        return [(self.NAME, value, self.HIGHER_BETTER)]
+
 
 class L2Metric(_PointwiseMetric):
     NAME = "l2"
 
     def point_loss(self, pred, label):
+        d = pred - label
+        return d * d
+
+    def point_loss_dev(self, pred, label):
         d = pred - label
         return d * d
 
@@ -102,12 +185,20 @@ class RMSEMetric(L2Metric):
     def finalize(self, value):
         return math.sqrt(value)
 
+    def finalize_dev(self, value):
+        import jax.numpy as jnp
+        return jnp.sqrt(value)
+
 
 class L1Metric(_PointwiseMetric):
     NAME = "l1"
 
     def point_loss(self, pred, label):
         return np.abs(pred - label)
+
+    def point_loss_dev(self, pred, label):
+        import jax.numpy as jnp
+        return jnp.abs(pred - label)
 
 
 class QuantileMetric(_PointwiseMetric):
@@ -242,6 +333,22 @@ class BinaryLoglossMetric(_PointwiseMetric):
             return objective.convert_output(score)
         return 1.0 / (1.0 + np.exp(-score))
 
+    def transform_dev(self, score, objective):
+        if objective is None:
+            import jax.numpy as jnp
+            return 1.0 / (1.0 + jnp.exp(-score))
+        return _dev_convert(score, objective)
+
+    def point_loss_dev(self, prob, label):
+        import jax.numpy as jnp
+        # f32-representable clip: 1 - 1e-15 rounds to exactly 1.0 in
+        # f32, which would turn saturated sigmoids into log(0) = -inf;
+        # 1e-7 sits just above the f32 epsilon at 1.0, bounding the
+        # device loss at ~16.1 (host f64 bounds at ~34.5)
+        eps = jnp.float32(1e-7)
+        p = jnp.clip(prob, eps, 1.0 - eps)
+        return -(label * jnp.log(p) + (1.0 - label) * jnp.log(1.0 - p))
+
 
 class BinaryErrorMetric(_PointwiseMetric):
     NAME = "binary_error"
@@ -255,6 +362,16 @@ class BinaryErrorMetric(_PointwiseMetric):
         pred_pos = prob > 0.5  # threshold on converted output
         actual_pos = label > 0
         return (pred_pos != actual_pos).astype(np.float64)
+
+    def transform_dev(self, score, objective):
+        if objective is None:
+            import jax.numpy as jnp
+            return 1.0 / (1.0 + jnp.exp(-score))
+        return _dev_convert(score, objective)
+
+    def point_loss_dev(self, prob, label):
+        import jax.numpy as jnp
+        return ((prob > 0.5) != (label > 0)).astype(jnp.float32)
 
 
 def _auc(label_pos: np.ndarray, score: np.ndarray,
@@ -292,6 +409,40 @@ class AUCMetric(Metric):
         return [(self.NAME,
                  _auc(self.label > 0, np.asarray(score, np.float64),
                       self.weight), True)]
+
+    def eval_device(self, score, objective=None):
+        # vectorized tie-grouped weighted AUC ≡ _auc: sort ascending,
+        # group equal scores (segment ids from boundary cumsum), then
+        # accum = Σ_g bp_g · (cum_neg_before_g + bn_g/2)
+        import jax
+        import jax.numpy as jnp
+        label, weight = self._dev_arrays()
+        if label is None:
+            return None
+        n = score.shape[-1]
+        if n > (1 << 24):
+            # f32 running sums stay EXACT for unweighted counts only up
+            # to 2^24; beyond that cumsum silently stops incrementing —
+            # fall back to the f64 host path for huge valid sets
+            return None
+        w = weight if weight is not None else jnp.ones(n, jnp.float32)
+        order = jnp.argsort(score)
+        s = score[order]
+        is_pos = label[order] > 0
+        wo = w[order]
+        pos = jnp.where(is_pos, wo, 0.0)
+        neg = jnp.where(is_pos, 0.0, wo)
+        gid = jnp.concatenate([
+            jnp.zeros(1, jnp.int32),
+            jnp.cumsum((s[1:] != s[:-1]).astype(jnp.int32))])
+        bp = jax.ops.segment_sum(pos, gid, num_segments=n)
+        bn = jax.ops.segment_sum(neg, gid, num_segments=n)
+        cnb = jnp.cumsum(bn) - bn
+        accum = jnp.sum(bp * (cnb + 0.5 * bn))
+        sp, sn = jnp.sum(pos), jnp.sum(neg)
+        auc = jnp.where((sp == 0) | (sn == 0), jnp.float32(1.0),
+                        accum / jnp.maximum(sp * sn, K_EPSILON))
+        return [(self.NAME, auc, True)]
 
 
 class AveragePrecisionMetric(Metric):
@@ -339,6 +490,19 @@ class MultiLoglossMetric(Metric):
             value = float(np.mean(losses))
         return [(self.NAME, value, False)]
 
+    def eval_device(self, score, objective=None):
+        import jax.numpy as jnp
+        label, weight = self._dev_arrays()
+        if label is None or score.ndim != 2:
+            return None
+        n = score.shape[1]
+        p = jnp.exp(score - score.max(axis=0, keepdims=True))
+        p = p / p.sum(axis=0, keepdims=True)
+        pt = jnp.clip(p[label.astype(jnp.int32), jnp.arange(n)],
+                      K_EPSILON, 1.0)
+        value = self._dev_mean(-jnp.log(pt), weight)
+        return [(self.NAME, value, False)]
+
 
 class MultiErrorMetric(Metric):
     NAME = "multi_error"
@@ -361,6 +525,21 @@ class MultiErrorMetric(Metric):
             value = float(np.sum(err * self.weight) / self.sum_weights)
         else:
             value = float(np.mean(err))
+        name = (self.NAME if self.top_k <= 1
+                else f"multi_error@{self.top_k}")
+        return [(name, value, False)]
+
+    def eval_device(self, score, objective=None):
+        import jax.numpy as jnp
+        label, weight = self._dev_arrays()
+        if label is None or score.ndim != 2:
+            return None
+        n = score.shape[1]
+        li = label.astype(jnp.int32)
+        true_score = score[li, jnp.arange(n)]
+        rank = (score > true_score[None, :]).sum(axis=0)
+        err = (rank >= self.top_k).astype(jnp.float32)
+        value = self._dev_mean(err, weight)
         name = (self.NAME if self.top_k <= 1
                 else f"multi_error@{self.top_k}")
         return [(name, value, False)]

@@ -1702,12 +1702,41 @@ class GBDT:
                     self._tree_outputs(t, vd.bins_dev, vd.dataset.raw))
 
     def _eval(self, metrics, score, data_name):
+        """Evaluate metrics over a device score array.
+
+        On non-CPU backends, metrics with a device path (Metric.
+        eval_device) compute on device and ALL their scalars come back
+        in one stacked fetch — pulling the full [K, N] score through a
+        high-latency tunnel every eval would otherwise dominate training
+        when valid sets are attached. Metrics without a device path fall
+        back to the host implementation (one score pull, shared)."""
         out = []
-        score_np = np.asarray(score, np.float64)
-        view = score_np[0] if self.num_tree_per_iteration == 1 else score_np
+        K = self.num_tree_per_iteration
+        use_dev = jax.default_backend() != "cpu"
+        view_dev = score[0] if K == 1 else score
+        entries = []          # ("dev", name, hib, idx) | ("host", metric)
+        dev_scalars = []
         for m in metrics:
-            for name, value, hib in m.eval(view, self.objective):
-                out.append((data_name, name, value, hib))
+            dev = m.eval_device(view_dev, self.objective) if use_dev \
+                else None
+            if dev is None:
+                entries.append(("host", m))
+            else:
+                for name, scalar, hib in dev:
+                    entries.append(("dev", name, hib, len(dev_scalars)))
+                    dev_scalars.append(scalar)
+        fetched = (np.asarray(jnp.stack(dev_scalars), np.float64)
+                   if dev_scalars else None)
+        view_np = None
+        for e in entries:
+            if e[0] == "host":
+                if view_np is None:
+                    score_np = np.asarray(score, np.float64)
+                    view_np = score_np[0] if K == 1 else score_np
+                for name, value, hib in e[1].eval(view_np, self.objective):
+                    out.append((data_name, name, value, hib))
+            else:
+                out.append((data_name, e[1], float(fetched[e[3]]), e[2]))
         return out
 
     # ------------------------------------------------------------------
