@@ -217,6 +217,43 @@ def test_async_partial_degenerate_multiclass_keeps_iteration_budget():
     assert out["true"] == out["false"]
 
 
+def test_async_continued_training_matches_sync():
+    """init_model + async: training continues on top of a loaded model
+    with the same result as the sync path."""
+    X, y = _data()
+    base = dict(objective="binary", num_leaves=15, verbose=-1)
+    first = lgb.train(dict(base, tpu_async_boosting="true"),
+                      lgb.Dataset(X, label=y), num_boost_round=8)
+    s = first.model_to_string()
+    out = {}
+    for mode in ("false", "true"):
+        cont = lgb.train(dict(base, tpu_async_boosting=mode),
+                         lgb.Dataset(X, label=y), num_boost_round=6,
+                         init_model=lgb.Booster(model_str=s))
+        out[mode] = (cont.num_trees(), _structure(cont))
+    assert out["true"][0] == 14
+    assert out["true"] == out["false"]
+
+
+def test_async_early_stopping_flow():
+    """early_stopping callback over a valid set stops at the same
+    iteration in async and sync modes."""
+    X, y = _data()
+    Xv, yv = _data(n=800, seed=9)
+    base = dict(objective="binary", num_leaves=31, learning_rate=0.3,
+                verbose=-1)
+    best = {}
+    for mode in ("false", "true"):
+        ds = lgb.Dataset(X, label=y)
+        b = lgb.train(dict(base, tpu_async_boosting=mode), ds,
+                      num_boost_round=60,
+                      valid_sets=[lgb.Dataset(Xv, label=yv,
+                                              reference=ds)],
+                      callbacks=[lgb.early_stopping(5, verbose=False)])
+        best[mode] = b.best_iteration
+    assert best["true"] == best["false"]
+
+
 def test_async_rollback_one_iter():
     X, y = _data()
     params = dict(objective="binary", num_leaves=15, verbose=-1,
