@@ -25,7 +25,10 @@ touches ``models``. The "no more splits" stop condition is checked in
 batches (one scalar fetch per tpu_stop_check_interval iterations) and is
 exact: on detection the affected iterations are rolled back (scores
 subtracted, sampler RNG restored) and replayed through the synchronous
-path, so the final model matches the sync path tree-for-tree.
+path. The final model matches the sync path split-for-split up to f32
+score rounding: the device update applies the f32 rate directly while
+the sync path shrinks on host in f64, which can flip gain TIES between
+adjacent thresholds over empty bins (identical partitions either way).
 """
 from __future__ import annotations
 

@@ -152,6 +152,46 @@ def test_async_first_iteration_degenerate_terminal_flush():
     assert abs(out["false"][1] - 0.75) < 0.05   # base rate, not 0.5
 
 
+def test_async_randomized_config_sweep():
+    """Property sweep: random hyperparameter combinations must produce
+    equivalent models in async and sync modes. Exact threshold-bin
+    equality is NOT asserted: the async path's f32 device score update
+    (vs the sync path's f64 host shrink) can flip gain TIES between
+    adjacent thresholds over empty bins — observed as e.g. threshold 80
+    vs 81 with identical row partitions. The invariants that must hold:
+    same split features, same leaf row counts, same predictions to f32
+    noise."""
+    rng = np.random.default_rng(123)
+    X, y = _data(n=1500, f=8)
+    for trial in range(6):
+        params = dict(
+            objective="binary", verbose=-1,
+            num_leaves=int(rng.integers(4, 32)),
+            learning_rate=float(rng.uniform(0.05, 0.5)),
+            min_data_in_leaf=int(rng.integers(5, 60)),
+            feature_fraction=float(rng.uniform(0.6, 1.0)),
+            bagging_fraction=float(rng.uniform(0.6, 1.0)),
+            bagging_freq=int(rng.integers(0, 3)),
+            lambda_l1=float(rng.choice([0.0, 0.5])),
+            lambda_l2=float(rng.choice([0.0, 2.0])),
+            min_gain_to_split=float(rng.choice([0.0, 1e-3])),
+            tpu_stop_check_interval=int(rng.integers(3, 20)),
+            seed=int(rng.integers(0, 1000)),
+        )
+        out = {}
+        for mode in ("false", "true"):
+            b = lgb.train(dict(params, tpu_async_boosting=mode),
+                          lgb.Dataset(X, label=y), num_boost_round=10)
+            out[mode] = (
+                [(t.num_leaves, t.split_feature.tolist(),
+                  t.leaf_count.tolist())
+                 for t in b._engine.models],
+                b.predict(X))
+        assert out["true"][0] == out["false"][0], (trial, params)
+        np.testing.assert_allclose(out["true"][1], out["false"][1],
+                                   atol=1e-4, err_msg=str((trial, params)))
+
+
 def test_async_model_io_roundtrip():
     X, _, m_async = _train_pair({}, n_round=12)
     s = m_async.model_to_string()
