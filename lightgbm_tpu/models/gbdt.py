@@ -222,12 +222,12 @@ class GBDT:
         Requirements: plain GBDT boosting with no per-iteration host
         feedback — no linear leaves (host lstsq), no CEGB bookkeeping,
         no quantized leaf renewal, no L1-style RenewTreeOutput, no
-        position bias Newton step, and a sampler that never reads
-        gradients (bagging qualifies, GOSS does not). Any tree learner
-        qualifies: the distributed learners' collectives live inside the
-        jitted grower program, and the device trees they return are
-        replicated, so the deferred-materialization machinery is
-        learner-agnostic."""
+        position bias Newton step, and a sampler that either never
+        reads gradients (bagging) or can sample on device (GOSS via
+        sample_dev). Any tree learner qualifies: the distributed
+        learners' collectives live inside the jitted grower program,
+        and the device trees they return are replicated, so the
+        deferred-materialization machinery is learner-agnostic."""
         if self._async_disabled:
             return False
         if self._async_mode is None:
@@ -245,7 +245,8 @@ class GBDT:
                 and (self.objective is None or
                      not self.objective.is_renew_tree_output())
                 and not self._pos_bias
-                and not self.sample_strategy.needs_grad
+                and (not self.sample_strategy.needs_grad or
+                     hasattr(self.sample_strategy, "sample_dev"))
                 and all(self.class_need_train))
             if want and not self._async_mode:
                 log.info("tpu_async_boosting: falling back to the "
@@ -418,10 +419,25 @@ class GBDT:
             if K == 1:
                 grad = grad[None, :]
                 hess = hess[None, :]
-        sample = self.sample_strategy.sample(self.iter)
-        if sample is not None:
-            sel_dev = jnp.asarray(sample[0])
-            w_dev = jnp.asarray(sample[1])
+        sel_dev = w_dev = None
+        strat = self.sample_strategy
+        if strat.needs_grad:
+            # device-capable gradient sampler (GOSS): stateless jax key
+            # chain, so there is no RNG state to snapshot. NOTE a
+            # stop-check rollback replays through the SYNC path, whose
+            # host sampler draws a fresh (equally valid) GOSS sample —
+            # bit-exact replay holds only for RNG-snapshot samplers
+            # (bagging); for GOSS the guarantee is policy-level
+            key = jax.random.fold_in(self._goss_key, self.iter)
+            pair = strat.sample_dev(self.iter, grad, hess, key)
+            if pair is not None:
+                sel_dev, w_dev = pair
+            sample = pair
+        else:
+            sample = strat.sample(self.iter)
+            if sample is not None:
+                sel_dev = jnp.asarray(sample[0])
+                w_dev = jnp.asarray(sample[1])
 
         if self._async_upd_fn is None:
             donate = (0,) if self.config.tpu_donate_state else ()
@@ -538,6 +554,9 @@ class GBDT:
 
         self.sample_strategy = SampleStrategy.create(
             cfg, self.num_data, K, metadata=md)
+        # stateless key chain for device-side gradient sampling (GOSS
+        # under async boosting); same seed the host sampler honors
+        self._goss_key = jax.random.PRNGKey(int(cfg.bagging_seed))
 
         hp = SplitHyperParams(
             lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,

@@ -134,24 +134,75 @@ class GOSSStrategy(SampleStrategy):
     def is_hessian_change(self):
         return True
 
-    def sample(self, it, grad=None, hess=None):
+    def _policy(self, it):
+        """Shared scalar GOSS policy (ref: goss.hpp:19-45): returns
+        (top_k, other_k, multiply) or None during the 1/learning_rate
+        warmup. The single source for BOTH the host and device samplers
+        so the policy cannot drift between them."""
         cfg = self.config
         if it < int(1.0 / cfg.learning_rate):
             return None
+        n = self.num_data
+        top_k = max(1, int(n * cfg.top_rate))
+        other_k = max(1, int(n * cfg.other_rate))
+        return top_k, other_k, (n - top_k) / other_k
+
+    def sample_dev(self, it, grad, hess, key):
+        """Device-side GOSS for the async fast path: the _policy
+        computed entirely on device (lax top-k threshold + jax RNG keep
+        mask), so gradient-based sampling never pulls [K, N] gradients
+        through the host. The keep mask uses the stateless jax key
+        chain instead of the host Generator — an equally valid GOSS
+        draw, but not bit-identical to the sync path's numpy sampling
+        (both honor bagging_seed). One jitted dispatch per call.
+        Returns (selected, weight) device arrays or None in warmup."""
+        pol = self._policy(it)
+        if pol is None:
+            return None
+        top_k, other_k, multiply = pol
+        if not hasattr(self, "_dev_jit"):
+            import jax
+            import jax.numpy as jnp
+
+            def draw(grad, hess, key, top_k, other_k, multiply):
+                n = grad.shape[-1]
+                g = jnp.sum(jnp.abs(grad * hess), axis=0)    # [N]
+                threshold = jax.lax.top_k(g, top_k)[0][-1]
+                is_top = g >= threshold
+                rest = ~is_top
+                n_rest = jnp.maximum(
+                    jnp.sum(rest.astype(jnp.int32)), 1)
+                keep_prob = jnp.minimum(
+                    1.0, other_k / n_rest.astype(jnp.float32))
+                sampled = rest & (jax.random.uniform(key, (n,)) <
+                                  keep_prob)
+                sel = (is_top | sampled).astype(jnp.float32)
+                weight = jnp.where(sampled, jnp.float32(multiply),
+                                   1.0) * sel
+                return sel, weight
+
+            self._dev_jit = jax.jit(draw,
+                                    static_argnames=("top_k", "other_k",
+                                                     "multiply"))
+        return self._dev_jit(grad, hess, key, top_k=top_k,
+                             other_k=other_k, multiply=multiply)
+
+    def sample(self, it, grad=None, hess=None):
+        pol = self._policy(it)
+        if pol is None:
+            return None
+        top_k, other_k, multiply = pol
         n = self.num_data
         # grad/hess may be [K, N]; rank by sum over classes of |g*h|
         g = np.abs(np.asarray(grad, np.float64) * np.asarray(hess, np.float64))
         if g.ndim == 2:
             g = g.sum(axis=0)
-        top_k = max(1, int(n * cfg.top_rate))
-        other_k = max(1, int(n * cfg.other_rate))
         threshold = np.partition(g, n - top_k)[n - top_k]
         is_top = g >= threshold
         rest = ~is_top
         n_rest = int(rest.sum())
         keep_prob = min(1.0, other_k / max(n_rest, 1))
         sampled = rest & (self.rng.random(n) < keep_prob)
-        multiply = (n - top_k) / other_k
         sel = (is_top | sampled).astype(np.float32)
         weight = np.where(sampled, multiply, 1.0).astype(np.float32) * sel
         return sel, weight

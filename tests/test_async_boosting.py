@@ -203,9 +203,7 @@ def test_async_model_io_roundtrip():
 def test_async_fallback_features_use_sync():
     """Features requiring per-iteration host work silently fall back."""
     X, y = _data()
-    for extra in (dict(data_sample_strategy="goss", top_rate=0.3,
-                       other_rate=0.3),
-                  dict(linear_tree=True),
+    for extra in (dict(linear_tree=True),
                   dict(boosting="dart")):
         params = dict(objective="binary", num_leaves=7, verbose=-1,
                       tpu_async_boosting="true", **extra)
@@ -213,6 +211,32 @@ def test_async_fallback_features_use_sync():
         assert b.num_trees() > 0
         eng = b._engine
         assert not eng._pending  # nothing left on device
+
+
+def test_async_goss_device_sampling():
+    """GOSS stays on the async path via the device sampler (stateless
+    jax keys — a valid GOSS draw, not bit-identical to the host RNG).
+    The model must train to a comparable fit."""
+    X, y = _data(n=4000)
+    params = dict(objective="binary", num_leaves=15, learning_rate=0.2,
+                  data_sample_strategy="goss", top_rate=0.2,
+                  other_rate=0.2, verbose=-1)
+    fits = {}
+    for mode in ("false", "true"):
+        b = lgb.train(dict(params, tpu_async_boosting=mode),
+                      lgb.Dataset(X, label=y), num_boost_round=30)
+        assert b.num_trees() == 30
+        p = b.predict(X)
+        fits[mode] = float(np.mean((p > 0.5) == (y > 0)))
+    assert fits["true"] > 0.9 and fits["false"] > 0.9
+    # async mode really did stay async (engine flag resolved true)
+    # (re-train to inspect, since predict flushed the first one)
+    ds = lgb.Dataset(X, label=y)
+    b2 = lgb.Booster(dict(params, tpu_async_boosting="true"), ds)
+    for _ in range(12):
+        b2.update()
+    assert b2._engine._async_mode is True
+    assert b2._engine._pending          # trees still on device
 
 
 import pytest
