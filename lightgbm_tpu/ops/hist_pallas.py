@@ -200,12 +200,6 @@ def fit_tiles(feature_tile: int, num_bin: int,
     return max(feature_tile, 8), max(block_rows, 128)
 
 
-def fit_feature_tile(feature_tile: int, num_bin: int,
-                     block_rows: int) -> int:
-    """Back-compat wrapper: feature-tile part of fit_tiles."""
-    return fit_tiles(feature_tile, num_bin, block_rows)[0]
-
-
 def hist_pallas(bins_t: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
                 block_rows: int = 1024, feature_tile: int = 8,
                 interpret: bool | None = None) -> jnp.ndarray:
