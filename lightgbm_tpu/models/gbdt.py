@@ -695,17 +695,22 @@ class GBDT:
                 log.warning(f"tree_learner={tl} requested but {cap}; "
                             "running serial")
         # ---- multi-value sparse storage (≡ SparseBin/MultiValSparseBin,
-        # sparse_bin.hpp:858): serial full-pass scatter histogram over the
-        # stored nonzeros; default-bin mass reconstructed at scan time
+        # sparse_bin.hpp:858): scatter histogram over the stored
+        # nonzeros; default-bin mass reconstructed at scan time.
+        # Composes with the data-parallel learner (rows of the [R, K]
+        # packing shard like dense rows; the default-bin fix runs on the
+        # psum'd global histogram); voting/feature stay serial fallbacks
         self._multival = train.bins_mv is not None
         if self._multival:
             fallback = []
-            if self._tree_learner != "serial":
+            if self._tree_learner not in ("serial", "data"):
                 fallback.append(f"tree_learner={self._tree_learner}")
                 self._tree_learner = "serial"
             if fallback:
-                log.warning("multi-value sparse storage is serial-only; "
-                            "overriding: " + ", ".join(fallback))
+                log.warning("multi-value sparse storage supports the "
+                            "serial and data learners only (consider "
+                            "tree_learner=data); overriding: " +
+                            ", ".join(fallback))
             self.grower_cfg = dataclasses.replace(
                 self.grower_cfg, hist_backend="multival")
         self._compact = self.grower_cfg.row_sched == "compact"
@@ -817,24 +822,23 @@ class GBDT:
         if self.feature_meta is None:
             self._grow = None
         elif self._multival:
-            from ..ops.hist_multival import (SparseBins,
-                                             make_default_bin_fix,
-                                             make_fetch_bin_column)
+            from ..ops.hist_multival import SparseBins
             if forced is not None:
                 log.warning("forced splits are not supported with "
                             "multi-value sparse storage; ignoring")
                 forced = None
-            idx_h, binv_h = train.bins_mv
-            self._bins_mv_dev = SparseBins(jnp.asarray(idx_h),
-                                           jnp.asarray(binv_h),
-                                           train.num_used_features)
-            dflt = np.asarray([m.default_bin for m in mappers], np.int32)
-            self._grow = jax.jit(make_tree_grower(
-                self.grower_cfg, self.feature_meta,
-                fetch_bin_column=make_fetch_bin_column(dflt),
-                prepare_split_hist=make_default_bin_fix(
-                    dflt, self.num_bin_max),
-                prepare_is_pure=True))
+            if self._tree_learner == "data":
+                self._setup_distributed(train, None, None)
+            else:
+                idx_h, binv_h = train.bins_mv
+                self._bins_mv_dev = SparseBins(jnp.asarray(idx_h),
+                                               jnp.asarray(binv_h),
+                                               train.num_used_features)
+                fetch, prepare = self._multival_hooks(train)
+                self._grow = jax.jit(make_tree_grower(
+                    self.grower_cfg, self.feature_meta,
+                    fetch_bin_column=fetch, prepare_split_hist=prepare,
+                    prepare_is_pure=True))
         elif self._tree_learner == "serial":
             self._grow = jax.jit(
                 make_tree_grower(self.grower_cfg, self.feature_meta,
@@ -864,6 +868,18 @@ class GBDT:
         self._col_rng = np.random.default_rng(cfg.feature_fraction_seed)
         self.num_used_features = train.num_used_features
 
+    def _multival_hooks(self, train: BinnedDataset):
+        """Multival grower hooks (shared by the serial and data-parallel
+        builders so the default-bin semantics cannot drift): the
+        column accessor for partitions and the FixHistogram-style
+        default-bin reconstruction (ops/hist_multival.py)."""
+        from ..ops.hist_multival import (make_default_bin_fix,
+                                         make_fetch_bin_column)
+        dflt = np.asarray(
+            [m.default_bin for m in train.used_bin_mappers()], np.int32)
+        return (make_fetch_bin_column(dflt),
+                make_default_bin_fix(dflt, self.num_bin_max))
+
     def _train_bins(self):
         """Bins array the grower trains on (layout depends on the learner;
         the distributed wrapper holds its own sharded copy)."""
@@ -884,10 +900,17 @@ class GBDT:
         With multi-value sparse storage the dense matrix is reconstructed
         on demand — only rollback/DART/continued-training traversal needs
         it, and it costs the dense footprint (warned once)."""
-        if self._bins_dev_cache is None and self._bins_fr_host is None \
-                and getattr(self, "_bins_mv_dev", None) is not None:
+        mv_pair = None
+        if self._bins_dev_cache is None and self._bins_fr_host is None:
+            if getattr(self, "_bins_mv_dev", None) is not None:
+                mv_pair = (self._bins_mv_dev.idx, self._bins_mv_dev.binv)
+            elif (self.train_set is not None and
+                    self.train_set.bins_mv is not None):
+                # distributed multival keeps only the sharded SparseBins;
+                # densify from the host packing for traversal consumers
+                mv_pair = self.train_set.bins_mv
+        if mv_pair is not None:
             from ..ops.hist_multival import densify
-            sb = self._bins_mv_dev
             log.warning("densifying multi-value sparse bins for a "
                         "traversal path (rollback/DART/continued "
                         "training) — this costs the dense bin footprint")
@@ -895,7 +918,7 @@ class GBDT:
                 [m.default_bin for m in self.train_set.used_bin_mappers()],
                 np.int32)
             self._bins_dev_cache = jnp.asarray(
-                densify(sb.idx, sb.binv, dflt))
+                densify(mv_pair[0], mv_pair[1], dflt))
         elif (self._bins_dev_cache is None and
                 self._bins_fr_host is not None):
             self._bins_dev_cache = jnp.asarray(self._bins_fr_host)
@@ -933,9 +956,39 @@ class GBDT:
             log.fatal("interaction_constraints are not supported with "
                       "tree_learner=feature")
 
-        if bins_host is None:
-            bins_host = train.bins
-        if tl in ("data", "voting"):
+        if self._multival and tl == "data":
+            # multi-value sparse storage under the data-parallel learner:
+            # the [R, K] nonzero packing row-shards exactly like dense
+            # rows (pad rows carry idx = -1, contributing nothing); the
+            # column accessor and leaf gathers are shard-local, and the
+            # default-bin reconstruction runs on the psum'd GLOBAL
+            # histograms in the split scan (see make_data_parallel_grower)
+            from ..ops.hist_multival import SparseBins
+            mesh = build_mesh(n_dev, axis_names=(DATA_AXIS,))
+            R_pad = padded_rows(N, n_dev)
+            self._row_pad = R_pad - N
+            idx_h, binv_h = train.bins_mv
+            if self._row_pad:
+                idx_h = np.pad(idx_h, ((0, self._row_pad), (0, 0)),
+                               constant_values=-1)
+                binv_h = np.pad(binv_h, ((0, self._row_pad), (0, 0)))
+            sh = NamedSharding(mesh, P(DATA_AXIS, None))
+            self.bins_sharded = SparseBins(
+                jax.device_put(np.ascontiguousarray(idx_h), sh),
+                jax.device_put(np.ascontiguousarray(binv_h), sh),
+                train.num_used_features)
+            fetch, prepare = self._multival_hooks(train)
+            grow = make_data_parallel_grower(
+                self.grower_cfg, self.feature_meta, mesh,
+                fetch_bin_column=fetch, prepare_split_hist=prepare,
+                prepare_is_pure=True,
+                bins_spec=SparseBins(P(DATA_AXIS, None),
+                                     P(DATA_AXIS, None),
+                                     train.num_used_features))
+            self._grow_dist = jax.jit(grow)
+        elif tl in ("data", "voting"):
+            if bins_host is None:
+                bins_host = train.bins
             mesh = build_mesh(n_dev, axis_names=(DATA_AXIS,))
             R_pad = padded_rows(N, n_dev)
             self._row_pad = R_pad - N
@@ -960,6 +1013,8 @@ class GBDT:
                     top_k=int(cfg.top_k))
             self._grow_dist = jax.jit(grow)
         else:  # feature-parallel
+            if bins_host is None:
+                bins_host = train.bins
             mesh = build_mesh(n_dev, axis_names=(FEATURE_AXIS,))
             Fp = padded_features(F, n_dev)
             self._feat_pad = Fp - F

@@ -52,7 +52,11 @@ from .mesh import DATA_AXIS
 
 def make_data_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
                               mesh: Mesh, data_axis: str = DATA_AXIS,
-                              forced=None, bundle=None):
+                              forced=None, bundle=None,
+                              fetch_bin_column=None,
+                              prepare_split_hist=None,
+                              prepare_is_pure: bool = False,
+                              bins_spec=None):
     """Build `grow(bins_t, gh, feature_mask, cegb) -> (TreeArrays, leaf_id)`
     where `bins_t` [F, R] and `gh` [R, 3] are sharded over `data_axis` on
     their row dimension; R must be divisible by the axis size (pad upstream
@@ -60,6 +64,13 @@ def make_data_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
     sharded. ``feature_mask``/``cegb`` match the serial grower's arguments
     (replicated); ``forced`` bakes a forced-split prefix like the serial
     grower (valid here because the histogram pool holds GLOBAL sums).
+
+    Multi-value sparse storage composes by passing the multival hooks
+    plus a SparseBins ``bins_spec`` (idx/binv row-sharded): the column
+    accessor and per-leaf gathers are shard-local, local scatter
+    histograms psum like the dense path, and the default-bin fix runs
+    in the split scan AFTER the psum against the GLOBAL leaf sums — the
+    same algebra as the reference's distributed FixHistogram.
     """
     grow = make_tree_grower(
         cfg, meta,
@@ -70,16 +81,22 @@ def make_data_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
         reduce_max=lambda x: lax.pmax(x, data_axis),
         localize_key=lambda k: jax.random.fold_in(
             k, lax.axis_index(data_axis)),
-        forced=forced, bundle=bundle)
+        forced=forced, bundle=bundle,
+        fetch_bin_column=fetch_bin_column,
+        prepare_split_hist=prepare_split_hist,
+        prepare_is_pure=prepare_is_pure)
 
     def wrapped(bins_t, gh, feature_mask, cegb_const, cegb_count, rng_key):
         return grow(bins_t, gh, feature_mask, (cegb_const, cegb_count),
                     rng_key)
 
     # compact scheduling takes ROW-major [R, F] bins (rows sharded on dim
-    # 0); full mode takes feature-major [F, R] (rows sharded on dim 1)
-    bins_spec = (P(data_axis, None) if cfg.row_sched == "compact"
-                 else P(None, data_axis))
+    # 0); full mode takes feature-major [F, R] (rows sharded on dim 1).
+    # A caller-provided bins_spec (pytree, e.g. SparseBins of specs)
+    # overrides for non-dense storages.
+    if bins_spec is None:
+        bins_spec = (P(data_axis, None) if cfg.row_sched == "compact"
+                     else P(None, data_axis))
     sharded = _make_sharded(
         wrapped, mesh,
         in_specs=(bins_spec, P(data_axis, None), P(), P(), P(), P()),

@@ -87,6 +87,65 @@ def test_multival_monotone_and_sampling(rng):
     assert acc > 0.8
 
 
+@pytest.mark.parametrize("sched", ["compact", "full"])
+def test_multival_data_parallel_matches_serial(rng, sched):
+    """Multival sparse storage under tree_learner=data on the 8-device
+    mesh: the psum'd stored-bin histograms + global default-bin fix must
+    reproduce the serial multival model up to f32 scatter-order drift
+    (per-shard scatter + psum sums in a different order than the serial
+    single scatter, which can flip near-tie splits — the same tolerance
+    class as the dense-vs-multival comparison above)."""
+    X, y = _sparse_data(rng, n=1100)       # odd size exercises row pad
+    sp_mat = scipy_sparse.csr_matrix(X)
+    base = {"objective": "binary", "tpu_sparse_storage": "multival",
+            "tpu_row_scheduling": sched}
+    serial = _train(sp_mat, y, base)
+    dp = _train(sp_mat, y, {**base, "tree_learner": "data"})
+    np.testing.assert_allclose(dp.predict(X), serial.predict(X),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_multival_data_parallel_quantized_exact(rng):
+    """Quantized int8 gradients compose with multival x data-parallel —
+    and int32 scatter histograms psum EXACTLY, so sharded and serial
+    models are split-for-split identical (the deterministic path)."""
+    X, y = _sparse_data(rng)
+    sp_mat = scipy_sparse.csr_matrix(X)
+    q = {"objective": "binary", "use_quantized_grad": True,
+         "stochastic_rounding": False, "tpu_sparse_storage": "multival"}
+    serial = _train(sp_mat, y, q)
+    dp = _train(sp_mat, y, {**q, "tree_learner": "data"})
+
+    def structure(b):
+        return [(t.num_leaves, t.split_feature.tolist(),
+                 t.threshold_bin.tolist(), t.leaf_count.tolist())
+                for t in b._engine.models]
+
+    assert structure(serial) == structure(dp)
+    np.testing.assert_allclose(dp.predict(X), serial.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_multival_data_parallel_rollback(rng):
+    """Traversal consumers (rollback) must work under multival+data,
+    where only the sharded SparseBins exist — bins_dev densifies from
+    the host packing."""
+    X, y = _sparse_data(rng)
+    sp_mat = scipy_sparse.csr_matrix(X)
+    ds = lgb.Dataset(sp_mat, label=y,
+                     params={"tpu_sparse_storage": "multival"})
+    b = lgb.Booster({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1, "tpu_sparse_storage": "multival",
+                     "tree_learner": "data", "min_data_in_leaf": 5}, ds)
+    for _ in range(4):
+        b.update()
+    p4 = b.predict(X)
+    b.update()
+    b.rollback_one_iter()
+    assert b.current_iteration() == 4
+    np.testing.assert_allclose(b.predict(X), p4, atol=1e-6)
+
+
 def test_multival_cv(rng):
     """cv() row-subsets the multival storage directly (CopySubrow on the
     [R, K] layout) -- sparse users keep cross-validation."""
