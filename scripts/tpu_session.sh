@@ -10,6 +10,14 @@ cd "$(dirname "$0")/.."
 # 0. health (fast fail if the backend is still recovering)
 python -c "import jax; print(jax.devices())" || exit 3
 
+# 0.5 headline numbers FIRST (default config; also warms the compile
+# cache for the driver's end-of-round run) — if the healthy window is
+# short, these are the measurements that matter most
+BENCH_ROWS=100000 BENCH_ITERS=30 BENCH_WATCHDOG_SEC=1500 \
+  python bench.py 2>&1 | tee bench_logs/headline_100k.log
+BENCH_ROWS=1000000 BENCH_ITERS=20 BENCH_WATCHDOG_SEC=1700 \
+  python bench.py 2>&1 | tee bench_logs/headline_1m.log
+
 # 1. kernel/primitive microbenches:
 #    - gather u8 vs packed u32 vs i32  -> tpu_packed_bins default
 #    - partition sort vs scatter by size -> grower auto threshold (32768)
