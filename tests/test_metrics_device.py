@@ -100,6 +100,33 @@ def test_binary_logloss_device_saturated_scores_finite():
     assert np.isfinite(v2) and v2 > 10.0
 
 
+def test_engine_eval_mixed_device_host_ordering(monkeypatch):
+    """engine._eval's batched device fetch must preserve metric order
+    and values when device-path metrics (binary_logloss, auc) mix with
+    host-only ones (average_precision). Forced on the CPU backend by
+    patching the backend probe — the jnp math is identical."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.models import gbdt as gbdt_mod
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(800, 6)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    params = dict(objective="binary", num_leaves=7, verbose=-1,
+                  metric=["binary_logloss", "average_precision", "auc"])
+    ds = lgb.Dataset(X, label=y)
+    b = lgb.Booster(params, ds)
+    b.add_valid(lgb.Dataset(X[:300], label=y[:300], reference=ds), "v")
+    for _ in range(3):
+        b.update()
+    host_res = b._engine.eval_valid()
+    monkeypatch.setattr(gbdt_mod.jax, "default_backend", lambda: "tpu")
+    dev_res = b._engine.eval_valid()
+    assert [(r[0], r[1], r[3]) for r in host_res] == \
+           [(r[0], r[1], r[3]) for r in dev_res]
+    for (hr, dr) in zip(host_res, dev_res):
+        assert abs(hr[2] - dr[2]) < 2e-5 * max(1.0, abs(hr[2])), (hr, dr)
+
+
 def test_unsupported_falls_back():
     # no device path for ndcg-style metrics: eval_device returns None
     m = _mk(M.L2Metric, LABEL_REG)
