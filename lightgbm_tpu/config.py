@@ -235,6 +235,12 @@ _reg("tpu_partition_mode", str, "auto", ())  # auto | scatter | sort
 _reg("tpu_min_bucket", int, 2048, ())        # smallest pow2 segment bucket
 _reg("tpu_use_pallas", bool, False, ())      # Pallas histogram kernel (off until tuned)
 _reg("tpu_rows_per_block", int, 1024, ())    # row tile for histogram kernels
+# opt-in device-side bagging: draw the bagging mask on device from a
+# stateless key chain instead of host RNG + [N] mask upload (~15-25 ms
+# host time per resample at 1M rows). Approximate-fraction per-row
+# draw (the host path picks an exact-count subset), so sync and async
+# runs differ when enabled; balanced/query bagging stay host-side.
+_reg("tpu_device_bagging", bool, False, ())
 # bit-pack 4 uint8 bins per uint32 word for the compact scheduler's
 # per-leaf row gathers (TPU gathers cost per element; packing quarters
 # them). auto = off until device-measured; true/false force. Requires

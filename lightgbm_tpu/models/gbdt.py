@@ -434,10 +434,16 @@ class GBDT:
                 sel_dev, w_dev = pair
             sample = pair
         else:
-            sample = strat.sample(self.iter)
-            if sample is not None:
-                sel_dev = jnp.asarray(sample[0])
-                w_dev = jnp.asarray(sample[1])
+            sdev = getattr(strat, "sample_dev", None)
+            sample = (sdev(self.iter, key=self._goss_key)
+                      if sdev is not None else None)
+            if sample is not None:      # opt-in device bagging
+                sel_dev, w_dev = sample
+            else:
+                sample = strat.sample(self.iter)
+                if sample is not None:
+                    sel_dev = jnp.asarray(sample[0])
+                    w_dev = jnp.asarray(sample[1])
 
         if self._async_upd_fn is None:
             donate = (0,) if self.config.tpu_donate_state else ()
@@ -1414,12 +1420,21 @@ class GBDT:
 
         # -- bagging / GOSS (host decision, device apply) ---------------
         # only GOSS reads gradients; skip the [K, N] device->host pull
-        # for RNG-only strategies (it costs a full tunnel round-trip)
+        # for RNG-only strategies (it costs a full tunnel round-trip).
+        # Opt-in device bagging is consulted HERE too so a stop-check
+        # rollback replay re-derives the exact same stateless-key mask
+        # the async path used (sample_strategy.sample_dev docstring)
         if self.sample_strategy.needs_grad:
             sample = self.sample_strategy.sample(
                 self.iter, np.asarray(grad), np.asarray(hess))
         else:
-            sample = self.sample_strategy.sample(self.iter)
+            sdev = getattr(self.sample_strategy, "sample_dev", None)
+            sample = (sdev(self.iter, key=self._goss_key)
+                      if sdev is not None else None)
+            if sample is not None:
+                sample = (np.asarray(sample[0]), np.asarray(sample[1]))
+            else:
+                sample = self.sample_strategy.sample(self.iter)
         if sample is not None:
             selected, weight = sample
             sel_dev = jnp.asarray(selected)

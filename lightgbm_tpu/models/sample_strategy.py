@@ -78,6 +78,41 @@ class BaggingStrategy(SampleStrategy):
             log.info("Using bagging, bagging_fraction="
                      f"{config.bagging_fraction}")
 
+    def sample_dev(self, it, grad=None, hess=None, key=None):
+        """Opt-in device bagging (tpu_device_bagging): per-row keep with
+        probability bagging_fraction from the stateless key chain. The
+        key is derived from the RESAMPLE iteration (it - it % freq), so
+        the mask is identical across a bagging_freq window and BOTH the
+        async and sync paths re-derive it (train_one_iter consults
+        sample_dev in either mode when the opt-in is on) — a stop-check
+        rollback replay therefore reproduces the exact mask. At least
+        one row is always kept (the host path's max(1, cnt) analogue).
+        Returns None (host fallback) for the balanced / by-query
+        variants and when the opt-in is off; approximate fraction vs
+        the host path's exact-count subset (documented in config.py)."""
+        cfg = self.config
+        if (not getattr(cfg, "tpu_device_bagging", False) or
+                not self.need_bagging or self.balanced or
+                cfg.bagging_by_query):
+            return None
+        import jax
+        import jax.numpy as jnp
+        freq = max(cfg.bagging_freq, 1)
+        kit = it - it % freq
+        cached = getattr(self, "_dev_cached", None)
+        if cached is not None and cached[0] == kit:
+            return cached[1]
+        k = jax.random.fold_in(key, kit)
+        u = jax.random.uniform(k, (self.num_data,))
+        sel = u < cfg.bagging_fraction
+        # an unlucky draw must not produce an empty bag: the row with
+        # the smallest uniform is the most-likely-kept row — forcing it
+        # distorts the distribution minimally
+        sel = sel.at[jnp.argmin(u)].set(True)
+        sel = sel.astype(jnp.float32)
+        self._dev_cached = (kit, (sel, sel))
+        return sel, sel
+
     def sample(self, it, grad=None, hess=None):
         cfg = self.config
         if not self.need_bagging:
