@@ -6,6 +6,15 @@ Dataset, Booster, train, cv, callbacks, sklearn estimators, plotting.
 ``LIGHTGBM_TPU_PLATFORM=cpu|tpu`` pins the jax backend before first use
 (useful to run CLI/examples on a CPU host or to opt out of a busy
 accelerator); unset, jax picks its default platform.
+
+``LIGHTGBM_TPU_DEBUG_CHECKS=1`` turns on the runtime sanitizers — the
+XLA-world analogue of the reference's ASan/TSan CI builds (SURVEY §5):
+``jax_debug_nans`` (every jitted op re-checked for NaN/Inf production,
+failing loudly at the producing op instead of corrupting training
+downstream) and ``jax_check_tracer_leaks`` (leaked tracers — the jit
+purity violations that stand in for data races in a functional
+runtime — raise instead of silently capturing stale values). Orders of
+magnitude slower; for debugging, like the sanitizers it mirrors.
 """
 import os as _os
 
@@ -14,6 +23,13 @@ if _os.environ.get("LIGHTGBM_TPU_PLATFORM"):
 
     _jax.config.update("jax_platforms",
                        _os.environ["LIGHTGBM_TPU_PLATFORM"])
+
+if _os.environ.get("LIGHTGBM_TPU_DEBUG_CHECKS", "").lower() not in \
+        ("", "0", "false", "off"):
+    import jax as _jax
+
+    _jax.config.update("jax_debug_nans", True)
+    _jax.config.update("jax_check_tracer_leaks", True)
 
 from .basic import Booster, Dataset, LightGBMError
 from .io.sequence import Sequence

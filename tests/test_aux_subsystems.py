@@ -60,3 +60,27 @@ def test_parameter_docs_in_sync():
     committed = open(os.path.join(repo, "docs", "Parameters.md")).read()
     assert committed == fresh, \
         "docs/Parameters.md is stale; rerun docs/gen_parameters.py"
+
+
+def test_debug_checks_env_flag(tmp_path):
+    """LIGHTGBM_TPU_DEBUG_CHECKS turns on the jax sanitizers (SURVEY §5
+    race/sanitizer analogue): NaN production inside jitted code fails
+    loudly instead of corrupting training downstream."""
+    import subprocess
+    import sys
+    code = (
+        "import os\n"
+        "os.environ['LIGHTGBM_TPU_DEBUG_CHECKS'] = '1'\n"
+        "os.environ['LIGHTGBM_TPU_PLATFORM'] = 'cpu'\n"
+        "import lightgbm_tpu  # activates the flags\n"
+        "import jax, jax.numpy as jnp\n"
+        "assert jax.config.jax_debug_nans\n"
+        "assert jax.config.jax_check_tracer_leaks\n"
+        "try:\n"
+        "    jax.jit(lambda x: x / 0.0 * 0.0)(jnp.float32(1.0))\n"
+        "except FloatingPointError:\n"
+        "    print('SANITIZER-OK')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=300)
+    assert "SANITIZER-OK" in out.stdout, (out.stdout, out.stderr)
