@@ -163,7 +163,7 @@ def test_async_randomized_config_sweep():
     noise."""
     rng = np.random.default_rng(123)
     X, y = _data(n=1500, f=8)
-    for trial in range(6):
+    for trial in range(8):
         params = dict(
             objective="binary", verbose=-1,
             num_leaves=int(rng.integers(4, 32)),
@@ -178,6 +178,12 @@ def test_async_randomized_config_sweep():
             tpu_stop_check_interval=int(rng.integers(3, 20)),
             seed=int(rng.integers(0, 1000)),
         )
+        if trial == 6:       # quantized int8 path through async
+            params.update(use_quantized_grad=True,
+                          stochastic_rounding=False,
+                          quant_train_renew_leaf=False)
+        if trial == 7:       # per-node column sampling through async
+            params.update(feature_fraction_bynode=0.7)
         out = {}
         for mode in ("false", "true"):
             b = lgb.train(dict(params, tpu_async_boosting=mode),
