@@ -108,25 +108,41 @@ class GrowerConfig:
     packed_cols: int = 0
 
 
+# The split loop's fixed per-split cost on TPU is the while-body op count
+# (docs/TPU_RUNBOOK.md cost model: each fused kernel dispatch costs ~2 us
+# through the tunnel, and the body runs num_leaves-1 times). Per-leaf
+# scalars therefore live in PACKED matrices — one fused row write per
+# child instead of ~10 separate gather/dynamic-update-slice pairs — and
+# the tree is materialized as TreeArrays only after the loop.
+#
+# stats columns (f32 [L, NS]; ints are exact in f32 below 2^24):
+S_SG, S_SH, S_CNT, S_VAL, S_LMIN, S_LMAX, S_DEPTH, S_PARENT, S_ISR, \
+    S_NROW = range(10)
+NS = 10
+# packed SplitRecord columns (f32 [L, NB]; NB = 13 with categoricals)
+B_GAIN, B_FEAT, B_THR, B_DL, B_LG, B_LH, B_LC, B_LO, B_RG, B_RH, B_RC, \
+    B_RO, B_NCAT = range(13)
+# tree internal-node columns (f32 [L-1, NN]; NN = 10 with categoricals)
+N_FEAT, N_THR, N_DL, N_GAIN, N_IVAL, N_IWT, N_ICNT, N_LC, N_RC, \
+    N_CCNT = range(10)
+
+
 class GrowState(NamedTuple):
     leaf_id: jnp.ndarray        # i32 [R]
     hist: jnp.ndarray           # f32 [L, F, B, 3]
-    # per-leaf stats
-    sum_g: jnp.ndarray          # f32 [L]
-    sum_h: jnp.ndarray          # f32 [L]
-    count: jnp.ndarray          # f32 [L]
-    value: jnp.ndarray          # f32 [L] current leaf output
-    depth: jnp.ndarray          # i32 [L]
-    parent_node: jnp.ndarray    # i32 [L] internal node owning this leaf's slot
-    is_right: jnp.ndarray       # bool [L]
-    best: SplitRecord           # [L] per-leaf best split
-    tree: TreeArrays
+    # packed per-leaf stats: [L, NS] f32 (columns S_* above) — sums,
+    # output, monotone bounds, depth, parent node, is_right, node row
+    stats: jnp.ndarray
+    # packed per-leaf best split: [L, NB] f32 (columns B_* above)
+    best: jnp.ndarray
+    # packed internal-node tree rows: [L-1, NN] f32 (columns N_* above)
+    node: jnp.ndarray
     num_leaves: jnp.ndarray     # i32
     done: jnp.ndarray           # bool
-    # per-leaf output bounds from monotone ancestors (BasicConstraint);
-    # all-(-inf,+inf) when constraints are off
-    leaf_min: jnp.ndarray = None  # f32 [L]
-    leaf_max: jnp.ndarray = None  # f32 [L]
+    # categorical split sets ([L, MAXK] best / [L-1, MAXK] tree), only
+    # when the dataset has categorical features
+    best_cat: jnp.ndarray = None
+    tree_cat: jnp.ndarray = None
     # bool [L, F]: features used on the path from root (interaction
     # constraints); None when constraints are off
     path_mask: jnp.ndarray = None
@@ -135,14 +151,18 @@ class GrowState(NamedTuple):
     # compact row scheduling (row_sched="compact"): rows grouped by leaf
     # (≡ DataPartition::indices_, data_partition.hpp:22)
     order: jnp.ndarray = None       # i32 [R] row ids, leaf-contiguous
-    leaf_start: jnp.ndarray = None  # i32 [L] segment start per leaf
-    leaf_rows: jnp.ndarray = None   # i32 [L] RAW rows per leaf (incl.
-                                    # bagged-out rows riding along)
-    # intermediate monotone mode: per-leaf bin hyper-rectangle + the
-    # feature_mask node row that leaf's best split was scanned with
+    # i32 [L, 2]: (segment start, RAW rows incl. bagged-out riders) per
+    # leaf — kept i32 (row offsets exceed f32's 2^24 exact range)
+    seg: jnp.ndarray = None
+    # intermediate monotone mode: per-leaf bin hyper-rectangle
     leaf_flo: jnp.ndarray = None    # i32 [L, F] inclusive low bin
     leaf_fhi: jnp.ndarray = None    # i32 [L, F] inclusive high bin
-    leaf_node_row: jnp.ndarray = None  # i32 [L]
+    # hist-dtype [L, 3]: per-leaf LOCAL (shard) gh sums — tracked only
+    # when the histogram pool is LOCAL (voting learner), where the
+    # global sums in the split records cannot stand in for shard totals
+    # (the vote ranks by LOCAL gain; multival/EFB default-bin
+    # reconstruction of a LOCAL hist needs LOCAL totals)
+    lsum: jnp.ndarray = None
 
 
 def _set(arr, idx, val, cond):
@@ -197,7 +217,8 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                      bundle=None,
                      reduce_max: Optional[Callable] = None,
                      localize_key: Optional[Callable] = None,
-                     prepare_is_pure: bool = False):
+                     prepare_is_pure: bool = False,
+                     local_pool: bool = False):
     """Build the tree-growing function for a fixed dataset geometry.
 
     Returns ``grow(bins_t, gh, feature_mask, cegb) -> (TreeArrays, leaf_id)``
@@ -283,9 +304,44 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     hist_dtype = jnp.int32 if quantized else jnp.float32
     has_cat = meta_has_categorical(meta)
     MAXK = min(hp.max_cat_threshold, B) if has_cat else 0
+    NB = 13 if has_cat else 12
+    NN = 10 if has_cat else 9
+
+    def pack_rec(rec: SplitRecord) -> jnp.ndarray:
+        """SplitRecord (any leading shape) -> packed f32 [..., NB].
+
+        Bin thresholds, feature ids and cat counts are < 2^24, exact in
+        f32; counts are f32 already (histogram count channel)."""
+        vals = [rec.gain, rec.feature, rec.threshold, rec.default_left,
+                rec.left_sum_gradient, rec.left_sum_hessian,
+                rec.left_count, rec.left_output, rec.right_sum_gradient,
+                rec.right_sum_hessian, rec.right_count, rec.right_output]
+        if has_cat:
+            vals.append(rec.num_cat)
+        return jnp.stack([jnp.asarray(v).astype(jnp.float32) for v in vals],
+                         axis=-1)
+
+    def unpack_rec(v: jnp.ndarray, cat_bins=None) -> SplitRecord:
+        """Packed f32 [..., NB] -> SplitRecord (integer fields restored)."""
+        i32 = lambda x: x.astype(jnp.int32)
+        return SplitRecord(
+            gain=v[..., B_GAIN], feature=i32(v[..., B_FEAT]),
+            threshold=i32(v[..., B_THR]), default_left=v[..., B_DL] > 0.5,
+            left_sum_gradient=v[..., B_LG], left_sum_hessian=v[..., B_LH],
+            left_count=v[..., B_LC], left_output=v[..., B_LO],
+            right_sum_gradient=v[..., B_RG], right_sum_hessian=v[..., B_RH],
+            right_count=v[..., B_RC], right_output=v[..., B_RO],
+            num_cat=i32(v[..., B_NCAT]) if has_cat else None,
+            cat_bins=cat_bins)
     pool_none = cfg.hist_pool == "none"
     if pool_none and not compact:
         raise ValueError("hist_pool='none' requires row_sched='compact'")
+    if local_pool and mv_mode and not compact:
+        # full-mode multival histograms omit default-bin mass, so leaf
+        # totals cannot be read off feature 0's bins (the full-mode
+        # local-sums shortcut); the compact path carries raw gh totals
+        raise ValueError("tree_learner=voting with multi-value sparse "
+                         "storage requires row_sched='compact'")
     if pool_none and forced is not None:
         raise ValueError("forced splits need the histogram pool; use "
                          "hist_pool='full'")
@@ -297,13 +353,16 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     bundled = bundle is not None
     if bundled:
         # EFB composes with data-parallel (group hists psum across row
-        # shards; the scan-time expansion is replicated). Voting ranks
-        # per-LOGICAL-feature gains on the local hist and feature-
-        # parallel shards logical columns — both incompatible with the
+        # shards; the scan-time expansion is replicated) and — via the
+        # local-sums channel (local_pool) — with voting: expansion uses
+        # LOCAL leaf totals, so the vote ranks correct local logical
+        # hists and psums only the selected features. Feature-parallel
+        # shards logical columns, still incompatible with the
         # physical-group layout.
-        if has_scan_hooks or feat_sharded:
+        if (has_scan_hooks and not local_pool) or feat_sharded:
             raise ValueError("EFB bundling does not compose with the "
-                             "voting/feature learners")
+                             "feature learner (voting needs the "
+                             "local-sums channel: local_pool=True)")
         b_gmap = jnp.asarray(bundle["gather_map"], jnp.int32)     # [F, B]
         b_group = jnp.asarray(bundle["group"], jnp.int32)         # [F]
         b_offset = jnp.asarray(bundle["offset"], jnp.int32)       # [F]
@@ -390,9 +449,13 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
 
     def best_of(hist, sg, sh, cnt, parent_out, feature_mask,
                 leaf_range=None, leaf_depth=None, cegb=None,
-                rand_u=None):
-        hist, extra_mask = prepare_split_hist(
-            hist, (sg, sh, cnt, parent_out), feature_mask)
+                rand_u=None, lsum3=None):
+        ctx = (sg, sh, cnt, parent_out)
+        if lsum3 is not None:
+            # local-sums channel (voting): ctx grows to 7 entries —
+            # (global sg/sh/cnt/out, LOCAL sg/sh/cnt)
+            ctx = ctx + (lsum3[0], lsum3[1], lsum3[2])
+        hist, extra_mask = prepare_split_hist(hist, ctx, feature_mask)
         if extra_mask is not None:
             feature_mask = (extra_mask if feature_mask is None
                             else feature_mask & extra_mask)
@@ -551,7 +614,10 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                     """O(rows_in_leaf) histogram over the gathered segment
                     (≡ indexed Bin::ConstructHistogram, dense_bin.hpp;
                     multival: O(rows_in_leaf * K) over stored nonzeros,
-                    ≡ multi_val_sparse_bin.hpp ConstructHistogram)."""
+                    ≡ multi_val_sparse_bin.hpp ConstructHistogram).
+                    With the local-sums channel the segment's raw gh
+                    totals ride along (multival hists lack the
+                    default-bin mass, so totals can't come from them)."""
                     start_c = jnp.clip(start, 0, max(R - S, 0))
                     delta = start - start_c
                     idx = lax.dynamic_slice(order, (start_c,), (S,))
@@ -568,7 +634,11 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                     pos = jnp.arange(S, dtype=jnp.int32)
                     w = ((pos >= delta) &
                          (pos < delta + rows)).astype(ghg.dtype)
-                    return hist_rm(blk, ghg * w[:, None])
+                    ghw = ghg * w[:, None]
+                    h = hist_rm(blk, ghw)
+                    if local_pool:
+                        return h, jnp.sum(ghw.astype(hist_dtype), axis=0)
+                    return h
                 return hb
 
             part_branches = [make_part(P) for P in sizes]
@@ -602,9 +672,11 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
 
         # ---- root (ref: LeafSplits::Init + first FindBestSplits) ----
         if quantized:
-            sums = conv(reduce_sums(gh.sum(axis=0, dtype=jnp.int32)))
+            local_root = gh.sum(axis=0, dtype=jnp.int32)
+            sums = conv(reduce_sums(local_root))
         else:
-            sums = reduce_sums(gh.sum(axis=0))        # [3]
+            local_root = gh.sum(axis=0)               # [3] LOCAL
+            sums = reduce_sums(local_root)            # [3] global
         root_g, root_h, root_c = sums[0], sums[1], sums[2]
         root_out = calculate_splitted_leaf_output(
             root_g, root_h + 2 * K_EPSILON, hp, root_c, jnp.float32(0.0))
@@ -619,8 +691,17 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         inf = jnp.float32(jnp.inf)
         root_path = jnp.zeros(F, bool)
         hist_root_l = conv(hist_root)
+        root_lsum = conv(local_root.astype(hist_dtype)) if local_pool \
+            else None
         if bundled:
-            hist_root_l = expand_hist(hist_root_l, root_g, root_h, root_c)
+            # a LOCAL pool expands with LOCAL totals (the default-bin
+            # mass of this shard's rows), global pools with global
+            if local_pool:
+                hist_root_l = expand_hist(hist_root_l, root_lsum[0],
+                                          root_lsum[1], root_lsum[2])
+            else:
+                hist_root_l = expand_hist(hist_root_l, root_g, root_h,
+                                          root_c)
         if use_rand:
             et_key = jax.random.fold_in(
                 rng_key if rng_key is not None else jax.random.PRNGKey(0),
@@ -632,53 +713,55 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                             root_out, node_mask(0, root_path),
                             leaf_range=(-inf, inf),
                             leaf_depth=jnp.int32(0), cegb=cegb,
-                            rand_u=root_rand)
+                            rand_u=root_rand, lsum3=root_lsum)
 
         hist_pool = (None if pool_none else
                      jnp.zeros((L, Fp, B, 3), hist_dtype).at[0].set(
                          hist_root))
-        zf = jnp.zeros(L, jnp.float32)
-        zi = jnp.zeros(L, jnp.int32)
-        best0 = SplitRecord.invalid((L,), max_cat=MAXK)
-        best0 = jax.tree.map(lambda a, b: a.at[0].set(b), best0, best_root)
+        stats0 = jnp.zeros((L, NS), jnp.float32)
+        stats0 = stats0.at[:, S_LMIN].set(-jnp.inf)
+        stats0 = stats0.at[:, S_LMAX].set(jnp.inf)
+        stats0 = stats0.at[:, S_PARENT].set(-1.0)
+        stats0 = stats0.at[0].set(jnp.stack([
+            root_g, root_h, root_c, root_out, -inf, inf,
+            jnp.float32(0.0), jnp.float32(-1.0), jnp.float32(0.0),
+            jnp.float32(0.0)]))
+        inv_row = pack_rec(SplitRecord.invalid((), max_cat=MAXK))
+        best0 = jnp.broadcast_to(inv_row, (L, NB)).at[0].set(
+            pack_rec(best_root))
 
         state = GrowState(
             leaf_id=leaf_id0,
             hist=hist_pool,
-            sum_g=zf.at[0].set(root_g),
-            sum_h=zf.at[0].set(root_h),
-            count=zf.at[0].set(root_c),
-            value=zf.at[0].set(root_out),
-            depth=zi,
-            parent_node=jnp.full(L, -1, jnp.int32),
-            is_right=jnp.zeros(L, bool),
+            stats=stats0,
             best=best0,
-            tree=TreeArrays.empty(L, max_cat=MAXK),
+            node=jnp.zeros((L - 1, NN), jnp.float32),
             num_leaves=jnp.asarray(1, jnp.int32),
             done=jnp.asarray(False),
-            leaf_min=jnp.full(L, -jnp.inf, jnp.float32),
-            leaf_max=jnp.full(L, jnp.inf, jnp.float32),
+            best_cat=(jnp.full((L, MAXK), -1, jnp.int32).at[0].set(
+                best_root.cat_bins) if has_cat else None),
+            tree_cat=(jnp.full((L - 1, MAXK), -1, jnp.int32)
+                      if has_cat else None),
             path_mask=jnp.zeros((L, F), bool) if use_ic else None,
             forced_ok=jnp.asarray(True),
             order=jnp.arange(R, dtype=jnp.int32) if compact else None,
-            leaf_start=jnp.zeros(L, jnp.int32) if compact else None,
-            leaf_rows=(jnp.zeros(L, jnp.int32).at[0].set(R)
-                       if compact else None),
+            seg=(jnp.zeros((L, 2), jnp.int32).at[0, 1].set(R)
+                 if compact else None),
+            lsum=(jnp.zeros((L, 3), hist_dtype).at[0].set(
+                local_root.astype(hist_dtype)) if local_pool else None),
             leaf_flo=(jnp.zeros((L, F), jnp.int32) if use_mc_inter
                       else None),
             leaf_fhi=(jnp.broadcast_to(
                 meta.num_bin.astype(jnp.int32)[None, :] - 1,
                 (L, F)).copy() if use_mc_inter else None),
-            leaf_node_row=(jnp.zeros(L, jnp.int32) if use_mc_inter
-                           else None),
         )
 
         def body(i, state: GrowState) -> GrowState:
             # ---- pick best leaf (ref: serial_tree_learner.cpp:229 ArgMax) --
             exists = jnp.arange(L) < state.num_leaves
             if cfg.max_depth > 0:
-                exists &= state.depth < cfg.max_depth
-            cand = jnp.where(exists, state.best.gain, K_MIN_SCORE)
+                exists &= state.stats[:, S_DEPTH] < cfg.max_depth
+            cand = jnp.where(exists, state.best[:, B_GAIN], K_MIN_SCORE)
             l = jnp.argmax(cand).astype(jnp.int32)
             gain = cand[l]
             forced_ok = state.forced_ok
@@ -691,75 +774,72 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 # (ref: serial_tree_learner.cpp ForceSplits + abort path)
                 want_forced = forced_active[i] & state.forced_ok
                 slot_i = forced_slot[i]
+                fs = state.stats[slot_i]
                 fhist = conv(state.hist[slot_i])
                 if bundled:
-                    fhist = expand_hist(fhist, state.sum_g[slot_i],
-                                        state.sum_h[slot_i],
-                                        state.count[slot_i])
+                    fhist = expand_hist(fhist, fs[S_SG], fs[S_SH],
+                                        fs[S_CNT])
                 frec = forced_split_record(
                     fhist, forced_feat[i], forced_thr[i],
-                    state.sum_g[slot_i], state.sum_h[slot_i],
-                    state.count[slot_i], state.value[slot_i], meta, hp)
+                    fs[S_SG], fs[S_SH], fs[S_CNT], fs[S_VAL], meta, hp)
                 if has_cat:  # forced splits are numerical-only
                     frec = frec._replace(
                         num_cat=jnp.int32(0),
                         cat_bins=jnp.full((MAXK,), -1, jnp.int32))
                 f_valid = frec.gain > 0.0
                 if cfg.max_depth > 0:  # forced prefix honors max_depth too
-                    f_valid &= state.depth[slot_i] < cfg.max_depth
+                    f_valid &= fs[S_DEPTH] < cfg.max_depth
                 apply_forced = want_forced & f_valid
                 forced_ok = state.forced_ok & (~want_forced | f_valid)
                 l = jnp.where(apply_forced, slot_i, l)
                 gain = jnp.where(apply_forced, frec.gain, gain)
-                rec = jax.tree.map(
-                    lambda fa, a: jnp.where(apply_forced, fa, a[l]),
-                    frec, state.best)
-            else:
-                rec = jax.tree.map(lambda a: a[l], state.best)
+            # ONE row gather each for the chosen leaf's stats/best — the
+            # packed-matrix layout makes every per-leaf scalar read a
+            # column of these rows instead of its own gather kernel
+            srow = state.stats[l]
+            brow = state.best[l]
+            bcat = state.best_cat[l] if has_cat else None
+            if forced is not None:
+                brow = jnp.where(apply_forced, pack_rec(frec), brow)
+                if has_cat:
+                    bcat = jnp.where(apply_forced, frec.cat_bins, bcat)
+            rec = unpack_rec(brow, bcat)
 
             proceed = jnp.logical_and(~state.done, gain > 0.0)
             done = ~proceed
-            new_leaf = i + 1  # static thanks to latched done
-
-            t = state.tree
+            new_leaf = i + 1  # deterministic thanks to latched done
+            i_f = i.astype(jnp.float32)
 
             # ---- record split into tree arrays (ref: tree.cpp Tree::Split) --
-            t = t._replace(
-                split_feature=_set(t.split_feature, i, rec.feature, proceed),
-                threshold_bin=_set(t.threshold_bin, i, rec.threshold, proceed),
-                default_left=_set(t.default_left, i, rec.default_left, proceed),
-                split_gain=_set(t.split_gain, i, rec.gain, proceed),
-                internal_value=_set(t.internal_value, i, state.value[l], proceed),
-                internal_weight=_set(t.internal_weight, i, state.sum_h[l], proceed),
-                internal_count=_set(t.internal_count, i, state.count[l], proceed),
-                left_child=_set(t.left_child, i, -(l + 1), proceed),
-                right_child=_set(t.right_child, i, -(new_leaf + 1), proceed),
-            )
-            if has_cat:
-                t = t._replace(
-                    cat_count=_set(t.cat_count, i, rec.num_cat, proceed),
-                    cat_bins=t.cat_bins.at[i].set(
-                        jnp.where(proceed, rec.cat_bins, t.cat_bins[i])))
+            # one fused row write; leaf arrays are derived from stats
+            # after the loop (leaf_value ≡ the child output stats hold)
+            noderow = jnp.stack(
+                [brow[B_FEAT], brow[B_THR], brow[B_DL], brow[B_GAIN],
+                 srow[S_VAL], srow[S_SH], srow[S_CNT],
+                 -(l.astype(jnp.float32) + 1.0),
+                 -(new_leaf.astype(jnp.float32) + 1.0)]
+                + ([brow[B_NCAT]] if has_cat else []))
+            node = state.node.at[i].set(
+                jnp.where(proceed, noderow, state.node[i]))
             # fix-up the parent's child pointer that pointed at leaf l
-            p = state.parent_node[l]
+            # (parent row p < i, so it is never the row just written)
+            p = srow[S_PARENT].astype(jnp.int32)
             p_safe = jnp.maximum(p, 0)
             has_parent = proceed & (p >= 0)
-            t = t._replace(
-                left_child=_set(t.left_child, p_safe, i,
-                                has_parent & ~state.is_right[l]),
-                right_child=_set(t.right_child, p_safe, i,
-                                 has_parent & state.is_right[l]),
-                leaf_value=_set(_set(t.leaf_value, l, rec.left_output, proceed),
-                                new_leaf, rec.right_output, proceed),
-                leaf_weight=_set(_set(t.leaf_weight, l, rec.left_sum_hessian,
-                                      proceed),
-                                 new_leaf, rec.right_sum_hessian, proceed),
-                leaf_count=_set(_set(t.leaf_count, l, rec.left_count, proceed),
-                                new_leaf, rec.right_count, proceed),
-                leaf_parent=_set(_set(t.leaf_parent, l, i, proceed),
-                                 new_leaf, i, proceed),
-                num_leaves=jnp.where(proceed, new_leaf + 1, t.num_leaves),
-            )
+            isr = srow[S_ISR] > 0.5
+            pr = lax.dynamic_slice(node, (p_safe, jnp.int32(N_LC)),
+                                   (1, 2))[0]
+            pr_new = jnp.where(isr, jnp.stack([pr[0], i_f]),
+                               jnp.stack([i_f, pr[1]]))
+            pr_new = jnp.where(has_parent, pr_new, pr)
+            node = lax.dynamic_update_slice(node, pr_new[None, :],
+                                            (p_safe, jnp.int32(N_LC)))
+            if has_cat:
+                tree_cat = state.tree_cat.at[i].set(
+                    jnp.where(proceed, rec.cat_bins, state.tree_cat[i]))
+            else:
+                tree_cat = None
+            nl_new = jnp.where(proceed, new_leaf + 1, state.num_leaves)
 
             # ---- partition rows (ref: dense_bin.hpp:317 SplitInner) --------
             if compact:
@@ -782,22 +862,9 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 leaf_id = jnp.where(proceed & in_leaf & ~go_left,
                                     new_leaf, state.leaf_id)
 
-            # ---- children stats --------------------------------------------
-            sum_g = _set(_set(state.sum_g, l, rec.left_sum_gradient, proceed),
-                         new_leaf, rec.right_sum_gradient, proceed)
-            sum_h = _set(_set(state.sum_h, l, rec.left_sum_hessian, proceed),
-                         new_leaf, rec.right_sum_hessian, proceed)
-            count = _set(_set(state.count, l, rec.left_count, proceed),
-                         new_leaf, rec.right_count, proceed)
-            value = _set(_set(state.value, l, rec.left_output, proceed),
-                         new_leaf, rec.right_output, proceed)
-            child_depth = state.depth[l] + 1
-            depth = _set(_set(state.depth, l, child_depth, proceed),
-                         new_leaf, child_depth, proceed)
-            parent_node = _set(_set(state.parent_node, l, i, proceed),
-                               new_leaf, i, proceed)
-            is_right = _set(_set(state.is_right, l, False, proceed),
-                            new_leaf, True, proceed)
+            # ---- children stats: assembled into two packed rows and
+            # written once the monotone bounds below are known
+            child_depth = srow[S_DEPTH] + 1.0
 
             # ---- children histograms: smaller pass + subtraction -----------
             # (ref: serial_tree_learner.cpp:368-386 + FeatureHistogram::Subtract)
@@ -807,8 +874,9 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 # one O(rows_in_smaller) pass + sibling subtraction; pool
                 # "none" gathers BOTH children (O(rows_in_parent) work,
                 # O(F*B) memory).
-                start_l = state.leaf_start[l]
-                rows_l = state.leaf_rows[l]
+                segrow = state.seg[l]
+                start_l = segrow[0]
+                rows_l = segrow[1]
 
                 if feat_sharded:
                     # owner-column broadcast OUTSIDE the (uniform) branch
@@ -837,13 +905,29 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                                         order2, start_l, nL, gh)
                         hr = lax.switch(bucket_branch(nR), hist_branches,
                                         order2, start_l + nL, nR, gh)
+                        if local_pool:
+                            return (order2, nL, hl[0], hr[0], hl[1],
+                                    hr[1])
                         return order2, nL, hl, hr
 
-                    order, nL_raw, hist_left_c, hist_right_c = lax.cond(
-                        proceed, do_part_hist2,
-                        lambda: (state.order, jnp.int32(0),
-                                 jnp.zeros((Fp, B, 3), hist_dtype),
-                                 jnp.zeros((Fp, B, 3), hist_dtype)))
+                    if local_pool:
+                        (order, nL_raw, hist_left_c, hist_right_c,
+                         lsum_l_c, lsum_r_c) = lax.cond(
+                            proceed, do_part_hist2,
+                            lambda: (state.order, jnp.int32(0),
+                                     jnp.zeros((Fp, B, 3), hist_dtype),
+                                     jnp.zeros((Fp, B, 3), hist_dtype),
+                                     jnp.zeros((3,), hist_dtype),
+                                     jnp.zeros((3,), hist_dtype)))
+                    else:
+                        order, nL_raw, hist_left_c, hist_right_c = \
+                            lax.cond(
+                                proceed, do_part_hist2,
+                                lambda: (state.order, jnp.int32(0),
+                                         jnp.zeros((Fp, B, 3),
+                                                   hist_dtype),
+                                         jnp.zeros((Fp, B, 3),
+                                                   hist_dtype)))
                     if distributed:
                         # collectives live OUTSIDE the (uniform) branch
                         lctx = (rec.left_sum_gradient, rec.left_sum_hessian,
@@ -871,15 +955,28 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                         s_start = start_l + jnp.where(lsm, 0, nL)
                         s_rows = jnp.where(lsm, nL, nR)
                         sb = bucket_branch(s_rows)
-                        h = lax.switch(sb, hist_branches, order2, s_start,
-                                       s_rows, gh)
-                        return order2, nL, lsm, h
+                        hs = lax.switch(sb, hist_branches, order2,
+                                        s_start, s_rows, gh)
+                        if local_pool:
+                            return (order2, nL, lsm) + hs
+                        return order2, nL, lsm, hs
 
-                    order, nL_raw, left_smaller, hist_small = lax.cond(
-                        proceed, do_part_hist,
-                        lambda: (state.order, jnp.int32(0),
-                                 jnp.asarray(True),
-                                 jnp.zeros((Fp, B, 3), hist_dtype)))
+                    if local_pool:
+                        (order, nL_raw, left_smaller, hist_small,
+                         small_lsum) = lax.cond(
+                            proceed, do_part_hist,
+                            lambda: (state.order, jnp.int32(0),
+                                     jnp.asarray(True),
+                                     jnp.zeros((Fp, B, 3), hist_dtype),
+                                     jnp.zeros((3,), hist_dtype)))
+                    else:
+                        order, nL_raw, left_smaller, hist_small = \
+                            lax.cond(
+                                proceed, do_part_hist,
+                                lambda: (state.order, jnp.int32(0),
+                                         jnp.asarray(True),
+                                         jnp.zeros((Fp, B, 3),
+                                                   hist_dtype)))
                     if distributed:
                         pick = lambda a, b: jnp.where(left_smaller, a, b)
                         small_ctx = (pick(rec.left_sum_gradient,
@@ -890,14 +987,15 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                                      pick(rec.left_output,
                                           rec.right_output))
                         hist_small = reduce_hist(hist_small, small_ctx)
-                leaf_start = _set(state.leaf_start, new_leaf,
-                                  start_l + nL_raw, proceed)
-                leaf_rows = _set(_set(state.leaf_rows, l, nL_raw, proceed),
-                                 new_leaf, rows_l - nL_raw, proceed)
+                seg = state.seg.at[l].set(jnp.where(
+                    proceed, jnp.stack([start_l, nL_raw]), segrow))
+                seg = seg.at[new_leaf].set(jnp.where(
+                    proceed,
+                    jnp.stack([start_l + nL_raw, rows_l - nL_raw]),
+                    seg[new_leaf]))
             else:
                 order = state.order
-                leaf_start = state.leaf_start
-                leaf_rows = state.leaf_rows
+                seg = state.seg
                 left_smaller = rec.left_count <= rec.right_count
                 small_leaf = jnp.where(left_smaller, l, new_leaf)
                 pick = lambda a, b: jnp.where(left_smaller, a, b)
@@ -918,6 +1016,10 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                         lambda: leaf_hist(bins_t, gh, leaf_id, small_leaf,
                                           small_ctx),
                         lambda: jnp.zeros((Fp, B, 3), hist_dtype))
+                if local_pool:
+                    # full mode is dense-only: any feature's bin sums are
+                    # the segment's raw gh totals
+                    small_lsum = hist_small[0].sum(axis=0)
             if pool_none:
                 hist_left, hist_right = hist_left_c, hist_right_c
                 hist = None
@@ -931,13 +1033,33 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 hist = hist.at[new_leaf].set(
                     jnp.where(proceed, hist_right, hist[new_leaf]))
 
+            # ---- local-sums channel (voting): children's LOCAL totals --
+            if local_pool:
+                if pool_none:
+                    lsum_lrow, lsum_rrow = lsum_l_c, lsum_r_c
+                else:
+                    lsum_parent = state.lsum[l]
+                    lsum_large = lsum_parent - small_lsum
+                    lsum_lrow = jnp.where(left_smaller, small_lsum,
+                                          lsum_large)
+                    lsum_rrow = jnp.where(left_smaller, lsum_large,
+                                          small_lsum)
+                lsum = state.lsum.at[l].set(
+                    jnp.where(proceed, lsum_lrow, state.lsum[l]))
+                lsum = lsum.at[new_leaf].set(
+                    jnp.where(proceed, lsum_rrow, lsum[new_leaf]))
+                lsums2 = conv(jnp.stack([lsum_lrow, lsum_rrow]))
+            else:
+                lsum = state.lsum
+                lsums2 = None
+
             # ---- monotone constraint propagation ---------------------------
             # (ref: monotone_constraints.hpp:488-504 BasicLeafConstraints::
             # Update — mid-point bound tightening on the split children;
             # :546 IntermediateLeafConstraints::UpdateConstraintsWithOutputs
             # — sibling-output bounds, looser on the children, with other
             # contiguous leaves tightened below)
-            p_min, p_max = state.leaf_min[l], state.leaf_max[l]
+            p_min, p_max = srow[S_LMIN], srow[S_LMAX]
             if use_mc:
                 mono_f = jnp.where(rec.feature >= 0,
                                    pmeta.monotone[jnp.maximum(rec.feature, 0)],
@@ -991,7 +1113,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                     # j ABOVE bounds the child's max when increasing
                     ub_on_c = linked_a & jnp.where(inc_a, j_above, j_below)
                     lb_on_c = linked_a & jnp.where(inc_a, j_below, j_above)
-                    jout = state.value[:, None]
+                    jout = state.stats[:, S_VAL][:, None]
                     geo_max = jnp.min(
                         jnp.where(ub_on_c, jout, jnp.inf), axis=0)  # [2]
                     geo_min = jnp.max(
@@ -1017,10 +1139,19 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             else:
                 l_min = r_min = p_min
                 l_max = r_max = p_max
-            leaf_min = _set(_set(state.leaf_min, l, l_min, proceed),
-                            new_leaf, r_min, proceed)
-            leaf_max = _set(_set(state.leaf_max, l, l_max, proceed),
-                            new_leaf, r_max, proceed)
+
+            # ---- write the two children's packed stats rows ---------------
+            lrow = jnp.stack([rec.left_sum_gradient, rec.left_sum_hessian,
+                              rec.left_count, rec.left_output, l_min,
+                              l_max, child_depth, i_f, jnp.float32(0.0),
+                              2.0 * i_f + 1.0])
+            rrow = jnp.stack([rec.right_sum_gradient,
+                              rec.right_sum_hessian, rec.right_count,
+                              rec.right_output, r_min, r_max, child_depth,
+                              i_f, jnp.float32(1.0), 2.0 * i_f + 2.0])
+            stats = state.stats.at[l].set(jnp.where(proceed, lrow, srow))
+            stats = stats.at[new_leaf].set(
+                jnp.where(proceed, rrow, stats[new_leaf]))
 
             # ---- interaction path bookkeeping ------------------------------
             if use_ic:
@@ -1046,11 +1177,19 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             cn2 = jnp.stack([rec.left_count, rec.right_count])
             hists2 = conv(jnp.stack([hist_left, hist_right]))
             if bundled:
-                hists2 = jax.vmap(expand_hist)(hists2, sg2, sh2, cn2)
+                if local_pool:
+                    # LOCAL pool: default-bin mass reconstructed from
+                    # the shard's own totals (local-sums channel)
+                    hists2 = jax.vmap(expand_hist)(
+                        hists2, lsums2[:, 0], lsums2[:, 1],
+                        lsums2[:, 2])
+                else:
+                    hists2 = jax.vmap(expand_hist)(hists2, sg2, sh2,
+                                                   cn2)
             ou2 = jnp.stack([rec.left_output, rec.right_output])
             mn2 = jnp.stack([l_min, r_min])
             mx2 = jnp.stack([l_max, r_max])
-            dp2 = jnp.stack([child_depth, child_depth])
+            dp2 = jnp.stack([child_depth, child_depth]).astype(jnp.int32)
             if use_rand:
                 ki = jax.random.fold_in(et_key, i)
                 rb2 = jnp.stack([
@@ -1060,21 +1199,33 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 rb2 = None
             if fm_l is None:
                 best2 = jax.vmap(
-                    lambda hh, a, b, c, d, mn, mx, dp, rb: best_of(
+                    lambda hh, a, b, c, d, mn, mx, dp, rb, ls: best_of(
                         hh, a, b, c, d, None, leaf_range=(mn, mx),
-                        leaf_depth=dp, cegb=cegb, rand_u=rb)
-                )(hists2, sg2, sh2, cn2, ou2, mn2, mx2, dp2, rb2)
+                        leaf_depth=dp, cegb=cegb, rand_u=rb, lsum3=ls)
+                )(hists2, sg2, sh2, cn2, ou2, mn2, mx2, dp2, rb2,
+                  lsums2)
             else:
                 fm2 = jnp.stack([fm_l, fm_r])
                 best2 = jax.vmap(
-                    lambda hh, a, b, c, d, mn, mx, dp, fm, rb: best_of(
+                    lambda hh, a, b, c, d, mn, mx, dp, fm, rb, ls:
+                    best_of(
                         hh, a, b, c, d, fm, leaf_range=(mn, mx),
-                        leaf_depth=dp, cegb=cegb, rand_u=rb)
-                )(hists2, sg2, sh2, cn2, ou2, mn2, mx2, dp2, fm2, rb2)
-            best = jax.tree.map(
-                lambda cur, nb: _set(_set(cur, l, nb[0], proceed),
-                                     new_leaf, nb[1], proceed),
-                state.best, best2)
+                        leaf_depth=dp, cegb=cegb, rand_u=rb, lsum3=ls)
+                )(hists2, sg2, sh2, cn2, ou2, mn2, mx2, dp2, fm2, rb2,
+                  lsums2)
+            rows2 = pack_rec(best2)                              # [2, NB]
+            best = state.best.at[l].set(
+                jnp.where(proceed, rows2[0], brow))
+            best = best.at[new_leaf].set(
+                jnp.where(proceed, rows2[1], best[new_leaf]))
+            if has_cat:
+                best_cat = state.best_cat.at[l].set(
+                    jnp.where(proceed, best2.cat_bins[0], bcat))
+                best_cat = best_cat.at[new_leaf].set(
+                    jnp.where(proceed, best2.cat_bins[1],
+                              best_cat[new_leaf]))
+            else:
+                best_cat = None
 
             # ---- intermediate mode: tighten contiguous leaves --------------
             # (ref: monotone_constraints.hpp:625 GoUpToFindLeavesToUpdate /
@@ -1098,14 +1249,13 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 leaf_flo = _set(state.leaf_flo, new_leaf, right_flo, proceed)
                 leaf_fhi = _set(_set(state.leaf_fhi, l, left_fhi, proceed),
                                 new_leaf, fhi_p, proceed)
-                leaf_node_row = _set(
-                    _set(state.leaf_node_row, l, 2 * i + 1, proceed),
-                    new_leaf, 2 * i + 2, proceed)
+                leaf_min = stats[:, S_LMIN]
+                leaf_max = stats[:, S_LMAX]
 
                 lar = jnp.arange(L)
-                updatable = ((lar < t.num_leaves) & (lar != l) &
+                updatable = ((lar < nl_new) & (lar != l) &
                              (lar != new_leaf) &
-                             (best.gain > K_MIN_SCORE))
+                             (best[:, B_GAIN] > K_MIN_SCORE))
                 # A constraint links leaf j to child c iff exactly ONE
                 # feature separates their boxes and that feature is
                 # monotone (points can then move between the regions by
@@ -1149,13 +1299,16 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 nmin = jnp.where(okj, jnp.maximum(leaf_min, cand_min),
                                  leaf_min)
                 changed = (nmax < leaf_max) | (nmin > leaf_min)
-                leaf_min, leaf_max = nmin, nmax
+                stats = stats.at[:, S_LMIN].set(nmin)
+                stats = stats.at[:, S_LMAX].set(nmax)
 
-                def _rescan(best_in):
+                def _rescan(args):
+                    best_in, bcat_in = args
                     hp_all = conv(hist)
                     if bundled:
-                        hp_all = jax.vmap(expand_hist)(hp_all, sum_g,
-                                                       sum_h, count)
+                        hp_all = jax.vmap(expand_hist)(
+                            hp_all, stats[:, S_SG], stats[:, S_SH],
+                            stats[:, S_CNT])
 
                     def one(hh, sg_, sh_, cn_, out_, mn_, mx_, dp_, nrow,
                             pj):
@@ -1172,46 +1325,73 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                     pj_arg = (path_mask if use_ic
                               else jnp.zeros((L, 1), bool))
                     new_recs = jax.vmap(one)(
-                        hp_all, sum_g, sum_h, count, value, leaf_min,
-                        leaf_max, depth, leaf_node_row, pj_arg)
-                    return jax.tree.map(
-                        lambda cur, nb: jnp.where(
-                            changed.reshape(
-                                changed.shape + (1,) * (cur.ndim - 1)),
-                            nb, cur), best_in, new_recs)
+                        hp_all, stats[:, S_SG], stats[:, S_SH],
+                        stats[:, S_CNT], stats[:, S_VAL], nmin, nmax,
+                        stats[:, S_DEPTH].astype(jnp.int32),
+                        stats[:, S_NROW].astype(jnp.int32), pj_arg)
+                    bo = jnp.where(changed[:, None], pack_rec(new_recs),
+                                   best_in)
+                    bc = (jnp.where(changed[:, None], new_recs.cat_bins,
+                                    bcat_in) if has_cat else bcat_in)
+                    return bo, bc
 
-                best = lax.cond(jnp.any(changed), _rescan,
-                                lambda b: b, best)
+                best, best_cat = lax.cond(jnp.any(changed), _rescan,
+                                          lambda a: a, (best, best_cat))
             else:
                 leaf_flo = state.leaf_flo
                 leaf_fhi = state.leaf_fhi
-                leaf_node_row = state.leaf_node_row
 
             return GrowState(
-                leaf_id=leaf_id, hist=hist, sum_g=sum_g, sum_h=sum_h,
-                count=count, value=value, depth=depth,
-                parent_node=parent_node, is_right=is_right, best=best,
-                tree=t, num_leaves=t.num_leaves, done=done | state.done,
-                leaf_min=leaf_min, leaf_max=leaf_max, path_mask=path_mask,
-                forced_ok=forced_ok, order=order, leaf_start=leaf_start,
-                leaf_rows=leaf_rows, leaf_flo=leaf_flo, leaf_fhi=leaf_fhi,
-                leaf_node_row=leaf_node_row)
+                leaf_id=leaf_id, hist=hist, stats=stats, best=best,
+                node=node, num_leaves=nl_new, done=done | state.done,
+                best_cat=best_cat, tree_cat=tree_cat,
+                path_mask=path_mask, forced_ok=forced_ok, order=order,
+                seg=seg, leaf_flo=leaf_flo, leaf_fhi=leaf_fhi,
+                lsum=lsum)
 
         state = lax.fori_loop(0, L - 1, body, state)
+
+        # ---- materialize TreeArrays from the packed loop state ----------
+        nodem = state.node
+        statm = state.stats
+        i32c = lambda c: nodem[:, c].astype(jnp.int32)
+        # leaf arrays: every existing leaf's (value, weight, count) are the
+        # stats its creating split wrote; a never-split tree keeps the
+        # empty() zeros (the reference also emits a zero leaf then)
+        grew = state.num_leaves > 1
+        tree = TreeArrays(
+            split_feature=i32c(N_FEAT),
+            threshold_bin=i32c(N_THR),
+            default_left=nodem[:, N_DL] > 0.5,
+            left_child=i32c(N_LC),
+            right_child=i32c(N_RC),
+            split_gain=nodem[:, N_GAIN],
+            internal_value=nodem[:, N_IVAL],
+            internal_weight=nodem[:, N_IWT],
+            internal_count=nodem[:, N_ICNT],
+            leaf_value=jnp.where(grew, statm[:, S_VAL], 0.0),
+            leaf_weight=jnp.where(grew, statm[:, S_SH], 0.0),
+            leaf_count=jnp.where(grew, statm[:, S_CNT], 0.0),
+            leaf_parent=statm[:, S_PARENT].astype(jnp.int32),
+            num_leaves=state.num_leaves,
+            shrinkage=jnp.asarray(1.0, jnp.float32),
+            cat_count=i32c(N_CCNT) if has_cat else None,
+            cat_bins=state.tree_cat,
+        )
         if compact:
             # rebuild per-row leaf ids from the final segments: mark each
             # segment start with its leaf, forward-fill along positions,
             # undo the ordering permutation
             lar = jnp.arange(L, dtype=jnp.int32)
             starts = jnp.where((lar < state.num_leaves) &
-                               (state.leaf_rows > 0), state.leaf_start, R)
+                               (state.seg[:, 1] > 0), state.seg[:, 0], R)
             marks = jnp.full(R, -1, jnp.int32).at[starts].set(
                 lar, mode="drop")
             pos2leaf = lax.associative_scan(
                 lambda a, b: jnp.where(b >= 0, b, a), marks)
             leaf_id = jnp.zeros(R, jnp.int32).at[state.order].set(
                 pos2leaf, unique_indices=True)
-            return state.tree, leaf_id
-        return state.tree, state.leaf_id
+            return tree, leaf_id
+        return tree, state.leaf_id
 
     return grow

@@ -282,6 +282,18 @@ def _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
     # (ref: feature_histogram.hpp:431-441)
     dl_false = (~multi_bin) & (miss == MISSING_ENUM["nan"])
 
+    # Trace-time: with no missing values anywhere the forward scan is
+    # provably dead (the reference's run_forward gate,
+    # feature_histogram.hpp:304 — reverse alone covers every threshold),
+    # so its cumsums/selects are dropped from the program entirely. The
+    # split loop's fixed cost on TPU is its op count; meta arrays are
+    # concrete closure constants in every grower build path.
+    try:
+        static_fwd_dead = bool(
+            np.all(np.asarray(meta.missing_type) == MISSING_ENUM["none"]))
+    except Exception:
+        static_fwd_dead = False  # traced meta — keep both directions
+
     in_range = bin_idx < nbin
     acc_mask = in_range & ~(skip_default & (bin_idx == dflt))
 
@@ -347,6 +359,28 @@ def _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
         thr_ok_rev &= bin_idx == rand_bins[:, None]
     gains_rev = jnp.where(valid_rev & thr_ok_rev, gains_rev, K_MIN_SCORE)
 
+    # ---------------- per-feature best: reverse side ------------------------
+    # reverse ties -> larger threshold (first seen high-to-low)
+    rev_best_t = (B - 1) - jnp.argmax(gains_rev[:, ::-1], axis=1)
+    rev_best_gain = jnp.take_along_axis(gains_rev, rev_best_t[:, None],
+                                        axis=1)[:, 0]
+    take = lambda a, idx: jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+    if static_fwd_dead:
+        best_t = rev_best_t.astype(jnp.int32)
+        best_gain = rev_best_gain
+        best_dl = jnp.broadcast_to(~dl_false[:, 0], best_gain.shape)
+        blg = take(lg_rev, best_t)
+        blh = take(lh_rev, best_t)
+        blc = take(lc_rev, best_t)
+        brg = take(rg_thr, best_t)
+        brh = take(rh_thr, best_t)
+        brc = take(rc_thr, best_t)
+        return dict(best_gain=best_gain, best_t=best_t, best_dl=best_dl,
+                    blg=blg, blh=blh, blc=blc, brg=brg, brh=brh, brc=brc,
+                    min_gain_shift=min_gain_shift,
+                    out_range=((out_min, out_max) if use_mc else None))
+
     # ---------------- FORWARD scan: left side accumulates 0..t -------------
     fwd_mask = (acc_mask & (bin_idx <= nbin - 2)).astype(hist.dtype)
     lg_acc = jnp.cumsum(g * fwd_mask, axis=1)
@@ -361,11 +395,7 @@ def _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
         thr_ok_fwd &= bin_idx == rand_bins[:, None]
     gains_fwd = jnp.where(valid_fwd & thr_ok_fwd, gains_fwd, K_MIN_SCORE)
 
-    # ---------------- per-feature best, then across features ---------------
-    # reverse ties -> larger threshold (first seen high-to-low)
-    rev_best_t = (B - 1) - jnp.argmax(gains_rev[:, ::-1], axis=1)
-    rev_best_gain = jnp.take_along_axis(gains_rev, rev_best_t[:, None],
-                                        axis=1)[:, 0]
+    # ---------------- merge the two directions ------------------------------
     # forward ties -> smaller threshold
     fwd_best_t = jnp.argmax(gains_fwd, axis=1)
     fwd_best_gain = jnp.take_along_axis(gains_fwd, fwd_best_t[:, None],
@@ -376,7 +406,6 @@ def _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
     best_gain = jnp.where(use_fwd, fwd_best_gain, rev_best_gain)
     best_dl = jnp.where(use_fwd, False, ~dl_false[:, 0])
 
-    take = lambda a, idx: jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
     blg = jnp.where(use_fwd, take(lg_acc, best_t), take(lg_rev, best_t))
     blh = jnp.where(use_fwd, take(lh_acc, best_t), take(lh_rev, best_t))
     blc = jnp.where(use_fwd, take(lc_acc, best_t), take(lc_rev, best_t))

@@ -1,0 +1,93 @@
+"""Op-count proxy for the grower's while-body fixed cost.
+
+The ~82 ms/tree fixed overhead at 255 leaves is program-op dispatch in
+the split loop (docs/TPU_RUNBOOK.md cost model: ~0.32 ms/split, ~1.5k
+HLO instructions in the compiled body). This tool compiles the grower
+at a bench-like geometry on CPU and reports instruction counts of the
+optimized module — total, inside the while body, and the worst
+offenders by opcode — so body-shrinking work has a measurable proxy
+without a TPU claim.
+
+Usage: python scripts/body_opcount.py [num_leaves] [rows]
+"""
+import re
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np       # noqa: E402
+
+sys.path.insert(0, ".")
+from lightgbm_tpu.core.grower import GrowerConfig, make_tree_grower  # noqa: E402
+from lightgbm_tpu.ops.split import FeatureMeta, SplitHyperParams     # noqa: E402
+
+
+def main() -> None:
+    L = int(sys.argv[1]) if len(sys.argv) > 1 else 255
+    R = int(sys.argv[2]) if len(sys.argv) > 2 else 16384
+    F, B = 28, 256
+    meta = FeatureMeta(
+        num_bin=jnp.full((F,), B, jnp.int32),
+        missing_type=jnp.zeros((F,), jnp.int32),
+        default_bin=jnp.zeros((F,), jnp.int32),
+        is_categorical=jnp.zeros((F,), bool),
+        monotone=None,
+    )
+    cfg = GrowerConfig(num_leaves=L, num_bin=B,
+                       hparams=SplitHyperParams(min_data_in_leaf=20),
+                       row_sched="compact", hist_rm_backend="einsum",
+                       partition_mode="auto", min_bucket=2048)
+    grow = make_tree_grower(cfg, meta)
+    bins = jnp.zeros((R, F), jnp.uint8)
+    gh = jnp.zeros((R, 3), jnp.float32)
+    lowered = jax.jit(grow).lower(bins, gh)
+    hlo = lowered.compile().as_text()
+
+    # split the module into computations: a computation header is a
+    # non-indented-ish line starting with %name or ENTRY and ending in "{"
+    # (params may contain layout braces, so key on the line END)
+    comps = {}
+    comp = None
+    body_name = None
+    for ln in hlo.splitlines():
+        stripped = ln.strip()
+        if stripped.endswith("{") and (stripped.startswith("%") or
+                                       stripped.startswith("ENTRY")):
+            name = stripped.lstrip("%").split(" ", 1)[0].split("(", 1)[0]
+            comp = name
+            comps[comp] = []
+            continue
+        if stripped == "}":
+            comp = None
+            continue
+        if comp is not None and re.match(r"\s+(ROOT\s+)?\S+\s*=", ln):
+            comps[comp].append(ln)
+            # the outermost fori_loop: op_name metadata "jit(grow)/while"
+            m = re.search(r"body=%?([\w.\-]+)", ln)
+            if m and 'op_name="jit(grow)/while"' in ln:
+                body_name = m.group(1)
+    total = sum(len(v) for v in comps.values())
+    print(f"geometry: L={L} R={R} F={F} B={B}")
+    print(f"total optimized-HLO instructions: {total}")
+    if body_name and body_name in comps:
+        body = comps[body_name]
+        ops = {}
+        for ln in body:
+            m = re.search(r"=\s*\S+\s+([\w\-]+)\(", ln)
+            op = m.group(1) if m else "?"
+            ops[op] = ops.get(op, 0) + 1
+        print(f"while-body '{body_name}': {len(body)} direct instrs "
+              f"(~kernel launches per split)")
+        for op, n in sorted(ops.items(), key=lambda kv: -kv[1])[:20]:
+            print(f"  {n:6d}  {op}")
+    else:
+        print("while body not found; largest computations:")
+        for name, v in sorted(comps.items(), key=lambda kv: -len(kv[1]))[:5]:
+            print(f"  {len(v):6d}  {name[:80]}")
+
+
+if __name__ == "__main__":
+    main()
