@@ -253,6 +253,13 @@ _reg("tpu_donate_state", bool, True, ())     # donate training state buffers
 # device is behind a high-latency tunnel (~70 ms/round-trip measured).
 # auto = on for TPU backends, off on CPU; true/false force.
 _reg("tpu_async_boosting", str, "auto", ())  # auto | true | false
+# device-side metric evaluation: metrics with an eval_device path
+# compute on device and fetch scalars only (vs pulling the full [K, N]
+# score through the tunnel). The device implementations are f32 with
+# wider clips than the host f64 path (e.g. binary logloss clips at 1e-7
+# vs 1e-15), so values can differ once predictions saturate. auto = on
+# for non-CPU backends; false forces the host f64 path everywhere.
+_reg("tpu_device_eval", str, "auto", ())     # auto | true | false
 # with async boosting, the "no more leaves to split" stop condition is
 # checked every this many iterations (each check costs one device
 # round-trip); detection is exact — extra trees past the stop point are

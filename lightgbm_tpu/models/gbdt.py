@@ -423,15 +423,16 @@ class GBDT:
         strat = self.sample_strategy
         if strat.needs_grad:
             # device-capable gradient sampler (GOSS): stateless jax key
-            # chain, so there is no RNG state to snapshot. NOTE a
-            # stop-check rollback replays through the SYNC path, whose
-            # host sampler draws a fresh (equally valid) GOSS sample —
-            # bit-exact replay holds only for RNG-snapshot samplers
-            # (bagging); for GOSS the guarantee is policy-level
+            # chain, so there is no RNG state to snapshot. A stop-check
+            # rollback replays through the SYNC path, which re-draws
+            # from this same fold_in(key, iter) chain once the flag
+            # below is set — bit-exact replay holds for GOSS exactly as
+            # it does for the RNG-snapshot samplers (bagging)
             key = jax.random.fold_in(self._goss_key, self.iter)
             pair = strat.sample_dev(self.iter, grad, hess, key)
             if pair is not None:
                 sel_dev, w_dev = pair
+                self._goss_dev_used = True
             sample = pair
         else:
             sdev = getattr(strat, "sample_dev", None)
@@ -709,14 +710,14 @@ class GBDT:
         self._multival = train.bins_mv is not None
         if self._multival:
             fallback = []
-            if self._tree_learner not in ("serial", "data"):
+            if self._tree_learner not in ("serial", "data", "voting"):
                 fallback.append(f"tree_learner={self._tree_learner}")
                 self._tree_learner = "serial"
             if fallback:
                 log.warning("multi-value sparse storage supports the "
-                            "serial and data learners only (consider "
-                            "tree_learner=data); overriding: " +
-                            ", ".join(fallback))
+                            "serial, data and voting learners "
+                            "(consider tree_learner=data); overriding: "
+                            + ", ".join(fallback))
             self.grower_cfg = dataclasses.replace(
                 self.grower_cfg, hist_backend="multival")
         self._compact = self.grower_cfg.row_sched == "compact"
@@ -732,7 +733,7 @@ class GBDT:
             log.warning("forced splits with EFB bundling are untested; "
                         "disabling bundling")
         elif (cfg.enable_bundle and
-                self._tree_learner in ("serial", "data") and
+                self._tree_learner in ("serial", "data", "voting") and
                 train.bins is not None and train.num_used_features > 1):
             from ..io.bundling import find_bundles, pack_bins
             nb_used = np.asarray([m.num_bin for m in mappers], np.int64)
@@ -833,7 +834,7 @@ class GBDT:
                 log.warning("forced splits are not supported with "
                             "multi-value sparse storage; ignoring")
                 forced = None
-            if self._tree_learner == "data":
+            if self._tree_learner in ("data", "voting"):
                 self._setup_distributed(train, None, None)
             else:
                 idx_h, binv_h = train.bins_mv
@@ -846,9 +847,24 @@ class GBDT:
                     fetch_bin_column=fetch, prepare_split_hist=prepare,
                     prepare_is_pure=True))
         elif self._tree_learner == "serial":
-            self._grow = jax.jit(
-                make_tree_grower(self.grower_cfg, self.feature_meta,
-                                 forced=forced, bundle=self._bundle))
+            # external collective injection (≡ LGBM_NetworkInitWithFunctions,
+            # ref: c_api.h:1674): the serial program becomes the per-worker
+            # data-parallel program with user-owned transport. The
+            # injection is SNAPSHOTTED here so several workers can be
+            # set up sequentially in one process (each Booster keeps
+            # its own rank/world).
+            from ..distributed import injected_collectives, \
+                make_injected_hooks
+            self._inj = injected_collectives()
+            hooks = make_injected_hooks()
+            if hooks is not None:
+                self._grow = jax.jit(make_tree_grower(
+                    self.grower_cfg, self.feature_meta, forced=forced,
+                    bundle=self._bundle, **hooks))
+            else:
+                self._grow = jax.jit(
+                    make_tree_grower(self.grower_cfg, self.feature_meta,
+                                     forced=forced, bundle=self._bundle))
         else:
             self._setup_distributed(train, forced, train_bins_host)
 
@@ -962,13 +978,15 @@ class GBDT:
             log.fatal("interaction_constraints are not supported with "
                       "tree_learner=feature")
 
-        if self._multival and tl == "data":
-            # multi-value sparse storage under the data-parallel learner:
+        if self._multival and tl in ("data", "voting"):
+            # multi-value sparse storage under the row-sharded learners:
             # the [R, K] nonzero packing row-shards exactly like dense
             # rows (pad rows carry idx = -1, contributing nothing); the
-            # column accessor and leaf gathers are shard-local, and the
-            # default-bin reconstruction runs on the psum'd GLOBAL
-            # histograms in the split scan (see make_data_parallel_grower)
+            # column accessor and leaf gathers are shard-local. Data-
+            # parallel reconstructs default bins on the psum'd GLOBAL
+            # histograms in the split scan; voting fixes LOCAL hists
+            # from the grower's local-sums channel BEFORE the vote (the
+            # fix is linear, so the psum of fixed locals is exact).
             from ..ops.hist_multival import SparseBins
             mesh = build_mesh(n_dev, axis_names=(DATA_AXIS,))
             R_pad = padded_rows(N, n_dev)
@@ -984,13 +1002,24 @@ class GBDT:
                 jax.device_put(np.ascontiguousarray(binv_h), sh),
                 train.num_used_features)
             fetch, prepare = self._multival_hooks(train)
-            grow = make_data_parallel_grower(
-                self.grower_cfg, self.feature_meta, mesh,
-                fetch_bin_column=fetch, prepare_split_hist=prepare,
-                prepare_is_pure=True,
-                bins_spec=SparseBins(P(DATA_AXIS, None),
-                                     P(DATA_AXIS, None),
-                                     train.num_used_features))
+            mv_spec = SparseBins(P(DATA_AXIS, None), P(DATA_AXIS, None),
+                                 train.num_used_features)
+            if tl == "data":
+                grow = make_data_parallel_grower(
+                    self.grower_cfg, self.feature_meta, mesh,
+                    fetch_bin_column=fetch, prepare_split_hist=prepare,
+                    prepare_is_pure=True, bins_spec=mv_spec)
+            else:
+                from ..ops.hist_multival import make_local_default_bin_fix
+                dflt = np.asarray(
+                    [m.default_bin for m in train.used_bin_mappers()],
+                    np.int32)
+                grow = make_voting_parallel_grower(
+                    self.grower_cfg, self.feature_meta, mesh,
+                    top_k=int(cfg.top_k), fetch_bin_column=fetch,
+                    bins_spec=mv_spec,
+                    pre_fix=make_local_default_bin_fix(
+                        dflt, self.num_bin_max))
             self._grow_dist = jax.jit(grow)
         elif tl in ("data", "voting"):
             if bins_host is None:
@@ -1016,7 +1045,7 @@ class GBDT:
             else:
                 grow = make_voting_parallel_grower(
                     self.grower_cfg, self.feature_meta, mesh,
-                    top_k=int(cfg.top_k))
+                    top_k=int(cfg.top_k), bundle=self._bundle)
             self._grow_dist = jax.jit(grow)
         else:  # feature-parallel
             if bins_host is None:
@@ -1262,6 +1291,12 @@ class GBDT:
     def _obtain_init_score(self, k: int) -> float:
         """ref: gbdt.cpp:317 ObtainAutomaticInitialScore + network mean."""
         init = self.objective.boost_from_score(k) if self.objective else 0.0
+        inj = getattr(self, "_inj", None)
+        if inj is not None and inj["num_machines"] > 1:
+            # ≡ Network::GlobalSyncUpByMean over machines (gbdt.cpp:322)
+            import numpy as _np
+            tot = inj["reduce_sum"](_np.asarray([init], _np.float64))
+            init = float(tot[0]) / inj["num_machines"]
         return float(init)
 
     def _score_add(self, score, delta, k: int):
@@ -1425,8 +1460,21 @@ class GBDT:
         # rollback replay re-derives the exact same stateless-key mask
         # the async path used (sample_strategy.sample_dev docstring)
         if self.sample_strategy.needs_grad:
-            sample = self.sample_strategy.sample(
-                self.iter, np.asarray(grad), np.asarray(hess))
+            pair = None
+            if getattr(self, "_goss_dev_used", False):
+                # this run's GOSS samples come from the async path's
+                # stateless key chain — a stop-check rollback replay
+                # re-derives the EXACT draw the async path used, so
+                # stopped-and-replayed runs stay bit-identical to
+                # uninterrupted async runs
+                key = jax.random.fold_in(self._goss_key, self.iter)
+                pair = self.sample_strategy.sample_dev(
+                    self.iter, grad, hess, key)
+            if pair is not None:
+                sample = (np.asarray(pair[0]), np.asarray(pair[1]))
+            else:
+                sample = self.sample_strategy.sample(
+                    self.iter, np.asarray(grad), np.asarray(hess))
         else:
             sdev = getattr(self.sample_strategy, "sample_dev", None)
             sample = (sdev(self.iter, key=self._goss_key)
@@ -1804,7 +1852,14 @@ class GBDT:
         back to the host implementation (one score pull, shared)."""
         out = []
         K = self.num_tree_per_iteration
-        use_dev = jax.default_backend() != "cpu"
+        # tpu_device_eval gates the f32 device path (its clips are wider
+        # than the host f64 path's — saturated predictions can report
+        # different logloss and flip early-stopping decisions)
+        mode = str(getattr(self.config, "tpu_device_eval", "auto")).lower()
+        if mode == "auto":
+            use_dev = jax.default_backend() != "cpu"
+        else:
+            use_dev = mode in ("true", "1", "yes")
         view_dev = score[0] if K == 1 else score
         entries = []          # ("dev", name, hib, idx) | ("host", metric)
         dev_scalars = []

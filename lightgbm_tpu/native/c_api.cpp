@@ -674,10 +674,25 @@ int LGBM_BoosterPredictForFile(BoosterHandle handle,
     return -1;
   }
   int nf = m->max_feature_idx + 1;
+  // prediction parameters (ref: c_api.cpp applies Config to the
+  // Predictor): the shape check is the one that changes file-predict
+  // semantics — short/long rows are an error unless
+  // predict_disable_shape_check=true (ref: config.h
+  // predict_disable_shape_check)
+  bool disable_shape_check = false;
+  if (parameter) {
+    std::string ps(parameter);
+    for (const char* key : {"predict_disable_shape_check=true",
+                            "predict_disable_shape_check=True",
+                            "predict_disable_shape_check=1"})
+      if (ps.find(key) != std::string::npos) disable_shape_check = true;
+  }
   std::string line;
   bool first = true;
+  int64_t line_no = 0;
   std::vector<double> row;
   while (std::getline(in, line)) {
+    ++line_no;
     if (first && data_has_header) {
       first = false;
       continue;
@@ -696,6 +711,14 @@ int LGBM_BoosterPredictForFile(BoosterHandle handle,
       p = e;
     }
     size_t off = row.size() == static_cast<size_t>(nf) + 1 ? 1 : 0;
+    if (!disable_shape_check && row.size() != static_cast<size_t>(nf) &&
+        row.size() != static_cast<size_t>(nf) + 1) {
+      SetError("data line " + std::to_string(line_no) + " has " +
+               std::to_string(row.size()) + " columns, but the model "
+               "needs " + std::to_string(nf) + " features (set "
+               "predict_disable_shape_check=true to zero-fill instead)");
+      return -1;
+    }
     std::vector<double> feats(nf, 0.0);
     for (int j = 0; j < nf && off + j < row.size(); ++j)
       feats[j] = row[off + j];
@@ -718,7 +741,6 @@ int LGBM_BoosterPredictForFile(BoosterHandle handle,
     }
     outf << '\n';
   }
-  (void)parameter;
   return 0;
 }
 

@@ -20,6 +20,7 @@
 #include <mutex>
 #include <set>
 #include <string>
+#include <vector>
 
 namespace {
 
@@ -491,6 +492,12 @@ int LGBM_BoosterGetEvalCounts(void* handle, int* out_len) {
   return RunGuarded(body);
 }
 
+namespace {
+int CopyNameList(const std::string& names_expr, uint64_t obj_id,
+                 int len, int* out_len, size_t buffer_len,
+                 size_t* out_buffer_len, char** out_strs);
+}  // namespace
+
 int LGBM_BoosterGetEvalNames(void* handle, const int len,
                              int* out_len, const size_t buffer_len,
                              size_t* out_buffer_len, char** out_strs) {
@@ -500,35 +507,11 @@ int LGBM_BoosterGetEvalNames(void* handle, const int len,
                       "handle");
     return -1;
   }
-  // gather the names through a bounded scratch buffer, then copy into
-  // the caller's string array (reference two-call sizing protocol).
-  // Calls must come from one thread (file-header contract); the blob is
-  // rejected loudly if it ever exceeds the scratch capacity.
-  static char scratch[65536];
-  static int n_names;
-  std::string body =
-      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
-      "names = [r[1] for r in b.eval_train()]\n" +
-      "blob = b'\\0'.join(n.encode() for n in names) + b'\\0\\0'\n" +
-      "if len(blob) > 65534:\n" +
-      "    raise ValueError('eval metric names exceed 64 KiB')\n" +
-      "_ct.memmove(" + Addr(scratch) + ", blob, len(blob))\n" +
-      "_ct.c_int.from_address(" + Addr(&n_names) +
-      ").value = len(names)\n";
-  if (RunGuarded(body) != 0) return -1;
-  *out_len = n_names;
-  size_t max_needed = 1;
-  const char* p = scratch;
-  for (int i = 0; i < n_names; ++i) {
-    size_t l = std::strlen(p);
-    if (l + 1 > max_needed) max_needed = l + 1;
-    if (out_strs && i < len && out_strs[i]) {
-      std::snprintf(out_strs[i], buffer_len, "%s", p);
-    }
-    p += l + 1;
-  }
-  *out_buffer_len = max_needed;
-  return 0;
+  // reference two-call sizing protocol via the shared per-call-buffer
+  // name-list copier (no static scratch, no size cap)
+  return CopyNameList("[r[1] for r in o['booster'].eval_train()]",
+                      h->id, len, out_len, buffer_len, out_buffer_len,
+                      out_strs);
 }
 
 int LGBM_BoosterSaveModel(void* handle, int start_iteration,
@@ -825,8 +808,12 @@ int LGBM_DatasetGetField(void* handle, const char* field_name,
     LgbmTrainSetError("DatasetGetField: not a training Dataset handle");
     return -1;
   }
-  static int64_t ptr_slot;
-  static int32_t len_slot, type_slot;
+  // per-call result slots (stack addresses embedded in the generated
+  // code): concurrent callers each write their own frame — the reference
+  // documents these getters as thread-safe (ref: c_api.cpp shared_lock
+  // Booster pattern)
+  int64_t ptr_slot = 0;
+  int32_t len_slot = 0, type_slot = 0;
   std::string body =
       "d = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n" +
       "fn = " + PyStr(field_name) + "\n" +
@@ -889,21 +876,33 @@ namespace {
 int CopyNameList(const std::string& names_expr, uint64_t obj_id,
                  const int len, int* out_len, const size_t buffer_len,
                  size_t* out_buffer_len, char** out_strs) {
-  static char scratch[262144];
-  static int32_t n_slot;
-  std::string body =
+  // Two interpreter passes with PER-CALL slots (no static scratch, no
+  // size cap): pass 1 builds the blob, stashes it under a key unique to
+  // this call frame and reports its size; pass 2 copies it into a
+  // right-sized heap buffer and drops the stash. Concurrent callers
+  // write distinct stack slots / stash keys, so the post-guard reads
+  // race with nothing.
+  int64_t blob_len = 0;
+  int32_t n_slot = 0;
+  const std::string key = "'nameblob_" + Addr(&blob_len) + "'";
+  std::string body1 =
       "o = _lgbm_capi['obj'][" + std::to_string(obj_id) + "]\n" +
       "names = " + names_expr + "\n" +
       "blob = b'\\0'.join(n.encode() for n in names) + b'\\0\\0'\n" +
-      "if len(blob) > 262142:\n" +
-      "    raise ValueError('name list exceeds 256 KiB')\n" +
-      "_ct.memmove(" + Addr(scratch) + ", blob, len(blob))\n" +
+      "_lgbm_capi[" + key + "] = blob\n" +
+      "_ct.c_int64.from_address(" + Addr(&blob_len) +
+      ").value = len(blob)\n" +
       "_ct.c_int32.from_address(" + Addr(&n_slot) +
       ").value = len(names)\n";
-  if (RunGuarded(body) != 0) return -1;
+  if (RunGuarded(body1) != 0) return -1;
+  std::vector<char> scratch(static_cast<size_t>(blob_len) + 2, '\0');
+  std::string body2 =
+      "blob = _lgbm_capi.pop(" + key + ")\n" +
+      "_ct.memmove(" + Addr(scratch.data()) + ", blob, len(blob))\n";
+  if (RunGuarded(body2) != 0) return -1;
   *out_len = n_slot;
   size_t max_needed = 1;
-  const char* p = scratch;
+  const char* p = scratch.data();
   for (int i = 0; i < n_slot; ++i) {
     size_t l = std::strlen(p);
     if (l + 1 > max_needed) max_needed = l + 1;

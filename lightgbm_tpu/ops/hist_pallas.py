@@ -197,7 +197,13 @@ def fit_tiles(feature_tile: int, num_bin: int,
     while block_rows > 128 and \
             resident(feature_tile, block_rows) > budget_elems:
         block_rows //= 2
-    return max(feature_tile, 8), max(block_rows, 128)
+    feature_tile, block_rows = max(feature_tile, 8), max(block_rows, 128)
+    # feasible=False when even the (8, 128) floor exceeds the budget
+    # (huge num_bin: the pinned 32*8*Bp accumulator alone overflows once
+    # Bp >= 4096) — callers must fall back to a non-Pallas backend
+    # rather than launch an over-budget kernel
+    return feature_tile, block_rows, \
+        resident(feature_tile, block_rows) <= budget_elems
 
 
 def hist_pallas(bins_t: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
@@ -211,7 +217,11 @@ def hist_pallas(bins_t: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    feature_tile, block_rows = fit_tiles(feature_tile, num_bin, block_rows)
+    feature_tile, block_rows, ok = fit_tiles(feature_tile, num_bin,
+                                             block_rows)
+    if not ok:
+        from .histogram import hist_xla
+        return hist_xla(bins_t, gh, num_bin, block_rows)
     return _hist_pallas_impl(bins_t, gh, num_bin, block_rows, feature_tile,
                              bool(interpret))
 
@@ -228,6 +238,11 @@ def hist_pallas_rm(bins_rm: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    feature_tile, block_rows = fit_tiles(feature_tile, num_bin, block_rows)
+    feature_tile, block_rows, ok = fit_tiles(feature_tile, num_bin,
+                                             block_rows)
+    if not ok:
+        from .histogram import hist_rowmajor
+        return hist_rowmajor(bins_rm, gh, num_bin,
+                             block_rows=block_rows, backend="einsum")
     return _hist_pallas_impl(bins_rm.T, gh, num_bin, block_rows,
                              feature_tile, bool(interpret))
