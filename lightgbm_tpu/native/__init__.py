@@ -22,7 +22,8 @@ _BUILD_DIR = os.path.join(_HERE, "_build")
 _SO_PATH = os.path.join(_BUILD_DIR, "lgbm_native.so")
 _SRCS = [os.path.join(_HERE, "parser.cpp"),
          os.path.join(_HERE, "c_api.cpp"),
-         os.path.join(_HERE, "c_api_train.cpp")]
+         os.path.join(_HERE, "c_api_train.cpp"),
+         os.path.join(_HERE, "shap.cpp")]
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -35,8 +36,8 @@ def _build() -> Optional[str]:
             os.path.getmtime(_SO_PATH) >= max(os.path.getmtime(s)
                                               for s in _SRCS)):
         return _SO_PATH
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", *_SRCS,
-           "-ldl", "-o", _SO_PATH + ".tmp"]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           *_SRCS, "-ldl", "-o", _SO_PATH + ".tmp"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(_SO_PATH + ".tmp", _SO_PATH)
@@ -75,6 +76,27 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32)]
+        lib.lgbm_tree_shap_batch.restype = ctypes.c_int
+        lib.lgbm_tree_shap_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),   # split_feature
+            ctypes.POINTER(ctypes.c_double),  # threshold_real
+            ctypes.POINTER(ctypes.c_int32),   # decision_type
+            ctypes.POINTER(ctypes.c_int32),   # left_child
+            ctypes.POINTER(ctypes.c_int32),   # right_child
+            ctypes.POINTER(ctypes.c_double),  # leaf_value
+            ctypes.POINTER(ctypes.c_double),  # leaf_count
+            ctypes.POINTER(ctypes.c_double),  # internal_count
+            ctypes.c_int32,                   # n_int
+            ctypes.POINTER(ctypes.c_int32),   # cat_boundaries
+            ctypes.POINTER(ctypes.c_uint32),  # cat_threshold
+            ctypes.c_int32,                   # num_cat
+            ctypes.c_int32,                   # n_cat_words
+            ctypes.POINTER(ctypes.c_double),  # X
+            ctypes.c_int64,                   # nrow
+            ctypes.c_int32,                   # ncol
+            ctypes.POINTER(ctypes.c_double),  # out
+            ctypes.c_int64,                   # out_stride
+            ctypes.c_int32]                   # nthreads
         _lib = lib
         return _lib
 

@@ -1,0 +1,90 @@
+"""Row-batched TreeSHAP parity vs the per-row recursion.
+
+The batched DFS (core/shap.py shap_tree_batch) must reproduce the
+scalar EXTEND/UNWIND recursion (shap_one_tree) bit-for-bit-ish (both
+accumulate in f64; identical op order per path), across numerical,
+missing-value, categorical and multiclass models.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.core.shap import shap_one_tree, shap_tree_batch
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def _parity(bst, X, F):
+    eng = bst._engine
+    for t in eng.models:
+        batch = shap_tree_batch(t, X, F)
+        for r in range(X.shape[0]):
+            ref = shap_one_tree(t, X[r], F)
+            np.testing.assert_allclose(batch[r], ref, rtol=1e-9,
+                                       atol=1e-12)
+
+
+def test_batch_matches_scalar_regression(rng):
+    X = rng.normal(size=(300, 6))
+    y = X[:, 0] * 3 + X[:, 1] ** 2 + rng.normal(size=300) * 0.1
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=5)
+    _parity(bst, X[:40], 6)
+
+
+def test_batch_matches_scalar_missing(rng):
+    X = rng.normal(size=(400, 5))
+    X[rng.uniform(size=X.shape) < 0.25] = np.nan
+    y = np.where(np.isnan(X[:, 0]), 1.5, X[:, 0]) + rng.normal(
+        size=400) * 0.1
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "use_missing": True, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    _parity(bst, X[:40], 5)
+
+
+def test_batch_matches_scalar_categorical(rng):
+    n = 500
+    cat = rng.integers(0, 8, size=n).astype(np.float64)
+    X = np.column_stack([cat, rng.normal(size=n)])
+    y = (cat % 3 == 0).astype(np.float64) * 2 + X[:, 1] * 0.5
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=y, categorical_feature=[0]),
+                    num_boost_round=4)
+    _parity(bst, X[:40], 2)
+
+
+def test_batch_matches_scalar_multiclass_api(rng):
+    X = rng.normal(size=(400, 5))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int) + (X[:, 2] > 0.5)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    contrib = bst.predict(X[:25], pred_contrib=True)
+    # per-class blocks of F+1, contributions sum to raw score
+    raw = bst.predict(X[:25], raw_score=True)
+    c = contrib.reshape(25, 3, 6)
+    np.testing.assert_allclose(c.sum(axis=2), raw, rtol=1e-5, atol=1e-5)
+
+
+def test_batch_throughput_smoke(rng):
+    """100k rows through a real model in seconds, not minutes."""
+    import time
+    X = rng.normal(size=(100_000, 8)).astype(np.float32)
+    y = X[:, 0] - X[:, 1] * X[:, 2]
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "verbosity": -1},
+                    lgb.Dataset(X[:20_000], label=y[:20_000]),
+                    num_boost_round=10)
+    t0 = time.perf_counter()
+    contrib = bst.predict(X, pred_contrib=True)
+    dt = time.perf_counter() - t0
+    assert contrib.shape == (100_000, 9)
+    # per-row recursion ran ~1k rows/s/tree; the batch must clear 100k
+    # rows x 10 trees in well under a minute even on a loaded CI box
+    assert dt < 60, f"batched SHAP too slow: {dt:.1f}s"
