@@ -85,3 +85,97 @@ def num_processes() -> int:
 def process_index() -> int:
     import jax
     return jax.process_index()
+
+
+# ---------------------------------------------------------------------------
+# External collective injection (≡ LGBM_NetworkInitWithFunctions,
+# ref: include/LightGBM/c_api.h:1674, src/network/network.cpp:49-62 —
+# the reference lets an embedding host (SynapseML/Spark) supply its own
+# reduce-scatter/allgather instead of the built-in socket/MPI linkers).
+#
+# The TPU translation: the grower's distributed hooks (reduce_hist /
+# reduce_sums / reduce_max, core/grower.py make_tree_grower) are fed
+# host callables through `jax.experimental.io_callback`, so EVERY
+# cross-worker reduction of the training program routes through the
+# injected functions — no jax.distributed world required. Each worker
+# runs the ordinary serial grower on its row shard; the injected
+# allreduce makes histograms/root sums global, which is exactly the
+# data-parallel algebra (SURVEY.md §3.3) with user-owned transport.
+# ---------------------------------------------------------------------------
+
+_injected = None
+
+
+def inject_collectives(reduce_sum, reduce_max=None, rank: int = 0,
+                       num_machines: int = 1) -> None:
+    """Register external collectives for subsequent Booster training.
+
+    reduce_sum(np.ndarray) -> np.ndarray: allreduce-sum across workers
+    (same shape/dtype; called for histograms [F, B, 3] f32/i32 and root
+    sum triples [3]). reduce_max: allreduce-max for scalars (only
+    needed with use_quantized_grad; defaults to identity). ``rank``
+    decorrelates per-worker RNG (stochastic rounding).
+
+    Rows must be pre-partitioned across workers and bin boundaries
+    shared (build each worker's Dataset with ``reference=`` or the same
+    forcedbins file) — the same contract as the reference's
+    pre_partition=true external-collective mode.
+    """
+    global _injected
+    if not callable(reduce_sum):
+        raise TypeError("reduce_sum must be callable")
+    _injected = {
+        "reduce_sum": reduce_sum,
+        "reduce_max": reduce_max,
+        "rank": int(rank),
+        "num_machines": int(num_machines),
+    }
+    log.info(f"external collectives injected (rank {rank}/"
+             f"{num_machines})")
+
+
+def clear_collectives() -> None:
+    """Remove an injected collective backend (≡ LGBM_NetworkFree)."""
+    global _injected
+    _injected = None
+
+
+def injected_collectives():
+    return _injected
+
+
+def make_injected_hooks():
+    """Grower hooks wrapping the injected callables via io_callback
+    (ordered: comm calls must run exactly once per step, in program
+    order). Returns None when nothing is injected."""
+    if _injected is None:
+        return None
+    import functools
+
+    import jax
+    import numpy as np
+    from jax.experimental import io_callback
+
+    inj = _injected
+
+    def _host_sum(a):
+        out = inj["reduce_sum"](np.asarray(a))
+        return np.asarray(out, a.dtype).reshape(a.shape)
+
+    def _host_max(a):
+        fn = inj["reduce_max"]
+        if fn is None:
+            return np.asarray(a)
+        return np.asarray(fn(np.asarray(a)), a.dtype).reshape(a.shape)
+
+    def _io(fn, x):
+        return io_callback(fn, jax.ShapeDtypeStruct(x.shape, x.dtype),
+                           x, ordered=True)
+
+    return {
+        "reduce_hist": lambda h, ctx=None: _io(_host_sum, h),
+        "reduce_sums": lambda s: _io(_host_sum, s),
+        "reduce_max": lambda x: _io(_host_max, x),
+        "localize_key": functools.partial(
+            jax.random.fold_in, data=inj["rank"]),
+    }
