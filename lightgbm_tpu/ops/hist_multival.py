@@ -96,23 +96,43 @@ def make_fetch_bin_column(default_bin: np.ndarray):
     return fetch
 
 
+def _default_bin_mask(default_bin: np.ndarray, num_bin: int):
+    return jnp.asarray(np.arange(num_bin)[None, :] ==
+                       np.asarray(default_bin)[:, None])
+
+
+def _apply_fix(hist, totals, dmask_j):
+    rest = hist.sum(axis=1)                                # [F, 3]
+    return hist + dmask_j[..., None] * (totals[None, None, :] -
+                                        rest[:, None, :])
+
+
 def make_default_bin_fix(default_bin: np.ndarray, num_bin: int):
     """prepare_split_hist hook: add (leaf totals - stored mass) to each
     feature's default-bin row (≡ FixHistogram; same algebra as EFB's
     expand_hist default-bin reconstruction)."""
-    dmask = (np.arange(num_bin)[None, :] ==
-             np.asarray(default_bin)[:, None])
-    dmask_j = jnp.asarray(dmask)
+    dmask_j = _default_bin_mask(default_bin, num_bin)
 
     def prepare(hist, ctx, feature_mask=None):
-        sg, sh, cnt, _ = ctx
-        totals = jnp.stack([sg, sh, cnt])                  # [3]
-        rest = hist.sum(axis=1)                            # [F, 3]
-        fixed = hist + dmask_j[..., None] * (totals[None, None, :] -
-                                             rest[:, None, :])
-        return fixed, None
+        sg, sh, cnt = ctx[0], ctx[1], ctx[2]
+        return _apply_fix(hist, jnp.stack([sg, sh, cnt]), dmask_j), None
 
     return prepare
+
+
+def make_local_default_bin_fix(default_bin: np.ndarray, num_bin: int):
+    """Voting-learner variant: fix a LOCAL histogram from the shard's
+    own leaf totals (the grower's local-sums channel). The fix is
+    linear in (hist, totals), so psum(fixed local) == fixed(psum) — the
+    same distributed-FixHistogram algebra as the reference's
+    data-parallel path, applied pre-aggregation so the local VOTE ranks
+    correct histograms."""
+    dmask_j = _default_bin_mask(default_bin, num_bin)
+
+    def fix(hist, totals3):
+        return _apply_fix(hist, jnp.stack(totals3), dmask_j)
+
+    return fix
 
 
 def take_rows(sb: SparseBins, idx) -> SparseBins:

@@ -42,11 +42,25 @@ from .mesh import DATA_AXIS
 
 def make_voting_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
                                 mesh: Mesh, top_k: int = 20,
-                                data_axis: str = DATA_AXIS):
+                                data_axis: str = DATA_AXIS,
+                                bundle=None, fetch_bin_column=None,
+                                bins_spec=None, pre_fix=None):
     """Build grow(bins_t, gh, feature_mask) with rows sharded over
     `data_axis` ([F, R] on dim 1, gh on dim 0), aggregating only the
     globally voted 2*top_k features per leaf (top_k ≡ config.top_k,
     config.h "top_k"/"topk").
+
+    Composition (the reference's learners are storage-agnostic —
+    feature_histogram.hpp constraints/scans are identical under every
+    learner — so these must compose here too):
+    - ``bundle``: EFB — the grower expands physical-group hists to
+      logical features with LOCAL totals (local-sums channel) before the
+      vote, so gains rank true local logical histograms.
+    - ``fetch_bin_column`` + ``bins_spec`` + ``pre_fix``: multi-value
+      sparse storage — ``pre_fix(hist, (lsg, lsh, lcnt))`` adds each
+      feature's missing default-bin mass from the LOCAL leaf totals
+      before the vote; the psum of locally-fixed hists is the correctly
+      fixed global histogram (the fix is linear in the totals).
     """
     F = int(meta.num_bin.shape[0])
     k = max(1, min(top_k, F))
@@ -54,13 +68,16 @@ def make_voting_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
     hp = cfg.hparams
 
     def prepare(hist_local, ctx, feature_mask=None):
-        _, _, _, parent_out = ctx
+        parent_out = ctx[3]
         # the LOCAL vote ranks by LOCAL gains (ref: voting learner votes
-        # with this->smaller_leaf_splits_, the local sums) — recover the
-        # local leaf totals from any feature's bin sums
-        local_sg = jnp.sum(hist_local[0, :, 0])
-        local_sh = jnp.sum(hist_local[0, :, 1])
-        local_cnt = jnp.sum(hist_local[0, :, 2])
+        # with this->smaller_leaf_splits_, the local sums) — the
+        # grower's local-sums channel carries the shard totals (ctx
+        # entries 4..6); any-feature bin sums would break for sparse
+        # storages whose default-bin mass is not stored
+        local_sg, local_sh, local_cnt = ctx[4], ctx[5], ctx[6]
+        if pre_fix is not None:
+            hist_local = pre_fix(hist_local,
+                                 (local_sg, local_sh, local_cnt))
         gains = per_feature_net_gains(hist_local, local_sg, local_sh,
                                       local_cnt, parent_out, meta, hp)  # [F]
         if feature_mask is not None:
@@ -88,14 +105,17 @@ def make_voting_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
         reduce_max=lambda x: lax.pmax(x, data_axis),
         localize_key=lambda k: jax.random.fold_in(
             k, lax.axis_index(data_axis)),
-        prepare_split_hist=prepare)
+        prepare_split_hist=prepare,
+        bundle=bundle, fetch_bin_column=fetch_bin_column,
+        local_pool=True)
 
     def wrapped(bins_t, gh, feature_mask, cegb_const, cegb_count, rng_key):
         return grow(bins_t, gh, feature_mask, (cegb_const, cegb_count),
                     rng_key)
 
-    bins_spec = (P(data_axis, None) if cfg.row_sched == "compact"
-                 else P(None, data_axis))
+    if bins_spec is None:
+        bins_spec = (P(data_axis, None) if cfg.row_sched == "compact"
+                     else P(None, data_axis))
     sharded = _make_sharded(
         wrapped, mesh,
         in_specs=(bins_spec, P(data_axis, None), P(), P(), P(), P()),

@@ -54,3 +54,76 @@ def test_feature_combo(combo_data, extra):
     assert np.isfinite(p).all()
     p2 = lgb.Booster(model_str=bst.model_to_string()).predict(X)
     np.testing.assert_allclose(p, p2, rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# Voting-learner composition (the reference composes these freely —
+# feature_histogram.hpp scans are learner-agnostic; here the voting
+# learner's local-sums channel makes EFB expansion and multival
+# default-bin reconstruction correct on LOCAL histograms).
+# ---------------------------------------------------------------------------
+
+
+def _sparse_onehot_data(seed=11, n=900, groups=4, per=5):
+    """Mutually-exclusive one-hot blocks: sparse enough for multival
+    auto-pick AND bundleable by EFB."""
+    rng = np.random.default_rng(seed)
+    F = groups * per
+    X = np.zeros((n, F), np.float32)
+    picks = [rng.integers(0, per, size=n) for _ in range(groups)]
+    for g in range(groups):
+        X[np.arange(n), g * per + picks[g]] = rng.uniform(
+            0.5, 2.0, size=n).astype(np.float32)
+    y = ((picks[0] % 2 == 0) ^ (picks[1] == 1)).astype(np.float32)
+    return X, y
+
+
+def _train_predict(X, y, **extra):
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 5, "seed": 1,
+              # exact int32 histogram algebra -> learners that aggregate
+              # the same features produce identical splits
+              "use_quantized_grad": True, "stochastic_rounding": False,
+              **extra}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=4)
+    return bst, bst.predict(X)
+
+
+def test_voting_multival_matches_serial():
+    from scipy import sparse as scipy_sparse
+    X, y = _sparse_onehot_data()
+    Xs = scipy_sparse.csr_matrix(X)   # multival needs a sparse source
+    _, p_serial = _train_predict(
+        Xs, y, tpu_sparse_storage="multival")
+    bst, p_vote = _train_predict(
+        Xs, y, tpu_sparse_storage="multival", tree_learner="voting",
+        tpu_num_devices=-1)
+    assert bst._engine._multival, "multival storage did not engage"
+    assert np.isfinite(p_vote).all()
+    # top_k default (20) >= F: every feature is aggregated, so voting
+    # degenerates to data-parallel and must match serial exactly
+    np.testing.assert_allclose(p_vote, p_serial, rtol=1e-5, atol=1e-6)
+
+
+def test_voting_efb_matches_serial():
+    X, y = _sparse_onehot_data(seed=12)
+    _, p_serial = _train_predict(
+        X, y, enable_bundle=True, tpu_sparse_storage="none")
+    bst, p_vote = _train_predict(
+        X, y, enable_bundle=True, tpu_sparse_storage="none",
+        tree_learner="voting", tpu_num_devices=-1)
+    assert bst._engine._bundle is not None, "EFB did not engage"
+    assert np.isfinite(p_vote).all()
+    np.testing.assert_allclose(p_vote, p_serial, rtol=1e-5, atol=1e-6)
+
+
+def test_voting_topk_restriction_still_learns():
+    """With top_k < F the vote truly restricts aggregation; training
+    must stay finite and learn signal (no exact-parity claim)."""
+    X, y = _sparse_onehot_data(seed=13)
+    bst, p = _train_predict(
+        X, y, tree_learner="voting", tpu_num_devices=-1, top_k=2,
+        tpu_sparse_storage="none")
+    assert np.isfinite(p).all()
+    auc_like = np.mean((p[y == 1][:, None] > p[y == 0][None, :]))
+    assert auc_like > 0.7
