@@ -1,0 +1,152 @@
+# CLI-backed k-fold cross-validation (role of reference
+# R-package/R/lgb.cv.R).
+#
+# Folds are materialized as train/valid CSV pairs and each fold trains
+# through the framework CLI with per-iteration metric printing
+# (metric_freq=1); the per-fold eval curves are parsed from the CLI's
+# "[i]  valid_0's metric: value" lines (callback.py log_evaluation
+# format, ref: callback.py:109) and aggregated into mean/stdv curves.
+# Early stopping is applied in R on the AGGREGATED mean curve — the
+# reference's CV semantics (one decision for all folds), not per-fold.
+
+.lgb_parse_eval <- function(lines) {
+  # "[LightGBM-TPU] [Info] [12]\tvalid_1's l2: 0.0234" (the logger
+  # prefixes log_evaluation's "[i]\tname's metric: value" lines)
+  hits <- regmatches(lines,
+                     regexec("\\[(\\d+)\\]\\s+valid_\\d+'s ([^:]+): ([-0-9.eE+naif]+)",
+                             lines))
+  hits <- Filter(function(h) length(h) == 4, hits)
+  if (length(hits) == 0) {
+    return(data.frame(iter = integer(), metric = character(),
+                      value = numeric(), stringsAsFactors = FALSE))
+  }
+  pick <- function(i) vapply(hits, function(h) h[i], character(1))
+  data.frame(
+    iter = as.integer(pick(2)),
+    metric = trimws(pick(3)),
+    value = as.numeric(pick(4)),
+    stringsAsFactors = FALSE)
+}
+
+.lgb_metric_higher_better <- function(metric) {
+  grepl("^(auc|average_precision|ndcg|map|r2)", metric)
+}
+
+#' k-fold cross validation
+#'
+#' @param params named list of training parameters.
+#' @param data an lgb.Dataset built from matrix data.
+#' @param nrounds number of boosting iterations per fold.
+#' @param nfold number of folds.
+#' @param early_stopping_rounds patience on the aggregated mean metric
+#'   (the first metric parsed); NULL disables.
+#' @param seed fold-assignment RNG seed.
+#' @param verbose verbosity for the underlying CLI runs.
+#' @return list with record_evals (per-metric eval_mean/eval_stdv),
+#'   best_iter, best_score and the per-fold booster model files.
+lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 5L,
+                   early_stopping_rounds = NULL, seed = 0L,
+                   verbose = -1L) {
+  if (!inherits(data, "lgb.Dataset")) stop("data must be an lgb.Dataset")
+  if (!isTRUE(data$owned))
+    stop("lgb.cv needs an lgb.Dataset built from matrix data ",
+         "(file-backed datasets have unknown row structure)")
+  if (file.exists(paste0(data$file, ".query")))
+    stop("lgb.cv does not support grouped (ranking) data yet")
+  rows <- readLines(data$file)
+  n <- length(rows)
+  if (nfold < 2L || n < nfold) stop("bad nfold for ", n, " rows")
+  weights <- if (file.exists(paste0(data$file, ".weight")))
+    readLines(paste0(data$file, ".weight")) else NULL
+
+  set.seed(seed)
+  fold_id <- sample(rep_len(seq_len(nfold), n))
+  curves <- list()   # fold -> data.frame(iter, metric, value)
+  boosters <- character(nfold)
+  for (k in seq_len(nfold)) {
+    tr <- which(fold_id != k)
+    va <- which(fold_id == k)
+    trf <- tempfile(fileext = ".csv")
+    vaf <- tempfile(fileext = ".csv")
+    writeLines(rows[tr], trf)
+    writeLines(rows[va], vaf)
+    if (!is.null(weights)) {
+      writeLines(weights[tr], paste0(trf, ".weight"))
+      writeLines(weights[va], paste0(vaf, ".weight"))
+    }
+    model_file <- tempfile(fileext = ".txt")
+    conf <- tempfile(fileext = ".conf")
+    writeLines(c("task = train",
+                 paste0("data = ", trf),
+                 paste0("valid = ", vaf),
+                 paste0("num_iterations = ", as.integer(nrounds)),
+                 paste0("output_model = ", model_file),
+                 "metric_freq = 1",
+                 # eval lines are what lgb.cv parses — verbosity >= 1
+                 # keeps log_evaluation's output flowing
+                 paste0("verbosity = ", max(as.integer(verbose), 1L)),
+                 .lgb_param_lines(data$params),
+                 .lgb_param_lines(params)), conf)
+    out <- suppressWarnings(system2(
+      .lgb_python(), c("-m", "lightgbm_tpu.cli", paste0("config=", conf)),
+      stdout = TRUE, stderr = TRUE))
+    status <- attr(out, "status")
+    if (!is.null(status) && status != 0)
+      stop("lgb.cv fold ", k, " failed:\n",
+           paste(utils::tail(out, 10), collapse = "\n"))
+    curves[[k]] <- .lgb_parse_eval(out)
+    boosters[k] <- model_file
+  }
+
+  metrics <- unique(unlist(lapply(curves, function(d) d$metric)))
+  if (length(metrics) == 0)
+    stop("lgb.cv: no eval lines parsed from the CLI output")
+  record_evals <- list(valid = list())
+  for (m in metrics) {
+    per_fold <- lapply(curves, function(d) {
+      d <- d[d$metric == m, ]
+      d$value[order(d$iter)]
+    })
+    iters <- min(vapply(per_fold, length, integer(1)))
+    mat <- vapply(per_fold, function(v) v[seq_len(iters)],
+                  numeric(iters))
+    if (iters == 1) mat <- matrix(mat, nrow = 1)
+    record_evals$valid[[m]] <- list(
+      eval_mean = rowMeans(mat),
+      eval_stdv = apply(mat, 1, stats::sd))
+  }
+
+  # early stopping on the aggregated mean of the FIRST metric
+  m0 <- metrics[[1]]
+  mean_curve <- record_evals$valid[[m0]]$eval_mean
+  hib <- .lgb_metric_higher_better(m0)
+  best_iter <- if (hib) which.max(mean_curve) else which.min(mean_curve)
+  if (!is.null(early_stopping_rounds)) {
+    es <- as.integer(early_stopping_rounds)
+    for (i in seq_along(mean_curve)) {
+      best_so_far <- if (hib) which.max(mean_curve[seq_len(i)])
+                     else which.min(mean_curve[seq_len(i)])
+      if (i - best_so_far >= es) {
+        best_iter <- best_so_far
+        record_evals$valid <- lapply(record_evals$valid, function(r)
+          list(eval_mean = r$eval_mean[seq_len(i)],
+               eval_stdv = r$eval_stdv[seq_len(i)]))
+        break
+      }
+    }
+  }
+
+  structure(list(record_evals = record_evals,
+                 best_iter = as.integer(best_iter),
+                 best_score = mean_curve[best_iter],
+                 metric = m0,
+                 booster_files = boosters),
+            class = "lgb.CVBooster")
+}
+
+print.lgb.CVBooster <- function(x, ...) {
+  cat("lgb.CVBooster:", length(x$booster_files), "folds, best_iter =",
+      x$best_iter, paste0("(", x$metric, " = ",
+                          signif(x$best_score, 6), ")\n"))
+  invisible(x)
+}

@@ -1,0 +1,60 @@
+# Feature importance from the model text (role of reference
+# R-package/R/lgb.importance.R).
+#
+# The LightGBM v4 model text carries a "feature_importances:" block
+# (split counts, ref: gbdt_model_text.cpp:377 / io/model_io.py:129);
+# gain importances are recomputed from the per-tree split_gain and
+# split_feature lines of the same text, so no framework call is needed.
+
+#' Feature importance table
+#'
+#' @param booster an lgb.Booster.
+#' @return data.frame with Feature, Gain, Frequency (both normalized
+#'   like the reference's percentage = TRUE output).
+lgb.importance <- function(booster) {
+  if (!inherits(booster, "lgb.Booster")) stop("not an lgb.Booster")
+  lines <- strsplit(booster$model_str, "\n")[[1]]
+
+  fn_line <- grep("^feature_names=", lines, value = TRUE)
+  feat_names <- if (length(fn_line))
+    strsplit(sub("^feature_names=", "", fn_line[1]), " ")[[1]]
+  else character(0)
+
+  gains <- numeric(0)
+  freq <- numeric(0)
+  sf_lines <- grep("^split_feature=", lines, value = TRUE)
+  sg_lines <- grep("^split_gain=", lines, value = TRUE)
+  for (i in seq_along(sf_lines)) {
+    feats <- as.integer(strsplit(sub("^split_feature=", "",
+                                     sf_lines[i]), " ")[[1]])
+    gvals <- as.numeric(strsplit(sub("^split_gain=", "",
+                                     sg_lines[i]), " ")[[1]])
+    m <- min(length(feats), length(gvals))
+    for (j in seq_len(m)) {
+      f <- feats[j] + 1L
+      if (length(gains) < f) {
+        length(gains) <- f
+        length(freq) <- f
+      }
+      gains[f] <- sum(gains[f], gvals[j], na.rm = TRUE)
+      freq[f] <- sum(freq[f], 1, na.rm = TRUE)
+    }
+  }
+  gains[is.na(gains)] <- 0
+  freq[is.na(freq)] <- 0
+  nf <- max(length(gains), length(feat_names))
+  length(gains) <- nf
+  length(freq) <- nf
+  gains[is.na(gains)] <- 0
+  freq[is.na(freq)] <- 0
+  if (length(feat_names) < nf)
+    feat_names <- c(feat_names,
+                    paste0("Column_", seq_len(nf))[seq_len(nf) -
+                                                   length(feat_names)])
+  keep <- freq > 0
+  d <- data.frame(Feature = feat_names[seq_len(nf)][keep],
+                  Gain = gains[keep] / max(sum(gains), 1e-300),
+                  Frequency = freq[keep] / max(sum(freq), 1),
+                  stringsAsFactors = FALSE)
+  d[order(-d$Gain), ]
+}

@@ -1,0 +1,20 @@
+# k-fold CV mirroring the reference's R-package/demo/cross_validation.R.
+# Run from the repo root:
+#   Rscript R-package/demo/cross_validation.R
+
+invisible(lapply(list.files("R-package/R", full.names = TRUE), source))
+
+set.seed(1)
+n <- 600
+X <- matrix(rnorm(n * 6), n, 6)
+y <- as.numeric(X[, 1] - 0.5 * X[, 2] * X[, 3] + rnorm(n) * 0.1 > 0)
+
+ds <- lgb.Dataset(X, label = y)
+cv <- lgb.cv(list(objective = "binary", num_leaves = 15,
+                  metric = "binary_logloss", device_type = "cpu"),
+             ds, nrounds = 25, nfold = 3,
+             early_stopping_rounds = 10)
+print(cv)
+stopifnot(cv$best_iter >= 1,
+          length(cv$record_evals$valid$binary_logloss$eval_mean) >= 1)
+cat("cross_validation OK\n")

@@ -93,3 +93,65 @@ def test_r_layer_sources_are_valid_r():
             assert src.count(op) == src.count(cl), (fn, op)
     for name in exported:
         assert f"{name} <- function" in blob, name
+
+
+def test_r_cv_cli_contract(rng, tmp_path):
+    """lgb.cv's contract: per-iteration eval lines on stdout in the
+    log_evaluation format its R regex parses, with metric_freq=1."""
+    import re
+    n, f = 400, 5
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + X[:, 1] > 0).astype(float)
+    # fold files exactly as lgb.cv writes them (row-split label-first CSV)
+    rows = np.column_stack([y, X])
+    trf = tmp_path / "fold_train.csv"
+    vaf = tmp_path / "fold_valid.csv"
+    np.savetxt(trf, rows[: n // 2], delimiter=",")
+    np.savetxt(vaf, rows[n // 2:], delimiter=",")
+    model_file = tmp_path / "cvmodel.txt"
+    conf = tmp_path / "cv.conf"
+    conf.write_text("\n".join([
+        "task = train",
+        f"data = {trf}",
+        f"valid = {vaf}",
+        "num_iterations = 8",
+        f"output_model = {model_file}",
+        "metric_freq = 1",
+        "verbosity = 1",
+        "objective = binary",
+        "metric = binary_logloss",
+        "num_leaves = 7",
+        "min_data_in_leaf = 5",
+        "device_type = cpu",
+    ]) + "\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["LIGHTGBM_TPU_PLATFORM"] = "cpu"
+    out = subprocess.run([sys.executable, "-m", "lightgbm_tpu.cli",
+                          f"config={conf}"], env=env, cwd=REPO,
+                         capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    # the exact regex lgb.cv.R applies (R-package/R/lgb.cv.R)
+    pat = re.compile(r"\[(\d+)\]\s+valid_\d+'s ([^:]+): ([-0-9.eE+naif]+)")
+    hits = [pat.search(ln) for ln in
+            (out.stdout + out.stderr).splitlines()]
+    hits = [h for h in hits if h]
+    iters = sorted({int(h.group(1)) for h in hits})
+    assert iters == list(range(1, 9)), iters
+    vals = [float(h.group(3)) for h in hits]
+    assert all(np.isfinite(v) for v in vals)
+    # the logloss curve should descend overall
+    assert vals[-1] < vals[0]
+
+
+def test_r_new_sources_exported():
+    rdir = os.path.join(REPO, "R-package", "R")
+    blob = ""
+    for fn in os.listdir(rdir):
+        with open(os.path.join(rdir, fn)) as fh:
+            blob += fh.read()
+    for name in ["lgb.cv", "lgb.importance", "print.lgb.CVBooster"]:
+        assert f"{name} <- function" in blob, name
+    demo = os.path.join(REPO, "R-package", "demo")
+    assert os.path.exists(os.path.join(demo, "basic_walkthrough.R"))
+    assert os.path.exists(os.path.join(demo, "cross_validation.R"))
