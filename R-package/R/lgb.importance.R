@@ -49,8 +49,8 @@ lgb.importance <- function(booster) {
   freq[is.na(freq)] <- 0
   if (length(feat_names) < nf)
     feat_names <- c(feat_names,
-                    paste0("Column_", seq_len(nf))[seq_len(nf) -
-                                                   length(feat_names)])
+                    paste0("Column_",
+                           seq.int(length(feat_names) + 1L, nf)))
   keep <- freq > 0
   d <- data.frame(Feature = feat_names[seq_len(nf)][keep],
                   Gain = gains[keep] / max(sum(gains), 1e-300),

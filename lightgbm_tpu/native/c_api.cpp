@@ -13,6 +13,7 @@
 // NumModelPerIteration / PredictForMat / GetLastError), so FFI callers can
 // switch by swapping the shared library. Unimplemented entry points
 // (training, SHAP) return -1 with a descriptive LGBM_GetLastError message.
+#include <algorithm>
 #include <cctype>
 #include <cmath>
 #include <cstdint>
@@ -40,6 +41,10 @@ struct Tree {
   std::vector<int8_t> decision_type;
   std::vector<int> left_child, right_child;
   std::vector<double> leaf_value;
+  // cover weights (needed by SHAP's zero fractions; optional in the
+  // model text — contrib prediction errors without them)
+  std::vector<double> leaf_count;
+  std::vector<double> internal_count;
   std::vector<int64_t> cat_boundaries;
   std::vector<uint32_t> cat_threshold;
   // linear trees (ref: tree.cpp:385 linear block)
@@ -160,6 +165,8 @@ bool ParseTreeBlock(const std::map<std::string, std::string>& kv, Tree* t) {
   if (n < 1) return false;  // an empty/garbled block must not parse
   t->leaf_value = ParseDoubles(get("leaf_value"));
   if (static_cast<int>(t->leaf_value.size()) != n) return false;
+  t->leaf_count = ParseDoubles(get("leaf_count"));
+  t->internal_count = ParseDoubles(get("internal_count"));
   if (ni > 0) {
     auto sf = ParseInts(get("split_feature"));
     t->threshold = ParseDoubles(get("threshold"));
@@ -378,6 +385,10 @@ void TransformRow(const Model& m, double* scores) {
   }
 }
 
+int PredictContribDense(Model* m, const double* X, int64_t nrow,
+                        int32_t ncol, int start_iteration,
+                        int num_iteration, double* out);  // defined below
+
 template <typename FillFn>
 int PredictRows(Model* m, FillFn fill, int64_t nrow, int64_t ncol,
                 int predict_type, int start_iteration, int num_iteration,
@@ -402,9 +413,20 @@ int PredictRows(Model* m, FillFn fill, int64_t nrow, int64_t ncol,
     *out_len = static_cast<int64_t>(nrow) * n_used;
     return 0;
   }
+  if (predict_type == 3) {  // C_API_PREDICT_CONTRIB
+    std::vector<double> X(static_cast<size_t>(nrow) * ncol);
+    for (int64_t r = 0; r < nrow; ++r) fill(r, X.data() + r * ncol);
+    if (PredictContribDense(m, X.data(), nrow,
+                            static_cast<int32_t>(ncol),
+                            start_iteration, num_iteration,
+                            out_result) != 0)
+      return -1;
+    *out_len = nrow * static_cast<int64_t>(m->max_feature_idx + 2) * K;
+    return 0;
+  }
   if (predict_type != 0 && predict_type != 1) {
-    SetError("predict_type must be 0 (normal), 1 (raw) or 2 (leaf index); "
-             "SHAP contributions are available via the Python API");
+    SetError("predict_type must be 0 (normal), 1 (raw), 2 (leaf index) "
+             "or 3 (contrib)");
     return -1;
   }
   int n_iter_used = end_iter - start_iteration;
@@ -434,6 +456,139 @@ inline void FillRow(const void* data, int data_type, int64_t r, int32_t ncol,
     for (int32_t c = 0; c < ncol; ++c)
       row[c] = is_row_major ? d[r * ncol + c] : d[c * nrow + r];
   }
+}
+
+int g_max_threads = -1;  // LGBM_SetMaxThreads; -1 = hardware default
+void (*g_log_callback)(const char*) = nullptr;
+
+// frozen single-row prediction setup (ref: c_api.h FastConfigHandle)
+struct FastConfig {
+  Model* model;
+  int predict_type;
+  int start_iteration;
+  int num_iteration;
+  int data_type;
+  int64_t ncol;
+};
+
+// ---- SHAP contributions (predict_type 3) --------------------------------
+// Bridges the serving trees to the native TreeSHAP kernel
+// (native/shap.cpp — the reference's kPredictContrib path,
+// src/application/predictor.hpp:31).
+
+double SubtreeW(const Tree& t, int node) {
+  if (node < 0) {
+    size_t i = static_cast<size_t>(~node);
+    return i < t.leaf_count.size() ? t.leaf_count[i] : 0.0;
+  }
+  size_t i = static_cast<size_t>(node);
+  return i < t.internal_count.size() ? t.internal_count[i] : 0.0;
+}
+
+double ExpectedValue(const Tree& t, int node) {
+  if (node < 0) return t.leaf_value[~node];
+  double lw = SubtreeW(t, t.left_child[node]);
+  double rw = SubtreeW(t, t.right_child[node]);
+  double tot = lw + rw;
+  if (tot <= 0) return 0.0;
+  return (lw * ExpectedValue(t, t.left_child[node]) +
+          rw * ExpectedValue(t, t.right_child[node])) / tot;
+}
+
+struct ShapTreeArrays {
+  std::vector<int32_t> split_feature, decision_type, left_child,
+      right_child, cat_boundaries;
+  std::vector<double> threshold, leaf_value, leaf_count, internal_count;
+  std::vector<uint32_t> cat_threshold;
+};
+
+void ToShapArrays(const Tree& t, ShapTreeArrays* a) {
+  int ni = t.num_leaves - 1;
+  a->split_feature.assign(t.split_feature.begin(), t.split_feature.end());
+  a->decision_type.resize(ni);
+  for (int i = 0; i < ni; ++i)
+    a->decision_type[i] = static_cast<int32_t>(t.decision_type[i]);
+  a->left_child.assign(t.left_child.begin(), t.left_child.end());
+  a->right_child.assign(t.right_child.begin(), t.right_child.end());
+  a->threshold = t.threshold;
+  a->leaf_value = t.leaf_value;
+  a->leaf_count = t.leaf_count;
+  a->internal_count = t.internal_count;
+  a->cat_boundaries.assign(t.cat_boundaries.begin(),
+                           t.cat_boundaries.end());
+  a->cat_threshold = t.cat_threshold;
+}
+
+}  // namespace
+
+// native/shap.cpp kernel (same shared library)
+extern "C" int lgbm_tree_shap_batch(
+    const int32_t* split_feature, const double* threshold_real,
+    const int32_t* decision_type, const int32_t* left_child,
+    const int32_t* right_child, const double* leaf_value,
+    const double* leaf_count, const double* internal_count,
+    int32_t n_int, const int32_t* cat_boundaries,
+    const uint32_t* cat_threshold, int32_t num_cat, int32_t n_cat_words,
+    const double* X, int64_t nrow, int32_t ncol, double* out,
+    int64_t out_stride, int32_t nthreads);
+
+namespace {
+
+// dense SHAP contributions over pre-materialized f64 rows:
+// out[r, k*(F+1) + f], bias column gets the per-tree expected value
+int PredictContribDense(Model* m, const double* X, int64_t nrow,
+                        int32_t ncol, int start_iteration,
+                        int num_iteration, double* out) {
+  int total_iter = m->NumIterations();
+  int end_iter = (num_iteration <= 0)
+                     ? total_iter
+                     : std::min(total_iter,
+                                start_iteration + num_iteration);
+  int K = m->num_tree_per_iteration;
+  int F = m->max_feature_idx + 1;
+  if (ncol < F) {
+    SetError("pred_contrib: input has fewer columns than the model");
+    return -1;
+  }
+  int64_t stride = static_cast<int64_t>(F + 1) * K;
+  std::memset(out, 0, sizeof(double) * nrow * stride);
+  ShapTreeArrays a;
+  for (int it = start_iteration; it < end_iter; ++it) {
+    for (int k = 0; k < K; ++k) {
+      const Tree& t = m->trees[it * K + k];
+      int ni = t.num_leaves - 1;
+      double* base = out + static_cast<int64_t>(k) * (F + 1);
+      if (t.num_leaves <= 1) {
+        for (int64_t r = 0; r < nrow; ++r)
+          base[r * stride + F] += t.leaf_value.empty()
+                                      ? 0.0 : t.leaf_value[0];
+        continue;
+      }
+      if (static_cast<int>(t.leaf_count.size()) < t.num_leaves ||
+          static_cast<int>(t.internal_count.size()) < ni) {
+        SetError("pred_contrib needs leaf_count/internal_count in the "
+                 "model text (absent in this model)");
+        return -1;
+      }
+      ToShapArrays(t, &a);
+      int rc = lgbm_tree_shap_batch(
+          a.split_feature.data(), a.threshold.data(),
+          a.decision_type.data(), a.left_child.data(),
+          a.right_child.data(), a.leaf_value.data(),
+          a.leaf_count.data(), a.internal_count.data(), ni,
+          t.num_cat > 0 ? a.cat_boundaries.data() : nullptr,
+          t.num_cat > 0 ? a.cat_threshold.data() : nullptr,
+          t.num_cat, static_cast<int32_t>(a.cat_threshold.size()), X,
+          nrow, ncol, base, stride, g_max_threads);
+      if (rc != 0) {
+        SetError("tree SHAP kernel failed");
+        return -1;
+      }
+      double ev = ExpectedValue(t, 0);
+      for (int64_t r = 0; r < nrow; ++r) base[r * stride + F] += ev;
+    }
+  }
+  return 0;
 }
 
 }  // namespace
@@ -790,6 +945,478 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
   };
   return PredictRows(m, fill, nrow, num_col, predict_type,
                      start_iteration, num_iteration, out_len, out_result);
+}
+
+// ---- CSC / multi-matrix prediction -------------------------------------
+
+int LGBM_BoosterPredictForCSC(BoosterHandle handle, const void* col_ptr,
+                              int col_ptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char* parameter, int64_t* out_len,
+                              double* out_result) {
+  // ref: c_api.h:394 family — column-compressed input; transposed once
+  // into per-row (col, value) lists, then the shared row predictor
+  (void)parameter;
+  if (LgbmTrainOwns(handle)) {
+    SetError("PredictForCSC on a training handle: save the model and "
+             "load it through a serving handle");
+    return -1;
+  }
+  Model* m = static_cast<Model*>(handle);
+  if (data_type != 0 && data_type != 1) {
+    SetError("only float32 (0) / float64 (1) data are supported");
+    return -1;
+  }
+  if (col_ptr_type != 2 && col_ptr_type != 3) {
+    SetError("col_ptr_type must be int32 (2) or int64 (3)");
+    return -1;
+  }
+  int64_t ncol = ncol_ptr - 1;
+  if (ncol < m->max_feature_idx + 1) {
+    SetError("input has fewer columns than the model's features");
+    return -1;
+  }
+  auto ptr_at = [&](int64_t i) -> int64_t {
+    return col_ptr_type == 2
+               ? static_cast<const int32_t*>(col_ptr)[i]
+               : static_cast<const int64_t*>(col_ptr)[i];
+  };
+  // CSC -> CSR transpose (counts, prefix, scatter)
+  std::vector<int64_t> rptr(num_row + 1, 0);
+  for (int64_t k = 0; k < nelem; ++k)
+    if (indices[k] >= 0 && indices[k] < num_row) ++rptr[indices[k] + 1];
+  for (int64_t r = 0; r < num_row; ++r) rptr[r + 1] += rptr[r];
+  std::vector<int32_t> rcol(static_cast<size_t>(nelem));
+  std::vector<double> rval(static_cast<size_t>(nelem));
+  std::vector<int64_t> cur(rptr.begin(), rptr.end() - 1);
+  for (int64_t c = 0; c < ncol; ++c) {
+    for (int64_t k = ptr_at(c); k < ptr_at(c + 1); ++k) {
+      int64_t r = indices[k];
+      if (r < 0 || r >= num_row) continue;
+      double v = data_type == 0 ? static_cast<const float*>(data)[k]
+                                : static_cast<const double*>(data)[k];
+      rcol[cur[r]] = static_cast<int32_t>(c);
+      rval[cur[r]] = v;
+      ++cur[r];
+    }
+  }
+  auto fill = [&](int64_t r, double* row) {
+    for (int64_t c = 0; c < ncol; ++c) row[c] = 0.0;
+    for (int64_t k = rptr[r]; k < rptr[r + 1]; ++k) row[rcol[k]] = rval[k];
+  };
+  return PredictRows(m, fill, num_row, ncol, predict_type,
+                     start_iteration, num_iteration, out_len, out_result);
+}
+
+int LGBM_BoosterPredictForMats(BoosterHandle handle, const void** data,
+                               int data_type, int32_t nrow, int32_t ncol,
+                               int predict_type, int start_iteration,
+                               int num_iteration, const char* parameter,
+                               int64_t* out_len, double* out_result) {
+  // ref: c_api.h PredictForMats — array of row pointers
+  (void)parameter;
+  if (LgbmTrainOwns(handle)) {
+    SetError("PredictForMats on a training handle: save the model and "
+             "load it through a serving handle");
+    return -1;
+  }
+  Model* m = static_cast<Model*>(handle);
+  if (data_type != 0 && data_type != 1) {
+    SetError("only float32 (0) / float64 (1) data are supported");
+    return -1;
+  }
+  auto fill = [&](int64_t r, double* row) {
+    if (data_type == 0) {
+      const float* d = static_cast<const float*>(data[r]);
+      for (int32_t c = 0; c < ncol; ++c) row[c] = d[c];
+    } else {
+      const double* d = static_cast<const double*>(data[r]);
+      for (int32_t c = 0; c < ncol; ++c) row[c] = d[c];
+    }
+  };
+  return PredictRows(m, fill, nrow, ncol, predict_type, start_iteration,
+                     num_iteration, out_len, out_result);
+}
+
+// ---- single-row fast paths (ref: c_api.h:1211-1428) --------------------
+// A FastConfig freezes (model, predict type, iteration range, layout) so
+// per-row calls skip all setup. Prediction state is call-local, so Fast
+// calls are thread-safe (ref precedent: tests/cpp_tests/test_single_row
+// .cpp exercises concurrent single-row prediction).
+
+typedef void* FastConfigHandle;
+
+int LGBM_BoosterPredictForMatSingleRowFastInit(
+    BoosterHandle handle, const int predict_type,
+    const int start_iteration, const int num_iteration,
+    const int data_type, const int32_t ncol, const char* parameter,
+    FastConfigHandle* out_fastConfig) {
+  (void)parameter;
+  if (LgbmTrainOwns(handle)) {
+    SetError("SingleRowFastInit on a training handle: save the model "
+             "and load it through a serving handle");
+    return -1;
+  }
+  if (!out_fastConfig || (data_type != 0 && data_type != 1)) {
+    SetError("SingleRowFastInit: bad arguments");
+    return -1;
+  }
+  auto* fc = new FastConfig{static_cast<Model*>(handle), predict_type,
+                            start_iteration, num_iteration, data_type,
+                            ncol};
+  *out_fastConfig = fc;
+  return 0;
+}
+
+int LGBM_BoosterPredictForMatSingleRowFast(FastConfigHandle fastConfig,
+                                           const void* data,
+                                           int64_t* out_len,
+                                           double* out_result) {
+  auto* fc = static_cast<FastConfig*>(fastConfig);
+  if (!fc || !data || !out_len || !out_result) {
+    SetError("SingleRowFast: bad arguments");
+    return -1;
+  }
+  auto fill = [&](int64_t, double* row) {
+    FillRow(data, fc->data_type, 0, static_cast<int32_t>(fc->ncol), 1, 1,
+            row);
+  };
+  return PredictRows(fc->model, fill, 1, fc->ncol, fc->predict_type,
+                     fc->start_iteration, fc->num_iteration, out_len,
+                     out_result);
+}
+
+int LGBM_BoosterPredictForCSRSingleRow(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int predict_type,
+    int start_iteration, int num_iteration, const char* parameter,
+    int64_t* out_len, double* out_result) {
+  return LGBM_BoosterPredictForCSR(handle, indptr, indptr_type, indices,
+                                   data, data_type, nindptr, nelem,
+                                   num_col, predict_type,
+                                   start_iteration, num_iteration,
+                                   parameter, out_len, out_result);
+}
+
+int LGBM_BoosterPredictForCSRSingleRowFastInit(
+    BoosterHandle handle, const int predict_type,
+    const int start_iteration, const int num_iteration,
+    const int data_type, const int64_t num_col, const char* parameter,
+    FastConfigHandle* out_fastConfig) {
+  return LGBM_BoosterPredictForMatSingleRowFastInit(
+      handle, predict_type, start_iteration, num_iteration, data_type,
+      static_cast<int32_t>(num_col), parameter, out_fastConfig);
+}
+
+int LGBM_BoosterPredictForCSRSingleRowFast(
+    FastConfigHandle fastConfig, const void* indptr,
+    const int indptr_type, const int32_t* indices, const void* data,
+    const int64_t nindptr, const int64_t nelem, int64_t* out_len,
+    double* out_result) {
+  auto* fc = static_cast<FastConfig*>(fastConfig);
+  if (!fc || !indptr || !out_len || !out_result) {
+    SetError("CSRSingleRowFast: bad arguments");
+    return -1;
+  }
+  return LGBM_BoosterPredictForCSR(
+      fc->model, indptr, indptr_type, indices, data, fc->data_type,
+      nindptr, nelem, fc->ncol, fc->predict_type, fc->start_iteration,
+      fc->num_iteration, "", out_len, out_result);
+}
+
+int LGBM_FastConfigFree(FastConfigHandle fastConfig) {
+  delete static_cast<FastConfig*>(fastConfig);
+  return 0;
+}
+
+// ---- sparse-output contrib (ref: c_api.h:1117) -------------------------
+
+int LGBM_BoosterPredictSparseOutput(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col_or_row,
+    int predict_type, int start_iteration, int num_iteration,
+    const char* parameter, int matrix_type, int64_t* out_len,
+    void** out_indptr, int32_t** out_indices, void** out_data) {
+  (void)parameter;
+  (void)nelem;
+  if (LgbmTrainOwns(handle)) {
+    SetError("PredictSparseOutput on a training handle: save the model "
+             "and load it through a serving handle");
+    return -1;
+  }
+  if (predict_type != 3) {
+    SetError("PredictSparseOutput supports only feature contributions "
+             "(predict_type=3)");
+    return -1;
+  }
+  if (matrix_type != 0) {  // C_API_MATRIX_TYPE_CSR
+    SetError("PredictSparseOutput: only CSR matrix_type (0) is "
+             "supported");
+    return -1;
+  }
+  if (indptr_type != 2 && indptr_type != 3) {
+    SetError("indptr_type must be int32 (2) or int64 (3)");
+    return -1;
+  }
+  Model* m = static_cast<Model*>(handle);
+  int64_t nrow = nindptr - 1;
+  int64_t ncol = num_col_or_row;
+  int K = m->num_tree_per_iteration;
+  int F = m->max_feature_idx + 1;
+  auto ptr_at = [&](int64_t i) -> int64_t {
+    return indptr_type == 2
+               ? static_cast<const int32_t*>(indptr)[i]
+               : static_cast<const int64_t*>(indptr)[i];
+  };
+  std::vector<double> X(static_cast<size_t>(nrow) * ncol, 0.0);
+  for (int64_t r = 0; r < nrow; ++r)
+    for (int64_t k = ptr_at(r); k < ptr_at(r + 1); ++k)
+      if (indices[k] >= 0 && indices[k] < ncol) {
+        double v = data_type == 0 ? static_cast<const float*>(data)[k]
+                                  : static_cast<const double*>(data)[k];
+        X[r * ncol + indices[k]] = v;
+      }
+  int64_t stride = static_cast<int64_t>(F + 1) * K;
+  std::vector<double> dense(static_cast<size_t>(nrow) * stride);
+  if (PredictContribDense(m, X.data(), nrow, static_cast<int32_t>(ncol),
+                          start_iteration, num_iteration,
+                          dense.data()) != 0)
+    return -1;
+  // compress nonzeros row-wise; output rows are nrow*K "class rows" of
+  // width F+1 (reference sparse-contrib layout)
+  int64_t out_rows = nrow * K;
+  std::vector<int64_t> iptr(out_rows + 1, 0);
+  int64_t nnz = 0;
+  for (int64_t r = 0; r < nrow; ++r)
+    for (int k = 0; k < K; ++k) {
+      const double* row = dense.data() + r * stride +
+                          static_cast<int64_t>(k) * (F + 1);
+      for (int f = 0; f <= F; ++f)
+        if (row[f] != 0.0) ++nnz;
+      iptr[r * K + k + 1] = nnz;
+    }
+  // output indptr matches the INPUT indptr type (reference ABI)
+  void* o_iptr = nullptr;
+  if (indptr_type == 2) {
+    auto* p32 = static_cast<int32_t*>(
+        std::malloc(sizeof(int32_t) * (out_rows + 1)));
+    if (p32)
+      for (int64_t i = 0; i <= out_rows; ++i)
+        p32[i] = static_cast<int32_t>(iptr[i]);
+    o_iptr = p32;
+  } else {
+    auto* p64 = static_cast<int64_t*>(
+        std::malloc(sizeof(int64_t) * (out_rows + 1)));
+    if (p64)
+      std::memcpy(p64, iptr.data(), sizeof(int64_t) * (out_rows + 1));
+    o_iptr = p64;
+  }
+  auto* o_idx = static_cast<int32_t*>(
+      std::malloc(sizeof(int32_t) * std::max<int64_t>(nnz, 1)));
+  auto* o_val = static_cast<double*>(
+      std::malloc(sizeof(double) * std::max<int64_t>(nnz, 1)));
+  if (!o_iptr || !o_idx || !o_val) {
+    std::free(o_iptr);
+    std::free(o_idx);
+    std::free(o_val);
+    SetError("PredictSparseOutput: allocation failed");
+    return -1;
+  }
+  int64_t w = 0;
+  for (int64_t r = 0; r < nrow; ++r)
+    for (int k = 0; k < K; ++k) {
+      const double* row = dense.data() + r * stride +
+                          static_cast<int64_t>(k) * (F + 1);
+      for (int f = 0; f <= F; ++f)
+        if (row[f] != 0.0) {
+          o_idx[w] = f;
+          o_val[w] = row[f];
+          ++w;
+        }
+    }
+  out_len[0] = nnz;            // data / indices length
+  out_len[1] = out_rows + 1;   // indptr length
+  *out_indptr = o_iptr;
+  *out_indices = o_idx;
+  *out_data = o_val;
+  return 0;
+}
+
+int LGBM_BoosterFreePredictSparse(void* indptr, int32_t* indices,
+                                  void* data, int indptr_type,
+                                  int data_type) {
+  (void)indptr_type;
+  (void)data_type;
+  std::free(indptr);
+  std::free(indices);
+  std::free(data);
+  return 0;
+}
+
+// ---- model bounds / introspection --------------------------------------
+
+int LGBM_BoosterGetLowerBoundValue(BoosterHandle handle,
+                                   double* out_results) {
+  // ref: gbdt.h GetLowerBoundValue — sum of per-tree minimum leaf
+  if (LgbmTrainOwns(handle)) {
+    SetError("GetLowerBoundValue: use a serving handle");
+    return -1;
+  }
+  Model* m = static_cast<Model*>(handle);
+  double s = 0.0;
+  for (const Tree& t : m->trees) {
+    double mn = t.leaf_value.empty() ? 0.0 : t.leaf_value[0];
+    for (double v : t.leaf_value) mn = std::min(mn, v);
+    s += mn;
+  }
+  *out_results = s;
+  return 0;
+}
+
+int LGBM_BoosterGetUpperBoundValue(BoosterHandle handle,
+                                   double* out_results) {
+  if (LgbmTrainOwns(handle)) {
+    SetError("GetUpperBoundValue: use a serving handle");
+    return -1;
+  }
+  Model* m = static_cast<Model*>(handle);
+  double s = 0.0;
+  for (const Tree& t : m->trees) {
+    double mx = t.leaf_value.empty() ? 0.0 : t.leaf_value[0];
+    for (double v : t.leaf_value) mx = std::max(mx, v);
+    s += mx;
+  }
+  *out_results = s;
+  return 0;
+}
+
+int LgbmTrainBoosterGetLinear(void* handle, int* out);
+
+int LGBM_BoosterGetLinear(BoosterHandle handle, int* out) {
+  if (LgbmTrainOwns(handle))
+    return LgbmTrainBoosterGetLinear(handle, out);
+  Model* m = static_cast<Model*>(handle);
+  int lin = 0;
+  for (const Tree& t : m->trees)
+    if (t.is_linear) lin = 1;
+  *out = lin;
+  return 0;
+}
+
+int LGBM_BoosterValidateFeatureNames(BoosterHandle handle,
+                                     const char** data_names,
+                                     int data_num_features) {
+  // ref: c_api.h:935 — error when names don't match the training names
+  if (LgbmTrainOwns(handle)) {
+    SetError("ValidateFeatureNames: use a serving handle");
+    return -1;
+  }
+  Model* m = static_cast<Model*>(handle);
+  int n_model = static_cast<int>(m->feature_names.size());
+  if (n_model && data_num_features != n_model) {
+    SetError("feature count mismatch: model has " +
+             std::to_string(n_model) + ", data has " +
+             std::to_string(data_num_features));
+    return -1;
+  }
+  for (int i = 0; i < n_model && data_names; ++i) {
+    if (!data_names[i] || m->feature_names[i] != data_names[i]) {
+      SetError("feature name mismatch at index " + std::to_string(i) +
+               ": model '" + m->feature_names[i] + "' vs data '" +
+               (data_names[i] ? data_names[i] : "<null>") + "'");
+      return -1;
+    }
+  }
+  return 0;
+}
+
+// ---- process-level utilities -------------------------------------------
+
+int LGBM_SetLastError(const char* msg) {
+  SetError(msg ? msg : "");
+  return 0;
+}
+
+int LGBM_SetMaxThreads(int num_threads) {
+  g_max_threads = num_threads;
+  return 0;
+}
+
+int LGBM_GetMaxThreads(int* out) {
+  if (!out) return -1;
+  *out = g_max_threads;
+  return 0;
+}
+
+int LGBM_RegisterLogCallback(void (*callback)(const char*)) {
+  // ref: c_api.h:82 — the training backend also routes the embedded
+  // interpreter's logger into this callback (c_api_train.cpp)
+  g_log_callback = callback;
+  return 0;
+}
+
+// internal accessor for the training backend's logger bridge
+void* LgbmGetLogCallback() {
+  return reinterpret_cast<void*>(g_log_callback);
+}
+
+int LGBM_GetSampleCount(int32_t num_total_row, const char* parameters,
+                        int* out) {
+  // ref: c_api.cpp LGBM_GetSampleCount — min(bin_construct_sample_cnt,
+  // num_total_row)
+  if (!out) {
+    SetError("GetSampleCount: null out");
+    return -1;
+  }
+  int cnt = 200000;  // config.h bin_construct_sample_cnt default
+  if (parameters) {
+    std::string ps(parameters);
+    auto pos = ps.find("bin_construct_sample_cnt=");
+    if (pos != std::string::npos)
+      cnt = std::atoi(ps.c_str() + pos + 25);
+  }
+  *out = std::min<int32_t>(cnt, num_total_row);
+  return 0;
+}
+
+int LGBM_SampleIndices(int32_t num_total_row, const char* parameters,
+                       void* out, int32_t* out_len) {
+  // ref: c_api.cpp LGBM_SampleIndices — Random(seed).Sample sorted
+  // unique indices
+  if (!out || !out_len) {
+    SetError("SampleIndices: null out");
+    return -1;
+  }
+  int cnt = 0;
+  if (LGBM_GetSampleCount(num_total_row, parameters, &cnt) != 0)
+    return -1;
+  int seed = 1;  // config.h data_random_seed default
+  if (parameters) {
+    std::string ps(parameters);
+    auto pos = ps.find("data_random_seed=");
+    if (pos != std::string::npos)
+      seed = std::atoi(ps.c_str() + pos + 17);
+  }
+  // reservoir-free uniform sample without replacement, then sort —
+  // selection probability matches the reference's Random::Sample
+  std::vector<int32_t> idx(num_total_row);
+  for (int32_t i = 0; i < num_total_row; ++i) idx[i] = i;
+  uint64_t st = static_cast<uint64_t>(seed) * 6364136223846793005ULL + 1;
+  for (int32_t i = 0; i < cnt && i < num_total_row; ++i) {
+    st = st * 6364136223846793005ULL + 1442695040888963407ULL;
+    int32_t j = i + static_cast<int32_t>((st >> 33) %
+                                         (num_total_row - i));
+    std::swap(idx[i], idx[j]);
+  }
+  std::sort(idx.begin(), idx.begin() + cnt);
+  std::memcpy(out, idx.data(), sizeof(int32_t) * cnt);
+  *out_len = cnt;
+  return 0;
 }
 
 }  // extern "C"

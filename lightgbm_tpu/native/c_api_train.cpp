@@ -14,6 +14,7 @@
 // usage the surface targets.
 #include <dlfcn.h>
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -96,6 +97,35 @@ bool EnsurePython() {
 // Run `body` (python statements operating on _lgbm_capi) under the
 // error-capture harness. Returns 0 on success, -1 with the python
 // exception message in the shared error slot otherwise.
+}  // namespace
+extern "C" void* LgbmGetLogCallback();  // c_api.cpp
+namespace {
+
+// route the framework's python logger into a registered C callback
+// (ref: c_api.h:82 LGBM_RegisterLogCallback). Synced lazily: the
+// bridge re-registers whenever the callback pointer changes.
+void SyncLogCallback() {
+  static void* synced = nullptr;
+  void* cb = LgbmGetLogCallback();
+  if (cb == synced) return;
+  synced = cb;
+  if (!cb) {
+    g_pyrun("import lightgbm_tpu as _l\n_l.register_logger(None)\n");
+    return;
+  }
+  char buf[512];
+  std::snprintf(buf, sizeof(buf),
+                "import ctypes as _ct2\n"
+                "import lightgbm_tpu as _l\n"
+                "_lgbm_logcb = _ct2.CFUNCTYPE(None, _ct2.c_char_p)"
+                "(%llu)\n"
+                "_l.register_logger("
+                "lambda m: _lgbm_logcb(str(m).encode()))\n",
+                static_cast<unsigned long long>(
+                    reinterpret_cast<uintptr_t>(cb)));
+  g_pyrun(buf);
+}
+
 int RunGuarded(const std::string& body) {
   // serialize embedded-interpreter entry: the training ABI is documented
   // single-threaded, but a stray concurrent call must not corrupt the
@@ -103,6 +133,7 @@ int RunGuarded(const std::string& body) {
   static std::mutex mu;
   std::lock_guard<std::mutex> lk(mu);
   if (!EnsurePython()) return -1;
+  SyncLogCallback();
   static int rc_slot;
   static char err_slot[4096];
   rc_slot = -9;
@@ -1070,6 +1101,1029 @@ int LgbmTrainBoosterPredictForFile(void* handle,
       "        f.write('\\t'.join(repr(float(v)) for v in row) + "
       "'\\n')\n";
   return RunGuarded(body);
+}
+
+}  // extern "C"
+
+// ===================================================================
+// Wave 2: dataset creation (CSC / mats / streaming), dataset ops,
+// booster introspection, network init (ref: c_api.h:154-332, :394,
+// :440, :491-686, :731-779, :1655-1682).
+// ===================================================================
+
+namespace {
+
+// C-side byte buffer (ref: ByteBufferHandle, utils/byte_buffer.h)
+struct ByteBuf {
+  std::vector<uint8_t> data;
+};
+
+// emit python that binds a C buffer as a numpy array named `var`
+std::string NpFromBuf(const std::string& var, const void* ptr,
+                      const char* ct, int64_t n) {
+  return var + " = _np.ctypeslib.as_array((" + ct + " * " +
+         std::to_string(n) + ").from_address(" + Addr(ptr) + ")).copy()\n";
+}
+
+const char* CtOf(int data_type) {
+  return data_type == 0   ? "_ct.c_float"
+         : data_type == 1 ? "_ct.c_double"
+         : data_type == 2 ? "_ct.c_int32"
+                          : "_ct.c_int64";
+}
+
+}  // namespace
+
+extern "C" {
+
+int LGBM_DatasetCreateFromCSC(const void* col_ptr, int col_ptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t ncol_ptr,
+                              int64_t nelem, int64_t num_row,
+                              const char* parameters,
+                              const void* reference, void** out) {
+  // ref: c_api.h:394 — the column-compressed ingestion path. The
+  // matrix stays SPARSE (scipy csc) so wide-sparse data can engage
+  // multi-value storage exactly like the Python API's scipy path.
+  (void)reference;
+  if (!col_ptr || !indices || !out) {
+    LgbmTrainSetError("DatasetCreateFromCSC: null argument");
+    return -1;
+  }
+  if ((data_type != 0 && data_type != 1) ||
+      (col_ptr_type != 2 && col_ptr_type != 3)) {
+    LgbmTrainSetError("DatasetCreateFromCSC: bad dtype codes");
+    return -1;
+  }
+  TrainHandle* h = NewHandle(false);
+  std::string body =
+      NpFromBuf("cp", col_ptr, CtOf(col_ptr_type), ncol_ptr) +
+      NpFromBuf("ci", indices, "_ct.c_int32", nelem) +
+      NpFromBuf("cd", data, CtOf(data_type), nelem) +
+      "import scipy.sparse as _sp\n" +
+      "m = _sp.csc_matrix((cd.astype(_np.float64), ci, cp), shape=(" +
+      std::to_string(num_row) + ", " + std::to_string(ncol_ptr - 1) +
+      "))\n" +
+      ParamsDict(parameters) +
+      "_lgbm_capi['obj'][" + std::to_string(h->id) +
+      "] = {'X': m.tocsr(), 'params': p, 'fields': {}}\n";
+  if (RunGuarded(body) != 0) {
+    DropHandle(h);
+    return -1;
+  }
+  *out = h;
+  return 0;
+}
+
+int LGBM_DatasetCreateFromMats(int32_t nmat, const void** data,
+                               int data_type, int32_t* nrow,
+                               int32_t ncol, int* is_row_major,
+                               const char* parameters,
+                               const void* reference, void** out) {
+  // ref: c_api.h:440 — vertically stacked dense blocks
+  (void)reference;
+  if (!data || !nrow || !is_row_major || !out || nmat <= 0) {
+    LgbmTrainSetError("DatasetCreateFromMats: null argument");
+    return -1;
+  }
+  if (data_type != 0 && data_type != 1) {
+    LgbmTrainSetError("DatasetCreateFromMats: bad dtype");
+    return -1;
+  }
+  TrainHandle* h = NewHandle(false);
+  std::string body = "blocks = []\n";
+  for (int32_t i = 0; i < nmat; ++i) {
+    body += NpFromBuf("b", data[i], CtOf(data_type),
+                      static_cast<int64_t>(nrow[i]) * ncol) +
+            (is_row_major[i]
+                 ? "b = b.reshape(" + std::to_string(nrow[i]) + ", " +
+                       std::to_string(ncol) + ")\n"
+                 : "b = b.reshape(" + std::to_string(ncol) + ", " +
+                       std::to_string(nrow[i]) + ").T.copy()\n") +
+            "blocks.append(b.astype(_np.float64))\n";
+  }
+  body += ParamsDict(parameters) +
+          "_lgbm_capi['obj'][" + std::to_string(h->id) +
+          "] = {'X': _np.concatenate(blocks, axis=0), 'params': p, "
+          "'fields': {}}\n";
+  if (RunGuarded(body) != 0) {
+    DropHandle(h);
+    return -1;
+  }
+  *out = h;
+  return 0;
+}
+
+// ---- streaming creation (ref: c_api.h:154-332; the SynapseML path) ----
+// A streaming dataset preallocates its row buffer; PushRows* fill row
+// ranges (metadata rides along); MarkFinished seals it. Binning then
+// happens at training time over the FULL pushed data — a superset of
+// the reference's sample-based binning (bin boundaries come from all
+// rows instead of the sample, every other semantic identical).
+
+int LGBM_DatasetCreateFromSampledColumn(double** sample_data,
+                                        int** sample_indices,
+                                        int32_t ncol,
+                                        const int* num_per_col,
+                                        int32_t num_sample_row,
+                                        int32_t num_local_row,
+                                        int64_t num_dist_row,
+                                        const char* parameters,
+                                        void** out) {
+  // the sample defines the SCHEMA (ncol); rows arrive via PushRows
+  (void)sample_data;
+  (void)sample_indices;
+  (void)num_per_col;
+  (void)num_sample_row;
+  (void)num_dist_row;
+  if (!out || ncol <= 0 || num_local_row < 0) {
+    LgbmTrainSetError("DatasetCreateFromSampledColumn: bad arguments");
+    return -1;
+  }
+  TrainHandle* h = NewHandle(false);
+  std::string body =
+      ParamsDict(parameters) +
+      "_lgbm_capi['obj'][" + std::to_string(h->id) +
+      "] = {'X': _np.zeros((" + std::to_string(num_local_row) + ", " +
+      std::to_string(ncol) + ")), 'params': p, 'fields': {}, "
+      "'stream': {'total': " + std::to_string(num_local_row) +
+      ", 'pushed': 0, 'finished': False, 'manual_finish': False, "
+      "'nclasses': 1}}\n";
+  if (RunGuarded(body) != 0) {
+    DropHandle(h);
+    return -1;
+  }
+  *out = h;
+  return 0;
+}
+
+int LGBM_DatasetCreateByReference(const void* reference,
+                                  int64_t num_total_row, void** out) {
+  TrainHandle* r = AsTrainHandle(const_cast<void*>(reference));
+  if (!r || r->is_booster || !out) {
+    LgbmTrainSetError("DatasetCreateByReference: bad reference handle");
+    return -1;
+  }
+  TrainHandle* h = NewHandle(false);
+  std::string body =
+      "ref = _lgbm_capi['obj'][" + std::to_string(r->id) + "]\n" +
+      "f = ref['X'].shape[1]\n" +
+      "_lgbm_capi['obj'][" + std::to_string(h->id) +
+      "] = {'X': _np.zeros((" + std::to_string(num_total_row) +
+      ", f)), 'params': dict(ref['params']), 'fields': {}, "
+      "'stream': {'total': " + std::to_string(num_total_row) +
+      ", 'pushed': 0, 'finished': False, 'manual_finish': False, "
+      "'nclasses': 1}}\n";
+  if (RunGuarded(body) != 0) {
+    DropHandle(h);
+    return -1;
+  }
+  *out = h;
+  return 0;
+}
+
+int LGBM_DatasetInitStreaming(void* dataset, int32_t has_weights,
+                              int32_t has_init_scores,
+                              int32_t has_queries, int32_t nclasses,
+                              int32_t nthreads,
+                              int32_t omp_max_threads) {
+  (void)nthreads;
+  (void)omp_max_threads;
+  TrainHandle* h = AsTrainHandle(dataset);
+  if (!h || h->is_booster) {
+    LgbmTrainSetError("DatasetInitStreaming: bad handle");
+    return -1;
+  }
+  std::string body =
+      "d = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n" +
+      "st = d.setdefault('stream', {'total': d['X'].shape[0], "
+      "'pushed': 0, 'finished': False, 'manual_finish': False})\n" +
+      "st['nclasses'] = max(" + std::to_string(nclasses) + ", 1)\n" +
+      "n = st['total']\n" +
+      "d['fields']['label'] = _np.zeros(n, _np.float32)\n" +
+      (has_weights ? "d['fields']['weight'] = _np.zeros(n, _np.float32)\n"
+                   : "") +
+      (has_init_scores
+           ? "d['fields']['init_score'] = _np.zeros(n * st['nclasses'])\n"
+           : "") +
+      (has_queries
+           ? "d['fields']['qid_raw'] = _np.zeros(n, _np.int32)\n"
+           : "");
+  return RunGuarded(body);
+}
+
+int LGBM_DatasetPushRows(void* dataset, const void* data, int data_type,
+                         int32_t nrow, int32_t ncol, int32_t start_row) {
+  TrainHandle* h = AsTrainHandle(dataset);
+  if (!h || h->is_booster || !data) {
+    LgbmTrainSetError("DatasetPushRows: bad handle");
+    return -1;
+  }
+  if (data_type != 0 && data_type != 1) {
+    LgbmTrainSetError("DatasetPushRows: bad dtype");
+    return -1;
+  }
+  std::string body =
+      "d = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n" +
+      NpFromBuf("b", data, CtOf(data_type),
+                static_cast<int64_t>(nrow) * ncol) +
+      "s = " + std::to_string(start_row) + "\n" +
+      "d['X'][s:s + " + std::to_string(nrow) + "] = b.reshape(" +
+      std::to_string(nrow) + ", " + std::to_string(ncol) + ")\n" +
+      "st = d.get('stream')\n" +
+      "if st is not None:\n" +
+      "    st['pushed'] += " + std::to_string(nrow) + "\n" +
+      "    if (st['pushed'] >= st['total'] and not "
+      "st['manual_finish']):\n" +
+      "        st['finished'] = True\n";
+  return RunGuarded(body);
+}
+
+int LGBM_DatasetPushRowsWithMetadata(void* dataset, const void* data,
+                                     int data_type, int32_t nrow,
+                                     int32_t ncol, int32_t start_row,
+                                     const float* label,
+                                     const float* weight,
+                                     const double* init_score,
+                                     const int32_t* query, int32_t tid) {
+  (void)tid;
+  TrainHandle* h = AsTrainHandle(dataset);
+  if (!h || h->is_booster || !data || !label) {
+    LgbmTrainSetError("DatasetPushRowsWithMetadata: bad handle");
+    return -1;
+  }
+  if (LGBM_DatasetPushRows(dataset, data, data_type, nrow, ncol,
+                           start_row) != 0)
+    return -1;
+  std::string body =
+      "d = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n" +
+      "s = " + std::to_string(start_row) + "\n" +
+      "e = s + " + std::to_string(nrow) + "\n" +
+      NpFromBuf("lb", label, "_ct.c_float", nrow) +
+      "d['fields'].setdefault('label', _np.zeros(d['X'].shape[0], "
+      "_np.float32))[s:e] = lb\n";
+  if (weight)
+    body += NpFromBuf("wt", weight, "_ct.c_float", nrow) +
+            "d['fields'].setdefault('weight', "
+            "_np.zeros(d['X'].shape[0], _np.float32))[s:e] = wt\n";
+  if (init_score)
+    body += std::string(
+        "ncl = max(d.get('stream', {}).get('nclasses', 1), 1)\n"
+        "nrw = e - s\n"
+        "isc = _np.ctypeslib.as_array((_ct.c_double * (nrw * ncl))"
+        ".from_address(") + Addr(init_score) + ")).copy()\n"
+        "tot = d['X'].shape[0]\n"
+        // reference column format: init_score[class * num_total_row + row]
+        "dst = d['fields'].setdefault('init_score', "
+        "_np.zeros(tot * ncl))\n"
+        "for c in range(ncl):\n"
+        "    dst[c * tot + s:c * tot + e] = "
+        "isc[c * nrw:(c + 1) * nrw]\n";
+  if (query)
+    body += NpFromBuf("q", query, "_ct.c_int32", nrow) +
+            "d['fields'].setdefault('qid_raw', "
+            "_np.zeros(d['X'].shape[0], _np.int32))[s:e] = q\n";
+  return RunGuarded(body);
+}
+
+int LGBM_DatasetPushRowsByCSR(void* dataset, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int64_t start_row) {
+  TrainHandle* h = AsTrainHandle(dataset);
+  if (!h || h->is_booster || !indptr) {
+    LgbmTrainSetError("DatasetPushRowsByCSR: bad handle");
+    return -1;
+  }
+  if ((data_type != 0 && data_type != 1) ||
+      (indptr_type != 2 && indptr_type != 3)) {
+    LgbmTrainSetError("DatasetPushRowsByCSR: bad dtype codes");
+    return -1;
+  }
+  int64_t nrow = nindptr - 1;
+  std::string body =
+      "d = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n" +
+      NpFromBuf("ip", indptr, CtOf(indptr_type), nindptr) +
+      NpFromBuf("ci", indices, "_ct.c_int32", nelem) +
+      NpFromBuf("cd", data, CtOf(data_type), nelem) +
+      "import scipy.sparse as _sp\n" +
+      "blk = _sp.csr_matrix((cd.astype(_np.float64), ci, ip), shape=(" +
+      std::to_string(nrow) + ", " + std::to_string(num_col) +
+      ")).toarray()\n" +
+      "s = " + std::to_string(start_row) + "\n" +
+      "d['X'][s:s + " + std::to_string(nrow) + ", :blk.shape[1]] = blk\n" +
+      "st = d.get('stream')\n" +
+      "if st is not None:\n" +
+      "    st['pushed'] += " + std::to_string(nrow) + "\n" +
+      "    if (st['pushed'] >= st['total'] and not "
+      "st['manual_finish']):\n" +
+      "        st['finished'] = True\n";
+  return RunGuarded(body);
+}
+
+int LGBM_DatasetPushRowsByCSRWithMetadata(
+    void* dataset, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t start_row,
+    const float* label, const float* weight, const double* init_score,
+    const int32_t* query, int32_t tid) {
+  (void)tid;
+  TrainHandle* h = AsTrainHandle(dataset);
+  if (!h || h->is_booster || !indptr || !label) {
+    LgbmTrainSetError("DatasetPushRowsByCSRWithMetadata: bad handle");
+    return -1;
+  }
+  int64_t nrow = nindptr - 1;
+  // push with the dataset's own width; metadata mirrors
+  // PushRowsWithMetadata
+  std::string body =
+      "d = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n" +
+      NpFromBuf("ip", indptr, CtOf(indptr_type), nindptr) +
+      NpFromBuf("ci", indices, "_ct.c_int32", nelem) +
+      NpFromBuf("cd", data, CtOf(data_type), nelem) +
+      "import scipy.sparse as _sp\n" +
+      "blk = _sp.csr_matrix((cd.astype(_np.float64), ci, ip), shape=(" +
+      std::to_string(nrow) + ", d['X'].shape[1])).toarray()\n" +
+      "s = " + std::to_string(start_row) + "\n" +
+      "e = s + " + std::to_string(nrow) + "\n" +
+      "d['X'][s:e] = blk\n" +
+      NpFromBuf("lb", label, "_ct.c_float", nrow) +
+      "d['fields'].setdefault('label', _np.zeros(d['X'].shape[0], "
+      "_np.float32))[s:e] = lb\n" +
+      "st = d.get('stream')\n" +
+      "if st is not None:\n" +
+      "    st['pushed'] += " + std::to_string(nrow) + "\n" +
+      "    if (st['pushed'] >= st['total'] and not "
+      "st['manual_finish']):\n" +
+      "        st['finished'] = True\n";
+  if (weight)
+    body += NpFromBuf("wt", weight, "_ct.c_float", nrow) +
+            "d['fields'].setdefault('weight', "
+            "_np.zeros(d['X'].shape[0], _np.float32))[s:e] = wt\n";
+  if (init_score)
+    body += std::string(
+        "ncl = max(d.get('stream', {}).get('nclasses', 1), 1)\n"
+        "nrw = e - s\n"
+        "isc = _np.ctypeslib.as_array((_ct.c_double * (nrw * ncl))"
+        ".from_address(") + Addr(init_score) + ")).copy()\n"
+        "tot = d['X'].shape[0]\n"
+        // reference column format: init_score[class * num_total_row + row]
+        "dst = d['fields'].setdefault('init_score', "
+        "_np.zeros(tot * ncl))\n"
+        "for c in range(ncl):\n"
+        "    dst[c * tot + s:c * tot + e] = "
+        "isc[c * nrw:(c + 1) * nrw]\n";
+  if (query)
+    body += NpFromBuf("q", query, "_ct.c_int32", nrow) +
+            "d['fields'].setdefault('qid_raw', "
+            "_np.zeros(d['X'].shape[0], _np.int32))[s:e] = q\n";
+  return RunGuarded(body);
+}
+
+int LGBM_DatasetSetWaitForManualFinish(void* dataset, int wait) {
+  TrainHandle* h = AsTrainHandle(dataset);
+  if (!h || h->is_booster) {
+    LgbmTrainSetError("DatasetSetWaitForManualFinish: bad handle");
+    return -1;
+  }
+  return RunGuarded(
+      "d = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n"
+      "d.setdefault('stream', {'total': d['X'].shape[0], 'pushed': 0, "
+      "'finished': False, 'manual_finish': False})['manual_finish'] = " +
+      std::string(wait ? "True" : "False") + "\n");
+}
+
+int LGBM_DatasetMarkFinished(void* dataset) {
+  TrainHandle* h = AsTrainHandle(dataset);
+  if (!h || h->is_booster) {
+    LgbmTrainSetError("DatasetMarkFinished: bad handle");
+    return -1;
+  }
+  return RunGuarded(
+      "d = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n"
+      "st = d.get('stream')\n"
+      "if st is not None:\n"
+      "    st['finished'] = True\n"
+      // ranking metadata: raw per-row qids convert to group sizes IN
+      // ROW ORDER (run-length encoding — np.unique would reorder by
+      // qid value and scramble non-ascending query ids)
+      "q = d['fields'].pop('qid_raw', None)\n"
+      "if q is not None and len(q):\n"
+      "    brk = _np.flatnonzero(_np.concatenate((\n"
+      "        [True], q[1:] != q[:-1], [True])))\n"
+      "    d['fields']['group'] = _np.diff(brk).astype(_np.int32)\n");
+}
+
+}  // extern "C"
+
+// ---- dataset ops / serialization / booster introspection ---------------
+
+extern "C" {
+
+int LGBM_DatasetGetSubset(const void* handle,
+                          const int32_t* used_row_indices,
+                          int32_t num_used_row_indices,
+                          const char* parameters, void** out) {
+  // ref: c_api.h:491 (Dataset::CopySubrow); python Dataset.subset is
+  // the same operation — here the raw dict is sliced directly
+  TrainHandle* h = AsTrainHandle(const_cast<void*>(handle));
+  if (!h || h->is_booster || !used_row_indices || !out) {
+    LgbmTrainSetError("DatasetGetSubset: bad arguments");
+    return -1;
+  }
+  TrainHandle* nh = NewHandle(false);
+  std::string body =
+      "d = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n" +
+      NpFromBuf("ri", used_row_indices, "_ct.c_int32",
+                num_used_row_indices) +
+      ParamsDict(parameters) +
+      "np2 = dict(d['params']); np2.update(p)\n" +
+      "X2 = d['X'][ri]\n" +
+      // group is dropped (a row subset breaks query boundaries, like
+      // the reference's CopySubrow for ranking); init_score slices per
+      // class when stored in the nclasses>1 column format
+      "tot = d['X'].shape[0]\n" +
+      "f2 = {}\n" +
+      "for k, v in d['fields'].items():\n" +
+      "    if k == 'group':\n" +
+      "        continue\n" +
+      "    if k == 'init_score' and len(v) != tot:\n" +
+      "        f2[k] = v.reshape(-1, tot)[:, ri].ravel()\n" +
+      "    else:\n" +
+      "        f2[k] = v[ri]\n" +
+      "_lgbm_capi['obj'][" + std::to_string(nh->id) +
+      "] = {'X': X2, 'params': np2, 'fields': f2}\n";
+  if (RunGuarded(body) != 0) {
+    DropHandle(nh);
+    return -1;
+  }
+  *out = nh;
+  return 0;
+}
+
+int LGBM_DatasetAddFeaturesFrom(void* target, void* source) {
+  // ref: c_api.h:677 (Dataset::AddFeaturesFrom — horizontal merge)
+  TrainHandle* t = AsTrainHandle(target);
+  TrainHandle* s = AsTrainHandle(source);
+  if (!t || t->is_booster || !s || s->is_booster) {
+    LgbmTrainSetError("DatasetAddFeaturesFrom: bad handles");
+    return -1;
+  }
+  return RunGuarded(
+      "a = _lgbm_capi['obj'][" + std::to_string(t->id) + "]\n"
+      "b = _lgbm_capi['obj'][" + std::to_string(s->id) + "]\n"
+      "import scipy.sparse as _sp\n"
+      "if _sp.issparse(a['X']) or _sp.issparse(b['X']):\n"
+      "    a['X'] = _sp.hstack([_sp.csr_matrix(a['X']), "
+      "_sp.csr_matrix(b['X'])]).tocsr()\n"
+      "else:\n"
+      "    a['X'] = _np.concatenate([a['X'], b['X']], axis=1)\n"
+      "fa = a.get('feature_names'); fb = b.get('feature_names')\n"
+      "if fa and fb:\n"
+      "    a['feature_names'] = list(fa) + list(fb)\n");
+}
+
+int LGBM_DatasetDumpText(void* handle, const char* filename) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || h->is_booster || !filename) {
+    LgbmTrainSetError("DatasetDumpText: bad arguments");
+    return -1;
+  }
+  return RunGuarded(
+      "d = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n"
+      "import scipy.sparse as _sp\n"
+      "X = d['X'].toarray() if _sp.issparse(d['X']) else d['X']\n"
+      "lb = d['fields'].get('label')\n"
+      "cols = [lb.reshape(-1, 1)] if lb is not None else []\n"
+      "_np.savetxt(" + PyStr(filename) + ", "
+      "_np.concatenate(cols + [X], axis=1), delimiter='\\t', "
+      "fmt='%.10g')\n");
+}
+
+int LGBM_DatasetGetFeatureNumBin(void* handle, int feature_idx,
+                                 int* out) {
+  // ref: c_api.h:667 — bins are found on demand with the dataset's own
+  // params (binning is lazy here; training re-derives the same bins)
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || h->is_booster || !out) {
+    LgbmTrainSetError("DatasetGetFeatureNumBin: bad arguments");
+    return -1;
+  }
+  int32_t slot = 0;
+  std::string body =
+      "d = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n"
+      "import scipy.sparse as _sp\n"
+      "X = d['X']\n"
+      "col = (_np.asarray(X[:, " + std::to_string(feature_idx) +
+      "].todense()).ravel() if _sp.issparse(X) else "
+      "_np.asarray(X[:, " + std::to_string(feature_idx) + "], "
+      "_np.float64))\n"
+      "from lightgbm_tpu.io.binning import BinMapper\n"
+      "pp = d['params']\n"
+      "m = BinMapper.find_bin(col, len(col), "
+      "int(pp.get('max_bin', 255)), int(pp.get('min_data_in_bin', 3)), "
+      "int(pp.get('min_data_in_leaf', 20)))\n"
+      "_ct.c_int32.from_address(" + Addr(&slot) +
+      ").value = int(m.num_bin)\n";
+  if (RunGuarded(body) != 0) return -1;
+  *out = slot;
+  return 0;
+}
+
+int LGBM_DatasetUpdateParamChecking(const char* old_parameters,
+                                    const char* new_parameters) {
+  // ref: c_api.h:639 — dataset-shaping params must not change between
+  // construction and training
+  static const char* kFrozen[] = {
+      "max_bin", "min_data_in_bin", "bin_construct_sample_cnt",
+      "use_missing", "zero_as_missing", "categorical_feature",
+      "feature_pre_filter", "enable_bundle", "data_random_seed",
+      nullptr};
+  auto get = [](const char* params, const char* key) -> std::string {
+    if (!params) return "";
+    std::string ps(params);
+    std::string k = std::string(key) + "=";
+    auto pos = ps.find(k);
+    if (pos != std::string::npos && pos > 0 &&
+        ps[pos - 1] != ' ' && ps[pos - 1] != ',')
+      pos = std::string::npos;
+    if (pos == std::string::npos && ps.rfind(k, 0) != 0) return "";
+    if (pos == std::string::npos) pos = 0;
+    auto end = ps.find_first_of(", ", pos);
+    return ps.substr(pos + k.size(),
+                     end == std::string::npos ? end
+                                              : end - pos - k.size());
+  };
+  for (int i = 0; kFrozen[i]; ++i) {
+    std::string a = get(old_parameters, kFrozen[i]);
+    std::string b = get(new_parameters, kFrozen[i]);
+    // omission means "keep the dataset's value" (the reference compares
+    // effective configs, so a key absent on one side never errors)
+    if (a.empty() || b.empty()) continue;
+    if (a != b) {
+      LgbmTrainSetError((std::string("Cannot change ") + kFrozen[i] +
+                         " after Dataset construction (was '" + a +
+                         "', now '" + b + "')").c_str());
+      return -1;
+    }
+  }
+  return 0;
+}
+
+int LGBM_BoosterDumpModel(void* handle, int start_iteration,
+                          int num_iteration,
+                          int feature_importance_type,
+                          int64_t buffer_len, int64_t* out_len,
+                          char* out_str) {
+  (void)feature_importance_type;
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !out_len) {
+    LgbmTrainSetError("BoosterDumpModel: bad arguments");
+    return -1;
+  }
+  int64_t len_slot = 0;
+  const std::string key = "'dump_" + Addr(&len_slot) + "'";
+  std::string body =
+      "import json\n"
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n"
+      "js = json.dumps(b.dump_model(" +
+      (num_iteration > 0 ? "num_iteration=" +
+                               std::to_string(num_iteration) + ", "
+                         : "") +
+      "start_iteration=" + std::to_string(std::max(start_iteration, 0)) +
+      ")).encode() + b'\\0'\n" +
+      "_lgbm_capi[" + key + "] = js\n" +
+      "_ct.c_int64.from_address(" + Addr(&len_slot) +
+      ").value = len(js)\n";
+  if (RunGuarded(body) != 0) return -1;
+  *out_len = len_slot;
+  if (out_str && buffer_len > 0) {
+    int64_t n = std::min<int64_t>(buffer_len, len_slot);
+    std::string copy_body =
+        "js = _lgbm_capi.pop(" + key + ")\n" +
+        "_ct.memmove(" + Addr(out_str) + ", js, " + std::to_string(n) +
+        ")\n";
+    if (RunGuarded(copy_body) != 0) return -1;
+  } else {
+    RunGuarded("_lgbm_capi.pop(" + key + ", None)\n");
+  }
+  return 0;
+}
+
+int LGBM_BoosterGetLoadedParam(void* handle, int64_t buffer_len,
+                               int64_t* out_len, char* out_str) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !out_len) {
+    LgbmTrainSetError("BoosterGetLoadedParam: bad arguments");
+    return -1;
+  }
+  int64_t len_slot = 0;
+  const std::string key = "'param_" + Addr(&len_slot) + "'";
+  std::string body =
+      "import json\n"
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n"
+      "js = json.dumps({k: v for k, v in b.params.items()}, "
+      "default=str).encode() + b'\\0'\n" +
+      "_lgbm_capi[" + key + "] = js\n" +
+      "_ct.c_int64.from_address(" + Addr(&len_slot) +
+      ").value = len(js)\n";
+  if (RunGuarded(body) != 0) return -1;
+  *out_len = len_slot;
+  if (out_str && buffer_len > 0) {
+    int64_t n = std::min<int64_t>(buffer_len, len_slot);
+    if (RunGuarded("js = _lgbm_capi.pop(" + key + ")\n" +
+                   "_ct.memmove(" + Addr(out_str) + ", js, " +
+                   std::to_string(n) + ")\n") != 0)
+      return -1;
+  } else {
+    RunGuarded("_lgbm_capi.pop(" + key + ", None)\n");
+  }
+  return 0;
+}
+
+int LGBM_BoosterFeatureImportance(void* handle, int num_iteration,
+                                  int importance_type,
+                                  double* out_results) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !out_results) {
+    LgbmTrainSetError("BoosterFeatureImportance: bad arguments");
+    return -1;
+  }
+  std::string body =
+      "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n"
+      "imp = b.feature_importance(importance_type=" +
+      std::string(importance_type == 1 ? "'gain'" : "'split'") +
+      (num_iteration > 0
+           ? ", iteration=" + std::to_string(num_iteration)
+           : "") +
+      ").astype(_np.float64)\n" +
+      "_ct.memmove(" + Addr(out_results) +
+      ", imp.ctypes.data, imp.nbytes)\n";
+  return RunGuarded(body);
+}
+
+int LGBM_BoosterMerge(void* handle, void* other_handle) {
+  // ref: c_api.h:761 (GBDT::MergeFrom — append the other's trees)
+  TrainHandle* a = AsTrainHandle(handle);
+  TrainHandle* b = AsTrainHandle(other_handle);
+  if (!a || !a->is_booster || !b || !b->is_booster) {
+    LgbmTrainSetError("BoosterMerge: bad handles");
+    return -1;
+  }
+  return RunGuarded(
+      "ea = _lgbm_capi['obj'][" + std::to_string(a->id) +
+      "]['booster']._engine\n"
+      "eb = _lgbm_capi['obj'][" + std::to_string(b->id) +
+      "]['booster']._engine\n"
+      "ea.models.extend(eb.models)\n"
+      "ea.iter += eb.iter\n");
+}
+
+int LGBM_BoosterResetTrainingData(void* handle, const void* train_data) {
+  // ref: c_api.h:779 (GBDT::ResetTrainingData — keep the trees, swap
+  // the data): a fresh engine over the new dataset continues from the
+  // existing model (init_from_model is the same mechanism continued
+  // training uses, engine.py)
+  TrainHandle* h = AsTrainHandle(handle);
+  TrainHandle* d = AsTrainHandle(const_cast<void*>(train_data));
+  if (!h || !h->is_booster || !d || d->is_booster) {
+    LgbmTrainSetError("BoosterResetTrainingData: bad handles");
+    return -1;
+  }
+  return RunGuarded(
+      "o = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n"
+      "d = _lgbm_capi['obj'][" + std::to_string(d->id) + "]\n"
+      "old = o['booster']\n"
+      "fl = d['fields']\n"
+      "grp = fl.get('group')\n"
+      "if grp is not None and grp.dtype != _np.int32:\n"
+      "    grp = grp.astype(_np.int32)\n"
+      "ds = _lgb.Dataset(d['X'], label=fl.get('label'), "
+      "weight=fl.get('weight'), group=grp, "
+      "init_score=fl.get('init_score'), "
+      "feature_name=d.get('feature_names', 'auto'), "
+      "params=dict(old.params))\n"
+      "nb = _lgb.Booster(dict(old.params), ds)\n"
+      "nb._engine.init_from_model(old._engine)\n"
+      "o['booster'] = nb\n");
+}
+
+int LGBM_BoosterShuffleModels(void* handle, int start_iter,
+                              int end_iter) {
+  // ref: c_api.h:751 (GBDT::ShuffleModels)
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster) {
+    LgbmTrainSetError("BoosterShuffleModels: bad handle");
+    return -1;
+  }
+  return RunGuarded(
+      "e = _lgbm_capi['obj'][" + std::to_string(h->id) +
+      "]['booster']._engine\n"
+      "K = max(e.num_tree_per_iteration, 1)\n"
+      "s = max(" + std::to_string(start_iter) + ", 0) * K\n"
+      "t = (" + std::to_string(end_iter) + " * K if " +
+      std::to_string(end_iter) + " > 0 else len(e.models))\n"
+      "seg = e.models[s:t]\n"
+      "_np.random.default_rng(0).shuffle(seg)\n"
+      "e.models[s:t] = seg\n");
+}
+
+int LgbmTrainBoosterGetLinear(void* handle, int* out) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || !h->is_booster || !out) {
+    LgbmTrainSetError("BoosterGetLinear: bad handle");
+    return -1;
+  }
+  int32_t slot = 0;
+  if (RunGuarded(
+          "e = _lgbm_capi['obj'][" + std::to_string(h->id) +
+          "]['booster']._engine\n"
+          "lin = any(getattr(t, 'is_linear', False) "
+          "for t in e.models)\n"
+          "_ct.c_int32.from_address(" + Addr(&slot) +
+          ").value = 1 if lin else 0\n") != 0)
+    return -1;
+  *out = slot;
+  return 0;
+}
+
+// ---- reference-schema serialization + ByteBuffer -----------------------
+// (ref: c_api.h:550 SerializeReferenceToBinary / :204
+// CreateFromSerializedReference / :117-124 ByteBuffer). The schema blob
+// is a pickled {ncol, params} — binning re-derives identically from the
+// pushed rows, so the schema is what must travel.
+
+int LGBM_DatasetSerializeReferenceToBinary(void* handle,
+                                           void** out_buffer,
+                                           int32_t* out_len) {
+  TrainHandle* h = AsTrainHandle(handle);
+  if (!h || h->is_booster || !out_buffer || !out_len) {
+    LgbmTrainSetError("SerializeReferenceToBinary: bad arguments");
+    return -1;
+  }
+  int64_t len_slot = 0;
+  const std::string key = "'refblob_" + Addr(&len_slot) + "'";
+  std::string body =
+      "import pickle\n"
+      "d = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n"
+      "blob = pickle.dumps({'ncol': int(d['X'].shape[1]), "
+      "'params': dict(d['params'])})\n" +
+      "_lgbm_capi[" + key + "] = blob\n" +
+      "_ct.c_int64.from_address(" + Addr(&len_slot) +
+      ").value = len(blob)\n";
+  if (RunGuarded(body) != 0) return -1;
+  auto* bb = new ByteBuf();
+  bb->data.resize(static_cast<size_t>(len_slot));
+  if (RunGuarded("blob = _lgbm_capi.pop(" + key + ")\n" +
+                 "_ct.memmove(" + Addr(bb->data.data()) + ", blob, " +
+                 std::to_string(len_slot) + ")\n") != 0) {
+    delete bb;
+    return -1;
+  }
+  *out_buffer = bb;
+  *out_len = static_cast<int32_t>(len_slot);
+  return 0;
+}
+
+int LGBM_ByteBufferGetAt(void* handle, int32_t index, uint8_t* out_val) {
+  auto* bb = static_cast<ByteBuf*>(handle);
+  if (!bb || !out_val || index < 0 ||
+      index >= static_cast<int32_t>(bb->data.size())) {
+    LgbmTrainSetError("ByteBufferGetAt: bad arguments");
+    return -1;
+  }
+  *out_val = bb->data[index];
+  return 0;
+}
+
+int LGBM_ByteBufferFree(void* handle) {
+  delete static_cast<ByteBuf*>(handle);
+  return 0;
+}
+
+int LGBM_DatasetCreateFromSerializedReference(
+    const void* ref_buffer, int32_t ref_buffer_size, int64_t num_row,
+    int32_t num_classes, const char* parameters, void** out) {
+  if (!ref_buffer || !out || ref_buffer_size <= 0) {
+    LgbmTrainSetError("CreateFromSerializedReference: bad arguments");
+    return -1;
+  }
+  TrainHandle* h = NewHandle(false);
+  std::string body =
+      "import pickle\n" +
+      NpFromBuf("raw", ref_buffer, "_ct.c_uint8", ref_buffer_size) +
+      "ref = pickle.loads(raw.tobytes())\n" +
+      ParamsDict(parameters) +
+      "np2 = dict(ref['params']); np2.update(p)\n" +
+      "_lgbm_capi['obj'][" + std::to_string(h->id) +
+      "] = {'X': _np.zeros((" + std::to_string(num_row) +
+      ", ref['ncol'])), 'params': np2, 'fields': {}, "
+      "'stream': {'total': " + std::to_string(num_row) +
+      ", 'pushed': 0, 'finished': False, 'manual_finish': False, "
+      "'nclasses': " + std::to_string(std::max(num_classes, 1)) +
+      "}}\n";
+  if (RunGuarded(body) != 0) {
+    DropHandle(h);
+    return -1;
+  }
+  *out = h;
+  return 0;
+}
+
+// ---- network (ref: c_api.h:1655-1682) ----------------------------------
+
+int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                     int listen_time_out, int num_machines) {
+  // ref: c_api.h:1655. machines = 'ip1:port1,ip2:port2,...'; the SPMD
+  // translation: entry 0 is the jax.distributed coordinator and this
+  // process' rank is its position in the list (matched by the
+  // reference's own local-address rule).
+  (void)listen_time_out;
+  if (num_machines <= 1) return 0;  // single machine: nothing to join
+  if (!machines) {
+    LgbmTrainSetError("NetworkInit: machines list required");
+    return -1;
+  }
+  std::string body =
+      "import socket as _s\n"
+      "machines = " + PyStr(machines) + ".split(',')\n"
+      "coord = machines[0].strip()\n"
+      "local = {_s.gethostbyname(_s.gethostname()), '127.0.0.1', "
+      "_s.gethostname()}\n"
+      "rank = next((i for i, m in enumerate(machines) if "
+      "m.split(':')[0].strip() in local and "
+      "int(m.split(':')[1]) == " + std::to_string(local_listen_port) +
+      "), None)\n"
+      "if rank is None:\n"
+      "    raise ValueError('local machine not found in machines list "
+      "(match by address and local_listen_port)')\n"
+      "from lightgbm_tpu.distributed import init_distributed\n"
+      "init_distributed(coordinator_address=coord, num_processes=" +
+      std::to_string(num_machines) + ", process_id=rank)\n";
+  return RunGuarded(body);
+}
+
+int LGBM_NetworkFree() {
+  return RunGuarded(
+      "from lightgbm_tpu.distributed import shutdown_distributed, "
+      "clear_collectives\n"
+      "clear_collectives()\n"
+      "try:\n"
+      "    shutdown_distributed()\n"
+      "except Exception:\n"
+      "    pass\n");
+}
+
+}  // extern "C"
+
+// external collective plumbing for LGBM_NetworkInitWithFunctions
+namespace {
+
+typedef void (*ExtReduceFn)(const char*, char*, int, int32_t);
+typedef void (*ExtReduceScatterFn)(char*, int32_t, int,
+                                   const int32_t*, const int32_t*, int,
+                                   char*, int32_t, const ExtReduceFn&);
+typedef void (*ExtAllgatherFn)(char*, int32_t, const int32_t*,
+                               const int32_t*, int, char*, int32_t);
+
+ExtReduceScatterFn g_ext_rs = nullptr;
+ExtAllgatherFn g_ext_ag = nullptr;
+int g_ext_world = 1;
+
+template <typename T>
+void SumReduce(const char* src, char* dst, int type_size,
+               int32_t nbytes) {
+  (void)type_size;
+  const T* s = reinterpret_cast<const T*>(src);
+  T* d = reinterpret_cast<T*>(dst);
+  for (int32_t i = 0; i < nbytes / static_cast<int32_t>(sizeof(T)); ++i)
+    d[i] += s[i];
+}
+
+template <typename T>
+void MaxReduce(const char* src, char* dst, int type_size,
+               int32_t nbytes) {
+  (void)type_size;
+  const T* s = reinterpret_cast<const T*>(src);
+  T* d = reinterpret_cast<T*>(dst);
+  for (int32_t i = 0; i < nbytes / static_cast<int32_t>(sizeof(T)); ++i)
+    d[i] = d[i] > s[i] ? d[i] : s[i];
+}
+
+}  // namespace
+
+extern "C" {
+
+// allreduce over the injected external functions — the exact
+// ReduceScatter + Allgather block recipe of Network::Allreduce
+// (ref: src/network/network.cpp:72-98). Called from the embedded
+// interpreter's injected reduce callables via ctypes.
+// dtype: 0=f32 1=f64 2=i32; op: 0=sum 1=max. Returns 0 on success.
+int lgbm_ext_allreduce(char* buf, int64_t n_elems, int dtype, int op) {
+  if (!g_ext_rs || !g_ext_ag) return -1;
+  const int ts = dtype == 1 ? 8 : 4;
+  const int32_t input_size = static_cast<int32_t>(n_elems) * ts;
+  const int world = g_ext_world;
+  std::vector<int32_t> bstart(world), blen(world);
+  int32_t count = static_cast<int32_t>(n_elems);
+  int32_t step = (count + world - 1) / world;
+  if (step < 1) step = 1;
+  bstart[0] = 0;
+  for (int i = 0; i < world - 1; ++i) {
+    blen[i] = std::min<int32_t>(step * ts, input_size - bstart[i]);
+    bstart[i + 1] = bstart[i] + blen[i];
+  }
+  blen[world - 1] = input_size - bstart[world - 1];
+  ExtReduceFn red =
+      op == 0 ? (dtype == 0   ? &SumReduce<float>
+                 : dtype == 1 ? &SumReduce<double>
+                              : &SumReduce<int32_t>)
+              : (dtype == 0   ? &MaxReduce<float>
+                 : dtype == 1 ? &MaxReduce<double>
+                              : &MaxReduce<int32_t>);
+  std::vector<char> out(static_cast<size_t>(input_size));
+  g_ext_rs(buf, input_size, ts, bstart.data(), blen.data(), world,
+           out.data(), input_size, red);
+  g_ext_ag(out.data(), input_size, bstart.data(), blen.data(), world,
+           out.data(), input_size);
+  std::memcpy(buf, out.data(), input_size);
+  return 0;
+}
+
+int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
+                                  void* reduce_scatter_ext_fun,
+                                  void* allgather_ext_fun) {
+  // ref: c_api.h:1674 / network.cpp:49-62. The injected function
+  // pointers become the transport of lightgbm_tpu.distributed's
+  // collective-injection mode: every histogram/root reduction routes
+  // host-side through lgbm_ext_allreduce above.
+  if (num_machines <= 1) return 0;
+  if (!reduce_scatter_ext_fun || !allgather_ext_fun) {
+    LgbmTrainSetError("NetworkInitWithFunctions: null function");
+    return -1;
+  }
+  g_ext_rs = reinterpret_cast<ExtReduceScatterFn>(reduce_scatter_ext_fun);
+  g_ext_ag = reinterpret_cast<ExtAllgatherFn>(allgather_ext_fun);
+  g_ext_world = num_machines;
+  std::string body =
+      "import ctypes as _ct2\n"
+      "_ar = _ct2.CFUNCTYPE(_ct2.c_int, _ct2.c_void_p, "
+      "_ct2.c_longlong, _ct2.c_int, _ct2.c_int)(" +
+      Addr(reinterpret_cast<const void*>(&lgbm_ext_allreduce)) + ")\n"
+      "def _code(a):\n"
+      "    if a.dtype == _np.float32: return 0\n"
+      "    if a.dtype == _np.float64: return 1\n"
+      "    if a.dtype == _np.int32: return 2\n"
+      "    raise TypeError(f'unsupported dtype {a.dtype}')\n"
+      "def _mk(op):\n"
+      "    def red(a):\n"
+      "        a = _np.ascontiguousarray(a)\n"
+      "        rc = _ar(a.ctypes.data, a.size, _code(a), op)\n"
+      "        if rc != 0:\n"
+      "            raise RuntimeError('external allreduce failed')\n"
+      "        return a\n"
+      "    return red\n"
+      "from lightgbm_tpu.distributed import inject_collectives\n"
+      "inject_collectives(_mk(0), reduce_max=_mk(1), rank=" +
+      std::to_string(rank) + ", num_machines=" +
+      std::to_string(num_machines) + ")\n";
+  return RunGuarded(body);
+}
+
+int LGBM_DumpParamAliases(int64_t buffer_len, int64_t* out_len,
+                          char* out_str) {
+  // ref: c_api.h:73 — JSON map of parameter -> aliases from the single
+  // config registry (the reference generates it from config_auto)
+  if (!out_len) {
+    LgbmTrainSetError("DumpParamAliases: null out_len");
+    return -1;
+  }
+  int64_t len_slot = 0;
+  const std::string key = "'aliases_" + Addr(&len_slot) + "'";
+  std::string body =
+      std::string(
+          "import json\n"
+          "from lightgbm_tpu import config as _cfgmod\n"
+          "amap = {}\n"
+          "for alias, canon in _cfgmod._ALIAS_TO_NAME.items():\n"
+          "    amap.setdefault(canon, []).append(alias)\n"
+          "js = json.dumps(amap, sort_keys=True).encode() + b'\\0'\n") +
+      "_lgbm_capi[" + key + "] = js\n" +
+      "_ct.c_int64.from_address(" + Addr(&len_slot) +
+      ").value = len(js)\n";
+  if (RunGuarded(body) != 0) return -1;
+  *out_len = len_slot;
+  if (out_str && buffer_len > 0) {
+    int64_t n = std::min<int64_t>(buffer_len, len_slot);
+    if (RunGuarded("js = _lgbm_capi.pop(" + key + ")\n" +
+                   "_ct.memmove(" + Addr(out_str) + ", js, " +
+                   std::to_string(n) + ")\n") != 0)
+      return -1;
+  } else {
+    RunGuarded("_lgbm_capi.pop(" + key + ", None)\n");
+  }
+  return 0;
 }
 
 }  // extern "C"

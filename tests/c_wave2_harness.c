@@ -1,0 +1,449 @@
+/* Wave-2 C-API harness: streaming creation, CSC create/predict,
+ * dataset ops, booster introspection, single-row fast prediction
+ * (incl. a multi-thread check — ref precedent:
+ * tests/cpp_tests/test_single_row.cpp), sparse contrib output, and
+ * the external-collective allreduce plumbing.
+ * Usage: c_wave2 <model_out.txt>  — prints C-WAVE2-OK on success. */
+#include <math.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "lgbm_c_api.h"
+
+#define CHECK(call)                                                    \
+  do {                                                                 \
+    if ((call) != 0) {                                                 \
+      fprintf(stderr, "FAIL %s: %s\n", #call, LGBM_GetLastError());    \
+      return 1;                                                        \
+    }                                                                  \
+  } while (0)
+
+#define ASSERT(cond)                                                   \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "ASSERT FAILED: %s (line %d)\n", #cond,          \
+              __LINE__);                                               \
+      return 1;                                                        \
+    }                                                                  \
+  } while (0)
+
+static int g_log_lines = 0;
+static void log_cb(const char* msg) {
+  (void)msg;
+  ++g_log_lines;
+}
+
+/* fake world-2 external collectives: pretend the peer contributes the
+ * same block values (reduce => x2 for sum) — enough to verify the
+ * Allreduce block recipe end-to-end */
+typedef void (*red_fn)(const char*, char*, int, int32_t);
+static void fake_reduce_scatter(char* input, int32_t input_size,
+                                int type_size, const int32_t* bstart,
+                                const int32_t* blen, int nblock,
+                                char* output, int32_t output_size,
+                                const red_fn* reducer) {
+  (void)type_size;
+  (void)output_size;
+  memcpy(output, input, (size_t)input_size);
+  /* "receive" the peer's identical blocks and reduce them in */
+  for (int b = 0; b < nblock; ++b)
+    (*reducer)(input + bstart[b], output + bstart[b], type_size,
+               blen[b]);
+}
+static void fake_allgather(char* input, int32_t input_size,
+                           const int32_t* bstart, const int32_t* blen,
+                           int nblock, char* output,
+                           int32_t output_size) {
+  (void)bstart;
+  (void)blen;
+  (void)nblock;
+  (void)output_size;
+  if (output != input) memcpy(output, input, (size_t)input_size);
+}
+
+extern int lgbm_ext_allreduce(char* buf, int64_t n, int dtype, int op);
+
+/* thread worker: many single-row fast predictions, compare to expected */
+typedef struct {
+  FastConfigHandle fc;
+  const double* X;
+  const double* expect;
+  int n;
+  int f;
+  int rc;
+} thr_arg;
+
+static void* thr_predict(void* p) {
+  thr_arg* a = (thr_arg*)p;
+  for (int r = 0; r < a->n; ++r) {
+    int64_t len = 0;
+    double out = 0.0;
+    if (LGBM_BoosterPredictForMatSingleRowFast(a->fc, a->X + r * a->f,
+                                               &len, &out) != 0 ||
+        fabs(out - a->expect[r]) > 1e-9) {
+      a->rc = 1;
+      return NULL;
+    }
+  }
+  a->rc = 0;
+  return NULL;
+}
+
+int main(int argc, char** argv) {
+  const char* model_path = argc > 1 ? argv[1] : "/tmp/c_wave2_model.txt";
+  const int n = 400, f = 5;
+  double* X = (double*)malloc(sizeof(double) * n * f);
+  float* y = (float*)malloc(sizeof(float) * n);
+  unsigned s = 123;
+  for (int i = 0; i < n * f; ++i) {
+    s = s * 1103515245u + 12345u;
+    X[i] = ((double)(s >> 16) / 32768.0) - 1.0;
+  }
+  for (int r = 0; r < n; ++r)
+    y[r] = (float)(X[r * f] * 2.0 - X[r * f + 1] + 0.1);
+
+  CHECK(LGBM_RegisterLogCallback(log_cb));
+
+  /* ---- streaming creation: schema -> init -> push chunks -> finish */
+  DatasetHandle sds = NULL;
+  CHECK(LGBM_DatasetCreateFromSampledColumn(
+      NULL, NULL, f, NULL, 0, n, n,
+      "min_data_in_leaf=5 verbosity=1 device_type=cpu", &sds));
+  CHECK(LGBM_DatasetInitStreaming(sds, 1, 0, 0, 1, 1, -1));
+  CHECK(LGBM_DatasetSetWaitForManualFinish(sds, 1));
+  {
+    float* w = (float*)malloc(sizeof(float) * n);
+    for (int r = 0; r < n; ++r) w[r] = 1.0f;
+    int half = n / 2;
+    CHECK(LGBM_DatasetPushRowsWithMetadata(sds, X, 1, half, f, 0, y, w,
+                                           NULL, NULL, 0));
+    CHECK(LGBM_DatasetPushRowsWithMetadata(
+        sds, X + (int64_t)half * f, 1, n - half, f, half, y + half,
+        w + half, NULL, NULL, 0));
+    free(w);
+  }
+  CHECK(LGBM_DatasetMarkFinished(sds));
+
+  /* ---- train on the streamed dataset */
+  BoosterHandle bst = NULL;
+  CHECK(LGBM_BoosterCreate(
+      sds, "objective=regression num_leaves=15 min_data_in_leaf=5 "
+           "verbosity=1 device_type=cpu", &bst));
+  for (int it = 0; it < 8; ++it) {
+    int fin = 0;
+    CHECK(LGBM_BoosterUpdateOneIter(bst, &fin));
+  }
+  ASSERT(g_log_lines > 0); /* the log bridge delivered messages */
+
+  /* ---- booster introspection */
+  {
+    int64_t need = 0;
+    CHECK(LGBM_BoosterDumpModel(bst, 0, -1, 0, 0, &need, NULL));
+    ASSERT(need > 2);
+    char* js = (char*)malloc((size_t)need);
+    int64_t got = 0;
+    CHECK(LGBM_BoosterDumpModel(bst, 0, -1, 0, need, &got, js));
+    ASSERT(js[0] == '{');
+    free(js);
+
+    double imp[8] = {0};
+    CHECK(LGBM_BoosterFeatureImportance(bst, -1, 0, imp));
+    double tot = 0;
+    for (int i = 0; i < f; ++i) tot += imp[i];
+    ASSERT(tot > 0);
+
+    int64_t plen = 0;
+    char pbuf[4096];
+    CHECK(LGBM_BoosterGetLoadedParam(bst, sizeof(pbuf), &plen, pbuf));
+    ASSERT(plen > 2 && pbuf[0] == '{');
+
+    int lin = 7;
+    CHECK(LGBM_BoosterGetLinear(bst, &lin));
+    ASSERT(lin == 0);
+  }
+
+  /* ---- save + reload through the serving path */
+  CHECK(LGBM_BoosterSaveModel(bst, 0, -1, 0, model_path));
+  BoosterHandle srv = NULL;
+  int n_iter = 0;
+  CHECK(LGBM_BoosterCreateFromModelfile(model_path, &n_iter, &srv));
+  ASSERT(n_iter == 8);
+
+  /* reference predictions via the plain mat path */
+  double* expect = (double*)malloc(sizeof(double) * n);
+  {
+    int64_t len = 0;
+    CHECK(LGBM_BoosterPredictForMat(srv, X, 1, n, f, 1, 0, 0, -1, "",
+                                    &len, expect));
+    ASSERT(len == n);
+  }
+
+  /* ---- CSC predict parity (dense -> CSC conversion) */
+  {
+    int64_t* cptr = (int64_t*)malloc(sizeof(int64_t) * (f + 1));
+    int32_t* cidx = (int32_t*)malloc(sizeof(int32_t) * n * f);
+    double* cval = (double*)malloc(sizeof(double) * n * f);
+    int64_t k = 0;
+    for (int c = 0; c < f; ++c) {
+      cptr[c] = k;
+      for (int r = 0; r < n; ++r) {
+        cidx[k] = r;
+        cval[k] = X[r * f + c];
+        ++k;
+      }
+    }
+    cptr[f] = k;
+    double* out = (double*)malloc(sizeof(double) * n);
+    int64_t len = 0;
+    CHECK(LGBM_BoosterPredictForCSC(srv, cptr, 3, cidx, cval, 1, f + 1,
+                                    k, n, 0, 0, -1, "", &len, out));
+    ASSERT(len == n);
+    for (int r = 0; r < n; ++r) ASSERT(fabs(out[r] - expect[r]) < 1e-9);
+    free(cptr);
+    free(cidx);
+    free(cval);
+    free(out);
+  }
+
+  /* ---- PredictForMats */
+  {
+    const void** rows = (const void**)malloc(sizeof(void*) * n);
+    for (int r = 0; r < n; ++r) rows[r] = X + (int64_t)r * f;
+    double* out = (double*)malloc(sizeof(double) * n);
+    int64_t len = 0;
+    CHECK(LGBM_BoosterPredictForMats(srv, rows, 1, n, f, 0, 0, -1, "",
+                                     &len, out));
+    for (int r = 0; r < n; ++r) ASSERT(fabs(out[r] - expect[r]) < 1e-9);
+    free(rows);
+    free(out);
+  }
+
+  /* ---- contrib (SHAP): local accuracy vs raw score */
+  {
+    double* contrib = (double*)malloc(sizeof(double) * n * (f + 1));
+    int64_t len = 0;
+    CHECK(LGBM_BoosterPredictForMat(srv, X, 1, n, f, 1, 3, 0, -1, "",
+                                    &len, contrib));
+    /* (is_row_major=1, predict_type=3) */
+    ASSERT(len == (int64_t)n * (f + 1));
+    for (int r = 0; r < n; ++r) {
+      double ssum = 0;
+      for (int c = 0; c <= f; ++c) ssum += contrib[r * (f + 1) + c];
+      ASSERT(fabs(ssum - expect[r]) < 1e-6);
+    }
+    free(contrib);
+  }
+
+  /* ---- sparse contrib output */
+  {
+    /* single dense row as CSR */
+    int32_t ip[2] = {0, f};
+    int32_t ci[8];
+    double cv[8];
+    for (int c = 0; c < f; ++c) {
+      ci[c] = c;
+      cv[c] = X[c];
+    }
+    int64_t out_len[2] = {0, 0};
+    void* o_iptr = NULL;
+    int32_t* o_idx = NULL;
+    void* o_val = NULL;
+    CHECK(LGBM_BoosterPredictSparseOutput(srv, ip, 2, ci, cv, 1, 2, f,
+                                          f, 3, 0, -1, "", 0, out_len,
+                                          &o_iptr, &o_idx, &o_val));
+    ASSERT(out_len[1] == 2);
+    double ssum = 0;
+    for (int64_t kx = 0; kx < out_len[0]; ++kx)
+      ssum += ((double*)o_val)[kx];
+    ASSERT(fabs(ssum - expect[0]) < 1e-6);
+    CHECK(LGBM_BoosterFreePredictSparse(o_iptr, o_idx, o_val, 3, 1));
+  }
+
+  /* ---- single-row fast: 4 threads x all rows, exact match */
+  {
+    FastConfigHandle fc = NULL;
+    CHECK(LGBM_BoosterPredictForMatSingleRowFastInit(srv, 0, 0, -1, 1,
+                                                     f, "", &fc));
+    pthread_t th[4];
+    thr_arg args[4];
+    for (int t = 0; t < 4; ++t) {
+      args[t].fc = fc;
+      args[t].X = X;
+      args[t].expect = expect;
+      args[t].n = n;
+      args[t].f = f;
+      args[t].rc = -1;
+      pthread_create(&th[t], NULL, thr_predict, &args[t]);
+    }
+    for (int t = 0; t < 4; ++t) {
+      pthread_join(th[t], NULL);
+      ASSERT(args[t].rc == 0);
+    }
+    CHECK(LGBM_FastConfigFree(fc));
+  }
+
+  /* ---- bounds + name validation */
+  {
+    double lo = 0, hi = 0;
+    CHECK(LGBM_BoosterGetLowerBoundValue(srv, &lo));
+    CHECK(LGBM_BoosterGetUpperBoundValue(srv, &hi));
+    ASSERT(lo <= hi);
+    const char* good[8] = {"Column_0", "Column_1", "Column_2",
+                           "Column_3", "Column_4"};
+    CHECK(LGBM_BoosterValidateFeatureNames(srv, good, f));
+    const char* bad[8] = {"a", "b", "c", "d", "e"};
+    ASSERT(LGBM_BoosterValidateFeatureNames(srv, bad, f) != 0);
+  }
+
+  /* ---- dataset ops: CSC create + subset + add-features + num-bin */
+  {
+    int64_t* cptr = (int64_t*)malloc(sizeof(int64_t) * (f + 1));
+    int32_t* cidx = (int32_t*)malloc(sizeof(int32_t) * n * f);
+    double* cval = (double*)malloc(sizeof(double) * n * f);
+    int64_t k = 0;
+    for (int c = 0; c < f; ++c) {
+      cptr[c] = k;
+      for (int r = 0; r < n; ++r) {
+        cidx[k] = r;
+        cval[k] = X[r * f + c];
+        ++k;
+      }
+    }
+    cptr[f] = k;
+    DatasetHandle csc = NULL;
+    CHECK(LGBM_DatasetCreateFromCSC(cptr, 3, cidx, cval, 1, f + 1, k, n,
+                                    "device_type=cpu", NULL, &csc));
+    int nb = 0;
+    CHECK(LGBM_DatasetGetFeatureNumBin(csc, 0, &nb));
+    ASSERT(nb > 1);
+
+    int32_t rows_sel[100];
+    for (int i = 0; i < 100; ++i) rows_sel[i] = i * 2;
+    DatasetHandle sub = NULL;
+    CHECK(LGBM_DatasetGetSubset(csc, rows_sel, 100, "", &sub));
+    int32_t sn = 0;
+    CHECK(LGBM_DatasetGetNumData(sub, &sn));
+    ASSERT(sn == 100);
+
+    ASSERT(LGBM_DatasetUpdateParamChecking("max_bin=255",
+                                           "max_bin=63") != 0);
+    CHECK(LGBM_DatasetUpdateParamChecking("max_bin=255 num_leaves=31",
+                                          "max_bin=255 num_leaves=63"));
+
+    CHECK(LGBM_DatasetFree(sub));
+    CHECK(LGBM_DatasetFree(csc));
+    free(cptr);
+    free(cidx);
+    free(cval);
+  }
+
+  /* ---- reference-schema serialization round trip */
+  {
+    ByteBufferHandle bb = NULL;
+    int32_t blen = 0;
+    CHECK(LGBM_DatasetSerializeReferenceToBinary(sds, &bb, &blen));
+    ASSERT(blen > 0);
+    uint8_t* blob = (uint8_t*)malloc((size_t)blen);
+    for (int32_t i = 0; i < blen; ++i)
+      CHECK(LGBM_ByteBufferGetAt(bb, i, &blob[i]));
+    CHECK(LGBM_ByteBufferFree(bb));
+    DatasetHandle rds = NULL;
+    CHECK(LGBM_DatasetCreateFromSerializedReference(blob, blen, n, 1,
+                                                    "", &rds));
+    CHECK(LGBM_DatasetPushRows(rds, X, 1, n, f, 0));
+    int32_t rn = 0;
+    CHECK(LGBM_DatasetGetNumData(rds, &rn));
+    ASSERT(rn == n);
+    CHECK(LGBM_DatasetFree(rds));
+    free(blob);
+  }
+
+  /* ---- reset training data: trees keep predicting identically */
+  {
+    int32_t rows_sel[64];
+    for (int i = 0; i < 64; ++i) rows_sel[i] = i;
+    DatasetHandle sub = NULL;
+    CHECK(LGBM_DatasetGetSubset(sds, rows_sel, 64, "", &sub));
+    CHECK(LGBM_BoosterResetTrainingData(bst, sub));
+    int it_after = 0;
+    CHECK(LGBM_BoosterGetCurrentIteration(bst, &it_after));
+    ASSERT(it_after == 8);
+    int fin = 0; /* training continues over the swapped data */
+    CHECK(LGBM_BoosterUpdateOneIter(bst, &fin));
+    CHECK(LGBM_DatasetFree(sub));
+  }
+
+  /* ---- merge + shuffle */
+  {
+    BoosterHandle b2 = NULL;
+    CHECK(LGBM_BoosterCreate(
+        sds, "objective=regression num_leaves=7 min_data_in_leaf=5 "
+             "verbosity=-1 device_type=cpu", &b2));
+    int fin = 0;
+    CHECK(LGBM_BoosterUpdateOneIter(b2, &fin));
+    int before = 0, after = 0;
+    CHECK(LGBM_BoosterGetCurrentIteration(bst, &before));
+    CHECK(LGBM_BoosterMerge(bst, b2));
+    CHECK(LGBM_BoosterGetCurrentIteration(bst, &after));
+    ASSERT(after == before + 1);
+    CHECK(LGBM_BoosterShuffleModels(bst, 0, -1));
+    CHECK(LGBM_BoosterFree(b2));
+  }
+
+  /* ---- utils: sampling, aliases, errors, threads */
+  {
+    int cnt = 0;
+    CHECK(LGBM_GetSampleCount(1000, "bin_construct_sample_cnt=100",
+                              &cnt));
+    ASSERT(cnt == 100);
+    int32_t* idx = (int32_t*)malloc(sizeof(int32_t) * cnt);
+    int32_t got = 0;
+    CHECK(LGBM_SampleIndices(1000, "bin_construct_sample_cnt=100", idx,
+                             &got));
+    ASSERT(got == 100);
+    for (int i = 1; i < got; ++i) ASSERT(idx[i] > idx[i - 1]);
+    ASSERT(idx[got - 1] < 1000);
+    free(idx);
+
+    int64_t alen = 0;
+    char abuf[65536];
+    CHECK(LGBM_DumpParamAliases(sizeof(abuf), &alen, abuf));
+    ASSERT(alen > 2 && abuf[0] == '{');
+
+    CHECK(LGBM_SetLastError("boom"));
+    ASSERT(strcmp(LGBM_GetLastError(), "boom") == 0);
+
+    CHECK(LGBM_SetMaxThreads(2));
+    int mt = 0;
+    CHECK(LGBM_GetMaxThreads(&mt));
+    ASSERT(mt == 2);
+    CHECK(LGBM_SetMaxThreads(-1));
+  }
+
+  /* ---- external-collective allreduce plumbing (world=2 fake) */
+  {
+    CHECK(LGBM_NetworkInitWithFunctions(2, 0,
+                                        (void*)fake_reduce_scatter,
+                                        (void*)fake_allgather));
+    double buf[7] = {1, 2, 3, 4, 5, 6, 7};
+    ASSERT(lgbm_ext_allreduce((char*)buf, 7, 1, 0) == 0);
+    for (int i = 0; i < 7; ++i) ASSERT(fabs(buf[i] - 2.0 * (i + 1)) <
+                                       1e-12);
+    int32_t ib[3] = {5, -1, 9};
+    ASSERT(lgbm_ext_allreduce((char*)ib, 3, 2, 1) == 0); /* max */
+    ASSERT(ib[0] == 5 && ib[1] == -1 && ib[2] == 9);
+    CHECK(LGBM_NetworkFree());
+  }
+
+  CHECK(LGBM_BoosterFree(bst));
+  CHECK(LGBM_BoosterFree(srv));
+  CHECK(LGBM_DatasetFree(sds));
+  free(X);
+  free(y);
+  free(expect);
+  printf("C-WAVE2-OK\n");
+  return 0;
+}

@@ -49,3 +49,33 @@ def test_c_train_harness(tmp_path):
                          capture_output=True, text=True, timeout=600)
     assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
     assert "C-TRAIN-OK" in out.stdout
+
+
+def test_c_wave2_harness(tmp_path):
+    """Wave-2 C surface end-to-end: streaming creation, CSC, dataset
+    ops, introspection, single-row fast (multi-threaded), contrib +
+    sparse output, external-collective allreduce plumbing."""
+    so_path = os.path.join(REPO, "lightgbm_tpu", "native", "_build",
+                           "lgbm_native.so")
+    assert os.path.exists(so_path)
+    exe = str(tmp_path / "c_wave2")
+    subprocess.run(
+        ["gcc", "-O1", "-pthread",
+         "-I", os.path.join(REPO, "lightgbm_tpu", "native"),
+         os.path.join(REPO, "tests", "c_wave2_harness.c"),
+         so_path, "-lm", "-o", exe],
+        check=True, capture_output=True, timeout=120)
+
+    env = dict(os.environ)
+    site = sysconfig.get_paths()["purelib"]
+    env["PYTHONPATH"] = site + os.pathsep + env.get("PYTHONPATH", "")
+    env["LIGHTGBM_TPU_PLATFORM"] = "cpu"
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ldlib = sysconfig.get_config_var("LDLIBRARY") or ""
+    if libdir and ldlib:
+        env.setdefault("LGBM_TPU_LIBPYTHON", os.path.join(libdir, ldlib))
+
+    out = subprocess.run([exe, str(tmp_path / "model.txt")], env=env,
+                         capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, (out.stdout[-3000:], out.stderr[-3000:])
+    assert "C-WAVE2-OK" in out.stdout
