@@ -64,11 +64,17 @@ class GrowerConfig:
     # histogram memory policy: "full" keeps the [L, F, B, 3] per-leaf pool
     # (sibling subtraction, fastest); "none" keeps NO pool and computes
     # both children's histograms per split from their gathered rows —
-    # O(F*B) memory so wide data (Allstate-class F) fits HBM. The XLA
-    # answer to the reference's LRU HistogramPool recompute-on-miss
+    # O(F*B) memory so wide data (Allstate-class F) fits HBM; "bounded"
+    # keeps a [pool_slots, F, B, 3] LRU pool — cached parents use the
+    # subtraction trick, evicted parents recompute both children
+    # (recompute-on-miss). The XLA answers to the reference's
+    # histogram_pool_size-capped LRU HistogramPool
     # (ref: feature_histogram.hpp:1368, serial_tree_learner.cpp:144-165).
-    # Requires row_sched="compact"; forced splits need the pool.
+    # "none"/"bounded" require row_sched="compact"; forced splits and
+    # refined monotone modes need the full pool.
     hist_pool: str = "full"
+    # slot count for hist_pool="bounded" (>= 2)
+    pool_slots: int = 0
     # quantized-gradient training (ref: gradient_discretizer.{hpp,cpp},
     # config use_quantized_grad): int8 grad/hess with stochastic rounding,
     # EXACT int32 histogram accumulation on the MXU — deterministic sums
@@ -163,6 +169,11 @@ class GrowState(NamedTuple):
     # (the vote ranks by LOCAL gain; multival/EFB default-bin
     # reconstruction of a LOCAL hist needs LOCAL totals)
     lsum: jnp.ndarray = None
+    # bounded LRU pool bookkeeping (hist_pool="bounded"; ≡ the
+    # reference's histogram_pool_size LRU, feature_histogram.hpp:1368)
+    slot_map: jnp.ndarray = None    # i32 [L] leaf -> pool slot (-1 miss)
+    slot_stamp: jnp.ndarray = None  # i32 [P] last-touch step (-1 free)
+    slot_owner: jnp.ndarray = None  # i32 [P] owning leaf (-1 free)
 
 
 def _set(arr, idx, val, cond):
@@ -334,17 +345,30 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             num_cat=i32(v[..., B_NCAT]) if has_cat else None,
             cat_bins=cat_bins)
     pool_none = cfg.hist_pool == "none"
-    if pool_none and not compact:
-        raise ValueError("hist_pool='none' requires row_sched='compact'")
+    pool_bounded = cfg.hist_pool == "bounded"
+    P_slots = max(int(cfg.pool_slots), 2) if pool_bounded else 0
+    if (pool_none or pool_bounded) and not compact:
+        raise ValueError(f"hist_pool={cfg.hist_pool!r} requires "
+                         "row_sched='compact'")
+    if pool_bounded and (reduce_hist is not None or
+                         prepare_split_hist is not None or
+                         select_best is not None or
+                         fetch_bin_column is not None):
+        # the miss/hit lax.cond would put collectives inside divergent
+        # control flow; the LRU cap is a single-machine memory concern
+        # (like the reference's) — distributed learners shard memory
+        # pressure instead
+        raise ValueError("hist_pool='bounded' supports the serial "
+                         "learner only")
     if local_pool and mv_mode and not compact:
         # full-mode multival histograms omit default-bin mass, so leaf
         # totals cannot be read off feature 0's bins (the full-mode
         # local-sums shortcut); the compact path carries raw gh totals
         raise ValueError("tree_learner=voting with multi-value sparse "
                          "storage requires row_sched='compact'")
-    if pool_none and forced is not None:
-        raise ValueError("forced splits need the histogram pool; use "
-                         "hist_pool='full'")
+    if (pool_none or pool_bounded) and forced is not None:
+        raise ValueError("forced splits need the full histogram pool; "
+                         "use hist_pool='full'")
 
     # EFB (ref: dataset.cpp FindGroups/FastFeatureBundling + FixHistogram):
     # histograms are built over PHYSICAL bundled columns and expanded to
@@ -414,7 +438,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     use_mc_inter = use_mc and cfg.mc_method in ("intermediate", "advanced")
     use_mc_adv = use_mc and cfg.mc_method == "advanced"
     if use_mc_inter:
-        if pool_none:
+        if pool_none or pool_bounded:
             raise ValueError("monotone_constraints_method=intermediate "
                              "re-scans affected leaves from the histogram "
                              "pool; use hist_pool='full'")
@@ -715,9 +739,14 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                             leaf_depth=jnp.int32(0), cegb=cegb,
                             rand_u=root_rand, lsum3=root_lsum)
 
-        hist_pool = (None if pool_none else
-                     jnp.zeros((L, Fp, B, 3), hist_dtype).at[0].set(
-                         hist_root))
+        if pool_none:
+            hist_pool = None
+        elif pool_bounded:
+            hist_pool = jnp.zeros((P_slots, Fp, B, 3),
+                                  hist_dtype).at[0].set(hist_root)
+        else:
+            hist_pool = jnp.zeros((L, Fp, B, 3), hist_dtype).at[0].set(
+                hist_root)
         stats0 = jnp.zeros((L, NS), jnp.float32)
         stats0 = stats0.at[:, S_LMIN].set(-jnp.inf)
         stats0 = stats0.at[:, S_LMAX].set(jnp.inf)
@@ -749,6 +778,12 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                  if compact else None),
             lsum=(jnp.zeros((L, 3), hist_dtype).at[0].set(
                 local_root.astype(hist_dtype)) if local_pool else None),
+            slot_map=(jnp.full(L, -1, jnp.int32).at[0].set(0)
+                      if pool_bounded else None),
+            slot_stamp=(jnp.full(P_slots, -1, jnp.int32).at[0].set(0)
+                        if pool_bounded else None),
+            slot_owner=(jnp.full(P_slots, -1, jnp.int32).at[0].set(0)
+                        if pool_bounded else None),
             leaf_flo=(jnp.zeros((L, F), jnp.int32) if use_mc_inter
                       else None),
             leaf_fhi=(jnp.broadcast_to(
@@ -897,7 +932,49 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                         ncat_a, cbins_a, colv)
 
                 small_ctx = None
-                if pool_none:
+                if pool_bounded:
+                    # LRU hit: smaller child + sibling subtraction from
+                    # the cached parent; miss: recompute BOTH children
+                    # (≡ HistogramPool recompute-on-miss,
+                    # feature_histogram.hpp:1368)
+                    sp = state.slot_map[l]
+                    have = sp >= 0
+                    hist_parent_b = state.hist[jnp.maximum(sp, 0)]
+
+                    def hit_path():
+                        order2, nL = do_partition()
+                        nR = rows_l - nL
+                        lsm = nL <= nR
+                        s_start = start_l + jnp.where(lsm, 0, nL)
+                        s_rows = jnp.where(lsm, nL, nR)
+                        h = lax.switch(bucket_branch(s_rows),
+                                       hist_branches, order2, s_start,
+                                       s_rows, gh)
+                        large = hist_parent_b - h
+                        hl = jnp.where(lsm, h, large)
+                        hr = jnp.where(lsm, large, h)
+                        return order2, nL, hl, hr
+
+                    def miss_path():
+                        order2, nL = do_partition()
+                        nR = rows_l - nL
+                        hl = lax.switch(bucket_branch(nL),
+                                        hist_branches, order2, start_l,
+                                        nL, gh)
+                        hr = lax.switch(bucket_branch(nR),
+                                        hist_branches, order2,
+                                        start_l + nL, nR, gh)
+                        return order2, nL, hl, hr
+
+                    order, nL_raw, hist_left_c, hist_right_c = lax.cond(
+                        proceed,
+                        lambda: lax.cond(have, hit_path, miss_path),
+                        lambda: (state.order, jnp.int32(0),
+                                 jnp.zeros((Fp, B, 3), hist_dtype),
+                                 jnp.zeros((Fp, B, 3), hist_dtype)))
+                    left_smaller = jnp.asarray(True)  # unused downstream
+                    hist_small = None
+                elif pool_none:
                     def do_part_hist2():
                         order2, nL = do_partition()
                         nR = rows_l - nL
@@ -1023,7 +1100,46 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             if pool_none:
                 hist_left, hist_right = hist_left_c, hist_right_c
                 hist = None
+                slot_map = state.slot_map
+                slot_stamp = state.slot_stamp
+                slot_owner = state.slot_owner
+            elif pool_bounded:
+                hist_left, hist_right = hist_left_c, hist_right_c
+                # LRU slot assignment: the left child reuses the
+                # parent's slot on a hit, else evicts the least-recent
+                # slot; the right child evicts the next least-recent.
+                # Evicted owners' map entries are invalidated so their
+                # future splits take the miss path.
+                stamps = state.slot_stamp
+                sl = jnp.where(have, jnp.maximum(sp, 0),
+                               jnp.argmin(stamps).astype(jnp.int32))
+                stamps1 = stamps.at[sl].set(
+                    jnp.where(proceed, i, stamps[sl]))
+                sr = jnp.argmin(stamps1).astype(jnp.int32)
+                own_l = state.slot_owner[sl]
+                own_r = state.slot_owner[sr]
+                slot_map = state.slot_map
+                inv_l = proceed & (own_l >= 0) & (own_l != l)
+                ols = jnp.maximum(own_l, 0)
+                slot_map = slot_map.at[ols].set(
+                    jnp.where(inv_l, -1, slot_map[ols]))
+                inv_r = proceed & (own_r >= 0) & (own_r != l)
+                ors = jnp.maximum(own_r, 0)
+                slot_map = slot_map.at[ors].set(
+                    jnp.where(inv_r, -1, slot_map[ors]))
+                slot_map = _set(slot_map, l, sl, proceed)
+                slot_map = _set(slot_map, new_leaf, sr, proceed)
+                slot_stamp = _set(stamps1, sr, i, proceed)
+                slot_owner = _set(_set(state.slot_owner, sl, l, proceed),
+                                  sr, new_leaf, proceed)
+                hist = state.hist.at[sl].set(
+                    jnp.where(proceed, hist_left, state.hist[sl]))
+                hist = hist.at[sr].set(
+                    jnp.where(proceed, hist_right, hist[sr]))
             else:
+                slot_map = state.slot_map
+                slot_stamp = state.slot_stamp
+                slot_owner = state.slot_owner
                 hist_parent = state.hist[l]
                 hist_large = hist_parent - hist_small
                 hist_left = jnp.where(left_smaller, hist_small, hist_large)
@@ -1347,7 +1463,8 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 best_cat=best_cat, tree_cat=tree_cat,
                 path_mask=path_mask, forced_ok=forced_ok, order=order,
                 seg=seg, leaf_flo=leaf_flo, leaf_fhi=leaf_fhi,
-                lsum=lsum)
+                lsum=lsum, slot_map=slot_map, slot_stamp=slot_stamp,
+                slot_owner=slot_owner)
 
         state = lax.fori_loop(0, L - 1, body, state)
 

@@ -805,6 +805,8 @@ class GBDT:
             limit_bytes = (cfg.histogram_pool_size * (1 << 20)
                            if cfg.histogram_pool_size >= 0 else 4 << 30)
             if pool_bytes > limit_bytes:
+                slot_bytes = n_phys * self.num_bin_max * 3 * 4
+                n_slots = int(limit_bytes // max(slot_bytes, 1))
                 if forced is not None:
                     log.warning(
                         "histogram pool exceeds the budget but forced "
@@ -817,6 +819,20 @@ class GBDT:
                         "histogram pool exceeds the budget but "
                         "monotone_constraints_method=intermediate re-scans "
                         "from it; keeping the full pool")
+                elif (n_slots >= 2 and
+                        self._tree_learner == "serial" and
+                        not self._multival):
+                    # LRU middle ground (≡ the reference's
+                    # histogram_pool_size-capped pool): cached parents
+                    # keep the subtraction trick; evicted parents
+                    # recompute both children
+                    self.grower_cfg = dataclasses.replace(
+                        self.grower_cfg, hist_pool="bounded",
+                        pool_slots=n_slots)
+                    log.info(
+                        f"histogram pool ({pool_bytes >> 20} MB) exceeds "
+                        f"the budget; bounded LRU pool with {n_slots} "
+                        "slots (recompute on miss)")
                 else:
                     self.grower_cfg = dataclasses.replace(
                         self.grower_cfg, hist_pool="none")

@@ -1028,6 +1028,10 @@ int LGBM_BoosterPredictForMats(BoosterHandle handle, const void** data,
     SetError("only float32 (0) / float64 (1) data are supported");
     return -1;
   }
+  if (ncol < m->max_feature_idx + 1) {
+    SetError("input has fewer columns than the model's features");
+    return -1;
+  }
   auto fill = [&](int64_t r, double* row) {
     if (data_type == 0) {
       const float* d = static_cast<const float*>(data[r]);
@@ -1064,9 +1068,13 @@ int LGBM_BoosterPredictForMatSingleRowFastInit(
     SetError("SingleRowFastInit: bad arguments");
     return -1;
   }
-  auto* fc = new FastConfig{static_cast<Model*>(handle), predict_type,
-                            start_iteration, num_iteration, data_type,
-                            ncol};
+  Model* fm = static_cast<Model*>(handle);
+  if (ncol < fm->max_feature_idx + 1) {
+    SetError("input has fewer columns than the model's features");
+    return -1;
+  }
+  auto* fc = new FastConfig{fm, predict_type, start_iteration,
+                            num_iteration, data_type, ncol};
   *out_fastConfig = fc;
   return 0;
 }
@@ -1380,7 +1388,11 @@ int LGBM_GetSampleCount(int32_t num_total_row, const char* parameters,
     if (pos != std::string::npos)
       cnt = std::atoi(ps.c_str() + pos + 25);
   }
-  *out = std::min<int32_t>(cnt, num_total_row);
+  if (cnt <= 0) {  // reference config validation: must be positive
+    SetError("bin_construct_sample_cnt must be positive");
+    return -1;
+  }
+  *out = std::min<int32_t>(cnt, std::max<int32_t>(num_total_row, 0));
   return 0;
 }
 

@@ -33,26 +33,30 @@ def test_poolless_matches_pooled(rng):
     bins_rm = np.ascontiguousarray(ds.bins.T)
 
     out = {}
-    for pool in ("full", "none"):
+    for pool in ("full", "none", "bounded"):
         gcfg = GrowerConfig(num_leaves=16, num_bin=B, hparams=hp,
                             block_rows=512, row_sched="compact",
                             hist_rm_backend="scatter", min_bucket=256,
-                            hist_pool=pool)
+                            hist_pool=pool,
+                            pool_slots=4 if pool == "bounded" else 0)
         grow = jax.jit(make_tree_grower(gcfg, meta))
         tree, leaf_id = grow(jnp.asarray(bins_rm), jnp.asarray(gh))
         out[pool] = (HostTree(jax.tree.map(np.asarray, tree),
                               ds.used_feature_map), np.asarray(leaf_id))
 
     hf, lf = out["full"]
-    hn, ln = out["none"]
-    assert hf.num_leaves == hn.num_leaves
-    np.testing.assert_array_equal(hf.split_feature_inner,
-                                  hn.split_feature_inner)
-    np.testing.assert_array_equal(hf.threshold_bin, hn.threshold_bin)
-    np.testing.assert_array_equal(lf, ln)
-    # leaf stats close (different summation order: subtraction vs direct)
-    np.testing.assert_allclose(hf.leaf_value[:16], hn.leaf_value[:16],
-                               rtol=1e-4, atol=1e-6)
+    for other in ("none", "bounded"):
+        hn, ln = out[other]
+        assert hf.num_leaves == hn.num_leaves, other
+        np.testing.assert_array_equal(hf.split_feature_inner,
+                                      hn.split_feature_inner)
+        np.testing.assert_array_equal(hf.threshold_bin, hn.threshold_bin)
+        np.testing.assert_array_equal(lf, ln)
+        # leaf stats close (different summation order: subtraction vs
+        # direct, and the 4-slot LRU mixes both per split)
+        np.testing.assert_allclose(hf.leaf_value[:16],
+                                   hn.leaf_value[:16],
+                                   rtol=1e-4, atol=1e-6)
 
 
 def test_wide_data_trains_via_auto_poolless(rng):
@@ -65,6 +69,21 @@ def test_wide_data_trains_via_auto_poolless(rng):
                      "verbose": -1, "max_bin": 63,
                      "histogram_pool_size": 1.0},   # 1 MB budget
                     lgb.Dataset(X, label=y), num_boost_round=5)
-    assert bst._engine.grower_cfg.hist_pool == "none"
+    # 1 MB fits a couple of slots -> the bounded LRU middle engages
+    assert bst._engine.grower_cfg.hist_pool == "bounded"
+    assert bst._engine.grower_cfg.pool_slots >= 2
     pred = bst.predict(X)
     assert np.mean((pred - y) ** 2) < y.var()
+
+
+def test_tiny_budget_falls_back_to_poolless(rng):
+    """A budget below two slots cannot host an LRU -> poolless."""
+    n, f = 800, 600
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] + rng.normal(scale=0.2, size=n)
+    bst = lgb.train({"objective": "regression", "num_leaves": 16,
+                     "verbose": -1, "max_bin": 63,
+                     "histogram_pool_size": 0.2},   # < 2 slots
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    assert bst._engine.grower_cfg.hist_pool == "none"
+    assert np.isfinite(bst.predict(X)).all()
