@@ -931,6 +931,17 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                         rec.feature, rec.threshold, rec.default_left,
                         ncat_a, cbins_a, colv)
 
+                def part_and_both():
+                    """Partition the leaf and histogram BOTH children
+                    (shared by the poolless and bounded-miss paths)."""
+                    order2, nL = do_partition()
+                    nR = rows_l - nL
+                    hl = lax.switch(bucket_branch(nL), hist_branches,
+                                    order2, start_l, nL, gh)
+                    hr = lax.switch(bucket_branch(nR), hist_branches,
+                                    order2, start_l + nL, nR, gh)
+                    return order2, nL, hl, hr
+
                 small_ctx = None
                 if pool_bounded:
                     # LRU hit: smaller child + sibling subtraction from
@@ -955,16 +966,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                         hr = jnp.where(lsm, large, h)
                         return order2, nL, hl, hr
 
-                    def miss_path():
-                        order2, nL = do_partition()
-                        nR = rows_l - nL
-                        hl = lax.switch(bucket_branch(nL),
-                                        hist_branches, order2, start_l,
-                                        nL, gh)
-                        hr = lax.switch(bucket_branch(nR),
-                                        hist_branches, order2,
-                                        start_l + nL, nR, gh)
-                        return order2, nL, hl, hr
+                    miss_path = part_and_both
 
                     order, nL_raw, hist_left_c, hist_right_c = lax.cond(
                         proceed,
@@ -976,12 +978,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                     hist_small = None
                 elif pool_none:
                     def do_part_hist2():
-                        order2, nL = do_partition()
-                        nR = rows_l - nL
-                        hl = lax.switch(bucket_branch(nL), hist_branches,
-                                        order2, start_l, nL, gh)
-                        hr = lax.switch(bucket_branch(nR), hist_branches,
-                                        order2, start_l + nL, nR, gh)
+                        order2, nL, hl, hr = part_and_both()
                         if local_pool:
                             return (order2, nL, hl[0], hr[0], hl[1],
                                     hr[1])

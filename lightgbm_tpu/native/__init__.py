@@ -23,7 +23,8 @@ _SO_PATH = os.path.join(_BUILD_DIR, "lgbm_native.so")
 _SRCS = [os.path.join(_HERE, "parser.cpp"),
          os.path.join(_HERE, "c_api.cpp"),
          os.path.join(_HERE, "c_api_train.cpp"),
-         os.path.join(_HERE, "shap.cpp")]
+         os.path.join(_HERE, "shap.cpp"),
+         os.path.join(_HERE, "arrow_ingest.cpp")]
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None

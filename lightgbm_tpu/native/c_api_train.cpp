@@ -31,8 +31,25 @@ void SetTrainError(const std::string& msg);  // fwd; shared with c_api.cpp
 typedef int (*PyRun_t)(const char*);
 typedef void (*PyInit_t)(int);
 typedef int (*PyIsInit_t)();
+typedef int (*PyGilEnsure_t)();
+typedef void (*PyGilRelease_t)(int);
 
+PyRun_t g_pyrun_raw = nullptr;
+PyGilEnsure_t g_gil_ensure = nullptr;
+PyGilRelease_t g_gil_release = nullptr;
+// kept as a flag name used throughout: non-null once bootstrapped
 PyRun_t g_pyrun = nullptr;
+
+// Every interpreter entry must hold the GIL. When the host process IS
+// python (ctypes callers: the FFI releases the GIL around the foreign
+// call), PyGILState_Ensure re-acquires it; when this library embedded
+// the interpreter itself, the pair is a no-op-ish recursion.
+int PyRunGil(const char* code) {
+  int st = g_gil_ensure ? g_gil_ensure() : 0;
+  int rc = g_pyrun_raw(code);
+  if (g_gil_release) g_gil_release(st);
+  return rc;
+}
 
 bool EnsurePython() {
   if (g_pyrun) return true;
@@ -50,13 +67,27 @@ bool EnsurePython() {
   }
   auto is_init = reinterpret_cast<PyIsInit_t>(dlsym(lib, "Py_IsInitialized"));
   auto init = reinterpret_cast<PyInit_t>(dlsym(lib, "Py_InitializeEx"));
-  g_pyrun = reinterpret_cast<PyRun_t>(dlsym(lib, "PyRun_SimpleString"));
-  if (!is_init || !init || !g_pyrun) {
+  g_pyrun_raw = reinterpret_cast<PyRun_t>(dlsym(lib, "PyRun_SimpleString"));
+  g_gil_ensure = reinterpret_cast<PyGilEnsure_t>(
+      dlsym(lib, "PyGILState_Ensure"));
+  g_gil_release = reinterpret_cast<PyGilRelease_t>(
+      dlsym(lib, "PyGILState_Release"));
+  if (!is_init || !init || !g_pyrun_raw) {
     SetTrainError("training C API: libpython is missing required symbols");
-    g_pyrun = nullptr;
+    g_pyrun_raw = nullptr;
     return false;
   }
-  if (!is_init()) init(0);
+  if (!is_init()) {
+    init(0);
+    // drop the GIL the initializing thread holds so that every entry
+    // goes through PyGILState_Ensure symmetrically — otherwise a later
+    // call from a DIFFERENT host thread would deadlock in Ensure
+    typedef void* (*PySave_t)();
+    auto save = reinterpret_cast<PySave_t>(
+        dlsym(lib, "PyEval_SaveThread"));
+    if (save) save();
+  }
+  g_pyrun = &PyRunGil;
 
   // bootstrap: make the package importable from the .so's own location
   // (<repo>/lightgbm_tpu/native/_build/lgbm_native.so -> <repo>)

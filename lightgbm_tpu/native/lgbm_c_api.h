@@ -320,6 +320,44 @@ int LGBM_SampleIndices(int32_t num_total_row, const char* parameters,
 int LGBM_DumpParamAliases(int64_t buffer_len, int64_t* out_len,
                           char* out_str);
 
+/* Arrow C data/stream interface ingestion (ref: c_api.h:461-480,
+ * :596-616, :1493-1536; struct ABI per the Apache Arrow spec) */
+struct ArrowArray;
+struct ArrowSchema;
+struct ArrowArrayStream;
+int LGBM_DatasetCreateFromArrow(int64_t n_chunks,
+                                struct ArrowArray* chunks,
+                                struct ArrowSchema* schema,
+                                const char* parameters,
+                                const DatasetHandle reference,
+                                DatasetHandle* out);
+int LGBM_DatasetCreateFromArrowStream(struct ArrowArrayStream* stream,
+                                      const char* parameters,
+                                      const DatasetHandle reference,
+                                      DatasetHandle* out);
+int LGBM_DatasetSetFieldFromArrow(DatasetHandle handle,
+                                  const char* field_name,
+                                  int64_t n_chunks,
+                                  struct ArrowArray* chunks,
+                                  struct ArrowSchema* schema);
+int LGBM_DatasetSetFieldFromArrowStream(DatasetHandle handle,
+                                        const char* field_name,
+                                        struct ArrowArrayStream* stream);
+int LGBM_BoosterPredictForArrow(BoosterHandle handle, int64_t n_chunks,
+                                struct ArrowArray* chunks,
+                                struct ArrowSchema* schema,
+                                int predict_type, int start_iteration,
+                                int num_iteration, const char* parameter,
+                                int64_t* out_len, double* out_result);
+int LGBM_BoosterPredictForArrowStream(BoosterHandle handle,
+                                      struct ArrowArrayStream* stream,
+                                      int predict_type,
+                                      int start_iteration,
+                                      int num_iteration,
+                                      const char* parameter,
+                                      int64_t* out_len,
+                                      double* out_result);
+
 /* network (ref: c_api.h:1655-1682) */
 int LGBM_NetworkInit(const char* machines, int local_listen_port,
                      int listen_time_out, int num_machines);
