@@ -229,7 +229,8 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                      reduce_max: Optional[Callable] = None,
                      localize_key: Optional[Callable] = None,
                      prepare_is_pure: bool = False,
-                     local_pool: bool = False):
+                     local_pool: bool = False,
+                     mc_rescan_hooks_ok: bool = False):
     """Build the tree-growing function for a fixed dataset geometry.
 
     Returns ``grow(bins_t, gh, feature_mask, cegb) -> (TreeArrays, leaf_id)``
@@ -445,10 +446,17 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         if cfg.extra_trees:
             raise ValueError("monotone_constraints_method=intermediate "
                              "does not compose with extra_trees")
-        if has_scan_hooks:
+        if has_scan_hooks and not mc_rescan_hooks_ok:
+            # the rescan re-applies the scan hooks under a lax.cond; a
+            # learner may opt in when (a) its hooks are pure functions
+            # of (hist, ctx, mask) so re-application is sound, and (b)
+            # the cond predicate is REPLICATED across the mesh, so its
+            # collectives execute uniformly (the voting learner
+            # qualifies; feature-parallel's boxes would need GLOBAL
+            # feature geometry its sharded meta cannot express)
             raise ValueError("monotone_constraints_method=intermediate "
-                             "is supported with the serial and data "
-                             "learners only")
+                             "is supported with the serial, data and "
+                             "voting learners")
     use_ic = cfg.interaction_groups is not None
     if forced is not None:
         forced_active = jnp.asarray(forced[0], bool)
@@ -1418,13 +1426,20 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 def _rescan(args):
                     best_in, bcat_in = args
                     hp_all = conv(hist)
+                    lsums_all = conv(lsum) if local_pool else None
                     if bundled:
-                        hp_all = jax.vmap(expand_hist)(
-                            hp_all, stats[:, S_SG], stats[:, S_SH],
-                            stats[:, S_CNT])
+                        if local_pool:
+                            # LOCAL pool: expand with the shard's totals
+                            hp_all = jax.vmap(expand_hist)(
+                                hp_all, lsums_all[:, 0],
+                                lsums_all[:, 1], lsums_all[:, 2])
+                        else:
+                            hp_all = jax.vmap(expand_hist)(
+                                hp_all, stats[:, S_SG], stats[:, S_SH],
+                                stats[:, S_CNT])
 
                     def one(hh, sg_, sh_, cn_, out_, mn_, mx_, dp_, nrow,
-                            pj):
+                            pj, ls):
                         fm = feature_mask
                         if cfg.bynode_mask and fm is not None:
                             fm = fm[jnp.minimum(nrow, fm.shape[0] - 1)]
@@ -1433,7 +1448,8 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                             fm = al if fm is None else fm & al
                         return best_of(hh, sg_, sh_, cn_, out_, fm,
                                        leaf_range=(mn_, mx_),
-                                       leaf_depth=dp_, cegb=cegb)
+                                       leaf_depth=dp_, cegb=cegb,
+                                       lsum3=ls)
 
                     pj_arg = (path_mask if use_ic
                               else jnp.zeros((L, 1), bool))
@@ -1441,7 +1457,8 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                         hp_all, stats[:, S_SG], stats[:, S_SH],
                         stats[:, S_CNT], stats[:, S_VAL], nmin, nmax,
                         stats[:, S_DEPTH].astype(jnp.int32),
-                        stats[:, S_NROW].astype(jnp.int32), pj_arg)
+                        stats[:, S_NROW].astype(jnp.int32), pj_arg,
+                        lsums_all)
                     bo = jnp.where(changed[:, None], pack_rec(new_recs),
                                    best_in)
                     bc = (jnp.where(changed[:, None], new_recs.cat_bins,

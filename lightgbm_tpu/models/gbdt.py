@@ -522,10 +522,11 @@ class GBDT:
         if monotone is not None:
             if mc_method in ("intermediate", "advanced") and (
                     cfg.extra_trees or
-                    cfg.tree_learner in ("voting", "feature")):
+                    cfg.tree_learner == "feature"):
                 log.warning(f"monotone_constraints_method={mc_method} is "
-                            "supported with the serial/data learners and "
-                            "without extra_trees; using 'basic'")
+                            "supported with the serial/data/voting "
+                            "learners and without extra_trees; using "
+                            "'basic'")
                 mc_method = "basic"
         contri = None
         if cfg.feature_contri:

@@ -201,11 +201,23 @@ bool ColumnToF64(int64_t n_chunks, const ArrowArray* chunks,
   out->clear();
   out->reserve(static_cast<size_t>(R));
   for (int64_t c = 0; c < n_chunks; ++c) {
-    const ArrowArray* a = wrapped && chunks[c].n_children == 1
-                              ? chunks[c].children[0] : &chunks[c];
+    const ArrowArray& ch = chunks[c];
+    const bool is_struct = wrapped && ch.n_children == 1;
+    const ArrowArray* a = is_struct ? ch.children[0] : &ch;
     ColumnReader rd;
     if (!rd.Init(cs, a, err)) return false;
-    for (int64_t i = 0; i < a->length; ++i) out->push_back(rd.At(i));
+    // wrapped case: the PARENT struct's length/offset/validity govern
+    // the logical rows (a sliced export keeps the child unsliced)
+    const int64_t poff = is_struct ? ch.offset : 0;
+    const uint8_t* pvalid =
+        is_struct && ch.n_buffers >= 1
+            ? static_cast<const uint8_t*>(ch.buffers[0]) : nullptr;
+    for (int64_t i = 0; i < ch.length; ++i) {
+      const bool prow_null = pvalid && !BitSet(pvalid, i + poff);
+      out->push_back(prow_null
+                         ? std::numeric_limits<double>::quiet_NaN()
+                         : rd.At(i + poff));
+    }
   }
   return true;
 }

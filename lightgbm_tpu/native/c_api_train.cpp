@@ -81,11 +81,16 @@ bool EnsurePython() {
     init(0);
     // drop the GIL the initializing thread holds so that every entry
     // goes through PyGILState_Ensure symmetrically — otherwise a later
-    // call from a DIFFERENT host thread would deadlock in Ensure
-    typedef void* (*PySave_t)();
-    auto save = reinterpret_cast<PySave_t>(
-        dlsym(lib, "PyEval_SaveThread"));
-    if (save) save();
+    // call from a DIFFERENT host thread would deadlock in Ensure.
+    // Only safe when the Ensure/Release pair resolved; without them,
+    // keeping the GIL on this thread is the working single-threaded
+    // contract.
+    if (g_gil_ensure && g_gil_release) {
+      typedef void* (*PySave_t)();
+      auto save = reinterpret_cast<PySave_t>(
+          dlsym(lib, "PyEval_SaveThread"));
+      if (save) save();
+    }
   }
   g_pyrun = &PyRunGil;
 

@@ -107,7 +107,11 @@ def make_voting_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
             k, lax.axis_index(data_axis)),
         prepare_split_hist=prepare,
         bundle=bundle, fetch_bin_column=fetch_bin_column,
-        local_pool=True)
+        local_pool=True,
+        # the vote/psum is a pure function of (hist, ctx, mask) and the
+        # rescan's cond predicate is replicated -> collectives execute
+        # uniformly on every device (refined monotone modes compose)
+        mc_rescan_hooks_ok=True)
 
     def wrapped(bins_t, gh, feature_mask, cegb_const, cegb_count, rng_key):
         return grow(bins_t, gh, feature_mask, (cegb_const, cegb_count),

@@ -127,3 +127,33 @@ def test_voting_topk_restriction_still_learns():
     assert np.isfinite(p).all()
     auc_like = np.mean((p[y == 1][:, None] > p[y == 0][None, :]))
     assert auc_like > 0.7
+
+
+@pytest.mark.parametrize("method", ["intermediate", "advanced"])
+def test_voting_refined_monotone_matches_serial(method):
+    """Refined monotone modes under the voting learner (rescan's
+    vote/psum runs under a REPLICATED cond, so its collectives are
+    uniform across the mesh)."""
+    rng = np.random.default_rng(21)
+    n = 800
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (X[:, 0] * 1.2 + np.square(X[:, 1]) * 0.3 +
+         0.05 * rng.normal(size=n)).astype(np.float32)
+    base = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+            "min_data_in_leaf": 5, "seed": 1,
+            "monotone_constraints": [1, 0, 0, 0, 0],
+            "monotone_constraints_method": method,
+            "use_quantized_grad": True, "stochastic_rounding": False}
+    b_ser = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=4)
+    b_vote = lgb.train({**base, "tree_learner": "voting",
+                        "tpu_num_devices": -1},
+                       lgb.Dataset(X, label=y), num_boost_round=4)
+    assert b_vote._engine.grower_cfg.mc_method == method
+    p_ser, p_vote = b_ser.predict(X), b_vote.predict(X)
+    assert np.isfinite(p_vote).all()
+    # top_k >= F: voting aggregates every feature -> identical splits
+    np.testing.assert_allclose(p_vote, p_ser, rtol=1e-5, atol=1e-6)
+    # monotonicity actually enforced along feature 0
+    Xp = X.copy()
+    Xp[:, 0] += 1.0
+    assert np.all(b_vote.predict(Xp) >= p_vote - 1e-6)
