@@ -334,13 +334,15 @@ def _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
     # hi = num_bin-1 - (1 if na_as_missing): NaN bin excluded => goes left.
     hi = nbin - 1 - na_as_missing.astype(jnp.int32)
     rev_mask = (acc_mask & (bin_idx <= hi)).astype(hist.dtype)
-    # suffix sums: rg_acc[t] = sum_{b>=t} masked
-    def suffix(x, m):
-        xm = x * m
-        return jnp.cumsum(xm[:, ::-1], axis=1)[:, ::-1]
-    rg_acc = suffix(g, rev_mask)
-    rh_acc = suffix(h, rev_mask) + K_EPSILON
-    rc_acc = suffix(c, rev_mask)
+    # suffix sums, all three channels in ONE cumsum (the split loop's
+    # fixed cost is kernel count; cumsum breaks fusion, so batching the
+    # channels saves two kernels per scan direction)
+    ghc = jnp.stack([g, h, c])                               # [3, F, B]
+    sfx = jnp.cumsum((ghc * rev_mask[None])[:, :, ::-1],
+                     axis=2)[:, :, ::-1]
+    rg_acc = sfx[0]
+    rh_acc = sfx[1] + K_EPSILON
+    rc_acc = sfx[2]
     # candidate threshold thr means right side accumulates bins >= thr+1
     # shift left by one: right_at_thr[t] = acc[t+1]
     pad = jnp.zeros((F, 1), hist.dtype)
@@ -383,9 +385,10 @@ def _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
 
     # ---------------- FORWARD scan: left side accumulates 0..t -------------
     fwd_mask = (acc_mask & (bin_idx <= nbin - 2)).astype(hist.dtype)
-    lg_acc = jnp.cumsum(g * fwd_mask, axis=1)
-    lh_acc = jnp.cumsum(h * fwd_mask, axis=1) + K_EPSILON
-    lc_acc = jnp.cumsum(c * fwd_mask, axis=1)
+    pfx = jnp.cumsum(ghc * fwd_mask[None], axis=2)
+    lg_acc = pfx[0]
+    lh_acc = pfx[1] + K_EPSILON
+    lc_acc = pfx[2]
     rg_fwd, rh_fwd, rc_fwd = side_stats(lg_acc, lh_acc, lc_acc)
     gains_fwd, valid_fwd = gains_and_validity(lg_acc, lh_acc, lc_acc,
                                               rg_fwd, rh_fwd, rc_fwd)
