@@ -9,9 +9,12 @@
 // the Python API uses. State lives in the embedded interpreter; handles
 // carry an id into it.
 //
-// Threading: calls must come from one thread (the embedding keeps the
-// GIL of the initializing thread). This matches the CLI-style training
-// usage the surface targets.
+// Threading: entry points serialize on RunGuarded's mutex and
+// acquire/release the GIL symmetrically (PyGILState_Ensure around every
+// interpreter entry; the self-embedding path drops the GIL after
+// initialization), so calls may come from any host thread — including
+// Python hosts whose FFI released the GIL — one at a time. The
+// lock-free fast predict paths live on the serving side (c_api.cpp).
 #include <dlfcn.h>
 
 #include <algorithm>

@@ -21,6 +21,10 @@ COMBOS = [
          interaction_constraints="[0,1,2],[3,4,5,6,7,8,9]"),
     dict(extra_trees=True, tree_learner="data", tpu_num_devices=-1),
     dict(use_quantized_grad=True, histogram_pool_size=0.0001),  # poolless
+    # bounded LRU pool (a few slots) x quantized int32 histograms
+    dict(use_quantized_grad=True, histogram_pool_size=0.3),
+    # bounded pool under async boosting's sync fallback machinery
+    dict(histogram_pool_size=0.3, tpu_async_boosting="true"),
 ]
 
 
