@@ -378,16 +378,22 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     bundled = bundle is not None
     if bundled:
         # EFB composes with data-parallel (group hists psum across row
-        # shards; the scan-time expansion is replicated) and — via the
-        # local-sums channel (local_pool) — with voting: expansion uses
-        # LOCAL leaf totals, so the vote ranks correct local logical
-        # hists and psums only the selected features. Feature-parallel
-        # shards logical columns, still incompatible with the
-        # physical-group layout.
-        if (has_scan_hooks and not local_pool) or feat_sharded:
-            raise ValueError("EFB bundling does not compose with the "
-                             "feature learner (voting needs the "
-                             "local-sums channel: local_pool=True)")
+        # shards; the scan-time expansion is replicated), with voting
+        # via the local-sums channel (local_pool: expansion uses LOCAL
+        # leaf totals, so the vote ranks correct local logical hists),
+        # and with feature-parallel (feat_sharded: the bundle arrives
+        # as the shard's LOCAL group layout and the partition column is
+        # owner-decoded inside fetch_bin_column, so no global decode
+        # happens here).
+        # only an impure PREPARE hook (voting's vote/psum over LOCAL
+        # hists) needs the local-sums channel; select_best merges after
+        # the scan and is layout-agnostic (feature-parallel's rows are
+        # replicated, so its pool holds GLOBAL sums)
+        if (prepare_split_hist is not None and not prepare_is_pure and
+                not local_pool):
+            raise ValueError("EFB bundling with an impure scan hook "
+                             "needs the local-sums channel "
+                             "(local_pool=True)")
         b_gmap = jnp.asarray(bundle["gather_map"], jnp.int32)     # [F, B]
         b_group = jnp.asarray(bundle["group"], jnp.int32)         # [F]
         b_offset = jnp.asarray(bundle["offset"], jnp.int32)       # [F]
@@ -891,11 +897,13 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 # from the final segments after the loop
                 leaf_id = state.leaf_id
             else:
-                if bundled:
+                if bundled and not feat_sharded:
                     fsafe = jnp.maximum(rec.feature, 0)
                     bin_col = decode_bin(
                         fetch_bin_column(bins_t, b_group[fsafe]), fsafe)
                 else:
+                    # feature-sharded EFB: fetch_bin_column already
+                    # returns the owner-decoded LOGICAL column
                     bin_col = fetch_bin_column(bins_t, rec.feature)
                 go_left = _go_left_bins(
                     bin_col, rec.threshold, rec.default_left, rec.feature,

@@ -734,7 +734,8 @@ class GBDT:
             log.warning("forced splits with EFB bundling are untested; "
                         "disabling bundling")
         elif (cfg.enable_bundle and
-                self._tree_learner in ("serial", "data", "voting") and
+                self._tree_learner in ("serial", "data", "voting",
+                                       "feature") and
                 train.bins is not None and train.num_used_features > 1):
             from ..io.bundling import find_bundles, pack_bins
             nb_used = np.asarray([m.num_bin for m in mappers], np.int64)
@@ -1068,11 +1069,23 @@ class GBDT:
             if bins_host is None:
                 bins_host = train.bins
             mesh = build_mesh(n_dev, axis_names=(FEATURE_AXIS,))
-            Fp = padded_features(F, n_dev)
-            self._feat_pad = Fp - F
-            bins = bins_host
-            if self._feat_pad:
-                bins = np.pad(bins, ((0, self._feat_pad), (0, 0)))
+            if self._bundle is not None:
+                # EFB: the sharded storage axis is PHYSICAL GROUPS —
+                # pad the packed bins to a group count divisible by the
+                # mesh (masks/cegb stay global-logical; the grower
+                # permutes them into the shard layout)
+                self._feat_pad = 0
+                from ..parallel.feature_parallel import padded_groups
+                G = int(self._bundle["num_groups"])
+                bins = np.pad(bins_host,
+                              ((0, padded_groups(G, n_dev) - G),
+                               (0, 0)))
+            else:
+                Fp = padded_features(F, n_dev)
+                self._feat_pad = Fp - F
+                bins = bins_host
+                if self._feat_pad:
+                    bins = np.pad(bins, ((0, self._feat_pad), (0, 0)))
             if self._compact:
                 self.bins_sharded = jax.device_put(
                     np.ascontiguousarray(bins.T),
@@ -1080,9 +1093,14 @@ class GBDT:
             else:
                 self.bins_sharded = jax.device_put(
                     bins, NamedSharding(mesh, P(FEATURE_AXIS, None)))
-            meta_p = pad_feature_meta(self.feature_meta, Fp)
-            grow = make_feature_parallel_grower(self.grower_cfg, meta_p,
-                                                mesh)
+            if self._bundle is not None:
+                grow = make_feature_parallel_grower(
+                    self.grower_cfg, self.feature_meta, mesh,
+                    bundle=self._bundle)
+            else:
+                meta_p = pad_feature_meta(self.feature_meta, Fp)
+                grow = make_feature_parallel_grower(self.grower_cfg,
+                                                    meta_p, mesh)
             self._grow_dist = jax.jit(grow)
         self._mesh = mesh
 

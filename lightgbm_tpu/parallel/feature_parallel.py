@@ -14,8 +14,11 @@ dimension. Per split step, each device:
    1/D — the whole point of feature-parallel for wide data);
 2. runs the split scan on its slice (local FeatureMeta slice);
 3. `all_gather`s the D candidate SplitRecords and takes the argmax —
-   gathered in device order, so ties resolve to the smaller global
-   feature index exactly like SplitInfo::operator>;
+   gathered in device order, so for contiguous (unbundled) slices ties
+   resolve to the smaller global feature index exactly like
+   SplitInfo::operator>; under EFB the scan order is the group layout,
+   so exact-gain ties may resolve to a different (equally optimal)
+   feature than the serial scan;
 4. broadcasts the winning feature's bin column with a one-hot psum
    (the owner contributes the column, everyone else zeros) and
    partitions its full local row set — no split-result broadcast of row
@@ -44,6 +47,13 @@ def padded_features(num_features: int, num_shards: int) -> int:
     return _pad_to_multiple(num_features, num_shards)
 
 
+def padded_groups(num_groups: int, num_shards: int) -> int:
+    """Padded PHYSICAL group count for the EFB-sharded feature learner
+    (single source of truth for gbdt's bin padding and shard_bundle's
+    per-shard layout)."""
+    return _pad_to_multiple(num_groups, num_shards)
+
+
 def pad_feature_meta(meta: FeatureMeta, target_f: int) -> FeatureMeta:
     """Pad meta arrays with trivial 1-bin features (never splittable)."""
     F = meta.num_bin.shape[0]
@@ -64,27 +74,120 @@ def pad_feature_meta(meta: FeatureMeta, target_f: int) -> FeatureMeta:
     )
 
 
+def shard_bundle(bundle: dict, meta: FeatureMeta, num_shards: int,
+                 B: int):
+    """Host-side EFB layout for the feature learner: physical GROUPS
+    shard contiguously ([Gd] per device); each device's LOGICAL
+    features (those living in its groups) are padded to a common width
+    Fd with 1-bin never-splittable dummies. Returns the stacked
+    per-shard meta/bundle arrays, the local->global logical id map, and
+    the padded group count (for padding the packed bins).
+    """
+    group = np.asarray(bundle["group"], np.int64)          # [F] global
+    offset = np.asarray(bundle["offset"], np.int64)
+    default_bin = np.asarray(bundle["default_bin"], np.int64)
+    num_bin_l = np.asarray(bundle["num_bin"], np.int64)
+    G = int(bundle["num_groups"])
+    D = num_shards
+    Gd = padded_groups(G, D) // D
+    feats = [np.where((group >= d * Gd) & (group < (d + 1) * Gd))[0]
+             for d in range(D)]
+    Fd = max(max((len(f) for f in feats), default=1), 1)
+
+    glob_ids = np.full((D, Fd), -1, np.int32)
+    l_group = np.zeros((D, Fd), np.int32)
+    l_offset = np.zeros((D, Fd), np.int32)
+    l_default = np.zeros((D, Fd), np.int32)
+    l_nbin = np.ones((D, Fd), np.int32)
+    l_gmap = np.full((D, Fd, B), -1, np.int64)
+    m_nbin = np.ones((D, Fd), np.int32)
+    m_miss = np.zeros((D, Fd), np.int32)
+    m_dflt = np.zeros((D, Fd), np.int32)
+    m_cat = np.zeros((D, Fd), bool)
+    m_mono = (np.zeros((D, Fd), np.int32)
+              if meta.monotone is not None else None)
+    m_pen = (np.ones((D, Fd), np.float32)
+             if meta.penalty is not None else None)
+    nb_np = np.asarray(meta.num_bin)
+    ms_np = np.asarray(meta.missing_type)
+    df_np = np.asarray(meta.default_bin)
+    ct_np = np.asarray(meta.is_categorical)
+    mono_np = None if m_mono is None else np.asarray(meta.monotone)
+    pen_np = None if m_pen is None else np.asarray(meta.penalty)
+    gmap_global = np.asarray(bundle["gather_map"], np.int64)  # [F, B]
+    for d in range(D):
+        for j, f in enumerate(feats[d]):
+            gl = int(group[f]) - d * Gd                   # LOCAL group
+            glob_ids[d, j] = f
+            l_group[d, j] = gl
+            l_offset[d, j] = offset[f]
+            l_default[d, j] = default_bin[f]
+            l_nbin[d, j] = num_bin_l[f]
+            # local flat indices into the shard's [Gd*B] hist: the
+            # global map's rows shift by the shard's group base (single
+            # source of truth: BundleInfo.build_gather_map)
+            gm = gmap_global[f]
+            l_gmap[d, j] = np.where(gm >= 0, gm - d * Gd * B, -1)
+            m_nbin[d, j] = nb_np[f]
+            m_miss[d, j] = ms_np[f]
+            m_dflt[d, j] = df_np[f]
+            m_cat[d, j] = ct_np[f]
+            if m_mono is not None:
+                m_mono[d, j] = mono_np[f]
+            if m_pen is not None:
+                m_pen[d, j] = pen_np[f]
+    meta_stacked = FeatureMeta(
+        num_bin=jnp.asarray(m_nbin), missing_type=jnp.asarray(m_miss),
+        default_bin=jnp.asarray(m_dflt), is_categorical=jnp.asarray(m_cat),
+        monotone=None if m_mono is None else jnp.asarray(m_mono),
+        penalty=None if m_pen is None else jnp.asarray(m_pen))
+    bundle_stacked = dict(
+        gather_map=jnp.asarray(l_gmap), group=jnp.asarray(l_group),
+        offset=jnp.asarray(l_offset), default_bin=jnp.asarray(l_default),
+        num_bin=jnp.asarray(l_nbin))
+    return (meta_stacked, bundle_stacked, jnp.asarray(glob_ids),
+            D * Gd, feats, Fd)
+
+
 def make_feature_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
                                  mesh: Mesh,
-                                 feature_axis: str = FEATURE_AXIS):
+                                 feature_axis: str = FEATURE_AXIS,
+                                 bundle: Optional[dict] = None):
     """Build grow(bins_t, gh) with bins sharded on the FEATURE dim over
     `feature_axis` (F must divide the axis size — pad with
     pad_feature_meta / zero bin rows): [F, R] in full mode, row-major
     [R, F] under compact scheduling (the partition column then arrives
     via the once-per-split owner broadcast). gh is replicated. Returns a
     replicated tree and leaf_id.
+
+    With ``bundle`` (EFB), the sharded storage axis is PHYSICAL GROUPS
+    (pad the packed bins to the returned padded group count); each
+    device expands its group histograms to its own logical features and
+    scans those, the winner's local logical index translates to the
+    TRUE global feature id, and the owner broadcasts the DECODED
+    logical column for partitioning. ``feature_mask``/``cegb`` stay in
+    GLOBAL logical order; grow_fn permutes them into the shard layout.
     """
     D = mesh.shape[feature_axis]
     F_total = int(meta.num_bin.shape[0])
-    assert F_total % D == 0, "pad features to a multiple of the axis size"
-    Fd = F_total // D
+    bundled = bundle is not None
+    if bundled:
+        (meta_stacked, bundle_stacked, glob_ids, _G_pad, _feats,
+         Fd) = shard_bundle(bundle, meta, D, cfg.num_bin)
+        # the shard layout's global-logical permutation IS glob_ids
+        perm_j = glob_ids.reshape(-1).astype(jnp.int64)
+        Fd_shard = Fd
+    else:
+        assert F_total % D == 0, \
+            "pad features to a multiple of the axis size"
+        Fd_shard = F_total // D
 
-    def shard_meta(m):
-        return jax.tree.map(
-            lambda a: a.reshape(D, Fd, *a.shape[1:]) if a is not None
-            else None, m)
+        def shard_meta(m):
+            return jax.tree.map(
+                lambda a: a.reshape(D, Fd_shard, *a.shape[1:])
+                if a is not None else None, m)
 
-    meta_stacked = shard_meta(meta)
+        meta_stacked = shard_meta(meta)
 
     def make_local_grow():
         def local_meta():
@@ -92,8 +195,55 @@ def make_feature_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
             return jax.tree.map(
                 lambda a: a[idx] if a is not None else None, meta_stacked)
 
+        if bundled:
+            def local_ids():
+                return glob_ids[lax.axis_index(feature_axis)]
+
+            def select_best(rec: SplitRecord) -> SplitRecord:
+                ids = local_ids()
+                fsafe = jnp.clip(rec.feature, 0, Fd_shard - 1)
+                rec_g = rec._replace(feature=jnp.where(
+                    rec.feature >= 0, ids[fsafe], -1))
+                allr = jax.tree.map(
+                    lambda a: lax.all_gather(a, feature_axis), rec_g)
+                win = jnp.argmax(allr.gain).astype(jnp.int32)
+                return jax.tree.map(lambda a: a[win], allr)
+
+            def fetch_bin_column(bins_local, f_global):
+                # owner finds its local logical slot, decodes the
+                # group column to the LOGICAL bin, and broadcasts
+                ids = local_ids()
+                hit = ids == jnp.maximum(f_global, 0)
+                own = jnp.any(hit) & (f_global >= 0)
+                f_local = jnp.argmax(hit).astype(jnp.int32)
+                bs = bundle_stacked
+                d = lax.axis_index(feature_axis)
+                g_local = bs["group"][d, f_local]
+                axis = 1 if cfg.row_sched == "compact" else 0
+                col_phys = jnp.take(bins_local, g_local,
+                                    axis=axis).astype(jnp.int32)
+                off = bs["offset"][d, f_local]
+                nb = bs["num_bin"][d, f_local]
+                dflt = bs["default_bin"][d, f_local]
+                rel = col_phys - off
+                act = (rel >= 0) & (rel < nb - 1)
+                col = jnp.where(act, rel + (rel >= dflt), dflt)
+                col = jnp.where(own, col, 0)
+                return lax.psum(col, feature_axis)
+
+            def local_bundle():
+                d = lax.axis_index(feature_axis)
+                return {k: v[d] for k, v in bundle_stacked.items()}
+
+            return make_tree_grower(
+                cfg, local_meta(),
+                select_best=select_best,
+                fetch_bin_column=fetch_bin_column,
+                partition_meta=meta,
+                bundle=local_bundle())
+
         def select_best(rec: SplitRecord) -> SplitRecord:
-            offset = lax.axis_index(feature_axis) * Fd
+            offset = lax.axis_index(feature_axis) * Fd_shard
             rec_g = rec._replace(feature=jnp.where(
                 rec.feature >= 0, rec.feature + offset, -1))
             # [D] per-leaf candidates in device (= feature-offset) order
@@ -103,13 +253,13 @@ def make_feature_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
             return jax.tree.map(lambda a: a[win], allr)
 
         def fetch_bin_column(bins_local, f_global):
-            offset = lax.axis_index(feature_axis) * Fd
+            offset = lax.axis_index(feature_axis) * Fd_shard
             f_local = f_global - offset
-            own = (f_local >= 0) & (f_local < Fd) & (f_global >= 0)
+            own = (f_local >= 0) & (f_local < Fd_shard) & (f_global >= 0)
             # full mode stores [F_local, R]; compact stores row-major
             # [R, F_local]
             axis = 1 if cfg.row_sched == "compact" else 0
-            col = jnp.take(bins_local, jnp.clip(f_local, 0, Fd - 1),
+            col = jnp.take(bins_local, jnp.clip(f_local, 0, Fd_shard - 1),
                            axis=axis).astype(jnp.int32)
             col = jnp.where(own, col, 0)
             # owner broadcast (≡ "no broadcast needed" in the reference
@@ -154,6 +304,19 @@ def make_feature_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
                     jnp.zeros(F_total, jnp.float32))
         if rng_key is None:
             rng_key = jax.random.PRNGKey(0)
+        if bundled:
+            # global-logical-order vectors -> the shard layout (padded
+            # slots masked off / zero-penalized)
+            pad_ok = perm_j >= 0
+            psafe = jnp.maximum(perm_j, 0)
+            if feature_mask.ndim == 2:
+                feature_mask = jnp.where(pad_ok[None, :],
+                                         feature_mask[:, psafe], False)
+            else:
+                feature_mask = jnp.where(pad_ok, feature_mask[psafe],
+                                         False)
+            cegb = (jnp.where(pad_ok, cegb[0][psafe], 0.0),
+                    jnp.where(pad_ok, cegb[1][psafe], 0.0))
         return sharded(bins_t, gh, feature_mask, cegb[0], cegb[1], rng_key)
 
     return grow_fn

@@ -161,3 +161,20 @@ def test_voting_refined_monotone_matches_serial(method):
     Xp = X.copy()
     Xp[:, 0] += 1.0
     assert np.all(b_vote.predict(Xp) >= p_vote - 1e-6)
+
+
+def test_feature_parallel_efb_matches_serial():
+    """EFB under the feature-parallel learner: physical GROUPS shard
+    across the mesh, each device expands/scans its own logical
+    features, and the owner broadcasts the DECODED split column."""
+    X, y = _sparse_onehot_data(seed=14)
+    bst_s, p_serial = _train_predict(
+        X, y, enable_bundle=True, tpu_sparse_storage="none")
+    bst_f, p_feat = _train_predict(
+        X, y, enable_bundle=True, tpu_sparse_storage="none",
+        tree_learner="feature", tpu_num_devices=-1)
+    assert bst_f._engine._bundle is not None, "EFB did not engage"
+    assert np.isfinite(p_feat).all()
+    # every device scans its slice exhaustively -> same split set; only
+    # gain ties could differ (scan order is permuted by group layout)
+    np.testing.assert_allclose(p_feat, p_serial, rtol=1e-5, atol=1e-6)
