@@ -415,12 +415,9 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
 
         def decode_bin(col_phys, f):
             """Physical group column -> logical bin of feature f."""
-            off = b_offset[f]
-            nb = b_nbin[f]
-            d = b_default[f]
-            rel = col_phys - off
-            act = (rel >= 0) & (rel < nb - 1)
-            return jnp.where(act, rel + (rel >= d), d)
+            from ..io.bundling import decode_logical_bin
+            return decode_logical_bin(col_phys, b_offset[f], b_nbin[f],
+                                      b_default[f])
     if reduce_hist is None:
         reduce_hist = lambda h, ctx=None: h
     if reduce_sums is None:

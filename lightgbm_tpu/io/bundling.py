@@ -166,3 +166,13 @@ def pack_bins(bins: np.ndarray, info: BundleInfo) -> np.ndarray:
         vals = info.offset[f] + shifted
         out[g, act] = vals[act].astype(dtype)
     return out
+
+
+def decode_logical_bin(col_phys, offset, num_bin, default_bin):
+    """Physical group bin -> logical feature bin (shared by the grower's
+    decode_bin and the feature-parallel owner broadcast; single source
+    of truth for the EFB packing's inverse)."""
+    import jax.numpy as jnp
+    rel = col_phys - offset
+    act = (rel >= 0) & (rel < num_bin - 1)
+    return jnp.where(act, rel + (rel >= default_bin), default_bin)

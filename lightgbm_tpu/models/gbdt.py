@@ -790,7 +790,9 @@ class GBDT:
                                 "storing unpacked")
                 self.bins_rf = jnp.asarray(
                     np.ascontiguousarray(train_bins_host.T))
-        elif self._bundle is not None:
+        elif self._bundle is not None and self._tree_learner == "serial":
+            # distributed learners train from their own sharded copy;
+            # a replicated upload here would just duplicate the matrix
             self._bins_packed_dev = jnp.asarray(train_bins_host)
         if self._packed_cols:
             self.grower_cfg = dataclasses.replace(

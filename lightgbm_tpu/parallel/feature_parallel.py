@@ -99,7 +99,7 @@ def shard_bundle(bundle: dict, meta: FeatureMeta, num_shards: int,
     l_offset = np.zeros((D, Fd), np.int32)
     l_default = np.zeros((D, Fd), np.int32)
     l_nbin = np.ones((D, Fd), np.int32)
-    l_gmap = np.full((D, Fd, B), -1, np.int64)
+    l_gmap = np.full((D, Fd, B), -1, np.int32)
     m_nbin = np.ones((D, Fd), np.int32)
     m_miss = np.zeros((D, Fd), np.int32)
     m_dflt = np.zeros((D, Fd), np.int32)
@@ -114,7 +114,7 @@ def shard_bundle(bundle: dict, meta: FeatureMeta, num_shards: int,
     ct_np = np.asarray(meta.is_categorical)
     mono_np = None if m_mono is None else np.asarray(meta.monotone)
     pen_np = None if m_pen is None else np.asarray(meta.penalty)
-    gmap_global = np.asarray(bundle["gather_map"], np.int64)  # [F, B]
+    gmap_global = np.asarray(bundle["gather_map"], np.int32)  # [F, B]
     for d in range(D):
         for j, f in enumerate(feats[d]):
             gl = int(group[f]) - d * Gd                   # LOCAL group
@@ -175,7 +175,7 @@ def make_feature_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
         (meta_stacked, bundle_stacked, glob_ids, _G_pad, _feats,
          Fd) = shard_bundle(bundle, meta, D, cfg.num_bin)
         # the shard layout's global-logical permutation IS glob_ids
-        perm_j = glob_ids.reshape(-1).astype(jnp.int64)
+        perm_j = glob_ids.reshape(-1)
         Fd_shard = Fd
     else:
         assert F_total % D == 0, \
@@ -222,12 +222,11 @@ def make_feature_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 axis = 1 if cfg.row_sched == "compact" else 0
                 col_phys = jnp.take(bins_local, g_local,
                                     axis=axis).astype(jnp.int32)
-                off = bs["offset"][d, f_local]
-                nb = bs["num_bin"][d, f_local]
-                dflt = bs["default_bin"][d, f_local]
-                rel = col_phys - off
-                act = (rel >= 0) & (rel < nb - 1)
-                col = jnp.where(act, rel + (rel >= dflt), dflt)
+                from ..io.bundling import decode_logical_bin
+                col = decode_logical_bin(col_phys,
+                                         bs["offset"][d, f_local],
+                                         bs["num_bin"][d, f_local],
+                                         bs["default_bin"][d, f_local])
                 col = jnp.where(own, col, 0)
                 return lax.psum(col, feature_axis)
 
