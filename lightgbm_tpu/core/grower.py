@@ -230,7 +230,9 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                      localize_key: Optional[Callable] = None,
                      prepare_is_pure: bool = False,
                      local_pool: bool = False,
-                     mc_rescan_hooks_ok: bool = False):
+                     mc_rescan_hooks_ok: bool = False,
+                     reduce_box: Optional[Callable] = None,
+                     localize_feature: Optional[Callable] = None):
     """Build the tree-growing function for a fixed dataset geometry.
 
     Returns ``grow(bins_t, gh, feature_mask, cegb) -> (TreeArrays, leaf_id)``
@@ -451,15 +453,18 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                              "does not compose with extra_trees")
         if has_scan_hooks and not mc_rescan_hooks_ok:
             # the rescan re-applies the scan hooks under a lax.cond; a
-            # learner may opt in when (a) its hooks are pure functions
-            # of (hist, ctx, mask) so re-application is sound, and (b)
-            # the cond predicate is REPLICATED across the mesh, so its
-            # collectives execute uniformly (the voting learner
-            # qualifies; feature-parallel's boxes would need GLOBAL
-            # feature geometry its sharded meta cannot express)
-            raise ValueError("monotone_constraints_method=intermediate "
-                             "is supported with the serial, data and "
-                             "voting learners")
+            # learner opts in when (a) its hooks are sound to re-apply
+            # and (b) the cond predicate is REPLICATED across the mesh,
+            # so its collectives execute uniformly. Voting and
+            # feature-parallel both opt in (feature-parallel also
+            # supplies reduce_box/localize_feature for the sharded box
+            # geometry); the only path left here is the bundled feature
+            # learner, whose EFB group layout permutes features across
+            # shards in a way the box psum cannot follow.
+            raise ValueError("refined monotone constraints do not "
+                             "compose with tree_learner=feature + EFB "
+                             "bundling; use "
+                             "monotone_constraints_method='basic'")
     use_ic = cfg.interaction_groups is not None
     if forced is not None:
         forced_active = jnp.asarray(forced[0], bool)
@@ -1203,14 +1208,23 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                     # below enumerates the complete constraint set, so
                     # direct enforcement stays sound while bounds only
                     # get looser (= more accurate) than intermediate's.
-                    fsafe_a = jnp.maximum(rec.feature, 0)
+                    # feature-sharded boxes ([L, F_local]): the split
+                    # feature's box update happens on the OWNER shard
+                    # only; separator counts/selectors reduce below
+                    if localize_feature is not None:
+                        f_box_a, f_own_a = localize_feature(rec.feature)
+                    else:
+                        f_box_a, f_own_a = rec.feature, jnp.bool_(True)
+                    fsafe_a = jnp.clip(f_box_a, 0, F - 1)
+                    upd_ok_a = is_num & f_own_a
                     flo_pa = state.leaf_flo[l]
                     fhi_pa = state.leaf_fhi[l]
                     a_left_fhi = jnp.where(
-                        is_num, fhi_pa.at[fsafe_a].set(rec.threshold),
+                        upd_ok_a, fhi_pa.at[fsafe_a].set(rec.threshold),
                         fhi_pa)
                     a_right_flo = jnp.where(
-                        is_num, flo_pa.at[fsafe_a].set(rec.threshold + 1),
+                        upd_ok_a,
+                        flo_pa.at[fsafe_a].set(rec.threshold + 1),
                         flo_pa)
                     ac_flo = jnp.stack([flo_pa, a_right_flo])   # [2, F]
                     ac_fhi = jnp.stack([a_left_fhi, fhi_pa])
@@ -1222,7 +1236,8 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                              ac_flo[None, :, :]))
                     n_sep_a = jnp.sum(~ov_a, axis=2)            # [L, 2]
                     sep_a = jnp.argmax(~ov_a, axis=2)
-                    msep_a = pmeta.monotone[sep_a]
+                    # sep is a LOCAL feature index -> LOCAL meta lookup
+                    msep_a = meta.monotone[sep_a]
                     linked_a = ((n_sep_a == 1) & (msep_a != 0) &
                                 exists_j[:, None])
                     jl = jnp.take_along_axis(state.leaf_flo, sep_a, axis=1)
@@ -1239,6 +1254,15 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                     # j ABOVE bounds the child's max when increasing
                     ub_on_c = linked_a & jnp.where(inc_a, j_above, j_below)
                     lb_on_c = linked_a & jnp.where(inc_a, j_below, j_above)
+                    if reduce_box is not None:
+                        # sharded boxes: a link exists when the GLOBAL
+                        # separator count is one; the owning shard's
+                        # local selector carries direction/sign
+                        one_a = reduce_box(n_sep_a) == 1
+                        ub_on_c = one_a & (reduce_box(
+                            ub_on_c.astype(jnp.int32)) > 0)
+                        lb_on_c = one_a & (reduce_box(
+                            lb_on_c.astype(jnp.int32)) > 0)
                     jout = state.stats[:, S_VAL][:, None]
                     geo_max = jnp.min(
                         jnp.where(ub_on_c, jout, jnp.inf), axis=0)  # [2]
@@ -1363,13 +1387,18 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             # are re-scanned from the (global) histogram pool only when a
             # bound actually tightened.
             if use_mc_inter:
-                fsafe = jnp.maximum(rec.feature, 0)
+                if localize_feature is not None:
+                    f_box, f_own = localize_feature(rec.feature)
+                else:
+                    f_box, f_own = rec.feature, jnp.bool_(True)
+                fsafe = jnp.clip(f_box, 0, F - 1)
+                upd_ok = is_num & f_own
                 flo_p = state.leaf_flo[l]
                 fhi_p = state.leaf_fhi[l]
-                left_fhi = jnp.where(is_num,
+                left_fhi = jnp.where(upd_ok,
                                      fhi_p.at[fsafe].set(rec.threshold),
                                      fhi_p)
-                right_flo = jnp.where(is_num,
+                right_flo = jnp.where(upd_ok,
                                       flo_p.at[fsafe].set(rec.threshold + 1),
                                       flo_p)
                 leaf_flo = _set(state.leaf_flo, new_leaf, right_flo, proceed)
@@ -1398,7 +1427,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                       (leaf_fhi[:, None, :] >= c_flo[None, :, :]))
                 n_sep = jnp.sum(~ov, axis=2)                # [L, 2]
                 sep = jnp.argmax(~ov, axis=2)               # [L, 2]
-                msep = pmeta.monotone[sep]                  # [L, 2]
+                msep = meta.monotone[sep]          # LOCAL index lookup
                 linked = (n_sep == 1) & (msep != 0)
                 j_lo = jnp.take_along_axis(leaf_flo, sep, axis=1)  # [L, 2]
                 j_hi = jnp.take_along_axis(leaf_fhi, sep, axis=1)
@@ -1415,6 +1444,12 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 # bound); j above => min bound. Decreasing: mirrored.
                 ub_sel = linked & jnp.where(inc, below, above)
                 lb_sel = linked & jnp.where(inc, above, below)
+                if reduce_box is not None:
+                    one_sep = reduce_box(n_sep) == 1
+                    ub_sel = one_sep & (reduce_box(
+                        ub_sel.astype(jnp.int32)) > 0)
+                    lb_sel = one_sep & (reduce_box(
+                        lb_sel.astype(jnp.int32)) > 0)
                 cand_max = jnp.min(
                     jnp.where(ub_sel, c_out[None, :], jnp.inf), axis=1)
                 cand_min = jnp.max(

@@ -520,12 +520,10 @@ class GBDT:
                 monotone = mc_in[train.used_feature_map]
         mc_method = cfg.monotone_constraints_method
         if monotone is not None:
-            if mc_method in ("intermediate", "advanced") and (
-                    cfg.extra_trees or
-                    cfg.tree_learner == "feature"):
-                log.warning(f"monotone_constraints_method={mc_method} is "
-                            "supported with the serial/data/voting "
-                            "learners and without extra_trees; using "
+            if mc_method in ("intermediate", "advanced") and \
+                    cfg.extra_trees:
+                log.warning(f"monotone_constraints_method={mc_method} "
+                            "does not compose with extra_trees; using "
                             "'basic'")
                 mc_method = "basic"
         contri = None
@@ -756,6 +754,19 @@ class GBDT:
                 log.info(
                     f"EFB bundled {train.num_used_features} features into "
                     f"{info.num_groups} groups")
+                if (self._tree_learner == "feature" and
+                        self.feature_meta is not None and
+                        self.feature_meta.monotone is not None and
+                        self.grower_cfg.mc_method in ("intermediate",
+                                                      "advanced")):
+                    # refined monotone geometry shards per logical
+                    # feature; the EFB group layout permutes features
+                    # across shards in a way the box psum cannot follow
+                    log.warning(
+                        "refined monotone constraints are not supported "
+                        "with tree_learner=feature + EFB; using 'basic'")
+                    self.grower_cfg = dataclasses.replace(
+                        self.grower_cfg, mc_method="basic")
 
         self.bins_rf = None
         self._bins_packed_dev = None

@@ -265,11 +265,25 @@ def make_feature_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
             # because all rows are local — only the column is exchanged)
             return lax.psum(col, feature_axis)
 
+        def localize_feature(f_global):
+            """Global logical feature -> (local index, owned?) for the
+            monotone-box geometry ([L, F_local] per shard)."""
+            off = lax.axis_index(feature_axis) * Fd_shard
+            f_local = f_global - off
+            own = (f_local >= 0) & (f_local < Fd_shard) & (f_global >= 0)
+            return f_local, own
+
         return make_tree_grower(
             cfg, local_meta(),
             select_best=select_best,
             fetch_bin_column=fetch_bin_column,
-            partition_meta=meta)
+            partition_meta=meta,
+            # refined monotone modes: separator counts/selectors psum
+            # over the feature shards; the rescan's all_gather runs
+            # under a REPLICATED cond predicate (uniform collectives)
+            reduce_box=lambda x: lax.psum(x, feature_axis),
+            localize_feature=localize_feature,
+            mc_rescan_hooks_ok=True)
 
     def sharded_grow(bins_t, gh, feature_mask, cegb_const, cegb_count,
                      rng_key):

@@ -178,3 +178,35 @@ def test_feature_parallel_efb_matches_serial():
     # every device scans its slice exhaustively -> same split set; only
     # gain ties could differ (scan order is permuted by group layout)
     np.testing.assert_allclose(p_feat, p_serial, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("method", ["intermediate", "advanced"])
+def test_feature_parallel_refined_monotone_matches_serial(method):
+    """Refined monotone modes under the FEATURE-parallel learner: the
+    leaf boxes live per feature shard and the separator-count/selector
+    geometry reduces with a psum over the feature axis; box updates
+    happen on the owning shard only."""
+    rng = np.random.default_rng(31)
+    n = 800
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    y = (X[:, 0] * 1.2 + np.square(X[:, 1]) * 0.3 - X[:, 4] * 0.8 +
+         0.05 * rng.normal(size=n)).astype(np.float32)
+    base = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+            "min_data_in_leaf": 5, "seed": 1,
+            "monotone_constraints": [1, 0, 0, 0, -1, 0],
+            "monotone_constraints_method": method,
+            "use_quantized_grad": True, "stochastic_rounding": False,
+            "enable_bundle": False}
+    b_ser = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=4)
+    b_feat = lgb.train({**base, "tree_learner": "feature",
+                        "tpu_num_devices": -1},
+                       lgb.Dataset(X, label=y), num_boost_round=4)
+    assert b_feat._engine.grower_cfg.mc_method == method
+    p_ser, p_feat = b_ser.predict(X), b_feat.predict(X)
+    assert np.isfinite(p_feat).all()
+    np.testing.assert_allclose(p_feat, p_ser, rtol=1e-5, atol=1e-6)
+    # monotonicity holds in both directions
+    Xp = X.copy(); Xp[:, 0] += 1.0
+    assert np.all(b_feat.predict(Xp) >= p_feat - 1e-6)
+    Xm = X.copy(); Xm[:, 4] += 1.0
+    assert np.all(b_feat.predict(Xm) <= p_feat + 1e-6)
