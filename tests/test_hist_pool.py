@@ -59,9 +59,9 @@ def test_poolless_matches_pooled(rng):
                                    rtol=1e-4, atol=1e-6)
 
 
-def test_wide_data_trains_via_auto_poolless(rng):
-    """Allstate-shaped axis: thousands of features with a bounded pool
-    budget trains end-to-end (the full pool would be multiple GB)."""
+def test_wide_data_auto_engages_bounded_pool(rng):
+    """Allstate-shaped axis: hundreds of features under a small
+    histogram_pool_size budget auto-engage the bounded LRU pool."""
     n, f = 1500, 600
     X = rng.normal(size=(n, f))
     y = X[:, 0] - X[:, 5] * 0.5 + rng.normal(scale=0.2, size=n)
