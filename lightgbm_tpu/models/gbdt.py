@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import tuned
 from ..config import Config
 from ..core.grower import GrowerConfig, make_tree_grower
 from ..core.metrics import Metric, metrics_for_config
@@ -615,9 +616,15 @@ class GBDT:
                 rm_backend = "pallas"
             else:
                 # f32: einsum+HIGHEST measured 24 ms vs 34 ms for the
-                # in-kernel HIGHEST path; the bf16-triple kernel path is
-                # projected faster but flips only once device-measured
-                rm_backend = "einsum"
+                # in-kernel HIGHEST path; the bf16-triple Pallas kernel
+                # takes over only once the on-device A/B has recorded a
+                # win in the tuned-defaults cache (scripts/
+                # tpu_session_auto.py writes it from measurements).
+                # Unknown cache values fall back — tuning must never be
+                # able to break training.
+                tk = tuned.get("f32_hist_kernel", "einsum")
+                rm_backend = (tk if tk in ("einsum", "pallas", "scatter")
+                              else "einsum")
         part_mode = cfg.tpu_partition_mode
         if part_mode == "auto" and jax.default_backend() == "cpu":
             # CPU favors scatter at every size; on TPU "auto" passes
@@ -777,9 +784,13 @@ class GBDT:
             # feature-major layout used by prediction/traversal (the
             # distributed learners shard their own row-major copy)
             pb = str(cfg.tpu_packed_bins).lower()
+            # auto: off until the on-device gather A/B records a win in
+            # the tuned cache (u32 packed words gather 4x fewer elements;
+            # measured on CPU proxy only so far). Only a literal JSON
+            # true counts — any other cache value falls back to off.
             want_pack = (pb in ("true", "1", "yes", "on") or
-                         (pb == "auto" and False))  # auto: off until
-            #                          device measurements pick a default
+                         (pb == "auto" and
+                          tuned.get("packed_bins", False) is True))
             if want_pack and self.num_bin_max <= 255:
                 # bit-pack 4 uint8 bins per uint32 word: quarters the
                 # element count of the compact scheduler's per-leaf row

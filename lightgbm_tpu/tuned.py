@@ -1,0 +1,70 @@
+"""Measured-on-device tuned defaults (the autotuner cache).
+
+Several ``auto`` config values have two viable lowering strategies whose
+winner depends on real device timings (e.g. the f32 histogram kernel:
+XLA einsum vs the VMEM-resident Pallas bf16-triple kernel; u8 vs packed
+u32 bin gathers). Rather than hard-coding guesses, the unattended
+measurement session (``scripts/tpu_session_auto.py``) runs the A/Bs on
+hardware and records the winners here; ``auto`` resolution consults this
+cache so measured wins become defaults without a source edit.
+
+The cache is a JSON object stored at ``lightgbm_tpu/TUNED.json``
+(checked into the repo once written, so the defaults ship). The
+``LIGHTGBM_TPU_TUNED`` env var overrides the path; a missing or
+malformed file silently resolves to the built-in fallbacks — tuning is
+an optimization, never a correctness dependency.
+
+Reference analog: LightGBM's device-specific defaults are compile-time
+(#ifdef USE_GPU etc., ref: src/treelearner/tree_learner.cpp:13-40); on
+TPU the measurement is the authority, so the cache is data.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+_CACHE: dict | None = None
+
+
+def _path() -> str:
+    env = os.environ.get("LIGHTGBM_TPU_TUNED")
+    if env:
+        return env
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "TUNED.json")
+
+
+def _load() -> dict:
+    global _CACHE
+    if _CACHE is None:
+        try:
+            with open(_path(), "r", encoding="utf-8") as f:
+                data = json.load(f)
+            _CACHE = data if isinstance(data, dict) else {}
+        except (OSError, ValueError):
+            _CACHE = {}
+    return _CACHE
+
+
+def get(key: str, default: Any = None) -> Any:
+    """Measured default for *key*, or *default* when unmeasured."""
+    return _load().get(key, default)
+
+
+def reload() -> None:
+    """Drop the in-process cache (tests / the autotune session)."""
+    global _CACHE
+    _CACHE = None
+
+
+def write(updates: dict) -> str:
+    """Merge *updates* into the cache file; returns the path written."""
+    path = _path()
+    current = dict(_load())
+    current.update(updates)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(current, f, indent=1, sort_keys=True)
+        f.write("\n")
+    reload()
+    return path

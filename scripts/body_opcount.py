@@ -25,10 +25,19 @@ from lightgbm_tpu.core.grower import GrowerConfig, make_tree_grower  # noqa: E40
 from lightgbm_tpu.ops.split import FeatureMeta, SplitHyperParams     # noqa: E402
 
 
-def main() -> None:
-    L = int(sys.argv[1]) if len(sys.argv) > 1 else 255
-    R = int(sys.argv[2]) if len(sys.argv) > 2 else 16384
-    F, B = 28, 256
+# bench-like geometry shared by analyze() and main()'s report line
+GEOM_F, GEOM_B = 28, 256
+
+
+def analyze(L: int = 255, R: int = 16384):
+    """Compile the grower at a bench-like geometry; return the op stats.
+
+    Returns (total_instrs, body_instrs_or_None, body_op_histogram,
+    computations_dict). Body instruction count is geometry-stable in R
+    (the loop body is shape-polymorphic over the scheduled row count),
+    so callers gating on it may use a small R for compile speed.
+    """
+    F, B = GEOM_F, GEOM_B
     meta = FeatureMeta(
         num_bin=jnp.full((F,), B, jnp.int32),
         missing_type=jnp.zeros((F,), jnp.int32),
@@ -70,8 +79,6 @@ def main() -> None:
             if m and 'op_name="jit(grow)/while"' in ln:
                 body_name = m.group(1)
     total = sum(len(v) for v in comps.values())
-    print(f"geometry: L={L} R={R} F={F} B={B}")
-    print(f"total optimized-HLO instructions: {total}")
     if body_name and body_name in comps:
         body = comps[body_name]
         ops = {}
@@ -79,7 +86,19 @@ def main() -> None:
             m = re.search(r"=\s*\S+\s+([\w\-]+)\(", ln)
             op = m.group(1) if m else "?"
             ops[op] = ops.get(op, 0) + 1
-        print(f"while-body '{body_name}': {len(body)} direct instrs "
+        return total, len(body), ops, comps
+    return total, None, {}, comps
+
+
+def main() -> None:
+    L = int(sys.argv[1]) if len(sys.argv) > 1 else 255
+    R = int(sys.argv[2]) if len(sys.argv) > 2 else 16384
+    total, body_n, ops, comps = analyze(L, R)
+    F, B = GEOM_F, GEOM_B
+    print(f"geometry: L={L} R={R} F={F} B={B}")
+    print(f"total optimized-HLO instructions: {total}")
+    if body_n is not None:
+        print(f"while-body: {body_n} direct instrs "
               f"(~kernel launches per split)")
         for op, n in sorted(ops.items(), key=lambda kv: -kv[1])[:20]:
             print(f"  {n:6d}  {op}")

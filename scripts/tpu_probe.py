@@ -1,0 +1,47 @@
+"""One patient single-client TPU probe.
+
+Claims the device, compiles a tiny jitted program, and barriers with a
+forced scalar fetch (``block_until_ready`` is a no-op through the axon
+tunnel — docs/TPU_RUNBOOK.md). Prints ``PROBE_OK`` and exits 0 on
+success; any failure prints ``PROBE_FAIL`` and exits 1.
+
+Wedge discipline (docs/TPU_RUNBOOK.md): the documented failure mode is a
+claim that waits ~1500 s and then errors ``UNAVAILABLE: TPU backend
+setup/compile error``. The caller must give this process enough wall
+clock to surface that (>=1600 s) and must never run two probes
+concurrently — a stacked claim-waiter is how the machine-wide wedge
+starts. Killing THIS process while it is merely waiting for the claim is
+benign; killing a client that holds the claim mid-compile is not, which
+is why the probe program is tiny (sub-second compile once claimed).
+"""
+import sys
+import time
+
+T0 = time.time()
+
+
+def say(msg: str) -> None:
+    print(f"[probe] {msg} +{time.time() - T0:.1f}s", flush=True)
+
+
+def main() -> int:
+    say("start")
+    try:
+        import jax
+        import jax.numpy as jnp
+        say("jax imported")
+        devs = jax.devices()
+        say(f"devices: {devs}")
+        x = jnp.arange(64, dtype=jnp.float32)
+        val = float(jnp.sum(jax.jit(lambda a: a * 2.0 + 1.0)(x)))
+        say(f"tiny jit ok (sum={val})")
+    except Exception as e:  # noqa: BLE001 — any failure is a failed probe
+        say(f"FAILED: {type(e).__name__}: {e}")
+        print("PROBE_FAIL", flush=True)
+        return 1
+    print("PROBE_OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,136 @@
+"""Persistent TPU-window watcher (round 5).
+
+Probes the tunneled device with one patient single-client probe at a
+time (scripts/tpu_probe.py); on the FIRST healthy probe it fires the
+full unattended measurement session (scripts/tpu_session_auto.py) —
+A/Bs, tuned-default flips, headline + 10.5M numbers, git commit. If the
+window closes mid-session it goes back to probing so a later window is
+not missed. Exits only when a session has landed a non-zero headline.
+
+Start at round open, leave running:
+    nohup python scripts/tpu_watcher.py > bench_logs/watcher_r05.log 2>&1 &
+
+Wedge discipline (docs/TPU_RUNBOOK.md): never two claims at once; a
+probe is given 1700 s (the documented failure signature waits ~1500 s
+before erroring UNAVAILABLE). While this watcher runs, nothing else may
+touch the axon backend.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOGDIR = os.path.join(REPO, "bench_logs")
+PROBE_TIMEOUT = 1700     # outlives the ~1500 s UNAVAILABLE signature
+SLEEP_BETWEEN = 240      # failed probe already burned ~25 min
+SESSION_TIMEOUT = 4 * 3600
+
+
+def say(msg: str) -> None:
+    print(f"[watcher {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def probe_once() -> bool:
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "tpu_probe.py")],
+            cwd=REPO, capture_output=True, text=True, timeout=PROBE_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        say(f"probe timed out at {PROBE_TIMEOUT}s (claim-waiter killed; "
+            "benign)")
+        return False
+    sys.stdout.write(proc.stdout)
+    sys.stdout.write(proc.stderr[-2000:])
+    return "PROBE_OK" in proc.stdout
+
+
+def session_landed_number(since: float) -> bool:
+    """True if MEASURED_r05.json was (re)written after *since* and
+    carries a non-zero headline — a stale file from an earlier session
+    must not count."""
+    path = os.path.join(LOGDIR, "MEASURED_r05.json")
+    try:
+        if os.path.getmtime(path) < since:
+            return False
+        with open(path, encoding="utf-8") as f:
+            state = json.load(f)
+    except (OSError, ValueError):
+        return False
+    return any(r.get("value", 0) > 0 and r["stage"].startswith("headline")
+               for r in state.get("results", []))
+
+
+def _descendants(root_pid: int) -> list:
+    """All live descendant pids of *root_pid* via /proc ppid chains.
+
+    Process groups are NOT enough here: the session starts each bench
+    stage in its own group (setsid), so killpg on the session would
+    orphan a claim-holding bench tree — the stacked-claims wedge
+    trigger. Parent links survive setsid, so the /proc walk sees the
+    whole tree."""
+    children: dict = {}
+    for ent in os.listdir("/proc"):
+        if not ent.isdigit():
+            continue
+        try:
+            with open(f"/proc/{ent}/stat") as f:
+                parts = f.read().split()
+            ppid = int(parts[3])
+        except (OSError, ValueError, IndexError):
+            continue
+        children.setdefault(ppid, []).append(int(ent))
+    out, stack = [], [root_pid]
+    while stack:
+        for kid in children.get(stack.pop(), []):
+            out.append(kid)
+            stack.append(kid)
+    return out
+
+
+def run_session() -> None:
+    """Run the measurement session; on the 4h ceiling kill its WHOLE
+    process tree (descendant walk — see _descendants) so no
+    claim-holding bench process is orphaned."""
+    with open(os.path.join(LOGDIR, "session_r05.log"), "a") as logf:
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "tpu_session_auto.py")],
+            cwd=REPO, stdout=logf, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        try:
+            proc.wait(timeout=SESSION_TIMEOUT)
+        except subprocess.TimeoutExpired:
+            say("session hit its 4h ceiling — killing its process tree")
+            victims = _descendants(proc.pid) + [proc.pid]
+            for pid in victims:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            proc.wait()
+
+
+def main() -> int:
+    os.makedirs(LOGDIR, exist_ok=True)
+    attempt = 0
+    while True:
+        attempt += 1
+        say(f"probe attempt {attempt}")
+        if probe_once():
+            say("HEALTHY — launching measurement session")
+            t_launch = time.time()
+            run_session()
+            if session_landed_number(since=t_launch):
+                say("session landed a headline number — watcher done")
+                return 0
+            say("session produced no headline number — back to probing")
+        time.sleep(SLEEP_BETWEEN)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,108 @@
+"""Unit coverage for the unattended measurement session's decision
+logic (scripts/tpu_session_auto.py) and the tuned-defaults cache.
+
+The session itself needs a healthy device; these tests pin the pure
+logic — flip selection must choose the MEASURED-best configuration
+(never an unmeasured composition), unreachable detection must match
+bench.py's fail-line contract, and the tuned cache must round-trip and
+fail soft.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _load_session_mod():
+    path = os.path.join(REPO, "scripts", "tpu_session_auto.py")
+    spec = importlib.util.spec_from_file_location("tpu_session_auto", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def sess():
+    return _load_session_mod()
+
+
+def test_unreachable_matches_bench_fail_contract(sess):
+    assert sess.unreachable(None)
+    assert sess.unreachable({"value": 0.0, "note": "device unreachable "
+                             "after 2 probe attempt(s)"})
+    # a 0.0 from a non-device failure is a failure but not window-closed
+    assert not sess.unreachable({"value": 0.0, "note": "sched=compact "
+                                 "exited rc=1"})
+    assert not sess.unreachable({"value": 2.5, "vs_baseline": 0.06})
+
+
+def test_flip_never_ships_a_measured_losing_composition(sess):
+    # negative interaction: both individually win, composition loses —
+    # the default must become the best SINGLE flip, not the pair
+    flips = sess.pick_flips(base=100.0, pallas=110.0, packed=108.0,
+                            both=90.0)
+    assert flips == {"f32_hist_kernel": "pallas"}
+
+
+def test_flip_requires_margin(sess):
+    assert sess.pick_flips(100.0, 102.0, 101.0, 102.5) == {}
+    assert sess.pick_flips(0.0, 110.0, 108.0, 125.0) == {}
+
+
+def test_flip_prefers_winning_composition(sess):
+    flips = sess.pick_flips(100.0, 110.0, 108.0, 125.0)
+    assert flips == {"f32_hist_kernel": "pallas", "packed_bins": True}
+
+
+def test_tuned_cache_fail_soft(tmp_path, monkeypatch):
+    monkeypatch.setenv("LIGHTGBM_TPU_TUNED", str(tmp_path / "TUNED.json"))
+    sys.path.insert(0, REPO)
+    from lightgbm_tpu import tuned
+    tuned.reload()
+    assert tuned.get("f32_hist_kernel", "einsum") == "einsum"
+    # malformed file degrades to fallbacks, never raises
+    (tmp_path / "TUNED.json").write_text("{not json")
+    tuned.reload()
+    assert tuned.get("packed_bins", False) is False
+    tuned.write({"packed_bins": True})
+    assert tuned.get("packed_bins") is True
+    tuned.reload()
+    assert tuned.get("packed_bins") is True
+    monkeypatch.delenv("LIGHTGBM_TPU_TUNED")
+    tuned.reload()
+
+
+def test_gbdt_sanitizes_unknown_tuned_kernel(tmp_path, monkeypatch):
+    """A wrong-typed tuned value must fall back, not crash training."""
+    cache = tmp_path / "TUNED.json"
+    cache.write_text(json.dumps({"f32_hist_kernel": True,
+                                 "packed_bins": "yes-ish"}))
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np, lightgbm_tpu as lgb\n"
+        "rng = np.random.default_rng(0)\n"
+        "X = rng.normal(size=(500, 4)); y = (X[:, 0] > 0).astype('f4')\n"
+        "b = lgb.train({'objective': 'binary', 'num_leaves': 7,\n"
+        "               'verbosity': -1}, lgb.Dataset(X, label=y),\n"
+        "              num_boost_round=2)\n"
+        "print('OK', len(b.predict(X)))\n")
+    env = dict(os.environ, LIGHTGBM_TPU_TUNED=str(cache))
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "OK 500" in out.stdout
+
+
+def test_probe_script_importable():
+    # the probe must not claim a device at import time (the watcher
+    # imports nothing, but a human running `python -c "import ..."`
+    # must not wedge the tunnel)
+    path = os.path.join(REPO, "scripts", "tpu_probe.py")
+    src = open(path).read()
+    compile(src, path, "exec")  # syntax gate only — no execution
