@@ -44,6 +44,48 @@ def _double_equal_ordered(a: float, b: float) -> bool:
     return b <= np.nextafter(a, np.inf)
 
 
+def merge_distinct(sorted_vals: np.ndarray,
+                   zero_cnt: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Distinct-value groups over an ascending f64 sample, vectorized.
+
+    Semantics match the reference's sequential scan (ref: bin.cpp:360-390)
+    exactly: an element merges into the running group when it is within
+    one ulp of its immediate PREDECESSOR (chain merging, not
+    representative merging), the group's representative is its largest
+    member, a zero group carrying ``zero_cnt`` (the values absent from a
+    sparse sample) is spliced at the negative->positive crossing, and a
+    leading/trailing zero group is added when the whole sample is
+    positive/negative. The scalar form was O(sample) Python per feature
+    — minutes per Dataset at 4228 features; this is three numpy passes.
+
+    Returns (distinct_values f64, counts i64), both length >= 1.
+    """
+    n_sorted = len(sorted_vals)
+    if n_sorted == 0:
+        return (np.asarray([0.0], dtype=np.float64),
+                np.asarray([max(zero_cnt, 0)], dtype=np.int64))
+    brk = sorted_vals[1:] > np.nextafter(sorted_vals[:-1], np.inf)
+    gid = np.empty(n_sorted, np.int64)
+    gid[0] = 0
+    np.cumsum(brk, out=gid[1:])
+    gcounts = np.bincount(gid)
+    last_idx = np.cumsum(gcounts) - 1
+    reps = sorted_vals[last_idx].astype(np.float64)
+    firsts = sorted_vals[last_idx - gcounts + 1]
+    ct = gcounts.astype(np.int64)
+    zpos = np.flatnonzero((reps[:-1] < 0.0) & (firsts[1:] > 0.0))
+    if len(zpos):
+        reps = np.insert(reps, zpos + 1, 0.0)
+        ct = np.insert(ct, zpos + 1, zero_cnt)
+    if sorted_vals[0] > 0.0 and zero_cnt > 0:
+        reps = np.concatenate([[0.0], reps])
+        ct = np.concatenate([[zero_cnt], ct])
+    elif sorted_vals[-1] < 0.0 and zero_cnt > 0:
+        reps = np.concatenate([reps, [0.0]])
+        ct = np.concatenate([ct, [zero_cnt]])
+    return reps, ct
+
+
 def greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
                     max_bin: int, total_cnt: int,
                     min_data_in_bin: int) -> List[float]:
@@ -280,35 +322,9 @@ class BinMapper:
         # distinct values with zero merged at |v| <= kZeroThreshold,
         # ulp-adjacent values merged (ref: bin.cpp:360-390)
         sorted_vals = np.sort(non_na, kind="stable")
-        distinct_values: List[float] = []
-        counts: List[int] = []
-        if len(sorted_vals) == 0 or (sorted_vals[0] > 0.0 and zero_cnt > 0):
-            distinct_values.append(0.0)
-            counts.append(zero_cnt)
-        if len(sorted_vals) > 0:
-            distinct_values.append(float(sorted_vals[0]))
-            counts.append(1)
-        for i in range(1, len(sorted_vals)):
-            prev, cur = float(sorted_vals[i - 1]), float(sorted_vals[i])
-            if not _double_equal_ordered(prev, cur):
-                if prev < 0.0 and cur > 0.0:
-                    distinct_values.append(0.0)
-                    counts.append(zero_cnt)
-                distinct_values.append(cur)
-                counts.append(1)
-            else:
-                distinct_values[-1] = cur  # use the larger value
-                counts[-1] += 1
-        if len(sorted_vals) > 0 and sorted_vals[-1] < 0.0 and zero_cnt > 0:
-            distinct_values.append(0.0)
-            counts.append(zero_cnt)
-
-        if not distinct_values:
-            distinct_values, counts = [0.0], [max(zero_cnt, 0)]
-        self.min_val = distinct_values[0]
-        self.max_val = distinct_values[-1]
-        dv = np.asarray(distinct_values, dtype=np.float64)
-        ct = np.asarray(counts, dtype=np.int64)
+        dv, ct = merge_distinct(sorted_vals, zero_cnt)
+        self.min_val = float(dv[0])
+        self.max_val = float(dv[-1])
         num_distinct = len(dv)
         cnt_in_bin: List[int] = []
 
