@@ -1,0 +1,102 @@
+# Evaluation callbacks (role of reference R-package/R/callback.R).
+#
+# The reference's callbacks run live inside the C++ training loop. This
+# layer trains through the framework CLI, so callbacks run as a REPLAY:
+# the CLI's per-iteration eval lines are parsed (.lgb_parse_eval) and
+# then streamed, iteration by iteration, through the callback chain
+# with the same env contract the reference uses (iteration,
+# eval_list, best_iter, best_score, met_early_stop). Semantics for
+# record / print / early-stop match; anything needing to MUTATE
+# training mid-flight (e.g. reset_parameter) is out of scope and
+# documented as such.
+
+#' Print evaluation callback
+#' @param period print every `period` iterations.
+lgb.cb.print.evaluation <- function(period = 1L) {
+  cb <- function(env) {
+    i <- env$iteration
+    if (period > 0L && (i - 1L) %% period == 0L && length(env$eval_list)) {
+      msg <- paste(vapply(env$eval_list, function(e)
+        sprintf("%s's %s: %g%s", e$data_name, e$name, e$value,
+                if (!is.null(e$stdv)) sprintf(" + %g", e$stdv) else ""),
+        character(1)), collapse = "  ")
+      cat(sprintf("[%d]  %s\n", i, msg))
+    }
+    env
+  }
+  attr(cb, "name") <- "cb_print_evaluation"
+  cb
+}
+
+#' Record evaluation callback — fills env$record_evals like the
+#' reference's cb_record_evaluation.
+lgb.cb.record.evaluation <- function() {
+  cb <- function(env) {
+    for (e in env$eval_list) {
+      dn <- e$data_name
+      if (is.null(env$record_evals[[dn]]))
+        env$record_evals[[dn]] <- list()
+      rec <- env$record_evals[[dn]][[e$name]]
+      if (is.null(rec)) rec <- list(eval = numeric(0),
+                                    eval_err = numeric(0))
+      rec$eval <- c(rec$eval, e$value)
+      if (!is.null(e$stdv)) rec$eval_err <- c(rec$eval_err, e$stdv)
+      env$record_evals[[dn]][[e$name]] <- rec
+    }
+    env
+  }
+  attr(cb, "name") <- "cb_record_evaluation"
+  cb
+}
+
+#' Early-stopping callback on the FIRST eval entry (the reference's
+#' aggregated-CV decision; ref callback.R cb_early_stop).
+#' @param stopping_rounds patience in iterations.
+#' @param verbose print the stop decision.
+lgb.cb.early.stop <- function(stopping_rounds, verbose = TRUE) {
+  stopping_rounds <- as.integer(stopping_rounds)
+  cb <- function(env) {
+    if (length(env$eval_list) == 0) return(env)
+    e <- env$eval_list[[1]]
+    hib <- .lgb_metric_higher_better(e$name)
+    better <- is.null(env$best_score) ||
+      (hib && e$value > env$best_score) ||
+      (!hib && e$value < env$best_score)
+    if (better) {
+      env$best_score <- e$value
+      env$best_iter <- env$iteration
+    } else if (env$iteration - env$best_iter >= stopping_rounds) {
+      env$met_early_stop <- TRUE
+      if (verbose)
+        cat(sprintf(
+          "Early stopping, best iteration is: [%d]  %s's %s: %g\n",
+          env$best_iter, e$data_name, e$name, env$best_score))
+    }
+    env
+  }
+  attr(cb, "name") <- "cb_early_stop"
+  cb
+}
+
+# Replay a parsed eval curve set through a callback chain.
+# curves: data.frame(iter, metric, value[, stdv]) with data_name column.
+.lgb_replay_callbacks <- function(curves, callbacks) {
+  env <- list(iteration = 0L, eval_list = list(),
+              record_evals = list(), best_iter = 0L,
+              best_score = NULL, met_early_stop = FALSE)
+  if (nrow(curves) == 0) return(env)
+  for (i in sort(unique(curves$iter))) {
+    rows <- curves[curves$iter == i, , drop = FALSE]
+    env$iteration <- as.integer(i)
+    env$eval_list <- lapply(seq_len(nrow(rows)), function(r) {
+      e <- list(data_name = if ("data_name" %in% names(rows))
+                  rows$data_name[r] else "valid",
+                name = rows$metric[r], value = rows$value[r])
+      if ("stdv" %in% names(rows)) e$stdv <- rows$stdv[r]
+      e
+    })
+    for (cb in callbacks) env <- cb(env)
+    if (isTRUE(env$met_early_stop)) break
+  }
+  env
+}

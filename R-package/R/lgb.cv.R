@@ -42,11 +42,14 @@
 #'   (the first metric parsed); NULL disables.
 #' @param seed fold-assignment RNG seed.
 #' @param verbose verbosity for the underlying CLI runs.
+#' @param callbacks list of callback functions (lgb.cb.*) replayed over
+#'   the aggregated per-iteration eval records (see callback.R for the
+#'   replay contract).
 #' @return list with record_evals (per-metric eval_mean/eval_stdv),
 #'   best_iter, best_score and the per-fold booster model files.
 lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 5L,
                    early_stopping_rounds = NULL, seed = 0L,
-                   verbose = -1L) {
+                   verbose = -1L, callbacks = list()) {
   if (!inherits(data, "lgb.Dataset")) stop("data must be an lgb.Dataset")
   if (!isTRUE(data$owned))
     stop("lgb.cv needs an lgb.Dataset built from matrix data ",
@@ -116,24 +119,37 @@ lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 5L,
       eval_stdv = apply(mat, 1, stats::sd))
   }
 
-  # early stopping on the aggregated mean of the FIRST metric
+  # callback replay over the aggregated curves (record / print /
+  # early-stop — the reference's cb_* chain, applied to the mean
+  # curve: one decision for all folds)
   m0 <- metrics[[1]]
   mean_curve <- record_evals$valid[[m0]]$eval_mean
   hib <- .lgb_metric_higher_better(m0)
-  best_iter <- if (hib) which.max(mean_curve) else which.min(mean_curve)
-  if (!is.null(early_stopping_rounds)) {
-    es <- as.integer(early_stopping_rounds)
-    for (i in seq_along(mean_curve)) {
-      best_so_far <- if (hib) which.max(mean_curve[seq_len(i)])
-                     else which.min(mean_curve[seq_len(i)])
-      if (i - best_so_far >= es) {
-        best_iter <- best_so_far
-        record_evals$valid <- lapply(record_evals$valid, function(r)
-          list(eval_mean = r$eval_mean[seq_len(i)],
-               eval_stdv = r$eval_stdv[seq_len(i)]))
-        break
-      }
-    }
+  chain <- callbacks
+  if (!is.null(early_stopping_rounds))
+    chain <- c(chain,
+               list(lgb.cb.early.stop(early_stopping_rounds,
+                                      verbose = verbose >= 1L)))
+  curve_rows <- do.call(rbind, lapply(metrics, function(m) {
+    r <- record_evals$valid[[m]]
+    data.frame(iter = seq_along(r$eval_mean), metric = m,
+               value = r$eval_mean, stdv = r$eval_stdv,
+               data_name = "valid", stringsAsFactors = FALSE)
+  }))
+  # the FIRST metric must lead each iteration group (early stop keys
+  # on eval_list[[1]])
+  curve_rows <- curve_rows[order(curve_rows$iter,
+                                 match(curve_rows$metric, metrics)), ]
+  env <- .lgb_replay_callbacks(curve_rows, chain)
+  best_iter <- if (env$best_iter > 0L) env$best_iter
+               else if (hib) which.max(mean_curve)
+               else which.min(mean_curve)
+  if (isTRUE(env$met_early_stop)) {
+    kept <- env$iteration
+    record_evals$valid <- lapply(record_evals$valid, function(r)
+      list(eval_mean = r$eval_mean[seq_len(kept)],
+           eval_stdv = r$eval_stdv[seq_len(kept)]))
+    mean_curve <- mean_curve[seq_len(kept)]
   }
 
   structure(list(record_evals = record_evals,

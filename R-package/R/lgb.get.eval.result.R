@@ -1,0 +1,31 @@
+# Eval-record accessor (role of reference
+# R-package/R/lgb.Booster.R lgb.get.eval.result).
+
+#' Extract a recorded evaluation curve
+#'
+#' @param modelfit an lgb.CVBooster (from lgb.cv) or a callback replay
+#'   env carrying record_evals.
+#' @param data_name evaluation dataset name (e.g. "valid").
+#' @param eval_name metric name (e.g. "l2", "auc").
+#' @param iters optional iteration subset (1-based).
+#' @param is_err return the stdv/error series instead of the mean.
+#' @return numeric vector of metric values.
+lgb.get.eval.result <- function(modelfit, data_name, eval_name,
+                                iters = NULL, is_err = FALSE) {
+  rec <- modelfit$record_evals
+  if (is.null(rec)) stop("no record_evals in this object")
+  dn <- rec[[data_name]]
+  if (is.null(dn))
+    stop("data_name not found; available: ",
+         paste(names(rec), collapse = ", "))
+  entry <- dn[[eval_name]]
+  if (is.null(entry))
+    stop("eval_name not found; available: ",
+         paste(names(dn), collapse = ", "))
+  # lgb.cv stores eval_mean/eval_stdv; replay envs store eval/eval_err
+  series <- if (is_err) entry$eval_stdv %||% entry$eval_err
+            else entry$eval_mean %||% entry$eval
+  if (is.null(series)) stop("requested series not recorded")
+  if (!is.null(iters)) series <- series[as.integer(iters)]
+  series
+}

@@ -1,0 +1,49 @@
+# Per-prediction interpretation (role of reference
+# R-package/R/lgb.interprete.R).
+#
+# Built on predict(predcontrib = TRUE) — the framework's TreeSHAP
+# contributions (last column is the bias/expected value, matching the
+# reference's contribution layout). One data.frame per requested row,
+# features sorted by |contribution| descending, bias row last — the
+# reference's tree_interpretation shape.
+
+#' Per-row feature contributions
+#'
+#' @param model an lgb.Booster.
+#' @param data numeric matrix / data.frame of rows to explain.
+#' @param idxset 1-based row indices of `data` to interpret (default:
+#'   all rows).
+#' @return list of data.frames (Feature, Contribution), one per row in
+#'   `idxset`, sorted by absolute contribution; the intercept appears
+#'   as Feature = "<bias>".
+lgb.interprete <- function(model, data, idxset = NULL) {
+  if (!inherits(model, "lgb.Booster")) stop("not an lgb.Booster")
+  mat <- as.matrix(data)
+  if (is.null(idxset)) idxset <- seq_len(nrow(mat))
+  idxset <- as.integer(idxset)
+  if (any(idxset < 1L | idxset > nrow(mat)))
+    stop("idxset out of range")
+  contrib <- predict.lgb.Booster(model, mat[idxset, , drop = FALSE],
+                                 predcontrib = TRUE)
+  contrib <- as.matrix(contrib)
+
+  lines <- strsplit(model$model_str, "\n")[[1]]
+  fn_line <- grep("^feature_names=", lines, value = TRUE)
+  feat_names <- if (length(fn_line))
+    strsplit(sub("^feature_names=", "", fn_line[1]), " ")[[1]]
+  else paste0("Column_", seq_len(ncol(contrib) - 1L))
+  n_feat <- ncol(contrib) - 1L
+  if (length(feat_names) < n_feat)
+    feat_names <- c(feat_names,
+                    paste0("Column_",
+                           seq.int(length(feat_names) + 1L, n_feat)))
+
+  lapply(seq_along(idxset), function(i) {
+    vals <- as.numeric(contrib[i, seq_len(n_feat)])
+    ord <- order(-abs(vals))
+    data.frame(
+      Feature = c(feat_names[seq_len(n_feat)][ord], "<bias>"),
+      Contribution = c(vals[ord], as.numeric(contrib[i, n_feat + 1L])),
+      stringsAsFactors = FALSE)
+  })
+}

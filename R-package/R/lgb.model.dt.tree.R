@@ -1,0 +1,129 @@
+# Model-to-table flattening (role of reference
+# R-package/R/lgb.model.dt.tree.R).
+#
+# Parses the LightGBM v4 model text directly (the same per-tree
+# split_feature= / threshold= / left_child= ... lines the reference
+# writes, ref: src/io/gbdt_model_text.cpp SaveModelToString), so no
+# framework call and no JSON dependency is needed. Returns a base
+# data.frame (the reference returns a data.table; the column contract
+# is the same).
+
+.lgb_tree_blocks <- function(model_str) {
+  lines <- strsplit(model_str, "\n")[[1]]
+  starts <- grep("^Tree=", lines)
+  ends <- c(starts[-1] - 1L, length(lines))
+  lapply(seq_along(starts), function(i) lines[starts[i]:ends[i]])
+}
+
+.lgb_tree_field <- function(block, key, as = as.numeric) {
+  ln <- grep(paste0("^", key, "="), block, value = TRUE)
+  if (length(ln) == 0) return(NULL)
+  txt <- sub(paste0("^", key, "="), "", ln[1])
+  if (nchar(trimws(txt)) == 0) return(as(character(0)))
+  as(strsplit(trimws(txt), " +")[[1]])
+}
+
+#' Flatten a model into one row per node
+#'
+#' @param model an lgb.Booster.
+#' @return data.frame with the reference's column contract:
+#'   tree_index, depth, split_index, split_feature, node_parent,
+#'   leaf_index, leaf_parent, split_gain, threshold, decision_type,
+#'   default_left, internal_value, internal_count, leaf_value,
+#'   leaf_count. Internal-node rows carry NA in the leaf columns and
+#'   vice versa.
+lgb.model.dt.tree <- function(model) {
+  if (!inherits(model, "lgb.Booster")) stop("not an lgb.Booster")
+  lines <- strsplit(model$model_str, "\n")[[1]]
+  fn_line <- grep("^feature_names=", lines, value = TRUE)
+  feat_names <- if (length(fn_line))
+    strsplit(sub("^feature_names=", "", fn_line[1]), " ")[[1]]
+  else character(0)
+  .feat <- function(idx) {
+    # split_feature indices are 0-based original feature ids
+    out <- as.character(idx)
+    have <- idx + 1L <= length(feat_names) & idx >= 0L
+    out[have] <- feat_names[idx[have] + 1L]
+    out
+  }
+
+  rows <- list()
+  blocks <- .lgb_tree_blocks(model$model_str)
+  for (ti in seq_along(blocks)) {
+    b <- blocks[[ti]]
+    num_leaves <- .lgb_tree_field(b, "num_leaves", as.integer)
+    leaf_value <- .lgb_tree_field(b, "leaf_value")
+    leaf_count <- .lgb_tree_field(b, "leaf_count", as.integer)
+    if (is.null(num_leaves) || num_leaves <= 1L) {
+      # stump: a single leaf, no internal nodes
+      rows[[length(rows) + 1L]] <- data.frame(
+        tree_index = ti - 1L, depth = 0L, split_index = NA_integer_,
+        split_feature = NA_character_, node_parent = NA_integer_,
+        leaf_index = 0L, leaf_parent = NA_integer_,
+        split_gain = NA_real_, threshold = NA_real_,
+        decision_type = NA_character_, default_left = NA,
+        internal_value = NA_real_, internal_count = NA_integer_,
+        leaf_value = if (length(leaf_value)) leaf_value[1] else 0.0,
+        leaf_count = if (length(leaf_count)) leaf_count[1] else NA_integer_,
+        stringsAsFactors = FALSE)
+      next
+    }
+    split_feature <- .lgb_tree_field(b, "split_feature", as.integer)
+    split_gain <- .lgb_tree_field(b, "split_gain")
+    threshold <- .lgb_tree_field(b, "threshold")
+    decision_type <- .lgb_tree_field(b, "decision_type", as.integer)
+    left_child <- .lgb_tree_field(b, "left_child", as.integer)
+    right_child <- .lgb_tree_field(b, "right_child", as.integer)
+    internal_value <- .lgb_tree_field(b, "internal_value")
+    internal_count <- .lgb_tree_field(b, "internal_count", as.integer)
+    n_internal <- length(split_feature)
+
+    # parents and depths via the child arrays (negative child ids are
+    # -(leaf_index) - 1, the reference's encoding)
+    node_parent <- rep(NA_integer_, n_internal)
+    leaf_parent <- rep(NA_integer_, num_leaves)
+    depth_internal <- rep(0L, n_internal)
+    depth_leaf <- rep(0L, num_leaves)
+    for (s in seq_len(n_internal)) {
+      for (child in c(left_child[s], right_child[s])) {
+        if (child >= 0L) {
+          node_parent[child + 1L] <- s - 1L
+          depth_internal[child + 1L] <- depth_internal[s] + 1L
+        } else {
+          li <- -child        # leaf index + 1
+          leaf_parent[li] <- s - 1L
+          depth_leaf[li] <- depth_internal[s] + 1L
+        }
+      }
+    }
+    # decision_type bit 2 is the default-left flag
+    # (ref: include/LightGBM/tree.h kDefaultLeftMask)
+    default_left <- bitwAnd(decision_type, 2L) > 0L
+
+    rows[[length(rows) + 1L]] <- data.frame(
+      tree_index = ti - 1L, depth = depth_internal,
+      split_index = seq_len(n_internal) - 1L,
+      split_feature = .feat(split_feature),
+      node_parent = node_parent, leaf_index = NA_integer_,
+      leaf_parent = NA_integer_, split_gain = split_gain,
+      threshold = threshold,
+      decision_type = ifelse(bitwAnd(decision_type, 1L) > 0L,
+                             "==", "<="),
+      default_left = default_left, internal_value = internal_value,
+      internal_count = internal_count, leaf_value = NA_real_,
+      leaf_count = NA_integer_, stringsAsFactors = FALSE)
+    rows[[length(rows) + 1L]] <- data.frame(
+      tree_index = ti - 1L, depth = depth_leaf,
+      split_index = NA_integer_, split_feature = NA_character_,
+      node_parent = NA_integer_,
+      leaf_index = seq_len(num_leaves) - 1L, leaf_parent = leaf_parent,
+      split_gain = NA_real_, threshold = NA_real_,
+      decision_type = NA_character_, default_left = NA,
+      internal_value = NA_real_, internal_count = NA_integer_,
+      leaf_value = leaf_value[seq_len(num_leaves)],
+      leaf_count = if (length(leaf_count) >= num_leaves)
+        leaf_count[seq_len(num_leaves)] else NA_integer_,
+      stringsAsFactors = FALSE)
+  }
+  do.call(rbind, rows)
+}

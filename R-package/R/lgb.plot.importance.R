@@ -1,0 +1,52 @@
+# Importance / interpretation plots (role of reference
+# R-package/R/lgb.plot.importance.R and lgb.plot.interpretation.R).
+#
+# Base-graphics horizontal barplots — the reference's layout (top-N
+# features, measure on x, names on y) without a graphics dependency.
+
+#' Plot feature importance
+#'
+#' @param tree_imp output of lgb.importance.
+#' @param top_n number of features to show.
+#' @param measure "Gain" or "Frequency".
+#' @param left_margin left margin (lines) for feature names.
+#' @return the plotted subset, invisibly.
+lgb.plot.importance <- function(tree_imp, top_n = 10L,
+                                measure = "Gain",
+                                left_margin = 10L) {
+  if (!measure %in% names(tree_imp))
+    stop("measure must be one of: ",
+         paste(setdiff(names(tree_imp), "Feature"), collapse = ", "))
+  d <- tree_imp[order(-tree_imp[[measure]]), , drop = FALSE]
+  d <- utils::head(d, as.integer(top_n))
+  d <- d[rev(seq_len(nrow(d))), , drop = FALSE]  # largest on top
+  old <- graphics::par(mar = c(4, left_margin, 2, 1))
+  on.exit(graphics::par(old))
+  graphics::barplot(d[[measure]], names.arg = d$Feature, horiz = TRUE,
+                    las = 1, xlab = measure,
+                    main = "Feature importance")
+  invisible(d)
+}
+
+#' Plot per-row feature contributions
+#'
+#' @param tree_interpretation one element of lgb.interprete's output.
+#' @param top_n number of features to show (bias excluded).
+#' @param left_margin left margin (lines) for feature names.
+#' @return the plotted subset, invisibly.
+lgb.plot.interpretation <- function(tree_interpretation, top_n = 10L,
+                                    left_margin = 10L) {
+  d <- tree_interpretation[tree_interpretation$Feature != "<bias>", ,
+                           drop = FALSE]
+  d <- utils::head(d[order(-abs(d$Contribution)), , drop = FALSE],
+                   as.integer(top_n))
+  d <- d[rev(seq_len(nrow(d))), , drop = FALSE]
+  old <- graphics::par(mar = c(4, left_margin, 2, 1))
+  on.exit(graphics::par(old))
+  graphics::barplot(d$Contribution, names.arg = d$Feature, horiz = TRUE,
+                    las = 1, xlab = "Contribution",
+                    main = "Feature contribution",
+                    col = ifelse(d$Contribution >= 0,
+                                 "steelblue", "firebrick"))
+  invisible(d)
+}

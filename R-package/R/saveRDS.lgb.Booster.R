@@ -1,0 +1,46 @@
+# RDS round-trip helpers (role of reference
+# R-package/R/saveRDS.lgb.Booster.R and readRDS.lgb.Booster.R).
+#
+# The reference needs these because its Booster holds an external
+# pointer that must be re-materialized from the raw model string on
+# load. This layer's booster is already a plain R list carrying
+# model_str, so base saveRDS would work — the wrappers exist for API
+# parity and to guarantee the serialized object is self-contained
+# (model_str present, stale temp-file path dropped) and re-classed on
+# read.
+
+#' Save an lgb.Booster to an RDS file
+#'
+#' @param object the booster.
+#' @param file path to write.
+#' @param ... passed through to base::saveRDS.
+saveRDS.lgb.Booster <- function(object, file, ...) {
+  if (!inherits(object, "lgb.Booster")) stop("not an lgb.Booster")
+  if (is.null(object$model_str) || !nzchar(object$model_str))
+    stop("booster has no model_str; cannot serialize")
+  # the temp model file will not exist in the next session — keep only
+  # the self-contained string
+  object$model_file <- NULL
+  saveRDS(object, file = file, ...)
+  invisible(file)
+}
+
+#' Read an lgb.Booster from an RDS file
+#'
+#' @param file path written by saveRDS.lgb.Booster (or base saveRDS of
+#'   a booster).
+#' @param ... passed through to base::readRDS.
+#' @return an lgb.Booster.
+readRDS.lgb.Booster <- function(file, ...) {
+  obj <- readRDS(file = file, ...)
+  if (is.null(obj$model_str) || !nzchar(obj$model_str))
+    stop("RDS file does not contain a serialized lgb.Booster")
+  # re-materialize a model file lazily on first use (.lgb_booster_file)
+  obj$model_file <- obj$model_file %||% tempfile(fileext = ".txt")
+  if (!file.exists(obj$model_file))
+    writeLines(obj$model_str, obj$model_file)
+  class(obj) <- "lgb.Booster"
+  obj
+}
+
+`%||%` <- function(a, b) if (is.null(a)) b else a

@@ -1,0 +1,120 @@
+"""Mechanical R-source gate for R-package/ (no R runtime in the image).
+
+Not a full R parser: a string/comment/%op%-aware structural lint that
+catches the ship-breaking mistakes a typo introduces — unbalanced or
+mismatched ()/[]/{}, unterminated '' "" `` literals, orphan closers —
+with file:line positions. The R-layer behavior itself is covered from
+Python by tests/test_r_layer.py (CLI/file contract); this gate makes
+sure the .R sources are at least structurally loadable so the 16-file
+surface cannot ship write-only. (Reference CI runs full R CMD check +
+testthat + valgrind — R-package/tests/ — which needs an R runtime.)
+
+Usage: python scripts/r_lint.py [paths...]   (default: R-package/)
+Exit 0 clean, 1 with findings printed.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+OPENERS = {"(": ")", "[": "]", "{": "}"}
+CLOSERS = {v: k for k, v in OPENERS.items()}
+
+
+def lint_r(text: str, name: str = "<r>") -> list:
+    """Return a list of 'file:line: message' strings."""
+    errors = []
+    stack = []          # (opener_char, line_no)
+    line = 1
+    i = 0
+    n = len(text)
+    in_str: str | None = None     # the quote char when inside a literal
+    str_line = 0
+    while i < n:
+        c = text[i]
+        if c == "\n":
+            line += 1
+            if in_str and in_str in "'\"":
+                # R string literals may legally span lines; track only
+                pass
+            i += 1
+            continue
+        if in_str:
+            if c == "\\" and in_str in "'\"":
+                i += 2
+                continue
+            if c == in_str:
+                in_str = None
+            i += 1
+            continue
+        if c == "#":
+            # comment to end of line
+            j = text.find("\n", i)
+            i = n if j < 0 else j
+            continue
+        if c in "'\"`":
+            in_str = c
+            str_line = line
+            i += 1
+            continue
+        if c == "%":
+            # %%, %in%, %*%, user %ops% — atomic when closed on the line
+            j = text.find("%", i + 1)
+            k = text.find("\n", i + 1)
+            if j >= 0 and (k < 0 or j < k):
+                i = j + 1
+                continue
+            i += 1
+            continue
+        if c in OPENERS:
+            stack.append((c, line))
+            i += 1
+            continue
+        if c in CLOSERS:
+            if not stack:
+                errors.append(f"{name}:{line}: unmatched '{c}'")
+            else:
+                op, op_line = stack.pop()
+                if OPENERS[op] != c:
+                    errors.append(
+                        f"{name}:{line}: '{c}' closes '{op}' opened at "
+                        f"line {op_line}")
+            i += 1
+            continue
+        i += 1
+    if in_str:
+        errors.append(f"{name}:{str_line}: unterminated {in_str} literal")
+    for op, op_line in stack:
+        errors.append(f"{name}:{op_line}: '{op}' never closed")
+    return errors
+
+
+def lint_paths(paths) -> list:
+    errors = []
+    for path in paths:
+        if os.path.isdir(path):
+            for root, _dirs, files in os.walk(path):
+                for fn in sorted(files):
+                    if fn.endswith(".R"):
+                        full = os.path.join(root, fn)
+                        with open(full, encoding="utf-8") as f:
+                            errors += lint_r(f.read(), full)
+        else:
+            with open(path, encoding="utf-8") as f:
+                errors += lint_r(f.read(), path)
+    return errors
+
+
+def main() -> int:
+    paths = sys.argv[1:] or [os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "R-package")]
+    errors = lint_paths(paths)
+    for e in errors:
+        print(e)
+    print(f"r_lint: {len(errors)} finding(s)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

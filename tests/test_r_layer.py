@@ -155,3 +155,114 @@ def test_r_new_sources_exported():
     demo = os.path.join(REPO, "R-package", "demo")
     assert os.path.exists(os.path.join(demo, "basic_walkthrough.R"))
     assert os.path.exists(os.path.join(demo, "cross_validation.R"))
+
+
+def test_r_round5_surface_exported():
+    """The verdict-requested everyday surface exists and is exported."""
+    rdir = os.path.join(REPO, "R-package", "R")
+    blob = ""
+    for fn in os.listdir(rdir):
+        with open(os.path.join(rdir, fn)) as fh:
+            blob += fh.read()
+    for name in ["lgb.interprete", "lgb.model.dt.tree",
+                 "lgb.plot.importance", "lgb.plot.interpretation",
+                 "lgb.get.eval.result", "lgb.cb.print.evaluation",
+                 "lgb.cb.record.evaluation", "lgb.cb.early.stop",
+                 "saveRDS.lgb.Booster", "readRDS.lgb.Booster"]:
+        assert f"{name} <- function" in blob, name
+    ns = open(os.path.join(REPO, "R-package", "NAMESPACE")).read()
+    for name in ["lgb.interprete", "lgb.model.dt.tree",
+                 "saveRDS.lgb.Booster", "readRDS.lgb.Booster",
+                 "lgb.get.eval.result"]:
+        assert f"export({name})" in ns, name
+
+
+def test_r_model_dt_tree_text_contract(rng, tmp_path):
+    """lgb.model.dt.tree parses the model TEXT directly; this pins the
+    format invariants that parsing relies on, and replays the R
+    parent/depth derivation in Python to prove it covers every node."""
+    n, f = 600, 5
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] - 0.5 * X[:, 2] + 0.1 * rng.normal(size=n)
+    bst = lgb.train({"objective": "regression", "num_leaves": 12,
+                     "min_data_in_leaf": 5, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    model_file = tmp_path / "m.txt"
+    bst.save_model(str(model_file))
+    text = model_file.read_text()
+    assert "feature_names=" in text
+    trees = text.split("Tree=")[1:]
+    assert len(trees) == 4
+    for block in trees:
+        fields = {}
+        for ln in block.splitlines():
+            if "=" in ln:
+                k, _, v = ln.partition("=")
+                fields[k] = v.split()
+        L = int(fields["num_leaves"][0])
+        n_int = L - 1
+        for key in ["split_feature", "split_gain", "threshold",
+                    "decision_type", "left_child", "right_child",
+                    "internal_value", "internal_count"]:
+            assert key in fields, key
+            assert len(fields[key]) == n_int, (key, len(fields[key]))
+        assert len(fields["leaf_value"]) == L
+        # replay the R derivation: every internal node except the root
+        # and every leaf must receive exactly one parent
+        left = [int(v) for v in fields["left_child"]]
+        right = [int(v) for v in fields["right_child"]]
+        node_parent = [None] * n_int
+        leaf_parent = [None] * L
+        for s in range(n_int):
+            for child in (left[s], right[s]):
+                if child >= 0:
+                    assert node_parent[child] is None
+                    node_parent[child] = s
+                else:
+                    li = -child - 1
+                    assert leaf_parent[li] is None
+                    leaf_parent[li] = s
+        assert node_parent[0] is None            # root
+        assert all(p is not None for p in node_parent[1:])
+        assert all(p is not None for p in leaf_parent)
+
+
+def test_r_interprete_contrib_contract(rng, tmp_path):
+    """lgb.interprete relies on predict_contrib output being [F+1]
+    columns per row (bias last) whose sum equals the raw score."""
+    n, f = 500, 6
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + X[:, 1] > 0).astype(float)
+    rows = np.column_stack([y, X])
+    trf = tmp_path / "t.csv"
+    np.savetxt(trf, rows, delimiter=",")
+    model_file = tmp_path / "m.txt"
+    conf = tmp_path / "c.conf"
+    conf.write_text("\n".join([
+        "task = train", f"data = {trf}", "num_iterations = 6",
+        f"output_model = {model_file}", "verbosity = -1",
+        "objective = binary", "num_leaves = 7", "min_data_in_leaf = 5",
+        "device_type = cpu"]) + "\n")
+    _run_cli(conf)
+    pred_csv = tmp_path / "p.csv"
+    np.savetxt(pred_csv, np.column_stack([np.zeros(8), X[:8]]),
+               delimiter=",")
+    out_contrib = tmp_path / "contrib.txt"
+    pconf = tmp_path / "pc.conf"
+    pconf.write_text("\n".join([
+        "task = predict", f"data = {pred_csv}",
+        f"input_model = {model_file}", f"output_result = {out_contrib}",
+        "header = false", "predict_contrib = true"]) + "\n")
+    _run_cli(pconf)
+    contrib = np.loadtxt(out_contrib)
+    assert contrib.shape == (8, f + 1)
+    out_raw = tmp_path / "raw.txt"
+    rconf = tmp_path / "rc.conf"
+    rconf.write_text("\n".join([
+        "task = predict", f"data = {pred_csv}",
+        f"input_model = {model_file}", f"output_result = {out_raw}",
+        "header = false", "predict_raw_score = true"]) + "\n")
+    _run_cli(rconf)
+    raw = np.loadtxt(out_raw)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw,
+                               rtol=1e-4, atol=1e-5)

@@ -1,0 +1,44 @@
+"""Mechanical R-source gate (scripts/r_lint.py) + its own unit checks.
+
+No R runtime exists in the image, so the .R sources cannot be executed;
+this gate guarantees they are at least structurally sound (balanced
+delimiters, terminated literals) so the R layer cannot ship with a
+paste error. Behavior is covered by tests/test_r_layer.py's CLI
+contract tests.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+from r_lint import lint_paths, lint_r  # noqa: E402
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def test_r_package_sources_structurally_clean():
+    errors = lint_paths([os.path.join(REPO, "R-package")])
+    assert errors == [], "\n".join(errors)
+
+
+def test_linter_catches_unbalanced():
+    assert lint_r("f <- function(x) { x + 1", "t") == [
+        "t:1: '{' never closed"]
+    assert any("unmatched" in e for e in lint_r("g <- x + 1)", "t"))
+    assert any("closes" in e for e in lint_r("h <- c(1, 2}", "t"))
+
+
+def test_linter_respects_strings_comments_ops():
+    # delimiters inside strings / comments / %op% must not count
+    assert lint_r('s <- "a ( [ { unclosed"', "t") == []
+    assert lint_r("# comment with ( [ {\nx <- 1\n", "t") == []
+    assert lint_r("y <- a %in% c(1, 2)\n", "t") == []
+    assert lint_r('z <- "%"; q <- 5 %% 2\n', "t") == []
+    assert lint_r("`weird (name` <- 4\n", "t") == []
+    # escapes inside strings
+    assert lint_r('e <- "a\\"b("\n', "t") == []
+
+
+def test_linter_catches_unterminated_string():
+    out = lint_r('bad <- "never ends\nx <- 1\n', "t")
+    assert any("unterminated" in e for e in out)
