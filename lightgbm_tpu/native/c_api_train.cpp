@@ -21,6 +21,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <atomic>
 #include <mutex>
 #include <set>
 #include <string>
@@ -165,21 +166,54 @@ void SyncLogCallback() {
   g_pyrun(buf);
 }
 
-int RunGuarded(const std::string& body) {
-  // serialize embedded-interpreter entry: the training ABI is documented
-  // single-threaded, but a stray concurrent call must not corrupt the
-  // static result slots
-  static std::mutex mu;
-  std::lock_guard<std::mutex> lk(mu);
-  if (!EnsurePython()) return -1;
-  SyncLogCallback();
-  static int rc_slot;
-  static char err_slot[4096];
-  rc_slot = -9;
+// ---- handle registry ---------------------------------------------------
+struct TrainHandle {
+  uint64_t id;
+  bool is_booster;
+  // per-handle lock: entry points serialize calls on the SAME handle
+  // (a booster's engine state is not re-entrant) while independent
+  // boosters/datasets proceed concurrently — the reference's
+  // per-Booster lock semantics (ref: src/c_api.cpp:170 yamc
+  // shared_mutex per Booster wrapper). Python-side dict/state access
+  // is additionally GIL-serialized; true overlap happens where the
+  // engine releases the GIL (XLA compute, numpy).
+  std::mutex mu;
+};
+
+std::mutex g_handles_mu;
+std::set<TrainHandle*> g_handles;
+uint64_t g_next_id = 1;
+
+TrainHandle* NewHandle(bool is_booster) {
+  std::lock_guard<std::mutex> lk(g_handles_mu);
+  auto* h = new TrainHandle{g_next_id++, is_booster, {}};
+  g_handles.insert(h);
+  return h;
+}
+
+std::atomic<uint64_t> g_call_seq{1};
+
+int RunGuarded(const std::string& body, TrainHandle* h = nullptr) {
+  // Re-entrant across handles: only interpreter bootstrap is globally
+  // serialized; each call gets stack-local result slots and a unique
+  // harness function name, and locks only its own handle (single lock
+  // per call — two-handle entry points lock the mutated handle only,
+  // so there is no lock-order cycle).
+  {
+    static std::mutex init_mu;
+    std::lock_guard<std::mutex> lk(init_mu);
+    if (!EnsurePython()) return -1;
+    SyncLogCallback();
+  }
+  std::unique_lock<std::mutex> hlk;
+  if (h) hlk = std::unique_lock<std::mutex>(h->mu);
+  int rc_slot = -9;
+  char err_slot[4096];
   err_slot[0] = '\0';
-  char head[256];
-  std::snprintf(head, sizeof(head),
-                "def _lgbm_tmp_fn():\n");
+  const uint64_t seq = g_call_seq.fetch_add(1, std::memory_order_relaxed);
+  char fname[64];
+  std::snprintf(fname, sizeof(fname), "_lgbm_tmp_fn_%llu",
+                static_cast<unsigned long long>(seq));
   std::string indented;
   size_t start = 0;
   while (start <= body.size()) {
@@ -190,35 +224,22 @@ int RunGuarded(const std::string& body) {
   }
   char tail[256];
   std::snprintf(tail, sizeof(tail),
-                "_lgbm_capi_call(_lgbm_tmp_fn, %llu, %llu)\n",
+                "_lgbm_capi_call(%s, %llu, %llu)\n"
+                "del %s\n",
+                fname,
                 static_cast<unsigned long long>(
                     reinterpret_cast<uintptr_t>(&rc_slot)),
                 static_cast<unsigned long long>(
-                    reinterpret_cast<uintptr_t>(err_slot)));
-  std::string code = std::string(head) + indented + tail;
+                    reinterpret_cast<uintptr_t>(err_slot)),
+                fname);
+  std::string code = std::string("def ") + fname + "():\n" +
+                     indented + tail;
   if (g_pyrun(code.c_str()) != 0 || rc_slot != 0) {
     SetTrainError(err_slot[0] ? err_slot
                               : "training C API: python execution failed");
     return -1;
   }
   return 0;
-}
-
-// ---- handle registry ---------------------------------------------------
-struct TrainHandle {
-  uint64_t id;
-  bool is_booster;
-};
-
-std::mutex g_handles_mu;
-std::set<TrainHandle*> g_handles;
-uint64_t g_next_id = 1;
-
-TrainHandle* NewHandle(bool is_booster) {
-  std::lock_guard<std::mutex> lk(g_handles_mu);
-  auto* h = new TrainHandle{g_next_id++, is_booster};
-  g_handles.insert(h);
-  return h;
 }
 
 TrainHandle* AsTrainHandle(void* p) {
@@ -326,7 +347,7 @@ int LGBM_DatasetSetField(void* handle, const char* field_name,
       "v = _np.ctypeslib.as_array(buf).copy()\n" +
       "_lgbm_capi['obj'][" + std::to_string(h->id) + "]['fields'][" +
       PyStr(field_name) + "] = v\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_DatasetGetNumData(void* handle, int32_t* out) {
@@ -338,7 +359,7 @@ int LGBM_DatasetGetNumData(void* handle, int32_t* out) {
   std::string body =
       "_ct.c_int32.from_address(" + Addr(out) + ").value = "
       "_lgbm_capi['obj'][" + std::to_string(h->id) + "]['X'].shape[0]\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_DatasetGetNumFeature(void* handle, int32_t* out) {
@@ -350,7 +371,7 @@ int LGBM_DatasetGetNumFeature(void* handle, int32_t* out) {
   std::string body =
       "_ct.c_int32.from_address(" + Addr(out) + ").value = "
       "_lgbm_capi['obj'][" + std::to_string(h->id) + "]['X'].shape[1]\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_DatasetFree(void* handle) {
@@ -361,7 +382,7 @@ int LGBM_DatasetFree(void* handle) {
   }
   std::string body = "_lgbm_capi['obj'].pop(" + std::to_string(h->id) +
                      ", None)\n";
-  int rc = RunGuarded(body);
+  int rc = RunGuarded(body, h);
   DropHandle(h);
   return rc;
 }
@@ -479,7 +500,7 @@ int LGBM_BoosterCreate(void* train_data, const char* parameters,
       "feature_name=d.get('feature_names', 'auto'), params=p)\n" +
       "_lgbm_capi['obj'][" + bid + "] = {'booster': _lgb.Booster(p, ds), "
       "'finished': False}\n";
-  if (RunGuarded(body) != 0) {
+  if (RunGuarded(body, d) != 0) {
     DropHandle(h);
     return -1;
   }
@@ -499,7 +520,7 @@ int LGBM_BoosterUpdateOneIter(void* handle, int* is_finished) {
       "b['finished'] = bool(fin)\n" +
       "_ct.c_int.from_address(" + Addr(is_finished) +
       ").value = 1 if fin else 0\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_BoosterAddValidData(void* handle, void* valid_data) {
@@ -522,7 +543,7 @@ int LGBM_BoosterAddValidData(void* handle, void* valid_data) {
       "b['booster'].add_valid(ds, 'valid_' + str(len(b.setdefault("
       "'valids', [])) ))\n" +
       "b['valids'].append(ds)\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_BoosterGetEval(void* handle, int data_idx, int* out_len,
@@ -545,7 +566,7 @@ int LGBM_BoosterGetEval(void* handle, int data_idx, int* out_len,
       "if a.size:\n" +
       "    _ct.memmove(" + Addr(out_results) +
       ", a.ctypes.data, a.size * 8)\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_BoosterGetEvalCounts(void* handle, int* out_len) {
@@ -559,7 +580,7 @@ int LGBM_BoosterGetEvalCounts(void* handle, int* out_len) {
       "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
       "_ct.c_int.from_address(" + Addr(out_len) +
       ").value = len(b.eval_train())\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 namespace {
@@ -601,7 +622,7 @@ int LGBM_BoosterSaveModel(void* handle, int start_iteration,
                                                 : 0) +
       ", importance_type=" +
       (feature_importance_type == 1 ? "'gain'" : "'split'") + ")\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 namespace {
@@ -632,7 +653,7 @@ int LGBM_BoosterGetNumPredict(void* handle, int data_idx,
       ScoreSnippet(h->id, data_idx) +
       "_ct.c_int64.from_address(" + Addr(out_len) +
       ").value = sc.size\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_BoosterGetPredict(void* handle, int data_idx, int64_t* out_len,
@@ -648,7 +669,7 @@ int LGBM_BoosterGetPredict(void* handle, int data_idx, int64_t* out_len,
       ").value = sc.size\n" +
       "_ct.memmove(" + Addr(out_result) +
       ", _np.ascontiguousarray(sc).ctypes.data, sc.size * 8)\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_BoosterGetLeafValue(void* handle, int tree_idx, int leaf_idx,
@@ -663,7 +684,7 @@ int LGBM_BoosterGetLeafValue(void* handle, int tree_idx, int leaf_idx,
       "_ct.c_double.from_address(" + Addr(out_val) + ").value = "
       "float(b.get_leaf_output(" + std::to_string(tree_idx) + ", " +
       std::to_string(leaf_idx) + "))\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_BoosterSetLeafValue(void* handle, int tree_idx, int leaf_idx,
@@ -679,7 +700,7 @@ int LGBM_BoosterSetLeafValue(void* handle, int tree_idx, int leaf_idx,
       "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
       "b.set_leaf_output(" + std::to_string(tree_idx) + ", " +
       std::to_string(leaf_idx) + ", " + vbuf + ")\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_BoosterRefit(void* handle, const double* leaf_preds,
@@ -705,7 +726,7 @@ int LGBM_BoosterRefit(void* handle, const double* leaf_preds,
       "b2 = b.refit(ts.data, ts.label)\n" +
       "_lgbm_capi['obj'][" + std::to_string(h->id) +
       "]['booster'] = b2\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_BoosterRollbackOneIter(void* handle) {
@@ -718,7 +739,7 @@ int LGBM_BoosterRollbackOneIter(void* handle) {
   std::string body =
       "_lgbm_capi['obj'][" + std::to_string(h->id) +
       "]['booster'].rollback_one_iter()\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LgbmTrainBoosterIntProp(void* handle, const char* prop, int* out);
@@ -763,7 +784,7 @@ int LGBM_BoosterSaveModelToString(void* handle, int start_iteration,
            ? "_ct.c_char.from_address(" +
                  Addr(out_str + (buffer_len - 1)) + ").value = b'\\0'\n"
            : std::string());
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 // ---- training-handle implementations used by c_api.cpp routers ---------
@@ -773,7 +794,7 @@ int LgbmTrainBoosterFree(void* handle) {
   if (!h) return -1;
   std::string body = "_lgbm_capi['obj'].pop(" + std::to_string(h->id) +
                      ", None)\n";
-  int rc = RunGuarded(body);
+  int rc = RunGuarded(body, h);
   DropHandle(h);
   return rc;
 }
@@ -785,7 +806,7 @@ int LgbmTrainBoosterIntProp(void* handle, const char* prop, int* out) {
       "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]['booster']\n" +
       "_ct.c_int.from_address(" + Addr(out) + ").value = int(" + prop +
       ")\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LgbmTrainBoosterPredictForMat(void* handle, const void* data,
@@ -826,7 +847,7 @@ int LgbmTrainBoosterPredictForMat(void* handle, const void* data,
       ").value = pred.size\n" +
       "_ct.memmove(" + Addr(out_result) +
       ", pred.ctypes.data, pred.size * 8)\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LgbmTrainBoosterPredictForCSR(void* handle, const void* indptr,
@@ -864,7 +885,7 @@ int LgbmTrainBoosterPredictForCSR(void* handle, const void* indptr,
       ").value = pred.size\n" +
       "_ct.memmove(" + Addr(out_result) +
       ", pred.ctypes.data, pred.size * 8)\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_DatasetGetField(void* handle, const char* field_name,
@@ -912,7 +933,7 @@ int LGBM_DatasetGetField(void* handle, const char* field_name,
       "_ct.c_int32.from_address(" + Addr(&len_slot) +
       ").value = v.size\n" +
       "_ct.c_int32.from_address(" + Addr(&type_slot) + ").value = t\n";
-  if (RunGuarded(body) != 0) return -1;
+  if (RunGuarded(body, h) != 0) return -1;
   *out_ptr = reinterpret_cast<const void*>(
       static_cast<uintptr_t>(ptr_slot));
   *out_len = len_slot;
@@ -934,7 +955,7 @@ int LGBM_DatasetSetFeatureNames(void* handle, const char** feature_names,
   std::string body =
       "_lgbm_capi['obj'][" + std::to_string(h->id) +
       "]['feature_names'] = " + names + "\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 namespace {
@@ -1032,7 +1053,7 @@ int LGBM_DatasetSaveBinary(void* handle, const char* filename) {
       "feature_name=d.get('feature_names', 'auto'), "
       "params=dict(d['params']))\n" +
       "ds.save_binary(" + PyStr(filename) + ")\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_BoosterUpdateOneIterCustom(void* handle, const float* grad,
@@ -1056,7 +1077,7 @@ int LGBM_BoosterUpdateOneIterCustom(void* handle, const float* grad,
       "b['finished'] = bool(fin)\n" +
       "_ct.c_int.from_address(" + Addr(is_finished) +
       ").value = 1 if fin else 0\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_BoosterResetParameter(void* handle, const char* parameters) {
@@ -1069,7 +1090,7 @@ int LGBM_BoosterResetParameter(void* handle, const char* parameters) {
       "b = _lgbm_capi['obj'][" + std::to_string(h->id) + "]\n" +
       ParamsDict(parameters) +
       "b['booster'].reset_parameter(p)\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LgbmTrainBoosterCalcNumPredict(void* handle, int num_row,
@@ -1097,7 +1118,7 @@ int LgbmTrainBoosterCalcNumPredict(void* handle, int num_row,
       "else K)\n" +
       "_ct.c_int64.from_address(" + Addr(out_len) + ").value = " +
       std::to_string(num_row) + " * per_row\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LgbmTrainBoosterPredictForFile(void* handle,
@@ -1139,7 +1160,7 @@ int LgbmTrainBoosterPredictForFile(void* handle,
       "    for row in pred:\n" +
       "        f.write('\\t'.join(repr(float(v)) for v in row) + "
       "'\\n')\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 }  // extern "C"
@@ -1313,7 +1334,7 @@ int LGBM_DatasetCreateByReference(const void* reference,
       "'stream': {'total': " + std::to_string(num_total_row) +
       ", 'pushed': 0, 'finished': False, 'manual_finish': False, "
       "'nclasses': 1}}\n";
-  if (RunGuarded(body) != 0) {
+  if (RunGuarded(body, r) != 0) {
     DropHandle(h);
     return -1;
   }
@@ -1348,7 +1369,7 @@ int LGBM_DatasetInitStreaming(void* dataset, int32_t has_weights,
       (has_queries
            ? "d['fields']['qid_raw'] = _np.zeros(n, _np.int32)\n"
            : "");
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_DatasetPushRows(void* dataset, const void* data, int data_type,
@@ -1375,7 +1396,7 @@ int LGBM_DatasetPushRows(void* dataset, const void* data, int data_type,
       "    if (st['pushed'] >= st['total'] and not "
       "st['manual_finish']):\n" +
       "        st['finished'] = True\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_DatasetPushRowsWithMetadata(void* dataset, const void* data,
@@ -1422,7 +1443,7 @@ int LGBM_DatasetPushRowsWithMetadata(void* dataset, const void* data,
     body += NpFromBuf("q", query, "_ct.c_int32", nrow) +
             "d['fields'].setdefault('qid_raw', "
             "_np.zeros(d['X'].shape[0], _np.int32))[s:e] = q\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_DatasetPushRowsByCSR(void* dataset, const void* indptr,
@@ -1458,7 +1479,7 @@ int LGBM_DatasetPushRowsByCSR(void* dataset, const void* indptr,
       "    if (st['pushed'] >= st['total'] and not "
       "st['manual_finish']):\n" +
       "        st['finished'] = True\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_DatasetPushRowsByCSRWithMetadata(
@@ -1517,7 +1538,7 @@ int LGBM_DatasetPushRowsByCSRWithMetadata(
     body += NpFromBuf("q", query, "_ct.c_int32", nrow) +
             "d['fields'].setdefault('qid_raw', "
             "_np.zeros(d['X'].shape[0], _np.int32))[s:e] = q\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_DatasetSetWaitForManualFinish(void* dataset, int wait) {
@@ -1593,7 +1614,7 @@ int LGBM_DatasetGetSubset(const void* handle,
       "        f2[k] = v[ri]\n" +
       "_lgbm_capi['obj'][" + std::to_string(nh->id) +
       "] = {'X': X2, 'params': np2, 'fields': f2}\n";
-  if (RunGuarded(body) != 0) {
+  if (RunGuarded(body, h) != 0) {
     DropHandle(nh);
     return -1;
   }
@@ -1665,7 +1686,7 @@ int LGBM_DatasetGetFeatureNumBin(void* handle, int feature_idx,
       "int(pp.get('min_data_in_leaf', 20)))\n"
       "_ct.c_int32.from_address(" + Addr(&slot) +
       ").value = int(m.num_bin)\n";
-  if (RunGuarded(body) != 0) return -1;
+  if (RunGuarded(body, h) != 0) return -1;
   *out = slot;
   return 0;
 }
@@ -1735,7 +1756,7 @@ int LGBM_BoosterDumpModel(void* handle, int start_iteration,
       "_lgbm_capi[" + key + "] = js\n" +
       "_ct.c_int64.from_address(" + Addr(&len_slot) +
       ").value = len(js)\n";
-  if (RunGuarded(body) != 0) return -1;
+  if (RunGuarded(body, h) != 0) return -1;
   *out_len = len_slot;
   if (out_str && buffer_len > 0) {
     int64_t n = std::min<int64_t>(buffer_len, len_slot);
@@ -1767,7 +1788,7 @@ int LGBM_BoosterGetLoadedParam(void* handle, int64_t buffer_len,
       "_lgbm_capi[" + key + "] = js\n" +
       "_ct.c_int64.from_address(" + Addr(&len_slot) +
       ").value = len(js)\n";
-  if (RunGuarded(body) != 0) return -1;
+  if (RunGuarded(body, h) != 0) return -1;
   *out_len = len_slot;
   if (out_str && buffer_len > 0) {
     int64_t n = std::min<int64_t>(buffer_len, len_slot);
@@ -1799,7 +1820,7 @@ int LGBM_BoosterFeatureImportance(void* handle, int num_iteration,
       ").astype(_np.float64)\n" +
       "_ct.memmove(" + Addr(out_results) +
       ", imp.ctypes.data, imp.nbytes)\n";
-  return RunGuarded(body);
+  return RunGuarded(body, h);
 }
 
 int LGBM_BoosterMerge(void* handle, void* other_handle) {
@@ -1911,7 +1932,7 @@ int LGBM_DatasetSerializeReferenceToBinary(void* handle,
       "_lgbm_capi[" + key + "] = blob\n" +
       "_ct.c_int64.from_address(" + Addr(&len_slot) +
       ").value = len(blob)\n";
-  if (RunGuarded(body) != 0) return -1;
+  if (RunGuarded(body, h) != 0) return -1;
   auto* bb = new ByteBuf();
   bb->data.resize(static_cast<size_t>(len_slot));
   if (RunGuarded("blob = _lgbm_capi.pop(" + key + ")\n" +

@@ -79,3 +79,33 @@ def test_c_wave2_harness(tmp_path):
                          capture_output=True, text=True, timeout=900)
     assert out.returncode == 0, (out.stdout[-3000:], out.stderr[-3000:])
     assert "C-WAVE2-OK" in out.stdout
+
+
+def test_c_train_concurrent_harness(tmp_path):
+    """Per-handle locking: independent boosters train concurrently from
+    two host threads; a contended booster serializes (exact iteration
+    count, no corruption). Ref: src/c_api.cpp:170 per-Booster locks."""
+    so_path = os.path.join(REPO, "lightgbm_tpu", "native", "_build",
+                           "lgbm_native.so")
+    assert os.path.exists(so_path)
+    exe = str(tmp_path / "c_train_concurrent")
+    subprocess.run(
+        ["gcc", "-O1",
+         "-I", os.path.join(REPO, "lightgbm_tpu", "native"),
+         os.path.join(REPO, "tests", "c_train_concurrent_harness.c"),
+         so_path, "-lm", "-lpthread", "-o", exe],
+        check=True, capture_output=True, timeout=120)
+
+    env = dict(os.environ)
+    site = sysconfig.get_paths()["purelib"]
+    env["PYTHONPATH"] = site + os.pathsep + env.get("PYTHONPATH", "")
+    env["LIGHTGBM_TPU_PLATFORM"] = "cpu"
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ldlib = sysconfig.get_config_var("LDLIBRARY") or ""
+    if libdir and ldlib:
+        env.setdefault("LGBM_TPU_LIBPYTHON", os.path.join(libdir, ldlib))
+
+    out = subprocess.run([exe], env=env, capture_output=True, text=True,
+                         timeout=600)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    assert "C-TRAIN-CONCURRENT-OK" in out.stdout
