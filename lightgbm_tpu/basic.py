@@ -43,6 +43,8 @@ def _to_2d_numpy(data) -> Tuple[np.ndarray, Optional[List[str]]]:
 
 
 def _to_1d_numpy(data, dtype=np.float32) -> np.ndarray:
+    if _has_arrow_c_stream(data):     # e.g. polars Series
+        data = _arrow_chunked_from_c(data)
     if _is_arrow_array(data):
         data = data.to_numpy(zero_copy_only=False)
     elif hasattr(data, "values"):
@@ -93,6 +95,44 @@ def _is_arrow_table(data) -> bool:
     except ImportError:
         return False
     return isinstance(data, (pa.Table, pa.RecordBatch))
+
+
+def _has_arrow_c_stream(data) -> bool:
+    """Arrow PyCapsule protocol producer that is not already handled.
+
+    Covers polars DataFrames/Series (ref: the reference's polars
+    ingestion rides the same Arrow C interface,
+    tests/python_package_test/test_polars.py) and any other producer of
+    ``__arrow_c_stream__``. pandas also implements the capsule protocol
+    on recent versions but keeps its dedicated path (detected first via
+    .values/.columns); pyarrow objects keep theirs.
+    """
+    return (hasattr(data, "__arrow_c_stream__") and
+            not (hasattr(data, "values") and hasattr(data, "columns")) and
+            not isinstance(data, np.ndarray) and
+            not _is_arrow_table(data) and not _is_arrow_array(data))
+
+
+def _arrow_table_from_c(data):
+    """Materialize a capsule-protocol producer as a pyarrow Table."""
+    try:
+        import pyarrow as pa
+    except ImportError as e:
+        raise LightGBMError(
+            "this input implements the Arrow C-stream protocol (e.g. a "
+            "polars DataFrame); ingesting it requires pyarrow") from e
+    return pa.table(data)
+
+
+def _arrow_chunked_from_c(data):
+    """Materialize a 1-D capsule-protocol producer (e.g. polars Series)."""
+    try:
+        import pyarrow as pa
+    except ImportError as e:
+        raise LightGBMError(
+            "this input implements the Arrow C-stream protocol (e.g. a "
+            "polars Series); ingesting it requires pyarrow") from e
+    return pa.chunked_array(data)
 
 
 def _is_arrow_array(data) -> bool:
@@ -215,8 +255,10 @@ class Dataset:
         elif _is_scipy_sparse(self.data):
             from .io.dataset_core import SparseColumns
             data, inferred_names = SparseColumns(self.data), None
-        elif _is_arrow_table(self.data):
+        elif _is_arrow_table(self.data) or _has_arrow_c_stream(self.data):
             from .io.dataset_core import ArrowColumns
+            if _has_arrow_c_stream(self.data):   # e.g. polars DataFrame
+                self.data = _arrow_table_from_c(self.data)
             data = ArrowColumns(self.data)
             inferred_names = data.column_names()
         else:
@@ -466,6 +508,8 @@ class Dataset:
                 f"Cannot add features from a dataset with {b.num_data} "
                 f"rows to one with {a.num_data} rows")
         off = a.num_total_features
+        a.ensure_logical_bins()
+        b.ensure_logical_bins()
         a.bin_mappers = list(a.bin_mappers) + list(b.bin_mappers)
         a.used_feature_map = np.concatenate(
             [a.used_feature_map, b.used_feature_map + off]).astype(np.int32)
@@ -833,8 +877,10 @@ class Booster:
                     validate_features=validate_features, **kwargs)
                 return sp.csr_matrix(dense)
             X = csr.toarray().astype(np.float64)
-        elif _is_arrow_table(data):
+        elif _is_arrow_table(data) or _has_arrow_c_stream(data):
             from .io.dataset_core import ArrowColumns
+            if _has_arrow_c_stream(data):        # e.g. polars DataFrame
+                data = _arrow_table_from_c(data)
             X = ArrowColumns(data).to_dense_f32().astype(np.float64)
         else:
             X, _ = _to_2d_numpy(data)
@@ -948,7 +994,8 @@ class Booster:
         import jax.numpy as jnp
         eng = self._engine
         K = eng.num_tree_per_iteration
-        bins_dev = jnp.asarray(binned.bins)
+        bins_dev = jnp.asarray(binned.ensure_logical_bins()
+                               if binned.bins is None else binned.bins)
         score = np.zeros((K, binned.num_data), np.float64)
         for i, t in enumerate(eng.models):
             k = i % K

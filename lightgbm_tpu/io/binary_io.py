@@ -60,6 +60,10 @@ def _mapper_from_dict(d: dict) -> BinMapper:
 
 def save_binary(ds: BinnedDataset, path: str) -> None:
     """Write a constructed BinnedDataset to `path` (ref: dataset.h:710)."""
+    if ds.bins is None and getattr(ds, "bins_grouped", None) is not None:
+        # binary format carries logical bins; reconstruct once (exact up
+        # to EFB conflict rows — the values training saw)
+        ds.ensure_logical_bins()
     if ds.bins is None:
         log.fatal("cannot save an unconstructed dataset")
     header = {

@@ -207,6 +207,12 @@ class BinnedDataset:
         # multi-value sparse storage: (idx [R, K], binv [R, K]) host
         # arrays over USED features, or None (dense `bins` used instead)
         self.bins_mv: Optional[tuple] = None
+        # direct-bundled storage: [G, R] physical EFB groups + the
+        # BundleInfo that packed them (sparse sources skip the [F, R]
+        # logical matrix entirely); `bins` stays None until a consumer
+        # that needs logical bins calls ensure_logical_bins()
+        self.bins_grouped: Optional[np.ndarray] = None
+        self.efb_info = None
         self.bin_mappers: List[BinMapper] = []
         self.used_feature_map: np.ndarray = np.zeros(0, dtype=np.int32)
         self.num_data: int = 0
@@ -282,6 +288,7 @@ class BinnedDataset:
         # only nonzero bins are stored, [R, K] with K = max nnz per row
         n_used = len(self.used_feature_map)
         use_mv = False
+        bundle_info = None
         if (isinstance(source, SparseColumns) and reference is None
                 and n_used >= 2):
             mode = str(config.tpu_sparse_storage).lower()
@@ -292,27 +299,47 @@ class BinnedDataset:
                 density = nnz / max(num_data * n_used, 1)
                 if density < 0.25 and n_used >= 32 and n_used <= 8192:
                     # storage bytes/row: dense-after-EFB ~G (u8 groups)
-                    # vs multival ~8*K ([R,K] int32 id+bin pairs). Probe
-                    # bundleability on a row sample (find_bundles only
-                    # reads presence patterns) and pick the cheaper one —
-                    # one-hot-ish data stays dense for EFB, high-conflict
-                    # wide-sparse goes multival.
+                    # vs multival ~8*K ([R,K] int32 id+bin pairs). Bin a
+                    # row sample and bundle it; one-hot-ish data goes
+                    # DIRECTLY to the bundled [G, R] layout (never
+                    # materializing [F, R] — 56 GB at Allstate shape),
+                    # high-conflict wide-sparse goes multival.
                     from .bundling import find_bundles
-                    csr = source.csc.tocsr()
-                    K_max = int(np.diff(csr.indptr).max()) if nnz else 1
-                    S = min(num_data, 2000)
-                    rs = np.linspace(0, num_data - 1, S).astype(np.int64)
-                    sub = (csr[rs][:, self.used_feature_map] != 0)
-                    presence = np.asarray(sub.todense(), np.uint8).T
+                    K_max = 1
+                    if nnz:
+                        csr_ptr = source.csc.tocsr().indptr
+                        K_max = int(np.diff(csr_ptr).max())
+                    S = min(num_data, 20_000)
+                    rs = np.unique(np.linspace(
+                        0, num_data - 1, S).astype(np.int64))
+                    sample_bins = np.empty((n_used, len(rs)), np.int64)
+                    for out_i, feat_i in enumerate(self.used_feature_map):
+                        sample_bins[out_i] = \
+                            self.bin_mappers[feat_i].value_to_bin(
+                                source.get_col_sample(feat_i, rs))
                     nb_used = np.asarray(
                         [self.bin_mappers[i].num_bin
                          for i in self.used_feature_map], np.int64)
-                    probe = (find_bundles(presence, nb_used,
+                    probe = (find_bundles(sample_bins, nb_used,
                                           config.max_conflict_rate)
                              if config.enable_bundle else None)
                     G = probe.num_groups if probe is not None else n_used
                     use_mv = 8 * max(K_max, 1) < G
-        if use_mv:
+                    if not use_mv:
+                        bundle_info = probe
+        if bundle_info is not None:
+            from .bundling import pack_sparse_direct
+            self.bins = None
+            self.efb_info = bundle_info
+            self.bins_grouped = pack_sparse_direct(
+                source.csc.tocsc(), self.bin_mappers,
+                self.used_feature_map, bundle_info)
+            log.info(
+                f"sparse source packed directly into "
+                f"{bundle_info.num_groups} EFB groups "
+                f"({n_used} features, [G, R] storage "
+                f"{self.bins_grouped.nbytes >> 20} MB)")
+        elif use_mv:
             self.bins = None
             self.bins_mv = cls._quantize_sparse(source, self.bin_mappers,
                                                 self.used_feature_map)
@@ -502,10 +529,41 @@ class BinnedDataset:
     def feature_infos(self) -> List[str]:
         return [m.feature_info() for m in self.bin_mappers]
 
+    def ensure_logical_bins(self) -> Optional[np.ndarray]:
+        """Logical [F_used, R] bin matrix, reconstructing it from the
+        direct-bundled storage when necessary.
+
+        The reconstruction is decode_logical_bin applied per feature —
+        exact except on EFB conflict rows (bounded by max_conflict_rate;
+        the overwritten feature reads as its default bin, which is the
+        value training itself saw). Rare consumers only (traversal
+        replay, dataset merging, binary export); the hot paths stay on
+        the [G, R] layout."""
+        if self.bins is not None or self.bins_grouped is None:
+            return self.bins
+        info = self.efb_info
+        F = len(self.used_feature_map)
+        max_nb = int(info.num_bin.max()) if F else 2
+        dtype = np.uint8 if max_nb <= 256 else np.uint16
+        out = np.empty((F, self.num_data), dtype)
+        for fi in range(F):
+            g = int(info.group[fi])
+            off = int(info.offset[fi])
+            d = int(info.default_bin[fi])
+            nb = int(info.num_bin[fi])
+            rel = self.bins_grouped[g].astype(np.int64) - off
+            act = (rel >= 0) & (rel < nb - 1)
+            out[fi] = np.where(act, rel + (rel >= d), d).astype(dtype)
+        self.bins = out
+        return out
+
     def subset(self, row_indices: np.ndarray) -> "BinnedDataset":
         """Row-subset copy (ref: Dataset::CopySubrow) — used by cv()."""
         out = BinnedDataset()
         out.bins = self.bins[:, row_indices] if self.bins is not None else None
+        if self.bins_grouped is not None:
+            out.bins_grouped = self.bins_grouped[:, row_indices]
+            out.efb_info = self.efb_info
         if self.bins_mv is not None:
             # multi-value storage is row-major: subsetting is a row gather
             out.bins_mv = (self.bins_mv[0][row_indices],

@@ -150,7 +150,9 @@ class _ValidData:
             self.bins_dev = jnp.asarray(
                 densify(dataset.bins_mv[0], dataset.bins_mv[1], dflt))
         else:
-            self.bins_dev = jnp.asarray(dataset.bins)
+            self.bins_dev = jnp.asarray(dataset.ensure_logical_bins()
+                                        if dataset.bins is None
+                                        else dataset.bins)
         self.score = jnp.zeros((num_class, dataset.num_data), jnp.float32)
         if dataset.metadata.init_score is not None:
             init = dataset.metadata.init_score.reshape(
@@ -741,16 +743,27 @@ class GBDT:
         elif (cfg.enable_bundle and
                 self._tree_learner in ("serial", "data", "voting",
                                        "feature") and
-                train.bins is not None and train.num_used_features > 1):
+                (train.bins is not None or
+                 getattr(train, "bins_grouped", None) is not None) and
+                train.num_used_features > 1):
             from ..io.bundling import find_bundles, pack_bins
             nb_used = np.asarray([m.num_bin for m in mappers], np.int64)
-            info = find_bundles(train.bins, nb_used,
-                                max_conflict_rate=cfg.max_conflict_rate)
+            if getattr(train, "bins_grouped", None) is not None:
+                # sparse sources packed straight into [G, R] at dataset
+                # construction (pack_sparse_direct) — reuse their
+                # BundleInfo instead of re-deriving it from a logical
+                # matrix that was never materialized
+                info = train.efb_info
+            else:
+                info = find_bundles(train.bins, nb_used,
+                                    max_conflict_rate=cfg.max_conflict_rate)
             if info is not None:
                 B_all = int(max(self.num_bin_max,
                                 info.group_num_bin.max()))
                 info.build_gather_map(B_all)
-                train_bins_host = pack_bins(train.bins, info)
+                train_bins_host = (train.bins_grouped
+                                   if train.bins_grouped is not None
+                                   else pack_bins(train.bins, info))
                 self.num_bin_max = B_all
                 self.grower_cfg = dataclasses.replace(self.grower_cfg,
                                                       num_bin=B_all)
@@ -774,6 +787,14 @@ class GBDT:
                         "with tree_learner=feature + EFB; using 'basic'")
                     self.grower_cfg = dataclasses.replace(
                         self.grower_cfg, mc_method="basic")
+
+        if (train_bins_host is None and self._bundle is None and
+                getattr(train, "bins_grouped", None) is not None):
+            # direct-bundled dataset but the bundle could not engage
+            # (enable_bundle off at train time, forced splits, learner
+            # mix): reconstruct the logical matrix so every downstream
+            # path keeps its contract
+            train_bins_host = train.ensure_logical_bins()
 
         self.bins_rf = None
         self._bins_packed_dev = None
@@ -965,6 +986,15 @@ class GBDT:
         on demand — only rollback/DART/continued-training traversal needs
         it, and it costs the dense footprint (warned once)."""
         mv_pair = None
+        if (self._bins_dev_cache is None and self._bins_fr_host is None and
+                self.train_set is not None and
+                getattr(self.train_set, "bins_grouped", None) is not None):
+            # direct-bundled storage: reconstruct logical bins once for
+            # the traversal consumer (same cost note as multival below)
+            log.warning("densifying EFB-bundled bins for a traversal "
+                        "path (rollback/DART/continued training) — this "
+                        "costs the logical bin footprint")
+            self._bins_fr_host = self.train_set.ensure_logical_bins()
         if self._bins_dev_cache is None and self._bins_fr_host is None:
             if getattr(self, "_bins_mv_dev", None) is not None:
                 mv_pair = (self._bins_mv_dev.idx, self._bins_mv_dev.binv)

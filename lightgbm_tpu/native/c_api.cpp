@@ -24,6 +24,7 @@
 #include <memory>
 #include <sstream>
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -389,6 +390,38 @@ int PredictContribDense(Model* m, const double* X, int64_t nrow,
                         int32_t ncol, int start_iteration,
                         int num_iteration, double* out);  // defined below
 
+extern int g_max_threads;  // defined below (LGBM_SetMaxThreads)
+
+// Row-parallel driver for the serving loops. Rows are independent and
+// write disjoint output regions, so a plain chunked std::thread pool
+// mirrors the reference's `#pragma omp parallel for` over rows
+// (ref: src/application/predictor.hpp:31 OMP per-row predict).
+// Honors LGBM_SetMaxThreads (g_max_threads; -1 = hardware default) and
+// stays single-threaded below min_rows_per_thread to avoid spawn cost
+// on small/single-row requests.
+template <typename BodyFn>
+void ParallelRows(int64_t nrow, int64_t min_rows_per_thread, BodyFn body) {
+  int hw = static_cast<int>(std::thread::hardware_concurrency());
+  int maxt = g_max_threads > 0 ? g_max_threads : (hw > 0 ? hw : 1);
+  int64_t want = (nrow + min_rows_per_thread - 1) / min_rows_per_thread;
+  int t = static_cast<int>(
+      std::min<int64_t>(maxt, std::max<int64_t>(want, 1)));
+  if (t <= 1) {
+    body(static_cast<int64_t>(0), nrow);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(t);
+  int64_t chunk = (nrow + t - 1) / t;
+  for (int i = 0; i < t; ++i) {
+    int64_t lo = i * chunk;
+    int64_t hi = std::min<int64_t>(nrow, lo + chunk);
+    if (lo >= hi) break;
+    threads.emplace_back([&body, lo, hi]() { body(lo, hi); });
+  }
+  for (auto& th : threads) th.join();
+}
+
 template <typename FillFn>
 int PredictRows(Model* m, FillFn fill, int64_t nrow, int64_t ncol,
                 int predict_type, int start_iteration, int num_iteration,
@@ -398,24 +431,28 @@ int PredictRows(Model* m, FillFn fill, int64_t nrow, int64_t ncol,
                      ? total_iter
                      : std::min(total_iter, start_iteration + num_iteration);
   int K = m->num_tree_per_iteration;
-  std::vector<double> row(ncol);
 
   if (predict_type == 2) {  // leaf indices, [nrow, num_trees_used]
     int n_used = (end_iter - start_iteration) * K;
-    for (int64_t r = 0; r < nrow; ++r) {
-      fill(r, row.data());
-      double* out = out_result + r * n_used;
-      int j = 0;
-      for (int it = start_iteration; it < end_iter; ++it)
-        for (int k = 0; k < K; ++k)
-          out[j++] = m->trees[it * K + k].PredictLeaf(row.data());
-    }
+    ParallelRows(nrow, 256, [&](int64_t lo, int64_t hi) {
+      std::vector<double> row(ncol);
+      for (int64_t r = lo; r < hi; ++r) {
+        fill(r, row.data());
+        double* out = out_result + r * n_used;
+        int j = 0;
+        for (int it = start_iteration; it < end_iter; ++it)
+          for (int k = 0; k < K; ++k)
+            out[j++] = m->trees[it * K + k].PredictLeaf(row.data());
+      }
+    });
     *out_len = static_cast<int64_t>(nrow) * n_used;
     return 0;
   }
   if (predict_type == 3) {  // C_API_PREDICT_CONTRIB
     std::vector<double> X(static_cast<size_t>(nrow) * ncol);
-    for (int64_t r = 0; r < nrow; ++r) fill(r, X.data() + r * ncol);
+    ParallelRows(nrow, 1024, [&](int64_t lo, int64_t hi) {
+      for (int64_t r = lo; r < hi; ++r) fill(r, X.data() + r * ncol);
+    });
     if (PredictContribDense(m, X.data(), nrow,
                             static_cast<int32_t>(ncol),
                             start_iteration, num_iteration,
@@ -430,17 +467,20 @@ int PredictRows(Model* m, FillFn fill, int64_t nrow, int64_t ncol,
     return -1;
   }
   int n_iter_used = end_iter - start_iteration;
-  for (int64_t r = 0; r < nrow; ++r) {
-    fill(r, row.data());
-    double* out = out_result + r * K;
-    for (int k = 0; k < K; ++k) out[k] = 0.0;
-    for (int it = start_iteration; it < end_iter; ++it)
-      for (int k = 0; k < K; ++k)
-        out[k] += m->trees[it * K + k].Predict(row.data());
-    if (m->average_output && n_iter_used > 0)
-      for (int k = 0; k < K; ++k) out[k] /= n_iter_used;  // rf averaging
-    if (predict_type == 0) TransformRow(*m, out);
-  }
+  ParallelRows(nrow, 256, [&](int64_t lo, int64_t hi) {
+    std::vector<double> row(ncol);
+    for (int64_t r = lo; r < hi; ++r) {
+      fill(r, row.data());
+      double* out = out_result + r * K;
+      for (int k = 0; k < K; ++k) out[k] = 0.0;
+      for (int it = start_iteration; it < end_iter; ++it)
+        for (int k = 0; k < K; ++k)
+          out[k] += m->trees[it * K + k].Predict(row.data());
+      if (m->average_output && n_iter_used > 0)
+        for (int k = 0; k < K; ++k) out[k] /= n_iter_used;  // rf averaging
+      if (predict_type == 0) TransformRow(*m, out);
+    }
+  });
   *out_len = static_cast<int64_t>(nrow) * K;
   return 0;
 }
