@@ -92,6 +92,40 @@ static void* thr_predict(void* p) {
   return NULL;
 }
 
+/* thread worker: full-matrix predict (the ParallelRows path) into a
+ * private buffer, compared row-for-row to expected — concurrent MAT
+ * predicts on one serving handle must be re-entrant */
+typedef struct {
+  void* handle;
+  const double* X;
+  const double* expect;
+  int n;
+  int f;
+  int rc;
+} mat_arg;
+
+static void* thr_predict_mat(void* p) {
+  mat_arg* a = (mat_arg*)p;
+  double* out = (double*)malloc(sizeof(double) * a->n);
+  int64_t len = 0;
+  a->rc = 1;
+  if (LGBM_BoosterPredictForMat(a->handle, a->X, 1, a->n, a->f, 1, 0, 0,
+                                -1, "", &len, out) != 0 ||
+      len != a->n) {
+    free(out);
+    return NULL;
+  }
+  for (int r = 0; r < a->n; ++r) {
+    if (fabs(out[r] - a->expect[r]) > 1e-9) {
+      free(out);
+      return NULL;
+    }
+  }
+  free(out);
+  a->rc = 0;
+  return NULL;
+}
+
 int main(int argc, char** argv) {
   const char* model_path = argc > 1 ? argv[1] : "/tmp/c_wave2_model.txt";
   const int n = 400, f = 5;
@@ -283,6 +317,25 @@ int main(int argc, char** argv) {
       ASSERT(args[t].rc == 0);
     }
     CHECK(LGBM_FastConfigFree(fc));
+  }
+
+  /* ---- concurrent full-matrix predict: 4 threads, same handle */
+  {
+    pthread_t th[4];
+    mat_arg margs[4];
+    for (int t = 0; t < 4; ++t) {
+      margs[t].handle = srv;
+      margs[t].X = X;
+      margs[t].expect = expect;
+      margs[t].n = n;
+      margs[t].f = f;
+      margs[t].rc = -1;
+      pthread_create(&th[t], NULL, thr_predict_mat, &margs[t]);
+    }
+    for (int t = 0; t < 4; ++t) {
+      pthread_join(th[t], NULL);
+      ASSERT(margs[t].rc == 0);
+    }
   }
 
   /* ---- bounds + name validation */
