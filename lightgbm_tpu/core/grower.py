@@ -181,6 +181,20 @@ def _set(arr, idx, val, cond):
     return arr.at[idx].set(jnp.where(cond, val, arr[idx]))
 
 
+def _set_rows2(arr, idx_a, idx_b, row_a, row_b, cond, fallback=None):
+    """Guarded write of the (parent, new-leaf) row pair as ONE gather +
+    ONE scatter instead of two of each — every scatter in the split
+    loop's while body is a dispatched kernel on device, and the body op
+    count is the fixed per-split cost (docs/TPU_RUNBOOK.md cost model).
+    Indices must be distinct (parent != new leaf always holds).
+    ``fallback`` overrides the not-cond rows (default: current rows)."""
+    idx2 = jnp.stack([idx_a, idx_b])
+    upd2 = jnp.stack([row_a, row_b])
+    if fallback is None:
+        fallback = arr[idx2]
+    return arr.at[idx2].set(jnp.where(cond, upd2, fallback))
+
+
 def _bucket_sizes(num_rows: int, min_bucket: int) -> list:
     """Descending static segment sizes: [R, pow2 < R, ..., min_bucket].
 
@@ -1079,12 +1093,11 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                                      pick(rec.left_output,
                                           rec.right_output))
                         hist_small = reduce_hist(hist_small, small_ctx)
-                seg = state.seg.at[l].set(jnp.where(
-                    proceed, jnp.stack([start_l, nL_raw]), segrow))
-                seg = seg.at[new_leaf].set(jnp.where(
-                    proceed,
+                seg = _set_rows2(
+                    state.seg, l, new_leaf,
+                    jnp.stack([start_l, nL_raw]),
                     jnp.stack([start_l + nL_raw, rows_l - nL_raw]),
-                    seg[new_leaf]))
+                    proceed)
             else:
                 order = state.order
                 seg = state.seg
@@ -1159,10 +1172,8 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 hist_large = hist_parent - hist_small
                 hist_left = jnp.where(left_smaller, hist_small, hist_large)
                 hist_right = jnp.where(left_smaller, hist_large, hist_small)
-                hist = state.hist.at[l].set(
-                    jnp.where(proceed, hist_left, state.hist[l]))
-                hist = hist.at[new_leaf].set(
-                    jnp.where(proceed, hist_right, hist[new_leaf]))
+                hist = _set_rows2(state.hist, l, new_leaf,
+                                  hist_left, hist_right, proceed)
 
             # ---- local-sums channel (voting): children's LOCAL totals --
             if local_pool:
@@ -1175,10 +1186,8 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                                           lsum_large)
                     lsum_rrow = jnp.where(left_smaller, lsum_large,
                                           small_lsum)
-                lsum = state.lsum.at[l].set(
-                    jnp.where(proceed, lsum_lrow, state.lsum[l]))
-                lsum = lsum.at[new_leaf].set(
-                    jnp.where(proceed, lsum_rrow, lsum[new_leaf]))
+                lsum = _set_rows2(state.lsum, l, new_leaf,
+                                  lsum_lrow, lsum_rrow, proceed)
                 lsums2 = conv(jnp.stack([lsum_lrow, lsum_rrow]))
             else:
                 lsum = state.lsum
@@ -1299,20 +1308,16 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                               rec.right_sum_hessian, rec.right_count,
                               rec.right_output, r_min, r_max, child_depth,
                               i_f, jnp.float32(1.0), 2.0 * i_f + 2.0])
-            stats = state.stats.at[l].set(jnp.where(proceed, lrow, srow))
-            stats = stats.at[new_leaf].set(
-                jnp.where(proceed, rrow, stats[new_leaf]))
+            stats = _set_rows2(state.stats, l, new_leaf, lrow, rrow,
+                               proceed)
 
             # ---- interaction path bookkeeping ------------------------------
             if use_ic:
                 f_onehot = (jnp.arange(F) ==
                             jnp.maximum(rec.feature, 0)) & (rec.feature >= 0)
                 child_path = state.path_mask[l] | f_onehot
-                path_mask = state.path_mask
-                path_mask = path_mask.at[l].set(
-                    jnp.where(proceed, child_path, path_mask[l]))
-                path_mask = path_mask.at[new_leaf].set(
-                    jnp.where(proceed, child_path, path_mask[new_leaf]))
+                path_mask = _set_rows2(state.path_mask, l, new_leaf,
+                                       child_path, child_path, proceed)
             else:
                 child_path = None
                 path_mask = None
@@ -1364,16 +1369,16 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 )(hists2, sg2, sh2, cn2, ou2, mn2, mx2, dp2, fm2, rb2,
                   lsums2)
             rows2 = pack_rec(best2)                              # [2, NB]
-            best = state.best.at[l].set(
-                jnp.where(proceed, rows2[0], brow))
-            best = best.at[new_leaf].set(
-                jnp.where(proceed, rows2[1], best[new_leaf]))
+            # fallback keeps brow/bcat (forced-split overwrites), not
+            # the raw state rows
+            best = _set_rows2(
+                state.best, l, new_leaf, rows2[0], rows2[1], proceed,
+                fallback=jnp.stack([brow, state.best[new_leaf]]))
             if has_cat:
-                best_cat = state.best_cat.at[l].set(
-                    jnp.where(proceed, best2.cat_bins[0], bcat))
-                best_cat = best_cat.at[new_leaf].set(
-                    jnp.where(proceed, best2.cat_bins[1],
-                              best_cat[new_leaf]))
+                best_cat = _set_rows2(
+                    state.best_cat, l, new_leaf,
+                    best2.cat_bins[0], best2.cat_bins[1], proceed,
+                    fallback=jnp.stack([bcat, state.best_cat[new_leaf]]))
             else:
                 best_cat = None
 
