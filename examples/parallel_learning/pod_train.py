@@ -1,0 +1,81 @@
+"""Pod-scale distributed training walkthrough (one process per host).
+
+The SPMD translation of the reference's parallel-learning guide
+(ref: docs/Parallel-Learning-Guide.rst:58+ — build a machine list, pick
+ports, start N copies): here every host runs THIS script unchanged; the
+launcher contract (LGBM_TPU_* env vars, or TPU-pod auto-detection with
+no env at all) wires the world, and the global mesh spans every host's
+chips. Collectives ride ICI/DCN via XLA — no machine list, no ports.
+
+Launch examples:
+
+  # TPU pod (GKE/QR): just run it on every host — zero config
+  python pod_train.py
+
+  # any generic launcher (SLURM, mpirun, k8s): set the env contract
+  LGBM_TPU_COORDINATOR=host0:8476 LGBM_TPU_NUM_PROCESSES=4 \
+  LGBM_TPU_PROCESS_ID=$RANK python pod_train.py
+
+  # localhost rehearsal without hardware (2 procs x 2 virtual devices)
+  python -c "from lightgbm_tpu.distributed import launch_local; \
+             print(launch_local(['python', 'pod_train.py'], 2, \
+                                cpu_devices_per_process=2))"
+
+Each process loads ITS OWN row shard (per-rank file or slice — the
+reference's pre-partitioned-data convention) and `tree_learner=data`
+makes histograms global through psum.
+"""
+import os
+import sys
+
+# runnable straight from a repo checkout (drop when pip-installed)
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from lightgbm_tpu.distributed import init_from_env  # noqa: E402
+
+rank = init_from_env()          # must precede any other jax use
+
+import numpy as np              # noqa: E402
+
+import lightgbm_tpu as lgb      # noqa: E402
+from lightgbm_tpu.distributed import num_processes  # noqa: E402
+
+
+def load_data():
+    """The GLOBAL training table, loaded identically on every host.
+
+    Multi-host contract (SPMD): every process passes the same global
+    arrays; jax then places only each device's ROW SHARD into its HBM
+    (host RAM holds the full table during ingest — the device memory,
+    not the host copy, is what scales with the pod). The reference's
+    pre_partition per-machine-file mode (each host reads only its rows)
+    is not yet wired through the binning sync and is the documented gap
+    here. Synthetic data keeps the walkthrough runnable anywhere."""
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(40_000, 16)).astype(np.float32)
+    y = (X[:, 0] - 0.5 * X[:, 1] + 0.25 * X[:, 2] * X[:, 3] > 0)
+    return X, y.astype(np.float32)
+
+
+def main() -> None:
+    world = num_processes()
+    X, y = load_data()
+    bst = lgb.train(
+        {"objective": "binary", "tree_learner": "data",
+         "num_leaves": 63, "learning_rate": 0.1, "verbose": -1,
+         # bit-identical across world sizes: exact int32 histogram
+         # accumulation under the global scales
+         "use_quantized_grad": True, "stochastic_rounding": False,
+         "deterministic": True, "seed": 7},
+        lgb.Dataset(X, label=y), num_boost_round=30)
+    if rank == 0:
+        bst.save_model("pod_model.txt")
+        pred = bst.predict(X)
+        acc = float(np.mean((pred > 0.5) == y))
+        print(f"[pod_train] world={world} train-shard acc={acc:.4f} "
+              "model -> pod_model.txt", flush=True)
+
+
+if __name__ == "__main__":
+    main()

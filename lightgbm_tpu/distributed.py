@@ -88,6 +88,119 @@ def process_index() -> int:
 
 
 # ---------------------------------------------------------------------------
+# Launcher convenience layer (the Dask-analog UX).
+#
+# The reference's dask module resolves workers, assigns listen ports and
+# builds the machines list before handing off to the socket linkers
+# (ref: python-package/lightgbm/dask.py:442 _train, :300 port search).
+# The SPMD translation needs exactly three facts per process —
+# coordinator address, world size, rank — so the convenience layer is an
+# env-var contract (works under ANY process launcher: SLURM, k8s,
+# mpirun, GKE pod spec) plus a local spawner for single-machine
+# multi-process runs and tests.
+# ---------------------------------------------------------------------------
+
+ENV_COORDINATOR = "LGBM_TPU_COORDINATOR"
+ENV_NUM_PROCESSES = "LGBM_TPU_NUM_PROCESSES"
+ENV_PROCESS_ID = "LGBM_TPU_PROCESS_ID"
+ENV_CPU_DEVICES = "LGBM_TPU_CPU_DEVICES_PER_PROCESS"
+
+
+def worker_env(coordinator_address: str, num_processes: int,
+               process_id: int, cpu_devices_per_process: int = 0,
+               base_env: Optional[dict] = None) -> dict:
+    """Environment for one worker process under the launcher contract.
+
+    ``cpu_devices_per_process`` > 0 additionally forces that many
+    virtual CPU devices (hardware-free testing; on real TPU hosts leave
+    it 0 so local devices are discovered normally).
+    """
+    import os
+    env = dict(base_env if base_env is not None else os.environ)
+    env[ENV_COORDINATOR] = str(coordinator_address)
+    env[ENV_NUM_PROCESSES] = str(int(num_processes))
+    env[ENV_PROCESS_ID] = str(int(process_id))
+    if cpu_devices_per_process:
+        env[ENV_CPU_DEVICES] = str(int(cpu_devices_per_process))
+    return env
+
+
+def init_from_env() -> int:
+    """``init_distributed`` driven by the launcher env contract.
+
+    Call this unconditionally at the top of a training script: with the
+    LGBM_TPU_* variables set (by ``launch_local`` or any cluster
+    launcher) it joins that world; with none set it falls back to jax's
+    auto-detection (TPU pod metadata, SLURM) — and on a plain
+    single-host run, to a world of one. Returns the process index.
+    """
+    import os
+    coord = os.environ.get(ENV_COORDINATOR)
+    cpu_devs = int(os.environ.get(ENV_CPU_DEVICES, "0") or 0)
+    if cpu_devs:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={cpu_devs}"
+            ).strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    if coord is None:
+        try:
+            return init_distributed()     # jax auto-detection
+        except Exception as e:  # noqa: BLE001 — single-host fallback
+            log.debug(f"no distributed environment detected ({e}); "
+                      "running single-process")
+            return 0
+    return init_distributed(
+        coordinator_address=coord,
+        num_processes=int(os.environ[ENV_NUM_PROCESSES]),
+        process_id=int(os.environ[ENV_PROCESS_ID]))
+
+
+def launch_local(argv: Sequence[str], num_processes: int,
+                 coordinator_port: Optional[int] = None,
+                 cpu_devices_per_process: int = 0,
+                 timeout: float = 600.0) -> list:
+    """Spawn ``num_processes`` copies of ``argv`` on THIS machine, wired
+    into one distributed world (the local analog of spawn-per-host; the
+    per-host version is the same env contract under any real launcher).
+
+    Returns ``[(returncode, combined_output), ...]`` per rank. Kills the
+    whole gang on timeout so a hung rank cannot leak claim-holding
+    children.
+    """
+    import socket
+    import subprocess
+    if coordinator_port is None:
+        with socket.socket() as s:
+            s.bind(("", 0))
+            coordinator_port = s.getsockname()[1]
+    coord = f"localhost:{coordinator_port}"
+    procs = []
+    for rank in range(num_processes):
+        env = worker_env(coord, num_processes, rank,
+                         cpu_devices_per_process=cpu_devices_per_process)
+        if cpu_devices_per_process:
+            env.pop("XLA_FLAGS", None)    # worker rebuilds it itself
+        procs.append(subprocess.Popen(
+            list(argv), env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    results = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            results.append((p.returncode, out))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        raise
+    return results
+
+
+# ---------------------------------------------------------------------------
 # External collective injection (≡ LGBM_NetworkInitWithFunctions,
 # ref: include/LightGBM/c_api.h:1674, src/network/network.cpp:49-62 —
 # the reference lets an embedding host (SynapseML/Spark) supply its own

@@ -1173,6 +1173,17 @@ class GBDT:
                                             cegb, rng_key)
             if self._row_pad:
                 leaf_id = leaf_id[:N]
+            if jax.process_count() > 1:
+                # multi-host: leaf_id is row-sharded across processes and
+                # a direct host fetch (np.asarray in train_one_iter) can
+                # only see addressable shards — gather it once per tree.
+                # Score updates and leaf bookkeeping then run on the
+                # replicated copy, matching the reference where every
+                # machine holds its full local partition
+                # (data_parallel_tree_learner GlobalSync semantics).
+                from jax.experimental import multihost_utils
+                leaf_id = jnp.asarray(
+                    multihost_utils.process_allgather(leaf_id, tiled=True))
             return tree, leaf_id
 
         self._grow = grow_wrapper

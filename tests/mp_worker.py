@@ -2,25 +2,20 @@
 
 The process-level analogue of the reference's DistributedMockup worker
 (ref: tests/distributed/_test_distributed.py:1 — N CLI processes on
-localhost exercising the real socket stack): here each process joins a
-`jax.distributed.initialize` world over localhost and trains
-`tree_learner=data` on the GLOBAL mesh spanning both processes' CPU
+localhost exercising the real socket stack): each process joins the
+world through the launcher env contract (distributed.init_from_env —
+coordinator/world-size/rank arrive via LGBM_TPU_* variables exactly as
+`launch_local` or any pod/SLURM launcher sets them) and trains
+`tree_learner=data` on the GLOBAL mesh spanning all processes' CPU
 devices, proving the collectives path end-to-end without TPU hardware.
 
-Usage: python mp_worker.py <coordinator> <num_procs> <rank> <out.npy>
+Usage: python mp_worker.py <out.npy>   (env: LGBM_TPU_COORDINATOR etc.)
 """
 import os
 import sys
 
-# 2 virtual CPU devices per process -> a 4-device global mesh across 2 procs
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=2").strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")  # opt out of the axon plugin
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
@@ -34,14 +29,15 @@ def synth(n=2001, f=8, seed=3):
 
 
 def main():
-    coord, nproc, rank, out = (sys.argv[1], int(sys.argv[2]),
-                               int(sys.argv[3]), sys.argv[4])
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    from lightgbm_tpu.distributed import init_distributed
+    out = sys.argv[1]
+    # init_from_env BEFORE any other jax use: it applies the virtual-CPU
+    # device count and platform override, which must precede backend init
+    from lightgbm_tpu.distributed import init_from_env
 
-    init_distributed(coordinator_address=coord, num_processes=nproc,
-                     process_id=rank)
+    rank = init_from_env()
+    import jax
+
+    nproc = int(os.environ["LGBM_TPU_NUM_PROCESSES"])
     assert jax.process_count() == nproc
     assert len(jax.devices()) == 2 * nproc
 

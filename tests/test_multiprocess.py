@@ -3,14 +3,15 @@
 Closes the reference's distributed test triangle
 (ref: tests/distributed/_test_distributed.py DistributedMockup — it
 spawns N CLI processes on localhost and checks the distributed model
-against centralized training): two REAL processes join a
-`jax.distributed.initialize` world over a localhost coordinator, the
-global 4-device CPU mesh spans both, and `tree_learner=data` trains
-through the collectives path end-to-end. Predictions must match
-single-process training up to f32 reduction order.
+against centralized training): the launcher convenience layer
+(`distributed.launch_local` — the Dask-analog UX, ref:
+python-package/lightgbm/dask.py:442 _train worker wiring) spawns two
+REAL processes wired by the env contract, the global 4-device CPU mesh
+spans both, and `tree_learner=data` trains through the collectives path
+end-to-end. Predictions must match single-process training up to f32
+reduction order.
 """
 import os
-import socket
 import subprocess
 import sys
 
@@ -18,41 +19,21 @@ import numpy as np
 import pytest
 
 import lightgbm_tpu as lgb
+from lightgbm_tpu.distributed import launch_local
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
 
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
-
-
 def test_two_process_data_parallel(tmp_path):
-    port = _free_port()
     out = tmp_path / "mp_pred.npy"
-    env = dict(os.environ)
-    # workers pick their own device count (2 each -> 4 global)
-    env.pop("XLA_FLAGS", None)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, os.path.join(HERE, "mp_worker.py"),
-             f"localhost:{port}", "2", str(rank), str(out)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True)
-        for rank in range(2)
-    ]
-    logs = []
-    for p in procs:
-        try:
-            stdout, _ = p.communicate(timeout=420)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("multi-process worker timed out")
-        logs.append(stdout)
-    for rank, (p, lg) in enumerate(zip(procs, logs)):
-        assert p.returncode == 0, f"rank {rank} failed:\n{lg[-3000:]}"
+    try:
+        results = launch_local(
+            [sys.executable, os.path.join(HERE, "mp_worker.py"), str(out)],
+            num_processes=2, cpu_devices_per_process=2, timeout=420)
+    except subprocess.TimeoutExpired:
+        pytest.fail("multi-process worker timed out")
+    for rank, (rc, log_out) in enumerate(results):
+        assert rc == 0, f"rank {rank} failed:\n{log_out[-3000:]}"
     pred_mp = np.load(out)
 
     # centralized baseline in THIS process (8-device single-process mesh
