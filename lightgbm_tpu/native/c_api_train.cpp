@@ -22,7 +22,9 @@
 #include <cstdio>
 #include <cstring>
 #include <atomic>
+#include <functional>
 #include <mutex>
+#include <utility>
 #include <set>
 #include <string>
 #include <vector>
@@ -473,6 +475,59 @@ int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
   }
   *out = h;
   return 0;
+}
+
+int LGBM_DatasetCreateFromCSRFunc(void* get_row_funptr, int num_rows,
+                                  int64_t num_col, const char* parameters,
+                                  const void* reference, void** out) {
+  // ref: include/LightGBM/c_api.h:436 / src/c_api.cpp:1487 — the
+  // row-iterator variant used by the SWIG wrapper: get_row_funptr is a
+  // pointer to a C++ std::function<void(int, vector<pair<int,double>>&)>
+  // producing one sparse row per call. Rows are materialized into CSR
+  // once (two passes are unnecessary: the vectors grow amortized) and
+  // handed to the buffer-based CSR ingest above.
+  if (!get_row_funptr || !out || num_rows < 0) {
+    LgbmTrainSetError("DatasetCreateFromCSRFunc: null/invalid argument");
+    return -1;
+  }
+  if (num_col <= 0 || num_col >= INT32_MAX) {
+    LgbmTrainSetError("DatasetCreateFromCSRFunc: num_col out of range");
+    return -1;
+  }
+  auto& get_row = *static_cast<
+      std::function<void(int, std::vector<std::pair<int, double>>&)>*>(
+      get_row_funptr);
+  std::vector<int64_t> indptr(static_cast<size_t>(num_rows) + 1, 0);
+  std::vector<int32_t> cols;
+  std::vector<double> vals;
+  std::vector<std::pair<int, double>> buffer;
+  try {
+    for (int r = 0; r < num_rows; ++r) {
+      buffer.clear();
+      get_row(r, buffer);
+      for (const auto& kv : buffer) {
+        if (kv.first < 0 || kv.first >= num_col) {
+          LgbmTrainSetError("DatasetCreateFromCSRFunc: column index "
+                            "out of range");
+          return -1;
+        }
+        cols.push_back(static_cast<int32_t>(kv.first));
+        vals.push_back(kv.second);
+      }
+      indptr[static_cast<size_t>(r) + 1] =
+          static_cast<int64_t>(cols.size());
+    }
+  } catch (const std::exception& e) {
+    LgbmTrainSetError(
+        (std::string("DatasetCreateFromCSRFunc: row callback threw: ") +
+         e.what()).c_str());
+    return -1;
+  }
+  return LGBM_DatasetCreateFromCSR(
+      indptr.data(), 3 /*int64*/, cols.data(), vals.data(),
+      1 /*float64*/, static_cast<int64_t>(indptr.size()),
+      static_cast<int64_t>(vals.size()), num_col, parameters, reference,
+      out);
 }
 
 int LGBM_BoosterCreate(void* train_data, const char* parameters,

@@ -71,6 +71,12 @@ int LGBM_DatasetCreateFromMat(const void* data, int data_type,
                               int is_row_major, const char* parameters,
                               const DatasetHandle reference,
                               DatasetHandle* out);
+/* C++-only row-iterator variant (SWIG wrapper contract): get_row_funptr is a
+ * std::function<void(int, std::vector<std::pair<int,double>>&)>* producing one
+ * sparse row per call (ref: c_api.h:436). */
+int LGBM_DatasetCreateFromCSRFunc(void* get_row_funptr, int num_rows,
+                                  int64_t num_col, const char* parameters,
+                                  const void* reference, void** out);
 int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
                               const int32_t* indices, const void* data,
                               int data_type, int64_t nindptr,

@@ -109,3 +109,33 @@ def test_c_train_concurrent_harness(tmp_path):
                          timeout=600)
     assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
     assert "C-TRAIN-CONCURRENT-OK" in out.stdout
+
+
+def test_c_csrfunc_harness(tmp_path):
+    """LGBM_DatasetCreateFromCSRFunc (the SWIG row-iterator variant,
+    ref c_api.h:436): a real C++ std::function produces rows; training
+    must match the FromMat path exactly."""
+    so_path = os.path.join(REPO, "lightgbm_tpu", "native", "_build",
+                           "lgbm_native.so")
+    assert os.path.exists(so_path)
+    exe = str(tmp_path / "c_csrfunc")
+    subprocess.run(
+        ["g++", "-O1", "-std=c++17",
+         "-I", os.path.join(REPO, "lightgbm_tpu", "native"),
+         os.path.join(REPO, "tests", "c_csrfunc_harness.cpp"),
+         so_path, "-lm", "-o", exe],
+        check=True, capture_output=True, timeout=120)
+
+    env = dict(os.environ)
+    site = sysconfig.get_paths()["purelib"]
+    env["PYTHONPATH"] = site + os.pathsep + env.get("PYTHONPATH", "")
+    env["LIGHTGBM_TPU_PLATFORM"] = "cpu"
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ldlib = sysconfig.get_config_var("LDLIBRARY") or ""
+    if libdir and ldlib:
+        env.setdefault("LGBM_TPU_LIBPYTHON", os.path.join(libdir, ldlib))
+
+    out = subprocess.run([exe], env=env, capture_output=True, text=True,
+                         timeout=600)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    assert "C-CSRFUNC-OK" in out.stdout
