@@ -174,12 +174,23 @@ def main() -> int:
         fails = fails + 1 if unreachable(res) else 0
         return fails >= MAX_CONSEC_FAILS
 
+    # ---- stage 00: micro number (16k rows, 31 leaves, seconds of
+    # compile) — if the window collapses right after the probe, ANY
+    # measured non-zero beats another 0.0 round; the _L31 suffix keeps
+    # it from masquerading as the headline metric
+    micro = run_bench("micro_16k", 16_384, 10, leaves=31, watchdog=900)
+    if guard(micro):
+        say("window closed during micro_16k — bailing")
+        git_commit("bench_logs: r5 session aborted at micro stage")
+        return 3
+
     # ---- stage 0+1: headline numbers first (most valuable if the
     # window is short; also warms the persistent compile cache)
     h100 = run_bench("headline_100k", 100_000, 30, watchdog=1500)
     if guard(h100):
         say("window closed during headline_100k — bailing")
-        git_commit("bench_logs: r5 session aborted (device window closed)")
+        git_commit("bench_logs: r5 session aborted (device window closed; "
+                   "micro number landed)")
         return 3
     h1m = run_bench("headline_1m", 1_000_000, 20)
     if guard(h1m):
