@@ -338,17 +338,18 @@ def _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
     # fixed cost is kernel count; cumsum breaks fusion, so batching the
     # channels saves two kernels per scan direction)
     ghc = jnp.stack([g, h, c])                               # [3, F, B]
-    sfx = jnp.cumsum((ghc * rev_mask[None])[:, :, ::-1],
-                     axis=2)[:, :, ::-1]
-    rg_acc = sfx[0]
-    rh_acc = sfx[1] + K_EPSILON
-    rc_acc = sfx[2]
-    # candidate threshold thr means right side accumulates bins >= thr+1
-    # shift left by one: right_at_thr[t] = acc[t+1]
-    pad = jnp.zeros((F, 1), hist.dtype)
-    rg_thr = jnp.concatenate([rg_acc[:, 1:], pad], axis=1)
-    rh_thr = jnp.concatenate([rh_acc[:, 1:], pad + K_EPSILON], axis=1)
-    rc_thr = jnp.concatenate([rc_acc[:, 1:], pad], axis=1)
+    # right side at threshold t accumulates bins t+1..hi. Computed as
+    # total - prefix instead of a reversed suffix cumsum + shift
+    # concatenates: one forward scan and pure elementwise math replace
+    # the double-reverse and three [F, B] concats (each a dispatched
+    # kernel in the split loop's while body). At t = B-1 this is
+    # exactly 0 (tot - tot), reproducing the old zero padding.
+    rev_in = ghc * rev_mask[None]
+    pfx_rev = jnp.cumsum(rev_in, axis=2)                     # [3, F, B]
+    tot = pfx_rev[:, :, -1:]                                 # [3, F, 1]
+    rg_thr = tot[0] - pfx_rev[0]
+    rh_thr = (tot[1] - pfx_rev[1]) + K_EPSILON
+    rc_thr = tot[2] - pfx_rev[2]
     lg_rev, lh_rev, lc_rev = side_stats(rg_thr, rh_thr, rc_thr)
     gains_rev, valid_rev = gains_and_validity(lg_rev, lh_rev, lc_rev,
                                               rg_thr, rh_thr, rc_thr)
