@@ -195,6 +195,7 @@ def _set_rows2(arr, idx_a, idx_b, row_a, row_b, cond, fallback=None):
     return arr.at[idx2].set(jnp.where(cond, upd2, fallback))
 
 
+
 def _bucket_sizes(num_rows: int, min_bucket: int) -> list:
     """Descending static segment sizes: [R, pow2 < R, ..., min_bucket].
 
@@ -212,15 +213,43 @@ def _bucket_sizes(num_rows: int, min_bucket: int) -> list:
     return sizes
 
 
+def _feature_meta_scalars(pmeta: FeatureMeta, f):
+    """(num_bin, missing_type, default_bin) of split feature ``f``.
+
+    Uniform metas (every feature shares the three values — the dense
+    numerical case) fold to static constants so the partition branches
+    receive three scalar constants instead of gathers from [F] arrays
+    (which cost a broadcast kernel per split in the grower's body)."""
+    nb, mt, db = pmeta.num_bin, pmeta.missing_type, pmeta.default_bin
+    try:
+        nbc, mtc, dbc = np.asarray(nb), np.asarray(mt), np.asarray(db)
+        if (nbc.max() == nbc.min() and mtc.max() == mtc.min()
+                and dbc.max() == dbc.min()):
+            return (jnp.int32(int(nbc[0])), jnp.int32(int(mtc[0])),
+                    jnp.int32(int(dbc[0])))
+    except Exception:
+        pass  # traced metas — gather at runtime
+    fs = jnp.maximum(f, 0)
+    return (nb[fs], mt[fs], db[fs])
+
+
 def _go_left_bins(col, thr, dl, f, pmeta: FeatureMeta, num_cat=None,
-                  cat_bins=None):
+                  cat_bins=None, fscal=None):
     """Partition direction for a bin column (ref: dense_bin.hpp:317
     SplitInner missing-type dispatch; categorical bitset membership per
     dense_bin.hpp SplitCategoricalInner — bins not in the chosen set,
-    including bin 0 (NaN/unseen), go right)."""
-    nbin_f = pmeta.num_bin[f]
-    miss_f = pmeta.missing_type[f]
-    dflt_f = pmeta.default_bin[f]
+    including bin 0 (NaN/unseen), go right).
+
+    ``fscal`` optionally carries the split feature's pre-gathered
+    (num_bin, missing_type, default_bin) scalars so switch branches
+    don't capture the [F] meta arrays as cond operands (each costs a
+    broadcast kernel per split in the grower's while body)."""
+    if fscal is not None:
+        nbin_f, miss_f, dflt_f = fscal
+    else:
+        nbin_f = pmeta.num_bin[f]
+        miss_f = pmeta.missing_type[f]
+        dflt_f = pmeta.default_bin[f]
     go_left = col <= thr
     is_nan_bin = (miss_f == 2) & (col == nbin_f - 1)
     is_dflt_bin = (miss_f == 1) & (col == dflt_f)
@@ -444,6 +473,10 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         localize_key = lambda k: k
     if prepare_split_hist is None:
         prepare_split_hist = lambda h, ctx=None, fm=None: (h, None)
+    # serial + numerical-only: children's best rows are packed inside
+    # the split selection (vector pieces), not via pack_rec's scalar
+    # stack — see best_of(want_row=...)
+    packed_best_rows = select_best is None and not has_cat
     if select_best is None:
         select_best = lambda rec: rec
     if fetch_bin_column is None:
@@ -480,6 +513,11 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                              "bundling; use "
                              "monotone_constraints_method='basic'")
     use_ic = cfg.interaction_groups is not None
+    # NOTE (measured, don't redo): redirecting dead-step pair writes to
+    # scratch rows (to drop the _set_rows2 fallback gather + select) was
+    # tried and REVERTED — XLA already fuses the guarded write into one
+    # gather-select-scatter kernel, so the redirect's extra index selects
+    # grew the while body from 79 to 81 instrs.
     if forced is not None:
         forced_active = jnp.asarray(forced[0], bool)
         forced_slot = jnp.asarray(forced[1], jnp.int32)
@@ -503,7 +541,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
 
     def best_of(hist, sg, sh, cnt, parent_out, feature_mask,
                 leaf_range=None, leaf_depth=None, cegb=None,
-                rand_u=None, lsum3=None):
+                rand_u=None, lsum3=None, want_row=False):
         ctx = (sg, sh, cnt, parent_out)
         if lsum3 is not None:
             # local-sums channel (voting): ctx grows to 7 entries —
@@ -514,11 +552,13 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             feature_mask = (extra_mask if feature_mask is None
                             else feature_mask & extra_mask)
         gp = None if cegb is None else cegb[0] + cegb[1] * cnt
-        rec = best_split_for_leaf(hist, sg, sh, cnt, parent_out, meta, hp,
+        out = best_split_for_leaf(hist, sg, sh, cnt, parent_out, meta, hp,
                                   feature_mask, leaf_range=leaf_range,
                                   leaf_depth=leaf_depth, gain_penalty=gp,
-                                  rand_u=rand_u)
-        return select_best(rec)
+                                  rand_u=rand_u, want_row=want_row)
+        if want_row:
+            return out[1]
+        return select_best(out)
 
     def grow(bins_t: jnp.ndarray, gh: jnp.ndarray,
              feature_mask: Optional[jnp.ndarray] = None,
@@ -594,7 +634,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
 
             def make_part(P):
                 def part(order, start, rows, f, thr, dl, ncat, cbins,
-                         colv):
+                         colv, fscal):
                     """Stable two-way partition of the leaf's segment
                     (≡ DataPartition::Split, data_partition.hpp:102).
                     ``colv`` is the replicated [R] global bin column of the
@@ -630,7 +670,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                     go_left = _go_left_bins(
                         col, thr, dl, f, pmeta,
                         ncat if has_cat else None,
-                        cbins if has_cat else None)
+                        cbins if has_cat else None, fscal=fscal)
                     pos = jnp.arange(P, dtype=jnp.int32)
                     valid = (pos >= delta) & (pos < delta + rows)
                     lm = valid & go_left
@@ -794,7 +834,10 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             hist=hist_pool,
             stats=stats0,
             best=best0,
-            node=jnp.zeros((L - 1, NN), jnp.float32),
+            # L-1 internal-node rows + one scratch row (index L-1) that
+            # absorbs the parent-pointer write of parentless splits so
+            # the body's paired row write always has distinct indices
+            node=jnp.zeros((L, NN), jnp.float32),
             num_leaves=jnp.asarray(1, jnp.int32),
             done=jnp.asarray(False),
             best_cat=(jnp.full((L, MAXK), -1, jnp.int32).at[0].set(
@@ -884,21 +927,27 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                  -(l.astype(jnp.float32) + 1.0),
                  -(new_leaf.astype(jnp.float32) + 1.0)]
                 + ([brow[B_NCAT]] if has_cat else []))
-            node = state.node.at[i].set(
-                jnp.where(proceed, noderow, state.node[i]))
-            # fix-up the parent's child pointer that pointed at leaf l
-            # (parent row p < i, so it is never the row just written)
+            # the new node row and the parent's child-pointer fix-up
+            # land as ONE gather + ONE scatter over the row pair. The
+            # parent row p < i is never the row being written; with no
+            # parent the second write is routed to the scratch row L-1
+            # (the node matrix carries one extra never-read row for
+            # exactly this), so the pair's indices are always distinct.
             p = srow[S_PARENT].astype(jnp.int32)
             p_safe = jnp.maximum(p, 0)
             has_parent = proceed & (p >= 0)
             isr = srow[S_ISR] > 0.5
-            pr = lax.dynamic_slice(node, (p_safe, jnp.int32(N_LC)),
-                                   (1, 2))[0]
+            rows_np = state.node[jnp.stack([i, p_safe])]        # [2, NN]
+            prow = rows_np[1]
+            pr = prow[N_LC:N_LC + 2]
             pr_new = jnp.where(isr, jnp.stack([pr[0], i_f]),
                                jnp.stack([i_f, pr[1]]))
-            pr_new = jnp.where(has_parent, pr_new, pr)
-            node = lax.dynamic_update_slice(node, pr_new[None, :],
-                                            (p_safe, jnp.int32(N_LC)))
+            prow_new = lax.dynamic_update_slice(prow, pr_new,
+                                                (jnp.int32(N_LC),))
+            p_tgt = jnp.where(has_parent, p_safe, jnp.int32(L - 1))
+            node = state.node.at[jnp.stack([i, p_tgt])].set(
+                jnp.stack([jnp.where(proceed, noderow, rows_np[0]),
+                           prow_new]))
             if has_cat:
                 tree_cat = state.tree_cat.at[i].set(
                     jnp.where(proceed, rec.cat_bins, state.tree_cat[i]))
@@ -953,6 +1002,14 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 else:
                     colv = jnp.zeros((1,), jnp.int32)
 
+                # the split feature's meta scalars, gathered at BODY
+                # level (outside every cond) so the partition branches
+                # don't capture the [F] meta arrays as cond operands —
+                # each cost a broadcast kernel per split in the while
+                # body. Uniform metas (the dense numerical case) fold
+                # to static constants: zero runtime ops.
+                fscal = _feature_meta_scalars(pmeta, rec.feature)
+
                 def do_partition():
                     pb = bucket_branch(rows_l)
                     ncat_a = rec.num_cat if has_cat else jnp.int32(0)
@@ -961,7 +1018,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                     return lax.switch(
                         pb, part_branches, state.order, start_l, rows_l,
                         rec.feature, rec.threshold, rec.default_left,
-                        ncat_a, cbins_a, colv)
+                        ncat_a, cbins_a, colv, fscal)
 
                 def part_and_both():
                     """Partition the leaf and histogram BOTH children
@@ -1172,6 +1229,11 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 hist_large = hist_parent - hist_small
                 hist_left = jnp.where(left_smaller, hist_small, hist_large)
                 hist_right = jnp.where(left_smaller, hist_large, hist_small)
+                # NOTE: an unconditional pair write (no proceed select)
+                # was tried here and REVERTED — without the fallback
+                # read XLA lost the in-place pattern and double-copied
+                # the whole [L, F, B, 3] pool every split (2x 21 MB at
+                # the bench geometry); don't redo it.
                 hist = _set_rows2(state.hist, l, new_leaf,
                                   hist_left, hist_right, proceed)
 
@@ -1327,9 +1389,12 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             # 2i+2 — siblings decorrelated, like ColSampler bynode)
             fm_l = node_mask(2 * i + 1, child_path)
             fm_r = node_mask(2 * i + 2, child_path)
-            sg2 = jnp.stack([rec.left_sum_gradient, rec.right_sum_gradient])
-            sh2 = jnp.stack([rec.left_sum_hessian, rec.right_sum_hessian])
-            cn2 = jnp.stack([rec.left_count, rec.right_count])
+            # children totals as one [2, 4] view of the packed best row
+            # (columns B_LG..B_RO are [lsg, lsh, lc, lout, rsg, rsh, rc,
+            # rout]) — slices fuse where per-field stacks each dispatched
+            # a concatenate kernel in the while body
+            lr4 = brow[B_LG:B_RO + 1].reshape(2, 4)
+            sg2, sh2, cn2 = lr4[:, 0], lr4[:, 1], lr4[:, 2]
             hists2 = conv(jnp.stack([hist_left, hist_right]))
             if bundled:
                 if local_pool:
@@ -1341,7 +1406,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 else:
                     hists2 = jax.vmap(expand_hist)(hists2, sg2, sh2,
                                                    cn2)
-            ou2 = jnp.stack([rec.left_output, rec.right_output])
+            ou2 = lr4[:, 3]
             mn2 = jnp.stack([l_min, r_min])
             mx2 = jnp.stack([l_max, r_max])
             dp2 = jnp.stack([child_depth, child_depth]).astype(jnp.int32)
@@ -1352,11 +1417,16 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                     rand_uniforms(jax.random.fold_in(ki, 2))])
             else:
                 rb2 = None
+            # serial numerical path: best_of assembles the packed rows
+            # from its vector intermediates (want_row), skipping the
+            # 12-operand scalar concatenate pack_rec would dispatch
+            pack_inline = packed_best_rows
             if fm_l is None:
                 best2 = jax.vmap(
                     lambda hh, a, b, c, d, mn, mx, dp, rb, ls: best_of(
                         hh, a, b, c, d, None, leaf_range=(mn, mx),
-                        leaf_depth=dp, cegb=cegb, rand_u=rb, lsum3=ls)
+                        leaf_depth=dp, cegb=cegb, rand_u=rb, lsum3=ls,
+                        want_row=pack_inline)
                 )(hists2, sg2, sh2, cn2, ou2, mn2, mx2, dp2, rb2,
                   lsums2)
             else:
@@ -1365,10 +1435,11 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                     lambda hh, a, b, c, d, mn, mx, dp, fm, rb, ls:
                     best_of(
                         hh, a, b, c, d, fm, leaf_range=(mn, mx),
-                        leaf_depth=dp, cegb=cegb, rand_u=rb, lsum3=ls)
+                        leaf_depth=dp, cegb=cegb, rand_u=rb, lsum3=ls,
+                        want_row=pack_inline)
                 )(hists2, sg2, sh2, cn2, ou2, mn2, mx2, dp2, fm2, rb2,
                   lsums2)
-            rows2 = pack_rec(best2)                              # [2, NB]
+            rows2 = best2 if pack_inline else pack_rec(best2)    # [2, NB]
             # fallback keeps brow/bcat (forced-split overwrites), not
             # the raw state rows
             best = _set_rows2(
@@ -1528,7 +1599,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         state = lax.fori_loop(0, L - 1, body, state)
 
         # ---- materialize TreeArrays from the packed loop state ----------
-        nodem = state.node
+        nodem = state.node[:L - 1]   # drop the scratch row
         statm = state.stats
         i32c = lambda c: nodem[:, c].astype(jnp.int32)
         # leaf arrays: every existing leaf's (value, weight, count) are the

@@ -200,7 +200,8 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
                         feature_mask: jnp.ndarray = None,
                         leaf_range=None, leaf_depth=None,
                         gain_penalty: jnp.ndarray = None,
-                        rand_u: jnp.ndarray = None) -> SplitRecord:
+                        rand_u: jnp.ndarray = None,
+                        want_row: bool = False):
     """Find the best split over all features for one leaf.
 
     Parameters
@@ -247,7 +248,8 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
                                 parent_output, meta, hp, leaf_range,
                                 rand_u=rand_u)
     return _select_across_features(scan, meta, hp, feature_mask, leaf_depth,
-                                   gain_penalty, parent_output, cat=cat)
+                                   gain_penalty, parent_output, cat=cat,
+                                   want_row=want_row)
 
 
 def _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
@@ -338,50 +340,61 @@ def _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
     # fixed cost is kernel count; cumsum breaks fusion, so batching the
     # channels saves two kernels per scan direction)
     ghc = jnp.stack([g, h, c])                               # [3, F, B]
-    # right side at threshold t accumulates bins t+1..hi. Computed as
-    # total - prefix instead of a reversed suffix cumsum + shift
-    # concatenates: one forward scan and pure elementwise math replace
-    # the double-reverse and three [F, B] concats (each a dispatched
-    # kernel in the split loop's while body). At t = B-1 this is
-    # exactly 0 (tot - tot), reproducing the old zero padding.
-    rev_in = ghc * rev_mask[None]
-    pfx_rev = jnp.cumsum(rev_in, axis=2)                     # [3, F, B]
-    tot = pfx_rev[:, :, -1:]                                 # [3, F, 1]
-    rg_thr = tot[0] - pfx_rev[0]
-    rh_thr = (tot[1] - pfx_rev[1]) + K_EPSILON
-    rc_thr = tot[2] - pfx_rev[2]
-    lg_rev, lh_rev, lc_rev = side_stats(rg_thr, rh_thr, rc_thr)
-    gains_rev, valid_rev = gains_and_validity(lg_rev, lh_rev, lc_rev,
-                                              rg_thr, rh_thr, rc_thr)
-    # thresholds evaluated by the reverse loop: thr in [0, hi-1]
-    thr_ok_rev = (bin_idx <= hi - 1) & (bin_idx >= 0) & in_range
+    # right side at threshold t accumulates bins t+1..hi — a SUFFIX sum,
+    # matching the reference's high-to-low accumulation order (a
+    # total-minus-prefix rewrite was tried for 3 fewer kernels and
+    # REVERTED: the subtraction of two near-equal prefixes amplifies
+    # per-bin ulp noise at high thresholds by cancellation, which broke
+    # the 1e-5 serial-vs-voting parity of psum'd histograms; don't redo
+    # it). Gains are evaluated in ITERATION index space u = t + 1
+    # (right side = sfx[u]), so no shift concatenates are needed — the
+    # per-feature argmax maps back with t = u - 1.
+    sfx = jnp.cumsum((ghc * rev_mask[None])[:, :, ::-1],
+                     axis=2)[:, :, ::-1]                     # [3, F, B]
+    rg_u = sfx[0]
+    rh_u = sfx[1] + K_EPSILON
+    rc_u = sfx[2]
+    lg_rev, lh_rev, lc_rev = side_stats(rg_u, rh_u, rc_u)
+    gains_rev_u, valid_rev = gains_and_validity(lg_rev, lh_rev, lc_rev,
+                                                rg_u, rh_u, rc_u)
+    # iterations evaluated by the reverse loop: u = t+1 in [1, hi]
+    thr_ok_u = (bin_idx >= 1) & (bin_idx <= hi) & in_range
     # skip-default applies to the *iteration* t=thr+1 in the reference loop
-    thr_ok_rev &= ~(skip_default & ((bin_idx + 1) == dflt))
+    thr_ok_u &= ~(skip_default & (bin_idx == dflt))
     if rand_bins is not None:
         # extra_trees: only the one random threshold per feature competes
-        thr_ok_rev &= bin_idx == rand_bins[:, None]
-    gains_rev = jnp.where(valid_rev & thr_ok_rev, gains_rev, K_MIN_SCORE)
+        thr_ok_u &= bin_idx == rand_bins[:, None] + 1
+    gains_rev_u = jnp.where(valid_rev & thr_ok_u, gains_rev_u,
+                            K_MIN_SCORE)
 
     # ---------------- per-feature best: reverse side ------------------------
     # reverse ties -> larger threshold (first seen high-to-low)
-    rev_best_t = (B - 1) - jnp.argmax(gains_rev[:, ::-1], axis=1)
-    rev_best_gain = jnp.take_along_axis(gains_rev, rev_best_t[:, None],
+    rev_best_u = ((B - 1) -
+                  jnp.argmax(gains_rev_u[:, ::-1], axis=1)).astype(
+                      jnp.int32)
+    rev_best_gain = jnp.take_along_axis(gains_rev_u, rev_best_u[:, None],
                                         axis=1)[:, 0]
-    take = lambda a, idx: jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+    rev_best_t = rev_best_u - 1
 
     if static_fwd_dead:
         best_t = rev_best_t.astype(jnp.int32)
         best_gain = rev_best_gain
         best_dl = jnp.broadcast_to(~dl_false[:, 0], best_gain.shape)
-        blg = take(lg_rev, best_t)
-        blh = take(lh_rev, best_t)
-        blc = take(lc_rev, best_t)
-        brg = take(rg_thr, best_t)
-        brh = take(rh_thr, best_t)
-        brc = take(rc_thr, best_t)
+        # the suffix array and the (u-indexed) side matrices go to the
+        # selection stage, which fetches the ONE winning entry from the
+        # suffix sums (one dynamic-slice) instead of materializing six
+        # per-feature take_along gathers — the split loop's fixed cost
+        # is kernel count. The cat path still takes per-feature rows
+        # (at iteration index u = t + 1).
         return dict(best_gain=best_gain, best_t=best_t, best_dl=best_dl,
-                    blg=blg, blh=blh, blc=blc, brg=brg, brh=brh, brc=brc,
                     min_gain_shift=min_gain_shift,
+                    sfx=sfx, use_fwd=None, pfx_fwd=None,
+                    lg_rev=lg_rev, lh_rev=lh_rev, lc_rev=lc_rev,
+                    rg_u=rg_u, rh_u=rh_u, rc_u=rc_u,
+                    lg_acc=None, lh_acc=None, lc_acc=None,
+                    rg_fwd=None, rh_fwd=None, rc_fwd=None,
+                    sum_gradient=sum_gradient, sum_hessian2=sum_hessian,
+                    num_data_f=num_data_f,
                     out_range=((out_min, out_max) if use_mc else None))
 
     # ---------------- FORWARD scan: left side accumulates 0..t -------------
@@ -410,16 +423,15 @@ def _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
     best_gain = jnp.where(use_fwd, fwd_best_gain, rev_best_gain)
     best_dl = jnp.where(use_fwd, False, ~dl_false[:, 0])
 
-    blg = jnp.where(use_fwd, take(lg_acc, best_t), take(lg_rev, best_t))
-    blh = jnp.where(use_fwd, take(lh_acc, best_t), take(lh_rev, best_t))
-    blc = jnp.where(use_fwd, take(lc_acc, best_t), take(lc_rev, best_t))
-    brg = jnp.where(use_fwd, take(rg_fwd, best_t), take(rg_thr, best_t))
-    brh = jnp.where(use_fwd, take(rh_fwd, best_t), take(rh_thr, best_t))
-    brc = jnp.where(use_fwd, take(rc_fwd, best_t), take(rc_thr, best_t))
-
     return dict(best_gain=best_gain, best_t=best_t, best_dl=best_dl,
-                blg=blg, blh=blh, blc=blc, brg=brg, brh=brh, brc=brc,
                 min_gain_shift=min_gain_shift,
+                sfx=sfx, use_fwd=use_fwd, pfx_fwd=pfx,
+                lg_rev=lg_rev, lh_rev=lh_rev, lc_rev=lc_rev,
+                rg_u=rg_u, rh_u=rh_u, rc_u=rc_u,
+                lg_acc=lg_acc, lh_acc=lh_acc, lc_acc=lc_acc,
+                rg_fwd=rg_fwd, rh_fwd=rh_fwd, rc_fwd=rc_fwd,
+                sum_gradient=sum_gradient, sum_hessian2=sum_hessian,
+                num_data_f=num_data_f,
                 out_range=((out_min, out_max) if use_mc else None))
 
 
@@ -623,8 +635,16 @@ def _categorical_scan(hist, sum_gradient, sum_hessian, num_data,
 def _select_across_features(scan: dict, meta: FeatureMeta,
                             hp: SplitHyperParams, feature_mask,
                             leaf_depth, gain_penalty,
-                            parent_output, cat: dict = None) -> SplitRecord:
-    """Cross-feature selection over _per_feature_scan output."""
+                            parent_output, cat: dict = None,
+                            want_row: bool = False):
+    """Cross-feature selection over _per_feature_scan output.
+
+    ``want_row`` (numerical-only) additionally returns the grower's
+    packed f32 [12] row — assembled here from the [3]-vector
+    intermediates so the whole tail stays a handful of vector kernels
+    instead of a 12-operand concatenate of independently-dispatched
+    scalars (the split loop's fixed cost is kernel count). Field values
+    are bit-identical to packing the returned SplitRecord."""
     use_mc = meta.monotone is not None
     if use_mc:
         mono = meta.monotone[:, None]
@@ -632,8 +652,6 @@ def _select_across_features(scan: dict, meta: FeatureMeta,
     best_gain = scan["best_gain"]
     best_t = scan["best_t"]
     best_dl = scan["best_dl"]
-    blg, blh, blc = scan["blg"], scan["blh"], scan["blc"]
-    brg, brh, brc = scan["brg"], scan["brh"], scan["brc"]
     min_gain_shift = scan["min_gain_shift"]
 
     if feature_mask is not None:
@@ -679,7 +697,74 @@ def _select_across_features(scan: dict, meta: FeatureMeta,
     gain_out = sel(net_gain)
     has_valid = sel(valid_any)
     is_cat_win = sel(meta.is_categorical) if cat is not None else False
-    if cat is not None:
+    best_t_w = sel(best_t)
+    if cat is None:
+        # fetch the winner's side sums straight from the suffix/prefix
+        # cumsum arrays at (feature, iteration) — 3-element
+        # dynamic-slices replace six per-feature take_along gathers
+        # plus six scalar selects (the split loop's fixed cost is
+        # kernel count). The arithmetic below repeats the scan's
+        # formulas on the fetched scalars, so every rounding step
+        # matches the matrix path bit for bit.
+        sum_g = scan["sum_gradient"]
+        sum_h2 = scan["sum_hessian2"]
+        n_f = scan["num_data_f"]
+        # all side-sum math on [3] vectors (g, h, c) so XLA keeps the
+        # tail as a couple of vector kernels instead of a dozen
+        # single-scalar ones. The +eps lands only on the h component;
+        # adding 0.0 to g/c is a bit-exact no-op for the values the
+        # cumsums produce (x + 0.0 only rewrites -0.0, and a - b is
+        # never -0.0 under round-to-nearest unless both operands are).
+        eps_h = jnp.asarray([0.0, K_EPSILON, 0.0], jnp.float32)
+        svec = jnp.stack([sum_g, sum_h2, n_f])
+        # right side at threshold t = sfx[:, f, t + 1]; t + 1 is always
+        # in range (valid reverse u <= hi <= B-1; forward t <= B-2)
+        pr = lax.dynamic_slice(
+            scan["sfx"], (jnp.int32(0), best_f, best_t_w + 1),
+            (3, 1, 1)).reshape(3)
+        rvec_r = pr + eps_h
+        lvec_r = svec - rvec_r
+        if scan["use_fwd"] is None:
+            lvec, rvec = lvec_r, rvec_r
+        else:
+            pf = lax.dynamic_slice(
+                scan["pfx_fwd"], (jnp.int32(0), best_f, best_t_w),
+                (3, 1, 1)).reshape(3)
+            lvec_f = pf + eps_h
+            rvec_f = svec - lvec_f
+            uf = sel(scan["use_fwd"])
+            lvec = jnp.where(uf, lvec_f, lvec_r)
+            rvec = jnp.where(uf, rvec_f, rvec_r)
+        blg_w, blh_w, blc_w = lvec[0], lvec[1], lvec[2]
+        brg_w, brh_w, brc_w = rvec[0], rvec[1], rvec[2]
+    else:
+        # categorical present: per-feature rows of BOTH scans are taken
+        # so the winner can come from either (matrix path; reverse
+        # matrices are u-indexed, u = t + 1)
+        take = lambda a, idx: jnp.take_along_axis(
+            a, idx[:, None], axis=1)[:, 0]
+        best_u = best_t + 1
+        if scan["use_fwd"] is None:
+            blg = take(scan["lg_rev"], best_u)
+            blh = take(scan["lh_rev"], best_u)
+            blc = take(scan["lc_rev"], best_u)
+            brg = take(scan["rg_u"], best_u)
+            brh = take(scan["rh_u"], best_u)
+            brc = take(scan["rc_u"], best_u)
+        else:
+            uf = scan["use_fwd"]
+            blg = jnp.where(uf, take(scan["lg_acc"], best_t),
+                            take(scan["lg_rev"], best_u))
+            blh = jnp.where(uf, take(scan["lh_acc"], best_t),
+                            take(scan["lh_rev"], best_u))
+            blc = jnp.where(uf, take(scan["lc_acc"], best_t),
+                            take(scan["lc_rev"], best_u))
+            brg = jnp.where(uf, take(scan["rg_fwd"], best_t),
+                            take(scan["rg_u"], best_u))
+            brh = jnp.where(uf, take(scan["rh_fwd"], best_t),
+                            take(scan["rh_u"], best_u))
+            brc = jnp.where(uf, take(scan["rc_fwd"], best_t),
+                            take(scan["rc_u"], best_u))
         csel = lambda k: cat[k][best_f]
         pickw = lambda cv, nv: jnp.where(is_cat_win, cv, nv)
         blg_w = pickw(csel("lg"), sel(blg))
@@ -688,29 +773,28 @@ def _select_across_features(scan: dict, meta: FeatureMeta,
         brg_w = pickw(csel("rg"), sel(brg))
         brh_w = pickw(csel("rh"), sel(brh))
         brc_w = pickw(csel("rc"), sel(brc))
-    else:
-        blg_w, blh_w, blc_w = sel(blg), sel(blh), sel(blc)
-        brg_w, brh_w, brc_w = sel(brg), sel(brh), sel(brc)
-    lout = calculate_splitted_leaf_output(blg_w, blh_w, hp, blc_w,
-                                          parent_output)
-    rout = calculate_splitted_leaf_output(brg_w, brh_w, hp, brc_w,
-                                          parent_output)
+    # one vectorized [2] output computation for both children (same
+    # elementwise formula, so per-lane rounding matches two scalar calls)
+    outs = calculate_splitted_leaf_output(
+        jnp.stack([blg_w, brg_w]), jnp.stack([blh_w, brh_w]), hp,
+        jnp.stack([blc_w, brc_w]), parent_output)
     if use_mc:
-        lout = jnp.clip(lout, out_min, out_max)
-        rout = jnp.clip(rout, out_min, out_max)
+        outs = jnp.clip(outs, out_min, out_max)
+    lout, rout = outs[0], outs[1]
     if cat is not None:
         # categorical outputs were computed with the cat-specific l2 in the
         # scan (ref: output block uses the per-path l2)
         lout = jnp.where(is_cat_win, csel("lo"), lout)
         rout = jnp.where(is_cat_win, csel("ro"), rout)
 
-    return SplitRecord(
+    dl_w = (jnp.where(is_cat_win, False, sel(best_dl))
+            if cat is not None else sel(best_dl))
+    rec = SplitRecord(
         gain=jnp.where(has_valid, gain_out, K_MIN_SCORE),
         feature=jnp.where(has_valid, best_f, -1).astype(jnp.int32),
-        threshold=jnp.where(is_cat_win, 0, sel(best_t)) if cat is not None
-        else sel(best_t),
-        default_left=(jnp.where(is_cat_win, False, sel(best_dl))
-                      if cat is not None else sel(best_dl)),
+        threshold=jnp.where(is_cat_win, 0, best_t_w) if cat is not None
+        else best_t_w,
+        default_left=dl_w,
         left_sum_gradient=blg_w,
         left_sum_hessian=blh_w - K_EPSILON,
         left_count=blc_w,
@@ -724,6 +808,21 @@ def _select_across_features(scan: dict, meta: FeatureMeta,
         cat_bins=(jnp.where(is_cat_win, csel("cat_bins"), -1)
                   if cat is not None else None),
     )
+    if not want_row:
+        return rec
+    if cat is not None:
+        raise ValueError("want_row supports numerical-only metas")
+    # [gain, feature, threshold, default_left] head + the two side
+    # triples (with the record's -eps on the hessian lane; -0.0 on the
+    # g/c lanes is the exact identity) + outputs, as one flat concat of
+    # vector pieces (the nested concatenates flatten in XLA)
+    head = jnp.stack([rec.gain,
+                      rec.feature.astype(jnp.float32),
+                      best_t_w.astype(jnp.float32),
+                      dl_w.astype(jnp.float32)])
+    row = jnp.concatenate([head, lvec - eps_h, outs[0:1],
+                           rvec - eps_h, outs[1:2]])
+    return rec, row
 
 
 def per_feature_net_gains(hist, sum_gradient, sum_hessian, num_data,
