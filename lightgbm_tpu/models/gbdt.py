@@ -623,8 +623,10 @@ class GBDT:
                 # win in the tuned-defaults cache (scripts/
                 # tpu_session_auto.py writes it from measurements).
                 # Unknown cache values fall back — tuning must never be
-                # able to break training.
-                tk = tuned.get("f32_hist_kernel", "einsum")
+                # able to break training. Size-gated: the 100k-measured
+                # flips regress small runs (tuned.applies).
+                tk = (tuned.get("f32_hist_kernel", "einsum")
+                      if tuned.applies(self.num_data) else "einsum")
                 rm_backend = (tk if tk in ("einsum", "pallas", "scatter")
                               else "einsum")
         part_mode = cfg.tpu_partition_mode
@@ -811,6 +813,7 @@ class GBDT:
             # true counts — any other cache value falls back to off.
             want_pack = (pb in ("true", "1", "yes", "on") or
                          (pb == "auto" and
+                          tuned.applies(self.num_data) and
                           tuned.get("packed_bins", False) is True))
             if want_pack and self.num_bin_max <= 255:
                 # bit-pack 4 uint8 bins per uint32 word: quarters the

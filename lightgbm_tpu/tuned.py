@@ -52,6 +52,24 @@ def get(key: str, default: Any = None) -> Any:
     return _load().get(key, default)
 
 
+# The session A/Bs its flips at 100k rows; at small sizes the winners
+# invert (measured 2026-08-01 on v5e: micro 16k x 28 ran 84.1 it/s on
+# the einsum/u8 defaults vs 57.0 with the 100k-tuned pallas+packed
+# flips applied globally). Flips therefore apply only at or above this
+# row count; the cache key "flip_min_rows" overrides the boundary when
+# a session measures it more finely.
+FLIP_MIN_ROWS_DEFAULT = 65536
+
+
+def applies(num_rows) -> bool:
+    """Whether the tuned kernel flips apply at this training size."""
+    try:
+        thr = int(get("flip_min_rows", FLIP_MIN_ROWS_DEFAULT))
+    except (TypeError, ValueError):
+        thr = FLIP_MIN_ROWS_DEFAULT
+    return num_rows is None or int(num_rows) >= thr
+
+
 def reload() -> None:
     """Drop the in-process cache (tests / the autotune session)."""
     global _CACHE

@@ -1,0 +1,74 @@
+"""In-memory serving head-to-head: the reference's lib_lightgbm.so vs
+our native/c_api.cpp, both via ctypes LGBM_BoosterPredictForMat on the
+SAME model file and the SAME [N, 28] f32 matrix, single thread
+(ref: src/application/predictor.hpp:31 — the reference serves via an
+OMP row-parallel loop; ours via native/c_api.cpp ParallelRows).
+
+Measured 2026-08-01 on this host (1 core): ours 124k rows/s vs
+reference 103k rows/s (+21%), max |pred diff| = 0.0
+(bench_logs/SERVING_AB.json).
+
+Building the reference library here (vendored submodules are absent in
+the read-only mount, cmake is older than its minimum; nothing is
+written into /root/reference):
+
+  1. shim headers in /tmp/lgb_shim: fast_double_parser.h (strtod),
+     fmt/format.h (snprintf for the three format strings common.h
+     uses), Eigen/Dense (MatrixXd + Gauss-Jordan fullPivLu().inverse(),
+     linear-tree solve only), nanoarrow/nanoarrow.hpp (schema-view +
+     Unique wrappers; Arrow paths are never exercised).
+  2. g++ -O2 -std=c++17 -fopenmp -pthread -shared -fPIC
+       -I/root/reference/include -I/tmp/lgb_shim
+       -DUSE_SOCKET -DMM_PREFETCH -DMM_MALLOC
+       /root/reference/src/{application,boosting,io,metric,network,
+       objective,treelearner,utils}/*.cpp /root/reference/src/c_api.cpp
+       -o /tmp/lgb_bin/lib_lightgbm.so
+"""
+import ctypes
+import sys
+import time
+
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+MODEL = "/root/repo/bench_logs/serving_model.txt"
+
+rng = np.random.default_rng(0)
+X = np.ascontiguousarray(rng.normal(size=(N, 28)).astype(np.float32))
+
+C_API_DTYPE_FLOAT32 = 0
+C_API_PREDICT_NORMAL = 0
+
+
+def bench(libpath, label, extra_param):
+    lib = ctypes.CDLL(libpath)
+    h = ctypes.c_void_p()
+    out_iter = ctypes.c_int(0)
+    rc = lib.LGBM_BoosterCreateFromModelfile(
+        MODEL.encode(), ctypes.byref(out_iter), ctypes.byref(h))
+    assert rc == 0, f"{label}: load failed"
+    out_len = ctypes.c_int64(0)
+    preds = np.zeros(N, dtype=np.float64)
+    args = (h, X.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int(C_API_DTYPE_FLOAT32),
+            ctypes.c_int32(N), ctypes.c_int32(28), ctypes.c_int(1),
+            ctypes.c_int(C_API_PREDICT_NORMAL), ctypes.c_int(0),
+            ctypes.c_int(-1), extra_param.encode(),
+            ctypes.byref(out_len),
+            preds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    lib.LGBM_BoosterPredictForMat(*args)          # warmup
+    t0 = time.perf_counter()
+    rc = lib.LGBM_BoosterPredictForMat(*args)
+    dt = time.perf_counter() - t0
+    assert rc == 0 and out_len.value == N, f"{label}: predict failed"
+    print(f"{label}: {dt:.3f}s  {N / dt / 1e3:.0f}k rows/s "
+          f"(pred[0]={preds[0]:.6f} mean={preds.mean():.6f})")
+    return preds
+
+
+p_ref = bench("/tmp/lgb_bin/lib_lightgbm.so", "reference (1 thread)",
+              "num_threads=1")
+p_ours = bench("/root/repo/lightgbm_tpu/native/_build/lgbm_native.so",
+               "ours (1 thread)", "num_threads=1")
+err = np.max(np.abs(p_ref - p_ours))
+print(f"max |pred diff| = {err:.3e}")

@@ -67,11 +67,17 @@ def dump_state() -> None:
 
 
 def run_bench(stage: str, rows: int, iters: int, extra: dict | None = None,
-              leaves: int | None = None, watchdog: int = 1700) -> dict | None:
+              leaves: int | None = None, watchdog: int = 1700,
+              scheds: str | None = None,
+              env_extra: dict | None = None) -> dict | None:
     """One bench.py invocation; returns the parsed JSON result or None."""
     env = dict(os.environ,
                BENCH_ROWS=str(rows), BENCH_ITERS=str(iters),
                BENCH_WATCHDOG_SEC=str(watchdog))
+    if scheds is not None:
+        env["BENCH_SCHEDS"] = scheds
+    if env_extra:
+        env.update(env_extra)
     if extra:
         env["BENCH_EXTRA"] = json.dumps(extra)
     if leaves is not None:
@@ -150,8 +156,37 @@ def unreachable(res: dict | None) -> bool:
                            "unreachable" in str(res.get("note", "")))
 
 
+TUNED_PATH = os.path.join(REPO, "lightgbm_tpu", "TUNED.json")
+TUNED_STASH = os.path.join(LOGDIR, "TUNED.stash.json")
+
+
+def stash_tuned() -> None:
+    """Move the tuned cache aside so base/A-B stages measure BUILT-IN
+    defaults (a rerun with flips active compares flipped baselines
+    against themselves and un-learns real winners — observed
+    2026-08-01). The stash lives ON DISK so a killed session can't
+    lose it; a leftover stash from a crash is restored first."""
+    if os.path.exists(TUNED_STASH) and not os.path.exists(TUNED_PATH):
+        os.replace(TUNED_STASH, TUNED_PATH)
+        say("recovered tuned cache from a previous session's stash")
+    if os.path.exists(TUNED_PATH):
+        os.replace(TUNED_PATH, TUNED_STASH)
+        say("tuned cache stashed for unbiased A/Bs")
+
+
+def restore_tuned() -> None:
+    """Put the stashed cache back (no fresh flips were written)."""
+    if os.path.exists(TUNED_STASH) and not os.path.exists(TUNED_PATH):
+        os.replace(TUNED_STASH, TUNED_PATH)
+        say("tuned cache restored (session ended before new flips)")
+
+
 def git_commit(msg: str) -> None:
     try:
+        # every commit is an exit-path act: put the stashed tuned cache
+        # back first (no-op when fresh flips already merged it) so no
+        # commit can ever stage a deleted TUNED.json or the stash file
+        restore_tuned()
         # separate adds: a missing TUNED.json (no flips written) must
         # not fail the pathspec atomically and leave the logs unstaged
         subprocess.run(["git", "add", "bench_logs"],
@@ -166,6 +201,17 @@ def git_commit(msg: str) -> None:
 
 def main() -> int:
     os.makedirs(LOGDIR, exist_ok=True)
+    stash_tuned()
+    try:
+        return _stages()
+    finally:
+        # any exit path that did not merge fresh flips (exception,
+        # guard bail, watcher kill that still lets finally run)
+        # restores the previous measured winners
+        restore_tuned()
+
+
+def _stages() -> int:
     fails = 0
 
     def guard(res: dict | None) -> bool:
@@ -238,6 +284,11 @@ def main() -> int:
     if flips:
         sys.path.insert(0, REPO)
         from lightgbm_tpu import tuned
+        # restore the stashed keys FIRST so write() merges the new
+        # flips on top — previously measured keys the flip candidates
+        # don't produce (e.g. flip_min_rows) must survive the session
+        restore_tuned()
+        tuned.reload()
         path = tuned.write(flips)
         say(f"tuned flips written to {path}: {flips}")
     else:
@@ -259,14 +310,30 @@ def main() -> int:
         git_commit("bench_logs: r5 session (A/Bs done, window closed "
                    "before final runs)")
         return 3
-    run_bench("final_10m", 10_500_000, 10)
+    # ---- stage 4.5: one TIMETAG diagnostic run at 1M — the section
+    # table (stderr -> r05_diag_1m.log) localizes where the ~320 ms/tree
+    # goes (gather / hist / partition / split-scan / pool writes); its
+    # throughput number is informational (host-side sync per section
+    # serializes the async pipeline)
+    run_bench("diag_1m", 1_000_000, 12,
+              env_extra={"LIGHTGBM_TPU_TIMETAG": "1"})
 
     # ---- stage 5: leaves ladder at 1M (fixed-cost curve for the
-    # runbook; secondary to everything above)
+    # runbook) runs BEFORE the 10.5M stage: the big shape's compiles
+    # through the remote-compile tunnel are pathological (a 31-leaf
+    # probe alone took 254 s), and a watchdog kill there is a
+    # mid-compile claim-holder kill — the documented machine-wide wedge
+    # trigger, which then zeroes everything after it.
     for lv in (31, 63, 127):
         res = run_bench(f"ladder_L{lv}", 1_000_000, 15, leaves=lv)
         if guard(res):
             break
+
+    # ---- stage 6: the Higgs-scale number, LAST (wedge risk): one
+    # scheduler only and a watchdog sized so compile + 10 iters fit
+    # without the kill path firing
+    run_bench("final_10m", 10_500_000, 10, watchdog=3400,
+              scheds="compact")
 
     STATE["done"] = True
     dump_state()

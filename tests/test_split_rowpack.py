@@ -1,0 +1,81 @@
+"""The split selection's inline packed row (want_row) must be
+bit-identical to packing the returned SplitRecord field by field —
+the grower stores whichever one the build produced, and trees must not
+depend on that choice (ref: split_info.hpp:22 SplitInfo is the single
+source of truth in the reference).
+
+Covers: reverse-only metas (no missing), mixed missing types (live
+forward scan), monotone bounds, feature masks, and the degenerate
+no-valid-split leaf.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops.split import (FeatureMeta, SplitHyperParams,
+                                    best_split_for_leaf)
+
+
+def _meta(F, B, missing):
+    return FeatureMeta(
+        num_bin=jnp.full((F,), B, jnp.int32),
+        missing_type=jnp.asarray(missing, jnp.int32),
+        default_bin=jnp.zeros((F,), jnp.int32),
+        is_categorical=jnp.zeros((F,), bool),
+        monotone=None)
+
+
+def _rand_hist(rng, F, B, rows=5000):
+    bins = rng.integers(0, B, size=(rows, F))
+    g = rng.normal(size=rows).astype(np.float32)
+    h = rng.uniform(0.5, 2.0, size=rows).astype(np.float32)
+    hist = np.zeros((F, B, 3), np.float32)
+    for f in range(F):
+        np.add.at(hist[f, :, 0], bins[:, f], g)
+        np.add.at(hist[f, :, 1], bins[:, f], h)
+        np.add.at(hist[f, :, 2], bins[:, f], 1.0)
+    return jnp.asarray(hist), float(g.sum()), float(h.sum()), float(rows)
+
+
+def _pack(rec):
+    return np.asarray([
+        rec.gain, rec.feature, rec.threshold, rec.default_left,
+        rec.left_sum_gradient, rec.left_sum_hessian, rec.left_count,
+        rec.left_output, rec.right_sum_gradient, rec.right_sum_hessian,
+        rec.right_count, rec.right_output], np.float32)
+
+
+@pytest.mark.parametrize("missing", ["none", "mixed"])
+def test_want_row_matches_field_pack(missing):
+    rng = np.random.default_rng(3)
+    F, B = 6, 64
+    miss = ([0] * F if missing == "none" else [0, 1, 2, 0, 1, 2])
+    meta = _meta(F, B, miss)
+    hp = SplitHyperParams(min_data_in_leaf=20, lambda_l2=0.5)
+    hist, sg, sh, nd = _rand_hist(rng, F, B)
+    rec, row = best_split_for_leaf(
+        hist, jnp.float32(sg), jnp.float32(sh), jnp.float32(nd),
+        jnp.float32(0.0), meta, hp, want_row=True)
+    np.testing.assert_array_equal(np.asarray(row), _pack(rec))
+    assert int(rec.feature) >= 0  # data has signal; split must exist
+
+
+def test_want_row_feature_mask_and_invalid():
+    rng = np.random.default_rng(4)
+    F, B = 4, 32
+    meta = _meta(F, B, [0] * F)
+    hp = SplitHyperParams(min_data_in_leaf=20)
+    hist, sg, sh, nd = _rand_hist(rng, F, B, rows=1000)
+    mask = jnp.asarray([False, True, True, False])
+    rec, row = best_split_for_leaf(
+        hist, jnp.float32(sg), jnp.float32(sh), jnp.float32(nd),
+        jnp.float32(0.0), meta, hp, feature_mask=mask, want_row=True)
+    np.testing.assert_array_equal(np.asarray(row), _pack(rec))
+    assert int(rec.feature) in (1, 2)
+    # all features masked -> no valid split; row still packs the record
+    rec0, row0 = best_split_for_leaf(
+        hist, jnp.float32(sg), jnp.float32(sh), jnp.float32(nd),
+        jnp.float32(0.0), meta, hp,
+        feature_mask=jnp.zeros((F,), bool), want_row=True)
+    np.testing.assert_array_equal(np.asarray(row0), _pack(rec0))
+    assert int(rec0.feature) == -1

@@ -1,0 +1,55 @@
+"""Size gate on the tuned-defaults cache: the measurement session A/Bs
+its kernel flips at 100k rows; applying them to much smaller runs is a
+measured regression (v5e micro 16k: 84.1 it/s untuned vs 57.0 flipped),
+so `tuned.applies` keeps flips off below the boundary.
+"""
+import json
+
+from lightgbm_tpu import tuned
+
+
+def _with_cache(tmp_path, monkeypatch, payload):
+    p = tmp_path / "TUNED.json"
+    p.write_text(json.dumps(payload))
+    monkeypatch.setenv("LIGHTGBM_TPU_TUNED", str(p))
+    tuned.reload()
+    return p
+
+
+def test_applies_default_boundary(tmp_path, monkeypatch):
+    _with_cache(tmp_path, monkeypatch, {"f32_hist_kernel": "pallas"})
+    assert not tuned.applies(16_384)
+    assert not tuned.applies(tuned.FLIP_MIN_ROWS_DEFAULT - 1)
+    assert tuned.applies(tuned.FLIP_MIN_ROWS_DEFAULT)
+    assert tuned.applies(10_500_000)
+    assert tuned.applies(None)  # unknown size: trust the measurement
+    tuned.reload()
+
+
+def test_applies_cache_override_and_garbage(tmp_path, monkeypatch):
+    _with_cache(tmp_path, monkeypatch,
+                {"flip_min_rows": 1000, "packed_bins": True})
+    assert tuned.applies(1000) and not tuned.applies(999)
+    _with_cache(tmp_path, monkeypatch, {"flip_min_rows": "junk"})
+    # malformed boundary falls back to the built-in default
+    assert not tuned.applies(16_384)
+    assert tuned.applies(tuned.FLIP_MIN_ROWS_DEFAULT)
+    tuned.reload()
+
+
+def test_small_run_resolves_to_builtin_kernel(tmp_path, monkeypatch):
+    """End-to-end: a small training run ignores the cached pallas flip
+    (resolves the CPU default), while the cache is still readable."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+
+    _with_cache(tmp_path, monkeypatch,
+                {"f32_hist_kernel": "pallas", "packed_bins": True})
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    assert np.isfinite(bst.predict(X)).all()
+    tuned.reload()
