@@ -162,6 +162,37 @@ def run_child(sched: str) -> None:
         print(f"[bench] quality after {booster.current_iteration()} iters: "
               f"train_logloss={float(ll):.5f} train_auc={auc:.5f}",
               file=sys.stderr)
+        # tree-depth stats: evidence for the level-synchronous grower's
+        # D0 cap (docs/TPU_RUNBOOK.md round-6 design) — how deep do
+        # best-first trees actually go at this shape, and what fraction
+        # of splits sit at depth < 10?
+        try:
+            import numpy as _np
+            depths = []
+            shallow = total = 0
+            for t in booster._engine.models[-5:]:
+                nn = int(t.num_leaves) - 1
+                if nn <= 0:
+                    depths.append(0)
+                    continue
+                lc, rc = (_np.asarray(t.left_child),
+                          _np.asarray(t.right_child))
+                dep = _np.zeros(nn, _np.int32)
+                for i in range(nn):     # parents precede children
+                    for c in (int(lc[i]), int(rc[i])):
+                        if 0 <= c < nn:
+                            dep[c] = dep[i] + 1
+                depths.append(int(dep.max()) + 1)
+                shallow += int((dep < 9).sum())
+                total += nn
+            if total:
+                print(f"[bench] tree depth (last {len(depths)} trees): "
+                      f"max={max(depths)} "
+                      f"splits_below_depth9={shallow}/{total} "
+                      f"({100.0 * shallow / total:.0f}%)",
+                      file=sys.stderr)
+        except Exception as e:
+            print(f"[bench] depth stats failed: {e!r}", file=sys.stderr)
     except Exception as e:          # quality line must never kill the bench
         print(f"[bench] quality line failed: {e!r}", file=sys.stderr)
     ref_ips_at_n = REF_HIGGS_IPS * (REF_HIGGS_ROWS / N_ROWS)

@@ -160,6 +160,29 @@ class _ValidData:
             self.score = jnp.asarray(init)
 
 
+def resolve_hist_kernel(requested: str, hist_dtype: str, use_quant: bool,
+                        num_data, platform: str) -> str:
+    """Resolve ``tpu_hist_kernel=auto`` to a concrete backend.
+
+    CPU: scatter-add (einsum one-hot is pathologically slow there).
+    TPU bf16/int8: the VMEM-resident Pallas kernel (measured on v5e at
+    1M rows, docs/TPU_RUNBOOK.md: 6.0 / 5.6 ms vs einsum's 16.5 /
+    16.3). TPU f32: einsum unless the on-device A/B recorded a Pallas
+    win in the tuned cache — size-gated (tuned.applies), since the
+    100k-measured flips regress small runs. Unknown cache values fall
+    back: tuning must never be able to break training.
+    """
+    if requested != "auto":
+        return requested
+    if platform == "cpu":
+        return "scatter"
+    if use_quant or hist_dtype in ("bfloat16", "bf16"):
+        return "pallas"
+    tk = (tuned.get("f32_hist_kernel", "einsum")
+          if tuned.applies(num_data) else "einsum")
+    return tk if tk in ("einsum", "pallas", "scatter") else "einsum"
+
+
 class GBDT:
     """Gradient Boosting Decision Tree engine (ref: gbdt.h:28)."""
 
@@ -606,29 +629,9 @@ class GBDT:
         # einsum kernel on TPU.
         row_sched = cfg.tpu_row_scheduling
         hist_dtype = cfg.tpu_hist_dtype
-        rm_backend = cfg.tpu_hist_kernel
-        if rm_backend == "auto":
-            if jax.default_backend() == "cpu":
-                rm_backend = "scatter"
-            elif (cfg.use_quantized_grad or
-                    hist_dtype in ("bfloat16", "bf16")):
-                # measured on v5e at 1M rows (docs/TPU_RUNBOOK.md): the
-                # VMEM-resident Pallas kernel does bf16 in 6.0 ms / int8
-                # in 5.6 ms vs the einsum's 16.5 / 16.3 ms
-                rm_backend = "pallas"
-            else:
-                # f32: einsum+HIGHEST measured 24 ms vs 34 ms for the
-                # in-kernel HIGHEST path; the bf16-triple Pallas kernel
-                # takes over only once the on-device A/B has recorded a
-                # win in the tuned-defaults cache (scripts/
-                # tpu_session_auto.py writes it from measurements).
-                # Unknown cache values fall back — tuning must never be
-                # able to break training. Size-gated: the 100k-measured
-                # flips regress small runs (tuned.applies).
-                tk = (tuned.get("f32_hist_kernel", "einsum")
-                      if tuned.applies(self.num_data) else "einsum")
-                rm_backend = (tk if tk in ("einsum", "pallas", "scatter")
-                              else "einsum")
+        rm_backend = resolve_hist_kernel(
+            cfg.tpu_hist_kernel, hist_dtype, bool(cfg.use_quantized_grad),
+            self.num_data, jax.default_backend())
         part_mode = cfg.tpu_partition_mode
         if part_mode == "auto" and jax.default_backend() == "cpu":
             # CPU favors scatter at every size; on TPU "auto" passes

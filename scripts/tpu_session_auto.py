@@ -324,10 +324,22 @@ def _stages() -> int:
     # probe alone took 254 s), and a watchdog kill there is a
     # mid-compile claim-holder kill — the documented machine-wide wedge
     # trigger, which then zeroes everything after it.
+    window_closed = False
     for lv in (31, 63, 127):
         res = run_bench(f"ladder_L{lv}", 1_000_000, 15, leaves=lv)
         if guard(res):
+            window_closed = True
             break
+
+    best_1m = max(value(final_1m), value(h1m))
+    if window_closed:
+        # do NOT point a 3700 s claim at a dead/wedged device — that is
+        # the mid-compile claim-holder kill scenario all over again
+        say("window closed during the ladder — skipping the 10.5M stage")
+        git_commit(
+            f"bench_logs: r5 partial session — 1M {best_1m:.2f} it/s, "
+            f"flips {flips or 'none'} (window closed before 10.5M)")
+        return 3
 
     # ---- stage 6: the Higgs-scale number, LAST (wedge risk): one
     # scheduler only and a watchdog sized so compile + 10 iters fit
@@ -337,7 +349,6 @@ def _stages() -> int:
 
     STATE["done"] = True
     dump_state()
-    best_1m = max(value(final_1m), value(h1m))
     git_commit(
         f"bench_logs: r5 measured session — 1M {best_1m:.2f} it/s, "
         f"flips {flips or 'none'}")

@@ -37,9 +37,36 @@ def test_applies_cache_override_and_garbage(tmp_path, monkeypatch):
     tuned.reload()
 
 
-def test_small_run_resolves_to_builtin_kernel(tmp_path, monkeypatch):
-    """End-to-end: a small training run ignores the cached pallas flip
-    (resolves the CPU default), while the cache is still readable."""
+def test_resolution_respects_size_gate(tmp_path, monkeypatch):
+    """The f32 auto-kernel resolution (the exact branch the engine
+    calls) honors the size gate on the TPU platform — the CPU platform
+    short-circuits to scatter before the cache is consulted, so this
+    targets the TPU decision directly."""
+    from lightgbm_tpu.models.gbdt import resolve_hist_kernel
+
+    _with_cache(tmp_path, monkeypatch,
+                {"f32_hist_kernel": "pallas", "packed_bins": True})
+    # big run on TPU: the measured flip applies
+    assert resolve_hist_kernel("auto", "float32", False,
+                               1_000_000, "tpu") == "pallas"
+    # small run on TPU: gated back to the built-in
+    assert resolve_hist_kernel("auto", "float32", False,
+                               16_384, "tpu") == "einsum"
+    # CPU short-circuit and explicit requests are untouched by the cache
+    assert resolve_hist_kernel("auto", "float32", False,
+                               1_000_000, "cpu") == "scatter"
+    assert resolve_hist_kernel("einsum", "float32", False,
+                               1_000_000, "tpu") == "einsum"
+    # garbage cache value falls back
+    _with_cache(tmp_path, monkeypatch, {"f32_hist_kernel": "warp9"})
+    assert resolve_hist_kernel("auto", "float32", False,
+                               1_000_000, "tpu") == "einsum"
+    tuned.reload()
+
+
+def test_small_run_trains_with_cache_present(tmp_path, monkeypatch):
+    """End-to-end smoke: training works with a populated cache (the
+    packed_bins consult site also passes through tuned.applies)."""
     import numpy as np
     import lightgbm_tpu as lgb
 
