@@ -224,7 +224,7 @@ _reg("tpu_hist_dtype", str, "float32", ())   # histogram input dtype:
 _reg("tpu_hist_kernel", str, "auto", ())     # auto | einsum | scatter |
                                              # pallas (auto: einsum on TPU,
                                              #  scatter-add on CPU)
-_reg("tpu_row_scheduling", str, "compact", ())  # compact | full
+_reg("tpu_row_scheduling", str, "compact", ())  # compact | full | level
 # sparse bin storage (≡ SparseBin/MultiValSparseBin, sparse_bin.hpp:858):
 # dense packs every cell; multival stores only nonzero bins row-wise
 # [R, K]; auto picks multival for sufficiently sparse scipy inputs

@@ -1,0 +1,271 @@
+"""Level-synchronous best-first tree grower (round-6 architecture,
+phase A: pure level mode for ``max_depth <= MAX_LEVEL_DEPTH``).
+
+The sequential grower (core/grower.py) mirrors the reference's
+leaf-wise loop (ref: serial_tree_learner.cpp:183-249): num_leaves-1
+dependent steps, each dispatching ~40 kernels through the device
+tunnel. This grower instead:
+
+1. grows the FULL tree level by level — one segment-histogram pass,
+   one vmapped split scan and one partition pass per DEPTH;
+2. ranks every candidate node by e(v) = min(gain(u) for u on the
+   root->v path) and keeps the top (num_leaves - 1): by the theorem
+   validated in tests/test_levelwise_theory.py this reproduces the
+   leaf-wise best-first tree exactly (expansion order = descending e,
+   ties parent-first — stable argsort over heap ids gives both);
+3. assembles TreeArrays + per-row leaf ids from the ranking with
+   vectorized per-level slot/pointer passes — no sequential split
+   loop at all.
+
+Numerical note: per-node sums, outputs and child stats come from the
+SAME SplitRecord fields the sequential grower uses, so the only
+divergence channel is histogram accumulation order (scatter-add over
+rows vs gathered-segment passes) — ulp-level on f32, bit-exact for
+dyadic gradients (e.g. a binary objective's first tree).
+
+Phase-A scope (the engine falls back to the sequential grower
+otherwise): serial learner, numerical features, no EFB bundle, no
+monotone/interaction/CEGB/forced/extra_trees/quantized, and
+max_depth in [1, MAX_LEVEL_DEPTH] (the level hists are [nodes, F, B,
+3]; past depth ~10 the dense node axis outgrows HBM — the hybrid
+level+tail design in docs/TPU_RUNBOOK.md lifts this).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.split import (FeatureMeta, SplitHyperParams, K_EPSILON,
+                         best_split_for_leaf,
+                         calculate_splitted_leaf_output)
+from .grower import GrowerConfig, _go_left_bins
+from .tree import TreeArrays
+
+# dense level hists are [2^d, F, B, 3]: depth 10 = 1024 nodes is the
+# last comfortable level at 28 x 256 (344 MB f32)
+MAX_LEVEL_DEPTH = 10
+
+
+def make_level_grower(cfg: GrowerConfig, meta: FeatureMeta):
+    """Build ``grow(bins_rm, gh, feature_mask, cegb, rng_key)`` ->
+    ``(TreeArrays, leaf_id)`` over row-major uint8/16 bins [R, F]."""
+    L = int(cfg.num_leaves)
+    D = int(cfg.max_depth)
+    if not (1 <= D <= MAX_LEVEL_DEPTH):
+        raise ValueError(
+            f"level scheduling requires 1 <= max_depth <= "
+            f"{MAX_LEVEL_DEPTH}, got {cfg.max_depth}")
+    B = int(cfg.num_bin)
+    hp: SplitHyperParams = cfg.hparams
+    F = int(meta.num_bin.shape[0])
+    T_all = 2 ** (D + 1) - 1          # heap nodes incl. depth-D leaves
+    NEG = jnp.float32(-jnp.inf)
+
+    def scan_level(hist, sg, sh, cn, out, feature_mask):
+        return jax.vmap(
+            lambda hh, a, b, c, o: best_split_for_leaf(
+                hh, a, b, c, o, meta, hp, feature_mask)
+        )(hist, sg, sh, cn, out)
+
+    def grow(bins_rm, gh, feature_mask=None, cegb=None, rng_key=None):
+        del cegb, rng_key             # gated off by the engine
+        R = bins_rm.shape[0]
+        binsi = bins_rm.astype(jnp.int32)             # [R, F]
+        f_idx = jnp.arange(F, dtype=jnp.int32)
+
+        # ---- root stats (identical formulas to the sequential grower)
+        sums = gh.sum(axis=0)
+        root_g, root_h, root_c = sums[0], sums[1], sums[2]
+        root_out = calculate_splitted_leaf_output(
+            root_g, root_h + 2 * K_EPSILON, hp, root_c, jnp.float32(0.0))
+
+        heap = jnp.zeros(R, jnp.int32)   # per-row current heap node
+        sg_d = root_g[None]
+        sh_d = root_h[None]
+        cn_d = root_c[None]
+        out_d = root_out[None]
+        e_par = None                      # e of this level's nodes
+
+        # heap-ordered per-node collections (concatenated level lists)
+        gain_l, e_l, feat_l, thr_l, dl_l = [], [], [], [], []
+        sg_l, sh_l, cn_l, out_l = [sg_d], [sh_d], [cn_d], [out_d]
+
+        for d in range(D):
+            n_d = 1 << d
+            base = n_d - 1
+            local = heap - base
+            in_lvl = (local >= 0) & (local < n_d)
+            lsafe = jnp.where(in_lvl, local, 0)
+
+            # ---- segment histogram for every level-d node -----------
+            ghm = gh * in_lvl[:, None].astype(gh.dtype)
+            keys = (lsafe[:, None] * F + f_idx[None, :]) * B + binsi
+            vals = jnp.broadcast_to(ghm[:, None, :], (R, F, 3))
+            hist = jnp.zeros((n_d * F * B, 3), jnp.float32).at[
+                keys.reshape(-1)].add(vals.reshape(-1, 3))
+            hist = hist.reshape(n_d, F, B, 3)
+
+            # ---- vmapped split scan --------------------------------
+            recs = scan_level(hist, sg_d, sh_d, cn_d, out_d,
+                              feature_mask)
+            valid = recs.gain > 0.0
+            e_d = (recs.gain if e_par is None
+                   else jnp.minimum(recs.gain, e_par))
+            e_d = jnp.where(valid, e_d, NEG)
+
+            gain_l.append(recs.gain)
+            e_l.append(e_d)
+            feat_l.append(recs.feature)
+            thr_l.append(recs.threshold)
+            dl_l.append(recs.default_left)
+
+            # ---- children stats (heap order: left then right) -------
+            sg_d = jnp.stack([recs.left_sum_gradient,
+                              recs.right_sum_gradient], 1).reshape(-1)
+            sh_d = jnp.stack([recs.left_sum_hessian,
+                              recs.right_sum_hessian], 1).reshape(-1)
+            cn_d = jnp.stack([recs.left_count,
+                              recs.right_count], 1).reshape(-1)
+            out_d = jnp.stack([recs.left_output,
+                               recs.right_output], 1).reshape(-1)
+            e_par = jnp.stack([e_d, e_d], 1).reshape(-1)
+            sg_l.append(sg_d)
+            sh_l.append(sh_d)
+            cn_l.append(cn_d)
+            out_l.append(out_d)
+
+            # ---- partition: rows at valid nodes descend -------------
+            f_row = jnp.maximum(recs.feature, 0)[lsafe]
+            col = jnp.take_along_axis(binsi, f_row[:, None],
+                                      axis=1)[:, 0]
+            go_left = _go_left_bins(col, recs.threshold[lsafe],
+                                    recs.default_left[lsafe], f_row,
+                                    meta)
+            descend = in_lvl & valid[lsafe]
+            heap = jnp.where(
+                descend,
+                2 * heap + 1 + (~go_left).astype(jnp.int32), heap)
+
+        # depth-D nodes are never scanned: candidates with e = -inf
+        n_leafrow = 1 << D
+        e_l.append(jnp.full(n_leafrow, NEG))
+        gain_l.append(jnp.full(n_leafrow, NEG))
+        feat_l.append(jnp.full(n_leafrow, -1, jnp.int32))
+        thr_l.append(jnp.zeros(n_leafrow, jnp.int32))
+        dl_l.append(jnp.zeros(n_leafrow, bool))
+
+        e_h = jnp.concatenate(e_l)                     # [T_all]
+        gain_h = jnp.concatenate(gain_l)
+        feat_h = jnp.concatenate(feat_l)
+        thr_h = jnp.concatenate(thr_l)
+        dl_h = jnp.concatenate(dl_l)
+        sg_h = jnp.concatenate(sg_l)
+        sh_h = jnp.concatenate(sh_l)
+        cn_h = jnp.concatenate(cn_l)
+        out_h = jnp.concatenate(out_l)
+
+        # ---- rank by e desc; stable ties keep heap order, which is
+        # exactly parent-first-then-smaller-id ------------------------
+        order = jnp.argsort(-e_h, stable=True)         # [T_all]
+        rank = jnp.zeros(T_all, jnp.int32).at[order].set(
+            jnp.arange(T_all, dtype=jnp.int32))
+        k = jnp.minimum(jnp.int32(L - 1),
+                        jnp.sum(e_h > 0.0).astype(jnp.int32))
+        chosen = rank < k
+
+        # ---- slots: per-level top-down -----------------------------
+        # slot[v]: the leaf slot v occupies while it is a leaf. left
+        # child inherits the parent's slot; right child takes
+        # rank(parent) + 1 (the sequential grower's new_leaf = i + 1).
+        slot = jnp.full(T_all, -1, jnp.int32).at[0].set(0)
+        # eff[v]: the FINAL leaf slot for rows whose node is v (or a
+        # descendant of v once v stops splitting)
+        eff = jnp.full(T_all, -1, jnp.int32).at[0].set(
+            jnp.where(chosen[0], -1, 0))
+        for d in range(D):
+            base = (1 << d) - 1
+            ids = base + jnp.arange(1 << d, dtype=jnp.int32)
+            lc, rc = 2 * ids + 1, 2 * ids + 2
+            ch = chosen[ids]
+            slot = slot.at[lc].set(
+                jnp.where(ch, slot[ids], slot[lc]))
+            slot = slot.at[rc].set(
+                jnp.where(ch, rank[ids] + 1, slot[rc]))
+            # resolved parents propagate; fresh leaves resolve unless
+            # they are themselves chosen
+            par_eff = eff[ids]
+            eff = eff.at[lc].set(jnp.where(
+                par_eff >= 0, par_eff,
+                jnp.where(ch & ~chosen[lc], slot[ids], -1)))
+            eff = eff.at[rc].set(jnp.where(
+                par_eff >= 0, par_eff,
+                jnp.where(ch & ~chosen[rc], rank[ids] + 1, -1)))
+
+        leaf_id = jnp.maximum(eff[heap], 0)
+
+        # ---- tree arrays -------------------------------------------
+        # scatters use one extra DUMP slot for every unselected heap
+        # node (duplicate dump writes carry only discarded garbage), so
+        # real entries can never be clobbered
+        ids_all = jnp.arange(T_all, dtype=jnp.int32)
+        li = max(L - 1, 1)
+        rk = jnp.where(chosen, rank, li)             # dump slot = li
+        lc_all = jnp.minimum(2 * ids_all + 1, T_all - 1)
+        rc_all = jnp.minimum(2 * ids_all + 2, T_all - 1)
+        lptr = jnp.where(chosen[lc_all], rank[lc_all],
+                         -(slot[lc_all] + 1))
+        rptr = jnp.where(chosen[rc_all], rank[rc_all],
+                         -(slot[rc_all] + 1))
+
+        def node_scatter(vals, dtype=jnp.float32):
+            return jnp.zeros(li + 1, dtype).at[rk].set(
+                vals.astype(dtype))[:li]
+
+        split_feature = node_scatter(feat_h, jnp.int32)
+        threshold_bin = node_scatter(thr_h, jnp.int32)
+        default_left = node_scatter(dl_h, bool)
+        split_gain = node_scatter(gain_h)
+        internal_value = node_scatter(out_h)
+        internal_weight = node_scatter(sh_h)
+        internal_count = node_scatter(cn_h)
+        left_child = node_scatter(lptr, jnp.int32)
+        right_child = node_scatter(rptr, jnp.int32)
+
+        # leaves: nodes with a chosen parent that are not chosen
+        par_all = jnp.maximum((ids_all - 1) // 2, 0)
+        is_leaf = (~chosen) & chosen[par_all] & (ids_all > 0)
+        grew = k > 0
+        lslot = jnp.where(is_leaf, slot, L)          # dump slot = L
+
+        def leaf_scatter(vals, fill=0.0, dtype=jnp.float32):
+            return jnp.full(L + 1, fill, dtype).at[lslot].set(
+                vals.astype(dtype))[:L]
+
+        zl = jnp.zeros(L, jnp.float32)
+        leaf_value = jnp.where(grew, leaf_scatter(out_h), zl)
+        leaf_weight = jnp.where(grew, leaf_scatter(sh_h), zl)
+        leaf_count = jnp.where(grew, leaf_scatter(cn_h), zl)
+        leaf_parent = jnp.where(
+            grew, leaf_scatter(rank[par_all], fill=-1, dtype=jnp.int32),
+            jnp.full(L, -1, jnp.int32))
+
+        tree = TreeArrays(
+            split_feature=split_feature,
+            threshold_bin=threshold_bin,
+            default_left=default_left,
+            left_child=left_child,
+            right_child=right_child,
+            split_gain=split_gain,
+            internal_value=internal_value,
+            internal_weight=internal_weight,
+            internal_count=internal_count,
+            leaf_value=leaf_value,
+            leaf_weight=leaf_weight,
+            leaf_count=leaf_count,
+            leaf_parent=leaf_parent,
+            num_leaves=(k + 1).astype(jnp.int32),
+            shrinkage=jnp.asarray(1.0, jnp.float32),
+        )
+        return tree, leaf_id
+
+    return grow

@@ -733,7 +733,8 @@ class GBDT:
                             + ", ".join(fallback))
             self.grower_cfg = dataclasses.replace(
                 self.grower_cfg, hist_backend="multival")
-        self._compact = self.grower_cfg.row_sched == "compact"
+        # "level" trains on the same row-major layout as "compact"
+        self._compact = self.grower_cfg.row_sched in ("compact", "level")
 
         # ---- EFB bundling (ref: dataset.cpp:112 FindGroups) -----------
         self._bundle = None
@@ -801,6 +802,22 @@ class GBDT:
             # path keeps its contract
             train_bins_host = train.ensure_logical_bins()
 
+        # resolve tpu_row_scheduling="level" ONCE, before the packing
+        # block and the learner branches: every eligibility input
+        # (learner, bundle, forced, meta, cegb params, hooks) is known
+        # here, and a fallback must happen before packed-bins decide on
+        # the final scheduler (review finding: a late fallback crashed
+        # distributed learners on the row-major layout and silently
+        # lost packing)
+        if self.grower_cfg.row_sched == "level":
+            reasons = self._level_ineligibility(forced)
+            if reasons:
+                log.warning(
+                    "tpu_row_scheduling='level' does not support "
+                    f"{'; '.join(reasons)} — falling back to 'compact'")
+                self.grower_cfg = dataclasses.replace(
+                    self.grower_cfg, row_sched="compact")
+
         self.bins_rf = None
         self._bins_packed_dev = None
         self._packed_cols = 0
@@ -818,6 +835,8 @@ class GBDT:
                          (pb == "auto" and
                           tuned.applies(self.num_data) and
                           tuned.get("packed_bins", False) is True))
+            # the level grower reads plain u8 [R, F] directly
+            want_pack &= self.grower_cfg.row_sched == "compact"
             if want_pack and self.num_bin_max <= 255:
                 # bit-pack 4 uint8 bins per uint32 word: quarters the
                 # element count of the compact scheduler's per-leaf row
@@ -930,6 +949,12 @@ class GBDT:
                 self._grow = jax.jit(make_tree_grower(
                     self.grower_cfg, self.feature_meta, forced=forced,
                     bundle=self._bundle, **hooks))
+            elif self.grower_cfg.row_sched == "level":
+                # eligibility already resolved before the packing block
+                from ..core.level_grower import make_level_grower
+                self._grow = jax.jit(
+                    make_level_grower(self.grower_cfg,
+                                      self.feature_meta))
             else:
                 self._grow = jax.jit(
                     make_tree_grower(self.grower_cfg, self.feature_meta,
@@ -1315,6 +1340,49 @@ class GBDT:
         self._cegb_feature_used = np.zeros(F, bool)
         self._cegb_row_charged = (np.zeros((F, self.num_data), bool)
                                   if lazy else None)
+
+    def _level_ineligibility(self, forced) -> list:
+        """Reasons the phase-A level grower cannot serve this config
+        (core/level_grower.py docstring); empty list = eligible."""
+        from ..core.level_grower import MAX_LEVEL_DEPTH
+        from ..distributed import make_injected_hooks
+        from ..ops.split import meta_has_categorical
+        cfg = self.config
+        reasons = []
+        if self._tree_learner != "serial":
+            reasons.append(f"tree_learner={self._tree_learner!r}")
+        if self._multival:
+            reasons.append("multi-value sparse storage")
+        if make_injected_hooks() is not None:
+            reasons.append("injected collectives")
+        if not (1 <= self.grower_cfg.max_depth <= MAX_LEVEL_DEPTH):
+            reasons.append(
+                f"max_depth outside [1, {MAX_LEVEL_DEPTH}]")
+        if meta_has_categorical(self.feature_meta):
+            reasons.append("categorical features")
+        if self._bundle is not None:
+            reasons.append("EFB bundles")
+        if self.grower_cfg.hparams.monotone_penalty > 0 or \
+                self.feature_meta.monotone is not None:
+            reasons.append("monotone constraints")
+        if self.grower_cfg.interaction_groups is not None:
+            reasons.append("interaction constraints")
+        if (cfg.cegb_penalty_split > 0.0 or
+                cfg.cegb_penalty_feature_coupled or
+                cfg.cegb_penalty_feature_lazy):
+            # from config (the check runs before _setup_cegb)
+            reasons.append("CEGB penalties")
+        if forced is not None:
+            reasons.append("forced splits")
+        if self.grower_cfg.extra_trees:
+            reasons.append("extra_trees")
+        if self.grower_cfg.quantized:
+            reasons.append("quantized gradients")
+        if self.grower_cfg.bynode_mask:
+            reasons.append("feature_fraction_bynode")
+        if cfg.linear_tree:
+            reasons.append("linear trees")
+        return reasons
 
     def _cegb_penalty(self):
         """(const [F], per_count [F]) for the current tree, or None."""

@@ -1,0 +1,138 @@
+"""Level-synchronous grower (phase A) vs the sequential leaf-wise
+grower: same trees, same predictions.
+
+The binary objective's FIRST tree has exactly dyadic gradients
+(g = 0.5 - y, h = 0.25 with boost_from_average off), so histogram sums
+are exact in f32 regardless of accumulation order — single-tree
+comparisons must match the sequential grower SPLIT FOR SPLIT.
+Multi-iteration runs accumulate ulp-level differences through the
+scores, so those compare with tolerance.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(seed=5, n=4000, f=8):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    logit = (X[:, 0] * 1.5 + np.square(X[:, 1]) - X[:, 2] +
+             0.3 * rng.normal(size=n))
+    return X, (logit > 0).astype(np.float32)
+
+
+def _params(sched, **kw):
+    p = {"objective": "binary", "num_leaves": 31, "max_depth": 6,
+         "min_data_in_leaf": 20, "verbosity": -1,
+         "boost_from_average": False, "tpu_row_scheduling": sched}
+    p.update(kw)
+    return p
+
+
+def _dump_splits(bst, it=0):
+    d = bst.dump_model()["tree_info"][it]["tree_structure"]
+    out = []
+
+    def walk(node, depth):
+        if "split_feature" in node:
+            out.append((node["split_feature"],
+                        node.get("threshold_bin"), depth))
+            walk(node["left_child"], depth + 1)
+            walk(node["right_child"], depth + 1)
+
+    walk(d, 0)
+    return out
+
+
+@pytest.mark.parametrize("depth,leaves", [(4, 31), (6, 31), (6, 9),
+                                          (3, 64)])
+def test_single_tree_exact_parity(depth, leaves):
+    """Dyadic first-tree gradients: trees must match split for split,
+    including leaf numbering (via identical predictions)."""
+    X, y = _data()
+    kw = dict(max_depth=depth, num_leaves=leaves)
+    b_seq = lgb.train(_params("compact", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=1)
+    b_lvl = lgb.train(_params("level", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=1)
+    s_seq = _dump_splits(b_seq)
+    s_lvl = _dump_splits(b_lvl)
+    assert sorted(s_seq) == sorted(s_lvl)
+    np.testing.assert_array_equal(b_lvl.predict(X), b_seq.predict(X))
+
+
+def test_multi_iteration_close():
+    X, y = _data(seed=9)
+    b_seq = lgb.train(_params("compact"), lgb.Dataset(X, label=y),
+                      num_boost_round=12)
+    b_lvl = lgb.train(_params("level"), lgb.Dataset(X, label=y),
+                      num_boost_round=12)
+    p_seq = b_seq.predict(X)
+    p_lvl = b_lvl.predict(X)
+    np.testing.assert_allclose(p_lvl, p_seq, rtol=1e-4, atol=1e-5)
+
+
+def test_regression_close_and_model_roundtrip(tmp_path):
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(3000, 6)).astype(np.float32)
+    y = (X[:, 0] * 2 + np.square(X[:, 1]) +
+         0.1 * rng.normal(size=3000)).astype(np.float32)
+    p = {"objective": "regression", "num_leaves": 15, "max_depth": 5,
+         "min_data_in_leaf": 10, "verbosity": -1,
+         "tpu_row_scheduling": "level"}
+    bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=20)
+    pred = bst.predict(X)
+    assert float(np.mean((pred - y) ** 2)) < float(y.var()) * 0.2
+    # the level trees must round-trip the reference text format
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+    b2 = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(b2.predict(X), pred, rtol=1e-6)
+
+
+def test_budget_binding_parity():
+    """num_leaves far below the full tree: the e-ranking must choose
+    the same best-first subset the sequential grower picks."""
+    X, y = _data(seed=13, n=6000)
+    kw = dict(max_depth=8, num_leaves=12)
+    b_seq = lgb.train(_params("compact", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=1)
+    b_lvl = lgb.train(_params("level", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=1)
+    assert sorted(_dump_splits(b_seq)) == sorted(_dump_splits(b_lvl))
+    np.testing.assert_array_equal(b_lvl.predict(X), b_seq.predict(X))
+
+
+def test_fallback_configs_warn_and_work():
+    """Ineligible configs fall back to the sequential grower."""
+    X, y = _data(seed=7, n=1500, f=4)
+    p = _params("level", max_depth=-1)  # unbounded depth: ineligible
+    bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=2)
+    assert np.isfinite(bst.predict(X)).all()
+    p2 = _params("level", monotone_constraints=[1, 0, 0, 0])
+    bst2 = lgb.train(p2, lgb.Dataset(X, label=y), num_boost_round=2)
+    assert np.isfinite(bst2.predict(X)).all()
+
+
+@pytest.mark.parametrize("tl", ["data", "feature", "voting"])
+def test_fallback_distributed_learners(tl):
+    """A level request with a distributed learner must fall back BEFORE
+    the learner builds its grower (an early review caught the full-mode
+    program compiling against the compact row-major layout)."""
+    X, y = _data(seed=8, n=800, f=4)
+    p = _params("level", max_depth=5, tree_learner=tl,
+                tpu_num_devices=-1)
+    bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=2)
+    assert np.isfinite(bst.predict(X)).all()
+
+
+def test_fallback_keeps_packed_bins():
+    """The eligibility fallback resolves before the packed-bins
+    decision, so an ineligible level config keeps the compact
+    scheduler's packing."""
+    X, y = _data(seed=8, n=800, f=4)
+    p = _params("level", max_depth=-1, tpu_packed_bins="true")
+    bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=2)
+    assert bst._engine._packed_cols > 0
+    assert np.isfinite(bst.predict(X)).all()
