@@ -19,9 +19,14 @@ tunnel. This grower instead:
 
 Numerical note: per-node sums, outputs and child stats come from the
 SAME SplitRecord fields the sequential grower uses, so the only
-divergence channel is histogram accumulation order (scatter-add over
-rows vs gathered-segment passes) — ulp-level on f32, bit-exact for
-dyadic gradients (e.g. a binary objective's first tree).
+divergence channel is histogram accumulation order (level-batched vs
+gathered-segment passes): bit-exact for dyadic gradients (e.g. a
+binary objective's first tree), ordinary f32 reassociation noise
+otherwise — each node accumulates only its own rows/blocks in every
+formulation here, so the error scales with the node's own magnitude,
+not the dataset's. Exact fp ties between UNRELATED candidate nodes
+break by heap order here vs leaf-slot order sequentially (measure-zero
+on real-valued gains).
 
 Phase-A scope (the engine falls back to the sequential grower
 otherwise): serial learner, numerical features, no EFB bundle, no
@@ -35,6 +40,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..ops.histogram import hist_rowmajor
 from ..ops.split import (FeatureMeta, SplitHyperParams, K_EPSILON,
                          best_split_for_leaf,
                          calculate_splitted_leaf_output)
@@ -61,11 +67,95 @@ def make_level_grower(cfg: GrowerConfig, meta: FeatureMeta):
     T_all = 2 ** (D + 1) - 1          # heap nodes incl. depth-D leaves
     NEG = jnp.float32(-jnp.inf)
 
+    # "scatter": one global scatter-add per level over (node, f, bin)
+    # keys — the natural CPU kernel. Anything else ("einsum"/"pallas"):
+    # the BLOCKS mode — rows sorted by node, whole-block histograms via
+    # the batched row-major kernel summed per owner node, and the two
+    # sub-block edges of every node via fixed-size masked windows. A
+    # level is then ~4 large batched kernels instead of a scatter —
+    # the MXU-friendly shape (docs/TPU_RUNBOOK.md round-6 design).
+    use_blocks = cfg.hist_rm_backend != "scatter"
+    rm_backend = cfg.hist_rm_backend
+
     def scan_level(hist, sg, sh, cn, out, feature_mask):
         return jax.vmap(
             lambda hh, a, b, c, o: best_split_for_leaf(
                 hh, a, b, c, o, meta, hp, feature_mask)
         )(hist, sg, sh, cn, out)
+
+    def hist_blocks(binsi, gh, local, in_lvl, n_d, R):
+        """[n_d, F, B, 3] per-node histograms, big-kernel formulation.
+
+        Full blocks interior to a node are summed by a per-owner
+        scatter over [G] block histograms (each node sums only its OWN
+        blocks — no global prefix, so no cancellation error beyond the
+        node's own magnitude); the two sub-block edges of every node
+        come from fixed-size masked windows."""
+        rm_hist = jax.vmap(lambda b, g: hist_rowmajor(
+            b, g, num_bin=B, dtype=cfg.hist_dtype, backend=rm_backend))
+
+        if n_d <= 2:
+            # shallow levels: per-node masked full passes beat the
+            # block/window machinery (n_d * R <= 2R vs ~3R rows)
+            return jnp.stack([
+                hist_rowmajor(
+                    binsi,
+                    gh * (in_lvl & (local == v))[:, None].astype(
+                        gh.dtype),
+                    num_bin=B, dtype=cfg.hist_dtype,
+                    backend=rm_backend)
+                for v in range(n_d)]).astype(jnp.float32)
+
+        key = jnp.where(in_lvl, local, n_d)
+        order = jnp.argsort(key, stable=True)
+        sb = binsi[order]                              # [R, F]
+        sgh = gh[order] * (key[order] < n_d)[:, None].astype(gh.dtype)
+        # PHYSICAL rows per node (counts incl. bagged-out rows)
+        cnt = jnp.zeros(n_d + 1, jnp.int32).at[key].add(1)[:n_d]
+        starts = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(cnt)])  # [n_d + 1]
+        s_v, e_v = starts[:-1], starts[1:]
+        # block size ~ mean segment, pow2
+        bs = 256
+        while bs * n_d < R:
+            bs *= 2
+        G = -(-R // bs)
+        pad = G * bs - R
+        sb = jnp.pad(sb, ((0, pad), (0, 0)))
+        sgh = jnp.pad(sgh, ((0, pad), (0, 0)))
+        bh = rm_hist(sb.reshape(G, bs, F), sgh.reshape(G, bs, 3))
+        # owner of each block: the node containing its first row, kept
+        # only when the whole block lies inside that node; straddling
+        # and out-of-range blocks go to the dump slot (their rows are
+        # exactly what the edge windows cover)
+        b_start = jnp.arange(G, dtype=jnp.int32) * bs
+        owner = (jnp.searchsorted(starts, b_start, side="right")
+                 .astype(jnp.int32) - 1)
+        own_safe = jnp.clip(owner, 0, n_d - 1)
+        interior = ((owner >= 0) & (owner < n_d) &
+                    (b_start + bs <= e_v[own_safe]) &
+                    (b_start >= s_v[own_safe]))
+        tgt = jnp.where(interior, own_safe, n_d)       # dump slot n_d
+        full = jnp.zeros((n_d + 1, F, B, 3), bh.dtype).at[tgt].add(
+            bh)[:n_d]
+        b0 = -(-s_v // bs)                             # ceil
+        b1 = jnp.maximum(e_v // bs, b0)
+        head_end = jnp.minimum(b0 * bs, e_v)
+        tail_start = jnp.maximum(b1 * bs, head_end)
+
+        def window_hist(w_start, w_len):
+            idx = jnp.minimum(w_start[:, None] +
+                              jnp.arange(bs, dtype=jnp.int32)[None, :],
+                              G * bs - 1)              # [n_d, bs]
+            wb = sb[idx]                               # [n_d, bs, F]
+            wm = (jnp.arange(bs)[None, :] <
+                  w_len[:, None]).astype(gh.dtype)
+            wg = sgh[idx] * wm[:, :, None]
+            return rm_hist(wb, wg)
+
+        head = window_hist(s_v, head_end - s_v)
+        tail = window_hist(tail_start, e_v - tail_start)
+        return (full + head + tail).astype(jnp.float32)
 
     def grow(bins_rm, gh, feature_mask=None, cegb=None, rng_key=None):
         del cegb, rng_key             # gated off by the engine
@@ -98,12 +188,15 @@ def make_level_grower(cfg: GrowerConfig, meta: FeatureMeta):
             lsafe = jnp.where(in_lvl, local, 0)
 
             # ---- segment histogram for every level-d node -----------
-            ghm = gh * in_lvl[:, None].astype(gh.dtype)
-            keys = (lsafe[:, None] * F + f_idx[None, :]) * B + binsi
-            vals = jnp.broadcast_to(ghm[:, None, :], (R, F, 3))
-            hist = jnp.zeros((n_d * F * B, 3), jnp.float32).at[
-                keys.reshape(-1)].add(vals.reshape(-1, 3))
-            hist = hist.reshape(n_d, F, B, 3)
+            if use_blocks:
+                hist = hist_blocks(binsi, gh, local, in_lvl, n_d, R)
+            else:
+                ghm = gh * in_lvl[:, None].astype(gh.dtype)
+                keys = (lsafe[:, None] * F + f_idx[None, :]) * B + binsi
+                vals = jnp.broadcast_to(ghm[:, None, :], (R, F, 3))
+                hist = jnp.zeros((n_d * F * B, 3), jnp.float32).at[
+                    keys.reshape(-1)].add(vals.reshape(-1, 3))
+                hist = hist.reshape(n_d, F, B, 3)
 
             # ---- vmapped split scan --------------------------------
             recs = scan_level(hist, sg_d, sh_d, cn_d, out_d,

@@ -127,6 +127,34 @@ def test_fallback_distributed_learners(tl):
     assert np.isfinite(bst.predict(X)).all()
 
 
+def test_blocks_hist_matches_scatter_hist():
+    """The blocks formulation (sorted rows + block prefix + edge
+    windows — the TPU shape) must produce the same trees as the
+    scatter level hist; dyadic first-tree gradients make it exact."""
+    X, y = _data(seed=21)
+    kw = dict(max_depth=6, num_leaves=31)
+    b_sc = lgb.train(_params("level", tpu_hist_kernel="scatter", **kw),
+                     lgb.Dataset(X, label=y), num_boost_round=1)
+    b_bl = lgb.train(_params("level", tpu_hist_kernel="einsum", **kw),
+                     lgb.Dataset(X, label=y), num_boost_round=1)
+    assert sorted(_dump_splits(b_sc)) == sorted(_dump_splits(b_bl))
+    np.testing.assert_array_equal(b_bl.predict(X), b_sc.predict(X))
+
+
+def test_level_with_bagging_close():
+    """Bagged rows stay physically present with zero mask weight; the
+    level partition must carry them like the sequential one does."""
+    X, y = _data(seed=23)
+    kw = dict(bagging_fraction=0.7, bagging_freq=1, seed=3,
+              max_depth=5)
+    b_seq = lgb.train(_params("compact", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=6)
+    b_lvl = lgb.train(_params("level", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=6)
+    np.testing.assert_allclose(b_lvl.predict(X), b_seq.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_fallback_keeps_packed_bins():
     """The eligibility fallback resolves before the packed-bins
     decision, so an ineligible level config keeps the compact
