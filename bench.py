@@ -230,6 +230,9 @@ def _hist_mfu(ips: float, sched: str) -> float:
     import math
     if sched == "compact":
         passes = math.log2(max(NUM_LEAVES, 2))
+    elif sched == "level":
+        # one blocks pass (~3x rows counting edge windows) per depth
+        passes = 3.0 * float(BENCH_EXTRA.get("max_depth", 10))
     else:
         passes = float(NUM_LEAVES - 1)
     flops_per_iter = 2.0 * 3.0 * MAX_BIN * N_FEATURES * N_ROWS * passes

@@ -318,6 +318,19 @@ def _stages() -> int:
     run_bench("diag_1m", 1_000_000, 12,
               env_extra={"LIGHTGBM_TPU_TIMETAG": "1"})
 
+    # ---- stage 4.6: level-vs-compact A/B at a depth-capped config
+    # (the level grower's first device measurement — informational, the
+    # metric suffix carries the non-headline config). BOTH arms pin the
+    # einsum kernel so the pair differs ONLY in scheduling (the tuned
+    # flip would otherwise put pallas under the compact arm), and the
+    # level arm selects its scheduler through BENCH_SCHEDS so bench.py
+    # labels the result correctly and has no phantom fallback rerun.
+    lvl_kw = {"max_depth": 10, "tpu_hist_kernel": "einsum"}
+    run_bench("ab_depth10_compact", 1_000_000, 15, lvl_kw,
+              scheds="compact")
+    run_bench("ab_depth10_level", 1_000_000, 15, lvl_kw,
+              scheds="level")
+
     # ---- stage 5: leaves ladder at 1M (fixed-cost curve for the
     # runbook) runs BEFORE the 10.5M stage: the big shape's compiles
     # through the remote-compile tunnel are pathological (a 31-leaf

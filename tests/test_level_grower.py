@@ -127,6 +127,38 @@ def test_fallback_distributed_learners(tl):
     assert np.isfinite(bst.predict(X)).all()
 
 
+def test_feature_fraction_parity():
+    """The per-tree column sample reaches the level scan as the same
+    feature mask the sequential grower uses (same seed => same mask =>
+    identical dyadic first tree)."""
+    X, y = _data(seed=31)
+    kw = dict(feature_fraction=0.6, seed=11, max_depth=6)
+    b_seq = lgb.train(_params("compact", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=1)
+    b_lvl = lgb.train(_params("level", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=1)
+    assert sorted(_dump_splits(b_seq)) == sorted(_dump_splits(b_lvl))
+    np.testing.assert_array_equal(b_lvl.predict(X), b_seq.predict(X))
+
+
+def test_multiclass_level_close():
+    rng = np.random.default_rng(17)
+    X = rng.normal(size=(2500, 6)).astype(np.float32)
+    yc = (np.abs(X[:, 0]) + X[:, 1] > 1).astype(int) + \
+        (X[:, 2] > 0.5).astype(int)
+    p = {"objective": "multiclass", "num_class": 3, "num_leaves": 15,
+         "max_depth": 5, "verbosity": -1}
+    b_seq = lgb.train({**p, "tpu_row_scheduling": "compact"},
+                      lgb.Dataset(X, label=yc), num_boost_round=5)
+    b_lvl = lgb.train({**p, "tpu_row_scheduling": "level"},
+                      lgb.Dataset(X, label=yc), num_boost_round=5)
+    # multiclass gradients are non-dyadic from iteration 1 (softmax
+    # 1/3), so hist reassociation can flip near-tie splits — compare
+    # as distributions, not bitwise
+    np.testing.assert_allclose(b_lvl.predict(X), b_seq.predict(X),
+                               rtol=5e-3, atol=5e-4)
+
+
 def test_blocks_hist_matches_scatter_hist():
     """The blocks formulation (sorted rows + block prefix + edge
     windows — the TPU shape) must produce the same trees as the
