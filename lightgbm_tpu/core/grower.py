@@ -563,8 +563,15 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     def grow(bins_t: jnp.ndarray, gh: jnp.ndarray,
              feature_mask: Optional[jnp.ndarray] = None,
              cegb: Optional[tuple] = None,
-             rng_key: Optional[jnp.ndarray] = None
+             rng_key: Optional[jnp.ndarray] = None,
+             init: Optional[tuple] = None
              ) -> Tuple[TreeArrays, jnp.ndarray]:
+        # ``init`` (hybrid level+tail growth, core/hybrid_grower.py):
+        # a ``(GrowState, start_step)`` pair replacing the root
+        # initialization — the loop resumes at traced step
+        # ``start_step`` with a state the level phase committed. The
+        # python-level branch specializes the trace; the normal path
+        # compiles exactly as before.
         # full mode takes feature-major [F, R] bins; compact mode takes
         # ROW-major [R, F] (the gather-friendly layout). With EFB the
         # stored columns are PHYSICAL bundles (Fp) while masks/paths/the
@@ -764,105 +771,112 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 fm = al if fm is None else (fm & al)
             return fm
 
-        # ---- root (ref: LeafSplits::Init + first FindBestSplits) ----
-        if quantized:
-            local_root = gh.sum(axis=0, dtype=jnp.int32)
-            sums = conv(reduce_sums(local_root))
-        else:
-            local_root = gh.sum(axis=0)               # [3] LOCAL
-            sums = reduce_sums(local_root)            # [3] global
-        root_g, root_h, root_c = sums[0], sums[1], sums[2]
-        root_out = calculate_splitted_leaf_output(
-            root_g, root_h + 2 * K_EPSILON, hp, root_c, jnp.float32(0.0))
-        leaf_id0 = jnp.zeros(R, jnp.int32)
-        if compact:
-            root_bins = unpack_rows(bins_t) if packed else bins_t
-            hist_root = reduce_hist(hist_rm(root_bins, gh),
-                                    (root_g, root_h, root_c, root_out))
-        else:
-            hist_root = reduce_hist(hist_fn(bins_t, gh),
-                                    (root_g, root_h, root_c, root_out))
         inf = jnp.float32(jnp.inf)
-        root_path = jnp.zeros(F, bool)
-        hist_root_l = conv(hist_root)
-        root_lsum = conv(local_root.astype(hist_dtype)) if local_pool \
-            else None
-        if bundled:
-            # a LOCAL pool expands with LOCAL totals (the default-bin
-            # mass of this shard's rows), global pools with global
-            if local_pool:
-                hist_root_l = expand_hist(hist_root_l, root_lsum[0],
-                                          root_lsum[1], root_lsum[2])
-            else:
-                hist_root_l = expand_hist(hist_root_l, root_g, root_h,
-                                          root_c)
         if use_rand:
             et_key = jax.random.fold_in(
                 rng_key if rng_key is not None else jax.random.PRNGKey(0),
                 7919)
-            root_rand = rand_uniforms(jax.random.fold_in(et_key, 2 ** 20))
+        if init is not None:
+            # hybrid handoff: the level phase committed `start_step`
+            # splits; resume the sequential loop from its state
+            state, start_step = init
         else:
-            root_rand = None
-        best_root = best_of(hist_root_l, root_g, root_h, root_c,
-                            root_out, node_mask(0, root_path),
-                            leaf_range=(-inf, inf),
-                            leaf_depth=jnp.int32(0), cegb=cegb,
-                            rand_u=root_rand, lsum3=root_lsum)
+            start_step = 0
+            # ---- root (ref: LeafSplits::Init + first FindBestSplits) ----
+            if quantized:
+                local_root = gh.sum(axis=0, dtype=jnp.int32)
+                sums = conv(reduce_sums(local_root))
+            else:
+                local_root = gh.sum(axis=0)               # [3] LOCAL
+                sums = reduce_sums(local_root)            # [3] global
+            root_g, root_h, root_c = sums[0], sums[1], sums[2]
+            root_out = calculate_splitted_leaf_output(
+                root_g, root_h + 2 * K_EPSILON, hp, root_c, jnp.float32(0.0))
+            leaf_id0 = jnp.zeros(R, jnp.int32)
+            if compact:
+                root_bins = unpack_rows(bins_t) if packed else bins_t
+                hist_root = reduce_hist(hist_rm(root_bins, gh),
+                                        (root_g, root_h, root_c, root_out))
+            else:
+                hist_root = reduce_hist(hist_fn(bins_t, gh),
+                                        (root_g, root_h, root_c, root_out))
+            root_path = jnp.zeros(F, bool)
+            hist_root_l = conv(hist_root)
+            root_lsum = conv(local_root.astype(hist_dtype)) if local_pool \
+                else None
+            if bundled:
+                # a LOCAL pool expands with LOCAL totals (the default-bin
+                # mass of this shard's rows), global pools with global
+                if local_pool:
+                    hist_root_l = expand_hist(hist_root_l, root_lsum[0],
+                                              root_lsum[1], root_lsum[2])
+                else:
+                    hist_root_l = expand_hist(hist_root_l, root_g, root_h,
+                                              root_c)
+            if use_rand:
+                root_rand = rand_uniforms(jax.random.fold_in(et_key, 2 ** 20))
+            else:
+                root_rand = None
+            best_root = best_of(hist_root_l, root_g, root_h, root_c,
+                                root_out, node_mask(0, root_path),
+                                leaf_range=(-inf, inf),
+                                leaf_depth=jnp.int32(0), cegb=cegb,
+                                rand_u=root_rand, lsum3=root_lsum)
 
-        if pool_none:
-            hist_pool = None
-        elif pool_bounded:
-            hist_pool = jnp.zeros((P_slots, Fp, B, 3),
-                                  hist_dtype).at[0].set(hist_root)
-        else:
-            hist_pool = jnp.zeros((L, Fp, B, 3), hist_dtype).at[0].set(
-                hist_root)
-        stats0 = jnp.zeros((L, NS), jnp.float32)
-        stats0 = stats0.at[:, S_LMIN].set(-jnp.inf)
-        stats0 = stats0.at[:, S_LMAX].set(jnp.inf)
-        stats0 = stats0.at[:, S_PARENT].set(-1.0)
-        stats0 = stats0.at[0].set(jnp.stack([
-            root_g, root_h, root_c, root_out, -inf, inf,
-            jnp.float32(0.0), jnp.float32(-1.0), jnp.float32(0.0),
-            jnp.float32(0.0)]))
-        inv_row = pack_rec(SplitRecord.invalid((), max_cat=MAXK))
-        best0 = jnp.broadcast_to(inv_row, (L, NB)).at[0].set(
-            pack_rec(best_root))
+            if pool_none:
+                hist_pool = None
+            elif pool_bounded:
+                hist_pool = jnp.zeros((P_slots, Fp, B, 3),
+                                      hist_dtype).at[0].set(hist_root)
+            else:
+                hist_pool = jnp.zeros((L, Fp, B, 3), hist_dtype).at[0].set(
+                    hist_root)
+            stats0 = jnp.zeros((L, NS), jnp.float32)
+            stats0 = stats0.at[:, S_LMIN].set(-jnp.inf)
+            stats0 = stats0.at[:, S_LMAX].set(jnp.inf)
+            stats0 = stats0.at[:, S_PARENT].set(-1.0)
+            stats0 = stats0.at[0].set(jnp.stack([
+                root_g, root_h, root_c, root_out, -inf, inf,
+                jnp.float32(0.0), jnp.float32(-1.0), jnp.float32(0.0),
+                jnp.float32(0.0)]))
+            inv_row = pack_rec(SplitRecord.invalid((), max_cat=MAXK))
+            best0 = jnp.broadcast_to(inv_row, (L, NB)).at[0].set(
+                pack_rec(best_root))
 
-        state = GrowState(
-            leaf_id=leaf_id0,
-            hist=hist_pool,
-            stats=stats0,
-            best=best0,
-            # L-1 internal-node rows + one scratch row (index L-1) that
-            # absorbs the parent-pointer write of parentless splits so
-            # the body's paired row write always has distinct indices
-            node=jnp.zeros((L, NN), jnp.float32),
-            num_leaves=jnp.asarray(1, jnp.int32),
-            done=jnp.asarray(False),
-            best_cat=(jnp.full((L, MAXK), -1, jnp.int32).at[0].set(
-                best_root.cat_bins) if has_cat else None),
-            tree_cat=(jnp.full((L - 1, MAXK), -1, jnp.int32)
-                      if has_cat else None),
-            path_mask=jnp.zeros((L, F), bool) if use_ic else None,
-            forced_ok=jnp.asarray(True),
-            order=jnp.arange(R, dtype=jnp.int32) if compact else None,
-            seg=(jnp.zeros((L, 2), jnp.int32).at[0, 1].set(R)
-                 if compact else None),
-            lsum=(jnp.zeros((L, 3), hist_dtype).at[0].set(
-                local_root.astype(hist_dtype)) if local_pool else None),
-            slot_map=(jnp.full(L, -1, jnp.int32).at[0].set(0)
-                      if pool_bounded else None),
-            slot_stamp=(jnp.full(P_slots, -1, jnp.int32).at[0].set(0)
-                        if pool_bounded else None),
-            slot_owner=(jnp.full(P_slots, -1, jnp.int32).at[0].set(0)
-                        if pool_bounded else None),
-            leaf_flo=(jnp.zeros((L, F), jnp.int32) if use_mc_inter
-                      else None),
-            leaf_fhi=(jnp.broadcast_to(
-                meta.num_bin.astype(jnp.int32)[None, :] - 1,
-                (L, F)).copy() if use_mc_inter else None),
-        )
+            state = GrowState(
+                leaf_id=leaf_id0,
+                hist=hist_pool,
+                stats=stats0,
+                best=best0,
+                # L-1 internal-node rows + one scratch row (index L-1) that
+                # absorbs the parent-pointer write of parentless splits so
+                # the body's paired row write always has distinct indices
+                node=jnp.zeros((L, NN), jnp.float32),
+                num_leaves=jnp.asarray(1, jnp.int32),
+                done=jnp.asarray(False),
+                best_cat=(jnp.full((L, MAXK), -1, jnp.int32).at[0].set(
+                    best_root.cat_bins) if has_cat else None),
+                tree_cat=(jnp.full((L - 1, MAXK), -1, jnp.int32)
+                          if has_cat else None),
+                path_mask=jnp.zeros((L, F), bool) if use_ic else None,
+                forced_ok=jnp.asarray(True),
+                order=jnp.arange(R, dtype=jnp.int32) if compact else None,
+                seg=(jnp.zeros((L, 2), jnp.int32).at[0, 1].set(R)
+                     if compact else None),
+                lsum=(jnp.zeros((L, 3), hist_dtype).at[0].set(
+                    local_root.astype(hist_dtype)) if local_pool else None),
+                slot_map=(jnp.full(L, -1, jnp.int32).at[0].set(0)
+                          if pool_bounded else None),
+                slot_stamp=(jnp.full(P_slots, -1, jnp.int32).at[0].set(0)
+                            if pool_bounded else None),
+                slot_owner=(jnp.full(P_slots, -1, jnp.int32).at[0].set(0)
+                            if pool_bounded else None),
+                leaf_flo=(jnp.zeros((L, F), jnp.int32) if use_mc_inter
+                          else None),
+                leaf_fhi=(jnp.broadcast_to(
+                    meta.num_bin.astype(jnp.int32)[None, :] - 1,
+                    (L, F)).copy() if use_mc_inter else None),
+            )
 
         def body(i, state: GrowState) -> GrowState:
             # ---- pick best leaf (ref: serial_tree_learner.cpp:229 ArgMax) --
@@ -1596,7 +1610,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 lsum=lsum, slot_map=slot_map, slot_stamp=slot_stamp,
                 slot_owner=slot_owner)
 
-        state = lax.fori_loop(0, L - 1, body, state)
+        state = lax.fori_loop(start_step, L - 1, body, state)
 
         # ---- materialize TreeArrays from the packed loop state ----------
         nodem = state.node[:L - 1]   # drop the scratch row
