@@ -61,13 +61,22 @@ if BENCH_EXTRA:
         f"{k}={v}" for k, v in sorted(BENCH_EXTRA.items()))
 
 
-def _fail_line(note: str) -> str:
+# exit codes (BENCH_*.json consumers key on "status"; the rc mirrors it):
+# 0 = result emitted; 3 = bench ran but produced no result ("slow code" /
+# child failure); 4 = device unreachable — every probe attempt failed, the
+# 0.0 value says nothing about the code under test ("hung device").
+RC_NO_RESULT = 3
+RC_DEVICE_UNREACHABLE = 4
+
+
+def _fail_line(note: str, status: str = "no_result") -> str:
     return json.dumps({
         "metric": f"higgs_synth_{N_ROWS}x{N_FEATURES}"
                   f"_iters_per_sec{_SUFFIX}",
         "value": 0.0,
         "unit": "iters/sec",
         "vs_baseline": 0.0,
+        "status": status,
         "note": note,
     })
 
@@ -312,6 +321,10 @@ def main() -> int:
     probe_ok = False
     attempts = 0
     last_err = ""
+    # timeouts and UNAVAILABLE cycling are device symptoms; a probe child
+    # that fails any other way (import error, OOM, …) is a CODE failure
+    # and must not masquerade as "hung device" (status/rc contract above)
+    probe_fail_status = "device_unreachable"
     reserve = min(max(BENCH_WATCHDOG_SEC * 0.35, 120.0),
                   BENCH_WATCHDOG_SEC * 0.5)
     while attempts == 0 or time.time() < deadline - reserve:
@@ -351,12 +364,16 @@ def main() -> int:
             time.sleep(min(30.0, max(deadline - reserve - time.time(), 0)))
             continue
         # unknown failure (import error, OOM, …): retrying won't help
+        probe_fail_status = "no_result"
         break
     if not probe_ok:
         print(_fail_line(
-            f"device unreachable after {attempts} probe attempt(s) across "
-            f"{BENCH_WATCHDOG_SEC}s window: {last_err}"), flush=True)
-        return 3
+            f"probe failed after {attempts} attempt(s) across "
+            f"{BENCH_WATCHDOG_SEC}s window: {last_err}",
+            status=probe_fail_status), flush=True)
+        return (RC_DEVICE_UNREACHABLE
+                if probe_fail_status == "device_unreachable"
+                else RC_NO_RESULT)
 
     last_note = "no scheduling mode completed"
     for i, sched in enumerate(SCHED_MODES):
@@ -389,7 +406,7 @@ def main() -> int:
         last_note = (f"sched={sched} exited rc={out.returncode} "
                      f"without a result: {out.stderr[-300:]!r}")
     print(_fail_line(last_note), flush=True)
-    return 3
+    return RC_NO_RESULT
 
 
 if __name__ == "__main__":

@@ -7,6 +7,12 @@ Dataset, Booster, train, cv, callbacks, sklearn estimators, plotting.
 (useful to run CLI/examples on a CPU host or to opt out of a busy
 accelerator); unset, jax picks its default platform.
 
+``LGBM_TPU_GUARDS=1|log|strict`` (alias ``LIGHTGBM_TPU_GUARDS``) turns
+on the dispatch guards — transfer-guard + compile logging — for ANY
+process that imports the package (bench, scripts, tests); see
+``lightgbm_tpu/analysis/guards.py`` and README "Static analysis &
+dispatch guards".
+
 ``LIGHTGBM_TPU_DEBUG_CHECKS=1`` turns on the runtime sanitizers — the
 XLA-world analogue of the reference's ASan/TSan CI builds (SURVEY §5):
 ``jax_debug_nans`` (every jitted op re-checked for NaN/Inf production,
@@ -30,6 +36,12 @@ if _os.environ.get("LIGHTGBM_TPU_DEBUG_CHECKS", "").lower() not in \
 
     _jax.config.update("jax_debug_nans", True)
     _jax.config.update("jax_check_tracer_leaks", True)
+
+# opt-in dispatch guards (no-op, and no jax import, when the env is
+# unset) — hooked here so LGBM_TPU_GUARDS audits any run, not just pytest
+from .analysis import guards as _guards
+
+_guards.install_from_env()
 
 from .basic import Booster, Dataset, LightGBMError
 from .io.sequence import Sequence

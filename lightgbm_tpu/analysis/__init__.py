@@ -1,0 +1,23 @@
+"""Static + runtime JAX-hazard analysis ("jaxlint") for the hot path.
+
+Two halves, one contract:
+
+- :mod:`.jaxlint` — pure-stdlib AST pass (rules JL001-JL005, suppression
+  comments, baseline diff). CLI: ``python scripts/jaxlint.py``.
+- :mod:`.guards` — opt-in runtime guards (compile-count budgets, transfer
+  guards, ``LGBM_TPU_GUARDS`` env toggle). Imports jax lazily; import it
+  explicitly where needed so the lint CLI never initializes a backend.
+
+See README "Static analysis & dispatch guards" for the workflow.
+"""
+from .jaxlint import (  # noqa: F401
+    Finding,
+    default_baseline_path,
+    default_targets,
+    diff_against_baseline,
+    lint_source,
+    load_baseline,
+    run_paths,
+    save_baseline,
+)
+from .rules import ALL_RULES, RULE_IDS  # noqa: F401

@@ -213,8 +213,12 @@ def _bucket_sizes(num_rows: int, min_bucket: int) -> list:
     return sizes
 
 
-def _feature_meta_scalars(pmeta: FeatureMeta, f):
+def _feature_meta_scalars(pmeta: FeatureMeta, f):  # jaxlint: disable=JL001
     """(num_bin, missing_type, default_bin) of split feature ``f``.
+
+    jaxlint JL001 suppressed for the whole helper: the np.asarray/int()
+    concretization is a TRACE-TIME probe of concrete closure constants,
+    guarded by try/except so traced metas fall through to the gather.
 
     Uniform metas (every feature shares the three values — the dense
     numerical case) fold to static constants so the partition branches

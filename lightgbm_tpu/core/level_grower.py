@@ -83,6 +83,9 @@ def make_level_grower(cfg: GrowerConfig, meta: FeatureMeta):
                 hh, a, b, c, o, meta, hp, feature_mask)
         )(hist, sg, sh, cn, out)
 
+    # jaxlint: disable=JL002 — n_d/R are static Python ints at trace time
+    # (the per-level node count and row count specialize the program; one
+    # compile per level width, cached across trees)
     def hist_blocks(binsi, gh, local, in_lvl, n_d, R):
         """[n_d, F, B, 3] per-node histograms, big-kernel formulation.
 

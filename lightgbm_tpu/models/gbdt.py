@@ -440,6 +440,10 @@ class GBDT:
         samp_state = (self.sample_strategy.rng.bit_generator.state
                       if getattr(self.sample_strategy, "rng", None)
                       is not None else None)
+        # jaxlint: disable=JL005 — async fast path: sections deliberately
+        # time DISPATCH only (a sync= barrier would serialize the very
+        # pipeline this path exists to keep sync-free; device time shows
+        # up in Tree::ToHost / GBDT::StopCheck at the batched fetches)
         with global_timer.section("GBDT::Boosting"):
             grad, hess = self._gh_fn(self.score)
             if K == 1:
@@ -494,11 +498,13 @@ class GBDT:
             if self._grow_rng is not None:
                 rng_key = jax.random.fold_in(
                     self._grow_rng, self.iter * K + k)
+            # jaxlint: disable=JL005 — dispatch-only timing, see above
             with global_timer.section("TreeLearner::Train"):
                 tree_dev, leaf_id = self._grow(
                     self._train_bins(), gh, fmask,
                     self._cegb_penalty(), rng_key)
             rate = jnp.float32(self.shrinkage_rate)
+            # jaxlint: disable=JL005 — dispatch-only timing, see above
             with global_timer.section("GBDT::UpdateScore"):
                 self.score = self._async_upd_fn(
                     self.score, tree_dev.leaf_value, tree_dev.num_leaves,

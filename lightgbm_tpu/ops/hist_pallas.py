@@ -222,6 +222,7 @@ def hist_pallas(bins_t: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
     if not ok:
         from .histogram import hist_xla
         return hist_xla(bins_t, gh, num_bin, block_rows)
+    # jaxlint: disable=JL001 — interpret is a static Python flag
     return _hist_pallas_impl(bins_t, gh, num_bin, block_rows, feature_tile,
                              bool(interpret))
 
@@ -244,5 +245,6 @@ def hist_pallas_rm(bins_rm: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
         from .histogram import hist_rowmajor
         return hist_rowmajor(bins_rm, gh, num_bin,
                              block_rows=block_rows, backend="einsum")
+    # jaxlint: disable=JL001 — interpret is a static Python flag
     return _hist_pallas_impl(bins_rm.T, gh, num_bin, block_rows,
                              feature_tile, bool(interpret))

@@ -189,6 +189,8 @@ def meta_has_categorical(meta: FeatureMeta) -> bool:
     """Trace-time check whether any feature is categorical (meta arrays are
     concrete closure constants in every grower build path)."""
     try:
+        # jaxlint: disable=JL001 — trace-time probe; except arm covers
+        # traced metas
         return bool(np.any(np.asarray(meta.is_categorical)))
     except Exception:
         return True  # traced — keep the categorical path
@@ -291,6 +293,8 @@ def _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
     # split loop's fixed cost on TPU is its op count; meta arrays are
     # concrete closure constants in every grower build path.
     try:
+        # jaxlint: disable=JL001 — trace-time probe of concrete closure
+        # constants; the except arm keeps traced metas correct
         static_fwd_dead = bool(
             np.all(np.asarray(meta.missing_type) == MISSING_ENUM["none"]))
     except Exception:

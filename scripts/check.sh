@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# One-command repo gate: static analysis + structural lints + tier-1 tests.
+#
+#   bash scripts/check.sh            # everything (tier-1 takes minutes)
+#   bash scripts/check.sh --fast     # lints only (seconds, no jax)
+#
+# Mirrors the reference repo's lint-gates-CI model: jaxlint (JAX hazards
+# JL001-JL005 vs jaxlint_baseline.json), r_lint (R-package structural
+# gate), then the tier-1 pytest suite on CPU. Fails on the first gate
+# that fails; the jaxlint new-finding count also appears in the pytest
+# header (tests/conftest.py) so the verify log carries it either way.
+set -u
+cd "$(dirname "$0")/.."
+
+rc=0
+
+echo "== jaxlint (JAX-hazard static analysis) =="
+python scripts/jaxlint.py || rc=1
+
+echo "== r_lint (R-package structural gate) =="
+python scripts/r_lint.py || rc=1
+
+if [ "${1:-}" = "--fast" ]; then
+    exit $rc
+fi
+if [ $rc -ne 0 ]; then
+    echo "check.sh: lint gate failed — skipping tier-1 pytest" >&2
+    exit $rc
+fi
+
+echo "== tier-1 pytest (CPU) =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider || rc=1
+
+exit $rc

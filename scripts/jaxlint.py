@@ -1,0 +1,37 @@
+"""JAX-hazard static analysis CLI (rules JL001-JL005).
+
+Thin wrapper over lightgbm_tpu.analysis.jaxlint — pure stdlib, no jax
+import, so it runs anywhere in a few seconds (same gate model as
+scripts/r_lint.py: CI-cheap, zero hardware).
+
+Usage:
+  python scripts/jaxlint.py                   # diff against the baseline
+  python scripts/jaxlint.py --list            # also print known findings
+  python scripts/jaxlint.py --update-baseline # accept current findings
+  python scripts/jaxlint.py path/to/file.py   # lint specific paths
+
+Exit 0: no new findings vs jaxlint_baseline.json. Exit 1: new findings
+(or syntax errors). Suppress a deliberate hazard in source with
+`# jaxlint: disable=JL00x` plus a reason.
+"""
+import importlib.util
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG_DIR = os.path.join(REPO_ROOT, "lightgbm_tpu", "analysis")
+
+# Load the analysis package by file path, NOT via `import lightgbm_tpu`:
+# the package root's __init__ imports jax (guards hook, Booster surface),
+# and this CLI must run on jax-free images and never touch a wedged
+# accelerator tunnel.
+_spec = importlib.util.spec_from_file_location(
+    "_jaxlint_analysis", os.path.join(_PKG_DIR, "__init__.py"),
+    submodule_search_locations=[_PKG_DIR])
+_pkg = importlib.util.module_from_spec(_spec)
+sys.modules["_jaxlint_analysis"] = _pkg
+_spec.loader.exec_module(_pkg)
+jaxlint = importlib.import_module("_jaxlint_analysis.jaxlint")
+
+if __name__ == "__main__":
+    sys.exit(jaxlint.main(root=REPO_ROOT))

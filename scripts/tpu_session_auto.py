@@ -152,8 +152,13 @@ def pick_flips(base: float, pallas: float, packed: float,
 
 
 def unreachable(res: dict | None) -> bool:
-    return res is None or (res.get("value", 1) == 0 and
-                           "unreachable" in str(res.get("note", "")))
+    if res is None:
+        return True
+    if "status" in res:  # bench.py structured status (rc=4 companion)
+        return res["status"] == "device_unreachable"
+    # pre-status payloads (BENCH_r05.json and earlier): note text only
+    return (res.get("value", 1) == 0 and
+            "unreachable" in str(res.get("note", "")))
 
 
 TUNED_PATH = os.path.join(REPO, "lightgbm_tpu", "TUNED.json")

@@ -36,6 +36,85 @@ enable_persistent_cache()
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+from lightgbm_tpu.analysis import guards as _guards  # noqa: E402
+
+# Opt-in runtime dispatch guards (LGBM_TPU_GUARDS=1|log|strict): transfer
+# guard + jax_log_compiles for the whole test process, so any tier-1 run
+# can be audited for silent host round-trips without code changes.
+# (lightgbm_tpu/__init__.py already installs them at import; this call is
+# a deliberate second anchor in case the import-time hook ever moves.)
+_guards.install_from_env()
+
+
+_JAXLINT_STATUS = None
+
+
+def _wants_jaxlint_status(config) -> bool:
+    """Pay the ~5 s repo-wide AST scan only for suite-level invocations
+    (directory args, as the tier-1 verify command passes `tests/`) —
+    single-file / single-test dev runs skip it. LGBM_TPU_JAXLINT_STATUS
+    =1/0 forces it on/off."""
+    forced = os.environ.get("LGBM_TPU_JAXLINT_STATUS")
+    if forced is not None:
+        return forced.strip().lower() not in ("", "0", "false", "off",
+                                              "no")
+    args = getattr(config, "args", None) or []
+    return all(os.path.isdir(a) for a in args)
+
+
+def _jaxlint_status() -> str:
+    """One-line jaxlint state (pure stdlib AST pass over the package,
+    a few seconds; memoized so header + terminal summary share one scan)."""
+    global _JAXLINT_STATUS
+    if _JAXLINT_STATUS is not None:
+        return _JAXLINT_STATUS
+    try:
+        from lightgbm_tpu.analysis import (default_baseline_path,
+                                           default_targets,
+                                           diff_against_baseline,
+                                           load_baseline, run_paths)
+        root = os.path.join(os.path.dirname(__file__), "..")
+        findings = run_paths(default_targets(root), root)
+        # JL000 syntax errors are never baselined — count them as new so
+        # this line agrees with the scripts/jaxlint.py gate's exit code
+        baseline = load_baseline(default_baseline_path(root))
+        new, known = diff_against_baseline(findings, baseline)
+        _JAXLINT_STATUS = (f"jaxlint: {len(new)} new finding(s), "
+                           f"{len(known)} known (baselined)")
+    except Exception as e:  # never break a test run over a lint status
+        _JAXLINT_STATUS = f"jaxlint: status unavailable ({e!r})"
+    return _JAXLINT_STATUS
+
+
+def pytest_report_header(config):
+    if not _wants_jaxlint_status(config):
+        return None
+    return _jaxlint_status()
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    # also emit at the END of the run: the tier-1 verify log is tailed,
+    # and `-q` suppresses the report header
+    if _wants_jaxlint_status(config):
+        terminalreporter.write_line(_jaxlint_status())
+
+
+@pytest.fixture
+def compile_budget():
+    """Compile-count budget guard (lightgbm_tpu.analysis.guards).
+
+    Usage::
+
+        def test_steady_state(compile_budget):
+            ...warmup...
+            with compile_budget(2, "train x5"):
+                for _ in range(5):
+                    booster.update()
+
+    Raises CompileBudgetExceeded (an AssertionError) when the block
+    compiles more than the budgeted number of programs."""
+    return _guards.compile_budget
+
 
 @pytest.fixture
 def rng():

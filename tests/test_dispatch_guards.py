@@ -1,0 +1,105 @@
+"""Runtime dispatch guards (lightgbm_tpu.analysis.guards).
+
+The compile-count regression test is the runtime half of the jaxlint
+contract: a warmed-up training loop must NOT recompile per iteration.
+It guards the level-grower steady-state win from the round-5 A/B session
+(one compile per level width, cached across trees) and the leaf-wise
+default alike — a regression that reintroduces per-iteration retraces
+fails the budget instead of silently running 100x slow on TPU.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.analysis import guards
+
+
+def _data(seed=5, n=2000, f=8):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] +
+         0.1 * rng.normal(size=n) > 0).astype(np.float32)
+    return X, y
+
+
+@pytest.mark.parametrize("sched", ["leaf", "level"])
+def test_train_one_iter_steady_state_compile_budget(compile_budget, sched):
+    """5 post-warmup iterations of GBDT.train_one_iter stay within a
+    2-compile budget (steady state is 0; the slack absorbs one-off eager
+    primitives from host-side bookkeeping, never a per-iteration jit)."""
+    X, y = _data()
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "tpu_row_scheduling": sched}
+    booster = lgb.Booster(params, lgb.Dataset(X, label=y))
+    for _ in range(3):  # warmup: trace + compile the training programs
+        booster.update()
+    with compile_budget(2, f"train_one_iter x5 [{sched}]"):
+        for _ in range(5):
+            booster.update()
+
+
+def test_compile_budget_fails_a_deliberately_recompiling_loop(
+        compile_budget):
+    """A loop that retraces every pass (fresh shape each iteration) must
+    blow the budget — this is the CI tripwire the fixture exists for."""
+    f = jax.jit(lambda x: x * 2.0)
+    f(jnp.ones(4)).block_until_ready()  # warmup
+    with pytest.raises(guards.CompileBudgetExceeded) as exc:
+        with compile_budget(1, "shape sweep"):
+            for n in range(5, 10):  # 5 distinct shapes -> 5 retraces
+                f(jnp.ones(n)).block_until_ready()
+    assert "compile budget exceeded" in str(exc.value)
+    assert "shape sweep" in str(exc.value)
+
+
+def test_compile_counter_warm_cache_counts_zero():
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.ones(7)
+    f(x).block_until_ready()
+    with guards.CompileCounter() as counter:
+        f(x).block_until_ready()
+    assert counter.count == 0, counter.names
+
+
+def test_compile_counter_restores_logger_state():
+    import logging
+    lg = logging.getLogger("jax._src.dispatch")
+    level, prop, n_handlers = lg.level, lg.propagate, len(lg.handlers)
+    with guards.CompileCounter():
+        pass
+    assert (lg.level, lg.propagate, len(lg.handlers)) == \
+        (level, prop, n_handlers)
+
+
+def test_no_implicit_transfers_allows_explicit_fetch():
+    """Explicit materialization (jax.device_get) stays allowed under the
+    guard — the deliberate fetch points in models/gbdt.py go through
+    device_get and must keep working. np.asarray on a device array is
+    NOT safe under strict mode (jax counts __array__ as implicit); here
+    it only touches the numpy array device_get returned. (The
+    implicit-transfer RAISE only manifests on a real accelerator
+    backend; on the CPU backend arrays are already host-resident, so
+    this is a smoke test there.)"""
+    a = jnp.arange(4, dtype=jnp.float32)
+    with guards.no_implicit_transfers():
+        host = np.asarray(jax.device_get(a))
+    np.testing.assert_array_equal(host, np.arange(4, dtype=np.float32))
+
+
+def test_guard_mode_env_parsing():
+    # LIGHTGBM_TPU_GUARDS aliases the toggle under the package's
+    # established env prefix; the short name wins when both are set
+    assert guards.guard_mode({"LIGHTGBM_TPU_GUARDS": "strict"}) == \
+        "disallow"
+    assert guards.guard_mode({"LGBM_TPU_GUARDS": "log",
+                              "LIGHTGBM_TPU_GUARDS": "strict"}) == "log"
+    assert guards.guard_mode({"LGBM_TPU_GUARDS": "1"}) == "log"
+    assert guards.guard_mode({"LGBM_TPU_GUARDS": "log"}) == "log"
+    assert guards.guard_mode({"LGBM_TPU_GUARDS": "strict"}) == "disallow"
+    assert guards.guard_mode({"LGBM_TPU_GUARDS": "2"}) == "disallow"
+    assert guards.guard_mode({"LGBM_TPU_GUARDS": "0"}) is None
+    assert guards.guard_mode({"LGBM_TPU_GUARDS": "off"}) is None
+    assert guards.guard_mode({}) is None

@@ -12,6 +12,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -33,12 +34,73 @@ def sess():
 
 def test_unreachable_matches_bench_fail_contract(sess):
     assert sess.unreachable(None)
+    # structured status (bench.py rc=4 companion) wins over note text —
+    # rewording the note must not break detection
+    assert sess.unreachable({"value": 0.0, "status": "device_unreachable",
+                             "note": "tunnel gave up"})
+    assert not sess.unreachable({"value": 0.0, "status": "no_result",
+                                 "note": "device unreachable-sounding"})
+    # pre-status payloads (BENCH_r05.json and earlier): note fallback
     assert sess.unreachable({"value": 0.0, "note": "device unreachable "
                              "after 2 probe attempt(s)"})
     # a 0.0 from a non-device failure is a failure but not window-closed
     assert not sess.unreachable({"value": 0.0, "note": "sched=compact "
                                  "exited rc=1"})
     assert not sess.unreachable({"value": 2.5, "vs_baseline": 0.06})
+
+
+def test_bench_fail_line_carries_status_and_distinct_rcs():
+    """bench.py's JSON fail line must let consumers tell "hung device"
+    (status=device_unreachable, rc=4) from "slow code / child failure"
+    (status=no_result, rc=3) — the ISSUE-1 satellite contract."""
+    bench = _load_bench_mod()
+    assert bench.RC_DEVICE_UNREACHABLE == 4
+    assert bench.RC_NO_RESULT == 3
+    assert bench.RC_DEVICE_UNREACHABLE != bench.RC_NO_RESULT
+    unreach = json.loads(bench._fail_line("probe died",
+                                          status="device_unreachable"))
+    assert unreach["status"] == "device_unreachable"
+    assert unreach["value"] == 0.0
+    default = json.loads(bench._fail_line("child rc=1"))
+    assert default["status"] == "no_result"
+
+
+def _load_bench_mod():
+    spec = importlib.util.spec_from_file_location(
+        "bench_probe_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_probe_failure_classification(monkeypatch, capsys):
+    """Only device symptoms (probe timeouts / UNAVAILABLE cycling) may
+    report status=device_unreachable rc=4; a probe child that dies of a
+    code failure (import error, OOM) is status=no_result rc=3 so the
+    session watcher doesn't count a code bug toward window closure."""
+    bench = _load_bench_mod()
+    bench.BENCH_WATCHDOG_SEC = 1  # reserve=0.5s -> tiny retry window
+
+    def timing_out(env_extra, timeout):
+        # consume the whole retry window so exactly one attempt runs
+        # (a real timed-out probe has eaten its slot by definition)
+        time.sleep(0.6)
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+    monkeypatch.setattr(bench, "_spawn", timing_out)
+    rc = bench.main()
+    res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == bench.RC_DEVICE_UNREACHABLE == 4
+    assert res["status"] == "device_unreachable"
+
+    def code_failure(env_extra, timeout):
+        return subprocess.CompletedProcess(
+            args=["probe"], returncode=1, stdout="",
+            stderr="ImportError: cannot import name 'grower'")
+    monkeypatch.setattr(bench, "_spawn", code_failure)
+    rc = bench.main()
+    res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == bench.RC_NO_RESULT == 3
+    assert res["status"] == "no_result"
 
 
 def test_flip_never_ships_a_measured_losing_composition(sess):
