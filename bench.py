@@ -264,6 +264,11 @@ def _apply_platform_override() -> None:
 def run_probe() -> None:
     """Tiny end-to-end sanity: device claim + a small jitted train step."""
     _apply_platform_override()
+    # fault harness hook: LGBM_TPU_FAULTS=probe_timeout (inherited via
+    # env) makes this child fail with the UNAVAILABLE signature, so the
+    # parent's shared retry policy is testable without a flaky device
+    from lightgbm_tpu.robustness import faults
+    faults.maybe_fail("probe_timeout")
     from lightgbm_tpu.utils.jit_cache import enable_persistent_cache
     enable_persistent_cache()
     import jax
@@ -309,71 +314,100 @@ def main() -> int:
     # Stage 0: establish the device is reachable — retrying ACROSS the bench
     # window instead of dying on the first failed probe (round-3 postmortem:
     # one 420 s probe attempt turned a recovering tunnel into a 0.0 bench).
+    # The retry loop itself is the SHARED policy from
+    # lightgbm_tpu/robustness/retry.py (bounded attempts, decorrelated
+    # jitter, deadline): rc=4 device_unreachable is only ever reported
+    # after that policy's budget is exhausted, the same contract
+    # init_distributed and the injected collectives run under.
     #
     # The documented recovery signature (docs/TPU_RUNBOOK.md) is a probe that
     # errors with "UNAVAILABLE: TPU backend setup/compile error" — that means
-    # the backend is cycling and a LATER claim may succeed, so it must be
-    # retried, not treated as terminal. Killing a claim-WAITER at its slot
+    # the backend is cycling and a LATER claim may succeed, so it is
+    # classified transient and retried. Killing a claim-WAITER at its slot
     # deadline is benign (the machine-wide wedge comes from killing a client
     # that HOLDS the grant mid-compile; probing first is what avoids that).
     # We reserve ~35% of the watchdog for the measurement itself: a probe
     # succeeding with less than that leaves no room to compile+run anyway.
-    probe_ok = False
-    attempts = 0
-    last_err = ""
-    # timeouts and UNAVAILABLE cycling are device symptoms; a probe child
-    # that fails any other way (import error, OOM, …) is a CODE failure
-    # and must not masquerade as "hung device" (status/rc contract above)
-    probe_fail_status = "device_unreachable"
+    from lightgbm_tpu.robustness.retry import (RetryError, RetryPolicy,
+                                               retry_call)
+
     reserve = min(max(BENCH_WATCHDOG_SEC * 0.35, 120.0),
                   BENCH_WATCHDOG_SEC * 0.5)
-    while attempts == 0 or time.time() < deadline - reserve:
-        attempts += 1
+    class _ProbeCodeFailure(Exception):
+        """Probe child failed in a non-device way (import error, OOM,
+        …) — NOT transient: retrying won't help and the 0.0 must not
+        masquerade as "hung device" (status/rc contract above)."""
+
+    from lightgbm_tpu.robustness.retry import is_transient_error
+
+    def _probe_classifier(exc: BaseException) -> bool:
+        # a code failure is terminal even if the embedded stderr tail
+        # happens to contain a substring the generic classifier would
+        # match ("timed out" in some unrelated traceback)
+        if isinstance(exc, _ProbeCodeFailure):
+            return False
+        return is_transient_error(exc)
+
+    policy = RetryPolicy(
+        max_attempts=int(os.environ.get("BENCH_PROBE_ATTEMPTS", "6")),
+        base_delay=5.0, max_delay=30.0,
+        deadline=max(BENCH_WATCHDOG_SEC - reserve, 1.0),
+        classifier=_probe_classifier)
+
+    state = {"attempts": 0}
+
+    def probe_attempt() -> None:
+        state["attempts"] += 1
         budget = deadline - reserve - time.time()
-        if attempts == 1:
+        if state["attempts"] == 1:
             # fast-fail slot: a healthy tunnel answers in seconds
-            probe_slot = max(min(BENCH_PROBE_SEC, budget), 30.0)
+            slot = max(min(BENCH_PROBE_SEC, budget), 30.0)
         else:
             # patient slot: the documented recovery signature is a claim
             # that waits ~1500 s then errors UNAVAILABLE — only a probe
-            # allowed to wait that long can ever surface it, so the retry
-            # gets the whole remaining pre-reserve budget (one patient
+            # allowed to wait that long can ever surface it, so retries
+            # get the whole remaining pre-reserve budget (one patient
             # single-client probe, never stacked)
-            probe_slot = max(budget, 30.0)
+            slot = max(budget, 30.0)
         try:
-            probe = _spawn({"_LGBM_BENCH_PROBE": "1"}, probe_slot)
+            probe = _spawn({"_LGBM_BENCH_PROBE": "1"}, slot)
         except subprocess.TimeoutExpired as e:
             _dump_timeout_streams(e)
-            last_err = f"probe attempt {attempts} timed out ({probe_slot:.0f}s)"
-            sys.stderr.write(f"[bench] {last_err}; retrying\n")
-            continue
+            raise TimeoutError(
+                f"probe attempt {state['attempts']} timed out "
+                f"({slot:.0f}s)")
         if '"probe_ok"' in probe.stdout:
-            probe_ok = True
             sys.stderr.write(
-                f"[bench] probe ok (attempt {attempts}): "
+                f"[bench] probe ok (attempt {state['attempts']}): "
                 f"{probe.stdout.strip()[:200]}\n")
-            break
-        tail = probe.stderr[-300:]
-        last_err = f"probe attempt {attempts} rc={probe.returncode}: {tail!r}"
+            return
         sys.stderr.write(probe.stderr[-2000:])
+        tail = probe.stderr[-300:]
         if "UNAVAILABLE" in probe.stderr:
-            # known recovery signature — backend cycling, retry after a
-            # short breather (the failed probe already waited its share)
-            sys.stderr.write(
-                "[bench] UNAVAILABLE recovery signature — retrying\n")
-            time.sleep(min(30.0, max(deadline - reserve - time.time(), 0)))
-            continue
-        # unknown failure (import error, OOM, …): retrying won't help
-        probe_fail_status = "no_result"
-        break
-    if not probe_ok:
+            # known recovery signature — transient, policy will retry
+            raise RuntimeError(
+                f"UNAVAILABLE: probe attempt {state['attempts']} "
+                f"rc={probe.returncode}: {tail!r}")
+        raise _ProbeCodeFailure(
+            f"probe attempt {state['attempts']} "
+            f"rc={probe.returncode}: {tail!r}")
+
+    try:
+        retry_call(probe_attempt, policy=policy,
+                   what="bench device probe")
+    except RetryError as e:
+        # transient failures exhausted the shared policy → honest
+        # device symptom (rc=4), reported only after the deadline
         print(_fail_line(
-            f"probe failed after {attempts} attempt(s) across "
-            f"{BENCH_WATCHDOG_SEC}s window: {last_err}",
-            status=probe_fail_status), flush=True)
-        return (RC_DEVICE_UNREACHABLE
-                if probe_fail_status == "device_unreachable"
-                else RC_NO_RESULT)
+            f"probe failed after {e.attempts} attempt(s) across "
+            f"{BENCH_WATCHDOG_SEC}s window: {e.last!r}",
+            status="device_unreachable"), flush=True)
+        return RC_DEVICE_UNREACHABLE
+    except _ProbeCodeFailure as e:
+        print(_fail_line(
+            f"probe failed (code failure, not retried): {e}",
+            status="no_result"), flush=True)
+        return RC_NO_RESULT
 
     last_note = "no scheduling mode completed"
     for i, sched in enumerate(SCHED_MODES):

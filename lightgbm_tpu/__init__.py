@@ -43,9 +43,17 @@ from .analysis import guards as _guards
 
 _guards.install_from_env()
 
+# opt-in fault injection (LGBM_TPU_FAULTS, robustness/faults.py) — the
+# chaos counterpart of the guards: any importing process (bench, CLI,
+# tests, worker subprocesses) runs under the injected fault plan
+from .robustness import faults as _faults
+
+_faults.install_from_env()
+
 from .basic import Booster, Dataset, LightGBMError
 from .io.sequence import Sequence
-from .callback import (EarlyStopException, early_stopping, log_evaluation,
+from .callback import (EarlyStopException, checkpoint_callback,
+                       early_stopping, log_evaluation,
                        record_evaluation, reset_parameter)
 from .engine import CVBooster, cv, train
 from .utils.log import register_logger
@@ -56,7 +64,7 @@ __all__ = [
     "Dataset", "Booster", "LightGBMError", "Sequence",
     "train", "cv", "CVBooster",
     "early_stopping", "log_evaluation", "record_evaluation",
-    "reset_parameter", "EarlyStopException",
+    "reset_parameter", "EarlyStopException", "checkpoint_callback",
     "register_logger",
 ]
 

@@ -1005,12 +1005,17 @@ class Booster:
     # -- model IO -------------------------------------------------------
     def save_model(self, filename, num_iteration: Optional[int] = None,
                    start_iteration: int = 0,
-                   importance_type: str = "split") -> "Booster":
+                   importance_type: str = "split",
+                   atomic: bool = False) -> "Booster":
+        """``atomic=True`` routes through the crash-safe writer
+        (robustness/checkpoint.py: tmp + fsync + rename) — a kill
+        mid-write can never leave a torn model file."""
         from .io.model_io import save_model_file
         save_model_file(self._engine, self.config, str(filename),
                         num_iteration=num_iteration,
                         start_iteration=start_iteration,
-                        importance_type=importance_type)
+                        importance_type=importance_type,
+                        atomic=atomic)
         return self
 
     def model_to_string(self, num_iteration: Optional[int] = None,

@@ -76,6 +76,22 @@ def _load_train_data(cfg: Config, params: Dict) -> Tuple[Dataset,
     return train_set, valid_sets, valid_names
 
 
+def _prune_snapshots(out_model: str, keep_last: int) -> None:
+    """Keep only the newest ``keep_last`` snapshot_iter files (the
+    reference accumulates snapshots forever; config snapshot_keep_last
+    bounds the disk footprint). Tmp litter from killed atomic writes is
+    cleaned up too (one shared sweep: robustness.checkpoint)."""
+    import os
+    import re
+
+    from .robustness.checkpoint import prune_numbered
+    prune_numbered(
+        os.path.dirname(os.path.abspath(out_model)),
+        re.compile(re.escape(os.path.basename(out_model)) +
+                   r"\.snapshot_iter_(\d+)$"),
+        keep_last)
+
+
 def task_train(cfg: Config, params: Dict) -> None:
     """ref: application.cpp InitTrain/Train."""
     train_set, valid_sets, valid_names = _load_train_data(cfg, params)
@@ -92,11 +108,17 @@ def task_train(cfg: Config, params: Dict) -> None:
         callbacks.append(log_evaluation(period=int(cfg.metric_freq)))
     if cfg.snapshot_freq > 0:
         out_model = cfg.output_model
+        keep_last = max(int(cfg.snapshot_keep_last), 1)
 
         def _snapshot(env):
             it = env.iteration + 1
             if it % cfg.snapshot_freq == 0:
-                env.model.save_model(f"{out_model}.snapshot_iter_{it}")
+                # atomic write: a kill mid-write used to leave a torn
+                # snapshot that input_model could not load; now the
+                # previous snapshot survives any crash point
+                env.model.save_model(f"{out_model}.snapshot_iter_{it}",
+                                     atomic=True)
+                _prune_snapshots(out_model, keep_last)
         _snapshot.order = 100
         callbacks.append(_snapshot)
 
@@ -204,6 +226,12 @@ def run(argv: List[str]) -> int:
         if str(cfg.device_type).lower() == "cpu":
             import jax
             jax.config.update("jax_platforms", "cpu")
+        elif cfg.tpu_fallback_to_cpu:
+            # graceful degradation: probe the device under the shared
+            # retry policy; a terminal failure pins CPU (loud warning)
+            # instead of wedging/aborting the task
+            from .robustness.retry import ensure_device_or_fallback
+            ensure_device_or_fallback(fallback=True)
         task = _TASKS.get(cfg.task)
         if task is None:
             raise LightGBMError(

@@ -125,6 +125,9 @@ _reg("input_model", str, "", ("model_input", "model_in"))
 _reg("output_model", str, "LightGBM_model.txt", ("model_output", "model_out"))
 _reg("saved_feature_importance_type", int, 0, ())
 _reg("snapshot_freq", int, -1, ("save_period",))
+# how many snapshot_freq snapshots the CLI keeps on disk (oldest are
+# pruned; the reference accumulates forever)
+_reg("snapshot_keep_last", int, 5, (), (1, None, True, False))
 _reg("use_quantized_grad", bool, False, ())
 _reg("num_grad_quant_bins", int, 4, ())
 _reg("quant_train_renew_leaf", bool, False, ())
@@ -272,6 +275,12 @@ _reg("tpu_predict_device", bool, False, ())  # batched device prediction
 # Set to a directory to capture a jax.profiler trace of the training loop
 # (view with tensorboard or xprof).
 _reg("tpu_profile_dir", str, "", ())
+# graceful degradation (robustness/retry.py): when the accelerator
+# never comes up — device probe still failing after the shared retry
+# policy's attempts and deadline — fall back to CPU with a loud warning
+# instead of aborting the run. Off by default: silent 100x slowdowns
+# must be opted into.
+_reg("tpu_fallback_to_cpu", bool, False, ())
 
 # objective alias names accepted for each canonical objective
 OBJECTIVE_ALIASES = {

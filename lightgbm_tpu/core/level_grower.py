@@ -75,7 +75,16 @@ def make_level_grower(cfg: GrowerConfig, meta: FeatureMeta):
     # level is then ~4 large batched kernels instead of a scatter —
     # the MXU-friendly shape (docs/TPU_RUNBOOK.md round-6 design).
     use_blocks = cfg.hist_rm_backend != "scatter"
-    rm_backend = cfg.hist_rm_backend
+    # ADVICE r05: blocks mode runs the row-major kernel under vmap with
+    # masked edge windows as small as bs=256 — a combination the pallas
+    # kernel has never been device-measured on (CPU tests cover only
+    # scatter/einsum; the r05 device A/B pinned einsum on both arms). A
+    # batching or small-block defect would corrupt level histograms
+    # silently, so every non-scatter backend maps to einsum here until
+    # pallas-under-level has device A/B coverage. Blocks mode already
+    # treats all non-scatter backends identically in shape/scheduling,
+    # so this changes the kernel only, not the algorithm.
+    rm_backend = "einsum" if use_blocks else cfg.hist_rm_backend
 
     def scan_level(hist, sg, sh, cn, out, feature_mask):
         return jax.vmap(

@@ -20,6 +20,11 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _f32_round(arr32: np.ndarray) -> np.ndarray:
+    """Widen an f32 result back to the f64 storage dtype (exact)."""
+    return arr32.astype(np.float64)
+
+
 class TreeArrays(NamedTuple):
     """One tree. Internal-node arrays have length L-1, leaf arrays L."""
     # internal nodes
@@ -158,11 +163,22 @@ class HostTree:
         return self
 
     def shrink(self, rate: float) -> None:
-        """ref: tree.h Tree::Shrinkage (scales linear consts/coeffs too)."""
-        self.leaf_value = self.leaf_value * rate
-        self.internal_value = self.internal_value * rate
+        """ref: tree.h Tree::Shrinkage (scales linear consts/coeffs too).
+
+        The product rounds through f32: the f32 score accumulator adds
+        ``f32(leaf_value) * f32(rate)`` (models/gbdt.py sync and async
+        score updates), so the STORED value must be that exact product —
+        an f64 product that rounds differently by one ulp makes a
+        replayed model (init_model / checkpoint resume) diverge from the
+        live score and eventually flip near-tie splits."""
+        self.leaf_value = _f32_round(
+            self.leaf_value.astype(np.float32) * np.float32(rate))
+        self.internal_value = _f32_round(
+            self.internal_value.astype(np.float32) * np.float32(rate))
         self.shrinkage *= rate
         if self.is_linear:
+            # linear terms predict in f64 from raw features; keep full
+            # precision (the linear path has no async/replay counterpart)
             self.leaf_const = self.leaf_const * rate
             self.leaf_coeff = [c * rate for c in self.leaf_coeff]
 
@@ -176,9 +192,16 @@ class HostTree:
 
     def add_bias(self, val: float) -> None:
         """ref: tree.cpp Tree::AddBias — folds the boost-from-average init
-        score into the first tree so the saved model is self-contained."""
-        self.leaf_value = self.leaf_value + val
-        self.internal_value = self.internal_value + val
+        score into the first tree so the saved model is self-contained.
+
+        Rounds through f32 for the same replay-exactness reason as
+        :meth:`shrink`: the live score received ``f32(bias)`` and
+        ``f32(leaf_value)`` as separate f32 adds, so the folded stored
+        value must be the f32 sum of those two f32 terms."""
+        self.leaf_value = _f32_round(
+            self.leaf_value.astype(np.float32) + np.float32(val))
+        self.internal_value = _f32_round(
+            self.internal_value.astype(np.float32) + np.float32(val))
         if self.is_linear:
             self.leaf_const = self.leaf_const + val
 

@@ -52,11 +52,35 @@ def init_distributed(coordinator_address: Optional[str] = None,
     if _initialized:
         log.warning("init_distributed called twice; ignoring")
         return jax.process_index()
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids)
+    # joining the world is the single most failure-prone call of a
+    # multi-host run (coordinator not up yet, DNS hiccup, tunnel
+    # cycling UNAVAILABLE) — retry under the shared device policy
+    # instead of dying on the first connection failure
+    import os
+
+    from .robustness.retry import DEVICE_POLICY, retry_call
+
+    def _attempt():
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                local_device_ids=local_device_ids)
+        except BaseException:
+            # a failed connect leaves jax's global client/service
+            # state set, and a second initialize would then raise the
+            # NON-transient "should only be called once" RuntimeError —
+            # reset so the next attempt is a real attempt
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001 — best-effort reset
+                pass
+            raise
+
+    retry_call(_attempt,
+               policy=DEVICE_POLICY.from_env_overrides(os.environ),
+               what="jax.distributed.initialize")
     _initialized = True
     n = jax.process_count()
     log.info(f"Distributed world initialized: process "
@@ -257,6 +281,42 @@ def injected_collectives():
     return _injected
 
 
+def retried_collective(fn, arr, what: str = "injected collective"):
+    """Drive one injected-collective call under the shared retry policy.
+
+    Every cross-worker reduction routes through here, so this is THE
+    choke point for transport flakiness: each attempt first consults
+    the fault harness (LGBM_TPU_FAULTS ``collective`` class), then runs
+    the user transport; transient failures — injected or real — are
+    retried under the bounded COLLECTIVE_POLICY (LGBM_TPU_RETRY_* env
+    overrides apply). The fault check sits INSIDE the retried attempt:
+    a fired fault means "this attempt's request was lost", exactly like
+    a dropped packet, and the retry must re-drive the whole operation.
+
+    Retry-safety contract for user transports: a failing ``fn`` must
+    fail ATOMICALLY — before any peer could observe the operation —
+    because a retry re-drives it from scratch. A transport that can
+    fail after partially synchronizing peers (e.g. after releasing a
+    barrier generation) must make its own call idempotent or fence the
+    retry itself; the harness's injected faults model the
+    request-lost case, which every barrier/rendezvous transport
+    handles naturally.
+    """
+    import os
+
+    from .robustness import faults
+    from .robustness.retry import COLLECTIVE_POLICY, retry_call
+
+    def attempt():
+        faults.maybe_fail("collective")
+        return fn(arr)
+
+    return retry_call(
+        attempt,
+        policy=COLLECTIVE_POLICY.from_env_overrides(os.environ),
+        what=what)
+
+
 def make_injected_hooks():
     """Grower hooks wrapping the injected callables via io_callback
     (ordered: comm calls must run exactly once per step, in program
@@ -272,14 +332,17 @@ def make_injected_hooks():
     inj = _injected
 
     def _host_sum(a):
-        out = inj["reduce_sum"](np.asarray(a))
+        out = retried_collective(inj["reduce_sum"], np.asarray(a),
+                                 what="injected reduce_sum")
         return np.asarray(out, a.dtype).reshape(a.shape)
 
     def _host_max(a):
         fn = inj["reduce_max"]
         if fn is None:
             return np.asarray(a)
-        return np.asarray(fn(np.asarray(a)), a.dtype).reshape(a.shape)
+        out = retried_collective(fn, np.asarray(a),
+                                 what="injected reduce_max")
+        return np.asarray(out, a.dtype).reshape(a.shape)
 
     def _io(fn, x):
         return io_callback(fn, jax.ShapeDtypeStruct(x.shape, x.dtype),

@@ -29,8 +29,19 @@ def train(params: Dict[str, Any], train_set: Dataset,
           valid_names: Optional[List[str]] = None,
           feval=None, init_model: Optional[Union[str, Booster]] = None,
           keep_training_booster: bool = False,
-          callbacks: Optional[List[Callable]] = None) -> Booster:
-    """Train one model (ref: engine.py:109)."""
+          callbacks: Optional[List[Callable]] = None,
+          resume_from: Optional[str] = None) -> Booster:
+    """Train one model (ref: engine.py:109).
+
+    ``resume_from``: directory of checkpoints written by
+    ``callback.checkpoint_callback``. The newest CRC-valid checkpoint
+    is loaded (corrupt/partial files are skipped with a warning) and
+    training continues from its iteration; ``num_boost_round`` is the
+    TOTAL round target, so the same ``train(...)`` call can be re-run
+    verbatim after a crash and it finishes the originally requested
+    run. With no valid checkpoint in the directory, training starts
+    fresh. See README "Fault tolerance & checkpointing".
+    """
     params = copy.deepcopy(params) if params else {}
     # resolve num_boost_round aliases (ref: engine.py:149-160)
     for alias in _ConfigAliases.get("num_iterations"):
@@ -60,6 +71,33 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     if not isinstance(train_set, Dataset):
         raise TypeError("train() only accepts Dataset object")
+
+    # graceful degradation: with tpu_fallback_to_cpu, prove the device
+    # is reachable (under the shared retry policy) BEFORE any dataset
+    # construction touches the backend; on terminal failure the run
+    # continues on CPU with a loud warning instead of aborting
+    if str(params.get("tpu_fallback_to_cpu", "")).lower() in \
+            ("1", "true", "yes", "on"):
+        from .robustness.retry import ensure_device_or_fallback
+        ensure_device_or_fallback(fallback=True)
+
+    # crash recovery: newest valid checkpoint wins over init_model
+    resumed_state = None
+    if resume_from:
+        from .robustness.checkpoint import latest_valid_checkpoint
+        found = latest_valid_checkpoint(resume_from)
+        if found is not None:
+            ckpt_path, resumed_state = found
+            if init_model is not None:
+                log.warning("resume_from checkpoint found; ignoring "
+                            "init_model")
+            init_model = Booster(model_str=resumed_state["model"])
+            log.info(f"Resuming from checkpoint {ckpt_path} "
+                     f"(iteration {resumed_state['iteration']})")
+        else:
+            log.info(f"resume_from={resume_from!r}: no valid "
+                     "checkpoint; starting fresh")
+
     train_set._update_params(params)
     train_set.construct()
 
@@ -90,6 +128,27 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if num_boost_round <= 0:
         raise ValueError("num_boost_round must be greater than 0")
     cbs = set(callbacks or [])
+    if resumed_state is not None:
+        from .robustness.checkpoint import restore_into_booster
+        restore_into_booster(booster, resumed_state)
+        # resume semantics: num_boost_round is the TOTAL target
+        done = int(resumed_state.get("iteration",
+                                     booster.current_iteration()))
+        remaining = num_boost_round - done
+        # hand the persisted eval history back to the checkpoint
+        # callback so later checkpoints carry the whole run's history
+        for cb in cbs:
+            seed = getattr(cb, "_ckpt_seed_state", None)
+            if seed is not None:
+                seed(resumed_state)
+        if remaining <= 0:
+            log.info(f"checkpoint already at iteration {done} >= "
+                     f"num_boost_round={num_boost_round}; nothing to "
+                     "train")
+            if not keep_training_booster:
+                booster.free_dataset()
+            return booster
+        num_boost_round = remaining
     if early_stopping_round is not None and early_stopping_round > 0:
         verbosity = 1
         for alias in _ConfigAliases.get("verbosity"):

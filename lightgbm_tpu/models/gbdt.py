@@ -25,10 +25,13 @@ touches ``models``. The "no more splits" stop condition is checked in
 batches (one scalar fetch per tpu_stop_check_interval iterations) and is
 exact: on detection the affected iterations are rolled back (scores
 subtracted, sampler RNG restored) and replayed through the synchronous
-path. The final model matches the sync path split-for-split up to f32
-score rounding: the device update applies the f32 rate directly while
-the sync path shrinks on host in f64, which can flip gain TIES between
-adjacent thresholds over empty bins (identical partitions either way).
+path. The final model matches the sync path BIT-FOR-BIT: both paths
+accumulate the identical f32 leaf product through the same jitted
+delta/traversal programs (see _leaf_delta — the product rounds in its
+own dispatch so FMA fusion cannot smuggle in an extra half-ulp), and
+HostTree.shrink stores exactly that product, so model replays
+(init_model continued training, checkpoint resume) reproduce the live
+score exactly as well.
 """
 from __future__ import annotations
 
@@ -198,7 +201,7 @@ class GBDT:
         self._stop_checked = 0        # pending entries already stop-checked
         self._async_mode: Optional[bool] = None   # resolved lazily
         self._async_disabled = False  # set on stop-rollback / fallbacks
-        self._async_upd_fn = None
+        self._async_delta_fn = None
         self._async_trav_fn = None
         self.models: List[HostTree] = []
         self.iter = 0
@@ -381,22 +384,24 @@ class GBDT:
                             rate: float, k: int):
         """score[k] += rate * tree(bins) with degenerate trees masked —
         the one jitted traversal shared by valid-set updates (+rate) and
-        rollback (-rate); jax.jit caches per bins/score shape."""
+        rollback (-rate); jax.jit caches per bins/score shape. The
+        traversal product rounds in its own dispatch, separate from the
+        accumulate, for the FMA reason documented on _leaf_delta."""
         if self._async_trav_fn is None:
             meta = self.feature_meta
 
             @jax.jit
-            def fn(score, tree, bins, rate, kk):
+            def fn(tree, bins, rate):
                 leaf = tree_leaf_bins(tree, bins, meta.num_bin,
                                       meta.missing_type, meta.default_bin)
-                delta = jnp.where(tree.num_leaves > 1,
-                                  tree.leaf_value[leaf] * rate,
-                                  jnp.float32(0.0))
-                return score.at[kk].add(delta)
+                return jnp.where(tree.num_leaves > 1,
+                                 tree.leaf_value[leaf] * rate,
+                                 jnp.float32(0.0))
 
             self._async_trav_fn = fn
-        return self._async_trav_fn(score, tree_dev, bins_dev,
-                                   jnp.float32(rate), k)
+        delta = self._async_trav_fn(tree_dev, bins_dev,
+                                    jnp.float32(rate))
+        return score.at[k].add(delta)
 
     def _async_rollback_from(self, it0: int) -> None:
         """Undo every pending iteration >= it0: subtract each tree's score
@@ -476,16 +481,6 @@ class GBDT:
                     sel_dev = jnp.asarray(sample[0])
                     w_dev = jnp.asarray(sample[1])
 
-        if self._async_upd_fn is None:
-            donate = (0,) if self.config.tpu_donate_state else ()
-
-            def upd(score, lv, nl, leaf, rate, kk):
-                delta = jnp.where(nl > 1, lv[leaf] * rate, jnp.float32(0.0))
-                return score.at[kk].add(delta)
-
-            self._async_upd_fn = jax.jit(upd, donate_argnums=donate,
-                                         static_argnums=(5,))
-
         for k in range(K):
             col_state = self._col_rng.bit_generator.state
             g, h = grad[k], hess[k]
@@ -506,9 +501,10 @@ class GBDT:
             rate = jnp.float32(self.shrinkage_rate)
             # jaxlint: disable=JL005 — dispatch-only timing, see above
             with global_timer.section("GBDT::UpdateScore"):
-                self.score = self._async_upd_fn(
-                    self.score, tree_dev.leaf_value, tree_dev.num_leaves,
-                    leaf_id, rate, k)
+                delta = self._leaf_delta(tree_dev.leaf_value,
+                                         tree_dev.num_leaves, leaf_id,
+                                         rate)
+                self.score = self._score_add(self.score, delta, k)
             for vd in self.valid_sets:
                 vd.score = self._async_traverse_add(
                     vd.score, tree_dev, vd.bins_dev,
@@ -1475,9 +1471,32 @@ class GBDT:
         if inj is not None and inj["num_machines"] > 1:
             # ≡ Network::GlobalSyncUpByMean over machines (gbdt.cpp:322)
             import numpy as _np
-            tot = inj["reduce_sum"](_np.asarray([init], _np.float64))
+
+            from ..distributed import retried_collective
+            tot = retried_collective(
+                inj["reduce_sum"], _np.asarray([init], _np.float64),
+                what="init-score sync")
             init = float(tot[0]) / inj["num_machines"]
         return float(init)
+
+    def _leaf_delta(self, lv, nl, leaf, rate):
+        """Per-row score delta ``f32(lv[leaf]) * f32(rate)`` (masked for
+        degenerate trees), rounded in its OWN dispatch.
+
+        The product must NOT live in the same program as the score
+        accumulate: XLA fuses ``lv[leaf] * rate + score`` into an FMA
+        (observed on this image's CPU backend), making the live score
+        differ by one ulp from what a model replay (init_model /
+        checkpoint resume, which adds the STORED f32 product back)
+        produces — and one ulp eventually flips near-tie splits. Two
+        dispatches pin the accumulated value to exactly the product
+        HostTree.shrink stores in the model, so async runs, sync runs
+        and replays stay bit-identical."""
+        if self._async_delta_fn is None:
+            self._async_delta_fn = jax.jit(
+                lambda lv, nl, leaf, rate: jnp.where(
+                    nl > 1, lv[leaf] * rate, jnp.float32(0.0)))
+        return self._async_delta_fn(lv, nl, leaf, rate)
 
     def _score_add(self, score, delta, k: int):
         """score[k] += delta, donating the old score buffer when
@@ -1766,10 +1785,15 @@ class GBDT:
                         np.isfinite(new_vals), new_vals, old)
 
             # -- shrinkage + score updates ------------------------------
-            host.shrink(self.shrinkage_rate)
+            # non-linear trees shrink AFTER the updates: the update
+            # routes through the same jitted delta/traversal programs
+            # the async path uses (unshrunk f32 leaf values x f32 rate),
+            # so sync, async and replayed models accumulate bit-identical
+            # scores (see _leaf_delta)
             with global_timer.section("GBDT::UpdateScore",
                                       sync=lambda: self.score):
                 if host.is_linear:
+                    host.shrink(self.shrinkage_rate)
                     delta = jnp.asarray(
                         host.linear_output(self.train_set.raw,
                                            leaf_np).astype(np.float32))
@@ -1777,16 +1801,26 @@ class GBDT:
                 else:
                     lv = np.zeros(self.config.num_leaves, np.float32)
                     lv[:host.num_leaves] = host.leaf_value[:host.num_leaves]
-                    lv_dev = jnp.asarray(lv)
-                    self.score = self._score_add(self.score,
-                                                 lv_dev[leaf_id], k)
+                    delta = self._leaf_delta(
+                        jnp.asarray(lv), jnp.int32(host.num_leaves),
+                        leaf_id, jnp.float32(self.shrinkage_rate))
+                    self.score = self._score_add(self.score, delta, k)
             with global_timer.section(
                     "GBDT::UpdateValidScore",
                     sync=lambda: [vd.score for vd in self.valid_sets]):
                 for vd in self.valid_sets:
-                    vd.score = vd.score.at[k].add(
-                        self._tree_outputs(host, vd.bins_dev,
-                                           vd.dataset.raw))
+                    if host.is_linear:
+                        vd.score = vd.score.at[k].add(
+                            self._tree_outputs(host, vd.bins_dev,
+                                               vd.dataset.raw))
+                    else:
+                        vd.score = self._async_traverse_add(
+                            vd.score,
+                            _host_tree_to_arrays(
+                                host, self.config.num_leaves),
+                            vd.bins_dev, self.shrinkage_rate, k)
+            if not host.is_linear:
+                host.shrink(self.shrinkage_rate)
             if abs(init_scores[k]) > K_EPSILON:
                 host.add_bias(init_scores[k])
             self.models.append(host)
@@ -1969,6 +2003,32 @@ class GBDT:
         for vd in self.valid_sets:
             out.extend(self._eval(vd.metrics, vd.score, vd.name))
         return out
+
+    def rng_snapshot(self) -> Dict:
+        """JSON-serializable snapshot of every host RNG that advances
+        per iteration/tree — the bagging sampler and the column sampler.
+        Restoring it (restore_rng) before the next iteration makes a
+        checkpoint-resumed run draw the exact masks an uninterrupted
+        run would have drawn (the GOSS/device-bagging samplers are
+        stateless fold_in(key, iter) chains and need no snapshot)."""
+        samp = getattr(self.sample_strategy, "rng", None)
+        col = getattr(self, "_col_rng", None)
+        return {
+            "sampler": samp.bit_generator.state if samp is not None
+            else None,
+            "col": col.bit_generator.state if col is not None else None,
+        }
+
+    def restore_rng(self, snapshot: Dict) -> None:
+        """Inverse of rng_snapshot (missing entries are left alone)."""
+        if not snapshot:
+            return
+        samp = getattr(self.sample_strategy, "rng", None)
+        if samp is not None and snapshot.get("sampler"):
+            samp.bit_generator.state = snapshot["sampler"]
+        if snapshot.get("col") and getattr(self, "_col_rng", None) \
+                is not None:
+            self._col_rng.bit_generator.state = snapshot["col"]
 
     def init_from_model(self, other: "GBDT") -> None:
         """Continued training from an existing model (ref: CLI input_model,

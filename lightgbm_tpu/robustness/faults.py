@@ -1,0 +1,217 @@
+"""Fault-injection harness: deterministic transient failures on demand.
+
+Mirrors the ``LGBM_TPU_GUARDS`` install pattern (analysis/guards.py):
+``LGBM_TPU_FAULTS`` is read once at package import (install_from_env in
+lightgbm_tpu/__init__.py), so ANY process — bench, CLI, tests, worker
+subprocesses — can be run under injected faults without code changes;
+:func:`inject` is the scoped context-manager equivalent for tests.
+
+Grammar (comma-separated fault specs, colon-separated options)::
+
+    LGBM_TPU_FAULTS="collective:p=0.2,probe_timeout,write_kill"
+    LGBM_TPU_FAULTS="collective:p=0.2:seed=7,write_kill:n=1:after=3"
+
+Fault classes (the ``site`` argument of :func:`maybe_fail`):
+
+- ``collective``  — the injected-collective host callables
+  (distributed.make_injected_hooks) raise :class:`FaultInjected`
+  (classified transient: its message carries ``UNAVAILABLE``).
+- ``probe_timeout`` — device probes (robustness.retry.probe_device,
+  which bench.py's probe child routes through) raise a transient
+  failure, simulating the tunnel's recovery cycling.
+- ``write_kill`` — checkpoint writes die MID-WRITE (after the payload
+  is partially written, before the atomic rename), simulating a kill
+  -9 during snapshotting; raises :class:`WriteKilled`.
+
+Options per spec:
+
+- ``p=<float>``  — failure probability per call (default 1.0).
+- ``n=<int>``    — at most this many injected failures, then the fault
+  disarms (default: unlimited for p<1, 1 for p=1 — a bare
+  ``write_kill`` kills exactly one write).
+- ``after=<int>`` — skip this many calls before arming (lets a test
+  kill the k-th checkpoint write precisely).
+- ``seed=<int>`` — per-fault RNG seed (default 0): injections are
+  deterministic and reproducible across runs and threads.
+
+Counters are PER-PROCESS: an env-installed plan re-arms in every
+subprocess (each child re-runs install_from_env with fresh counters).
+For flows that spawn one process per attempt — the bench probe — a
+count-limited spec like ``probe_timeout:n=2`` therefore fails EVERY
+child, which deterministically exercises the retry-exhaustion leg
+(rc=4); to exercise the retry-then-recover leg use ``p=<1`` (each
+child flips its own coin) or in-process injection (``inject(...)``
+around ``robustness.retry.probe_device``, as
+tests/test_robustness.py::test_probe_retries_then_succeeds does).
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+from typing import Dict, List, Optional
+
+from ..utils import log
+
+ENV_FAULTS = "LGBM_TPU_FAULTS"
+
+KNOWN_SITES = ("collective", "probe_timeout", "write_kill")
+
+
+class FaultInjected(Exception):
+    """An injected TRANSIENT failure (message carries UNAVAILABLE so the
+    retry classifier treats it exactly like the real device symptom)."""
+
+
+class WriteKilled(FaultInjected):
+    """An injected mid-write kill: the write never completed; whatever
+    bytes hit the disk are garbage that recovery must survive."""
+
+
+class _Fault:
+    def __init__(self, site: str, p: float = 1.0,
+                 n: Optional[int] = None, after: int = 0,
+                 seed: int = 0):
+        self.site = site
+        self.p = float(p)
+        # a bare always-on fault (p=1, no n) fires once then disarms:
+        # "kill the write" means one kill, not an unrecoverable loop
+        self.n = n if n is not None else (1 if self.p >= 1.0 else None)
+        self.after = int(after)
+        self.calls = 0
+        self.fired = 0
+        self.rng = random.Random(seed)
+        self.lock = threading.Lock()
+
+    def should_fire(self) -> bool:
+        with self.lock:
+            self.calls += 1
+            if self.calls <= self.after:
+                return False
+            if self.n is not None and self.fired >= self.n:
+                return False
+            if self.rng.random() >= self.p:
+                return False
+            self.fired += 1
+            return True
+
+    def __repr__(self):
+        return (f"_Fault({self.site}, p={self.p}, n={self.n}, "
+                f"after={self.after}, fired={self.fired}/"
+                f"calls={self.calls})")
+
+
+class FaultPlan:
+    """Parsed set of active faults, keyed by site."""
+
+    def __init__(self, faults: Dict[str, _Fault]):
+        self.faults = faults
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        faults: Dict[str, _Fault] = {}
+        for entry in spec.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            parts = entry.split(":")
+            site = parts[0].strip()
+            if site not in KNOWN_SITES:
+                raise ValueError(
+                    f"unknown fault class {site!r}; expected one of "
+                    f"{KNOWN_SITES}")
+            kw = {}
+            for opt in parts[1:]:
+                if "=" not in opt:
+                    raise ValueError(
+                        f"malformed fault option {opt!r} in {entry!r} "
+                        "(expected key=value)")
+                k, _, v = opt.partition("=")
+                k = k.strip()
+                if k == "p":
+                    kw["p"] = float(v)
+                elif k == "n":
+                    kw["n"] = int(v)
+                elif k == "after":
+                    kw["after"] = int(v)
+                elif k == "seed":
+                    kw["seed"] = int(v)
+                else:
+                    raise ValueError(
+                        f"unknown fault option {k!r} in {entry!r}")
+            if site in faults:
+                raise ValueError(f"duplicate fault class {site!r}")
+            faults[site] = _Fault(site, **kw)
+        return cls(faults)
+
+    def __repr__(self):
+        return f"FaultPlan({list(self.faults.values())})"
+
+
+_active: Optional[FaultPlan] = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _active
+
+
+def maybe_fail(site: str) -> None:
+    """Raise the configured injected failure for ``site`` (no-op when no
+    plan is installed or the site's fault doesn't fire this call).
+
+    Call sites sit immediately BEFORE the real operation, so a fired
+    fault means the operation did not run this attempt — exactly the
+    semantics of a request lost to a flaky device."""
+    plan = _active
+    if plan is None:
+        return
+    f = plan.faults.get(site)
+    if f is None or not f.should_fire():
+        return
+    if site == "write_kill":
+        raise WriteKilled(
+            f"injected mid-write kill (write #{f.calls})")
+    raise FaultInjected(
+        f"UNAVAILABLE: injected {site} fault "
+        f"(call #{f.calls}, injection #{f.fired})")
+
+
+class inject:
+    """Scoped fault injection::
+
+        with faults.inject("collective:p=0.2:seed=3"):
+            ...train...
+
+    Nestable in the trivial sense (restores the previous plan on exit).
+    Also usable as ``inject(None)`` to suppress an env-installed plan
+    within the block.
+    """
+
+    def __init__(self, spec: Optional[str]):
+        self.plan = FaultPlan.parse(spec) if spec else None
+        self._saved: List[Optional[FaultPlan]] = []
+
+    def __enter__(self) -> Optional[FaultPlan]:
+        global _active
+        self._saved.append(_active)
+        _active = self.plan
+        return self.plan
+
+    def __exit__(self, *exc) -> None:
+        global _active
+        _active = self._saved.pop()
+
+
+def install_from_env(env=None) -> bool:
+    """Process-wide plan from ``LGBM_TPU_FAULTS`` (returns True if a
+    plan was installed). Hooked into lightgbm_tpu/__init__.py so any
+    importing process — including bench/probe child processes, which
+    inherit the env var — runs under the plan."""
+    global _active
+    e = env if env is not None else os.environ
+    spec = (e.get(ENV_FAULTS) or "").strip()
+    if not spec or spec.lower() in ("0", "false", "off", "no"):
+        return False
+    _active = FaultPlan.parse(spec)
+    log.warning(f"fault injection ACTIVE ({ENV_FAULTS}): {_active!r}")
+    return True

@@ -1,0 +1,242 @@
+"""Reusable retry policy: bounded attempts, decorrelated-jitter backoff,
+an overall deadline, and a transient-error classifier for jax/XLA.
+
+Every bench round to date (BENCH_r01-r05) died with
+``device_unreachable``: the TPU tunnel cycles through
+``UNAVAILABLE: TPU backend setup/compile error`` while recovering
+(docs/TPU_RUNBOOK.md), and a single unretried failure turned a
+recovering device into a dead run. This module is the one shared answer:
+``init_distributed``, the injected-collective call sites
+(distributed.py) and the bench probe (bench.py) all retry through the
+same policy, so "how long do we believe in a flaky device" is configured
+in exactly one place.
+
+Backoff is decorrelated jitter (Brooker, "Exponential Backoff And
+Jitter", AWS builders' library): ``sleep = min(cap, uniform(base,
+prev_sleep * 3))`` — spreads concurrent retriers apart instead of
+re-synchronizing them the way plain exponential backoff does.
+
+No jax import at module scope (the classifier matches on type/message
+strings precisely so it can run in processes that must not initialize a
+backend).
+"""
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable, Optional
+
+from ..utils import log
+
+# Substrings of exception text (or type name) that mark a failure as
+# transient — retry may succeed. gRPC/XLA status names cover the
+# device-tunnel failure modes measured in BENCH_r01-r05; the plain
+# words cover socket/timeout errors raised by launchers.
+TRANSIENT_MARKERS = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "connection reset",
+    "connection refused",
+    "timed out",
+    "timeout",
+)
+
+# Exception type names treated as transient regardless of message.
+TRANSIENT_TYPES = (
+    "TimeoutError",
+    "ConnectionError",
+    "ConnectionResetError",
+    "ConnectionRefusedError",
+    "BrokenPipeError",
+)
+
+
+def is_transient_error(exc: BaseException) -> bool:
+    """True when ``exc`` looks like a device/network failure that a
+    later attempt may survive (UNAVAILABLE / DEADLINE_EXCEEDED /
+    timeouts), False for anything that smells like a code bug.
+
+    jaxlib's XlaRuntimeError carries the gRPC status name in its
+    message, so string matching is the stable contract across jaxlib
+    versions (the exception classes themselves moved modules twice).
+    """
+    for t in type(exc).__mro__:
+        if t.__name__ in TRANSIENT_TYPES:
+            return True
+    text = f"{type(exc).__name__}: {exc}"
+    upper = text.upper()
+    return any(m.upper() in upper for m in TRANSIENT_MARKERS)
+
+
+class RetryError(Exception):
+    """All attempts failed (or the deadline passed). ``last`` holds the
+    final underlying exception; ``attempts`` how many were made."""
+
+    def __init__(self, msg: str, last: Optional[BaseException],
+                 attempts: int):
+        super().__init__(msg)
+        self.last = last
+        self.attempts = attempts
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded retry with decorrelated-jitter backoff and a deadline.
+
+    - ``max_attempts``: total tries (first call included).
+    - ``base_delay`` / ``max_delay``: jitter window bounds in seconds.
+    - ``deadline``: wall-clock budget across ALL attempts (None = no
+      deadline). No new attempt starts after it passes, and the
+      pre-attempt sleep is clipped to it, so the policy can never
+      outlive its budget — the property the bench watchdog relies on.
+    - ``classifier``: exception -> bool (True = transient, retry).
+    """
+
+    max_attempts: int = 5
+    base_delay: float = 0.5
+    max_delay: float = 30.0
+    deadline: Optional[float] = None
+    classifier: Callable[[BaseException], bool] = is_transient_error
+
+    def next_delay(self, prev_delay: float,
+                   rng: random.Random) -> float:
+        """Decorrelated jitter: uniform(base, prev*3) capped."""
+        hi = max(self.base_delay, prev_delay * 3.0)
+        return min(self.max_delay, rng.uniform(self.base_delay, hi))
+
+    def from_env_overrides(self, env) -> "RetryPolicy":
+        """LGBM_TPU_RETRY_* env knobs override individual fields
+        (ATTEMPTS / BASE_DELAY / MAX_DELAY / DEADLINE)."""
+        kw = {}
+        if env.get("LGBM_TPU_RETRY_ATTEMPTS"):
+            kw["max_attempts"] = int(env["LGBM_TPU_RETRY_ATTEMPTS"])
+        if env.get("LGBM_TPU_RETRY_BASE_DELAY"):
+            kw["base_delay"] = float(env["LGBM_TPU_RETRY_BASE_DELAY"])
+        if env.get("LGBM_TPU_RETRY_MAX_DELAY"):
+            kw["max_delay"] = float(env["LGBM_TPU_RETRY_MAX_DELAY"])
+        if env.get("LGBM_TPU_RETRY_DEADLINE"):
+            kw["deadline"] = float(env["LGBM_TPU_RETRY_DEADLINE"])
+        return dataclasses.replace(self, **kw) if kw else self
+
+
+# Policy used by the in-band training call sites (collectives,
+# init_distributed): short sleeps — a training step is stalled while we
+# wait — but enough attempts to ride out a p=0.2 injected failure rate
+# with margin (P[5 consecutive failures] = 0.032%).
+COLLECTIVE_POLICY = RetryPolicy(max_attempts=5, base_delay=0.05,
+                                max_delay=2.0, deadline=120.0)
+
+# Policy for device acquisition (probe / init): patient — the measured
+# recovery signature is a claim that waits minutes before succeeding.
+DEVICE_POLICY = RetryPolicy(max_attempts=6, base_delay=2.0,
+                            max_delay=60.0, deadline=900.0)
+
+
+def retry_call(fn: Callable, *args,
+               policy: RetryPolicy = RetryPolicy(),
+               what: str = "",
+               rng: Optional[random.Random] = None,
+               sleep: Callable[[float], None] = time.sleep,
+               clock: Callable[[], float] = time.monotonic,
+               on_retry: Optional[Callable[[int, BaseException], None]]
+               = None,
+               **kwargs):
+    """Call ``fn(*args, **kwargs)`` under ``policy``.
+
+    Transient failures (per ``policy.classifier``) are retried with
+    decorrelated-jitter sleeps until attempts or deadline run out;
+    non-transient exceptions propagate immediately (a code bug must
+    never burn the retry budget). Raises :class:`RetryError` when the
+    budget is exhausted.
+    """
+    rng = rng if rng is not None else random.Random()
+    label = what or getattr(fn, "__name__", "call")
+    start = clock()
+    deadline_at = (start + policy.deadline
+                   if policy.deadline is not None else None)
+    delay = policy.base_delay
+    last: Optional[BaseException] = None
+    attempts = 0
+    while attempts < policy.max_attempts:
+        attempts += 1
+        try:
+            return fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001 — classifier decides
+            if not policy.classifier(e):
+                raise
+            last = e
+            if attempts >= policy.max_attempts:
+                break
+            if deadline_at is not None and clock() >= deadline_at:
+                break
+            delay = policy.next_delay(delay, rng)
+            if deadline_at is not None:
+                delay = max(0.0, min(delay, deadline_at - clock()))
+            if on_retry is not None:
+                on_retry(attempts, e)
+            log.warning(f"{label}: transient failure (attempt "
+                        f"{attempts}/{policy.max_attempts}): {e!r}; "
+                        f"retrying in {delay:.2f}s")
+            sleep(delay)
+    raise RetryError(
+        f"{label}: gave up after {attempts} attempt(s) over "
+        f"{clock() - start:.1f}s: {last!r}", last, attempts)
+
+
+# ---------------------------------------------------------------------------
+# Graceful degradation: device acquisition with CPU fallback
+# (config: tpu_fallback_to_cpu — ref motivation: the reference treats
+# interruption as normal; we additionally treat "device never came up"
+# as survivable when the user opted in).
+# ---------------------------------------------------------------------------
+
+def probe_device() -> int:
+    """One device-acquisition attempt: list devices and run a trivial
+    computation (forces backend init through the tunnel). Honors the
+    fault harness's ``probe_timeout`` class so CPU tests can exercise
+    the retry/fallback paths."""
+    from . import faults
+    faults.maybe_fail("probe_timeout")
+    import jax
+    devs = jax.devices()
+    jax.block_until_ready(jax.numpy.zeros(8) + 1)
+    return len(devs)
+
+
+def ensure_device_or_fallback(fallback: bool = False,
+                              policy: RetryPolicy = DEVICE_POLICY
+                              ) -> bool:
+    """Acquire the configured device under the retry policy; on terminal
+    failure either fall back to CPU (``fallback=True``, from
+    ``tpu_fallback_to_cpu``; loud warning, returns False) or re-raise.
+    Returns True when the device came up.
+
+    Call sites: engine.train (before the boosting loop) and the CLI
+    runner. A no-op returning True on runs already pinned to CPU.
+    """
+    try:
+        import os
+        n = retry_call(
+            probe_device,
+            policy=policy.from_env_overrides(os.environ),
+            what="device probe")
+        log.debug(f"device probe ok ({n} device(s))")
+        return True
+    except Exception as e:  # noqa: BLE001
+        # only a transient-classified terminal failure earns the CPU
+        # fallback: a code bug (ImportError, TypeError, ...) must still
+        # crash loudly rather than masquerade as a flaky device
+        if not fallback or not (isinstance(e, RetryError) or
+                                is_transient_error(e)):
+            raise
+        log.warning(
+            "=" * 60 + "\n"
+            f"DEVICE UNREACHABLE after retry policy exhausted: {e!r}\n"
+            "tpu_fallback_to_cpu=true — CONTINUING ON CPU. Training "
+            "will be correct but slow; fix the accelerator and restart "
+            "to regain device speed.\n" + "=" * 60)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        return False

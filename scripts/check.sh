@@ -28,6 +28,13 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
+echo "== fault-matrix smoke (robustness runtime, CPU) =="
+JAX_PLATFORMS=cpu python scripts/fault_smoke.py || rc=1
+if [ $rc -ne 0 ]; then
+    echo "check.sh: fault smoke failed — skipping tier-1 pytest" >&2
+    exit $rc
+fi
+
 echo "== tier-1 pytest (CPU) =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || rc=1

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Fault-matrix smoke: one short CPU training under EACH fault class.
+
+The tier-1 suite proves the robustness contracts in depth
+(tests/test_robustness.py); this script is the fast end-to-end gate for
+scripts/check.sh — it drives the REAL surfaces (train(), the
+checkpoint callback, the injected-collective path, the device-probe
+fallback) under every LGBM_TPU_FAULTS class and fails non-zero if any
+guarantee regresses:
+
+  write_kill      -> a mid-write kill during checkpointing, then a
+                     resume that must bit-match the uninterrupted run
+  collective      -> 20% transient failures on the 2-worker injected
+                     allreduce; must still match centralized training
+  probe_timeout   -> device probe never succeeds; tpu_fallback_to_cpu
+                     must finish training anyway
+
+Runs in ~half a minute on CPU.
+"""
+import os
+import sys
+import tempfile
+import threading
+
+os.environ.setdefault("XLA_FLAGS", "")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# fast retry budget for the smoke (read per call site)
+os.environ["LGBM_TPU_RETRY_ATTEMPTS"] = "8"
+os.environ["LGBM_TPU_RETRY_BASE_DELAY"] = "0.001"
+os.environ["LGBM_TPU_RETRY_MAX_DELAY"] = "0.01"
+os.environ["LGBM_TPU_RETRY_DEADLINE"] = "30"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.robustness import checkpoint as ckpt  # noqa: E402
+from lightgbm_tpu.robustness import faults  # noqa: E402
+
+PARAMS = dict(objective="binary", num_leaves=15, learning_rate=0.1,
+              verbose=-1, seed=3, bagging_fraction=0.8, bagging_freq=1)
+
+
+def _data(n=800, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    return X, y
+
+
+def smoke_write_kill() -> None:
+    X, y = _data()
+    n_round = 8
+    full = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y),
+                     num_boost_round=n_round)
+    with tempfile.TemporaryDirectory() as d:
+        cb = lgb.checkpoint_callback(d, every_n=1, keep_last=3)
+        try:
+            with faults.inject("write_kill:after=3:n=1"):
+                lgb.train(dict(PARAMS), lgb.Dataset(X, label=y),
+                          num_boost_round=n_round, callbacks=[cb])
+            raise AssertionError("write_kill never fired")
+        except faults.WriteKilled:
+            pass
+        got = ckpt.latest_valid_checkpoint(d)
+        assert got is not None and got[1]["iteration"] == 3, got
+        resumed = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y),
+                            num_boost_round=n_round, resume_from=d)
+    assert resumed.current_iteration() == n_round
+    np.testing.assert_array_equal(resumed.predict(X), full.predict(X))
+
+
+def smoke_collective() -> None:
+    from lightgbm_tpu.distributed import (clear_collectives,
+                                          inject_collectives)
+    params = dict(objective="regression", num_leaves=15,
+                  learning_rate=0.2, min_data_in_leaf=5,
+                  use_quantized_grad=True, stochastic_rounding=False,
+                  verbosity=-1)
+    rounds = 4
+    # same data recipe as tests/test_injected_collectives.py: the
+    # bit-exactness contract holds for the int32 quantized histogram
+    # algebra over a continuous target
+    rng = np.random.default_rng(1)
+    n, f = 400, 6
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] * 2 - X[:, 1] * X[:, 2] +
+         0.05 * rng.normal(size=n)).astype(np.float32)
+    clear_collectives()
+    full = lgb.Dataset(X, label=y)
+    pred_c = lgb.train(dict(params), full,
+                       num_boost_round=rounds).predict(X)
+
+    barrier = threading.Barrier(2)
+    bufs = [None, None]
+
+    def allreduce(rank, a, op):
+        bufs[rank] = np.asarray(a).copy()
+        barrier.wait()
+        out = bufs[0].astype(np.float64) if op == "sum" else bufs[0]
+        out = (out + bufs[1]) if op == "sum" else np.maximum(out, bufs[1])
+        barrier.wait()
+        return out.astype(a.dtype)
+
+    boosters = [None, None]
+    for rank in range(2):
+        inject_collectives(
+            lambda a, r=rank: allreduce(r, a, "sum"),
+            reduce_max=lambda a, r=rank: allreduce(r, a, "max"),
+            rank=rank, num_machines=2)
+        lo, hi = (0, n // 2) if rank == 0 else (n // 2, n)
+        ds = lgb.Dataset(X[lo:hi], label=y[lo:hi], reference=full)
+        boosters[rank] = lgb.Booster(dict(params), ds)
+    clear_collectives()
+
+    errs = []
+
+    def run(rank):
+        try:
+            for _ in range(rounds):
+                boosters[rank].update()
+        except Exception as e:
+            errs.append((rank, e))
+            try:
+                barrier.abort()
+            except Exception:
+                pass
+
+    with faults.inject("collective:p=0.2:seed=5:n=100000") as plan:
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        fired = plan.faults["collective"].fired
+    assert not errs, errs
+    assert fired > 0, "collective fault never fired — vacuous smoke"
+    assert boosters[0].model_to_string() == boosters[1].model_to_string()
+    np.testing.assert_allclose(boosters[0].predict(X), pred_c,
+                               rtol=1e-6, atol=1e-7)
+
+
+def smoke_probe_fallback() -> None:
+    X, y = _data(n=400, seed=2)
+    with faults.inject("probe_timeout:p=1:n=1000000"):
+        b = lgb.train(dict(PARAMS, tpu_fallback_to_cpu=True),
+                      lgb.Dataset(X, label=y), num_boost_round=3)
+    assert b.current_iteration() == 3
+
+
+def main() -> int:
+    rc = 0
+    for name, fn in (("write_kill", smoke_write_kill),
+                     ("collective", smoke_collective),
+                     ("probe_timeout", smoke_probe_fallback)):
+        try:
+            fn()
+            print(f"fault_smoke: {name} OK")
+        except Exception as e:  # noqa: BLE001 — gate reports all classes
+            rc = 1
+            print(f"fault_smoke: {name} FAILED: {e!r}", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
