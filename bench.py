@@ -285,12 +285,99 @@ def run_probe() -> None:
 
 
 def _spawn(env_extra: dict, timeout: float) -> subprocess.CompletedProcess:
-    """Run this script as a child with extra env, shared argv/capture/cwd."""
+    """Run this script as a child with extra env, shared argv/capture/cwd.
+
+    PROBE children only: a probe that blows its slot is a claim-WAITER
+    and killing it is benign (docs/TPU_RUNBOOK.md wedge discipline);
+    measurement children go through _spawn_claim_holder below, which
+    never kills."""
     return subprocess.run(
         [sys.executable, os.path.abspath(__file__)],
         env=dict(os.environ, **env_extra),
         timeout=timeout, capture_output=True, text=True,
         cwd=os.path.dirname(os.path.abspath(__file__)))
+
+
+class _ParkedChild(Exception):
+    """A measurement child outlived every wait budget and was left
+    RUNNING (parked): it may hold the device claim mid-compile, and a
+    SIGKILL there is the documented machine-wide wedge trigger that
+    zeroed BENCH_r0{3,4,5}.json three rounds running (VERDICT weak #1).
+    The parent reports no_result and skips remaining stages instead."""
+
+
+def _spawn_claim_holder(env_extra: dict, slot: float,
+                        hard_deadline: float):
+    """Run a measurement child with file-redirected output and a slot
+    deadline that does NOT kill on expiry.
+
+    The child passed the probe, so it is presumed to HOLD the device
+    claim (possibly mid-compile). On slot expiry we keep waiting up to
+    ``hard_deadline`` (letting it finish and still banking its result);
+    if it is STILL running there, it is left alive — detached from our
+    pipes (output goes to temp files, so nothing blocks) — and
+    _ParkedChild is raised so the caller skips every remaining stage.
+
+    Returns (rc_or_None, stdout_text, stderr_text, timed_out_slot).
+    """
+    import tempfile
+    out_f = tempfile.NamedTemporaryFile(
+        mode="w+", prefix="bench_child_", suffix=".out", delete=False)
+    err_f = tempfile.NamedTemporaryFile(
+        mode="w+", prefix="bench_child_", suffix=".err", delete=False)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=dict(os.environ, **env_extra),
+        stdout=out_f, stderr=err_f, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+
+    def read_streams():
+        out_f.flush()
+        err_f.flush()
+        with open(out_f.name, "r", encoding="utf-8",
+                  errors="replace") as f:
+            out = f.read()
+        with open(err_f.name, "r", encoding="utf-8",
+                  errors="replace") as f:
+            err = f.read()
+        return out, err
+
+    def cleanup_streams():
+        # every non-parked exit removes the temp pair (sessions spawn
+        # many children; parked children keep theirs — the child still
+        # writes there and the operator may want the tail)
+        for f in (out_f, err_f):
+            try:
+                f.close()
+                os.unlink(f.name)
+            except OSError:
+                pass
+
+    timed_out = False
+    try:
+        proc.wait(timeout=max(slot, 1.0))
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        grace = max(hard_deadline - time.time(), 0.0)
+        sys.stderr.write(
+            f"[bench] child slot ({slot:.0f}s) expired; NOT killing a "
+            f"claim holder — waiting up to {grace:.0f}s more for it to "
+            "finish or park\n")
+        try:
+            proc.wait(timeout=max(grace, 1.0))
+        except subprocess.TimeoutExpired:
+            out, err = read_streams()
+            sys.stderr.write(err[-2000:])
+            sys.stderr.write(
+                f"[bench] parked child output stays in {out_f.name} / "
+                f"{err_f.name}\n")
+            raise _ParkedChild(
+                f"measurement child pid={proc.pid} still running at the "
+                "watchdog deadline; left alive (parked) to avoid the "
+                "mid-compile claim-holder kill wedge") from None
+    out, err = read_streams()
+    cleanup_streams()
+    return proc.returncode, out, err, timed_out
 
 
 def _dump_timeout_streams(e: subprocess.TimeoutExpired) -> None:
@@ -416,29 +503,47 @@ def main() -> int:
             last_note = f"watchdog exhausted before trying sched={sched}"
             break
         # Weight the preferred (first) mode: give it up to 70% of the
-        # remaining budget so a cold-cache compile isn't killed mid-flight,
-        # while still reserving a slot for the fallback mode.
+        # remaining budget, while still reserving a slot for the
+        # fallback mode. Post-probe children HOLD the device claim, so
+        # slot expiry never kills them (VERDICT weak #1: the
+        # mid-compile claim-holder SIGKILL is the machine-wide wedge
+        # that zeroed three rounds of BENCH json): an over-slot child
+        # gets the rest of the watchdog to finish — its late result
+        # still counts — and remaining sched modes are SKIPPED. Only
+        # at the hard deadline is it parked (left running, reported as
+        # no_result).
         remaining_modes = len(SCHED_MODES) - i
         if remaining_modes > 1:
             slot = max(budget * 0.7, 5.0)
         else:
             slot = max(budget - 5.0, 5.0)
         try:
-            out = _spawn({"_LGBM_BENCH_CHILD": sched.strip()}, slot)
-        except subprocess.TimeoutExpired as e:
-            _dump_timeout_streams(e)
-            last_note = (f"sched={sched} exceeded its {slot:.0f}s slot of "
-                         f"the {BENCH_WATCHDOG_SEC}s watchdog "
-                         "(device unavailable or compile stalled)")
-            continue
-        sys.stderr.write(out.stderr[-4000:])
-        for ln in out.stdout.splitlines():
+            rc, stdout, stderr, timed_out = _spawn_claim_holder(
+                {"_LGBM_BENCH_CHILD": sched.strip()}, slot,
+                hard_deadline=deadline)
+        except _ParkedChild as e:
+            # status "parked" is load-bearing: tpu_session_auto.py keys
+            # on it to skip ALL remaining session stages — a parked
+            # grandchild still holds the device claim, and any fresh
+            # claim stacked on it is the documented wedge trigger
+            print(_fail_line(
+                f"sched={sched}: {e} — remaining stages skipped",
+                status="parked"), flush=True)
+            return RC_NO_RESULT
+        sys.stderr.write(stderr[-4000:])
+        for ln in stdout.splitlines():
             ln = ln.strip()
             if ln.startswith("{") and '"iters/sec"' in ln:
                 print(ln, flush=True)
                 return 0
-        last_note = (f"sched={sched} exited rc={out.returncode} "
-                     f"without a result: {out.stderr[-300:]!r}")
+        last_note = (f"sched={sched} exited rc={rc} "
+                     f"without a result: {stderr[-300:]!r}")
+        if timed_out:
+            # the child overran its slot (claim was held past the
+            # planned budget): do not point another fresh claim at the
+            # device in the leftover time
+            last_note += " (over slot; remaining sched modes skipped)"
+            break
     print(_fail_line(last_note), flush=True)
     return RC_NO_RESULT
 

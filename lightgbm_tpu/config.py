@@ -228,6 +228,12 @@ _reg("tpu_hist_kernel", str, "auto", ())     # auto | einsum | scatter |
                                              # pallas (auto: einsum on TPU,
                                              #  scatter-add on CPU)
 _reg("tpu_row_scheduling", str, "compact", ())  # compact | full | level
+# hybrid level+tail growth (tpu_row_scheduling="level" with unbounded or
+# > MAX_LEVEL_DEPTH max_depth): depth the level-synchronous phase runs
+# to before the sequential tail takes over. 0 = auto
+# (ceil(log2(num_leaves)) + 1 — 9 for the default 255 leaves), clamped
+# to [1, MAX_LEVEL_DEPTH].
+_reg("tpu_level_handoff_depth", int, 0, (), (0, None, True, False))
 # sparse bin storage (≡ SparseBin/MultiValSparseBin, sparse_bin.hpp:858):
 # dense packs every cell; multival stores only nonzero bins row-wise
 # [R, K]; auto picks multival for sufficiently sparse scipy inputs

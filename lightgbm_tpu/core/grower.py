@@ -34,7 +34,7 @@ from ..ops.histogram import make_hist_fn, hist_rowmajor
 from ..ops.split import (FeatureMeta, SplitHyperParams, SplitRecord,
                          K_EPSILON, K_MIN_SCORE, best_split_for_leaf,
                          calculate_splitted_leaf_output, forced_split_record,
-                         meta_has_categorical)
+                         meta_has_categorical, pack_record_rows)
 from .tree import TreeArrays
 
 
@@ -213,6 +213,51 @@ def _bucket_sizes(num_rows: int, min_bucket: int) -> list:
     return sizes
 
 
+def quantize_gradients(cfg: GrowerConfig, gh, rng_key,
+                       reduce_max: Optional[Callable] = None,
+                       localize_key: Optional[Callable] = None):
+    """int8 gradient discretization with stochastic rounding
+    (ref: GradientDiscretizer::DiscretizeGradients,
+    gradient_discretizer.cpp:71-162): scale |g| to
+    [-quant_bins/2, quant_bins/2] and h to [0, quant_bins]; the mask
+    channel stays exact 0/1. Histogram sums then accumulate EXACTLY in
+    int32 and convert back via the returned ``conv``.
+
+    Shared by the sequential grower and the level/hybrid schedulers so
+    one tree's quantization is bit-identical wherever its histograms
+    are built (the hybrid's level phase and its sequential tail must
+    see the SAME int8 rows or the handoff breaks parity).
+
+    Returns ``(gh_int8 [R, 3], conv)`` where ``conv`` maps raw int32
+    histogram sums back to f32 through the per-tree scales."""
+    if reduce_max is None:
+        reduce_max = lambda x: x
+    if localize_key is None:
+        localize_key = lambda k: k
+    g, h, m = gh[:, 0], gh[:, 1], gh[:, 2]
+    kq = max(cfg.quant_bins // 2, 1)
+    # reduce_max makes the scales global under row sharding so the
+    # downstream int32 psum is exact (identity when serial)
+    g_scale = jnp.maximum(reduce_max(jnp.max(jnp.abs(g))),
+                          1e-30) / kq
+    h_scale = jnp.maximum(reduce_max(jnp.max(h)),
+                          1e-30) / cfg.quant_bins
+    if cfg.stochastic_rounding:
+        # localize_key decorrelates the rounding noise across row
+        # shards (each row is rounded once, on its owning device)
+        kg, kh = jax.random.split(localize_key(
+            rng_key if rng_key is not None else jax.random.PRNGKey(0)))
+        ug = jax.random.uniform(kg, g.shape, jnp.float32)
+        uh = jax.random.uniform(kh, h.shape, jnp.float32)
+    else:
+        ug = uh = jnp.float32(0.5)
+    gq = jnp.trunc(g / g_scale + jnp.where(g >= 0, ug, -ug))
+    hq = jnp.trunc(h / h_scale + uh)
+    gh_q = jnp.stack([gq, hq, m], axis=1).astype(jnp.int8)
+    scale3 = jnp.stack([g_scale, h_scale, jnp.float32(1.0)])
+    return gh_q, (lambda hh: hh.astype(jnp.float32) * scale3)
+
+
 def _feature_meta_scalars(pmeta: FeatureMeta, f):  # jaxlint: disable=JL001
     """(num_bin, missing_type, default_bin) of split feature ``f``.
 
@@ -369,18 +414,10 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     NN = 10 if has_cat else 9
 
     def pack_rec(rec: SplitRecord) -> jnp.ndarray:
-        """SplitRecord (any leading shape) -> packed f32 [..., NB].
-
-        Bin thresholds, feature ids and cat counts are < 2^24, exact in
-        f32; counts are f32 already (histogram count channel)."""
-        vals = [rec.gain, rec.feature, rec.threshold, rec.default_left,
-                rec.left_sum_gradient, rec.left_sum_hessian,
-                rec.left_count, rec.left_output, rec.right_sum_gradient,
-                rec.right_sum_hessian, rec.right_count, rec.right_output]
-        if has_cat:
-            vals.append(rec.num_cat)
-        return jnp.stack([jnp.asarray(v).astype(jnp.float32) for v in vals],
-                         axis=-1)
+        """SplitRecord (any leading shape) -> packed f32 [..., NB]
+        (ops/split.py pack_record_rows — the layout shared with the
+        level/hybrid schedulers' GrowState handoff)."""
+        return pack_record_rows(rec, has_cat)
 
     def unpack_rec(v: jnp.ndarray, cat_bins=None) -> SplitRecord:
         """Packed f32 [..., NB] -> SplitRecord (integer fields restored)."""
@@ -443,24 +480,14 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             raise ValueError("EFB bundling with an impure scan hook "
                              "needs the local-sums channel "
                              "(local_pool=True)")
-        b_gmap = jnp.asarray(bundle["gather_map"], jnp.int32)     # [F, B]
+        from ..io.bundling import make_expand_hist
         b_group = jnp.asarray(bundle["group"], jnp.int32)         # [F]
         b_offset = jnp.asarray(bundle["offset"], jnp.int32)       # [F]
         b_default = jnp.asarray(bundle["default_bin"], jnp.int32)  # [F]
         b_nbin = jnp.asarray(bundle["num_bin"], jnp.int32)        # [F]
-
-        def expand_hist(hist_g, sg, sh, cnt):
-            """[G, B, 3] group hist -> [F, B, 3] logical hist; the default
-            bin's row = leaf totals - sum(stored bins) (FixHistogram)."""
-            flat = hist_g.reshape(-1, hist_g.shape[-1])
-            h = jnp.where(b_gmap[..., None] >= 0,
-                          flat[jnp.maximum(b_gmap, 0)], 0.0)
-            totals = jnp.stack([sg, sh, cnt])
-            rest = h.sum(axis=1)                                  # [F, 3]
-            dmask = (jnp.arange(h.shape[1])[None, :] ==
-                     b_default[:, None])
-            return h + dmask[..., None] * (totals[None, None, :] -
-                                           rest[:, None, :])
+        # [G, B, 3] group hist -> [F, B, 3] logical (FixHistogram);
+        # shared with the level/hybrid schedulers (io/bundling.py)
+        expand_hist = make_expand_hist(bundle)
 
         def decode_bin(col_phys, f):
             """Physical group column -> logical bin of feature f."""
@@ -592,34 +619,9 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         F = int(meta.num_bin.shape[0]) if bundled else Fp
 
         if quantized:
-            # ref: GradientDiscretizer::DiscretizeGradients
-            # (gradient_discretizer.cpp:71-162): scale |g| to
-            # [-quant_bins/2, quant_bins/2] and h to [0, quant_bins] with
-            # stochastic rounding toward/away from zero; the mask channel
-            # is exact 0/1. All histogram sums then accumulate EXACTLY in
-            # int32 and are converted back via the scales at scan time.
-            g, h, m = gh[:, 0], gh[:, 1], gh[:, 2]
-            kq = max(cfg.quant_bins // 2, 1)
-            # reduce_max makes the scales global under row sharding so the
-            # downstream int32 psum is exact (identity when serial)
-            g_scale = jnp.maximum(reduce_max(jnp.max(jnp.abs(g))),
-                                  1e-30) / kq
-            h_scale = jnp.maximum(reduce_max(jnp.max(h)),
-                                  1e-30) / cfg.quant_bins
-            if cfg.stochastic_rounding:
-                # localize_key decorrelates the rounding noise across row
-                # shards (each row is rounded once, on its owning device)
-                kg, kh = jax.random.split(localize_key(
-                    rng_key if rng_key is not None else jax.random.PRNGKey(0)))
-                ug = jax.random.uniform(kg, g.shape, jnp.float32)
-                uh = jax.random.uniform(kh, h.shape, jnp.float32)
-            else:
-                ug = uh = jnp.float32(0.5)
-            gq = jnp.trunc(g / g_scale + jnp.where(g >= 0, ug, -ug))
-            hq = jnp.trunc(h / h_scale + uh)
-            gh = jnp.stack([gq, hq, m], axis=1).astype(jnp.int8)
-            scale3 = jnp.stack([g_scale, h_scale, jnp.float32(1.0)])
-            conv = lambda hh: hh.astype(jnp.float32) * scale3
+            gh, conv = quantize_gradients(cfg, gh, rng_key,
+                                          reduce_max=reduce_max,
+                                          localize_key=localize_key)
         else:
             conv = lambda hh: hh
 

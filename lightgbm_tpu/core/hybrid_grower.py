@@ -1,0 +1,230 @@
+"""Hybrid level+tail grower (round-6 phase B, docs/TPU_RUNBOOK.md §3).
+
+The pure level grower (core/level_grower.py) kills the sequential
+split loop but its dense [2^d, F, B, 3] level hists cap it at
+``max_depth <= MAX_LEVEL_DEPTH`` — excluding the DEFAULT benchmark
+config (255 leaves, ``max_depth=-1``), the one shape the round-5
+device verdict says is dispatch-bound. This module lifts the cap:
+
+1. run the level phase to a handoff depth D0 (~15 dispatches per LEVEL
+   instead of ~40 per SPLIT), scanning levels 0..D0 so every candidate
+   node's gain — and hence e(v) = min path gain — is known EXACTLY for
+   all nodes at depth <= D0;
+2. rank all candidates by e (descending, stable ties = heap order) and
+   COMMIT the rank prefix that provably matches the sequential
+   best-first expansion: the cut stops at the first rank that expands
+   a depth-D0 node (exactness guard). Any deeper node w has
+   e(w) <= e(parent(w)) with parent at depth D0, and the parent's own
+   expansion position is >= the cut, so no unscanned node can preempt
+   a committed rank — the committed prefix IS the true first-k0
+   expansion sequence, set and numbering;
+3. seed the sequential grower's GrowState from the level output —
+   per-leaf stats/best rows straight from the level scans
+   (ops/split.pack_record_rows layout), histogram-pool rows gathered
+   from the kept level hists, order/seg reconstructed by a stable sort
+   on leaf ids — and resume core/grower.py's fori_loop at traced step
+   k0. The tail finishes the deep part leaf-wise to ``num_leaves`` at
+   unbounded depth with the EXISTING, fully-tested sequential body.
+
+Exactness: the committed splits and the tail use the same SplitRecord
+arithmetic; the only divergence channel vs a pure sequential run is
+histogram accumulation order (bit-exact for dyadic gradients and the
+quantized int32 path, f32 reassociation noise otherwise — same caveat
+as the pure level mode). A balanced 255-leaf tree is depth 8, so at
+D0 = 9 the level phase typically resolves the bulk of the 254 splits
+and the tail handles only the deep best-first excursions.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.split import (SplitRecord, meta_has_categorical,
+                         pack_record_rows)
+from .grower import (NS, S_LMAX, S_LMIN, S_PARENT, GrowerConfig,
+                     GrowState, make_tree_grower)
+from .level_grower import (MAX_LEVEL_DEPTH, make_level_phase,
+                           rank_and_slots)
+
+
+def auto_handoff_depth(num_leaves: int) -> int:
+    """Default D0: one past the balanced depth of a num_leaves tree
+    (ceil(log2(L)) + 1 — 255 leaves -> 9), clamped to
+    [1, MAX_LEVEL_DEPTH]. One extra level costs ~4 batched kernels and
+    moves best-first excursions out of the sequential tail."""
+    d = int(np.ceil(np.log2(max(int(num_leaves), 2)))) + 1
+    return max(1, min(d, MAX_LEVEL_DEPTH))
+
+
+def resolve_handoff_depth(num_leaves: int, requested: int) -> int:
+    """The ONE handoff-depth resolution (<=0 -> auto; clamp to
+    [1, MAX_LEVEL_DEPTH]) — shared by make_hybrid_grower and the
+    eligibility memory gate in models/gbdt.py so the depth the gate
+    budgets is always the depth the grower runs."""
+    d = int(requested) if int(requested) > 0 else \
+        auto_handoff_depth(num_leaves)
+    return max(1, min(d, MAX_LEVEL_DEPTH))
+
+
+def make_hybrid_grower(cfg: GrowerConfig, meta, bundle=None,
+                       handoff_depth: int = 0):
+    """Build ``grow(bins_rm, gh, feature_mask, cegb, rng_key)`` ->
+    ``(TreeArrays, leaf_id)`` over row-major uint8/16 bins [R, F]
+    ([R, G] physical groups when ``bundle`` is set) for unbounded /
+    deep ``max_depth`` — the level phase to D0 plus the sequential
+    compact tail. ``handoff_depth`` <= 0 means auto."""
+    L = int(cfg.num_leaves)
+    D0 = resolve_handoff_depth(L, handoff_depth)
+    if 0 < cfg.max_depth <= D0:
+        raise ValueError(
+            f"hybrid growth needs max_depth > handoff depth {D0} "
+            f"(got {cfg.max_depth}); the pure level grower serves "
+            "shallow configs")
+    hp = cfg.hparams
+    B = int(cfg.num_bin)
+    has_cat = meta_has_categorical(meta)
+    MAXK = min(hp.max_cat_threshold, B) if has_cat else 0
+    NB = 13 if has_cat else 12
+    NN = 10 if has_cat else 9
+    quantized = cfg.quantized
+    hist_dtype = jnp.int32 if quantized else jnp.float32
+    inf = jnp.float32(jnp.inf)
+
+    phase = make_level_phase(cfg, meta, depth=D0, scan_last=True,
+                             bundle=bundle, collect_hists=True)
+    # the tail is the EXISTING compact sequential program, resumed from
+    # the level phase's committed state via its ``init`` seam
+    tail_cfg = dataclasses.replace(cfg, row_sched="compact")
+    tail_grow = make_tree_grower(tail_cfg, meta, bundle=bundle)
+
+    T = 2 ** (D0 + 1) - 1             # heap nodes, levels 0..D0
+    ids_np = np.arange(T)
+    depth_np = np.floor(np.log2(ids_np + 1)).astype(np.int32)
+    par_np = np.maximum((ids_np - 1) // 2, 0).astype(np.int32)
+    is_deep_np = depth_np == D0
+    # right children have even heap ids (> 0)
+    isr_np = ((ids_np % 2 == 0) & (ids_np > 0)).astype(np.float32)
+
+    def grow(bins_rm, gh, feature_mask=None, cegb=None, rng_key=None):
+        R = bins_rm.shape[0]
+        res = phase(bins_rm, gh, feature_mask, rng_key)
+
+        # ---- rank + exactness cut + committed-tree leaf slots ------
+        # (level_grower.rank_and_slots — the shared slot-numbering/
+        # eff-resolution invariant). The cut: the selected prefix stops
+        # at the first rank held by a depth-D0 node; ranks before it
+        # beat every depth-D0 e, hence (e is monotone down any path)
+        # every unscanned deeper node too. Invalid deep nodes
+        # (e = -inf) sit in the -inf tail at positions >= k, so a tree
+        # that never reaches depth D0 commits all k splits and the tail
+        # starts done.
+        rank, k0, committed, slot, eff = rank_and_slots(
+            res["e"], L, D0, cut_mask=jnp.asarray(is_deep_np))
+        # every row's node resolves: committed nodes hold no rows
+        # (their partitions ran), and the first non-committed ancestor
+        # is the row's live leaf
+        leaf_slot = jnp.maximum(eff[res["heap"]], 0)    # [R]
+
+        # ---- order/seg: stable sort on leaf ids --------------------
+        # (runbook §3: the sequential order after k0 stable partitions
+        # of arange(R) keeps original row order inside every leaf —
+        # exactly what a stable argsort on the slot keys rebuilds)
+        order_rows = jnp.argsort(leaf_slot,
+                                 stable=True).astype(jnp.int32)
+        cnt = jnp.zeros(L, jnp.int32).at[leaf_slot].add(1)
+        starts = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(cnt)])[:L]
+
+        ids_all = jnp.asarray(ids_np, jnp.int32)
+        par_all = jnp.asarray(par_np, jnp.int32)
+        live = (~committed) & ((committed[par_all] & (ids_all > 0)) |
+                               ((ids_all == 0) & (k0 == 0)))
+        lslot = jnp.where(live, slot, L)                # dump slot L
+        live_slot = jnp.zeros(L + 1, bool).at[lslot].set(True)[:L]
+        node_of_slot = jnp.zeros(L + 1, jnp.int32).at[lslot].set(
+            ids_all)[:L]
+        seg = jnp.stack([jnp.where(live_slot, starts, 0),
+                         jnp.where(live_slot, cnt, 0)], axis=1)
+
+        # ---- per-leaf stats rows (grower.py S_* columns) -----------
+        depth_h = jnp.asarray(depth_np, jnp.float32)
+        isr_h = jnp.asarray(isr_np)
+        prank = rank[par_all].astype(jnp.float32)
+        root = ids_all == 0
+        stat_rows = jnp.stack(
+            [res["sg"], res["sh"], res["cn"], res["out"],
+             jnp.full(T, -inf), jnp.full(T, inf), depth_h,
+             jnp.where(root, -1.0, prank), isr_h,
+             jnp.where(root, 0.0, 2.0 * prank + 1.0 + isr_h)],
+            axis=1)                                     # [T, NS]
+        stats0 = jnp.zeros((L + 1, NS), jnp.float32)
+        stats0 = stats0.at[:, S_LMIN].set(-inf)
+        stats0 = stats0.at[:, S_LMAX].set(inf)
+        stats0 = stats0.at[:, S_PARENT].set(-1.0)
+        stats = stats0.at[lslot].set(stat_rows)[:L]
+
+        # ---- per-leaf best rows: straight from the level scans -----
+        # (every live leaf sits at depth <= D0 and was scanned)
+        inv_row = pack_record_rows(
+            SplitRecord.invalid((), max_cat=MAXK), has_cat)
+        best = jnp.broadcast_to(inv_row, (L + 1, NB)).at[lslot].set(
+            res["rows"])[:L]
+        if has_cat:
+            best_cat = jnp.full((L + 1, MAXK), -1, jnp.int32).at[
+                lslot].set(res["catb"])[:L]
+        else:
+            best_cat = None
+
+        # ---- committed internal-node rows (grower.py N_* columns) --
+        f32 = lambda a: a.astype(jnp.float32)
+        lc_all = jnp.minimum(2 * ids_all + 1, T - 1)
+        rc_all = jnp.minimum(2 * ids_all + 2, T - 1)
+        lptr = jnp.where(committed[lc_all], f32(rank[lc_all]),
+                         -f32(slot[lc_all] + 1))
+        rptr = jnp.where(committed[rc_all], f32(rank[rc_all]),
+                         -f32(slot[rc_all] + 1))
+        node_cols = [f32(res["feat"]), f32(res["thr"]), f32(res["dl"]),
+                     res["gain"], res["out"], res["sh"], res["cn"],
+                     lptr, rptr]
+        if has_cat:
+            node_cols.append(f32(res["ncat"]))
+        node_rows = jnp.stack(node_cols, axis=1)        # [T, NN]
+        # dump slot = L-1, the node matrix's never-read scratch row
+        rk_nodes = jnp.where(committed, rank, L - 1)
+        node = jnp.zeros((L, NN), jnp.float32).at[rk_nodes].set(
+            node_rows)
+        if has_cat:
+            tree_cat = jnp.full((L, MAXK), -1, jnp.int32).at[
+                rk_nodes].set(res["catb"])[:L - 1]
+        else:
+            tree_cat = None
+
+        # ---- histogram pool: gather live leaves' level hists -------
+        # (raw accumulator dtype — the tail converts at scan time with
+        # the same per-tree scales; unborn slots alias the root row,
+        # which the tail never reads before writing)
+        pool = res["hists"][node_of_slot]               # [L, Fp, B, 3]
+        pool = pool.astype(hist_dtype)
+
+        state = GrowState(
+            leaf_id=leaf_slot,
+            hist=pool,
+            stats=stats,
+            best=best,
+            node=node,
+            num_leaves=(k0 + 1).astype(jnp.int32),
+            done=jnp.asarray(False),
+            best_cat=best_cat,
+            tree_cat=tree_cat,
+            path_mask=None,
+            forced_ok=jnp.asarray(True),
+            order=order_rows,
+            seg=seg,
+        )
+        return tail_grow(bins_rm, gh, feature_mask, cegb, rng_key,
+                         init=(state, k0))
+
+    return grow

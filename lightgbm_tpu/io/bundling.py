@@ -210,6 +210,30 @@ def pack_sparse_direct(csc, mappers, used_map: np.ndarray,
     return out
 
 
+def make_expand_hist(bundle: dict):
+    """Build ``expand_hist(hist_g [G, B, 3], sg, sh, cnt) -> [F, B, 3]``:
+    physical group histogram -> logical per-feature histogram with the
+    default bin's row reconstructed from the leaf totals
+    (≡ FixHistogram). Single source of truth shared by the sequential
+    grower and the level/hybrid schedulers — the hybrid handoff only
+    works because both sides expand group histograms identically."""
+    import jax.numpy as jnp
+    b_gmap = jnp.asarray(bundle["gather_map"], jnp.int32)      # [F, B]
+    b_default = jnp.asarray(bundle["default_bin"], jnp.int32)  # [F]
+
+    def expand_hist(hist_g, sg, sh, cnt):
+        flat = hist_g.reshape(-1, hist_g.shape[-1])
+        h = jnp.where(b_gmap[..., None] >= 0,
+                      flat[jnp.maximum(b_gmap, 0)], 0.0)
+        totals = jnp.stack([sg, sh, cnt])
+        rest = h.sum(axis=1)                                   # [F, 3]
+        dmask = (jnp.arange(h.shape[1])[None, :] ==
+                 b_default[:, None])
+        return h + dmask[..., None] * (totals[None, None, :] -
+                                       rest[:, None, :])
+    return expand_hist
+
+
 def decode_logical_bin(col_phys, offset, num_bin, default_bin):
     """Physical group bin -> logical feature bin (shared by the grower's
     decode_bin and the feature-parallel owner broadcast; single source

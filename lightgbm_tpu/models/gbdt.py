@@ -870,16 +870,16 @@ class GBDT:
         # histogram pool policy (ref: histogram_pool_size / LRU
         # HistogramPool, feature_histogram.hpp:1368): when the [L, F, B, 3]
         # pool would blow the budget (wide data), drop the pool and compute
-        # both children histograms per split instead
-        if self._compact:
-            n_phys = (self._bundle["num_groups"] if self._bundle is not None
-                      else train.num_used_features)
-            pool_bytes = (cfg.num_leaves * n_phys *
-                          self.num_bin_max * 3 * 4)
-            limit_bytes = (cfg.histogram_pool_size * (1 << 20)
-                           if cfg.histogram_pool_size >= 0 else 4 << 30)
+        # both children histograms per split instead. Level scheduling is
+        # exempt: the pure mode keeps no pool at all, and the hybrid tail
+        # REQUIRES the full pool (seeded from the level hists) — configs
+        # whose pool exceeds the budget already fell back to compact in
+        # _level_ineligibility above.
+        if self._compact and self.grower_cfg.row_sched != "level":
+            slot_bytes, limit_bytes = self._hist_budget(
+                n_feat_fallback=train.num_used_features)
+            pool_bytes = cfg.num_leaves * slot_bytes
             if pool_bytes > limit_bytes:
-                slot_bytes = n_phys * self.num_bin_max * 3 * 4
                 n_slots = int(limit_bytes // max(slot_bytes, 1))
                 if forced is not None:
                     log.warning(
@@ -952,11 +952,28 @@ class GBDT:
                     self.grower_cfg, self.feature_meta, forced=forced,
                     bundle=self._bundle, **hooks))
             elif self.grower_cfg.row_sched == "level":
-                # eligibility already resolved before the packing block
-                from ..core.level_grower import make_level_grower
-                self._grow = jax.jit(
-                    make_level_grower(self.grower_cfg,
-                                      self.feature_meta))
+                # eligibility already resolved before the packing
+                # block; depth routes pure vs hybrid (docs/TPU_RUNBOOK
+                # round-6 §3: the hybrid serves the DEFAULT 255-leaf
+                # unbounded-depth config)
+                from ..core.level_grower import (MAX_LEVEL_DEPTH,
+                                                 make_level_grower)
+                if 1 <= self.grower_cfg.max_depth <= MAX_LEVEL_DEPTH:
+                    self._grow = jax.jit(
+                        make_level_grower(self.grower_cfg,
+                                          self.feature_meta,
+                                          bundle=self._bundle))
+                else:
+                    from ..core.hybrid_grower import make_hybrid_grower
+                    d0 = int(cfg.tpu_level_handoff_depth)
+                    if d0 > MAX_LEVEL_DEPTH:
+                        log.warning(
+                            f"tpu_level_handoff_depth={d0} exceeds "
+                            f"MAX_LEVEL_DEPTH={MAX_LEVEL_DEPTH}; "
+                            "clamping")
+                    self._grow = jax.jit(make_hybrid_grower(
+                        self.grower_cfg, self.feature_meta,
+                        bundle=self._bundle, handoff_depth=d0))
             else:
                 self._grow = jax.jit(
                     make_tree_grower(self.grower_cfg, self.feature_meta,
@@ -1343,12 +1360,38 @@ class GBDT:
         self._cegb_row_charged = (np.zeros((F, self.num_data), bool)
                                   if lazy else None)
 
+    def _hist_budget(self, n_feat_fallback: int = 0):
+        """(bytes per [Fp, B, 3] histogram row, budget limit in bytes)
+        — the ONE place the histogram memory rule lives, shared by the
+        compact pool policy and the hybrid eligibility gate so the two
+        can never budget with different constants."""
+        cfg = self.config
+        if self._bundle is not None:
+            n_phys = self._bundle["num_groups"]
+        elif self.feature_meta is not None:
+            n_phys = int(self.feature_meta.num_bin.shape[0])
+        else:
+            n_phys = n_feat_fallback
+        row_bytes = n_phys * self.num_bin_max * 3 * 4
+        limit_bytes = (cfg.histogram_pool_size * (1 << 20)
+                       if cfg.histogram_pool_size >= 0 else 4 << 30)
+        return row_bytes, limit_bytes
+
     def _level_ineligibility(self, forced) -> list:
-        """Reasons the phase-A level grower cannot serve this config
-        (core/level_grower.py docstring); empty list = eligible."""
+        """Reasons level scheduling cannot serve this config (pure
+        level grower for max_depth in [1, MAX_LEVEL_DEPTH], the hybrid
+        level+tail grower otherwise — core/level_grower.py and
+        core/hybrid_grower.py docstrings); empty list = eligible.
+
+        Round-7 admissions: any max_depth (incl. the default -1, via
+        the hybrid), categorical features, EFB bundles and quantized
+        gradients are now served — they were histogram-layout
+        questions, not ordering questions. The remaining reasons are
+        order-dependent features (the sequential loop's step-by-step
+        state feeds back into later split decisions in ways a batched
+        level scan cannot reproduce) or other-learner layouts."""
         from ..core.level_grower import MAX_LEVEL_DEPTH
         from ..distributed import make_injected_hooks
-        from ..ops.split import meta_has_categorical
         cfg = self.config
         reasons = []
         if self._tree_learner != "serial":
@@ -1357,13 +1400,6 @@ class GBDT:
             reasons.append("multi-value sparse storage")
         if make_injected_hooks() is not None:
             reasons.append("injected collectives")
-        if not (1 <= self.grower_cfg.max_depth <= MAX_LEVEL_DEPTH):
-            reasons.append(
-                f"max_depth outside [1, {MAX_LEVEL_DEPTH}]")
-        if meta_has_categorical(self.feature_meta):
-            reasons.append("categorical features")
-        if self._bundle is not None:
-            reasons.append("EFB bundles")
         if self.grower_cfg.hparams.monotone_penalty > 0 or \
                 self.feature_meta.monotone is not None:
             reasons.append("monotone constraints")
@@ -1378,12 +1414,32 @@ class GBDT:
             reasons.append("forced splits")
         if self.grower_cfg.extra_trees:
             reasons.append("extra_trees")
-        if self.grower_cfg.quantized:
-            reasons.append("quantized gradients")
         if self.grower_cfg.bynode_mask:
             reasons.append("feature_fraction_bynode")
         if cfg.linear_tree:
             reasons.append("linear trees")
+        if not (1 <= self.grower_cfg.max_depth <= MAX_LEVEL_DEPTH):
+            # hybrid path: the sequential tail runs with the FULL
+            # [L, Fp, B, 3] histogram pool (its rows are seeded from
+            # the level hists), AND the level phase keeps ALL level
+            # hists [T, Fp, B, 3] with T = 2^(D0+1)-1 (~4L at the auto
+            # depth) alive through the ranking for that seeding.
+            # Budget BOTH against the histogram_pool_size limit —
+            # configs that exceed it would previously train compact
+            # with a bounded/none pool, which the handoff cannot seed
+            # (review r7: gating on the pool alone admitted wide
+            # configs whose phase hists alone exceed device HBM)
+            from ..core.hybrid_grower import resolve_handoff_depth
+            d0 = resolve_handoff_depth(cfg.num_leaves,
+                                       cfg.tpu_level_handoff_depth)
+            t_nodes = 2 ** (d0 + 1) - 1
+            row_bytes, limit_bytes = self._hist_budget()
+            need_bytes = (cfg.num_leaves + t_nodes) * row_bytes
+            if need_bytes > limit_bytes:
+                reasons.append(
+                    f"histogram memory over budget ({need_bytes >> 20}"
+                    " MB for the hybrid's full pool + level-phase "
+                    "hists)")
         return reasons
 
     def _cegb_penalty(self):

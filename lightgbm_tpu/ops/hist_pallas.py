@@ -43,6 +43,11 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams (~0.4.3x -> 0.5);
+# resolve whichever this jax ships so the kernel lowers on both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams")
+
 
 def _hist_kernel(bins_ref, gh_ref, out_ref, *, feature_tile: int,
                  num_bin_padded: int, int8_mode: bool = False,
@@ -157,7 +162,7 @@ def _hist_pallas_impl(bins_fm: jnp.ndarray, gh: jnp.ndarray, num_bin: int,
         out_specs=pl.BlockSpec((Cp, feature_tile * Bp), lambda i, j: (0, i),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((Cp, Fp * Bp), acc_dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(bins_fm.astype(jnp.int32), gh_t)

@@ -133,6 +133,29 @@ class SplitRecord(NamedTuple):
                       if max_cat else None))
 
 
+def pack_record_rows(rec: "SplitRecord", has_cat: bool) -> jnp.ndarray:
+    """SplitRecord (any leading shape) -> packed f32 [..., 12|13] rows in
+    the grower's best-row column layout (core/grower.py B_* columns):
+    [gain, feature, threshold, default_left, left (g, h, count, output),
+    right (g, h, count, output), num_cat?].
+
+    This IS the level->compact stat handoff layout: the level/hybrid
+    schedulers pack their per-node scan records here and the sequential
+    grower unpacks them with its ``unpack_rec``, so the two schedulers
+    exchange GrowState best rows through one shared contract instead of
+    a private one. Bin thresholds, feature ids and cat counts are
+    < 2^24, exact in f32; counts are f32 already (histogram count
+    channel)."""
+    vals = [rec.gain, rec.feature, rec.threshold, rec.default_left,
+            rec.left_sum_gradient, rec.left_sum_hessian,
+            rec.left_count, rec.left_output, rec.right_sum_gradient,
+            rec.right_sum_hessian, rec.right_count, rec.right_output]
+    if has_cat:
+        vals.append(rec.num_cat)
+    return jnp.stack([jnp.asarray(v).astype(jnp.float32) for v in vals],
+                     axis=-1)
+
+
 # ---------------------------------------------------------------------------
 # Gain math (ref: feature_histogram.hpp:712-830)
 # ---------------------------------------------------------------------------

@@ -75,10 +75,26 @@ def analyze(L: int = 255, R: int = 16384):
         if comp is not None and re.match(r"\s+(ROOT\s+)?\S+\s*=", ln):
             comps[comp].append(ln)
             # the outermost fori_loop: op_name metadata "jit(grow)/while"
+            # (jax <= 0.4.x inserts a "jit(main)/" segment; accept both)
             m = re.search(r"body=%?([\w.\-]+)", ln)
-            if m and 'op_name="jit(grow)/while"' in ln:
+            if m and re.search(r'op_name="jit\(grow\)/(jit\(main\)/)?'
+                               r'while"', ln):
                 body_name = m.group(1)
     total = sum(len(v) for v in comps.values())
+    if not (body_name and body_name in comps):
+        # newer/older XLA pipelines rename the fori body (e.g. the
+        # "wide.*region_*" widened clones) and drop the op_name
+        # metadata from the while line — fall back to the LARGEST
+        # while-body computation, which is the split loop by an order
+        # of magnitude (scatter-expansion whiles are ~5-10 instrs)
+        bodies = set()
+        for lines in comps.values():
+            for ln in lines:
+                m = re.search(r"body=%?([\w.\-]+)", ln)
+                if m and m.group(1) in comps:
+                    bodies.add(m.group(1))
+        if bodies:
+            body_name = max(bodies, key=lambda b: len(comps[b]))
     if body_name and body_name in comps:
         body = comps[body_name]
         ops = {}
@@ -88,6 +104,16 @@ def analyze(L: int = 255, R: int = 16384):
             ops[op] = ops.get(op, 0) + 1
         return total, len(body), ops, comps
     return total, None, {}, comps
+
+
+# body instructions with NO dispatch cost (tuple plumbing, literals):
+# the device cost model charges kernel launches, and these never launch
+FREE_BODY_OPS = ("get-tuple-element", "tuple", "parameter", "constant")
+
+
+def dispatch_ops(ops: dict) -> int:
+    """Dispatch-relevant body op count (the cost-model quantity)."""
+    return sum(n for op, n in ops.items() if op not in FREE_BODY_OPS)
 
 
 def main() -> None:

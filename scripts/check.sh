@@ -35,6 +35,19 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
+echo "== hybrid-path dispatch guards (compile budget + O(levels) shape) =="
+# the round-7 hot path: steady-state hybrid training must stay <=2
+# recompiles over 5 iterations and the level phase must issue
+# O(levels), not O(splits), dispatches (also covered by tier-1; this
+# explicit gate keeps the hybrid regression visible on its own line)
+JAX_PLATFORMS=cpu python -m pytest tests/test_dispatch_guards.py -q \
+    -p no:cacheprovider \
+    -k "hybrid or o_levels or steady_state" || rc=1
+if [ $rc -ne 0 ]; then
+    echo "check.sh: hybrid dispatch guards failed — skipping tier-1 pytest" >&2
+    exit $rc
+fi
+
 echo "== tier-1 pytest (CPU) =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || rc=1

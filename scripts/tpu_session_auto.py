@@ -12,12 +12,18 @@ git at the end.
 Invoked by scripts/tpu_watcher.py the moment a probe succeeds; safe to
 run by hand in a known-healthy window too. All stages run sequentially
 — one device claim at a time (docs/TPU_RUNBOOK.md wedge discipline).
+
+Round-6 hardening (VERDICT weak #1): the DRIVER-SHAPED 1M stage runs
+FIRST so the official number banks before anything can close the
+window, and a stage that outlives its deadline is PARKED — left
+running to finish its compile and release the claim cleanly — with
+every remaining stage skipped. No SIGKILL ever reaches a process that
+may hold the device claim.
 """
 from __future__ import annotations
 
 import json
 import os
-import signal
 import subprocess
 import sys
 import time
@@ -39,22 +45,41 @@ def say(msg: str) -> None:
     print(f"[session +{time.time() - T0:7.1f}s] {msg}", flush=True)
 
 
-def _run_group(cmd: list, env: dict, timeout: float):
-    """Run *cmd* in its own process group; kill the WHOLE group on
-    timeout. Returns (stdout, stderr, timed_out)."""
-    proc = subprocess.Popen(
-        cmd, env=env, cwd=REPO, text=True, start_new_session=True,
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
-    try:
-        stdout, stderr = proc.communicate(timeout=timeout)
-        return stdout, stderr, False
-    except subprocess.TimeoutExpired:
+# a stage that outlived its deadline and was left running: its bench
+# tree may hold the device claim mid-compile, and SIGKILLing that is
+# the documented machine-wide wedge trigger (VERDICT weak #1 — it
+# zeroed BENCH_r0{3,4,5}.json three rounds running). The session skips
+# every remaining stage instead and hands control back to the watcher.
+PARKED: dict = {"proc": None, "stage": None}
+
+
+class SessionParked(Exception):
+    """Raised when a stage is parked: no further device claims may be
+    made by this session (a parked claim-holder plus a fresh claim =
+    stacked claims = the wedge)."""
+
+
+def _run_stage(cmd: list, env: dict, timeout: float, logpath: str):
+    """Run *cmd* in its own process group with output to FILES (so an
+    abandoned child can never block on a pipe). NEVER kills on
+    timeout: the child is parked — left running to finish its compile
+    and release the claim cleanly — and (stdout_text, timed_out=True)
+    is returned with whatever output it produced so far."""
+    with open(logpath + ".stdout", "w", encoding="utf-8") as out_f, \
+            open(logpath, "a", encoding="utf-8") as err_f:
+        proc = subprocess.Popen(
+            cmd, env=env, cwd=REPO, text=True, start_new_session=True,
+            stdout=out_f, stderr=err_f)
+        timed_out = False
         try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            pass
-        stdout, stderr = proc.communicate()
-        return stdout or "", stderr or "", True
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            PARKED["proc"] = proc
+    with open(logpath + ".stdout", "r", encoding="utf-8",
+              errors="replace") as f:
+        stdout = f.read()
+    return stdout, timed_out
 
 
 def dump_state() -> None:
@@ -82,29 +107,32 @@ def run_bench(stage: str, rows: int, iters: int, extra: dict | None = None,
         env["BENCH_EXTRA"] = json.dumps(extra)
     if leaves is not None:
         env["BENCH_LEAVES"] = str(leaves)
+    if PARKED["proc"] is not None and PARKED["proc"].poll() is None:
+        # a previous stage is parked and still alive — no new claims
+        raise SessionParked(
+            f"stage {stage} skipped: stage {PARKED['stage']!r} is "
+            f"parked (pid={PARKED['proc'].pid} still running)")
     say(f"stage {stage}: rows={rows} iters={iters} extra={extra} "
         f"leaves={leaves}")
     logpath = os.path.join(LOGDIR, f"r05_{stage}.log")
     # bench.py's internal watchdog is the normal exit path; this outer
-    # deadline only fires if bench.py itself wedges. The bench tree runs
-    # in its own process group so a deadline kill cannot orphan the
-    # grandchild that holds the device claim (an orphaned claim-holder
-    # plus the next stage's fresh claim = stacked claims = the
-    # documented machine-wide wedge trigger).
-    stdout, stderr, timed_out = _run_group(
+    # deadline only fires if bench.py itself wedges. On expiry the
+    # bench tree is PARKED, never killed: its grandchild may hold the
+    # device claim mid-compile, and a SIGKILL there is the documented
+    # machine-wide wedge trigger (VERDICT weak #1 — three rounds of
+    # zeroed BENCH json). Remaining stages are skipped via
+    # SessionParked so no fresh claim can stack on the parked one.
+    stdout, timed_out = _run_stage(
         [sys.executable, os.path.join(REPO, "bench.py")],
-        env=env, timeout=watchdog + 300)
-    with open(logpath, "a", encoding="utf-8") as f:
-        if timed_out:
-            f.write(f"TIMEOUT after {watchdog + 300}s (process group "
-                    "killed)\n")
-        f.write(stderr)
-        f.write(stdout)
+        env=env, timeout=watchdog + 300, logpath=logpath)
     if timed_out:
-        say(f"stage {stage}: TIMEOUT — cooling down 120s before any "
-            "further claim")
-        time.sleep(120)
-        return None
+        PARKED["stage"] = stage
+        with open(logpath, "a", encoding="utf-8") as f:
+            f.write(f"PARKED after {watchdog + 300}s (left running; "
+                    "session skips remaining stages)\n")
+        say(f"stage {stage}: deadline expired — child PARKED (pid="
+            f"{PARKED['proc'].pid}), skipping all remaining stages")
+        raise SessionParked(f"stage {stage} parked at its deadline")
     proc_stdout = stdout
     result = None
     for ln in proc_stdout.splitlines():
@@ -117,6 +145,14 @@ def run_bench(stage: str, rows: int, iters: int, extra: dict | None = None,
     if result is not None:
         result["stage"] = stage
         RESULTS.append(result)
+        if result.get("status") == "parked":
+            # bench.py exited but left a claim-holding grandchild
+            # RUNNING (its internal watchdog preempts ours, so the
+            # PARKED proc-handle guard above never sees it) — no
+            # further claims from this session
+            dump_state()
+            raise SessionParked(
+                f"stage {stage}: bench parked a claim-holding child")
         say(f"stage {stage}: {result.get('value')} it/s "
             f"(vs_baseline {result.get('vs_baseline')})")
     else:
@@ -209,6 +245,16 @@ def main() -> int:
     stash_tuned()
     try:
         return _stages()
+    except SessionParked as e:
+        # a stage deadline expired with a live (possibly claim-holding)
+        # bench tree: it was left running and every later stage is
+        # skipped — never SIGKILL a claim holder, never stack claims
+        say(f"session parked: {e}")
+        STATE["parked"] = str(e)
+        dump_state()
+        git_commit("bench_logs: session parked at a stage deadline "
+                   "(claim holder left running, no kill)")
+        return 3
     finally:
         # any exit path that did not merge fresh flips (exception,
         # guard bail, watcher kill that still lets finally run)
@@ -225,27 +271,42 @@ def _stages() -> int:
         fails = fails + 1 if unreachable(res) else 0
         return fails >= MAX_CONSEC_FAILS
 
+    # ---- stage 0: the DRIVER-SHAPED 1M headline FIRST (VERDICT weak
+    # #1: three rounds running, the official BENCH_r0X.json stayed 0.0
+    # because this exact shape only ran after earlier stages had
+    # wedged the device — bank the official number before anything
+    # else can park or close the window)
+    h1m = run_bench("headline_1m", 1_000_000, 20)
+    if guard(h1m):
+        say("window closed during headline_1m — bailing")
+        git_commit("bench_logs: r6 session aborted at the 1M headline")
+        return 3
+
+    # ---- stage 0.5: hybrid level scheduling at the SAME driver shape
+    # (round-7 tentpole: 255 leaves / max_depth=-1 is level-eligible
+    # now — headline_1m above is its compact baseline pair; ≥1.5x here
+    # makes level the default scheduler for the headline)
+    h1m_lvl = run_bench("headline_1m_level", 1_000_000, 20,
+                        scheds="level")
+    if guard(h1m_lvl):
+        git_commit("bench_logs: r6 partial session (compact 1M only)")
+        return 3
+
     # ---- stage 00: micro number (16k rows, 31 leaves, seconds of
-    # compile) — if the window collapses right after the probe, ANY
-    # measured non-zero beats another 0.0 round; the _L31 suffix keeps
-    # it from masquerading as the headline metric
+    # compile); the _L31 suffix keeps it from masquerading as the
+    # headline metric
     micro = run_bench("micro_16k", 16_384, 10, leaves=31, watchdog=900)
     if guard(micro):
         say("window closed during micro_16k — bailing")
-        git_commit("bench_logs: r5 session aborted at micro stage")
+        git_commit("bench_logs: r6 partial session (1M headlines landed)")
         return 3
 
-    # ---- stage 0+1: headline numbers first (most valuable if the
-    # window is short; also warms the persistent compile cache)
+    # ---- stage 1: the 100k headline (compile-cache warm by now)
     h100 = run_bench("headline_100k", 100_000, 30, watchdog=1500)
     if guard(h100):
         say("window closed during headline_100k — bailing")
-        git_commit("bench_logs: r5 session aborted (device window closed; "
-                   "micro number landed)")
-        return 3
-    h1m = run_bench("headline_1m", 1_000_000, 20)
-    if guard(h1m):
-        git_commit("bench_logs: r5 partial session (100k only)")
+        git_commit("bench_logs: r6 session aborted (device window closed; "
+                   "1M + micro numbers landed)")
         return 3
 
     # ---- stage 2: A/Bs at 100k (compile-dominated, fast turnaround).

@@ -25,20 +25,90 @@ def _data(seed=5, n=2000, f=8):
     return X, y
 
 
-@pytest.mark.parametrize("sched", ["leaf", "level"])
-def test_train_one_iter_steady_state_compile_budget(compile_budget, sched):
+@pytest.mark.parametrize("sched,max_depth", [
+    ("leaf", -1),
+    ("level", 6),     # pure level mode
+    ("level", -1),    # HYBRID level+tail (the round-7 default-config
+                      # path: level phase + traced-start fori tail —
+                      # the traced k0 cut must not retrace per tree)
+])
+def test_train_one_iter_steady_state_compile_budget(compile_budget, sched,
+                                                    max_depth):
     """5 post-warmup iterations of GBDT.train_one_iter stay within a
     2-compile budget (steady state is 0; the slack absorbs one-off eager
     primitives from host-side bookkeeping, never a per-iteration jit)."""
     X, y = _data()
     params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
-              "tpu_row_scheduling": sched}
+              "max_depth": max_depth, "tpu_row_scheduling": sched}
     booster = lgb.Booster(params, lgb.Dataset(X, label=y))
     for _ in range(3):  # warmup: trace + compile the training programs
         booster.update()
-    with compile_budget(2, f"train_one_iter x5 [{sched}]"):
+    with compile_budget(2, f"train_one_iter x5 [{sched}/{max_depth}]"):
         for _ in range(5):
             booster.update()
+
+
+def _grower_compiled_text(make, cfg_kw):
+    """Compile a grower at a tiny CPU geometry; return optimized HLO."""
+    import re
+    from lightgbm_tpu.core.grower import GrowerConfig
+    from lightgbm_tpu.ops.split import FeatureMeta, SplitHyperParams
+    F, B, R = 8, 64, 2048
+    meta = FeatureMeta(
+        num_bin=jnp.full((F,), B, jnp.int32),
+        missing_type=jnp.zeros((F,), jnp.int32),
+        default_bin=jnp.zeros((F,), jnp.int32),
+        is_categorical=jnp.zeros((F,), bool),
+        monotone=None)
+    cfg = GrowerConfig(num_bin=B,
+                       hparams=SplitHyperParams(min_data_in_leaf=20),
+                       hist_rm_backend="scatter",
+                       partition_mode="scatter", **cfg_kw)
+    bins = jnp.zeros((R, F), jnp.uint8)
+    gh = jnp.zeros((R, 3), jnp.float32)
+    txt = jax.jit(make(cfg, meta)).lower(bins, gh).compile().as_text()
+    n = sum(1 for ln in txt.splitlines()
+            if re.match(r"\s+(%|ROOT )", ln))
+    return n
+
+
+def test_level_phase_dispatch_count_is_o_levels():
+    """The level program's compiled instruction count — the dispatch
+    proxy (docs/TPU_RUNBOOK.md cost model: every top-level kernel is a
+    tunnel launch; there is no sequential while loop here) — must
+    scale with DEPTH, not with num_leaves. 63 -> 255 leaves is 4.1x
+    the splits but only 6 -> 8 levels; a split-loop-shaped program
+    would blow the 2x bound (measured ratio ~1.3)."""
+    from lightgbm_tpu.core.level_grower import make_level_grower
+    small = _grower_compiled_text(
+        make_level_grower, dict(num_leaves=63, max_depth=6,
+                                row_sched="level"))
+    big = _grower_compiled_text(
+        make_level_grower, dict(num_leaves=255, max_depth=8,
+                                row_sched="level"))
+    assert big < small * 2.0, (
+        f"level program instrs scaled like splits, not levels: "
+        f"{small} -> {big}")
+
+
+def test_hybrid_program_shape():
+    """The hybrid program = one straight-line level phase + ONE
+    sequential tail loop. Its instruction count stays within a small
+    constant of the pure level program at the same geometry — i.e. the
+    handoff/assembly does not smuggle an O(splits) unrolled stage back
+    in."""
+    from lightgbm_tpu.core.hybrid_grower import make_hybrid_grower
+    from lightgbm_tpu.core.level_grower import make_level_grower
+    pure = _grower_compiled_text(
+        make_level_grower, dict(num_leaves=63, max_depth=6,
+                                row_sched="level"))
+    hybrid = _grower_compiled_text(
+        make_hybrid_grower, dict(num_leaves=63, max_depth=-1,
+                                 row_sched="level"))
+    # level phase to D0=7 (auto for 63 leaves) + tail body + handoff:
+    # comfortably under 3x the pure-D6 program, nowhere near the ~62
+    # unrolled splits a sequential-shaped program would add
+    assert hybrid < pure * 3.0, (pure, hybrid)
 
 
 def test_compile_budget_fails_a_deliberately_recompiling_loop(

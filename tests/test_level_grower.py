@@ -105,9 +105,13 @@ def test_budget_binding_parity():
 
 
 def test_fallback_configs_warn_and_work():
-    """Ineligible configs fall back to the sequential grower."""
+    """Ineligible configs fall back to the sequential grower.
+
+    (max_depth=-1 is NOT on this list anymore — the hybrid level+tail
+    grower serves unbounded depth since round 7; the remaining reasons
+    are order-dependent features.)"""
     X, y = _data(seed=7, n=1500, f=4)
-    p = _params("level", max_depth=-1)  # unbounded depth: ineligible
+    p = _params("level", extra_trees=True)  # random thresholds: ineligible
     bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=2)
     assert np.isfinite(bst.predict(X)).all()
     p2 = _params("level", monotone_constraints=[1, 0, 0, 0])
@@ -159,6 +163,165 @@ def test_multiclass_level_close():
                                rtol=5e-3, atol=5e-4)
 
 
+# ---------------------------------------------------------------------------
+# Phase B (round 7): hybrid level+tail growth + eligibility admissions
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("d0", [1, 5])
+def test_hybrid_unbounded_depth_exact_parity(d0):
+    """max_depth=-1 (the previously-excluded DEFAULT shape): the level
+    phase to D0 + sequential tail must reproduce the compact grower's
+    tree bit for bit at extreme handoff depths — d0=1 puts nearly the
+    whole tree in the tail, d0=5 most of it in the level phase (the
+    255-leaf test below covers auto; d0 in {0, 3, 8} also verified
+    bit-exact, trimmed from CI for the tier-1 time budget)."""
+    X, y = _data(seed=13, n=6000)
+    kw = dict(max_depth=-1, num_leaves=63, min_data_in_leaf=5)
+    b_seq = lgb.train(_params("compact", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=1)
+    b_hyb = lgb.train(_params("level", tpu_level_handoff_depth=d0, **kw),
+                      lgb.Dataset(X, label=y), num_boost_round=1)
+    assert sorted(_dump_splits(b_seq)) == sorted(_dump_splits(b_hyb))
+    np.testing.assert_array_equal(b_hyb.predict(X), b_seq.predict(X))
+
+
+def test_hybrid_default_255_leaf_exact_parity():
+    """The driver-shaped default config (255 leaves, max_depth=-1,
+    serial): level-eligible AND bit-identical to compact — the
+    acceptance criterion of the round-7 tentpole. The grown tree goes
+    well past MAX_LEVEL_DEPTH, so the sequential tail provably runs."""
+    X, y = _data(seed=13, n=6000)
+    kw = dict(max_depth=-1, num_leaves=255, min_data_in_leaf=5)
+    b_seq = lgb.train(_params("compact", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=1)
+    b_hyb = lgb.train(_params("level", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=1)
+    s_seq = _dump_splits(b_seq)
+    assert max(d for _, _, d in s_seq) > 10  # tail territory reached
+    assert sorted(s_seq) == sorted(_dump_splits(b_hyb))
+    np.testing.assert_array_equal(b_hyb.predict(X), b_seq.predict(X))
+    # the default config must be level-ELIGIBLE, not a silent fallback
+    assert b_hyb._engine._level_ineligibility(None) == []
+    assert b_hyb._engine.grower_cfg.row_sched == "level"
+
+
+def test_hybrid_multi_iteration_close():
+    X, y = _data(seed=9)
+    kw = dict(max_depth=-1, num_leaves=63)
+    b_seq = lgb.train(_params("compact", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=8)
+    b_hyb = lgb.train(_params("level", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=8)
+    np.testing.assert_allclose(b_hyb.predict(X), b_seq.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("depth", [6, -1])
+def test_quantized_admission_parity(depth):
+    """Quantized int8 gradients in level/hybrid mode: the shared
+    quantize_gradients helper (same rng fold) + exact int32 histogram
+    algebra make the trees bit-identical to compact quantized — on
+    BOTH sides of a hybrid handoff."""
+    X, y = _data(seed=5)
+    kw = dict(max_depth=depth, use_quantized_grad=True, seed=3)
+    b_seq = lgb.train(_params("compact", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=1)
+    b_lvl = lgb.train(_params("level", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=1)
+    assert sorted(_dump_splits(b_seq)) == sorted(_dump_splits(b_lvl))
+    np.testing.assert_array_equal(b_lvl.predict(X), b_seq.predict(X))
+
+
+@pytest.mark.parametrize("depth", [6, -1])
+def test_categorical_admission_parity(depth):
+    """Categorical features in level/hybrid mode: the vmapped scan's
+    per-node category sets + the per-row membership partition must
+    reproduce the sequential trees split for split."""
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(4000, 8)).astype(np.float32)
+    X[:, 3] = rng.integers(0, 12, size=4000).astype(np.float32)
+    X[:, 5] = rng.integers(0, 5, size=4000).astype(np.float32)
+    y = ((X[:, 3] % 3 == 0).astype(np.float32) * 2 + X[:, 0] +
+         0.2 * rng.normal(size=4000) > 0.5).astype(np.float32)
+    kw = dict(max_depth=depth, categorical_feature="3,5")
+    b_seq = lgb.train(_params("compact", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=1)
+    b_lvl = lgb.train(_params("level", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=1)
+    assert sorted(_dump_splits(b_seq)) == sorted(_dump_splits(b_lvl))
+    np.testing.assert_array_equal(b_lvl.predict(X), b_seq.predict(X))
+
+
+def _bundle_data(seed=11, n=3000, groups=4, per=5):
+    """Mutually-exclusive few-bin blocks: bundleable by EFB (the
+    per-group bin widths must fit the 256-bin group budget)."""
+    rng = np.random.default_rng(seed)
+    F = groups * per
+    X = np.zeros((n, F), np.float32)
+    picks = [rng.integers(0, per, size=n) for _ in range(groups)]
+    for g in range(groups):
+        X[np.arange(n), g * per + picks[g]] = rng.integers(
+            1, 8, size=n).astype(np.float32)
+    y = ((picks[0] % 2 == 0) ^ (picks[1] == 1) ^
+         (X[:, 0] > 4)).astype(np.float32)
+    return X, y
+
+
+@pytest.mark.parametrize("depth", [6, -1])
+def test_efb_admission_parity(depth):
+    """EFB bundles in level/hybrid mode: level histograms run over the
+    PHYSICAL group columns and expand per node at scan time
+    (make_expand_hist) — trees must match the compact bundled path."""
+    X, y = _bundle_data()
+    kw = dict(max_depth=depth, num_leaves=15, enable_bundle=True,
+              min_data_in_leaf=5, tpu_sparse_storage="dense")
+    b_seq = lgb.train(_params("compact", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=1)
+    b_lvl = lgb.train(_params("level", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=1)
+    # the recipe must actually engage bundling on both arms, or this
+    # test silently degrades to the dense path
+    assert b_seq._engine._bundle is not None
+    assert b_lvl._engine._bundle is not None
+    assert sorted(_dump_splits(b_seq)) == sorted(_dump_splits(b_lvl))
+    np.testing.assert_array_equal(b_lvl.predict(X), b_seq.predict(X))
+
+
+def test_hybrid_with_bagging_close():
+    """Bagged rows ride through the level phase AND the handoff
+    (physical seg counts include mask-zero rows on both sides)."""
+    X, y = _data(seed=23)
+    kw = dict(bagging_fraction=0.7, bagging_freq=1, seed=3,
+              max_depth=-1, num_leaves=63)
+    b_seq = lgb.train(_params("compact", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=6)
+    b_hyb = lgb.train(_params("level", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=6)
+    p_hyb, p_seq = b_hyb.predict(X), b_seq.predict(X)
+    close = np.isclose(p_hyb, p_seq, rtol=1e-4, atol=1e-5)
+    assert close.mean() >= 0.999, \
+        f"{int((~close).sum())}/{len(close)} rows diverged"
+    assert np.abs(p_hyb - p_seq).max() < 0.2
+
+
+def test_pallas_blocks_parity_interpret(monkeypatch):
+    """The blocks-mode level histogram under the REAL pallas kernel
+    (interpret mode on CPU), vmapped over nodes with edge windows as
+    small as bs=256 — the exact combination the r05 einsum pin guards
+    (ADVICE medium). Tree parity with the scatter path is the evidence
+    that lets the pin be lifted after a device A/B."""
+    monkeypatch.setenv("LGBM_TPU_LEVEL_PALLAS", "1")
+    X, y = _data(seed=21)
+    kw = dict(max_depth=6, num_leaves=31)
+    b_sc = lgb.train(_params("level", tpu_hist_kernel="scatter", **kw),
+                     lgb.Dataset(X, label=y), num_boost_round=1)
+    b_pl = lgb.train(_params("level", tpu_hist_kernel="pallas", **kw),
+                     lgb.Dataset(X, label=y), num_boost_round=1)
+    assert sorted(_dump_splits(b_sc)) == sorted(_dump_splits(b_pl))
+    np.testing.assert_array_equal(b_pl.predict(X), b_sc.predict(X))
+
+
 def test_blocks_hist_matches_scatter_hist():
     """The blocks formulation (sorted rows + block prefix + edge
     windows — the TPU shape) must produce the same trees as the
@@ -201,7 +364,7 @@ def test_fallback_keeps_packed_bins():
     decision, so an ineligible level config keeps the compact
     scheduler's packing."""
     X, y = _data(seed=8, n=800, f=4)
-    p = _params("level", max_depth=-1, tpu_packed_bins="true")
+    p = _params("level", extra_trees=True, tpu_packed_bins="true")
     bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=2)
     assert bst._engine._packed_cols > 0
     assert np.isfinite(bst.predict(X)).all()

@@ -16,7 +16,7 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
 
-from body_opcount import analyze  # noqa: E402
+from body_opcount import analyze, dispatch_ops  # noqa: E402
 
 # round-4 landed 128; round-5's paired (parent, new-leaf) scatters
 # (_set_rows2) brought it to 105; the iteration-space suffix scan (no
@@ -24,7 +24,16 @@ from body_opcount import analyze  # noqa: E402
 # winner fetch, inline row packing, meta scalar constants and the
 # paired node write brought it to 78. Lower as the body shrinks —
 # never raise without a device-measured justification.
-BODY_INSTR_CEILING = 78
+#
+# Round 7: the gate counts DISPATCH-relevant body ops (body_opcount.
+# dispatch_ops — tuple plumbing and literals never launch a kernel),
+# because this image's XLA renames the fori body to a "wide.*region"
+# clone whose raw line count includes ~30 get-tuple-element/constant
+# lines the old metadata-matched body did not carry. The ceiling is
+# RE-BASELINED to the new metric (61 measured + 4 slack for XLA
+# fusion-boundary jitter) — carrying the old 78 over would hand a
+# future regression ~17 free kernels per split.
+BODY_INSTR_CEILING = 65
 
 
 def test_while_body_op_floor():
@@ -32,6 +41,8 @@ def test_while_body_op_floor():
     # (verified: same 128 at R=16384 and R=4096)
     total, body_n, ops, _ = analyze(L=255, R=4096)
     assert body_n is not None, "grower while body not found in HLO"
-    assert body_n <= BODY_INSTR_CEILING, (
-        f"while-body grew to {body_n} instrs (> {BODY_INSTR_CEILING}); "
-        f"opcode histogram: {sorted(ops.items(), key=lambda kv: -kv[1])}")
+    n_dispatch = dispatch_ops(ops)
+    assert n_dispatch <= BODY_INSTR_CEILING, (
+        f"while-body grew to {n_dispatch} dispatch ops "
+        f"(> {BODY_INSTR_CEILING}); opcode histogram: "
+        f"{sorted(ops.items(), key=lambda kv: -kv[1])}")
