@@ -10,9 +10,17 @@ region, matching the reference's convention of reporting training time.
 count (per-iteration cost in histogram GBDT is ~linear in rows at fixed
 leaves/bins): ref_ips(N) = 3.843 * (10.5e6 / N).
 
-Robustness: the parent process tries each row-scheduling mode in a child
-subprocess with a deadline (the TPU terminal compiles remotely and has
-wedged on oversized programs before); the first mode that completes wins.
+Robustness (ISSUE 4 — heartbeat-aware supervision): every child writes
+phase-tagged heartbeats (compiling / warmup / measuring, robustness/
+heartbeat.py) and the parent replaces blind wall-clock slots with
+phase-aware liveness deadlines: a child advancing is never parked, a
+child silent past its phase's stall budget is classified hung
+(DeviceStallError, transient) and RETRIED — with the persistent compile
+cache (LGBM_TPU_COMPILE_CACHE) shared across attempts so the retry skips
+the multi-minute compile that used to eat the watchdog. Measurement
+children additionally BANK partial throughput (a crash-safe JSON
+rewrite) so a stage that parks or stalls late still salvages its last
+banked number instead of reporting an unconditional 0.0.
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "iters/sec", "vs_baseline": N}
 """
@@ -20,9 +28,16 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
+
+from lightgbm_tpu.robustness import heartbeat
+from lightgbm_tpu.robustness.supervisor import (DeviceStallError,
+                                                StillAlive, watch_child)
+from lightgbm_tpu.utils.jit_cache import (ENV_COMPILE_CACHE,
+                                          resolve_cache_dir)
 
 # Watchdog: if the device/tunnel wedges (or compile stalls pathologically),
 # emit an honest zero-result line instead of hanging the driver forever.
@@ -50,6 +65,18 @@ REF_HIGGS_ROWS = 10_500_000
 # environments where the compact program cannot compile/run in time
 SCHED_MODES = os.environ.get("BENCH_SCHEDS", "compact,full").split(",")
 
+# how many times a STALL-classified (heartbeat-silent) measurement child
+# is relaunched before salvaging; with the compile cache warm a retry
+# costs a cache read, not a recompile
+BENCH_MEASURE_ATTEMPTS = int(os.environ.get("BENCH_MEASURE_ATTEMPTS", 2))
+# partial-result banking cadence inside the timed loop (seconds between
+# banks; each bank costs one device sync, so the default is sized to
+# never fire during a healthy fast run — 0 banks after every iteration,
+# for tests)
+ENV_PARTIAL = "LGBM_TPU_PARTIAL"
+PARTIAL_EVERY_SEC = float(os.environ.get("LGBM_TPU_PARTIAL_EVERY_SEC",
+                                         45.0))
+
 
 # non-default configs (leaves ladder, dtype modes) are labeled so their
 # numbers can't masquerade as the headline metric
@@ -69,16 +96,24 @@ RC_NO_RESULT = 3
 RC_DEVICE_UNREACHABLE = 4
 
 
-def _fail_line(note: str, status: str = "no_result") -> str:
-    return json.dumps({
+def _result_record(ips: float, **extra) -> dict:
+    """The ONE place the benchmark record shape lives (metric name,
+    reference-scaled vs_baseline): shared by the headline result, the
+    banked partials and the failure lines so they can never
+    desynchronize."""
+    ref_ips_at_n = REF_HIGGS_IPS * (REF_HIGGS_ROWS / N_ROWS)
+    return {
         "metric": f"higgs_synth_{N_ROWS}x{N_FEATURES}"
                   f"_iters_per_sec{_SUFFIX}",
-        "value": 0.0,
+        "value": round(ips, 4),
         "unit": "iters/sec",
-        "vs_baseline": 0.0,
-        "status": status,
-        "note": note,
-    })
+        "vs_baseline": round(ips / ref_ips_at_n, 4) if ips else 0.0,
+        **extra,
+    }
+
+
+def _fail_line(note: str, status: str = "no_result") -> str:
+    return json.dumps(_result_record(0.0, status=status, note=note))
 
 
 def _force_sync(arr) -> float:
@@ -105,13 +140,35 @@ def synth_higgs(n, f, seed=0):
     return X, y
 
 
+def _bank_partial(path: str, sched: str, iters_done: int,
+                  elapsed: float) -> None:
+    """Crash-safe rewrite of the partial-result file (tmp + replace):
+    whatever the parent finds here after a park/stall is the last
+    throughput the device PROVABLY sustained (each bank follows a full
+    device sync)."""
+    if not path or iters_done <= 0 or elapsed <= 0:
+        return
+    rec = _result_record(iters_done / elapsed, sched=sched,
+                         partial=True, iters_done=iters_done)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(json.dumps(rec))
+        os.replace(tmp, path)
+    except OSError as e:
+        print(f"[bench] partial bank failed: {e!r}", file=sys.stderr)
+
+
 def run_child(sched: str) -> None:
     """Measure one scheduling mode and print the JSON result line."""
     _apply_platform_override()
+    heartbeat.install_from_env()
+    heartbeat.beat(heartbeat.PHASE_COMPILING, 0)
     from lightgbm_tpu.utils.jit_cache import enable_persistent_cache
     enable_persistent_cache()
     import lightgbm_tpu as lgb
 
+    partial_path = os.environ.get(ENV_PARTIAL, "")
     X, y = synth_higgs(N_ROWS, N_FEATURES)
     params = {
         "objective": "binary",
@@ -136,20 +193,36 @@ def run_child(sched: str) -> None:
         print(f"[bench] 31-leaf probe compile+step ok "
               f"({time.perf_counter() - t0:.1f}s)", file=sys.stderr)
         del probe_b
+    heartbeat.beat(heartbeat.PHASE_COMPILING, 1)
     booster = lgb.Booster(params, ds)
-    for _ in range(WARMUP_ITERS):      # compile + cache warm
+    for w in range(WARMUP_ITERS):      # compile + cache warm
+        heartbeat.beat(heartbeat.PHASE_WARMUP, w)
         booster.update()
 
     _force_sync(booster._engine.score)
     from lightgbm_tpu.utils.timer import global_timer
     global_timer.reset()  # drop warmup/compile time from the table
+    heartbeat.beat(heartbeat.PHASE_MEASURING, 0)
     t0 = time.perf_counter()
-    for _ in range(TIMED_ITERS):
+    next_bank = (t0 + PARTIAL_EVERY_SEC) if partial_path else None
+    for i in range(TIMED_ITERS):
         booster.update()
+        heartbeat.beat(heartbeat.PHASE_MEASURING, i + 1)
+        if next_bank is not None and i + 1 < TIMED_ITERS and \
+                time.perf_counter() >= next_bank:
+            # salvage point: sync so the banked rate covers COMPLETED
+            # work, then re-arm the cadence (healthy fast runs never
+            # reach the first bank — zero cost on the headline)
+            _force_sync(booster._engine.score)
+            _bank_partial(partial_path, sched, i + 1,
+                          time.perf_counter() - t0)
+            next_bank = time.perf_counter() + PARTIAL_EVERY_SEC
     _force_sync(booster._engine.score)
     dt = time.perf_counter() - t0
 
     ips = TIMED_ITERS / dt
+    if partial_path:
+        _bank_partial(partial_path, sched, TIMED_ITERS, dt)
     if global_timer.enabled:
         print(global_timer.table(), file=sys.stderr)
     # quality line (stderr): lets dtype/kernel modes prove they didn't
@@ -204,18 +277,11 @@ def run_child(sched: str) -> None:
             print(f"[bench] depth stats failed: {e!r}", file=sys.stderr)
     except Exception as e:          # quality line must never kill the bench
         print(f"[bench] quality line failed: {e!r}", file=sys.stderr)
-    ref_ips_at_n = REF_HIGGS_IPS * (REF_HIGGS_ROWS / N_ROWS)
-    print(json.dumps({
-        "metric": f"higgs_synth_{N_ROWS}x{N_FEATURES}"
-                  f"_iters_per_sec{_SUFFIX}",
-        "value": round(ips, 4),
-        "unit": "iters/sec",
-        "vs_baseline": round(ips / ref_ips_at_n, 4),
-        "sched": sched,
+    print(json.dumps(_result_record(
+        ips, sched=sched,
         # model-based: hist-kernel FLOPs over the measured 156 TFLOP/s
         # tunnel peak — a trendline, NOT a hardware utilization counter
-        "mfu_model": round(_hist_mfu(ips, sched), 6),
-    }), flush=True)
+        mfu_model=round(_hist_mfu(ips, sched), 6))), flush=True)
 
 
 # Measured bf16 MXU peak through this tunnel (docs/TPU_RUNBOOK.md:
@@ -264,6 +330,8 @@ def _apply_platform_override() -> None:
 def run_probe() -> None:
     """Tiny end-to-end sanity: device claim + a small jitted train step."""
     _apply_platform_override()
+    heartbeat.install_from_env()
+    heartbeat.beat(heartbeat.PHASE_COMPILING, 0)
     # fault harness hook: LGBM_TPU_FAULTS=probe_timeout (inherited via
     # env) makes this child fail with the UNAVAILABLE signature, so the
     # parent's shared retry policy is testable without a flaky device
@@ -284,119 +352,159 @@ def run_probe() -> None:
           flush=True)
 
 
-def _spawn(env_extra: dict, timeout: float) -> subprocess.CompletedProcess:
-    """Run this script as a child with extra env, shared argv/capture/cwd.
-
-    PROBE children only: a probe that blows its slot is a claim-WAITER
-    and killing it is benign (docs/TPU_RUNBOOK.md wedge discipline);
-    measurement children go through _spawn_claim_holder below, which
-    never kills."""
-    return subprocess.run(
-        [sys.executable, os.path.abspath(__file__)],
-        env=dict(os.environ, **env_extra),
-        timeout=timeout, capture_output=True, text=True,
-        cwd=os.path.dirname(os.path.abspath(__file__)))
-
-
 class _ParkedChild(Exception):
-    """A measurement child outlived every wait budget and was left
-    RUNNING (parked): it may hold the device claim mid-compile, and a
-    SIGKILL there is the documented machine-wide wedge trigger that
-    zeroed BENCH_r0{3,4,5}.json three rounds running (VERDICT weak #1).
-    The parent reports no_result and skips remaining stages instead."""
+    """A measurement child was left RUNNING (parked): either it was
+    alive AND ADVANCING at the hard watchdog deadline, or it was
+    classified hung but ignored SIGTERM. Its bench tree may hold the
+    device claim mid-compile, and a SIGKILL there is the documented
+    machine-wide wedge trigger that zeroed BENCH_r0{3,4,5}.json three
+    rounds running (VERDICT weak #1). The parent salvages the last
+    banked partial (if any) and skips remaining stages."""
 
 
-def _spawn_claim_holder(env_extra: dict, slot: float,
-                        hard_deadline: float):
-    """Run a measurement child with file-redirected output and a slot
-    deadline that does NOT kill on expiry.
+class _ChildSpawn:
+    """One supervised child: file-redirected streams (an abandoned
+    child can never block on a pipe) + its own heartbeat and
+    partial-result files, compile cache shared across attempts."""
 
-    The child passed the probe, so it is presumed to HOLD the device
-    claim (possibly mid-compile). On slot expiry we keep waiting up to
-    ``hard_deadline`` (letting it finish and still banking its result);
-    if it is STILL running there, it is left alive — detached from our
-    pipes (output goes to temp files, so nothing blocks) — and
-    _ParkedChild is raised so the caller skips every remaining stage.
+    def __init__(self, env_extra: dict, tag: str,
+                 partial: bool = False):
+        self.out_f = tempfile.NamedTemporaryFile(
+            mode="w+", prefix=f"bench_{tag}_", suffix=".out",
+            delete=False)
+        self.err_f = tempfile.NamedTemporaryFile(
+            mode="w+", prefix=f"bench_{tag}_", suffix=".err",
+            delete=False)
+        # mkstemp (not the race-prone mktemp): the file exists from
+        # birth with 0600 perms; an empty heartbeat/partial file reads
+        # as "no record yet", which is exactly right
+        fd, self.hb_path = tempfile.mkstemp(prefix=f"bench_{tag}_",
+                                            suffix=".hb")
+        os.close(fd)
+        self.partial_path = ""
+        if partial:
+            fd, self.partial_path = tempfile.mkstemp(
+                prefix=f"bench_{tag}_", suffix=".partial")
+            os.close(fd)
+        env = dict(os.environ, **env_extra)
+        env[heartbeat.ENV_HEARTBEAT] = self.hb_path
+        env[ENV_COMPILE_CACHE] = _cache_dir()
+        env.pop(ENV_PARTIAL, None)
+        if self.partial_path:
+            env[ENV_PARTIAL] = self.partial_path
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=self.out_f, stderr=self.err_f, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
 
-    Returns (rc_or_None, stdout_text, stderr_text, timed_out_slot).
-    """
-    import tempfile
-    out_f = tempfile.NamedTemporaryFile(
-        mode="w+", prefix="bench_child_", suffix=".out", delete=False)
-    err_f = tempfile.NamedTemporaryFile(
-        mode="w+", prefix="bench_child_", suffix=".err", delete=False)
-    proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__)],
-        env=dict(os.environ, **env_extra),
-        stdout=out_f, stderr=err_f, text=True,
-        cwd=os.path.dirname(os.path.abspath(__file__)))
+    def fail_cleanup(self, tail: int = 2000) -> bool:
+        """Failure-path epilogue shared by every probe/measurement
+        except-branch: dump the stderr tail, clean up, and report
+        whether the child is actually DEAD (False = it survived
+        SIGTERM and was left running — the caller must treat it as
+        stuck/parked, never retry on top of it)."""
+        _, err = self.read_streams()
+        sys.stderr.write(err[-tail:])
+        dead = self.proc.poll() is not None
+        self.cleanup()
+        return dead
 
-    def read_streams():
-        out_f.flush()
-        err_f.flush()
-        with open(out_f.name, "r", encoding="utf-8",
+    def read_streams(self):
+        self.out_f.flush()
+        self.err_f.flush()
+        with open(self.out_f.name, "r", encoding="utf-8",
                   errors="replace") as f:
             out = f.read()
-        with open(err_f.name, "r", encoding="utf-8",
+        with open(self.err_f.name, "r", encoding="utf-8",
                   errors="replace") as f:
             err = f.read()
         return out, err
 
-    def cleanup_streams():
-        # every non-parked exit removes the temp pair (sessions spawn
+    def cleanup(self):
+        # every dead-child exit removes the temp pair (sessions spawn
         # many children; parked children keep theirs — the child still
         # writes there and the operator may want the tail)
-        for f in (out_f, err_f):
+        if self.proc.poll() is None:
+            sys.stderr.write(
+                f"[bench] parked child output stays in "
+                f"{self.out_f.name} / {self.err_f.name}\n")
+            return
+        for f in (self.out_f, self.err_f):
             try:
                 f.close()
                 os.unlink(f.name)
             except OSError:
                 pass
+        # the child's atomic-write tmp (hb_path.<pid>.tmp) can be
+        # orphaned when the interpreter exits mid-keepalive — sweep it
+        for p in (self.hb_path,
+                  f"{self.hb_path}.{self.proc.pid}.tmp"):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
 
-    timed_out = False
+
+def _cache_dir() -> str:
+    """Compile cache shared by every child of this bench run (and, via
+    LGBM_TPU_COMPILE_CACHE exported by the session supervisor, across
+    retried/relaunched stages): a retried attempt reads the first
+    attempt's compile from disk instead of repaying the minutes that
+    used to eat the watchdog."""
+    d = resolve_cache_dir()
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _read_partial(path: str):
+    """Last banked partial result, or None (missing/torn tolerated)."""
+    if not path:
+        return None
     try:
-        proc.wait(timeout=max(slot, 1.0))
-    except subprocess.TimeoutExpired:
-        timed_out = True
-        grace = max(hard_deadline - time.time(), 0.0)
-        sys.stderr.write(
-            f"[bench] child slot ({slot:.0f}s) expired; NOT killing a "
-            f"claim holder — waiting up to {grace:.0f}s more for it to "
-            "finish or park\n")
-        try:
-            proc.wait(timeout=max(grace, 1.0))
-        except subprocess.TimeoutExpired:
-            out, err = read_streams()
-            sys.stderr.write(err[-2000:])
-            sys.stderr.write(
-                f"[bench] parked child output stays in {out_f.name} / "
-                f"{err_f.name}\n")
-            raise _ParkedChild(
-                f"measurement child pid={proc.pid} still running at the "
-                "watchdog deadline; left alive (parked) to avoid the "
-                "mid-compile claim-holder kill wedge") from None
-    out, err = read_streams()
-    cleanup_streams()
-    return proc.returncode, out, err, timed_out
+        with open(path, "r", encoding="utf-8") as f:
+            d = json.loads(f.read())
+        return d if float(d.get("value", 0.0)) > 0 else None
+    except (OSError, ValueError):
+        return None
 
 
-def _dump_timeout_streams(e: subprocess.TimeoutExpired) -> None:
-    for stream in (e.stderr, e.stdout):
-        if stream:
-            if isinstance(stream, bytes):
-                stream = stream.decode("utf-8", "replace")
-            sys.stderr.write(stream[-2000:])
+def _run_instrumented(fn, *args) -> int:
+    """Child entry shell: a stall classified by the child's OWN
+    watchdog (raised at an iteration boundary, or delivered as the
+    watchdog's interrupt) must exit with EXIT_STALLED so the parent
+    maps it to DeviceStallError and RETRIES — a generic rc would read
+    as a code failure and kill the retry the stall deserves."""
+    try:
+        fn(*args)
+        return 0
+    except DeviceStallError as e:
+        print(f"[bench] self-watchdogged stall: {e}", file=sys.stderr)
+        return heartbeat.EXIT_STALLED
+    except KeyboardInterrupt:
+        if heartbeat.stall_pending():
+            print("[bench] stall watchdog interrupt", file=sys.stderr)
+            return heartbeat.EXIT_STALLED
+        raise
 
 
 def main() -> int:
     if os.environ.get("_LGBM_BENCH_PROBE"):
-        run_probe()
-        return 0
+        return _run_instrumented(run_probe)
     if os.environ.get("_LGBM_BENCH_CHILD"):
-        run_child(os.environ["_LGBM_BENCH_CHILD"])
-        return 0
+        return _run_instrumented(run_child,
+                                 os.environ["_LGBM_BENCH_CHILD"])
 
     deadline = time.time() + BENCH_WATCHDOG_SEC
+    # liveness plumbing (ISSUE 4): this parent's own heartbeat (present
+    # when a session supervisor exported LGBM_TPU_HEARTBEAT — child
+    # spawns override the env with their own files) relays every
+    # observed child advance upward; the stall policy governs how long
+    # a child phase may sit silent before it is hung, replacing the
+    # blind wall-clock slots that parked healthy compiling children in
+    # rounds 3-5
+    hb_self = heartbeat.install_from_env()
+    stall_policy = heartbeat.StallPolicy.from_env()
+    watch_poll = float(os.environ.get("BENCH_WATCH_POLL", 1.0))
 
     # Stage 0: establish the device is reachable — retrying ACROSS the bench
     # window instead of dying on the first failed probe (round-3 postmortem:
@@ -425,13 +533,19 @@ def main() -> int:
         …) — NOT transient: retrying won't help and the 0.0 must not
         masquerade as "hung device" (status/rc contract above)."""
 
+    class _ProbeStuck(Exception):
+        """A stalled probe ignored SIGTERM and is still running: a
+        fresh probe must NOT stack on it (one patient single-client
+        probe, never stacked) — terminal, reported as the device
+        symptom it is."""
+
     from lightgbm_tpu.robustness.retry import is_transient_error
 
     def _probe_classifier(exc: BaseException) -> bool:
         # a code failure is terminal even if the embedded stderr tail
         # happens to contain a substring the generic classifier would
         # match ("timed out" in some unrelated traceback)
-        if isinstance(exc, _ProbeCodeFailure):
+        if isinstance(exc, (_ProbeCodeFailure, _ProbeStuck)):
             return False
         return is_transient_error(exc)
 
@@ -443,45 +557,83 @@ def main() -> int:
 
     state = {"attempts": 0}
 
-    def probe_attempt() -> None:
+    def probe_attempt(slot_budget=None) -> None:
+        # ``slot_budget`` is injected by retry_call (budget_kw): the
+        # POLICY's remaining deadline, so an attempt slot can never
+        # exceed the window that actually remains (ISSUE 4 satellite —
+        # the r05 log showed attempt 2 granted 750 s inside an already
+        # half-spent window)
         state["attempts"] += 1
-        budget = deadline - reserve - time.time()
         if state["attempts"] == 1:
             # fast-fail slot: a healthy tunnel answers in seconds
-            slot = max(min(BENCH_PROBE_SEC, budget), 30.0)
+            slot = min(BENCH_PROBE_SEC, slot_budget
+                       if slot_budget is not None else BENCH_PROBE_SEC)
         else:
             # patient slot: the documented recovery signature is a claim
             # that waits ~1500 s then errors UNAVAILABLE — only a probe
             # allowed to wait that long can ever surface it, so retries
-            # get the whole remaining pre-reserve budget (one patient
+            # get the whole remaining pre-reserve window (one patient
             # single-client probe, never stacked)
-            slot = max(budget, 30.0)
+            slot = slot_budget if slot_budget is not None \
+                else BENCH_PROBE_SEC
+        slot = max(slot, 30.0)
+        child = _ChildSpawn({"_LGBM_BENCH_PROBE": "1"},
+                            tag=f"probe{state['attempts']}")
         try:
-            probe = _spawn({"_LGBM_BENCH_PROBE": "1"}, slot)
-        except subprocess.TimeoutExpired as e:
-            _dump_timeout_streams(e)
+            rc = watch_child(
+                child.proc, child.hb_path, policy=stall_policy,
+                hard_deadline=time.monotonic() + slot,
+                poll=watch_poll, relay=hb_self,
+                label=f"probe attempt {state['attempts']}")
+        except StillAlive:
+            # a probe is a claim-WAITER: stopping it at slot expiry is
+            # benign (the wedge comes from killing claim HOLDERS);
+            # SIGTERM + grace, never SIGKILL
+            from lightgbm_tpu.robustness.supervisor import \
+                terminate_gently
+            terminate_gently(child.proc, 10.0,
+                             f"probe attempt {state['attempts']}")
+            if not child.fail_cleanup():
+                # it survived SIGTERM: a retry would stack a second
+                # probe on the one still in the claim queue
+                raise _ProbeStuck(
+                    f"slot-expired probe pid={child.proc.pid} ignored "
+                    "SIGTERM; left running — further probes would "
+                    "stack claims") from None
             raise TimeoutError(
                 f"probe attempt {state['attempts']} timed out "
-                f"({slot:.0f}s)")
-        if '"probe_ok"' in probe.stdout:
+                f"({slot:.0f}s)") from None
+        except DeviceStallError:
+            # heartbeat-silent probe: already classified (and SIGTERMed)
+            # by the supervisor WITHIN stall/silent_sec — not after the
+            # full slot; transient, the policy retries
+            if not child.fail_cleanup():
+                raise _ProbeStuck(
+                    f"stalled probe pid={child.proc.pid} ignored "
+                    "SIGTERM; left running — further probes would "
+                    "stack claims") from None
+            raise
+        out, err = child.read_streams()
+        child.cleanup()
+        if '"probe_ok"' in out:
             sys.stderr.write(
                 f"[bench] probe ok (attempt {state['attempts']}): "
-                f"{probe.stdout.strip()[:200]}\n")
+                f"{out.strip()[:200]}\n")
             return
-        sys.stderr.write(probe.stderr[-2000:])
-        tail = probe.stderr[-300:]
-        if "UNAVAILABLE" in probe.stderr:
+        sys.stderr.write(err[-2000:])
+        tail = err[-300:]
+        if "UNAVAILABLE" in err:
             # known recovery signature — transient, policy will retry
             raise RuntimeError(
                 f"UNAVAILABLE: probe attempt {state['attempts']} "
-                f"rc={probe.returncode}: {tail!r}")
+                f"rc={rc}: {tail!r}")
         raise _ProbeCodeFailure(
             f"probe attempt {state['attempts']} "
-            f"rc={probe.returncode}: {tail!r}")
+            f"rc={rc}: {tail!r}")
 
     try:
         retry_call(probe_attempt, policy=policy,
-                   what="bench device probe")
+                   what="bench device probe", budget_kw="slot_budget")
     except RetryError as e:
         # transient failures exhausted the shared policy → honest
         # device symptom (rc=4), reported only after the deadline
@@ -490,62 +642,181 @@ def main() -> int:
             f"{BENCH_WATCHDOG_SEC}s window: {e.last!r}",
             status="device_unreachable"), flush=True)
         return RC_DEVICE_UNREACHABLE
+    except _ProbeStuck as e:
+        print(_fail_line(f"probe stalled and unkillable: {e}",
+                         status="device_unreachable"), flush=True)
+        return RC_DEVICE_UNREACHABLE
     except _ProbeCodeFailure as e:
         print(_fail_line(
             f"probe failed (code failure, not retried): {e}",
             status="no_result"), flush=True)
         return RC_NO_RESULT
 
-    last_note = "no scheduling mode completed"
-    for i, sched in enumerate(SCHED_MODES):
-        budget = deadline - time.time()
-        if budget <= 5:
-            last_note = f"watchdog exhausted before trying sched={sched}"
-            break
-        # Weight the preferred (first) mode: give it up to 70% of the
-        # remaining budget, while still reserving a slot for the
-        # fallback mode. Post-probe children HOLD the device claim, so
-        # slot expiry never kills them (VERDICT weak #1: the
-        # mid-compile claim-holder SIGKILL is the machine-wide wedge
-        # that zeroed three rounds of BENCH json): an over-slot child
-        # gets the rest of the watchdog to finish — its late result
-        # still counts — and remaining sched modes are SKIPPED. Only
-        # at the hard deadline is it parked (left running, reported as
-        # no_result).
-        remaining_modes = len(SCHED_MODES) - i
-        if remaining_modes > 1:
-            slot = max(budget * 0.7, 5.0)
-        else:
-            slot = max(budget - 5.0, 5.0)
+    # ---- measurement stages: phase-aware liveness instead of fixed
+    # slots. Each sched's children get the FULL remaining watchdog as
+    # their hard deadline: an ADVANCING child (compiling with live
+    # keepalives, iterating) deserves the window — the old 70% slot
+    # split existed only because blind slots could not tell advancing
+    # from wedged. A STALLED child is classified within its phase's
+    # stall budget (not the full watchdog), SIGTERMed, and retried
+    # under the shared RetryPolicy — with the compile cache warm the
+    # retry skips the recompile. Partial results banked by any attempt
+    # are SALVAGED if every attempt ultimately fails.
+    class _ChildNoResult(Exception):
+        """Child exited without a result line — a code failure, not a
+        device symptom: never retried."""
+
+    def _measure_classifier(exc: BaseException) -> bool:
+        # the embedded stderr tail may contain strings the generic
+        # classifier would match ("timed out" in an unrelated child
+        # traceback) — a no-result exit is terminal no matter what
+        if isinstance(exc, (_ChildNoResult, _ParkedChild)):
+            return False
+        return is_transient_error(exc)
+
+    salvage_files: list = []   # (sched, partial_path), attempt order
+    parked_pid = {"pid": None}
+
+    def best_salvage():
+        best = None
+        for sched, p in salvage_files:
+            rec = _read_partial(p)
+            if rec is None:
+                continue
+            if best is None or int(rec.get("iters_done", 0)) >= \
+                    int(best.get("iters_done", 0)):
+                best = rec
+        return best
+
+    def emit_salvaged(failed_stage: str, reason: str) -> bool:
+        """Print the last banked stage metric (with a "salvaged" note
+        naming the failed stage) instead of an unconditional 0.0. Only
+        when NOTHING ever banked does the caller fall through to the
+        0.0 line."""
+        rec = best_salvage()
+        if rec is None:
+            return False
+        rec = dict(rec)
+        rec.pop("partial", None)
+        rec["status"] = "salvaged"
+        rec["note"] = (f"salvaged: last banked partial "
+                       f"({rec.get('iters_done')} iters, "
+                       f"sched={rec.get('sched')}); failed stage "
+                       f"{failed_stage}: {reason}")
+        if parked_pid["pid"] is not None:
+            # load-bearing for tpu_session_auto.py: a parked child may
+            # still hold the device claim — no further session claims
+            rec["parked"] = True
+            rec["parked_pid"] = parked_pid["pid"]
+        print(json.dumps(rec), flush=True)
+        return True
+
+    # a fresh measurement child needs at least this much window to be
+    # supervisable at all (startup + first beats); launching into a
+    # near-exhausted watchdog would make a seconds-old WAITING child hit
+    # the hard deadline instantly and be mis-parked, stopping the whole
+    # session for nothing
+    measure_min_slot = min(60.0, BENCH_WATCHDOG_SEC * 0.3)
+
+    def measure_attempt(sched: str) -> str:
+        """One supervised measurement child; returns the result line."""
+        remaining = deadline - time.time()
+        if remaining < measure_min_slot:
+            raise _ChildNoResult(
+                f"sched={sched}: only {remaining:.0f}s of watchdog "
+                f"remain (< {measure_min_slot:.0f}s floor) — not "
+                "launching a fresh measurement child")
+        child = _ChildSpawn({"_LGBM_BENCH_CHILD": sched},
+                            tag=f"child_{sched}", partial=True)
+        salvage_files.append((sched, child.partial_path))
         try:
-            rc, stdout, stderr, timed_out = _spawn_claim_holder(
-                {"_LGBM_BENCH_CHILD": sched.strip()}, slot,
-                hard_deadline=deadline)
-        except _ParkedChild as e:
-            # status "parked" is load-bearing: tpu_session_auto.py keys
-            # on it to skip ALL remaining session stages — a parked
-            # grandchild still holds the device claim, and any fresh
-            # claim stacked on it is the documented wedge trigger
-            print(_fail_line(
-                f"sched={sched}: {e} — remaining stages skipped",
-                status="parked"), flush=True)
-            return RC_NO_RESULT
-        sys.stderr.write(stderr[-4000:])
-        for ln in stdout.splitlines():
+            rc = watch_child(
+                child.proc, child.hb_path, policy=stall_policy,
+                hard_deadline=time.monotonic() + (deadline - time.time()),
+                poll=watch_poll, relay=hb_self,
+                label=f"measurement sched={sched}")
+        except StillAlive as e:
+            # alive AND advancing at the watchdog: park (never kill a
+            # claim holder), skip every remaining stage
+            child.fail_cleanup()
+            parked_pid["pid"] = e.pid
+            raise _ParkedChild(
+                f"measurement child pid={e.pid} still advancing at the "
+                "watchdog deadline; left alive (parked) to avoid the "
+                "mid-compile claim-holder kill wedge") from None
+        except DeviceStallError:
+            if not child.fail_cleanup():
+                # hung AND unkillable (ignored SIGTERM): treat as
+                # parked — a fresh claim must not stack on it
+                parked_pid["pid"] = child.proc.pid
+                raise _ParkedChild(
+                    f"stalled measurement child pid={child.proc.pid} "
+                    "ignored SIGTERM; left running (parked)") from None
+            raise       # transient: the retry policy relaunches
+        out, err = child.read_streams()
+        child.cleanup()
+        sys.stderr.write(err[-4000:])
+        for ln in out.splitlines():
             ln = ln.strip()
             if ln.startswith("{") and '"iters/sec"' in ln:
-                print(ln, flush=True)
+                return ln
+        raise _ChildNoResult(
+            f"sched={sched} exited rc={rc} without a result: "
+            f"{err[-300:]!r}")
+
+    try:
+        last_note = "no scheduling mode completed"
+        for sched in [s.strip() for s in SCHED_MODES]:
+            budget = deadline - time.time()
+            if budget <= 5:
+                last_note = f"watchdog exhausted before trying sched={sched}"
+                break
+            measure_policy = RetryPolicy(
+                max_attempts=BENCH_MEASURE_ATTEMPTS, base_delay=2.0,
+                max_delay=15.0, deadline=max(budget, 1.0),
+                classifier=_measure_classifier)
+            try:
+                line = retry_call(measure_attempt, sched,
+                                  policy=measure_policy,
+                                  what=f"bench measurement sched={sched}")
+                print(line, flush=True)
                 return 0
-        last_note = (f"sched={sched} exited rc={rc} "
-                     f"without a result: {stderr[-300:]!r}")
-        if timed_out:
-            # the child overran its slot (claim was held past the
-            # planned budget): do not point another fresh claim at the
-            # device in the leftover time
-            last_note += " (over slot; remaining sched modes skipped)"
-            break
-    print(_fail_line(last_note), flush=True)
-    return RC_NO_RESULT
+            except _ParkedChild as e:
+                # status "parked" (or a salvaged line with parked=true) is
+                # load-bearing: tpu_session_auto.py keys on it to skip ALL
+                # remaining session stages — a parked grandchild still
+                # holds the device claim, and any fresh claim stacked on
+                # it is the documented wedge trigger
+                if emit_salvaged(f"sched={sched}", str(e)):
+                    return 0
+                print(_fail_line(
+                    f"sched={sched}: {e} — remaining stages skipped",
+                    status="parked"), flush=True)
+                return RC_NO_RESULT
+            except RetryError as e:
+                # every relaunch stalled: salvage whatever a timed loop
+                # banked before the device went quiet
+                if emit_salvaged(f"sched={sched}", str(e)):
+                    return 0
+                last_note = (f"sched={sched} stalled through "
+                             f"{e.attempts} attempt(s): {e.last!r}")
+                continue
+            except _ChildNoResult as e:
+                last_note = str(e)
+                continue
+        if emit_salvaged("all scheduling modes", last_note):
+            return 0
+        print(_fail_line(last_note), flush=True)
+        return RC_NO_RESULT
+    finally:
+        # banked partials were read by emit_salvaged above;
+        # drop them unless a parked child still writes there
+        if parked_pid["pid"] is None:
+            for _, pth in salvage_files:
+                try:
+                    os.unlink(pth)
+                except OSError:
+                    pass
 
 
 if __name__ == "__main__":

@@ -35,8 +35,12 @@ from typing import List, Optional
 # jax 0.4.x emits "Compiling <name> with global shapes and types ..." from
 # these loggers when a function is traced+lowered (DEBUG unless
 # jax_log_compiles); dispatch.py carries the "Finished XLA compilation"
-# companion records.
-_COMPILE_LOGGERS = ("jax._src.interpreters.pxla", "jax._src.dispatch")
+# companion records; compiler.py logs "Persistent compilation cache
+# hit for '<name>' ..." when the lowered program is served from the
+# on-disk cache instead of XLA-compiled (the signal the ISSUE-4
+# relaunch-skips-recompilation test asserts on).
+_COMPILE_LOGGERS = ("jax._src.interpreters.pxla", "jax._src.dispatch",
+                    "jax._src.compiler")
 
 
 class CompileBudgetExceeded(AssertionError):
@@ -56,6 +60,10 @@ class CompileCounter(logging.Handler):
     def __init__(self):
         super().__init__(level=logging.DEBUG)
         self.names: List[str] = []
+        # programs served from the PERSISTENT on-disk cache while
+        # active: these lowered (so they appear in ``names`` too) but
+        # did NOT pay an XLA compile — the warm-relaunch signal
+        self.cache_hits: List[str] = []
         self._saved = []
 
     @property
@@ -69,6 +77,12 @@ class CompileCounter(logging.Handler):
             return
         if msg.startswith("Compiling "):
             self.names.append(msg.split(" ", 2)[1])
+        elif msg.startswith("Persistent compilation cache hit"):
+            # "Persistent compilation cache hit for '<name>' with key …"
+            try:
+                self.cache_hits.append(msg.split("'", 2)[1])
+            except IndexError:
+                self.cache_hits.append(msg)
 
     def __enter__(self) -> "CompileCounter":
         # when the user asked for the compile audit (jax_log_compiles,

@@ -287,6 +287,27 @@ _reg("tpu_profile_dir", str, "", ())
 # instead of aborting the run. Off by default: silent 100x slowdowns
 # must be opted into.
 _reg("tpu_fallback_to_cpu", bool, False, ())
+# persistent XLA compilation cache directory (robustness/heartbeat
+# ISSUE 4): realistic grower shapes compile for minutes on TPU, and a
+# retried or relaunched attempt repays that compile unless it is cached
+# on disk. Empty = keep jax's current setting (the bench/session
+# supervisors and tests set LGBM_TPU_COMPILE_CACHE instead;
+# LGBM_TPU_JIT_CACHE is the legacy alias). Routed through
+# utils/jit_cache.enable_persistent_cache by engine.train and the gbdt
+# engine setup.
+_reg("tpu_compile_cache_dir", str, "", ())
+# phase-tagged heartbeat file (robustness/heartbeat.py): when set (or
+# when a supervisor exports LGBM_TPU_HEARTBEAT), the training loop
+# writes crash-safe liveness beats (compiling / iter N) and starts the
+# in-training stall watchdog, which raises DeviceStallError instead of
+# hanging forever at a wedged device sync.
+_reg("tpu_heartbeat_file", str, "", ())
+# stall budget override (seconds) for the in-training watchdog and any
+# supervisor reading this process's heartbeat: how long one phase may
+# sit with no substantive beat before it is classified hung. 0 = the
+# per-phase defaults in robustness/heartbeat.py (compiling 1200 s,
+# iterations 300 s), overridable per phase via LGBM_TPU_STALL_SEC_*.
+_reg("tpu_stall_sec", float, 0.0, (), (0, None, True, False))
 
 # objective alias names accepted for each canonical objective
 OBJECTIVE_ALIASES = {

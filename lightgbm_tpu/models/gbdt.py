@@ -45,6 +45,7 @@ import numpy as np
 
 from .. import tuned
 from ..config import Config
+from ..robustness import heartbeat
 from ..core.grower import GrowerConfig, make_tree_grower
 from ..core.metrics import Metric, metrics_for_config
 from ..core.objective import ObjectiveFunction, CustomObjective, K_EPSILON
@@ -203,6 +204,11 @@ class GBDT:
         self._async_disabled = False  # set on stop-rollback / fallbacks
         self._async_delta_fn = None
         self._async_trav_fn = None
+        # phase-tagged liveness (ISSUE 4): beats + the process-global
+        # stall watchdog; all no-ops unless a heartbeat file is
+        # configured (tpu_heartbeat_file / LGBM_TPU_HEARTBEAT)
+        self._hb_warm = False         # first iteration (compile) done
+        self._hb_policy = None
         self.models: List[HostTree] = []
         self.iter = 0
         self.num_init_iteration = 0
@@ -299,6 +305,7 @@ class GBDT:
             return
         pending, self._pending = self._pending, []
         self._stop_checked = 0
+        self._hb_sync_beat()
         with global_timer.section("Tree::ToHost"):
             stacked = jax.tree.map(lambda *xs: jnp.stack(xs),
                                    *[p.tree for p in pending])
@@ -339,6 +346,7 @@ class GBDT:
         if self._stop_checked >= len(self._pending):
             return False
         new = self._pending[self._stop_checked:]
+        self._hb_sync_beat()
         with global_timer.section("GBDT::StopCheck"):
             nls = np.asarray(jax.device_get(
                 jnp.stack([p.tree.num_leaves for p in new])))
@@ -524,6 +532,31 @@ class GBDT:
     def _setup_train(self, train: BinnedDataset) -> None:
         cfg = self.config
         cfg.warn_unimplemented()
+        # persistent compile cache + liveness instrumentation (ISSUE 4)
+        # — wired here (not only engine.train) so directly-constructed
+        # Boosters get them too, BEFORE the grower compiles below; the
+        # env knobs count like the param so a supervisor's exported
+        # LGBM_TPU_COMPILE_CACHE reaches Booster(params, ds) users
+        import os as _os
+
+        from ..utils.jit_cache import (ENV_COMPILE_CACHE, ENV_JIT_CACHE,
+                                       enable_persistent_cache)
+        if cfg.tpu_compile_cache_dir or \
+                _os.environ.get(ENV_COMPILE_CACHE) or \
+                _os.environ.get(ENV_JIT_CACHE):
+            enable_persistent_cache(
+                str(cfg.tpu_compile_cache_dir) or None)
+        if cfg.tpu_heartbeat_file:
+            heartbeat.install(str(cfg.tpu_heartbeat_file))
+        else:
+            heartbeat.install_from_env()
+        policy = heartbeat.StallPolicy.from_env()
+        if float(cfg.tpu_stall_sec or 0.0) > 0.0:
+            s = float(cfg.tpu_stall_sec)
+            policy = dataclasses.replace(
+                policy, stall_sec={p: s for p in policy.stall_sec},
+                default_stall=s)
+        self._hb_policy = policy
         self.num_data = train.num_data
         self.max_feature_idx = train.num_total_features - 1
         self.feature_names = list(train.feature_names)
@@ -1675,12 +1708,70 @@ class GBDT:
         return np.asarray(run(stacked, bins_dev), np.float64).T  # [R, K]
 
     # ------------------------------------------------------------------
+    def _hb_iter_begin(self):
+        """Beat the process heartbeat and arm the stall watchdog for one
+        iteration (ISSUE 4). Phase is ``compiling`` until the first
+        iteration completed (the grower's multi-minute XLA compile
+        happens inside it), ``iter`` + iteration counter afterwards —
+        the supervisor's generous compile budget applies exactly where
+        compiles can occur, and advancing iterations are never parked.
+        Returns the armed watchdog (None when unsupervised)."""
+        hb = heartbeat.current()
+        if hb is None:
+            return None
+        wd = heartbeat.training_watchdog(self._hb_policy)
+        wd.check()                  # a stall armed while we were away
+        wd.begin()
+        hb.beat(heartbeat.PHASE_ITER if self._hb_warm
+                else heartbeat.PHASE_COMPILING, self.iter)
+        return wd
+
+    def _hb_sync_beat(self) -> None:
+        """Refresh liveness right before a blocking device fetch — the
+        exact points a wedged tunnel freezes the loop, so beat age
+        measured by watchdog/supervisor starts at the sync, not at the
+        iteration that dispatched it."""
+        hb = heartbeat.current()
+        if hb is not None:
+            hb.beat(heartbeat.PHASE_ITER if self._hb_warm
+                    else heartbeat.PHASE_COMPILING, self.iter)
+
     def train_one_iter(self, gradients: Optional[np.ndarray] = None,
                        hessians: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration (ref: gbdt.cpp:353 TrainOneIter).
-        Returns True when training should stop (no more valid splits)."""
-        if gradients is None and hessians is None and self._async_on():
-            return self._train_one_iter_async()
+        Returns True when training should stop (no more valid splits).
+
+        Liveness shell around the sync/async bodies: beats + the stall
+        watchdog (armed only while the iteration is in flight) convert
+        a forever-hang at a device sync into DeviceStallError."""
+        wd = self._hb_iter_begin()
+        try:
+            if gradients is None and hessians is None and \
+                    self._async_on():
+                done = self._train_one_iter_async()
+            else:
+                done = self._train_one_iter_sync(gradients, hessians)
+            self._hb_warm = True
+            return done
+        except KeyboardInterrupt:
+            # the watchdog unblocks a wedged iteration via
+            # interrupt_main — surface that as the classified
+            # DeviceStallError the contract promises, not as a fake
+            # Ctrl-C. With no stall armed this is a real Ctrl-C and
+            # re-raises untouched; with one armed, check() raises the
+            # DeviceStallError carrying the armed detail.
+            if wd is not None:
+                wd.check()
+            raise
+        finally:
+            if wd is not None:
+                wd.end()
+
+    def _train_one_iter_sync(self,
+                             gradients: Optional[np.ndarray] = None,
+                             hessians: Optional[np.ndarray] = None
+                             ) -> bool:
+        """Synchronous TrainOneIter body (see train_one_iter)."""
         self._flush_pending()
         K = self.num_tree_per_iteration
         init_scores = [0.0] * K
@@ -1772,6 +1863,7 @@ class GBDT:
                 tree_dev, leaf_id = self._grow(train_bins, gh, fmask,
                                                self._cegb_penalty(),
                                                rng_key)
+            self._hb_sync_beat()
             with global_timer.section("Tree::ToHost"):
                 host = HostTree(jax.tree.map(np.asarray, tree_dev),
                                 self.train_set.used_feature_map)

@@ -197,14 +197,17 @@ bool ParseTreeBlock(const std::map<std::string, std::string>& kv, Tree* t) {
     t->cat_boundaries = ParseInts(get("cat_boundaries"));
     auto ct = ParseInts(get("cat_threshold"));
     t->cat_threshold.assign(ct.begin(), ct.end());
-    // every categorical node's threshold is an index into cat_boundaries
-    for (int i = 0; i < ni; ++i) {
-      if (!(t->decision_type[i] & 1)) continue;
-      int64_t ci = static_cast<int64_t>(t->threshold[i]);
-      if (ci < 0 ||
-          ci + 1 >= static_cast<int64_t>(t->cat_boundaries.size()))
-        return false;
-    }
+  }
+  // every categorical node's threshold is an index into cat_boundaries;
+  // a node with the categorical bit but NO cat tables (num_cat=0 —
+  // e.g. a corrupted decision_type in an all-numerical tree) would
+  // index an empty vector in CatInBitset, so it must not parse
+  for (int i = 0; i < ni; ++i) {
+    if (!(t->decision_type[i] & 1)) continue;
+    int64_t ci = static_cast<int64_t>(t->threshold[i]);
+    if (t->num_cat <= 0 || ci < 0 ||
+        ci + 1 >= static_cast<int64_t>(t->cat_boundaries.size()))
+      return false;
   }
   if (t->num_cat > 0) {
     // categorical tables must be self-consistent or traversal would read

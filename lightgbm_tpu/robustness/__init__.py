@@ -18,8 +18,16 @@ with ``device_unreachable`` and losing every iteration of progress):
 - :mod:`.faults` — a fault-injection harness (``LGBM_TPU_FAULTS`` env
   var or context manager, mirroring the ``LGBM_TPU_GUARDS`` install
   pattern) that injects transient failures into collectives, device
-  probes and checkpoint writes, so the retry and atomicity guarantees
-  are testable on CPU in tier-1.
+  probes, checkpoint writes, heartbeat liveness (``hang``) and compile
+  duration (``slow_compile``), so the retry, atomicity and supervision
+  guarantees are testable on CPU in tier-1.
+- :mod:`.heartbeat` / :mod:`.supervisor` — phase-tagged liveness
+  (ISSUE 4): instrumented children write crash-safe heartbeats
+  (``compiling``/``warmup``/``measuring``/``iter N``), supervisors
+  replace blind wall-clock slots with phase-aware stall deadlines
+  (:class:`DeviceStallError` is transient under the retry policy), and
+  an in-training watchdog raises instead of hanging forever at a
+  wedged device sync.
 
 jax is never imported at module import time (mirrors analysis/guards.py:
 the CLI and host-side tools must be able to import this package without
@@ -33,6 +41,9 @@ from .checkpoint import (CheckpointError, atomic_write_text,
                          write_checkpoint)
 from .faults import (FaultInjected, active_plan, inject, install_from_env,
                      maybe_fail)
+from .heartbeat import (DeviceStallError, Heartbeat, HeartbeatRecord,
+                        StallPolicy, TrainingWatchdog)
+from .supervisor import StillAlive, watch_child
 
 __all__ = [
     "RetryPolicy", "RetryError", "retry_call", "is_transient_error",
@@ -41,4 +52,6 @@ __all__ = [
     "prune_checkpoints",
     "FaultInjected", "inject", "install_from_env", "maybe_fail",
     "active_plan",
+    "DeviceStallError", "Heartbeat", "HeartbeatRecord", "StallPolicy",
+    "TrainingWatchdog", "StillAlive", "watch_child",
 ]

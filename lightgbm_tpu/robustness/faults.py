@@ -22,6 +22,15 @@ Fault classes (the ``site`` argument of :func:`maybe_fail`):
 - ``write_kill`` — checkpoint writes die MID-WRITE (after the payload
   is partially written, before the atomic rename), simulating a kill
   -9 during snapshotting; raises :class:`WriteKilled`.
+- ``hang`` — the heartbeat writer (robustness/heartbeat.py) stops
+  writing from the moment the fault fires: the child keeps running but
+  its liveness file goes silent mid-phase, which is exactly what a
+  wedged runtime looks like to a supervisor. Consulted via
+  :func:`check` (non-raising) inside ``Heartbeat.beat``.
+- ``slow_compile`` — stretches the ``compiling`` phase by ``sec``
+  seconds (default 30) while keepalives keep flowing: a benign slow
+  remote compile, the case phase-aware supervision must NOT park.
+  Consulted via :func:`maybe_delay` at compile-phase entry.
 
 Options per spec:
 
@@ -33,6 +42,8 @@ Options per spec:
   kill the k-th checkpoint write precisely).
 - ``seed=<int>`` — per-fault RNG seed (default 0): injections are
   deterministic and reproducible across runs and threads.
+- ``sec=<float>`` — duration for delay-style faults (``slow_compile``
+  only; default 30.0).
 
 Counters are PER-PROCESS: an env-installed plan re-arms in every
 subprocess (each child re-runs install_from_env with fresh counters).
@@ -55,7 +66,8 @@ from ..utils import log
 
 ENV_FAULTS = "LGBM_TPU_FAULTS"
 
-KNOWN_SITES = ("collective", "probe_timeout", "write_kill")
+KNOWN_SITES = ("collective", "probe_timeout", "write_kill", "hang",
+               "slow_compile")
 
 
 class FaultInjected(Exception):
@@ -71,9 +83,10 @@ class WriteKilled(FaultInjected):
 class _Fault:
     def __init__(self, site: str, p: float = 1.0,
                  n: Optional[int] = None, after: int = 0,
-                 seed: int = 0):
+                 seed: int = 0, sec: float = 30.0):
         self.site = site
         self.p = float(p)
+        self.sec = float(sec)
         # a bare always-on fault (p=1, no n) fires once then disarms:
         # "kill the write" means one kill, not an unrecoverable loop
         self.n = n if n is not None else (1 if self.p >= 1.0 else None)
@@ -136,6 +149,8 @@ class FaultPlan:
                     kw["after"] = int(v)
                 elif k == "seed":
                     kw["seed"] = int(v)
+                elif k == "sec":
+                    kw["sec"] = float(v)
                 else:
                     raise ValueError(
                         f"unknown fault option {k!r} in {entry!r}")
@@ -174,6 +189,38 @@ def maybe_fail(site: str) -> None:
     raise FaultInjected(
         f"UNAVAILABLE: injected {site} fault "
         f"(call #{f.calls}, injection #{f.fired})")
+
+
+def check(site: str) -> bool:
+    """Non-raising consult: True when ``site``'s fault fires this call.
+
+    For fault kinds whose effect is behavioral rather than an exception
+    (``hang`` suppresses heartbeat writes) the call site decides what
+    "failing" means; counters/probability/arming work exactly like
+    :func:`maybe_fail`."""
+    plan = _active
+    if plan is None:
+        return False
+    f = plan.faults.get(site)
+    return f is not None and f.should_fire()
+
+
+def maybe_delay(site: str, sleep=None) -> float:
+    """Delay-style injection: sleep the fault's ``sec`` when it fires
+    and return the seconds slept (0.0 otherwise). Used by
+    ``slow_compile`` to stretch the compiling phase without touching
+    liveness."""
+    plan = _active
+    if plan is None:
+        return 0.0
+    f = plan.faults.get(site)
+    if f is None or not f.should_fire():
+        return 0.0
+    log.warning(f"injected {site} delay: sleeping {f.sec:.1f}s "
+                f"(call #{f.calls}, injection #{f.fired})")
+    import time
+    (sleep if sleep is not None else time.sleep)(f.sec)
+    return f.sec
 
 
 class inject:

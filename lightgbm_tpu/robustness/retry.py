@@ -142,6 +142,7 @@ def retry_call(fn: Callable, *args,
                clock: Callable[[], float] = time.monotonic,
                on_retry: Optional[Callable[[int, BaseException], None]]
                = None,
+               budget_kw: Optional[str] = None,
                **kwargs):
     """Call ``fn(*args, **kwargs)`` under ``policy``.
 
@@ -150,6 +151,21 @@ def retry_call(fn: Callable, *args,
     non-transient exceptions propagate immediately (a code bug must
     never burn the retry budget). Raises :class:`RetryError` when the
     budget is exhausted.
+
+    Window accounting (ISSUE 4 satellite — the r05 log showed a probe
+    attempt granted a 750 s slot inside an already half-spent window):
+
+    - no attempt STARTS at or past the deadline (previously the
+      deadline was only consulted after a failure, so a sleep could
+      run the clock out and a fresh attempt still launch);
+    - a backoff sleep that alone would exhaust the remaining deadline
+      is skipped — the remaining window is spent on one final attempt
+      instead of slept away;
+    - ``budget_kw``: when set, every attempt receives the policy's
+      remaining deadline (seconds, or None without a deadline) as that
+      keyword argument, so callables that grant their own sub-slots
+      (the bench probe's child timeout) can clip them to the window
+      that actually remains.
     """
     rng = rng if rng is not None else random.Random()
     label = what or getattr(fn, "__name__", "call")
@@ -160,8 +176,15 @@ def retry_call(fn: Callable, *args,
     last: Optional[BaseException] = None
     attempts = 0
     while attempts < policy.max_attempts:
+        if (deadline_at is not None and clock() >= deadline_at and
+                attempts > 0):
+            break
         attempts += 1
         try:
+            if budget_kw is not None:
+                remaining = (max(0.0, deadline_at - clock())
+                             if deadline_at is not None else None)
+                return fn(*args, **{budget_kw: remaining}, **kwargs)
             return fn(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001 — classifier decides
             if not policy.classifier(e):
@@ -172,14 +195,18 @@ def retry_call(fn: Callable, *args,
             if deadline_at is not None and clock() >= deadline_at:
                 break
             delay = policy.next_delay(delay, rng)
-            if deadline_at is not None:
-                delay = max(0.0, min(delay, deadline_at - clock()))
+            if deadline_at is not None and \
+                    clock() + delay >= deadline_at:
+                # the backoff alone would exhaust the window — spend
+                # what remains on a final immediate attempt instead
+                delay = 0.0
             if on_retry is not None:
                 on_retry(attempts, e)
             log.warning(f"{label}: transient failure (attempt "
                         f"{attempts}/{policy.max_attempts}): {e!r}; "
                         f"retrying in {delay:.2f}s")
-            sleep(delay)
+            if delay > 0.0:
+                sleep(delay)
     raise RetryError(
         f"{label}: gave up after {attempts} attempt(s) over "
         f"{clock() - start:.1f}s: {last!r}", last, attempts)

@@ -1,30 +1,59 @@
 """Persistent XLA compilation cache setup (shared by bench/tests/CLI).
 
-The grower programs for realistic shapes take minutes to compile on TPU;
-a warm on-disk cache turns that into a file read. One helper so the cache
-directory convention and tuning thresholds live in one place.
+The grower programs for realistic shapes take minutes to compile on TPU,
+and most watchdog kills in BENCH_r03-r05 landed during exactly that
+compile; a warm on-disk cache turns a retried or parked-then-relaunched
+attempt's compile into a file read. One helper so the cache directory
+convention and tuning thresholds live in one place (ISSUE 4: the engine
+and both supervisors — bench.py and scripts/tpu_session_auto.py — all
+route through it).
 """
 from __future__ import annotations
 
 import os
 
+# primary env knob (supervisors export it to every child so retried
+# attempts share one cache); LGBM_TPU_JIT_CACHE is the pre-ISSUE-4 name,
+# honored as a legacy alias
+ENV_COMPILE_CACHE = "LGBM_TPU_COMPILE_CACHE"
+ENV_JIT_CACHE = "LGBM_TPU_JIT_CACHE"
 
-def enable_persistent_cache(cache_dir: str | None = None) -> str:
-    """Point jax's persistent compilation cache at ``cache_dir``.
 
-    Resolution order: explicit argument, ``LGBM_TPU_JIT_CACHE`` env var,
-    ``<repo>/.jax_cache`` next to the package. Returns the directory used.
-    """
-    import jax
-
-    if cache_dir is None:
-        cache_dir = os.environ.get("LGBM_TPU_JIT_CACHE")
-    if cache_dir is None:
+def resolve_cache_dir(cache_dir: str | None = None,
+                      env=None) -> str:
+    """Resolution order: explicit argument (the ``tpu_compile_cache_dir``
+    config param routes here), ``LGBM_TPU_COMPILE_CACHE``,
+    ``LGBM_TPU_JIT_CACHE`` (legacy), ``<repo>/.jax_cache``."""
+    e = env if env is not None else os.environ
+    if not cache_dir:
+        cache_dir = e.get(ENV_COMPILE_CACHE) or e.get(ENV_JIT_CACHE)
+    if not cache_dir:
         cache_dir = os.path.join(
             os.path.dirname(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__)))), ".jax_cache")
-    cache_dir = os.path.abspath(cache_dir)
+    return os.path.abspath(cache_dir)
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> str:
+    """Point jax's persistent compilation cache at ``cache_dir``
+    (resolved via :func:`resolve_cache_dir`). Returns the directory
+    used. Safe to call repeatedly; the last call wins — the cache
+    singleton is reset when the directory actually changes after first
+    use (jax binds it lazily to the dir seen at the first compile, so
+    a mid-process ``tpu_compile_cache_dir`` would otherwise be
+    silently ignored)."""
+    import jax
+
+    cache_dir = resolve_cache_dir(cache_dir)
+    changed = jax.config.jax_compilation_cache_dir != cache_dir
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    if changed:
+        try:
+            from jax._src import compilation_cache as _cc
+            if _cc.is_initialized():
+                _cc.reset_cache()
+        except Exception:   # noqa: BLE001 — private API; best effort
+            pass
     return cache_dir

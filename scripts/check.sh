@@ -35,6 +35,18 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
+echo "== heartbeat smoke (stall supervision round-trip, CPU) =="
+# ISSUE 4: an injected hang must be classified within the stall budget,
+# SIGTERMed, and recovered by the shared RetryPolicy; a slow_compile-
+# stretched child with live keepalives must NOT be classified. The
+# script asserts its own <30 s budget; the timeout is a backstop.
+timeout -k 10 90 env JAX_PLATFORMS=cpu \
+    python scripts/heartbeat_smoke.py || rc=1
+if [ $rc -ne 0 ]; then
+    echo "check.sh: heartbeat smoke failed — skipping tier-1 pytest" >&2
+    exit $rc
+fi
+
 echo "== hybrid-path dispatch guards (compile budget + O(levels) shape) =="
 # the round-7 hot path: steady-state hybrid training must stay <=2
 # recompiles over 5 iterations and the level phase must issue

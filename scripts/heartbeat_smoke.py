@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Heartbeat supervision smoke: supervisor + injected hang round-trip.
+
+The fast end-to-end gate for scripts/check.sh (ISSUE 4): a child under
+LGBM_TPU_FAULTS=hang goes heartbeat-silent mid-phase, the supervisor
+classifies it hung WITHIN the stall budget (not a blind slot), SIGTERMs
+it, and the shared RetryPolicy relaunches — the second (healthy)
+attempt completes. Also exercises the slow_compile leg: a child whose
+compiling phase is stretched but whose keepalives advance is NEVER
+classified hung. Must finish in <30 s on the CPU backend; fails
+non-zero (and prints the budget) if any guarantee regresses.
+"""
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from lightgbm_tpu.robustness.heartbeat import (DeviceStallError,  # noqa: E402
+                                               StallPolicy)
+from lightgbm_tpu.robustness.retry import (RetryPolicy,  # noqa: E402
+                                           retry_call)
+from lightgbm_tpu.robustness.supervisor import watch_child  # noqa: E402
+
+BUDGET_SEC = 30.0
+
+# the child only touches the no-jax robustness layer: it beats, sleeps,
+# exits — liveness plumbing is what's under test, not training
+CHILD = r"""
+import os, sys, time
+sys.path.insert(0, os.environ["SMOKE_REPO"])
+from lightgbm_tpu.robustness import heartbeat
+heartbeat.install_from_env()
+heartbeat.beat("compiling", 0)
+for i in range(int(os.environ.get("SMOKE_ITERS", "10"))):
+    heartbeat.beat("measuring", i)
+    time.sleep(0.1)
+"""
+
+POLICY = StallPolicy(
+    stall_sec={"compiling": 15.0, "measuring": 3.0},
+    default_stall=3.0, silent_sec=1.5, startup_grace=20.0)
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def spawn(tmpdir, n, extra_env):
+    hb = os.path.join(tmpdir, f"smoke{n}.hb")
+    env = dict(os.environ, SMOKE_REPO=REPO, LGBM_TPU_HEARTBEAT=hb,
+               LGBM_TPU_HEARTBEAT_KA="0.2", JAX_PLATFORMS="cpu",
+               **extra_env)
+    env.pop("LGBM_TPU_FAULTS", None)
+    env.update(extra_env)
+    proc = subprocess.Popen([sys.executable, "-c", CHILD], env=env)
+    return proc, hb
+
+
+def main() -> int:
+    import tempfile
+    t0 = time.monotonic()
+    tmpdir = tempfile.mkdtemp(prefix="hb_smoke_")
+    state = {"n": 0}
+
+    def attempt():
+        state["n"] += 1
+        n = state["n"]
+        # attempt 1 hangs (beats stop after 3); attempt 2 is healthy
+        extra = ({"LGBM_TPU_FAULTS": "hang:after=3",
+                  "SMOKE_ITERS": "200"} if n == 1
+                 else {"SMOKE_ITERS": "5"})
+        proc, hb = spawn(tmpdir, n, extra)
+        rc = watch_child(proc, hb, policy=POLICY, poll=0.25,
+                         term_grace=5.0, label=f"smoke attempt {n}")
+        if rc != 0:
+            raise RuntimeError(f"healthy child exited rc={rc}")
+        return n
+
+    done = retry_call(
+        attempt,
+        policy=RetryPolicy(max_attempts=3, base_delay=0.01,
+                           max_delay=0.05, deadline=BUDGET_SEC),
+        what="hang round-trip")
+    assert done == 2, f"expected recovery on attempt 2, got {done}"
+    print(f"[hb-smoke] hang classified + retried + recovered "
+          f"(attempt {done}) in {time.monotonic() - t0:.1f}s")
+
+    # slow_compile leg: stretched compiling phase, keepalives advancing
+    # -> must complete WITHOUT a stall classification
+    proc, hb = spawn(tmpdir, 9, {
+        "LGBM_TPU_FAULTS": "slow_compile:sec=4", "SMOKE_ITERS": "3"})
+    try:
+        rc = watch_child(proc, hb, policy=POLICY, poll=0.25,
+                         label="slow-compile child")
+    except DeviceStallError as e:
+        print(f"[hb-smoke] FAIL: slow_compile child was classified "
+              f"hung: {e}")
+        return 1
+    assert rc == 0, f"slow-compile child exited rc={rc}"
+    elapsed = time.monotonic() - t0
+    print(f"[hb-smoke] slow-compile child survived supervision; "
+          f"total {elapsed:.1f}s (budget {BUDGET_SEC:.0f}s)")
+    if elapsed >= BUDGET_SEC:
+        print("[hb-smoke] FAIL: over budget")
+        return 1
+    print("[hb-smoke] PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,25 @@ LOGDIR = os.path.join(REPO, "bench_logs")
 MEASURED = os.path.join(LOGDIR, "MEASURED_r05.json")
 T0 = time.time()
 
+sys.path.insert(0, REPO)
+from lightgbm_tpu.robustness import heartbeat  # noqa: E402
+from lightgbm_tpu.utils.jit_cache import (ENV_COMPILE_CACHE,  # noqa: E402
+                                          resolve_cache_dir)
+
+# ISSUE 4: one persistent compile cache for EVERY stage of the session
+# (and every bench child under them) — a stage relaunched after a park/
+# stall, or simply the next stage at the same shape, reads the previous
+# compile from disk instead of repaying the multi-minute remote compile
+# that used to eat stage deadlines.
+SESSION_CACHE = os.environ.get(ENV_COMPILE_CACHE) or resolve_cache_dir()
+
+# heartbeat-aware stage extension: a stage past its deadline whose bench
+# tree is still ADVANCING (bench.py relays grandchild beats onto its own
+# heartbeat file) gets up to this much extra wall-clock before parking;
+# a stage gone heartbeat-silent parks at the deadline, classified as a
+# stall rather than as slow.
+STALL_EXTEND_SEC = int(os.environ.get("SESSION_STALL_EXTEND_SEC", 1500))
+
 # consecutive stages that come back "device unreachable" before we
 # conclude the window closed and hand control back to the watcher
 MAX_CONSEC_FAILS = 2
@@ -64,18 +83,54 @@ def _run_stage(cmd: list, env: dict, timeout: float, logpath: str):
     abandoned child can never block on a pipe). NEVER kills on
     timeout: the child is parked — left running to finish its compile
     and release the claim cleanly — and (stdout_text, timed_out=True)
-    is returned with whatever output it produced so far."""
+    is returned with whatever output it produced so far.
+
+    ISSUE 4: the deadline is heartbeat-aware. The bench parent beats at
+    ``<logpath>.hb`` (relaying its grandchildren's phase/progress), and
+    a stage past ``timeout`` whose heartbeat still ADVANCES is granted
+    up to STALL_EXTEND_SEC more — a healthy long compile is not a
+    wedge. A stage whose heartbeat went silent parks at the deadline
+    with a "stalled" classification in the log (still no kill: the
+    grandchild may hold the device claim)."""
+    hb_path = logpath + ".hb"
+    policy = heartbeat.StallPolicy.from_env()
     with open(logpath + ".stdout", "w", encoding="utf-8") as out_f, \
             open(logpath, "a", encoding="utf-8") as err_f:
         proc = subprocess.Popen(
-            cmd, env=env, cwd=REPO, text=True, start_new_session=True,
+            cmd, env=dict(env, LGBM_TPU_HEARTBEAT=hb_path), cwd=REPO,
+            text=True, start_new_session=True,
             stdout=out_f, stderr=err_f)
         timed_out = False
-        try:
-            proc.wait(timeout=timeout)
-        except subprocess.TimeoutExpired:
+        verdict = "alive"
+        started = time.monotonic()
+        base_deadline = started + timeout
+        hard_deadline = base_deadline + STALL_EXTEND_SEC
+        extending = False
+        while True:
+            try:
+                proc.wait(timeout=5.0)
+                break
+            except subprocess.TimeoutExpired:
+                pass
+            now = time.monotonic()
+            if now < base_deadline:
+                continue
+            rec = heartbeat.read(hb_path)
+            verdict = policy.classify(rec, now, started)
+            if verdict == heartbeat.ALIVE and now < hard_deadline:
+                if not extending:
+                    extending = True
+                    say(f"stage deadline reached but the bench tree is "
+                        f"ALIVE (phase {rec.phase!r} progress "
+                        f"{rec.progress}); extending up to "
+                        f"{STALL_EXTEND_SEC}s instead of parking")
+                continue
             timed_out = True
             PARKED["proc"] = proc
+            with open(logpath, "a", encoding="utf-8") as f2:
+                f2.write(f"stage liveness verdict at park: {verdict} "
+                         f"(hb={rec!r})\n")
+            break
     with open(logpath + ".stdout", "r", encoding="utf-8",
               errors="replace") as f:
         stdout = f.read()
@@ -99,6 +154,7 @@ def run_bench(stage: str, rows: int, iters: int, extra: dict | None = None,
     env = dict(os.environ,
                BENCH_ROWS=str(rows), BENCH_ITERS=str(iters),
                BENCH_WATCHDOG_SEC=str(watchdog))
+    env[ENV_COMPILE_CACHE] = SESSION_CACHE
     if scheds is not None:
         env["BENCH_SCHEDS"] = scheds
     if env_extra:
@@ -145,16 +201,22 @@ def run_bench(stage: str, rows: int, iters: int, extra: dict | None = None,
     if result is not None:
         result["stage"] = stage
         RESULTS.append(result)
-        if result.get("status") == "parked":
+        if result.get("status") == "parked" or result.get("parked"):
             # bench.py exited but left a claim-holding grandchild
             # RUNNING (its internal watchdog preempts ours, so the
             # PARKED proc-handle guard above never sees it) — no
-            # further claims from this session
+            # further claims from this session. A "salvaged" result
+            # with parked=true still BANKED its partial metric above
+            # before the park stops the session.
             dump_state()
             raise SessionParked(
-                f"stage {stage}: bench parked a claim-holding child")
+                f"stage {stage}: bench parked a claim-holding child"
+                + (f" (salvaged {result.get('value')} it/s first)"
+                   if result.get("status") == "salvaged" else ""))
         say(f"stage {stage}: {result.get('value')} it/s "
-            f"(vs_baseline {result.get('vs_baseline')})")
+            f"(vs_baseline {result.get('vs_baseline')})"
+            + (" [salvaged]" if result.get("status") == "salvaged"
+               else ""))
     else:
         say(f"stage {stage}: no result line")
     STATE["stages"].append({"stage": stage,

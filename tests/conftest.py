@@ -24,14 +24,32 @@ import jax  # noqa: E402
 _test_platform = os.environ.get("LGBM_TPU_TEST_DEVICE", "cpu")
 jax.config.update("jax_platforms", _test_platform)
 
-# Persistent compilation cache: the suite re-jits the same grower shapes
-# every run; warm-cache runs skip most XLA compile time.
+# Persistent compilation cache (ISSUE 4 hermeticity rules):
+# - the resolved directory is PINNED into LGBM_TPU_COMPILE_CACHE so
+#   every subprocess a test spawns (bench salvage/stall children,
+#   fault smokes) shares THIS run's cache instead of scribbling into
+#   whatever ambient convention the child would resolve — one run, one
+#   cache, no cross-talk with concurrently running suites;
+# - LGBM_TPU_HERMETIC_CACHE=1 pins it to a fresh per-run tmpdir (fully
+#   cold start). The default stays the shared repo cache: the tier-1
+#   verify runs under a fixed wall-clock window and the measured warm
+#   cache (~1000 entries) is worth tens of passed tests within it —
+#   XLA cache keys hash the full HLO, so a stale entry can never serve
+#   a changed program, only cost disk;
+# - tests that ASSERT cache behavior (test_heartbeat.py) create their
+#   own tmpdir caches and are hermetic regardless of this default.
 import sys  # noqa: E402
+import tempfile  # noqa: E402
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-from lightgbm_tpu.utils.jit_cache import enable_persistent_cache  # noqa: E402
+from lightgbm_tpu.utils.jit_cache import (ENV_COMPILE_CACHE,  # noqa: E402
+                                          enable_persistent_cache)
 
-enable_persistent_cache()
+if os.environ.get("LGBM_TPU_HERMETIC_CACHE", "").strip().lower() in \
+        ("1", "true", "yes", "on"):
+    os.environ[ENV_COMPILE_CACHE] = tempfile.mkdtemp(
+        prefix="lgbm_tpu_compile_cache_")
+os.environ[ENV_COMPILE_CACHE] = enable_persistent_cache()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

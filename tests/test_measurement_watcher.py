@@ -81,22 +81,54 @@ def test_probe_failure_classification(monkeypatch, capsys):
     bench = _load_bench_mod()
     bench.BENCH_WATCHDOG_SEC = 1  # reserve=0.5s -> tiny retry window
 
-    def timing_out(env_extra, timeout):
+    class _FakeProc:
+        pid = 1
+        _rc = None
+
+        def poll(self):
+            return self._rc
+
+        def terminate(self):
+            self._rc = -15
+
+        def wait(self, timeout=None):
+            return self._rc
+
+    class _FakeChild:
+        """Post-ISSUE-4 spawn surface (_ChildSpawn + watch_child)."""
+
+        stderr_text = ""
+
+        def __init__(self, env_extra, tag, partial=False):
+            self.hb_path = "/nonexistent.hb"
+            self.partial_path = ""
+            self.proc = _FakeProc()
+
+        def read_streams(self):
+            return "", type(self).stderr_text
+
+        def cleanup(self):
+            pass
+
+        def fail_cleanup(self, tail=2000):
+            return self.proc.poll() is not None
+
+    from lightgbm_tpu.robustness.supervisor import StillAlive
+    monkeypatch.setattr(bench, "_ChildSpawn", _FakeChild)
+
+    def timing_out(proc, hb, **kw):
         # consume the whole retry window so exactly one attempt runs
         # (a real timed-out probe has eaten its slot by definition)
         time.sleep(0.6)
-        raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
-    monkeypatch.setattr(bench, "_spawn", timing_out)
+        raise StillAlive("probe at slot", pid=1)
+    monkeypatch.setattr(bench, "watch_child", timing_out)
     rc = bench.main()
     res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == bench.RC_DEVICE_UNREACHABLE == 4
     assert res["status"] == "device_unreachable"
 
-    def code_failure(env_extra, timeout):
-        return subprocess.CompletedProcess(
-            args=["probe"], returncode=1, stdout="",
-            stderr="ImportError: cannot import name 'grower'")
-    monkeypatch.setattr(bench, "_spawn", code_failure)
+    _FakeChild.stderr_text = "ImportError: cannot import name 'grower'"
+    monkeypatch.setattr(bench, "watch_child", lambda *a, **k: 1)
     rc = bench.main()
     res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == bench.RC_NO_RESULT == 3

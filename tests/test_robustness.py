@@ -577,12 +577,31 @@ def test_bench_probe_retries_under_shared_policy(monkeypatch, capsys):
 
     attempts = []
 
-    def unavailable(env_extra, timeout):
-        attempts.append(timeout)
-        return subprocess.CompletedProcess(
-            args=["probe"], returncode=1, stdout="",
-            stderr="UNAVAILABLE: TPU backend setup/compile error")
-    monkeypatch.setattr(bench, "_spawn", unavailable)
+    class _FakeProc:
+        pid = 1
+
+        def poll(self):
+            return 1
+
+    class _FakeChild:
+        """Probe child that dies with the UNAVAILABLE recovery
+        signature (post-ISSUE-4 spawn surface: _ChildSpawn +
+        watch_child instead of _spawn)."""
+
+        def __init__(self, env_extra, tag, partial=False):
+            attempts.append(tag)
+            self.hb_path = "/nonexistent.hb"
+            self.partial_path = ""
+            self.proc = _FakeProc()
+
+        def read_streams(self):
+            return "", "UNAVAILABLE: TPU backend setup/compile error"
+
+        def cleanup(self):
+            pass
+
+    monkeypatch.setattr(bench, "_ChildSpawn", _FakeChild)
+    monkeypatch.setattr(bench, "watch_child", lambda *a, **k: 1)
     rc = bench.main()
     res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == bench.RC_DEVICE_UNREACHABLE == 4
