@@ -77,6 +77,17 @@ ENV_PARTIAL = "LGBM_TPU_PARTIAL"
 PARTIAL_EVERY_SEC = float(os.environ.get("LGBM_TPU_PARTIAL_EVERY_SEC",
                                          45.0))
 
+# inference axis (ISSUE 5): after the training measurement the same child
+# times the packed-forest serving engine (models/gbdt.py predict_device)
+# over the trained model — binned route (device searchsorted binning) and
+# raw route (model round-tripped through text, served without mappers via
+# tree_leaf_raw). Emits a second JSON line, unit rows/sec, same status
+# grammar; banked partials salvage it when the child dies mid-measure.
+ENV_PARTIAL_PREDICT = "LGBM_TPU_PARTIAL_PREDICT"
+BENCH_PREDICT = os.environ.get("BENCH_PREDICT", "1") == "1"
+PREDICT_BATCH = int(os.environ.get("BENCH_PREDICT_BATCH", 100_000))
+PREDICT_ROWS = int(os.environ.get("BENCH_PREDICT_ROWS", 1_000_000))
+
 
 # non-default configs (leaves ladder, dtype modes) are labeled so their
 # numbers can't masquerade as the headline metric
@@ -116,6 +127,23 @@ def _fail_line(note: str, status: str = "no_result") -> str:
     return json.dumps(_result_record(0.0, status=status, note=note))
 
 
+def _predict_record(rows_per_sec: float, **extra) -> dict:
+    """The ONE shape of the inference metric (same status grammar as the
+    training record; `value` is the BINNED-route throughput, the raw
+    route rides along as a field)."""
+    return {
+        "metric": f"higgs_synth_{N_ROWS}x{N_FEATURES}"
+                  f"_predict_rows_per_sec{_SUFFIX}",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/sec",
+        **extra,
+    }
+
+
+def _predict_fail_line(note: str, status: str = "no_result") -> str:
+    return json.dumps(_predict_record(0.0, status=status, note=note))
+
+
 def _force_sync(arr) -> float:
     """Barrier that actually waits for device completion.
 
@@ -140,16 +168,13 @@ def synth_higgs(n, f, seed=0):
     return X, y
 
 
-def _bank_partial(path: str, sched: str, iters_done: int,
-                  elapsed: float) -> None:
-    """Crash-safe rewrite of the partial-result file (tmp + replace):
+def _bank_record(path: str, rec: dict) -> None:
+    """Crash-safe rewrite of a partial-result file (tmp + replace):
     whatever the parent finds here after a park/stall is the last
     throughput the device PROVABLY sustained (each bank follows a full
     device sync)."""
-    if not path or iters_done <= 0 or elapsed <= 0:
+    if not path:
         return
-    rec = _result_record(iters_done / elapsed, sched=sched,
-                         partial=True, iters_done=iters_done)
     tmp = f"{path}.{os.getpid()}.tmp"
     try:
         with open(tmp, "w", encoding="utf-8") as f:
@@ -157,6 +182,14 @@ def _bank_partial(path: str, sched: str, iters_done: int,
         os.replace(tmp, path)
     except OSError as e:
         print(f"[bench] partial bank failed: {e!r}", file=sys.stderr)
+
+
+def _bank_partial(path: str, sched: str, iters_done: int,
+                  elapsed: float) -> None:
+    if not path or iters_done <= 0 or elapsed <= 0:
+        return
+    _bank_record(path, _result_record(iters_done / elapsed, sched=sched,
+                                      partial=True, iters_done=iters_done))
 
 
 def run_child(sched: str) -> None:
@@ -283,6 +316,106 @@ def run_child(sched: str) -> None:
         # tunnel peak — a trendline, NOT a hardware utilization counter
         mfu_model=round(_hist_mfu(ips, sched), 6))), flush=True)
 
+    if BENCH_PREDICT:
+        # inference axis (ISSUE 5): serve the just-trained model through
+        # the packed-forest engine. Failures must never retro-poison the
+        # training line already printed above.
+        try:
+            _measure_predict(lgb, booster, X, sched)
+        except Exception as e:
+            print(f"[bench] predict measurement failed: {e!r}",
+                  file=sys.stderr)
+            print(_predict_fail_line(f"sched={sched}: {e!r}"), flush=True)
+
+
+def _timed_predict(predict_fn, X, tag: str, sched: str,
+                   bank_path: str, extra: dict) -> float:
+    """Drive predict_fn over PREDICT_ROWS rows in PREDICT_BATCH chunks;
+    returns rows/sec. Each chunk result is host-materialized (a real
+    barrier), beats the heartbeat, and banks a crash-safe partial so a
+    late park/stall still salvages a provably-sustained rate."""
+    n = X.shape[0]
+    rows_done = 0
+    t0 = time.perf_counter()
+    next_bank = t0 + PARTIAL_EVERY_SEC if bank_path else None
+    chunk_i = 0
+    while rows_done < PREDICT_ROWS:
+        off = (chunk_i * PREDICT_BATCH) % n
+        chunk = X[off:off + PREDICT_BATCH]
+        predict_fn(chunk)
+        rows_done += len(chunk)
+        chunk_i += 1
+        heartbeat.beat(heartbeat.PHASE_MEASURING, 10_000 + chunk_i)
+        now = time.perf_counter()
+        if next_bank is not None and rows_done < PREDICT_ROWS and \
+                now >= next_bank:
+            _bank_record(bank_path, _predict_record(
+                rows_done / (now - t0), partial=True, path=tag,
+                sched=sched, rows_done=rows_done, **extra))
+            next_bank = time.perf_counter() + PARTIAL_EVERY_SEC
+    return rows_done / (time.perf_counter() - t0)
+
+
+def _measure_predict(lgb, booster, X, sched: str) -> None:
+    """Binned + raw serving throughput over the trained model; prints the
+    predict JSON line."""
+    bank_path = os.environ.get(ENV_PARTIAL_PREDICT, "")
+    Xq = np.asarray(X[:PREDICT_BATCH], np.float64)
+    n_trees = booster.current_iteration()
+    extra = {"trees": n_trees, "leaves": NUM_LEAVES,
+             "batch": PREDICT_BATCH}
+
+    def binned(chunk):
+        return booster.predict(chunk, device=True, raw_score=True)
+
+    t0 = time.perf_counter()
+    binned(Xq[:PREDICT_BATCH])           # compile + pack, untimed
+    print(f"[bench] predict binned warmup {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+    # Booster.predict falls back to the HOST walk (with only a stderr
+    # warning) when the serving engine refuses a shape — a number
+    # measured there must never masquerade as device throughput
+    srv = getattr(booster._engine, "_serving", None)
+    if srv is None or srv.pack.count != len(booster._engine.models):
+        raise RuntimeError("binned device route did not serve (host "
+                           "fallback engaged) — refusing to publish host "
+                           "throughput as the packed-forest metric")
+    binned_rps = _timed_predict(binned, X, "binned", sched, bank_path,
+                                extra)
+
+    # raw route: round-trip through model text — a loaded model has no
+    # bin mappers, so predict_device serves via tree_leaf_raw
+    loaded = lgb.Booster(model_str=booster.model_to_string())
+
+    def raw(chunk):
+        return loaded.predict(chunk, device=True, raw_score=True)
+
+    t0 = time.perf_counter()
+    raw(Xq[:PREDICT_BATCH])
+    print(f"[bench] predict raw warmup {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+    srv = getattr(loaded._engine, "_serving", None)
+    if srv is None or srv.raw_pack.count != len(loaded._engine.models):
+        raise RuntimeError("raw device route did not serve (host "
+                           "fallback engaged) — refusing to publish host "
+                           "throughput as the packed-forest metric")
+    raw_rps = _timed_predict(raw, X, "raw", sched, bank_path, extra)
+
+    # parity guard: a serving engine that quietly diverged must not
+    # publish a throughput number
+    host = booster.predict(Xq[:4096], raw_score=True)
+    dev = binned(Xq[:4096])
+    if not np.allclose(host, dev, rtol=1e-5, atol=1e-6):
+        raise RuntimeError("device/host prediction parity broke: "
+                           f"max|d|={np.abs(host - dev).max():.3e}")
+    rec = _predict_record(binned_rps, sched=sched,
+                          binned_rows_per_sec=round(binned_rps, 1),
+                          raw_rows_per_sec=round(raw_rps, 1), **extra)
+    if bank_path:
+        _bank_record(bank_path, dict(rec, partial=True,
+                                     rows_done=PREDICT_ROWS))
+    print(json.dumps(rec), flush=True)
+
 
 # Measured bf16 MXU peak through this tunnel (docs/TPU_RUNBOOK.md:
 # 8192^3 matmul sustained ~156 TFLOP/s). MFU here is hist-kernel model
@@ -382,16 +515,23 @@ class _ChildSpawn:
                                             suffix=".hb")
         os.close(fd)
         self.partial_path = ""
+        self.predict_partial_path = ""
         if partial:
             fd, self.partial_path = tempfile.mkstemp(
                 prefix=f"bench_{tag}_", suffix=".partial")
+            os.close(fd)
+            fd, self.predict_partial_path = tempfile.mkstemp(
+                prefix=f"bench_{tag}_", suffix=".ppartial")
             os.close(fd)
         env = dict(os.environ, **env_extra)
         env[heartbeat.ENV_HEARTBEAT] = self.hb_path
         env[ENV_COMPILE_CACHE] = _cache_dir()
         env.pop(ENV_PARTIAL, None)
+        env.pop(ENV_PARTIAL_PREDICT, None)
         if self.partial_path:
             env[ENV_PARTIAL] = self.partial_path
+        if self.predict_partial_path:
+            env[ENV_PARTIAL_PREDICT] = self.predict_partial_path
         self.proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)],
             env=env, stdout=self.out_f, stderr=self.err_f, text=True,
@@ -637,19 +777,28 @@ def main() -> int:
     except RetryError as e:
         # transient failures exhausted the shared policy → honest
         # device symptom (rc=4), reported only after the deadline
-        print(_fail_line(
-            f"probe failed after {e.attempts} attempt(s) across "
-            f"{BENCH_WATCHDOG_SEC}s window: {e.last!r}",
-            status="device_unreachable"), flush=True)
+        note = (f"probe failed after {e.attempts} attempt(s) across "
+                f"{BENCH_WATCHDOG_SEC}s window: {e.last!r}")
+        print(_fail_line(note, status="device_unreachable"), flush=True)
+        if BENCH_PREDICT:
+            print(_predict_fail_line(note, status="device_unreachable"),
+                  flush=True)
         return RC_DEVICE_UNREACHABLE
     except _ProbeStuck as e:
-        print(_fail_line(f"probe stalled and unkillable: {e}",
-                         status="device_unreachable"), flush=True)
+        note = f"probe stalled and unkillable: {e}"
+        print(_fail_line(note, status="device_unreachable"), flush=True)
+        if BENCH_PREDICT:
+            print(_predict_fail_line(note, status="device_unreachable"),
+                  flush=True)
         return RC_DEVICE_UNREACHABLE
     except _ProbeCodeFailure as e:
         print(_fail_line(
             f"probe failed (code failure, not retried): {e}",
             status="no_result"), flush=True)
+        if BENCH_PREDICT:
+            print(_predict_fail_line(
+                f"probe failed (code failure, not retried): {e}"),
+                flush=True)
         return RC_NO_RESULT
 
     # ---- measurement stages: phase-aware liveness instead of fixed
@@ -675,18 +824,58 @@ def main() -> int:
         return is_transient_error(exc)
 
     salvage_files: list = []   # (sched, partial_path), attempt order
+    predict_salvage_files: list = []   # (sched, predict_partial_path)
     parked_pid = {"pid": None}
 
-    def best_salvage():
+    def _best_banked(files, progress_key):
+        """Best banked partial across attempts, by measured progress —
+        the ONE selection rule for both metric lines."""
         best = None
-        for sched, p in salvage_files:
+        for _, p in files:
             rec = _read_partial(p)
             if rec is None:
                 continue
-            if best is None or int(rec.get("iters_done", 0)) >= \
-                    int(best.get("iters_done", 0)):
+            if best is None or int(rec.get(progress_key, 0)) >= \
+                    int(best.get(progress_key, 0)):
                 best = rec
         return best
+
+    def _salvage_decorate(rec: dict, note: str) -> dict:
+        """The ONE salvage-record shape (status/note/parked fields) both
+        metric lines share — tpu_session_auto keys on these fields."""
+        rec = dict(rec)
+        rec.pop("partial", None)
+        rec["status"] = "salvaged"
+        rec["note"] = note
+        if parked_pid["pid"] is not None:
+            rec["parked"] = True
+            rec["parked_pid"] = parked_pid["pid"]
+        return rec
+
+    def best_salvage():
+        return _best_banked(salvage_files, "iters_done")
+
+    def emit_predict_line(line, failed_stage: str, reason: str) -> None:
+        """Second metric line (inference axis): the child's own line when
+        it produced one (run_child prints its own 0.0 fail line when the
+        predict stage dies after a successful training print), else the
+        best banked predict partial with status=salvaged. A failed run
+        that never reached the predict stage emits NOTHING here — the
+        training salvage/fail line stays the LAST line, which downstream
+        consumers (test_heartbeat, session logs) key on."""
+        if not BENCH_PREDICT:
+            return
+        if line is not None:
+            print(line, flush=True)
+            return
+        best = _best_banked(predict_salvage_files, "rows_done")
+        if best is not None:
+            print(json.dumps(_salvage_decorate(
+                best,
+                f"salvaged: last banked predict partial "
+                f"({best.get('rows_done')} rows, path="
+                f"{best.get('path', 'final')}); "
+                f"{failed_stage}: {reason}")), flush=True)
 
     def emit_salvaged(failed_stage: str, reason: str) -> bool:
         """Print the last banked stage metric (with a "salvaged" note
@@ -696,19 +885,15 @@ def main() -> int:
         rec = best_salvage()
         if rec is None:
             return False
-        rec = dict(rec)
-        rec.pop("partial", None)
-        rec["status"] = "salvaged"
-        rec["note"] = (f"salvaged: last banked partial "
-                       f"({rec.get('iters_done')} iters, "
-                       f"sched={rec.get('sched')}); failed stage "
-                       f"{failed_stage}: {reason}")
-        if parked_pid["pid"] is not None:
-            # load-bearing for tpu_session_auto.py: a parked child may
-            # still hold the device claim — no further session claims
-            rec["parked"] = True
-            rec["parked_pid"] = parked_pid["pid"]
-        print(json.dumps(rec), flush=True)
+        # parked/parked_pid are load-bearing for tpu_session_auto.py: a
+        # parked child may still hold the device claim — no further
+        # session claims (attached by _salvage_decorate)
+        print(json.dumps(_salvage_decorate(
+            rec,
+            f"salvaged: last banked partial "
+            f"({rec.get('iters_done')} iters, "
+            f"sched={rec.get('sched')}); failed stage "
+            f"{failed_stage}: {reason}")), flush=True)
         return True
 
     # a fresh measurement child needs at least this much window to be
@@ -718,8 +903,9 @@ def main() -> int:
     # session for nothing
     measure_min_slot = min(60.0, BENCH_WATCHDOG_SEC * 0.3)
 
-    def measure_attempt(sched: str) -> str:
-        """One supervised measurement child; returns the result line."""
+    def measure_attempt(sched: str) -> tuple:
+        """One supervised measurement child; returns (training result
+        line, predict result line or None)."""
         remaining = deadline - time.time()
         if remaining < measure_min_slot:
             raise _ChildNoResult(
@@ -729,6 +915,8 @@ def main() -> int:
         child = _ChildSpawn({"_LGBM_BENCH_CHILD": sched},
                             tag=f"child_{sched}", partial=True)
         salvage_files.append((sched, child.partial_path))
+        predict_salvage_files.append(
+            (sched, getattr(child, "predict_partial_path", "")))
         try:
             rc = watch_child(
                 child.proc, child.hb_path, policy=stall_policy,
@@ -756,10 +944,17 @@ def main() -> int:
         out, err = child.read_streams()
         child.cleanup()
         sys.stderr.write(err[-4000:])
+        train_line = predict_line = None
         for ln in out.splitlines():
             ln = ln.strip()
-            if ln.startswith("{") and '"iters/sec"' in ln:
-                return ln
+            if not ln.startswith("{"):
+                continue
+            if '"iters/sec"' in ln and train_line is None:
+                train_line = ln
+            elif '"rows/sec"' in ln and predict_line is None:
+                predict_line = ln
+        if train_line is not None:
+            return train_line, predict_line
         raise _ChildNoResult(
             f"sched={sched} exited rc={rc} without a result: "
             f"{err[-300:]!r}")
@@ -776,10 +971,12 @@ def main() -> int:
                 max_delay=15.0, deadline=max(budget, 1.0),
                 classifier=_measure_classifier)
             try:
-                line = retry_call(measure_attempt, sched,
-                                  policy=measure_policy,
-                                  what=f"bench measurement sched={sched}")
+                line, predict_line = retry_call(
+                    measure_attempt, sched, policy=measure_policy,
+                    what=f"bench measurement sched={sched}")
                 print(line, flush=True)
+                emit_predict_line(predict_line, f"sched={sched}",
+                                  "child exited without a predict line")
                 return 0
             except _ParkedChild as e:
                 # status "parked" (or a salvaged line with parked=true) is
@@ -788,15 +985,19 @@ def main() -> int:
                 # holds the device claim, and any fresh claim stacked on
                 # it is the documented wedge trigger
                 if emit_salvaged(f"sched={sched}", str(e)):
+                    emit_predict_line(None, f"sched={sched}", str(e))
                     return 0
                 print(_fail_line(
                     f"sched={sched}: {e} — remaining stages skipped",
                     status="parked"), flush=True)
+                emit_predict_line(None, f"sched={sched}",
+                                  f"parked: {e}")
                 return RC_NO_RESULT
             except RetryError as e:
                 # every relaunch stalled: salvage whatever a timed loop
                 # banked before the device went quiet
                 if emit_salvaged(f"sched={sched}", str(e)):
+                    emit_predict_line(None, f"sched={sched}", str(e))
                     return 0
                 last_note = (f"sched={sched} stalled through "
                              f"{e.attempts} attempt(s): {e.last!r}")
@@ -805,14 +1006,16 @@ def main() -> int:
                 last_note = str(e)
                 continue
         if emit_salvaged("all scheduling modes", last_note):
+            emit_predict_line(None, "all scheduling modes", last_note)
             return 0
         print(_fail_line(last_note), flush=True)
+        emit_predict_line(None, "all scheduling modes", last_note)
         return RC_NO_RESULT
     finally:
         # banked partials were read by emit_salvaged above;
         # drop them unless a parked child still writes there
         if parked_pid["pid"] is None:
-            for _, pth in salvage_files:
+            for _, pth in salvage_files + predict_salvage_files:
                 try:
                     os.unlink(pth)
                 except OSError:

@@ -940,11 +940,14 @@ class Booster:
             else ""
         es = es and not raw_score and (K > 1 or obj_name == "binary")
 
-        # opt-in device prediction (predict(..., device=True)): bin with
-        # the training mappers + one jitted all-trees traversal — exact
-        # vs the host walk (thresholds ARE bin boundaries); linear trees,
-        # empty ranges and prediction early stop fall back to the host
-        # path. On success `raw` falls through to the shared output tail.
+        # opt-in device prediction (predict(..., device=True)) through the
+        # packed-forest serving engine (ops/forest.py): device binning +
+        # depth-bounded batched traversal — split-exact vs the host walk
+        # (thresholds ARE bin boundaries). Models without in-session
+        # mappers (loaded from file) serve over raw thresholds; linear
+        # trees, raw categorical bitsets, empty ranges and prediction
+        # early stop fall back to the host path. On success `raw` falls
+        # through to the shared output tail.
         raw = None
         use_device = kwargs.get(
             "device", self.params.get("tpu_predict_device", False))
@@ -1057,7 +1060,7 @@ class Booster:
         t = self._engine.models[tree_id]
         t.leaf_value = np.asarray(t.leaf_value, np.float64).copy()
         t.leaf_value[leaf_id] = float(value)
-        self._engine._dev_pred_cache = None  # stacked trees are stale
+        self._engine.invalidate_serving_cache()  # in-place content edit
         return self
 
     def trees_to_dataframe(self):
@@ -1193,7 +1196,7 @@ class Booster:
         """Randomly permute the trees of the given iteration window
         (ref: basic.py:4416 shuffle_models; used before refit)."""
         eng = self._engine
-        eng._dev_pred_cache = None  # stacked-tree cache is order-sensitive
+        eng.invalidate_serving_cache()  # packed forest is order-sensitive
         K = eng.num_tree_per_iteration
         n_iter = len(eng.models) // max(K, 1)
         end = n_iter if end_iteration <= 0 else min(end_iteration, n_iter)

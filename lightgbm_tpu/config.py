@@ -276,6 +276,12 @@ _reg("tpu_device_eval", str, "auto", ())     # auto | true | false
 _reg("tpu_stop_check_interval", int, 16, ())
 _reg("tpu_predict_device", bool, False, ())  # batched device prediction
                                              # (predict(..., device=True))
+# serving batch-size bucketing (ops/forest.py bucket_rows): pad request
+# batches to a small family of compiled shapes (pow2 up to 4096, then
+# 1/8-octave steps, <= ~12% padding) so a serving loop with varying row
+# counts reuses XLA programs instead of retracing per distinct size.
+# false = compile at exact request shapes.
+_reg("tpu_predict_buckets", bool, True, ())
 # device tracing (SURVEY §5 tracing: jax.profiler traces + the named-
 # section wall-clock table ≡ the reference's USE_TIMETAG global_timer).
 # Set to a directory to capture a jax.profiler trace of the training loop

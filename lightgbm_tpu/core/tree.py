@@ -25,6 +25,35 @@ def _f32_round(arr32: np.ndarray) -> np.ndarray:
     return arr32.astype(np.float64)
 
 
+def max_leaf_depth(left_child, right_child, num_leaves) -> int:
+    """Max root->leaf path length in DECISIONS — the number of lockstep
+    traversal steps needed for every row to absorb into a leaf (a leaf at
+    depth d absorbs at step d). 0 for a single-leaf tree. Malformed child
+    pointers (cyclic / out of range, e.g. a corrupted model file) fall
+    back to the exhaustive ``num_leaves - 1`` bound instead of looping."""
+    n = int(num_leaves) - 1
+    if n <= 0:
+        return 0
+    lc = np.asarray(left_child[:n], np.int64)
+    rc = np.asarray(right_child[:n], np.int64)
+    best = 1
+    stack = [(0, 1)]
+    budget = 4 * n + 8
+    while stack:
+        budget -= 1
+        if budget <= 0:
+            return n
+        node, d = stack.pop()
+        if d > best:
+            best = d
+        if d >= n:        # deeper than any well-formed tree: cycle
+            return n
+        for c in (int(lc[node]), int(rc[node])):
+            if 0 <= c < n:
+                stack.append((c, d + 1))
+    return best
+
+
 class TreeArrays(NamedTuple):
     """One tree. Internal-node arrays have length L-1, leaf arrays L."""
     # internal nodes
@@ -49,6 +78,11 @@ class TreeArrays(NamedTuple):
     # stored here as a fixed-width padded set of category BINS per node)
     cat_count: jnp.ndarray = None  # i32 [L-1]; 0 = numerical node
     cat_bins: jnp.ndarray = None   # i32 [L-1, max_cat_threshold], -1 pad
+    # max leaf depth recorded at pack time (host_tree_to_arrays); bounds
+    # the traversal fori_loop at the tree's REAL depth instead of L-1
+    # (ops/predict.py). None for grower-built device trees (the grower
+    # never traverses its own output; depth is computed on the host copy)
+    max_depth: jnp.ndarray = None  # i32 scalar
 
     @staticmethod
     def empty(max_leaves: int, max_cat: int = 0) -> "TreeArrays":
@@ -108,6 +142,8 @@ class HostTree:
         self.leaf_count = a["leaf_count"][:L].astype(np.int64)
         self.leaf_parent = a["leaf_parent"][:L]
         self.shrinkage = float(a["shrinkage"])
+        self.max_depth = max_leaf_depth(self.left_child, self.right_child,
+                                        self.num_leaves)
         # per-node category-BIN sets from the grower (inner representation,
         # ref: cat_threshold_inner_); -1 padded, empty for numerical nodes
         if "cat_bins" in a and n_int:
@@ -151,6 +187,7 @@ class HostTree:
         self.leaf_count = np.zeros(1, np.int64)
         self.leaf_parent = np.full(1, -1, np.int32)
         self.shrinkage = 1.0
+        self.max_depth = 0
         self.threshold_real = np.zeros(0, np.float64)
         self.decision_type = np.zeros(0, np.int32)
         self.is_linear = False
@@ -301,3 +338,59 @@ class HostTree:
         if self.is_linear:
             return self.linear_output(X, leaf)
         return self.leaf_value[leaf]
+
+
+def host_tree_to_arrays(t: HostTree, max_leaves: int) -> TreeArrays:
+    """Rebuild device TreeArrays from a host tree (DART drop/restore,
+    valid-set traversal of reloaded models, and packed-forest serving).
+    Records the tree's max leaf depth so traversals can run depth-bounded
+    instead of the exhaustive ``max_leaves - 1`` lockstep walk."""
+    li = max_leaves - 1
+    L = max_leaves
+
+    def pad_i(a, n):
+        out = np.zeros(n, np.int32)
+        out[:len(a)] = a
+        return jnp.asarray(out)
+
+    def pad_f(a, n):
+        out = np.zeros(n, np.float32)
+        out[:len(a)] = a
+        return jnp.asarray(out)
+
+    def pad_b(a, n):
+        out = np.zeros(n, bool)
+        out[:len(a)] = a
+        return jnp.asarray(out)
+
+    cat_count = cat_bins = None
+    cci = getattr(t, "cat_count_inner", None)
+    if cci is not None and len(cci) and cci.any():
+        width = max(t.cat_bins_inner.shape[1], 1)
+        cb = np.full((li, width), -1, np.int32)
+        cb[:t.cat_bins_inner.shape[0]] = t.cat_bins_inner
+        cat_bins = jnp.asarray(cb)
+        cat_count = pad_i(cci, li)
+    depth = getattr(t, "max_depth", None)
+    if depth is None:
+        depth = max_leaf_depth(t.left_child, t.right_child, t.num_leaves)
+    return TreeArrays(
+        split_feature=pad_i(t.split_feature_inner, li),
+        threshold_bin=pad_i(t.threshold_bin, li),
+        default_left=pad_b(t.default_left, li),
+        left_child=pad_i(t.left_child, li),
+        right_child=pad_i(t.right_child, li),
+        split_gain=pad_f(t.split_gain, li),
+        internal_value=pad_f(t.internal_value, li),
+        internal_weight=pad_f(t.internal_weight, li),
+        internal_count=pad_f(t.internal_count, li),
+        leaf_value=pad_f(t.leaf_value, L),
+        leaf_weight=pad_f(t.leaf_weight, L),
+        leaf_count=pad_f(t.leaf_count, L),
+        leaf_parent=pad_i(t.leaf_parent, L),
+        num_leaves=jnp.asarray(t.num_leaves, jnp.int32),
+        shrinkage=jnp.asarray(t.shrinkage, jnp.float32),
+        cat_count=cat_count,
+        cat_bins=cat_bins,
+        max_depth=jnp.asarray(min(int(depth), li), jnp.int32),
+    )

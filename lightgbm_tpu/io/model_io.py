@@ -230,6 +230,8 @@ def _tree_from_block(block: Dict[str, str]) -> HostTree:
         nthr = t.cat_boundaries[-1] if len(t.cat_boundaries) else 0
         t.cat_threshold = ints("cat_threshold", int(nthr)).astype(np.uint32)
     t.from_text = True  # threshold_bin/inner indices need rebinding
+    from ..core.tree import max_leaf_depth
+    t.max_depth = max_leaf_depth(t.left_child, t.right_child, t.num_leaves)
     return t
 
 
@@ -252,9 +254,42 @@ class _LoadedEngine:
         self.train_metrics: List = []
         self.valid_sets: List = []
         self.iter = 0
+        # packed-forest serving over RAW thresholds (ISSUE 5): a loaded
+        # model has no bin mappers, so predict_device routes through
+        # ops/predict.py tree_leaf_raw with per-node missing handling
+        self._model_gen = 0
+        self._serving = None
 
     def current_iteration(self) -> int:
         return len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    def invalidate_serving_cache(self) -> None:
+        """In-place tree edits (set_leaf_output) force a forest repack."""
+        self._model_gen += 1
+
+    def predict_device(self, X, start_iteration: int,
+                       end_iteration: int):
+        """Batched device prediction over raw thresholds (the binned
+        route needs in-session training mappers). Raises ValueError for
+        shapes the raw route cannot serve — empty windows, linear trees,
+        categorical bitsets — and the Booster falls back to the host
+        walk."""
+        from ..ops.forest import RawForestPack, ServingEngine
+        K = max(self.num_tree_per_iteration, 1)
+        lo, hi = start_iteration * K, end_iteration * K
+        if not self.models[lo:hi]:
+            raise ValueError("device prediction needs a non-empty tree "
+                             "range")
+        RawForestPack.check_servable(self.models[lo:hi])
+        bucket = bool(self.config.tpu_predict_buckets)
+        if self._serving is None or self._serving.bucket != bucket:
+            # per-call re-check like GBDT.predict_device: reset_parameter
+            # can flip tpu_predict_buckets after the engine was built
+            cap = max([t.num_leaves for t in self.models] + [2])
+            self._serving = ServingEngine(cap, K, bucket=bucket)
+        out = self._serving.predict_raw(self.models, self._model_gen,
+                                        X, lo, hi)
+        return out.T  # [R, K]
 
     def eval_train(self):
         return []

@@ -75,6 +75,10 @@ class DART(GBDT):
                 ti = i * K + k
                 self.models[ti].shrink(-1.0)
                 self._add_tree_score(ti, k, 1.0)
+        if self.drop_index:
+            # shrink() edits leaf values in place — serving caches can't
+            # see it through the models list
+            self.invalidate_serving_cache()
         n_drop = len(self.drop_index)
         if not cfg.xgboost_dart_mode:
             self.shrinkage_rate = cfg.learning_rate / (1.0 + n_drop)
@@ -113,6 +117,8 @@ class DART(GBDT):
                         k_drop + cfg.learning_rate)
                     self.tree_weight[wi] *= k_drop / (
                         k_drop + cfg.learning_rate)
+        if self.drop_index:
+            self.invalidate_serving_cache()
 
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
         self._dropping_trees()
@@ -133,4 +139,6 @@ class DART(GBDT):
                 ti = i * K + k
                 self.models[ti].shrink(-1.0)
                 self._add_tree_score(ti, k, 1.0)
+        if self.drop_index:
+            self.invalidate_serving_cache()
         self.drop_index = []

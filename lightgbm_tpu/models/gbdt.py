@@ -49,10 +49,11 @@ from ..robustness import heartbeat
 from ..core.grower import GrowerConfig, make_tree_grower
 from ..core.metrics import Metric, metrics_for_config
 from ..core.objective import ObjectiveFunction, CustomObjective, K_EPSILON
-from ..core.tree import HostTree, TreeArrays
+from ..core.tree import HostTree, TreeArrays, host_tree_to_arrays
 from ..io.dataset_core import BinnedDataset
 from ..ops.split import FeatureMeta, SplitHyperParams
-from ..ops.predict import tree_leaf_bins, tree_output_bins
+from ..ops.forest import ServingEngine
+from ..ops.predict import depth_steps, tree_leaf_bins
 from ..utils import log
 from ..utils.timer import global_timer
 from .sample_strategy import SampleStrategy
@@ -69,54 +70,75 @@ class _PendingTree(NamedTuple):
     col_rng_state: Optional[dict]  # column-sampler RNG before this tree
 
 
-def _host_tree_to_arrays(t: HostTree, max_leaves: int) -> TreeArrays:
-    """Rebuild device TreeArrays from a host tree (for DART drop/restore &
-    valid-set traversal of reloaded models)."""
-    li = max_leaves - 1
-    L = max_leaves
+# canonical packer now lives next to the tree types (core/tree.py) so the
+# serving engine (ops/forest.py) can share it without a models-layer import;
+# it additionally records HostTree.max_depth for depth-bounded traversal
+_host_tree_to_arrays = host_tree_to_arrays
 
-    def pad_i(a, n):
-        out = np.zeros(n, np.int32)
-        out[:len(a)] = a
-        return jnp.asarray(out)
 
-    def pad_f(a, n):
-        out = np.zeros(n, np.float32)
-        out[:len(a)] = a
-        return jnp.asarray(out)
+class _ModelList(list):
+    """Model container that notifies the owning engine on every structural
+    mutation. Appends at the tail keep the serving forest incrementally
+    packable; everything else (rollback's ``del``, shuffles, item
+    replacement) is DESTRUCTIVE and bumps the model generation so serving
+    caches can never replay a stale stacked forest — the ISSUE 5 bug was a
+    rollback + retrain back to the SAME model count slipping past a cache
+    keyed only on ``len(models)``."""
 
-    def pad_b(a, n):
-        out = np.zeros(n, bool)
-        out[:len(a)] = a
-        return jnp.asarray(out)
+    __slots__ = ("_note",)
 
-    cat_count = cat_bins = None
-    cci = getattr(t, "cat_count_inner", None)
-    if cci is not None and len(cci) and cci.any():
-        width = max(t.cat_bins_inner.shape[1], 1)
-        cb = np.full((li, width), -1, np.int32)
-        cb[:t.cat_bins_inner.shape[0]] = t.cat_bins_inner
-        cat_bins = jnp.asarray(cb)
-        cat_count = pad_i(cci, li)
-    return TreeArrays(
-        split_feature=pad_i(t.split_feature_inner, li),
-        threshold_bin=pad_i(t.threshold_bin, li),
-        default_left=pad_b(t.default_left, li),
-        left_child=pad_i(t.left_child, li),
-        right_child=pad_i(t.right_child, li),
-        split_gain=pad_f(t.split_gain, li),
-        internal_value=pad_f(t.internal_value, li),
-        internal_weight=pad_f(t.internal_weight, li),
-        internal_count=pad_f(t.internal_count, li),
-        leaf_value=pad_f(t.leaf_value, L),
-        leaf_weight=pad_f(t.leaf_weight, L),
-        leaf_count=pad_f(t.leaf_count, L),
-        leaf_parent=pad_i(t.leaf_parent, L),
-        num_leaves=jnp.asarray(t.num_leaves, jnp.int32),
-        shrinkage=jnp.asarray(t.shrinkage, jnp.float32),
-        cat_count=cat_count,
-        cat_bins=cat_bins,
-    )
+    def __init__(self, iterable=(), note=None):
+        super().__init__(iterable)
+        self._note = note if note is not None else lambda destructive: None
+
+    def append(self, v):
+        super().append(v)
+        self._note(False)
+
+    def extend(self, it):
+        super().extend(it)
+        self._note(False)
+
+    def __iadd__(self, it):
+        super().extend(it)
+        self._note(False)
+        return self
+
+    def insert(self, i, v):
+        super().insert(i, v)
+        self._note(True)
+
+    def pop(self, i=-1):
+        v = super().pop(i)
+        self._note(True)
+        return v
+
+    def remove(self, v):
+        super().remove(v)
+        self._note(True)
+
+    def clear(self):
+        super().clear()
+        self._note(True)
+
+    def reverse(self):
+        super().reverse()
+        self._note(True)
+
+    def sort(self, **kw):
+        super().sort(**kw)
+        self._note(True)
+
+    def __setitem__(self, i, v):
+        super().__setitem__(i, v)
+        self._note(True)
+
+    def __delitem__(self, i):
+        super().__delitem__(i)
+        self._note(True)
+
+    def __imul__(self, n):
+        raise TypeError("model list repetition is not supported")
 
 
 def _orig_to_used(used_feature_map) -> dict:
@@ -203,12 +225,19 @@ class GBDT:
         self._async_mode: Optional[bool] = None   # resolved lazily
         self._async_disabled = False  # set on stop-rollback / fallbacks
         self._async_delta_fn = None
-        self._async_trav_fn = None
+        self._async_trav_fn: Dict[int, object] = {}
         # phase-tagged liveness (ISSUE 4): beats + the process-global
         # stall watchdog; all no-ops unless a heartbeat file is
         # configured (tpu_heartbeat_file / LGBM_TPU_HEARTBEAT)
         self._hb_warm = False         # first iteration (compile) done
         self._hb_policy = None
+        # serving state (ISSUE 5): the generation counter advances on every
+        # DESTRUCTIVE model mutation (rollback, shuffle, item replacement,
+        # in-place tree edits via invalidate_serving_cache); tail appends
+        # leave it alone so the packed forest can grow incrementally
+        self._model_gen = 0
+        self._serving: Optional[ServingEngine] = None
+        self._serving_mappers = None  # stable identity for binner caching
         self.models: List[HostTree] = []
         self.iter = 0
         self.num_init_iteration = 0
@@ -245,7 +274,19 @@ class GBDT:
     @models.setter
     def models(self, value: List[HostTree]) -> None:
         self._flush_pending()   # never silently drop device-side trees
-        self._models = value
+        self._note_models_mutation(True)
+        self._models = _ModelList(value, note=self._note_models_mutation)
+
+    def _note_models_mutation(self, destructive: bool) -> None:
+        if destructive:
+            self._model_gen += 1
+
+    def invalidate_serving_cache(self) -> None:
+        """Declare tree CONTENT mutated in place (set_leaf_output, refit
+        decay, DART drop/normalize) — mutations the models-list generation
+        counter cannot observe. Forces a full forest repack on the next
+        device prediction."""
+        self._model_gen += 1
 
     def _n_models_total(self) -> int:
         """Model count including not-yet-materialized device trees."""
@@ -389,26 +430,33 @@ class GBDT:
         return finished
 
     def _async_traverse_add(self, score, tree_dev: TreeArrays, bins_dev,
-                            rate: float, k: int):
+                            rate: float, k: int, num_steps: int = None):
         """score[k] += rate * tree(bins) with degenerate trees masked —
         the one jitted traversal shared by valid-set updates (+rate) and
         rollback (-rate); jax.jit caches per bins/score shape. The
         traversal product rounds in its own dispatch, separate from the
-        accumulate, for the FMA reason documented on _leaf_delta."""
-        if self._async_trav_fn is None:
+        accumulate, for the FMA reason documented on _leaf_delta.
+        ``num_steps`` (static, bucketed via depth_steps) bounds the
+        lockstep walk when the caller knows the tree's depth; rollback of
+        grower-resident device trees passes None (exhaustive bound — depth
+        is only computed on the host copy, and a rollback must not sync)."""
+        steps = (self.config.num_leaves - 1 if num_steps is None
+                 else int(num_steps))
+        fn = self._async_trav_fn.get(steps)
+        if fn is None:
             meta = self.feature_meta
 
             @jax.jit
             def fn(tree, bins, rate):
                 leaf = tree_leaf_bins(tree, bins, meta.num_bin,
-                                      meta.missing_type, meta.default_bin)
+                                      meta.missing_type, meta.default_bin,
+                                      num_steps=steps)
                 return jnp.where(tree.num_leaves > 1,
                                  tree.leaf_value[leaf] * rate,
                                  jnp.float32(0.0))
 
-            self._async_trav_fn = fn
-        delta = self._async_trav_fn(tree_dev, bins_dev,
-                                    jnp.float32(rate))
+            self._async_trav_fn[steps] = fn
+        delta = fn(tree_dev, bins_dev, jnp.float32(rate))
         return score.at[k].add(delta)
 
     def _async_rollback_from(self, it0: int) -> None:
@@ -1633,79 +1681,51 @@ class GBDT:
     # ------------------------------------------------------------------
     def predict_device(self, X: np.ndarray, start_iteration: int,
                        end_iteration: int) -> np.ndarray:
-        """Batched TPU prediction: bin the raw input with the TRAINING
-        BinMappers and traverse all trees in one jitted program
-        (≡ the CUDA predictor's batched AddPredictionToScore,
-        cuda_tree.cu; the reference CPU predictor walks rows under OMP).
+        """Batched TPU prediction through the packed-forest serving engine
+        (ops/forest.py; ≡ the CUDA predictor's batched
+        AddPredictionToScore, cuda_tree.cu — the reference CPU predictor
+        walks rows under OMP).
 
-        Split decisions are exact by construction: threshold_real is
-        the left bin's upper bound, so `x <= threshold_real` and
-        `bin(x) <= threshold_bin` decide identically for every x; only
-        the leaf-value accumulation differs (f32 on device vs f64 on
-        host). Requires the in-session training mappers; linear trees
-        fall back to the host path.
+        With in-session training mappers the request is binned ON DEVICE
+        (vmapped searchsorted over the uploaded BinMapper bounds) and
+        traversal runs on integer bin thresholds — split decisions are
+        exact by construction: threshold_real is the left bin's upper
+        bound, so `x <= threshold_real` and `bin(x) <= threshold_bin`
+        decide identically. Without mappers (model loaded from file) the
+        raw-threshold route serves instead (per-node missing handling
+        from decision_type); categorical raw bitsets stay on the host
+        path. Only the leaf-value accumulation differs from the host walk
+        (f32 on device vs f64). The packed forest grows incrementally
+        with training and is keyed on the model generation; batch sizes
+        are bucketed into a small family of compiled shapes
+        (tpu_predict_buckets).
         """
         K = self.num_tree_per_iteration
-        models = self.models[start_iteration * K:end_iteration * K]
-        if (not models or self.train_set is None or
-                not self.train_set.bin_mappers or
-                any(t.is_linear for t in models)):
+        models = self.models          # property: flushes pending trees
+        lo, hi = start_iteration * K, end_iteration * K
+        window = models[lo:hi]
+        if not window:
             raise ValueError("device prediction needs a non-empty tree "
-                             "range, in-session bin mappers and "
-                             "non-linear trees")
-        used = self.train_set.used_feature_map
-        mappers = self.train_set.used_bin_mappers()
-        R = X.shape[0]
-        bins = np.empty((len(used), R), np.int32)
-        for i, (fi, m) in enumerate(zip(used, mappers)):
-            bins[i] = m.value_to_bin(np.asarray(X[:, fi], np.float64))
-        bins_dev = jnp.asarray(bins)
-
-        # stacked trees + jitted runner are cached per model window so
-        # serving loops with stable shapes hit the XLA cache instead of
-        # re-tracing every call
-        cache_key = (start_iteration, end_iteration, len(self.models))
-        cached = getattr(self, "_dev_pred_cache", None)
-        if cached is not None and cached[0] == cache_key:
-            stacked, run = cached[1], cached[2]
+                             "range")
+        if any(t.is_linear for t in window):
+            raise ValueError("device prediction does not cover linear "
+                             "trees")
+        bucket = bool(self.config.tpu_predict_buckets)
+        srv = self._serving
+        if srv is None or srv.bucket != bucket:
+            srv = self._serving = ServingEngine(
+                self.config.num_leaves, K, bucket=bucket)
+        if self.train_set is not None and self.train_set.bin_mappers:
+            if self._serving_mappers is None:
+                # fresh list per used_bin_mappers() call — pin one so the
+                # binner/pack identity caches hold across requests
+                self._serving_mappers = self.train_set.used_bin_mappers()
+            out = srv.predict_binned(
+                models, self._model_gen, X, lo, hi,
+                self._serving_mappers, self.train_set.used_feature_map)
         else:
-            arrs = [_host_tree_to_arrays(t, self.config.num_leaves)
-                    for t in models]
-            # normalize categorical fields so heterogeneous trees stack
-            widths = [a.cat_bins.shape[1] for a in arrs
-                      if a.cat_bins is not None]
-            if widths:
-                W = max(widths)
-                li = self.config.num_leaves - 1
-
-                def with_cat(a):
-                    if a.cat_bins is None:
-                        return a._replace(
-                            cat_count=jnp.zeros(li, jnp.int32),
-                            cat_bins=jnp.full((li, W), -1, jnp.int32))
-                    if a.cat_bins.shape[1] < W:
-                        pad = jnp.full((li, W - a.cat_bins.shape[1]), -1,
-                                       jnp.int32)
-                        return a._replace(
-                            cat_bins=jnp.concatenate([a.cat_bins, pad], 1))
-                    return a
-
-                arrs = [with_cat(a) for a in arrs]
-            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *arrs)
-            meta = self.feature_meta
-
-            @jax.jit
-            def run(st, bd):
-                outs = jax.vmap(
-                    lambda tr: tree_output_bins(tr, bd, meta.num_bin,
-                                                meta.missing_type,
-                                                meta.default_bin))(st)
-                T = outs.shape[0]
-                return outs.reshape(T // K, K, -1).sum(axis=0)
-
-            self._dev_pred_cache = (cache_key, stacked, run)
-
-        return np.asarray(run(stacked, bins_dev), np.float64).T  # [R, K]
+            out = srv.predict_raw(models, self._model_gen, X, lo, hi)
+        return out.T  # [R, K]
 
     # ------------------------------------------------------------------
     def _hb_iter_begin(self):
@@ -1966,7 +1986,9 @@ class GBDT:
                             vd.score,
                             _host_tree_to_arrays(
                                 host, self.config.num_leaves),
-                            vd.bins_dev, self.shrinkage_rate, k)
+                            vd.bins_dev, self.shrinkage_rate, k,
+                            num_steps=depth_steps(
+                                host.max_depth, self.config.num_leaves))
             if not host.is_linear:
                 host.shrink(self.shrinkage_rate)
             if abs(init_scores[k]) > K_EPSILON:

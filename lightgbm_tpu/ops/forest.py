@@ -1,0 +1,478 @@
+"""Packed-forest serving engine (ISSUE 5).
+
+High-throughput batched prediction around ops/predict.py: the model list is
+packed ONCE into a stacked structure-of-arrays forest and kept in sync
+incrementally (newly trained trees are appended, never the O(T) restack the
+old per-call path paid on every window change), request batches are binned ON
+DEVICE with the training BinMapper bounds (one vmapped searchsorted instead
+of a per-feature host Python loop), and traversal runs depth-bounded
+(ops/predict.py). Batch sizes are bucketed into a small family of padded
+compiled shapes so a serving loop with varying row counts hits the XLA
+program cache instead of retracing.
+
+Mirrors the reference's batched CUDA predictor
+(src/treelearner/cuda/cuda_tree.cu AddPredictionToScore) where the forest
+lives device-resident between requests; the reference CPU predictor re-walks
+pointer trees per row under OMP (src/application/predictor.hpp).
+
+Exactness contract: device compares run in f32 against ``f32_floor`` of the
+f64 training bounds/thresholds, which decides identically to the host f64
+mapper/walk for every f32-representable request value (incl. NaN/±inf).
+Requests carrying f64-only values are never silently misrouted: the binned
+route re-bins those COLUMNS with the host mapper, the raw route refuses
+(ValueError -> host fallback). Details in docs/TPU_RUNBOOK.md "Serving".
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .predict import (RawTreeArrays, depth_steps, forest_leaf_bins,
+                      tree_leaf_raw)
+from .split import MISSING_ENUM
+from ..core.tree import HostTree, TreeArrays, host_tree_to_arrays, \
+    max_leaf_depth
+
+ROW_BUCKET_MIN = 256
+
+
+def bucket_rows(r: int) -> int:
+    """Padded row count for a request batch: next power of two up to 4096,
+    then 1/8-octave steps (<= ~12% padding) — a handful of compiled shapes
+    per decade of batch size, so mixed-size serving loops reuse programs."""
+    if r <= ROW_BUCKET_MIN:
+        return ROW_BUCKET_MIN
+    p = 1 << int(r - 1).bit_length()          # next pow2 >= r
+    if p <= 4096:
+        return p
+    step = (p >> 1) // 8                      # 1/8 of the floor octave
+    return -(-r // step) * step
+
+
+def f32_floor(vals: np.ndarray) -> np.ndarray:
+    """Largest float32 <= each float64 value. For f32 ``x``:
+    ``x <= f32_floor(v)  <=>  x <= v`` — the compare that makes on-device
+    f32 split/bin decisions exact against the host f64 thresholds.
+    NaN passes through (compares false either way); values beyond f32
+    range clamp to the largest finite f32 / -inf with the same property."""
+    v = np.asarray(vals, np.float64)
+    out = v.astype(np.float32)
+    with np.errstate(over="ignore"):
+        over = out.astype(np.float64) > v     # round-to-nearest went up
+    if over.any():
+        out = out.copy()
+        out[over] = np.nextafter(out[over], np.float32(-np.inf))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# device binning: the training BinMapper bounds live on device as one
+# [F, B] tensor; a request batch is binned by a single vmapped searchsorted
+# (ref: bin.h:613 ValueToBin — first bin whose upper bound >= value)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _bin_columns(bounds, num_bin, nan_miss, x_t):
+    """[F, R] f32 feature-major raw values -> [F, R] i32 bins."""
+    isnan = jnp.isnan(x_t)
+    x0 = jnp.where(isnan, jnp.float32(0.0), x_t)
+    b = jax.vmap(lambda bb, xx: jnp.searchsorted(bb, xx, side="left"))(
+        bounds, x0).astype(jnp.int32)
+    return jnp.where(nan_miss[:, None] & isnan, num_bin[:, None] - 1, b)
+
+
+class DeviceBinner:
+    """Bins raw request columns on device with the TRAINING BinMappers.
+
+    Numerical features: one vmapped ``searchsorted`` over the uploaded
+    ``f32_floor`` bound tensor (bit-exact vs the host mapper for f32
+    inputs, incl. nan/zero missing-bin routing — NaN maps to the reserved
+    last bin of nan-missing features and to the 0.0 bin otherwise, exactly
+    like bin.h ValueToBin). Categorical features keep the host dict lookup
+    (tiny, and raw category ids need the exact int mapping)."""
+
+    def __init__(self, mappers, used_feature_map):
+        F = len(mappers)
+        self.mappers = mappers
+        self.used = np.asarray(used_feature_map, np.int64)
+        nb = np.asarray([m.num_bin for m in mappers], np.int64)
+        nan_miss = np.asarray(
+            [m.missing_type == "nan" for m in mappers], bool)
+        self.cat_idx = [i for i, m in enumerate(mappers)
+                        if m.bin_type == "categorical"]
+        # bounds actually compared: bin_upper_bound[:n_numeric-1] (the last
+        # numeric bound is +inf / the NaN sentinel and never decides)
+        n_bounds = np.maximum(nb - nan_miss - 1, 0)
+        B = max(int(n_bounds.max()) if F else 0, 1)
+        bounds = np.full((F, B), np.inf, np.float32)
+        for i, m in enumerate(mappers):
+            if m.bin_type == "categorical":
+                continue                      # all-inf row -> bin 0 (unused)
+            k = int(n_bounds[i])
+            if k:
+                bounds[i, :k] = f32_floor(m.bin_upper_bound[:k])
+        self.bounds_dev = jnp.asarray(bounds)
+        self.num_bin_dev = jnp.asarray(nb, jnp.int32)
+        self.nan_miss_dev = jnp.asarray(nan_miss)
+
+    def bins(self, X: np.ndarray, rows: int = None) -> jnp.ndarray:
+        """[R, C] raw request matrix -> [F, rows] i32 device bins.
+
+        ``rows`` >= R pads the batch (with 0.0, a benign always-binnable
+        value) BEFORE the device binning so the jitted searchsorted only
+        ever sees bucketed shapes — binning at the exact request size
+        would retrace per distinct R and defeat the bucketing.
+
+        Exactness: a column whose values are all f32-representable (the
+        serving norm — f32 feature stores; also NaN/±inf) bins on device,
+        provably identical to the host f64 mapper (f32_floor bounds). A
+        column carrying f64-only values COULD straddle a bound under f32
+        rounding (observed: a request one f64-ulp above a bound rounding
+        below it), so it falls back to the host mapper for that column —
+        device prediction never silently disagrees with the host walk."""
+        r = X.shape[0]
+        rows = r if rows is None else rows
+        cols = X[:, self.used].T                  # [F, R] f64 view
+        x_t = np.zeros((len(self.used), rows), np.float32)
+        x_t[:, :r] = cols
+        with np.errstate(invalid="ignore"):
+            f32_ok = (x_t[:, :r].astype(np.float64) == cols) | np.isnan(cols)
+        host_cols = sorted(set(np.nonzero(~f32_ok.all(axis=1))[0].tolist())
+                           | set(self.cat_idx))
+        out = _bin_columns(self.bounds_dev, self.num_bin_dev,
+                           self.nan_miss_dev, jnp.asarray(x_t))
+        if host_cols:
+            hb = np.zeros((len(host_cols), rows), np.int32)
+            for j, i in enumerate(host_cols):
+                hb[j, :r] = self.mappers[i].value_to_bin(
+                    np.asarray(cols[i], np.float64))
+            out = out.at[jnp.asarray(host_cols)].set(jnp.asarray(hb))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# incremental forest packing
+# ---------------------------------------------------------------------------
+
+def _with_cat_width(a: TreeArrays, width: int, max_leaves: int) -> TreeArrays:
+    """Normalize one tree's categorical fields to a common stacked width."""
+    if width == 0:
+        return a
+    li = max_leaves - 1
+    if a.cat_bins is None:
+        return a._replace(cat_count=jnp.zeros(li, jnp.int32),
+                          cat_bins=jnp.full((li, width), -1, jnp.int32))
+    if a.cat_bins.shape[1] < width:
+        pad = jnp.full((li, width - a.cat_bins.shape[1]), -1, jnp.int32)
+        return a._replace(cat_bins=jnp.concatenate([a.cat_bins, pad], 1))
+    return a
+
+
+def _widen_stacked_cat(stacked: TreeArrays, width: int,
+                       max_leaves: int) -> TreeArrays:
+    """Same normalization for an already-stacked [T, ...] forest."""
+    if width == 0:
+        return stacked
+    li = max_leaves - 1
+    T = stacked.leaf_value.shape[0]
+    if stacked.cat_bins is None:
+        return stacked._replace(
+            cat_count=jnp.zeros((T, li), jnp.int32),
+            cat_bins=jnp.full((T, li, width), -1, jnp.int32))
+    have = stacked.cat_bins.shape[2]
+    if have < width:
+        pad = jnp.full((T, li, width - have), -1, jnp.int32)
+        return stacked._replace(
+            cat_bins=jnp.concatenate([stacked.cat_bins, pad], 2))
+    return stacked
+
+
+class PackedTree(NamedTuple):
+    """One binned-serving tree: device arrays + the per-node missing
+    routing folded into (special, flip) — see predict.forest_leaf_bins."""
+    tree: TreeArrays
+    special: jnp.ndarray  # i32 [L-1]; the one bin routed by flip, -1 none
+    flip: jnp.ndarray     # bool [L-1]
+
+
+def _host_depth(t: HostTree, max_leaves: int) -> int:
+    """Tree depth as a HOST int (no device sync; HostTree carries it)."""
+    d = getattr(t, "max_depth", None)
+    if d is None:
+        d = max_leaf_depth(t.left_child, t.right_child, t.num_leaves)
+    return min(int(d), max_leaves - 1)
+
+
+class _IncrementalPack:
+    """Shared skeleton of both packs: generation/count bookkeeping, the
+    reset-on-gen-bump rule, tail append and the cached window slice —
+    the invalidation semantics live in ONE place so the binned and raw
+    routes cannot drift apart (a stale forest on either route is the
+    exact bug class the generation counter exists to kill)."""
+
+    def __init__(self, max_leaves: int):
+        self.max_leaves = max_leaves
+        self.gen = None
+        self.count = 0
+        self.stacked = None
+        self.depths: List[int] = []
+        self._win = None          # ((gen, lo, hi), window, steps)
+
+    def _reset(self, gen) -> None:
+        self.gen = gen
+        self.count = 0
+        self.stacked = None
+        self.depths = []
+        self._win = None
+
+    def _append(self, models: List[HostTree], tail_stacked,
+                tail: List[HostTree]) -> None:
+        if self.stacked is None:
+            self.stacked = tail_stacked
+        else:
+            self.stacked = jax.tree.map(
+                lambda a, b: jnp.concatenate([a, b]),
+                self.stacked, tail_stacked)
+        self.depths.extend(_host_depth(t, self.max_leaves) for t in tail)
+        self.count = len(models)
+        self._win = None
+
+    def window(self, lo: int, hi: int):
+        """Sliced [hi-lo, ...] forest + static traversal step bound."""
+        key = (self.gen, lo, hi)
+        if self._win is not None and self._win[0] == key:
+            return self._win[1], self._win[2]
+        win = jax.tree.map(lambda x: x[lo:hi], self.stacked)
+        steps = depth_steps(max(self.depths[lo:hi]), self.max_leaves)
+        self._win = (key, win, steps)
+        return win, steps
+
+
+class ForestPack(_IncrementalPack):
+    """Stacked SoA over the model list for BINNED traversal, kept in sync
+    incrementally: same generation + more trees appends only the tail;
+    a generation bump (destructive model mutation) triggers a full repack.
+    Serving windows are leading-axis slices of the one packed forest."""
+
+    def __init__(self, max_leaves: int):
+        super().__init__(max_leaves)
+        self.cat_width = 0
+        self._mapper_src = None   # per-feature host arrays for special/flip
+        self._feat_nbin = None
+        self._feat_miss = None
+        self._feat_dflt = None
+
+    def _set_mappers(self, mappers) -> None:
+        if mappers is self._mapper_src:
+            return
+        self._mapper_src = mappers
+        self._feat_nbin = np.asarray([m.num_bin for m in mappers], np.int64)
+        self._feat_miss = np.asarray(
+            [MISSING_ENUM[m.missing_type] for m in mappers], np.int64)
+        self._feat_dflt = np.asarray(
+            [m.default_bin for m in mappers], np.int64)
+
+    def _pack_tree(self, t: HostTree) -> PackedTree:
+        arrs = host_tree_to_arrays(t, self.max_leaves)
+        li = self.max_leaves - 1
+        ni = max(int(t.num_leaves) - 1, 0)
+        special = np.full(li, -1, np.int32)
+        flip = np.zeros(li, bool)
+        if ni:
+            f = np.asarray(t.split_feature_inner[:ni], np.int64)
+            miss = self._feat_miss[f]
+            sp = np.where(
+                miss == MISSING_ENUM["nan"], self._feat_nbin[f] - 1,
+                np.where(miss == MISSING_ENUM["zero"],
+                         self._feat_dflt[f], -1))
+            cci = getattr(t, "cat_count_inner", None)
+            if cci is not None and len(cci):
+                sp = np.where(np.asarray(cci[:ni]) > 0, -1, sp)
+            thr = np.asarray(t.threshold_bin[:ni], np.int64)
+            dl = np.asarray(t.default_left[:ni], bool)
+            special[:ni] = sp
+            flip[:ni] = (sp >= 0) & (dl != (sp <= thr))
+        return PackedTree(tree=arrs, special=jnp.asarray(special),
+                          flip=jnp.asarray(flip))
+
+    def sync(self, models: List[HostTree], gen, mappers) -> None:
+        self._set_mappers(mappers)
+        if gen != self.gen or self.count > len(models):
+            self._reset(gen)
+            self.cat_width = 0
+        if self.count == len(models):
+            return
+        tail = models[self.count:]
+        packed = [self._pack_tree(t) for t in tail]
+        width = max([self.cat_width] + [p.tree.cat_bins.shape[1]
+                                        for p in packed
+                                        if p.tree.cat_bins is not None])
+        packed = [p._replace(tree=_with_cat_width(p.tree, width,
+                                                  self.max_leaves))
+                  for p in packed]
+        if self.stacked is not None and width > self.cat_width:
+            self.stacked = self.stacked._replace(tree=_widen_stacked_cat(
+                self.stacked.tree, width, self.max_leaves))
+        self.cat_width = width
+        tail_stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *packed)
+        self._append(models, tail_stacked, tail)
+
+
+def _host_tree_to_raw(t: HostTree, max_leaves: int) -> RawTreeArrays:
+    """Raw-serving view of one host tree (ORIGINAL columns, per-node
+    missing type from decision_type bits 2-3, f32_floor thresholds)."""
+    li = max_leaves - 1
+    ni = max(int(t.num_leaves) - 1, 0)
+
+    def pad(a, n, dtype, fill=0):
+        out = np.full(n, fill, dtype)
+        out[:len(a)] = a
+        return jnp.asarray(out)
+
+    thr = np.zeros(li, np.float32)
+    thr[:ni] = f32_floor(t.threshold_real[:ni])
+    miss = np.zeros(li, np.int32)
+    miss[:ni] = (np.asarray(t.decision_type[:ni], np.int32) >> 2) & 3
+    depth = getattr(t, "max_depth", None)
+    if depth is None:
+        depth = max_leaf_depth(t.left_child, t.right_child, t.num_leaves)
+    return RawTreeArrays(
+        split_feature=pad(t.split_feature[:ni], li, np.int32),
+        threshold=jnp.asarray(thr),
+        default_left=pad(t.default_left[:ni], li, bool),
+        missing_type=jnp.asarray(miss),
+        left_child=pad(t.left_child[:ni], li, np.int32),
+        right_child=pad(t.right_child[:ni], li, np.int32),
+        leaf_value=pad(t.leaf_value[:int(t.num_leaves)], max_leaves,
+                       np.float32),
+        num_leaves=jnp.asarray(t.num_leaves, jnp.int32),
+        max_depth=jnp.asarray(min(int(depth), li), jnp.int32),
+    )
+
+
+class RawForestPack(_IncrementalPack):
+    """Incrementally-packed stacked forest for RAW traversal (serving a
+    model without in-session bin mappers, e.g. loaded from file).
+
+    Packs EVERY tree tolerantly (a categorical node's threshold slot just
+    carries its cat_idx as a float — those trees are never traversed);
+    servability is a WINDOW property checked by the caller, so one
+    unservable tree outside the requested window does not defeat device
+    serving for a servable window."""
+
+    @staticmethod
+    def check_servable(models: List[HostTree]) -> None:
+        if any(t.is_linear for t in models):
+            raise ValueError("raw device prediction does not cover linear "
+                             "trees")
+        if any(getattr(t, "num_cat", 0) > 0 for t in models):
+            raise ValueError("raw device prediction does not cover "
+                             "categorical splits (bitset membership stays "
+                             "on the host path)")
+
+    def sync(self, models: List[HostTree], gen) -> None:
+        cap = max([t.num_leaves for t in models] + [2])
+        if gen != self.gen or self.count > len(models) or \
+                cap > self.max_leaves:
+            self.max_leaves = max(cap, self.max_leaves)
+            self._reset(gen)
+        if self.count == len(models):
+            return
+        tail = models[self.count:]
+        arrs = [_host_tree_to_raw(t, self.max_leaves) for t in tail]
+        tail_stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *arrs)
+        self._append(models, tail_stacked, tail)
+
+
+# ---------------------------------------------------------------------------
+# jitted runners — module level so every engine shares one program cache;
+# (num_steps, k_trees) are static, shapes key the rest
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _forest_scores_binned(num_steps, k_trees, packed, bins_t):
+    def one(p):
+        leaf = forest_leaf_bins(p.tree, p.special, p.flip, bins_t,
+                                num_steps=num_steps)
+        return p.tree.leaf_value[leaf]
+
+    outs = jax.vmap(one)(packed)
+    t = outs.shape[0]
+    return outs.reshape(t // k_trees, k_trees, -1).sum(axis=0)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _forest_scores_raw(num_steps, k_trees, stacked, x_dev):
+    def one(tr):
+        leaf = tree_leaf_raw(tr, x_dev, num_steps=num_steps)
+        return tr.leaf_value[leaf]
+
+    outs = jax.vmap(one)(stacked)
+    t = outs.shape[0]
+    return outs.reshape(t // k_trees, k_trees, -1).sum(axis=0)
+
+
+class ServingEngine:
+    """Per-model serving state: device binner + packed forests. Owned
+    lazily by the training engine (models/gbdt.py) and the loaded-model
+    facade (io/model_io.py); the model-generation counter keys cache
+    validity, the packs handle incremental growth."""
+
+    def __init__(self, max_leaves: int, k_per_iter: int,
+                 bucket: bool = True):
+        self.k = max(int(k_per_iter), 1)
+        self.bucket = bool(bucket)
+        self.pack = ForestPack(max_leaves)
+        self.raw_pack = RawForestPack(max_leaves)
+        self.binner: Optional[DeviceBinner] = None
+        self._binner_src = None
+
+    def _padded_rows(self, r: int) -> int:
+        return bucket_rows(r) if self.bucket else r
+
+    def predict_binned(self, models, gen, X: np.ndarray, lo: int, hi: int,
+                       mappers, used_feature_map) -> np.ndarray:
+        """[K, R] f32-accumulated raw scores over the binned route."""
+        self.pack.sync(models, gen, mappers)
+        if self.binner is None or self._binner_src is not mappers:
+            self.binner = DeviceBinner(mappers, used_feature_map)
+            self._binner_src = mappers
+        r = X.shape[0]
+        bins = self.binner.bins(X, rows=self._padded_rows(r))
+        win, steps = self.pack.window(lo, hi)
+        out = _forest_scores_binned(steps, self.k, win, bins)
+        # slice the padding off on the HOST: an on-device out[:, :r]
+        # would trace a new dynamic_slice program per distinct r —
+        # exactly the retrace the bucketing exists to avoid
+        return np.asarray(out, np.float64)[:, :r]
+
+    def predict_raw(self, models, gen, X: np.ndarray,
+                    lo: int, hi: int) -> np.ndarray:
+        """[K, R] f32-accumulated raw scores over the raw-threshold route.
+
+        Traversal compares f32 requests against f32_floor thresholds —
+        bit-exact vs the host f64 walk for f32-representable requests.
+        The raw route has no per-column host fallback (the traversal
+        itself needs the values on device), so f64-only request values
+        are REFUSED (ValueError -> the Booster's host fallback) rather
+        than served with possible one-ulp boundary misroutes."""
+        self.raw_pack.check_servable(models[lo:hi])
+        r = X.shape[0]
+        rb = self._padded_rows(r)
+        x = np.zeros((rb, X.shape[1]), np.float32)
+        x[:r] = X
+        with np.errstate(invalid="ignore"):
+            f32_ok = (x[:r].astype(np.float64) == X) | np.isnan(X)
+        if not f32_ok.all():
+            raise ValueError(
+                "raw device serving needs float32-representable requests "
+                f"({int((~f32_ok).sum())} value(s) are f64-only and could "
+                "cross a split threshold under f32 rounding)")
+        self.raw_pack.sync(models, gen)
+        win, steps = self.raw_pack.window(lo, hi)
+        out = _forest_scores_raw(steps, self.k, win, jnp.asarray(x))
+        return np.asarray(out, np.float64)[:, :r]  # host-side pad slice

@@ -309,8 +309,14 @@ class LGBMModel(_SKBase):
     def predict(self, X, raw_score: bool = False, start_iteration: int = 0,
                 num_iteration: Optional[int] = None, pred_leaf: bool = False,
                 pred_contrib: bool = False, validate_features: bool = False,
-                **kwargs):
-        """ref: sklearn.py LGBMModel.predict (:1073)."""
+                device: Optional[bool] = None, **kwargs):
+        """ref: sklearn.py LGBMModel.predict (:1073).
+
+        ``device=True`` routes through the packed-forest serving engine
+        (batched device traversal, ISSUE 5) — identical split decisions
+        to the host walk, f32 leaf accumulation; shapes the engine cannot
+        serve fall back to the host path with a warning. ``None`` defers
+        to the ``tpu_predict_device`` parameter."""
         if self._Booster is None:
             raise LightGBMError(
                 "Estimator not fitted, call fit before predict")
@@ -320,6 +326,8 @@ class LGBMModel(_SKBase):
                 f"Number of features of the model must match the input. "
                 f"Model n_features_ is {self._n_features} and input "
                 f"n_features is {X_arr.shape[1]}")
+        if device is not None:
+            kwargs = dict(kwargs, device=device)
         return self._Booster.predict(
             X_arr, raw_score=raw_score, start_iteration=start_iteration,
             num_iteration=num_iteration, pred_leaf=pred_leaf,
@@ -491,12 +499,12 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
     def predict(self, X, raw_score: bool = False, start_iteration: int = 0,
                 num_iteration: Optional[int] = None, pred_leaf: bool = False,
                 pred_contrib: bool = False, validate_features: bool = False,
-                **kwargs):
+                device: Optional[bool] = None, **kwargs):
         result = self.predict_proba(
             X, raw_score=raw_score, start_iteration=start_iteration,
             num_iteration=num_iteration, pred_leaf=pred_leaf,
             pred_contrib=pred_contrib, validate_features=validate_features,
-            **kwargs)
+            device=device, **kwargs)
         if callable(self._objective) or raw_score or pred_leaf or \
                 pred_contrib:
             return result
@@ -520,13 +528,14 @@ class LGBMClassifier(_SKClassifier, LGBMModel):
                       start_iteration: int = 0,
                       num_iteration: Optional[int] = None,
                       pred_leaf: bool = False, pred_contrib: bool = False,
-                      validate_features: bool = False, **kwargs):
+                      validate_features: bool = False,
+                      device: Optional[bool] = None, **kwargs):
         """ref: sklearn.py LGBMClassifier.predict_proba (:1738)."""
         result = super().predict(
             X, raw_score=raw_score, start_iteration=start_iteration,
             num_iteration=num_iteration, pred_leaf=pred_leaf,
             pred_contrib=pred_contrib, validate_features=validate_features,
-            **kwargs)
+            device=device, **kwargs)
         if callable(self._objective) or raw_score or pred_leaf or \
                 pred_contrib:
             return result

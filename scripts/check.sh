@@ -47,6 +47,17 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
+echo "== predict smoke (serving parity + compile budget, CPU) =="
+# ISSUE 5: device/host prediction parity (binned + raw routes, NaN/0/inf
+# batches), bit-identical per-tree leaves, the <=2-trace steady-state
+# budget over mixed batch sizes, and the stale-cache generation counter.
+timeout -k 10 90 env JAX_PLATFORMS=cpu \
+    python scripts/predict_smoke.py || rc=1
+if [ $rc -ne 0 ]; then
+    echo "check.sh: predict smoke failed — skipping tier-1 pytest" >&2
+    exit $rc
+fi
+
 echo "== hybrid-path dispatch guards (compile budget + O(levels) shape) =="
 # the round-7 hot path: steady-state hybrid training must stay <=2
 # recompiles over 5 iterations and the level phase must issue

@@ -1,0 +1,128 @@
+"""Serving smoke gate (ISSUE 5): device/host prediction parity + the
+steady-state compile budget of the packed-forest engine, on CPU, <30 s.
+
+Asserts, end to end through the public API:
+  1. predict(device=True) matches the host walk on a model with NaN +
+     zero + ±inf request values (binned route), on a text-round-tripped
+     model without mappers (raw route), and per-tree LEAF INDICES are
+     bit-identical through the serving internals;
+  2. after warming the row buckets, 5 mixed-size predict calls compile
+     NOTHING (budget <= 2 traces, measured 0) — the bucketing contract
+     that keeps a varying-batch serving loop on the XLA program cache;
+  3. a rollback + retrain to the same model count is served fresh (the
+     model-generation counter), the stale-cache regression.
+
+Wired into scripts/check.sh; exits non-zero on the first violated gate.
+"""
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+T_START = time.perf_counter()
+BUDGET_SEC = 30.0
+
+
+def check(cond, what):
+    took = time.perf_counter() - T_START
+    if not cond:
+        print(f"predict_smoke: FAIL {what} ({took:.1f}s)", file=sys.stderr)
+        sys.exit(1)
+    print(f"predict_smoke: ok {what} ({took:.1f}s)")
+
+
+def main() -> int:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.analysis import guards
+    from lightgbm_tpu.core.tree import host_tree_to_arrays
+    from lightgbm_tpu.ops.predict import depth_steps, tree_leaf_bins
+    from lightgbm_tpu.ops.split import FeatureMeta
+
+    rng = np.random.default_rng(7)
+    n, f = 1200, 8
+    X = rng.normal(size=(n, f)).astype(np.float32).astype(np.float64)
+    X[rng.uniform(size=X.shape) < 0.05] = np.nan
+    y = np.nan_to_num(X[:, 0]) + 0.5 * np.nan_to_num(X[:, 1]) ** 2
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "verbose": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+
+    Xq = X.copy()
+    Xq[:100] = np.nan
+    Xq[100:200] = 0.0
+    Xq[200:260] = np.inf
+    Xq[260:320] = -np.inf
+
+    host = bst.predict(Xq, raw_score=True)
+    dev = bst.predict(Xq, device=True, raw_score=True)
+    check(np.allclose(dev, host, rtol=1e-5, atol=1e-6),
+          "binned-route parity (NaN/0/±inf batch)")
+
+    # per-tree leaf indices bit-identical (device binning + depth-bounded
+    # traversal vs the host raw walk)
+    eng = bst._engine
+    import jax.numpy as jnp
+    srv_bins = eng._serving.binner.bins(Xq)
+    meta = FeatureMeta.from_mappers(eng.train_set.used_bin_mappers())
+    L = eng.config.num_leaves
+    for t in eng.models:
+        leaf_dev = tree_leaf_bins(
+            host_tree_to_arrays(t, L), srv_bins, meta.num_bin,
+            meta.missing_type, meta.default_bin,
+            num_steps=depth_steps(t.max_depth, L))
+        leaf_host = t.predict_leaf(Xq)
+        check(np.array_equal(np.asarray(leaf_dev)[:len(Xq)], leaf_host),
+              f"leaf parity tree depth={t.max_depth}")
+
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    dev_raw = loaded.predict(Xq, device=True, raw_score=True)
+    check(np.allclose(dev_raw, loaded.predict(Xq, raw_score=True),
+                      rtol=1e-5, atol=1e-6),
+          "raw-route parity (loaded model, no mappers)")
+    check(loaded._engine._serving is not None and
+          loaded._engine._serving.raw_pack.count == len(loaded._engine
+                                                        .models),
+          "raw route actually served on device")
+
+    # steady-state compile budget: warm the buckets, then 5 mixed sizes
+    for warm in (500, 140):
+        bst.predict(Xq[:warm], device=True)
+        loaded.predict(Xq[:warm], device=True)
+    with guards.CompileCounter() as counter:
+        for r in (500, 400, 300, 140, 450):
+            bst.predict(Xq[:r], device=True)
+            loaded.predict(Xq[:r], device=True)
+    check(counter.count <= 2,
+          f"compile budget: {counter.count} traces across 5 mixed-size "
+          f"calls (<=2) {counter.names if counter.count else ''}")
+
+    # stale-cache regression: rollback + retrain to the same count
+    before = bst.predict(X, device=True)
+    bst.rollback_one_iter()
+
+    def fobj(preds, _):
+        g = np.asarray(preds - y * 2.5, np.float32)
+        return g, np.ones_like(g)
+
+    bst.update(fobj=fobj)
+    fresh_host = bst.predict(X)
+    fresh_dev = bst.predict(X, device=True)
+    check(np.allclose(fresh_dev, fresh_host, rtol=1e-5, atol=1e-6) and
+          np.abs(fresh_dev - before).max() > 1e-5,
+          "generation counter serves the retrained forest")
+
+    took = time.perf_counter() - T_START
+    check(took < BUDGET_SEC, f"wall budget {took:.1f}s < {BUDGET_SEC:.0f}s")
+    print(f"predict_smoke: PASS in {took:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
