@@ -107,11 +107,20 @@ RC_NO_RESULT = 3
 RC_DEVICE_UNREACHABLE = 4
 
 
+# resolved level-histogram kernel attribution (ISSUE 6): set by
+# run_child once the engine exists; "n/a" = non-level scheduling,
+# "unknown" = parent-side failure lines emitted before/without a child
+# resolution (salvaged lines inherit the child's banked value). r05's
+# A/B confusion came from device numbers that could not be attributed
+# to a kernel config — every record now carries the resolution.
+_LEVEL_BACKEND = "unknown"
+
+
 def _result_record(ips: float, **extra) -> dict:
     """The ONE place the benchmark record shape lives (metric name,
-    reference-scaled vs_baseline): shared by the headline result, the
-    banked partials and the failure lines so they can never
-    desynchronize."""
+    reference-scaled vs_baseline, level-kernel attribution): shared by
+    the headline result, the banked partials and the failure lines so
+    they can never desynchronize."""
     ref_ips_at_n = REF_HIGGS_IPS * (REF_HIGGS_ROWS / N_ROWS)
     return {
         "metric": f"higgs_synth_{N_ROWS}x{N_FEATURES}"
@@ -119,6 +128,7 @@ def _result_record(ips: float, **extra) -> dict:
         "value": round(ips, 4),
         "unit": "iters/sec",
         "vs_baseline": round(ips / ref_ips_at_n, 4) if ips else 0.0,
+        "level_backend": _LEVEL_BACKEND,
         **extra,
     }
 
@@ -228,6 +238,19 @@ def run_child(sched: str) -> None:
         del probe_b
     heartbeat.beat(heartbeat.PHASE_COMPILING, 1)
     booster = lgb.Booster(params, ds)
+    global _LEVEL_BACKEND
+    try:
+        gcfg = booster._engine.grower_cfg
+        if gcfg.row_sched == "level":
+            from lightgbm_tpu.core.level_grower import \
+                effective_level_backend
+            _LEVEL_BACKEND = effective_level_backend(gcfg)
+        else:                      # incl. an eligibility fallback:
+            _LEVEL_BACKEND = "n/a"  # the record's sched field + this
+            # say "no level kernel ran", attributably
+    except Exception as e:
+        print(f"[bench] level-backend attribution failed: {e!r}",
+              file=sys.stderr)
     for w in range(WARMUP_ITERS):      # compile + cache warm
         heartbeat.beat(heartbeat.PHASE_WARMUP, w)
         booster.update()

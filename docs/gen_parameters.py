@@ -11,7 +11,8 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from lightgbm_tpu.config import _P, _UNIMPLEMENTED_WHEN  # noqa: E402
+from lightgbm_tpu.config import (_CHOICES, _P,  # noqa: E402
+                                 _UNIMPLEMENTED_WHEN)
 
 
 def _type_name(t):
@@ -69,6 +70,9 @@ def main() -> str:
         if aliases:
             lines.append("- aliases: " +
                          ", ".join(f"`{a}`" for a in aliases))
+        if name in _CHOICES:
+            lines.append("- options: " +
+                         ", ".join(f"`{c}`" for c in _CHOICES[name]))
         if name in _UNIMPLEMENTED_WHEN:
             lines.append("- **note**: accepted for compatibility; the "
                          "underlying feature is not implemented yet and "

@@ -25,6 +25,25 @@ from .utils import log
 
 _P: Dict[str, Tuple[Any, Any, Tuple[str, ...]]] = {}
 
+# enumerated string params: name -> accepted values, rendered into
+# docs/Parameters.md by docs/gen_parameters.py (kept HERE so the
+# registry stays the single source of truth for user docs)
+_CHOICES: Dict[str, Tuple[str, ...]] = {
+    "tpu_hist_kernel": ("auto", "einsum", "scatter", "pallas",
+                        "pallas_level"),
+    "tpu_hist_dtype": ("float32", "bfloat16", "bf16"),
+    # "leaf" = the masked full-pass leaf-wise program (same row layout
+    # as "full"; kept for parity with existing configs/tests)
+    "tpu_row_scheduling": ("compact", "full", "leaf", "level"),
+    "tpu_sparse_storage": ("auto", "dense", "multival", "none"),
+    "tpu_partition_mode": ("auto", "scatter", "sort"),
+    # full truthy/falsy set the consumer (models/gbdt.py packed-bins
+    # resolution) accepts — validation must not reject spellings that
+    # worked before it existed
+    "tpu_packed_bins": ("auto", "true", "false", "1", "0", "yes", "no",
+                        "on", "off"),
+}
+
 
 def _reg(name, typ, default, aliases=(), check=None):
     _P[name] = (typ, default, tuple(aliases), check)
@@ -225,8 +244,15 @@ _reg("tpu_num_devices", int, 0, ())          # 0 = use all visible devices
 _reg("tpu_hist_dtype", str, "float32", ())   # histogram input dtype:
                                              # float32 | bfloat16
 _reg("tpu_hist_kernel", str, "auto", ())     # auto | einsum | scatter |
-                                             # pallas (auto: einsum on TPU,
-                                             #  scatter-add on CPU)
+                                             # pallas | pallas_level
+                                             # (auto: einsum on TPU,
+                                             #  scatter-add on CPU;
+                                             #  pallas_level = the
+                                             #  one-launch sorted-segment
+                                             #  level kernel, level/hybrid
+                                             #  scheduling only — the
+                                             #  compact path resolves as
+                                             #  auto under it)
 _reg("tpu_row_scheduling", str, "compact", ())  # compact | full | level
 # hybrid level+tail growth (tpu_row_scheduling="level" with unbounded or
 # > MAX_LEVEL_DEPTH max_depth): depth the level-synchronous phase runs
@@ -528,6 +554,16 @@ class Config:
                     raise ValueError(f"{canonical}={coerced} out of range")
                 if hi is not None and (coerced > hi or (not hi_inc and coerced == hi)):
                     raise ValueError(f"{canonical}={coerced} out of range")
+            if canonical in _CHOICES and coerced is not None:
+                coerced = str(coerced).lower()   # case-normalize enums
+                if coerced not in _CHOICES[canonical]:
+                    # fail LOUDLY at parse time: a typo'd enum (e.g.
+                    # tpu_hist_kernel="palas") would otherwise train
+                    # silently on some fallback path — the
+                    # invisible-remap class the r05 postmortem is about
+                    raise ValueError(
+                        f"{canonical}={coerced!r} is not one of "
+                        f"{'/'.join(_CHOICES[canonical])}")
             self._values[canonical] = coerced
             self._explicit[canonical] = coerced
         self._post_process()

@@ -57,6 +57,13 @@ class GrowerConfig:
     hist_dtype: str = "float32"
     # compact-mode histogram kernel: einsum (TPU) | scatter (CPU)
     hist_rm_backend: str = "einsum"
+    # level-mode histogram kernel: "" derives from hist_rm_backend
+    # (legacy); otherwise scatter | einsum | pallas | pallas_level —
+    # the last is the ONE-launch sorted-segment Pallas kernel
+    # (ops/hist_level_pallas.py). Resolved by
+    # models/gbdt.resolve_level_hist_kernel from tpu_hist_kernel +
+    # the tuned cache at the training row count.
+    level_hist_backend: str = ""
     # compact-mode segment partition primitive: scatter | sort
     partition_mode: str = "scatter"
     # smallest pow2 segment bucket (smaller leaves pad up to this)

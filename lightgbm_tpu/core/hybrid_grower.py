@@ -96,8 +96,16 @@ def make_hybrid_grower(cfg: GrowerConfig, meta, bundle=None,
     phase = make_level_phase(cfg, meta, depth=D0, scan_last=True,
                              bundle=bundle, collect_hists=True)
     # the tail is the EXISTING compact sequential program, resumed from
-    # the level phase's committed state via its ``init`` seam
-    tail_cfg = dataclasses.replace(cfg, row_sched="compact")
+    # the level phase's committed state via its ``init`` seam. The
+    # level-only histogram backend (e.g. pallas_level) must not leak
+    # into the tail's row-major kernel selection: the tail reads
+    # hist_rm_backend only, and the pool it resumes from is seeded
+    # below from whatever kernel the level phase ran — the raw
+    # accumulator dtype contract (f32 / exact int32) is identical
+    # across scatter, blocks and pallas_level, so the handoff stays
+    # bit-exact regardless of which one produced the hists.
+    tail_cfg = dataclasses.replace(cfg, row_sched="compact",
+                                   level_hist_backend="")
     tail_grow = make_tree_grower(tail_cfg, meta, bundle=bundle)
 
     T = 2 ** (D0 + 1) - 1             # heap nodes, levels 0..D0

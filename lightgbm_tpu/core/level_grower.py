@@ -62,6 +62,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.histogram import hist_rowmajor
+from ..utils import log
 from ..ops.split import (FeatureMeta, K_EPSILON, SplitHyperParams,
                          SplitRecord, best_split_for_leaf,
                          calculate_splitted_leaf_output,
@@ -85,33 +86,189 @@ from .tree import TreeArrays
 MAX_LEVEL_DEPTH = 10
 
 
+_LOGGED_ONCE: set = set()
+
+
+def _log_once(msg: str) -> None:
+    """INFO-log a backend-resolution decision exactly once per process.
+
+    The r05 A/B confusion started with an INVISIBLE mapping (pallas
+    silently running as einsum under blocks mode), so every silent
+    remap now announces itself — once, not per-level/per-tree."""
+    if msg not in _LOGGED_ONCE:
+        _LOGGED_ONCE.add(msg)
+        log.info(msg)
+
+
 def _resolve_rm_backend(requested: str) -> str:
-    """Blocks-mode kernel selection.
+    """Level-mode histogram kernel selection.
 
     "scatter": one global scatter-add per level over (node, f, bin)
-    keys — the natural CPU kernel. Anything else runs the BLOCKS mode
-    (rows sorted by node + batched whole-block histograms + masked
-    edge windows — ~4 large batched kernels per level, the MXU shape).
+    keys — the natural CPU kernel. "pallas_level": the ONE-launch
+    sorted-segment Pallas kernel (ops/hist_level_pallas.py) — per-node
+    VMEM accumulator banks over segment-aligned row blocks. Anything
+    else runs the BLOCKS mode (rows sorted by node + batched
+    whole-block histograms + masked edge windows — ~4 large batched
+    kernels per level, the pre-round-10 MXU shape).
 
     ADVICE r05: blocks mode runs the row-major kernel under vmap with
     masked edge windows as small as bs=256 — a combination the pallas
     kernel has never been device-measured on (the r05 device A/B
     pinned einsum on both arms). A batching or small-block defect
-    would corrupt level histograms silently, so every non-scatter
-    backend maps to einsum until pallas-under-level has device A/B
-    coverage. The interpret-mode parity test
-    (tests/test_level_grower.py::test_pallas_blocks_parity_interpret)
-    exercises the real pallas kernel under vmap via
-    LGBM_TPU_LEVEL_PALLAS=1 — flip that env on device once the A/B
-    lands to re-enable pallas here.
+    would corrupt level histograms silently, so a bare "pallas"
+    request maps to einsum until pallas-under-level has device A/B
+    coverage (the interpret-mode parity test
+    tests/test_level_grower.py::test_pallas_blocks_parity_interpret
+    exercises the real kernel under vmap via LGBM_TPU_LEVEL_PALLAS=1).
+    The mapping is no longer silent: it logs once at INFO with the
+    reason — invisibility is exactly how the r05 A/B confusion
+    started.
     """
     if requested == "scatter":
         return "scatter"
-    if (requested == "pallas" and
-            os.environ.get("LGBM_TPU_LEVEL_PALLAS", "").lower()
-            in ("1", "true", "yes")):
-        return "pallas"
+    if requested == "pallas_level":
+        return "pallas_level"
+    if requested == "pallas":
+        if os.environ.get("LGBM_TPU_LEVEL_PALLAS", "").lower() in (
+                "1", "true", "yes"):
+            return "pallas"
+        _log_once(
+            "level histograms: tpu_hist_kernel=pallas maps to einsum "
+            "under blocks mode (pallas-under-vmap lacks device A/B "
+            "coverage, ADVICE r05; set LGBM_TPU_LEVEL_PALLAS=1 to "
+            "force, or use tpu_hist_kernel=pallas_level for the "
+            "sorted-segment kernel)")
+        return "einsum"
+    if requested != "einsum":
+        _log_once(
+            f"level histograms: backend {requested!r} has no level-mode "
+            "formulation; running blocks mode with einsum")
     return "einsum"
+
+
+def effective_level_backend(cfg: "GrowerConfig") -> str:
+    """The backend the level phase will actually run (after the
+    pallas→einsum pin, legacy derivation, AND the VMEM-infeasibility
+    fallback — which depends only on num_bin, so it is knowable here)
+    — the ONE attribution string bench records carry so device numbers
+    are traceable to a kernel config (r05 lesson: an invisible remap
+    made two sessions' A/Bs unattributable). The per-depth padding-
+    economy fallback (deep near-empty levels route to blocks) can
+    still mix backends WITHIN a tree; that one is INFO-logged, not
+    re-attributed."""
+    resolved = _resolve_rm_backend(cfg.level_hist_backend or
+                                   cfg.hist_rm_backend)
+    if resolved == "pallas_level":
+        from ..ops.hist_level_pallas import level_tiles
+        if not level_tiles(8, int(cfg.num_bin), 512, 1, 1)[2]:
+            return "einsum"        # what the fallback actually runs
+    return resolved
+
+
+def hist_level_scatter(bins_t, gh, lsafe, in_lvl, n_d, *, num_bin,
+                       acc_dtype):
+    """[n_d, Fp, B, 3] per-node histograms, scatter formulation.
+
+    Streams per FEATURE: one [R] scatter into a cache-resident
+    [n_d*B, 3] accumulator per column — the natural CPU kernel
+    (measured ~2x over a single (node, f, bin)-keyed scatter at 1M
+    rows on CPU, whose [R, Fp, 3] broadcast updates and multi-MB
+    output thrash). ``bins_t`` is feature-major [Fp, R]."""
+    Fp = bins_t.shape[0]
+    ghm = (gh * in_lvl[:, None].astype(gh.dtype)).astype(acc_dtype)
+    key_base = lsafe * num_bin
+
+    def one_feature(col):
+        return jnp.zeros((n_d * num_bin, 3), acc_dtype).at[
+            key_base + col.astype(jnp.int32)].add(ghm)
+
+    hist_raw = jax.lax.map(one_feature, bins_t)
+    return hist_raw.reshape(Fp, n_d, num_bin, 3).transpose(1, 0, 2, 3)
+
+
+# jaxlint: disable=JL002 — n_d/R/Fp are static Python ints at trace
+# time (the per-level node count and row count specialize the
+# program; one compile per level width, cached across trees)
+def hist_level_blocks(bins_p, gh, local, in_lvl, n_d, R, Fp, *, num_bin,
+                      input_dtype, rm_backend, acc_dtype):
+    """[n_d, Fp, B, 3] per-node histograms, big-kernel formulation.
+
+    Full blocks interior to a node are summed by a per-owner
+    scatter over [G] block histograms (each node sums only its OWN
+    blocks — no global prefix, so no cancellation error beyond the
+    node's own magnitude); the two sub-block edges of every node
+    come from fixed-size masked windows. ``bins_p`` stays uint8/16
+    through the sort and the window gathers (the ADVICE r05 memory
+    bound); the cast to int32 happens per block inside the kernel
+    call, where it is fused and ephemeral."""
+    B = num_bin
+    rm_hist = jax.vmap(lambda b, g: hist_rowmajor(
+        b.astype(jnp.int32), g, num_bin=B, dtype=input_dtype,
+        backend=rm_backend))
+
+    if n_d <= 2:
+        # shallow levels: per-node masked full passes beat the
+        # block/window machinery (n_d * R <= 2R vs ~3R rows); the
+        # inline cast fuses into the one-hot compare
+        return jnp.stack([
+            hist_rowmajor(
+                bins_p.astype(jnp.int32),
+                gh * (in_lvl & (local == v))[:, None].astype(
+                    gh.dtype),
+                num_bin=B, dtype=input_dtype,
+                backend=rm_backend)
+            for v in range(n_d)]).astype(acc_dtype)
+
+    key = jnp.where(in_lvl, local, n_d)
+    order = jnp.argsort(key, stable=True)
+    sb = bins_p[order]                             # [R, Fp] uint8
+    sgh = gh[order] * (key[order] < n_d)[:, None].astype(gh.dtype)
+    # PHYSICAL rows per node (counts incl. bagged-out rows)
+    cnt = jnp.zeros(n_d + 1, jnp.int32).at[key].add(1)[:n_d]
+    starts = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(cnt)])  # [n_d + 1]
+    s_v, e_v = starts[:-1], starts[1:]
+    # block size ~ mean segment, pow2
+    bs = 256
+    while bs * n_d < R:
+        bs *= 2
+    G = -(-R // bs)
+    pad = G * bs - R
+    sb = jnp.pad(sb, ((0, pad), (0, 0)))
+    sgh = jnp.pad(sgh, ((0, pad), (0, 0)))
+    bh = rm_hist(sb.reshape(G, bs, Fp), sgh.reshape(G, bs, 3))
+    # owner of each block: the node containing its first row, kept
+    # only when the whole block lies inside that node; straddling
+    # and out-of-range blocks go to the dump slot (their rows are
+    # exactly what the edge windows cover)
+    b_start = jnp.arange(G, dtype=jnp.int32) * bs
+    owner = (jnp.searchsorted(starts, b_start, side="right")
+             .astype(jnp.int32) - 1)
+    own_safe = jnp.clip(owner, 0, n_d - 1)
+    interior = ((owner >= 0) & (owner < n_d) &
+                (b_start + bs <= e_v[own_safe]) &
+                (b_start >= s_v[own_safe]))
+    tgt = jnp.where(interior, own_safe, n_d)       # dump slot n_d
+    full = jnp.zeros((n_d + 1, Fp, B, 3), bh.dtype).at[tgt].add(
+        bh)[:n_d]
+    b0 = -(-s_v // bs)                             # ceil
+    b1 = jnp.maximum(e_v // bs, b0)
+    head_end = jnp.minimum(b0 * bs, e_v)
+    tail_start = jnp.maximum(b1 * bs, head_end)
+
+    def window_hist(w_start, w_len):
+        idx = jnp.minimum(w_start[:, None] +
+                          jnp.arange(bs, dtype=jnp.int32)[None, :],
+                          G * bs - 1)              # [n_d, bs]
+        wb = sb[idx]                               # [n_d, bs, Fp] u8
+        wm = (jnp.arange(bs)[None, :] <
+              w_len[:, None]).astype(gh.dtype)
+        wg = sgh[idx] * wm[:, :, None]
+        return rm_hist(wb, wg)
+
+    head = window_hist(s_v, head_end - s_v)
+    tail = window_hist(tail_start, e_v - tail_start)
+    return (full + head + tail).astype(acc_dtype)
 
 
 def make_level_phase(cfg: GrowerConfig, meta: FeatureMeta, depth: int,
@@ -154,8 +311,15 @@ def make_level_phase(cfg: GrowerConfig, meta: FeatureMeta, depth: int,
         b_nbin = jnp.asarray(bundle["num_bin"], jnp.int32)       # [F]
         b_default = jnp.asarray(bundle["default_bin"], jnp.int32)
 
-    use_blocks = cfg.hist_rm_backend != "scatter"
-    rm_backend = _resolve_rm_backend(cfg.hist_rm_backend)
+    lvl_backend = _resolve_rm_backend(cfg.level_hist_backend or
+                                      cfg.hist_rm_backend)
+    use_scatter = lvl_backend == "scatter"
+    use_pallas_level = lvl_backend == "pallas_level"
+    use_blocks = not use_scatter
+    # inner row-major backend for the blocks composition (also the
+    # pallas_level fallback on tile-infeasible shapes)
+    rm_backend = lvl_backend if lvl_backend in ("einsum", "pallas") \
+        else "einsum"
 
     def scan_level(hist, sg, sh, cn, out, feature_mask):
         return jax.vmap(
@@ -166,84 +330,44 @@ def make_level_phase(cfg: GrowerConfig, meta: FeatureMeta, depth: int,
     # jaxlint: disable=JL002 — n_d/R/Fp are static Python ints at trace
     # time (the per-level node count and row count specialize the
     # program; one compile per level width, cached across trees)
-    def hist_blocks(bins_p, gh, local, in_lvl, n_d, R, Fp):
-        """[n_d, Fp, B, 3] per-node histograms, big-kernel formulation.
-
-        Full blocks interior to a node are summed by a per-owner
-        scatter over [G] block histograms (each node sums only its OWN
-        blocks — no global prefix, so no cancellation error beyond the
-        node's own magnitude); the two sub-block edges of every node
-        come from fixed-size masked windows. ``bins_p`` stays uint8/16
-        through the sort and the window gathers (the ADVICE r05 memory
-        bound); the cast to int32 happens per block inside the kernel
-        call, where it is fused and ephemeral."""
-        rm_hist = jax.vmap(lambda b, g: hist_rowmajor(
-            b.astype(jnp.int32), g, num_bin=B, dtype=cfg.hist_dtype,
-            backend=rm_backend))
-
-        if n_d <= 2:
-            # shallow levels: per-node masked full passes beat the
-            # block/window machinery (n_d * R <= 2R vs ~3R rows); the
-            # inline cast fuses into the one-hot compare
-            return jnp.stack([
-                hist_rowmajor(
-                    bins_p.astype(jnp.int32),
-                    gh * (in_lvl & (local == v))[:, None].astype(
-                        gh.dtype),
-                    num_bin=B, dtype=cfg.hist_dtype,
-                    backend=rm_backend)
-                for v in range(n_d)]).astype(hist_dtype)
-
-        key = jnp.where(in_lvl, local, n_d)
-        order = jnp.argsort(key, stable=True)
-        sb = bins_p[order]                             # [R, Fp] uint8
-        sgh = gh[order] * (key[order] < n_d)[:, None].astype(gh.dtype)
-        # PHYSICAL rows per node (counts incl. bagged-out rows)
-        cnt = jnp.zeros(n_d + 1, jnp.int32).at[key].add(1)[:n_d]
-        starts = jnp.concatenate(
-            [jnp.zeros(1, jnp.int32), jnp.cumsum(cnt)])  # [n_d + 1]
-        s_v, e_v = starts[:-1], starts[1:]
-        # block size ~ mean segment, pow2
-        bs = 256
-        while bs * n_d < R:
-            bs *= 2
-        G = -(-R // bs)
-        pad = G * bs - R
-        sb = jnp.pad(sb, ((0, pad), (0, 0)))
-        sgh = jnp.pad(sgh, ((0, pad), (0, 0)))
-        bh = rm_hist(sb.reshape(G, bs, Fp), sgh.reshape(G, bs, 3))
-        # owner of each block: the node containing its first row, kept
-        # only when the whole block lies inside that node; straddling
-        # and out-of-range blocks go to the dump slot (their rows are
-        # exactly what the edge windows cover)
-        b_start = jnp.arange(G, dtype=jnp.int32) * bs
-        owner = (jnp.searchsorted(starts, b_start, side="right")
-                 .astype(jnp.int32) - 1)
-        own_safe = jnp.clip(owner, 0, n_d - 1)
-        interior = ((owner >= 0) & (owner < n_d) &
-                    (b_start + bs <= e_v[own_safe]) &
-                    (b_start >= s_v[own_safe]))
-        tgt = jnp.where(interior, own_safe, n_d)       # dump slot n_d
-        full = jnp.zeros((n_d + 1, Fp, B, 3), bh.dtype).at[tgt].add(
-            bh)[:n_d]
-        b0 = -(-s_v // bs)                             # ceil
-        b1 = jnp.maximum(e_v // bs, b0)
-        head_end = jnp.minimum(b0 * bs, e_v)
-        tail_start = jnp.maximum(b1 * bs, head_end)
-
-        def window_hist(w_start, w_len):
-            idx = jnp.minimum(w_start[:, None] +
-                              jnp.arange(bs, dtype=jnp.int32)[None, :],
-                              G * bs - 1)              # [n_d, bs]
-            wb = sb[idx]                               # [n_d, bs, Fp] u8
-            wm = (jnp.arange(bs)[None, :] <
-                  w_len[:, None]).astype(gh.dtype)
-            wg = sgh[idx] * wm[:, :, None]
-            return rm_hist(wb, wg)
-
-        head = window_hist(s_v, head_end - s_v)
-        tail = window_hist(tail_start, e_v - tail_start)
-        return (full + head + tail).astype(hist_dtype)
+    def level_hist(bins_p, gh, local, in_lvl, lsafe, bins_t, n_d, R, Fp):
+        """Per-level [n_d, Fp, B, 3] dispatch over the three
+        formulations; the pallas_level ladder falls back to blocks on
+        tile-infeasible shapes (VMEM budget), loudly."""
+        if use_pallas_level:
+            from ..ops.hist_level_pallas import hist_level, level_tiles
+            ft, br, ok = level_tiles(8, B, 512, n_d, R)
+            # padding-economy bound: the segment-aligned layout carries
+            # up to (n_d + 1) * br dead rows; when that exceeds ~4x the
+            # real rows (deep near-empty levels, tiny datasets) the
+            # kernel would mostly chew padding — the blocks composition
+            # is strictly cheaper there
+            if ok and (n_d + 1) * br <= 4 * R:
+                g_in = gh
+                if cfg.hist_dtype in ("bfloat16", "bf16") and \
+                        gh.dtype == jnp.float32:
+                    # the bf16 fast mode: gh rounded once, single-bf16
+                    # contraction with f32 accumulation (same semantic
+                    # as hist_rowmajor dtype="bfloat16"; f32 inputs
+                    # otherwise take the exact bf16-triple path inside
+                    # the kernel)
+                    g_in = gh.astype(jnp.bfloat16)
+                return hist_level(bins_p, g_in, local, in_lvl, n_d, B,
+                                  block_rows=br,
+                                  feature_tile=ft).astype(hist_dtype)
+            _log_once(
+                f"level histograms: pallas_level falls back to the "
+                f"blocks composition with {rm_backend} "
+                + (f"at num_bin={B} (VMEM budget)" if not ok else
+                   f"for levels with >= {n_d} nodes at {R} rows "
+                   "(alignment padding would dominate)"))
+        if use_blocks:
+            return hist_level_blocks(
+                bins_p, gh, local, in_lvl, n_d, R, Fp, num_bin=B,
+                input_dtype=cfg.hist_dtype, rm_backend=rm_backend,
+                acc_dtype=hist_dtype)
+        return hist_level_scatter(bins_t, gh, lsafe, in_lvl, n_d,
+                                  num_bin=B, acc_dtype=hist_dtype)
 
     def phase(bins_rm, gh, feature_mask=None, rng_key=None):
         R, Fp = bins_rm.shape
@@ -293,21 +417,8 @@ def make_level_phase(cfg: GrowerConfig, meta: FeatureMeta, depth: int,
 
             # ---- segment histogram for every level-d node -----------
             # (physical columns; raw accumulator dtype)
-            if use_blocks:
-                hist_raw = hist_blocks(bins_rm, gh, local, in_lvl, n_d,
-                                       R, Fp)
-            else:
-                ghm = (gh * in_lvl[:, None].astype(gh.dtype)).astype(
-                    hist_dtype)
-                key_base = lsafe * B
-
-                def one_feature(col):
-                    return jnp.zeros((n_d * B, 3), hist_dtype).at[
-                        key_base + col.astype(jnp.int32)].add(ghm)
-
-                hist_raw = jax.lax.map(one_feature, bins_t)
-                hist_raw = hist_raw.reshape(Fp, n_d, B, 3).transpose(
-                    1, 0, 2, 3)
+            hist_raw = level_hist(bins_rm, gh, local, in_lvl, lsafe,
+                                  bins_t, n_d, R, Fp)
             if collect_hists:
                 hist_l.append(hist_raw)
             hist = conv(hist_raw)

@@ -198,8 +198,16 @@ def resolve_hist_kernel(requested: str, hist_dtype: str, use_quant: bool,
     100k-measured flips regress small runs. Unknown cache values fall
     back: tuning must never be able to break training.
     """
-    if requested != "auto":
+    if requested not in ("auto", "pallas_level"):
         return requested
+    if requested == "pallas_level":
+        # "pallas_level" names the LEVEL-mode sorted-segment kernel
+        # only; the compact/tail row-major path resolves as if auto (it
+        # has no level formulation to run) — SAY so (r05 postmortem:
+        # silent remaps make A/B numbers unattributable)
+        log.info("tpu_hist_kernel=pallas_level applies to level-phase "
+                 "histograms only; the compact/tail row-major path "
+                 "resolves as auto")
     if platform == "cpu":
         return "scatter"
     if use_quant or hist_dtype in ("bfloat16", "bf16"):
@@ -207,6 +215,31 @@ def resolve_hist_kernel(requested: str, hist_dtype: str, use_quant: bool,
     tk = (tuned.get("f32_hist_kernel", "einsum")
           if tuned.applies(num_data) else "einsum")
     return tk if tk in ("einsum", "pallas", "scatter") else "einsum"
+
+
+def resolve_level_hist_kernel(requested: str, num_data,
+                              platform: str) -> str:
+    """Resolve ``tpu_hist_kernel`` for the LEVEL phase's per-node
+    histograms (core/level_grower.py; the compact/tail path resolves
+    separately through resolve_hist_kernel).
+
+    Explicit values pass through (``pallas_level`` = the one-launch
+    sorted-segment Pallas kernel, ops/hist_level_pallas.py; a bare
+    ``pallas`` stays einsum-pinned under blocks mode per ADVICE r05 —
+    level_grower._resolve_rm_backend). ``auto``: scatter on CPU;
+    on TPU the tuned cache's ``level_hist_backend`` (re-learned by the
+    microbench ``hist_level`` A/B at level shapes), size-gated like
+    every tuned flip, einsum fallback. Unknown cache values fall back —
+    tuning must never be able to break training.
+    """
+    if requested != "auto":
+        return requested
+    if platform == "cpu":
+        return "scatter"
+    tk = (tuned.get("level_hist_backend", "einsum")
+          if tuned.applies(num_data) else "einsum")
+    return tk if tk in ("einsum", "pallas", "scatter", "pallas_level") \
+        else "einsum"
 
 
 class GBDT:
@@ -715,6 +748,8 @@ class GBDT:
         rm_backend = resolve_hist_kernel(
             cfg.tpu_hist_kernel, hist_dtype, bool(cfg.use_quantized_grad),
             self.num_data, jax.default_backend())
+        level_backend = resolve_level_hist_kernel(
+            cfg.tpu_hist_kernel, self.num_data, jax.default_backend())
         part_mode = cfg.tpu_partition_mode
         if part_mode == "auto" and jax.default_backend() == "cpu":
             # CPU favors scatter at every size; on TPU "auto" passes
@@ -729,6 +764,7 @@ class GBDT:
             bynode_mask=self._bynode, interaction_groups=groups,
             row_sched=row_sched, hist_dtype=hist_dtype,
             hist_rm_backend=rm_backend,
+            level_hist_backend=level_backend,
             partition_mode=part_mode,
             min_bucket=cfg.tpu_min_bucket,
             quantized=bool(cfg.use_quantized_grad),

@@ -52,6 +52,22 @@ def get(key: str, default: Any = None) -> Any:
     return _load().get(key, default)
 
 
+# Known keys (all optional; consumers fall back when absent/invalid):
+#   f32_hist_kernel     compact-path f32 histogram kernel
+#                       (models/gbdt.resolve_hist_kernel)
+#   packed_bins         bit-packed u32 bin gathers (models/gbdt.py)
+#   level_hist_backend  LEVEL-phase per-node histogram kernel —
+#                       scatter | einsum | pallas | pallas_level
+#                       (models/gbdt.resolve_level_hist_kernel);
+#                       re-learned by scripts/tpu_session_auto.py
+#                       stage 4.7 from END-TO-END bench arms at the
+#                       1M depth-10 level shape (ab_level_kernel_*,
+#                       3% margin; the microbench ``hist_level`` raw
+#                       kernel table is informational). Seeded
+#                       "einsum" (conservative) until a device
+#                       session measures the sorted-segment kernel.
+#   flip_min_rows       row-count floor below which flips don't apply
+#
 # The session A/Bs its flips at 100k rows; at small sizes the winners
 # invert (measured 2026-08-01 on v5e: micro 16k x 28 ran 84.1 it/s on
 # the einsum/u8 defaults vs 57.0 with the 100k-tuned pallas+packed

@@ -124,6 +124,99 @@ def bench_pallas():
                           f"{type(e).__name__}: {str(e)[:120]}", flush=True)
 
 
+def bench_hist_level():
+    """Level-mode per-node histogram A/B (ISSUE 6): the one-launch
+    sorted-segment Pallas kernel (pallas_level) vs the blocks
+    composition (interior blocks + 2x edge windows, einsum inner) vs
+    the per-feature scatter, at level shapes — depth 4/7/10,
+    F in {28, 200}, B=255, quantized on/off. INFORMATIONAL: this raw
+    kernel table goes to the runbook/logs; the TUNED.json
+    ``level_hist_backend`` decision is made by tpu_session_auto stage
+    4.7 from END-TO-END bench arms (``ab_level_kernel_*``), not from
+    this table — a kernel that wins here but loses in the training
+    loop (layout/fusion effects) must not become the default.
+
+    On CPU the matrix shrinks (32k rows, depth<=7, F=28, no einsum at
+    F=200) and the Pallas arm runs the INTERPRETER — mechanics proof
+    only, never a tuning signal; set MB_LEVEL_PALLAS=0/1 to force the
+    arm off/on.
+    """
+    import os
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.core.level_grower import (hist_level_blocks,
+                                                hist_level_scatter)
+    from lightgbm_tpu.ops.hist_level_pallas import hist_level, level_tiles
+
+    rng = np.random.default_rng(0)
+    B = 255
+    on_tpu = jax.default_backend() == "tpu"
+    R = 1_048_576 if on_tpu else 32_768
+    feats = (28, 200) if on_tpu else (28,)
+    depths = (4, 7, 10) if on_tpu else (4, 7)
+    run_pallas = os.environ.get("MB_LEVEL_PALLAS",
+                                "1" if on_tpu else "0") == "1"
+    for F in feats:
+        bins = jnp.asarray(rng.integers(0, B, (R, F), dtype=np.uint8))
+        gh = jnp.asarray(rng.normal(size=(R, 3)).astype(np.float32))
+        ghq = jnp.asarray(rng.integers(-8, 8, (R, 3), dtype=np.int8))
+        for depth in depths:
+            n_d = 1 << depth
+            if F * n_d * B * 3 * 4 > 300 << 20:
+                # [n_d, F, B, 3] output past ~300 MB: not a live shape
+                # (the level phase's memory gate rejects it upstream)
+                print(f"hist_level F={F} d={depth}: SKIP (output "
+                      f"{F * n_d * B * 3 * 4 >> 20} MB)", flush=True)
+                continue
+            local = jnp.asarray(rng.integers(0, n_d, R).astype(np.int32))
+            in_lvl = jnp.ones(R, bool)
+            for qname, g, acc in (("f32", gh, jnp.float32),
+                                  ("int8", ghq, jnp.int32)):
+                # one jit per measured arm is the POINT here: each
+                # (shape, backend) pair is timed as its own program,
+                # warmed by timeit before the timed loop
+                arms = [
+                    # jaxlint: disable=JL003 — per-arm jit, warmed by timeit
+                    ("scatter", jax.jit(
+                        lambda bt, gg, n_d=n_d, acc=acc:
+                        hist_level_scatter(bt, gg, local, in_lvl, n_d,
+                                           num_bin=B, acc_dtype=acc)),
+                     bins.T, g),
+                    # jaxlint: disable=JL003 — per-arm jit, warmed by timeit
+                    ("blocks", jax.jit(
+                        lambda bb, gg, n_d=n_d, F=F, acc=acc:
+                        hist_level_blocks(
+                            bb, gg, local, in_lvl, n_d, R, F,
+                            num_bin=B, input_dtype="float32",
+                            rm_backend="einsum", acc_dtype=acc)),
+                     bins, g),
+                ]
+                if run_pallas:
+                    ft, br, ok = level_tiles(8, B, 512, n_d, R)
+                    if ok:
+                        # jaxlint: disable=JL003 — per-arm jit, warmed by timeit
+                        arms.append(("pallas_level", jax.jit(
+                            lambda bb, gg, n_d=n_d, br=br, ft=ft:
+                            hist_level(bb, gg, local, in_lvl, n_d, B,
+                                       block_rows=br, feature_tile=ft)),
+                            bins, g))
+                    else:
+                        print(f"hist_level F={F} d={depth} {qname} "
+                              f"pallas_level: SKIP (tiles infeasible)",
+                              flush=True)
+                for name, f, b_arg, g_arg in arms:
+                    try:
+                        dt_s = timeit(f, b_arg, g_arg, iters=5,
+                                      warmup=2)
+                        print(f"hist_level F={F:3d} d={depth:2d} "
+                              f"{qname}: {name:12s} {dt_s*1e3:9.3f} ms "
+                              f"({R/dt_s/1e9:.2f} Grows/s)", flush=True)
+                    except Exception as e:
+                        print(f"hist_level F={F} d={depth} {qname} "
+                              f"{name}: FAIL {type(e).__name__}: "
+                              f"{str(e)[:100]}", flush=True)
+
+
 def bench_part():
     import jax
     import jax.numpy as jnp
@@ -270,8 +363,9 @@ def bench_multival():
 
 
 SUITES = {"hist": bench_hist, "pallas": bench_pallas,
-          "pallas_rm": bench_pallas_rm, "part": bench_part,
-          "fullpass": bench_fullpass, "multival": bench_multival}
+          "pallas_rm": bench_pallas_rm, "hist_level": bench_hist_level,
+          "part": bench_part, "fullpass": bench_fullpass,
+          "multival": bench_multival}
 
 if __name__ == "__main__":
     picks = sys.argv[1:] or list(SUITES)

@@ -58,6 +58,18 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
+echo "== hist smoke (sorted-segment level kernel parity + fallback, CPU) =="
+# ISSUE 6: the one-launch pallas_level kernel must be bit-identical to
+# the blocks/scatter formulations on ragged segments (f32 dyadic +
+# exact int8), retrace nothing at a fixed shape, and fall back to the
+# blocks composition (not crash) on VMEM-infeasible tile shapes.
+timeout -k 10 90 env JAX_PLATFORMS=cpu \
+    python scripts/hist_smoke.py || rc=1
+if [ $rc -ne 0 ]; then
+    echo "check.sh: hist smoke failed — skipping tier-1 pytest" >&2
+    exit $rc
+fi
+
 echo "== hybrid-path dispatch guards (compile budget + O(levels) shape) =="
 # the round-7 hot path: steady-state hybrid training must stay <=2
 # recompiles over 5 iterations and the level phase must issue

@@ -1,0 +1,140 @@
+"""Level-histogram kernel smoke gate (ISSUE 6): sorted-segment Pallas
+kernel parity + compile budget + the fallback ladder, on CPU, <30 s.
+
+Asserts, at the op layer (interpret-mode Pallas = the SAME kernel the
+device compiles):
+  1. hist_level (one-launch sorted-segment kernel) is bit-identical to
+     the blocks composition AND the scatter formulation on ragged
+     segments (an empty node, a single-row node, dump rows) for dyadic
+     f32 gradients and for the exact-int32 int8 quantized path;
+  2. after one warmup call, repeated calls at the same (n_d, R, F, B)
+     shape compile NOTHING — the static-shape contract that keeps the
+     hybrid grower inside its <=2-recompile steady-state budget;
+  3. an infeasible tile shape (num_bin >= ~4096 busts the pinned-bank
+     VMEM budget) is REPORTED by level_tiles, REFUSED by hist_level,
+     and the level phase falls back to the blocks composition with
+     identical results — the ladder, not a crash.
+
+Wired into scripts/check.sh; exits non-zero on the first violated gate.
+"""
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+T_START = time.perf_counter()
+BUDGET_SEC = 30.0
+
+
+def check(cond, what):
+    took = time.perf_counter() - T_START
+    if not cond:
+        print(f"hist_smoke: FAIL {what} ({took:.1f}s)", file=sys.stderr)
+        sys.exit(1)
+    print(f"hist_smoke: ok {what} ({took:.1f}s)")
+
+
+def main():
+    from lightgbm_tpu.analysis import guards
+    from lightgbm_tpu.core.level_grower import (hist_level_blocks,
+                                                hist_level_scatter)
+    from lightgbm_tpu.ops.hist_level_pallas import (hist_level,
+                                                    level_tiles)
+
+    rng = np.random.default_rng(0)
+    R, F, B, n_d = 1536, 5, 32, 8
+    bins = rng.integers(0, B, (R, F), dtype=np.uint8)
+    gh = (rng.integers(-8, 8, (R, 3)) * 0.25).astype(np.float32)
+    ghq = rng.integers(-8, 8, (R, 3)).astype(np.int8)
+    local = rng.integers(-1, n_d + 1, R).astype(np.int32)
+    local[local == 2] = 3                  # node 2: empty
+    one = np.where(local == 0)[0]
+    if len(one) > 1:
+        local[one[1:]] = 1                 # node 0: single row
+    in_lvl = (local >= 0) & (local < n_d)
+    b, lc, il = map(jnp.asarray, (bins, local, in_lvl))
+
+    # ---- 1. parity (dyadic f32 exact; int8 exact by construction) --
+    for name, g_np, acc in (("f32", gh, jnp.float32),
+                            ("int8", ghq, jnp.int32)):
+        g = jnp.asarray(g_np)
+        pl_h = np.asarray(hist_level(b, g, lc, il, n_d, B,
+                                     block_rows=128))
+        bl_h = np.asarray(hist_level_blocks(
+            b, g, lc, il, n_d, R, F, num_bin=B, input_dtype="float32",
+            rm_backend="einsum", acc_dtype=acc))
+        sc_h = np.asarray(hist_level_scatter(
+            b.T, g, jnp.where(il, lc, 0), il, n_d, num_bin=B,
+            acc_dtype=acc))
+        check(np.array_equal(pl_h, bl_h) and np.array_equal(pl_h, sc_h),
+              f"parity pallas_level == blocks == scatter [{name}, "
+              "ragged: empty + single-row + dump]")
+        check(np.all(pl_h[2] == 0), f"empty node zeroed [{name}]")
+
+    # ---- 2. compile budget: same shape => no retrace ---------------
+    g = jnp.asarray(gh)
+    hist_level(b, g, lc, il, n_d, B, block_rows=128)  # warm
+    with guards.CompileCounter() as counter:
+        for _ in range(3):
+            out = hist_level(b, g, lc, il, n_d, B, block_rows=128)
+        jax.block_until_ready(out)
+    check(counter.count == 0,
+          f"steady-state compile budget (0 retraces over 3 calls, "
+          f"got {counter.count}: {counter.names})")
+
+    # ---- 3. fallback ladder on infeasible tiles --------------------
+    _, _, ok = level_tiles(8, 8192, 512, n_d, R)
+    check(not ok, "level_tiles reports num_bin=8192 infeasible")
+    refused = False
+    try:
+        hist_level(b, g, lc, il, n_d, 8192)
+    except ValueError:
+        refused = True
+    check(refused, "hist_level refuses infeasible tiles")
+
+    from lightgbm_tpu.core.grower import GrowerConfig
+    from lightgbm_tpu.core.level_grower import make_level_phase
+    from lightgbm_tpu.ops.split import FeatureMeta, SplitHyperParams
+    BF = 4096
+    meta = FeatureMeta(
+        num_bin=jnp.full((2,), BF, jnp.int32),
+        missing_type=jnp.zeros((2,), jnp.int32),
+        default_bin=jnp.zeros((2,), jnp.int32),
+        is_categorical=jnp.zeros((2,), bool),
+        monotone=None)
+    bins2 = jnp.asarray(rng.integers(0, BF, (256, 2), dtype=np.uint16))
+    gh2 = jnp.asarray(np.concatenate(
+        [(rng.integers(-8, 8, (256, 2)) * 0.25).astype(np.float32),
+         np.ones((256, 1), np.float32)], 1))
+
+    def run(backend):
+        cfg = GrowerConfig(num_leaves=4, max_depth=2, num_bin=BF,
+                           hparams=SplitHyperParams(min_data_in_leaf=5),
+                           row_sched="level",
+                           level_hist_backend=backend)
+        return make_level_phase(cfg, meta, depth=2, scan_last=False)(
+            bins2, gh2)
+
+    res_pl, res_sc = run("pallas_level"), run("scatter")
+    check(np.array_equal(np.asarray(res_pl["e"]),
+                         np.asarray(res_sc["e"])) and
+          np.array_equal(np.asarray(res_pl["heap"]),
+                         np.asarray(res_sc["heap"])),
+          "level phase falls back to blocks on infeasible tiles, "
+          "bit-identical to scatter")
+
+    took = time.perf_counter() - T_START
+    check(took < BUDGET_SEC, f"within the {BUDGET_SEC:.0f}s budget")
+    print(f"hist_smoke: PASS ({took:.1f}s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -459,6 +459,61 @@ def _stages() -> int:
     run_bench("ab_depth10_level", 1_000_000, 15, lvl_kw,
               scheds="level")
 
+    # ---- stage 4.7 (ISSUE 6): level-histogram kernel A/B + the
+    # TUNED.json re-learn. One raw-kernel table from the microbench
+    # (depth 4/7/10 x F x quantized — goes to the runbook), then three
+    # end-to-end arms at the depth-10 level shape differing ONLY in
+    # tpu_hist_kernel; every BENCH record carries the resolved backend
+    # (bench.py level_backend), so these numbers are attributable. The
+    # winner is written to TUNED.json's level_hist_backend (consulted
+    # by resolve_level_hist_kernel under tpu_hist_kernel=auto) with the
+    # same 3% noise margin as pick_flips; einsum (the blocks
+    # composition) is the incumbent default.
+    mb_log = os.path.join(LOGDIR, "r06_microbench_hist_level.log")
+    _run_stage([sys.executable, os.path.join(REPO, "microbench.py"),
+                "hist_level"],
+               env=dict(os.environ, **{ENV_COMPILE_CACHE: SESSION_CACHE}),
+               timeout=1500, logpath=mb_log)
+    lvl_arms = {}
+    lvl_window_closed = False
+    for kern in ("scatter", "pallas_level"):
+        res = run_bench(f"ab_level_kernel_{kern}", 1_000_000, 15,
+                        {"max_depth": 10, "tpu_hist_kernel": kern},
+                        scheds="level")
+        lvl_arms[kern] = value(res)
+        if guard(res):
+            lvl_window_closed = True
+            break
+    # incumbent = the einsum-blocks arm already measured as
+    # ab_depth10_level above
+    lvl_base = 0.0
+    for r in RESULTS:
+        if r.get("stage") == "ab_depth10_level":
+            lvl_base = value(r)
+    best_kern, best_v = max(lvl_arms.items(), key=lambda kv: kv[1],
+                            default=("einsum", 0.0))
+    if lvl_base > 0 and best_v > lvl_base * 1.03:
+        sys.path.insert(0, REPO)
+        from lightgbm_tpu import tuned
+        restore_tuned()
+        tuned.reload()
+        path = tuned.write({"level_hist_backend": best_kern})
+        say(f"level_hist_backend={best_kern} written to {path} "
+            f"({best_v:.3f} vs einsum-blocks {lvl_base:.3f} it/s)")
+    else:
+        say(f"level_hist_backend stays einsum (arms {lvl_arms}, "
+            f"base {lvl_base})")
+    STATE["level_kernel_ab"] = dict(lvl_arms, einsum=lvl_base)
+    dump_state()
+    if lvl_window_closed:
+        # same discipline as every other guard site: do NOT point a
+        # fresh claim (the ladder / 10.5M stages) at a dead or wedged
+        # device — bail with whatever landed
+        say("window closed during the level-kernel A/B — bailing")
+        git_commit("bench_logs: r6 partial session (level-kernel A/B "
+                   "cut short; headlines landed)")
+        return 3
+
     # ---- stage 5: leaves ladder at 1M (fixed-cost curve for the
     # runbook) runs BEFORE the 10.5M stage: the big shape's compiles
     # through the remote-compile tunnel are pathological (a 31-leaf

@@ -48,6 +48,30 @@ def test_train_one_iter_steady_state_compile_budget(compile_budget, sched,
             booster.update()
 
 
+def test_hybrid_pallas_level_steady_state_compile_budget(compile_budget):
+    """The sorted-segment Pallas level kernel (ISSUE 6) under the
+    HYBRID grower: 5 post-warmup iterations stay within the same
+    2-compile budget — per-depth pallas_call shapes are static inside
+    the one jitted grow program, so a retrace per tree/depth (the
+    failure mode the segment-aligned padding bound exists to prevent:
+    a data-dependent block count would respecialize every call) blows
+    the budget here."""
+    X, y = _data()
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "max_depth": -1, "max_bin": 63,
+              "tpu_row_scheduling": "level",
+              "tpu_hist_kernel": "pallas_level"}
+    booster = lgb.Booster(params, lgb.Dataset(X, label=y))
+    from lightgbm_tpu.core.level_grower import effective_level_backend
+    assert effective_level_backend(
+        booster._engine.grower_cfg) == "pallas_level"
+    for _ in range(3):  # warmup: trace + compile the training programs
+        booster.update()
+    with compile_budget(2, "train_one_iter x5 [level/-1/pallas_level]"):
+        for _ in range(5):
+            booster.update()
+
+
 def _grower_compiled_text(make, cfg_kw):
     """Compile a grower at a tiny CPU geometry; return optimized HLO."""
     import re

@@ -1,0 +1,274 @@
+"""Sorted-segment Pallas level-histogram kernel (ops/hist_level_pallas):
+interpret-mode exact parity with the blocks and scatter formulations.
+
+All f32 cases use DYADIC gradient values (small multiples of 0.25), so
+every accumulation order — the blocks composition's interior/edge
+split, the scatter's per-feature adds, the pallas kernel's
+block-sequential VMEM banks — produces the SAME f32 sums bit for bit;
+the quantized int8 path is exact int32 by construction. That makes
+``np.testing.assert_array_equal`` the right assertion: any layout,
+owner-mapping or masking defect shows up as a hard mismatch, never as
+"tolerance noise".
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.core.level_grower import (hist_level_blocks,
+                                            hist_level_scatter)
+from lightgbm_tpu.ops.hist_level_pallas import hist_level, level_tiles
+
+
+def _dyadic_gh(rng, n):
+    return (rng.integers(-8, 8, (n, 3)) * 0.25).astype(np.float32)
+
+
+def _all_three(bins, gh, local, in_lvl, n_d, B):
+    """(pallas_level, blocks, scatter) level histograms as numpy."""
+    b = jnp.asarray(bins)
+    g = jnp.asarray(gh)
+    lc = jnp.asarray(local)
+    il = jnp.asarray(in_lvl)
+    acc = jnp.int32 if gh.dtype == np.int8 else jnp.float32
+    pl_h = hist_level(b, g, lc, il, n_d, B, block_rows=128)
+    bl_h = hist_level_blocks(b, g, lc, il, n_d, bins.shape[0],
+                             bins.shape[1], num_bin=B,
+                             input_dtype="float32", rm_backend="einsum",
+                             acc_dtype=acc)
+    lsafe = jnp.where(il, lc, 0)
+    sc_h = hist_level_scatter(b.T, g, lsafe, il, n_d, num_bin=B,
+                              acc_dtype=acc)
+    return np.asarray(pl_h), np.asarray(bl_h), np.asarray(sc_h)
+
+
+@pytest.mark.parametrize("n_d", [1, 4, 16, 64])
+def test_exact_parity_ragged_f32(n_d):
+    """Ragged segments incl. a forced EMPTY node and a SINGLE-ROW node:
+    the three formulations agree bit for bit on dyadic gradients."""
+    rng = np.random.default_rng(7 + n_d)
+    R, F, B = 3000, 7, 64
+    bins = rng.integers(0, B, (R, F), dtype=np.uint8)
+    gh = _dyadic_gh(rng, R)
+    local = rng.integers(-1, n_d + 2, R).astype(np.int32)
+    if n_d >= 4:
+        local[local == 1] = 2              # node 1: empty
+        one = np.where(local == 0)[0]
+        if len(one) > 1:
+            local[one[1:]] = 3             # node 0: single row
+    in_lvl = (local >= 0) & (local < n_d)
+    pl_h, bl_h, sc_h = _all_three(bins, gh, local, in_lvl, n_d, B)
+    np.testing.assert_array_equal(pl_h, bl_h)
+    np.testing.assert_array_equal(pl_h, sc_h)
+    if n_d >= 4:
+        assert np.all(pl_h[1] == 0)        # the empty node is zeroed
+
+
+def test_exact_parity_all_rows_one_node():
+    rng = np.random.default_rng(11)
+    R, F, B, n_d = 2000, 5, 32, 8
+    bins = rng.integers(0, B, (R, F), dtype=np.uint8)
+    gh = _dyadic_gh(rng, R)
+    local = np.full(R, 5, np.int32)
+    in_lvl = np.ones(R, bool)
+    pl_h, bl_h, sc_h = _all_three(bins, gh, local, in_lvl, n_d, B)
+    np.testing.assert_array_equal(pl_h, bl_h)
+    np.testing.assert_array_equal(pl_h, sc_h)
+    assert np.all(pl_h[[0, 1, 2, 3, 4, 6, 7]] == 0)
+
+
+def test_exact_parity_all_rows_dumped():
+    """No row in the level at all (every leaf already deeper): the
+    kernel must return exact zeros, not uninitialized banks."""
+    rng = np.random.default_rng(13)
+    R, F, B, n_d = 1000, 4, 32, 4
+    bins = rng.integers(0, B, (R, F), dtype=np.uint8)
+    gh = _dyadic_gh(rng, R)
+    local = np.zeros(R, np.int32)
+    in_lvl = np.zeros(R, bool)
+    pl_h, _, _ = _all_three(bins, gh, local, in_lvl, n_d, B)
+    assert np.all(pl_h == 0)
+
+
+def test_exact_parity_int8_quantized():
+    """Quantized int8 gradients: exact int32 accumulation on every
+    path — parity is unconditional, no dyadic trick needed."""
+    rng = np.random.default_rng(17)
+    R, F, B, n_d = 3000, 6, 64, 16
+    bins = rng.integers(0, B, (R, F), dtype=np.uint8)
+    gh = rng.integers(-8, 8, (R, 3)).astype(np.int8)
+    local = rng.integers(0, n_d, R).astype(np.int32)
+    in_lvl = rng.uniform(size=R) < 0.9
+    pl_h, bl_h, sc_h = _all_three(bins, gh, local, in_lvl, n_d, B)
+    assert pl_h.dtype == np.int32
+    np.testing.assert_array_equal(pl_h, bl_h)
+    np.testing.assert_array_equal(pl_h, sc_h)
+
+
+def test_depth10_max_level_nodes():
+    """n_d = 2^MAX_LEVEL_DEPTH = 1024 nodes with far fewer rows than
+    nodes — the extreme ragged shape (most nodes empty, the rest 1-2
+    rows). Exercises the segment-aligned padding bound and the
+    owner-keyed bank init at its worst case."""
+    from lightgbm_tpu.core.level_grower import MAX_LEVEL_DEPTH
+    rng = np.random.default_rng(19)
+    n_d = 1 << MAX_LEVEL_DEPTH
+    R, F, B = 512, 4, 16
+    bins = rng.integers(0, B, (R, F), dtype=np.uint8)
+    gh = _dyadic_gh(rng, R)
+    local = rng.integers(0, n_d, R).astype(np.int32)
+    in_lvl = np.ones(R, bool)
+    pl_h = np.asarray(hist_level(
+        jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(local),
+        jnp.asarray(in_lvl), n_d, B, block_rows=128))
+    ref = np.zeros((n_d, F, B, 3), np.float32)
+    np.add.at(ref, (local[:, None], np.arange(F)[None, :], bins),
+              np.broadcast_to(gh[:, None, :], (R, F, 3)))
+    np.testing.assert_array_equal(pl_h, ref)
+
+
+@pytest.mark.slow  # also gated (smaller shape) by scripts/hist_smoke.py
+def test_infeasible_tiles_fall_back_to_blocks():
+    """num_bin >= ~4096 busts the pinned-accumulator VMEM budget:
+    level_tiles must say so, hist_level must refuse, and the level
+    phase must run the blocks composition instead — with identical
+    results (the fallback ladder, not an error)."""
+    _, _, ok = level_tiles(8, 8192, 512, 4, 4096)
+    assert not ok
+    with pytest.raises(ValueError, match="infeasible"):
+        hist_level(jnp.zeros((256, 2), jnp.uint8),
+                   jnp.zeros((256, 3), jnp.float32),
+                   jnp.zeros(256, jnp.int32),
+                   jnp.ones(256, bool), 2, 8192)
+
+    from lightgbm_tpu.core.grower import GrowerConfig
+    from lightgbm_tpu.core.level_grower import make_level_phase
+    from lightgbm_tpu.ops.split import FeatureMeta, SplitHyperParams
+    rng = np.random.default_rng(23)
+    F, B, R = 2, 8192, 256
+    meta = FeatureMeta(
+        num_bin=jnp.full((F,), B, jnp.int32),
+        missing_type=jnp.zeros((F,), jnp.int32),
+        default_bin=jnp.zeros((F,), jnp.int32),
+        is_categorical=jnp.zeros((F,), bool),
+        monotone=None)
+    bins = jnp.asarray(rng.integers(0, B, (R, F), dtype=np.uint16))
+    gh = jnp.asarray(np.concatenate(
+        [_dyadic_gh(rng, R)[:, :2], np.ones((R, 1), np.float32)], 1))
+
+    def run(backend):
+        cfg = GrowerConfig(num_leaves=4, max_depth=2, num_bin=B,
+                           hparams=SplitHyperParams(min_data_in_leaf=5),
+                           row_sched="level",
+                           level_hist_backend=backend)
+        return make_level_phase(cfg, meta, depth=2, scan_last=False)(
+            bins, gh)
+
+    res_pl = run("pallas_level")           # falls back internally
+    res_sc = run("scatter")
+    np.testing.assert_array_equal(np.asarray(res_pl["heap"]),
+                                  np.asarray(res_sc["heap"]))
+    np.testing.assert_array_equal(np.asarray(res_pl["e"]),
+                                  np.asarray(res_sc["e"]))
+
+
+def _params(sched, **kw):
+    p = {"objective": "binary", "num_leaves": 31, "max_depth": 6,
+         "min_data_in_leaf": 20, "verbosity": -1,
+         "boost_from_average": False, "tpu_row_scheduling": sched}
+    p.update(kw)
+    return p
+
+
+def _data(seed=5, n=4000, f=8):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    logit = (X[:, 0] * 1.5 + np.square(X[:, 1]) - X[:, 2] +
+             0.3 * rng.normal(size=n))
+    return X, (logit > 0).astype(np.float32)
+
+
+@pytest.mark.slow  # the hybrid/EFB/quantized train tests below cover
+def test_train_pure_level_pallas_level_exact():  # the pure path too
+    """Dyadic first-tree gradients: pallas_level trains the SAME tree
+    as the scatter level path, prediction-identical."""
+    X, y = _data()
+    b_sc = lgb.train(_params("level", tpu_hist_kernel="scatter"),
+                     lgb.Dataset(X, label=y), num_boost_round=1)
+    b_pl = lgb.train(_params("level", tpu_hist_kernel="pallas_level"),
+                     lgb.Dataset(X, label=y), num_boost_round=1)
+    np.testing.assert_array_equal(b_pl.predict(X), b_sc.predict(X))
+
+
+def test_train_hybrid_pallas_level_exact():
+    """The driver-shaped hybrid path (max_depth=-1) under pallas_level:
+    bit-identical to the compact sequential grower — level hists from
+    the new kernel seed the tail's pool across the handoff."""
+    X, y = _data(seed=13, n=6000)
+    kw = dict(max_depth=-1, num_leaves=63, min_data_in_leaf=5)
+    b_seq = lgb.train(_params("compact", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=1)
+    b_hyb = lgb.train(
+        _params("level", tpu_hist_kernel="pallas_level", **kw),
+        lgb.Dataset(X, label=y), num_boost_round=1)
+    np.testing.assert_array_equal(b_hyb.predict(X), b_seq.predict(X))
+
+
+def test_train_quantized_pallas_level_exact():
+    """int8 gradient rows through the kernel's int8 MXU path: exact
+    int32 level hists keep the hybrid handoff bit-exact."""
+    X, y = _data(seed=5)
+    kw = dict(max_depth=-1, use_quantized_grad=True, seed=3)
+    b_seq = lgb.train(_params("compact", **kw), lgb.Dataset(X, label=y),
+                      num_boost_round=1)
+    b_lvl = lgb.train(
+        _params("level", tpu_hist_kernel="pallas_level", **kw),
+        lgb.Dataset(X, label=y), num_boost_round=1)
+    np.testing.assert_array_equal(b_lvl.predict(X), b_seq.predict(X))
+
+
+def _bundle_data(seed=11, n=3000, groups=4, per=5):
+    rng = np.random.default_rng(seed)
+    F = groups * per
+    X = np.zeros((n, F), np.float32)
+    picks = [rng.integers(0, per, size=n) for _ in range(groups)]
+    for g in range(groups):
+        X[np.arange(n), g * per + picks[g]] = rng.integers(
+            1, 8, size=n).astype(np.float32)
+    y = ((picks[0] % 2 == 0) ^ (picks[1] == 1) ^
+         (X[:, 0] > 4)).astype(np.float32)
+    return X, y
+
+
+def test_train_efb_pallas_level_exact():
+    """EFB bundles: the kernel histograms PHYSICAL group columns and
+    the unchanged make_expand_hist expands per node at scan time —
+    trees must match the scatter-level bundled path bit for bit."""
+    X, y = _bundle_data()
+    kw = dict(max_depth=6, num_leaves=15, enable_bundle=True,
+              min_data_in_leaf=5, tpu_sparse_storage="dense")
+    b_sc = lgb.train(_params("level", tpu_hist_kernel="scatter", **kw),
+                     lgb.Dataset(X, label=y), num_boost_round=1)
+    b_pl = lgb.train(
+        _params("level", tpu_hist_kernel="pallas_level", **kw),
+        lgb.Dataset(X, label=y), num_boost_round=1)
+    assert b_pl._engine._bundle is not None
+    np.testing.assert_array_equal(b_pl.predict(X), b_sc.predict(X))
+
+
+def test_effective_backend_attribution():
+    """The string bench records carry must reflect the kernel that
+    actually runs — incl. the pallas→einsum pin (the r05 lesson)."""
+    from lightgbm_tpu.core.grower import GrowerConfig
+    from lightgbm_tpu.core.level_grower import effective_level_backend
+    assert effective_level_backend(
+        GrowerConfig(level_hist_backend="pallas_level")) == "pallas_level"
+    assert effective_level_backend(
+        GrowerConfig(level_hist_backend="scatter")) == "scatter"
+    # a bare pallas request stays einsum-pinned under blocks mode
+    assert effective_level_backend(
+        GrowerConfig(level_hist_backend="pallas")) in ("einsum", "pallas")
+    # legacy derivation from hist_rm_backend when the level field is ""
+    assert effective_level_backend(
+        GrowerConfig(hist_rm_backend="scatter")) == "scatter"
