@@ -88,6 +88,24 @@ BENCH_PREDICT = os.environ.get("BENCH_PREDICT", "1") == "1"
 PREDICT_BATCH = int(os.environ.get("BENCH_PREDICT_BATCH", 100_000))
 PREDICT_ROWS = int(os.environ.get("BENCH_PREDICT_ROWS", 1_000_000))
 
+# ingestion axis (ISSUE 7): replicated-vs-sharded ingest A/B at the
+# reference Higgs shape. A launch_local gang of BENCH_INGEST_WORLD
+# processes (virtual CPU devices — the gang NEVER touches the TPU
+# claim) constructs the synthetic table twice: replicated (every rank
+# materializes + bins the GLOBAL table — the pre-round-7 behavior) and
+# sharded (pre_partition: each rank generates + bins only its shard;
+# distributed bin finding syncs the mappers). Per-rank ingest seconds
+# and peak RSS go into a third JSON line, same status grammar. Runs on
+# the full-success path AND the reaped-children failure paths (skipped
+# only when a parked/unkillable child still owns the box), inside the
+# remaining watchdog budget.
+BENCH_INGEST = os.environ.get("BENCH_INGEST", "1") == "1"
+INGEST_ROWS = int(os.environ.get("BENCH_INGEST_ROWS", 10_500_000))
+INGEST_WORLD = int(os.environ.get("BENCH_INGEST_WORLD", 2))
+# minimum watchdog seconds left to even start the ingest stage (two
+# gang launches binning INGEST_ROWS rows; generous on server hosts)
+INGEST_MIN_BUDGET = float(os.environ.get("BENCH_INGEST_MIN_BUDGET", 420))
+
 
 # non-default configs (leaves ladder, dtype modes) are labeled so their
 # numbers can't masquerade as the headline metric
@@ -470,6 +488,153 @@ def _hist_mfu(ips: float, sched: str) -> float:
     return flops_per_iter * ips / PEAK_BF16_FLOPS
 
 
+def _ingest_record(value: float, **extra) -> dict:
+    """The ONE shape of the ingest metric line (status grammar shared
+    with the training/predict lines): ``value`` is the slowest rank's
+    SHARDED ingest seconds, the replicated arm and the RSS A/B ride
+    along as fields."""
+    return {
+        "metric": f"ingest_synth_{INGEST_ROWS}x{N_FEATURES}"
+                  f"_w{INGEST_WORLD}_sec",
+        "value": round(value, 2),
+        "unit": "sec",
+        **extra,
+    }
+
+
+def run_ingest_child(mode: str) -> None:
+    """One rank of the ingest gang: generate THIS rank's data (sharded)
+    or the global table (replicated), construct the Dataset, report
+    ingest seconds + peak RSS as one JSON line on stdout."""
+    # init_from_env BEFORE other jax use (virtual CPU devices + gloo)
+    from lightgbm_tpu.distributed import init_from_env
+    rank = init_from_env()
+    import resource
+
+    from lightgbm_tpu.robustness import heartbeat as hb
+    hb_base = os.environ.get(hb.ENV_HEARTBEAT, "")
+    if hb_base:
+        hb.install(f"{hb_base}.r{rank}")
+    hb.beat(hb.PHASE_COMPILING, 0)
+    import jax
+
+    import lightgbm_tpu as lgb
+    world = jax.process_count()
+    if mode == "sharded":
+        from lightgbm_tpu.distributed import row_slice
+        lo, hi = row_slice(INGEST_ROWS, rank, world)
+        n_local, seed = hi - lo, 1000 + rank
+    else:
+        n_local, seed = INGEST_ROWS, 1000
+    t_gen = time.perf_counter()
+    X, y = synth_higgs(n_local, N_FEATURES, seed=seed)
+    gen_sec = time.perf_counter() - t_gen
+    hb.beat(hb.PHASE_MEASURING, 0)
+    params = {"verbose": -1}
+    if mode == "sharded":
+        params["pre_partition"] = True
+        params["tree_learner"] = "data"
+    # jaxlint: disable=JL005 — the timed region is host-side binning +
+    # allgather collectives (process_allgather returns host numpy, a
+    # real barrier); there is no async device dispatch to sync
+    t0 = time.perf_counter()
+    ds = lgb.Dataset(X, label=y, params=params)
+    ds.construct()
+    ingest_sec = time.perf_counter() - t0
+    hb.beat(hb.PHASE_MEASURING, 1)
+    binned = ds._binned
+    local_rows = binned.bins.shape[1] if binned.bins is not None else 0
+    if mode == "sharded":
+        assert binned.shard is not None, "sharded ingest did not engage"
+        assert local_rows == n_local
+    # ru_maxrss: KB on linux — the per-process peak over generation +
+    # binning, i.e. exactly the "does a host ever hold the global
+    # table" number the stage exists to measure
+    peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    print(json.dumps({
+        "rank": rank, "mode": mode, "world": world,
+        "rows_local": int(n_local), "ingest_sec": round(ingest_sec, 2),
+        "gen_sec": round(gen_sec, 2),
+        "peak_rss_mb": round(peak_kb / 1024.0, 1)}), flush=True)
+
+
+def _run_ingest_gang(mode: str, deadline: float) -> list:
+    """Launch + supervise one ingest gang; returns the per-rank record
+    dicts. Raises on rank failure/timeout (caller maps to status)."""
+    import tempfile as _tf
+
+    from lightgbm_tpu.distributed import launch_local
+    fd, hb_base = _tf.mkstemp(prefix=f"bench_ingest_{mode}_",
+                              suffix=".hb")
+    os.close(fd)
+    budget = max(deadline - time.time(), 30.0)
+    try:
+        results = launch_local(
+            [sys.executable, os.path.abspath(__file__)],
+            num_processes=INGEST_WORLD, cpu_devices_per_process=1,
+            timeout=budget,
+            env_extra={"_LGBM_BENCH_INGEST_CHILD": mode,
+                       heartbeat.ENV_HEARTBEAT: hb_base,
+                       ENV_COMPILE_CACHE: _cache_dir()})
+    finally:
+        for r in range(INGEST_WORLD):
+            for p in (hb_base, f"{hb_base}.r{r}"):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+    recs = []
+    for r, (rc, out) in enumerate(results):
+        rec = None
+        for ln in out.splitlines():
+            ln = ln.strip()
+            if ln.startswith("{") and '"ingest_sec"' in ln:
+                rec = json.loads(ln)
+        if rc != 0 or rec is None:
+            raise RuntimeError(
+                f"ingest {mode} rank {r} rc={rc}: {out[-400:]!r}")
+        recs.append(rec)
+    return recs
+
+
+def maybe_run_ingest(deadline: float) -> None:
+    """Replicated-vs-sharded ingest A/B line. The gang runs on virtual
+    CPU devices and never touches the device claim, so it runs on BOTH
+    the full-success path and the reaped-children failure paths
+    (device_unreachable / salvage / no_result — on those its line is
+    printed BEFORE the final training fail/salvage line, which stays
+    LAST for downstream consumers). It is skipped only when a child is
+    still alive on the box (parked / unkillable probe: the A/B timings
+    would race a live claim-holder for the cores). Its own failure must
+    never poison the training/predict lines already printed. Skips
+    silently when disabled or the watchdog is nearly spent."""
+    if not BENCH_INGEST:
+        return
+    remaining = deadline - time.time()
+    if remaining < INGEST_MIN_BUDGET:
+        print(f"[bench] ingest stage skipped: {remaining:.0f}s of "
+              f"watchdog left (< {INGEST_MIN_BUDGET:.0f}s floor)",
+              file=sys.stderr)
+        return
+    try:
+        sharded = _run_ingest_gang("sharded", deadline)
+        replicated = _run_ingest_gang("replicated", deadline)
+        sh_sec = max(r["ingest_sec"] for r in sharded)
+        re_sec = max(r["ingest_sec"] for r in replicated)
+        sh_rss = max(r["peak_rss_mb"] for r in sharded)
+        re_rss = max(r["peak_rss_mb"] for r in replicated)
+        print(json.dumps(_ingest_record(
+            sh_sec, replicated_sec=re_sec,
+            sharded_peak_rss_mb=sh_rss, replicated_peak_rss_mb=re_rss,
+            rss_ratio=round(sh_rss / max(re_rss, 1e-9), 3),
+            sharded=sharded, replicated=replicated)), flush=True)
+    except Exception as e:  # noqa: BLE001 — never poison earlier lines
+        print(f"[bench] ingest stage failed: {e!r}", file=sys.stderr)
+        print(json.dumps(_ingest_record(
+            0.0, status="no_result", note=f"ingest stage: {e}")),
+            flush=True)
+
+
 def _apply_platform_override() -> None:
     """Honor BENCH_PLATFORM=cpu for hardware-free testing.
 
@@ -656,6 +821,14 @@ def main() -> int:
     if os.environ.get("_LGBM_BENCH_CHILD"):
         return _run_instrumented(run_child,
                                  os.environ["_LGBM_BENCH_CHILD"])
+    if os.environ.get("_LGBM_BENCH_INGEST_CHILD"):
+        return _run_instrumented(
+            run_ingest_child, os.environ["_LGBM_BENCH_INGEST_CHILD"])
+    if os.environ.get("BENCH_INGEST_ONLY"):
+        # standalone ingest A/B (PARITY.md numbers, smoke): no device
+        # probe, no training — the gang runs on virtual CPU devices
+        maybe_run_ingest(time.time() + BENCH_WATCHDOG_SEC)
+        return 0
 
     deadline = time.time() + BENCH_WATCHDOG_SEC
     # liveness plumbing (ISSUE 4): this parent's own heartbeat (present
@@ -799,7 +972,12 @@ def main() -> int:
                    what="bench device probe", budget_kw="slot_budget")
     except RetryError as e:
         # transient failures exhausted the shared policy → honest
-        # device symptom (rc=4), reported only after the deadline
+        # device symptom (rc=4), reported only after the deadline.
+        # Every probe child was reaped, so the CPU-only ingest A/B can
+        # still bank its line (the pre-reserve ~35% window is > its
+        # 420 s floor); it prints FIRST so the device fail line stays
+        # the last training-axis line.
+        maybe_run_ingest(deadline)
         note = (f"probe failed after {e.attempts} attempt(s) across "
                 f"{BENCH_WATCHDOG_SEC}s window: {e.last!r}")
         print(_fail_line(note, status="device_unreachable"), flush=True)
@@ -808,6 +986,8 @@ def main() -> int:
                   flush=True)
         return RC_DEVICE_UNREACHABLE
     except _ProbeStuck as e:
+        # NO ingest here: the unkillable probe is still alive on the
+        # box — same skip rule as parked children
         note = f"probe stalled and unkillable: {e}"
         print(_fail_line(note, status="device_unreachable"), flush=True)
         if BENCH_PREDICT:
@@ -815,6 +995,7 @@ def main() -> int:
                   flush=True)
         return RC_DEVICE_UNREACHABLE
     except _ProbeCodeFailure as e:
+        maybe_run_ingest(deadline)
         print(_fail_line(
             f"probe failed (code failure, not retried): {e}",
             status="no_result"), flush=True)
@@ -1000,6 +1181,7 @@ def main() -> int:
                 print(line, flush=True)
                 emit_predict_line(predict_line, f"sched={sched}",
                                   "child exited without a predict line")
+                maybe_run_ingest(deadline)
                 return 0
             except _ParkedChild as e:
                 # status "parked" (or a salvaged line with parked=true) is
@@ -1018,7 +1200,12 @@ def main() -> int:
                 return RC_NO_RESULT
             except RetryError as e:
                 # every relaunch stalled: salvage whatever a timed loop
-                # banked before the device went quiet
+                # banked before the device went quiet. Children were
+                # reaped (not parked), so the CPU-only ingest A/B still
+                # banks its line — before the salvage lines, which stay
+                # last.
+                if best_salvage() is not None:
+                    maybe_run_ingest(deadline)
                 if emit_salvaged(f"sched={sched}", str(e)):
                     emit_predict_line(None, f"sched={sched}", str(e))
                     return 0
@@ -1028,6 +1215,9 @@ def main() -> int:
             except _ChildNoResult as e:
                 last_note = str(e)
                 continue
+        # exiting without a training result; children were reaped (the
+        # parked path returned above), so the ingest line can still bank
+        maybe_run_ingest(deadline)
         if emit_salvaged("all scheduling modes", last_note):
             emit_predict_line(None, "all scheduling modes", last_note)
             return 0

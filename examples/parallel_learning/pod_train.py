@@ -21,9 +21,15 @@ Launch examples:
              print(launch_local(['python', 'pod_train.py'], 2, \
                                 cpu_devices_per_process=2))"
 
-Each process loads ITS OWN row shard (per-rank file or slice — the
-reference's pre-partitioned-data convention) and `tree_learner=data`
-makes histograms global through psum.
+Each process loads ITS OWN row shard (per-rank slice here; a per-rank
+file via 'data_{rank}.csv' works the same) and ``pre_partition=true``
+engages sharded ingestion: distributed bin finding (per-shard sample
+summaries → feature-sliced find_bin → BinMapper allgather) makes the
+bin boundaries globally identical, each host bins only its rows, and
+the device mesh is fed from the process-local shards — host RAM per
+process is O(rows/world), the reference's 176 GB/machine Criteo recipe
+(src/io/dataset_loader.cpp:1175-1219) in SPMD form. See
+docs/TPU_RUNBOOK.md "Sharded ingestion".
 """
 import os
 import sys
@@ -39,31 +45,45 @@ rank = init_from_env()          # must precede any other jax use
 import numpy as np              # noqa: E402
 
 import lightgbm_tpu as lgb      # noqa: E402
-from lightgbm_tpu.distributed import num_processes  # noqa: E402
+from lightgbm_tpu.distributed import num_processes, row_slice  # noqa: E402
+
+N_ROWS = int(os.environ.get("POD_TRAIN_ROWS", 40_000))
+N_FEATURES = 16
+_GEN_BLOCK = 8192
 
 
-def load_data():
-    """The GLOBAL training table, loaded identically on every host.
-
-    Multi-host contract (SPMD): every process passes the same global
-    arrays; jax then places only each device's ROW SHARD into its HBM
-    (host RAM holds the full table during ingest — the device memory,
-    not the host copy, is what scales with the pod). The reference's
-    pre_partition per-machine-file mode (each host reads only its rows)
-    is not yet wired through the binning sync and is the documented gap
-    here. Synthetic data keeps the walkthrough runnable anywhere."""
-    rng = np.random.default_rng(7)
-    X = rng.normal(size=(40_000, 16)).astype(np.float32)
+def load_data(rank: int, world: int):
+    """THIS process's row shard only — no host ever holds the global
+    table. Synthetic data keeps the walkthrough runnable anywhere: the
+    deterministic global table is defined in fixed 8192-row blocks,
+    each seeded by its block index, and a rank materializes ONLY the
+    blocks overlapping its slice — every world size trains on the same
+    logical rows at O(rows/world) host memory (a real deployment reads
+    a per-rank file or slice instead, e.g.
+    ``lgb.Dataset("higgs_{rank}.csv", params={"pre_partition": True})``).
+    """
+    lo, hi = row_slice(N_ROWS, rank, world)
+    parts = []
+    for b in range(lo // _GEN_BLOCK, (max(hi, lo + 1) - 1) // _GEN_BLOCK + 1):
+        b_lo = b * _GEN_BLOCK
+        n_blk = min(b_lo + _GEN_BLOCK, N_ROWS) - b_lo
+        blk = np.random.default_rng([7, b]).normal(
+            size=(n_blk, N_FEATURES)).astype(np.float32)
+        parts.append(blk[max(lo - b_lo, 0):hi - b_lo])
+    X = np.concatenate(parts, axis=0)
     y = (X[:, 0] - 0.5 * X[:, 1] + 0.25 * X[:, 2] * X[:, 3] > 0)
     return X, y.astype(np.float32)
 
 
 def main() -> None:
     world = num_processes()
-    X, y = load_data()
+    X, y = load_data(rank, world)
     bst = lgb.train(
         {"objective": "binary", "tree_learner": "data",
          "num_leaves": 63, "learning_rate": 0.1, "verbose": -1,
+         # sharded ingestion: per-host row shards, distributed bin
+         # finding, O(rows/world) host memory
+         "pre_partition": True,
          # bit-identical across world sizes: exact int32 histogram
          # accumulation under the global scales
          "use_quantized_grad": True, "stochastic_rounding": False,
@@ -73,8 +93,9 @@ def main() -> None:
         bst.save_model("pod_model.txt")
         pred = bst.predict(X)
         acc = float(np.mean((pred > 0.5) == y))
-        print(f"[pod_train] world={world} train-shard acc={acc:.4f} "
-              "model -> pod_model.txt", flush=True)
+        print(f"[pod_train] world={world} shard_rows={len(X)} "
+              f"train-shard acc={acc:.4f} model -> pod_model.txt",
+              flush=True)
 
 
 if __name__ == "__main__":

@@ -215,10 +215,34 @@ class Dataset:
         if isinstance(self.data, (str, Path)):
             from .io.binary_io import is_binary_dataset_file, load_binary
             if is_binary_dataset_file(str(self.data)):
+                from .io.dataset_core import _resolve_shard_world
+                if self.reference is None and \
+                        _resolve_shard_world(Config(self.params)) is not None:
+                    log.fatal(
+                        "binary dataset files cannot be shard-ingested "
+                        "(pre_partition=true / tpu_ingest='sharded'): a "
+                        ".bin file is already binned with its own global "
+                        "mappers, so distributed bin finding cannot run "
+                        "and per-host .bin files at the same path would "
+                        "desync the SPMD program — load the raw data "
+                        "with per-rank files ('...{rank}...'), or set "
+                        "tpu_ingest='replicated'")
                 self._binned = load_binary(str(self.data))
                 return self._finish_prebinned()
             cfg = Config(self.params)
             if cfg.two_round:
+                from .io.dataset_core import _resolve_shard_world
+                if self.reference is None and \
+                        _resolve_shard_world(cfg) is not None:
+                    log.fatal(
+                        "two_round=true is incompatible with sharded "
+                        "ingestion (pre_partition=true / "
+                        "tpu_ingest='sharded'): the two-pass streaming "
+                        "loader reads the GLOBAL file on every rank, so "
+                        "the O(rows/world) host-memory contract would "
+                        "not hold — use per-rank files "
+                        "('...{rank}...') without two_round, or set "
+                        "tpu_ingest='replicated'")
                 # streaming two-pass load: bounded memory, binned in place
                 # (ref: dataset_loader.cpp:266 two_round branch)
                 from .io.stream_loader import load_binned_two_round
@@ -227,8 +251,18 @@ class Dataset:
                     categorical_feature=self.categorical_feature,
                     reference=ref_binned)
                 return self._finish_prebinned()
+            from .io.dataset_core import _resolve_shard_world
             from .io.file_loader import load_position_file, load_svm_or_csv
-            X, y, w, grp = load_svm_or_csv(str(self.data), cfg)
+            # shard-load ONLY the training table: datasets built with
+            # reference= (validation sets) take the replicated
+            # construction path, so slicing their file here would
+            # silently hand each rank a different partial valid set
+            sw = (_resolve_shard_world(cfg)
+                  if self.reference is None else None)
+            X, y, w, grp = load_svm_or_csv(
+                str(self.data), cfg,
+                rank=sw[0] if sw else None,
+                world=sw[1] if sw else None)
             if self.label is None:
                 self.label = y
             if self.weight is None:
@@ -236,7 +270,38 @@ class Dataset:
             if self.group is None:
                 self.group = grp
             if self.position is None:
-                self.position = load_position_file(str(self.data))
+                from .io.file_loader import resolve_rank_path
+                ppath, per_rank = resolve_rank_path(
+                    str(self.data), sw[0] if sw else None)
+                self.position = load_position_file(ppath)
+                if (self.position is not None and sw is not None
+                        and not per_rank
+                        and len(self.position) != len(X)):
+                    # shared-file row-slice mode: a full-length
+                    # .position sidecar gets this shard's rows, the
+                    # same treatment the .weight sidecar receives in
+                    # load_svm_or_csv. Cut with the shared shard
+                    # convention and re-check the length: a sidecar
+                    # whose row count disagrees with the data file
+                    # yields a wrong-length slice on at least one rank
+                    # (the slice lengths sum to the sidecar's count,
+                    # the shards to the data file's), so at least one
+                    # rank dies loudly here instead of training on
+                    # shifted positions; its peers then fail their
+                    # first ingest collective within the retry-policy
+                    # deadline (launch_local's watchdog reaps the gang
+                    # immediately)
+                    from .distributed import row_slice
+                    rank, world = sw
+                    lo, hi = row_slice(len(self.position), rank, world)
+                    if hi - lo != len(X):
+                        log.fatal(
+                            f"{ppath}: position sidecar has "
+                            f"{len(self.position)} entries but the data "
+                            f"file's rank {rank}/{world} row slice holds "
+                            f"{len(X)} rows — the sidecar must have "
+                            "exactly one entry per data-file row")
+                    self.position = self.position[lo:hi]
             data, inferred_names = X, None
         elif _is_sequence_input(self.data):
             from .io.sequence import build_from_sequences

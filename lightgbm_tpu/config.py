@@ -42,6 +42,7 @@ _CHOICES: Dict[str, Tuple[str, ...]] = {
     # worked before it existed
     "tpu_packed_bins": ("auto", "true", "false", "1", "0", "yes", "no",
                         "on", "off"),
+    "tpu_ingest": ("auto", "replicated", "sharded"),
 }
 
 
@@ -328,6 +329,20 @@ _reg("tpu_fallback_to_cpu", bool, False, ())
 # utils/jit_cache.enable_persistent_cache by engine.train and the gbdt
 # engine setup.
 _reg("tpu_compile_cache_dir", str, "", ())
+# sharded ingestion (io/dataset_core.py): how the training table is
+# loaded in a multi-process (multi-host) world. "replicated" = every
+# process passes the GLOBAL table (the pre-round-7 behavior; host RAM
+# per process scales with the pod's total rows). "sharded" = every
+# process passes only ITS row shard: bin boundaries are found
+# distributed (per-shard sample summaries + feature-sliced find_bin +
+# BinMapper allgather, ≡ dataset_loader.cpp:1175-1260 pre-partition),
+# each host bins only its rows, and the device array is assembled from
+# the process-local shards — host memory per process is O(rows/world).
+# "auto" = sharded when pre_partition=true and a multi-process world is
+# up, replicated otherwise. Trees are bit-identical to replicated/
+# single-process training under use_quantized_grad=true (exact int32
+# histogram accumulation); requires tree_learner=data or voting.
+_reg("tpu_ingest", str, "auto", ())
 # phase-tagged heartbeat file (robustness/heartbeat.py): when set (or
 # when a supervisor exports LGBM_TPU_HEARTBEAT), the training loop
 # writes crash-safe liveness beats (compiling / iter N) and starts the
@@ -503,8 +518,6 @@ _REDIRECTED_PARAMS = {
     "force_row_wise": "see force_col_wise",
     "is_enable_sparse": "sparse inputs (scipy) are detected and binned "
                         "column-wise automatically; EFB handles bundling",
-    "pre_partition": "row sharding over the mesh is automatic "
-                     "(tree_learner=data/voting)",
     "precise_float_parser": "the native parser always uses full-precision "
                             "strtod",
     "parser_config_file": "parser plugins are not supported; CSV/TSV/"

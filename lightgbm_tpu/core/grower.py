@@ -340,6 +340,14 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     optional (const [F], per_count [F]) penalty pair — CEGB's DeltaGain as
     penalty[f] = const[f] + per_count[f] * num_data_in_leaf.
 
+    The row axis R is a LAYOUT contract, not a semantic one: callers may
+    pad or permute rows freely (mesh padding; sharded ingestion's
+    per-process regions, models/gbdt._setup_distributed) as long as
+    padded slots carry gh = (0, 0, 0) — zero-mass rows are invisible to
+    histograms, root sums and counts (exactly so under quantized int32
+    accumulation; to f32 reduction order otherwise), and ``leaf_id`` is
+    returned in whatever row order ``bins_t``/``gh`` used.
+
     ``forced`` bakes a forced-split prefix into the program
     (ref: SerialTreeLearner::ForceSplits serial_tree_learner.cpp:560):
     (active [L-1] bool, slot [L-1], feature [L-1], threshold_bin [L-1])

@@ -586,7 +586,9 @@ def make_level_grower(cfg: GrowerConfig, meta: FeatureMeta, bundle=None):
     ``(TreeArrays, leaf_id)`` over row-major uint8/16 bins [R, F]
     ([R, G] physical groups when ``bundle`` is set) — the pure level
     mode for max_depth in [1, MAX_LEVEL_DEPTH]. Unbounded/deeper
-    configs go through core/hybrid_grower.make_hybrid_grower."""
+    configs go through core/hybrid_grower.make_hybrid_grower. The row
+    axis follows make_tree_grower's layout contract (pad/permute freely
+    with gh = 0 on pad slots; sharded ingestion relies on it)."""
     L = int(cfg.num_leaves)
     D = int(cfg.max_depth)
     if not (1 <= D <= MAX_LEVEL_DEPTH):

@@ -111,6 +111,69 @@ def process_index() -> int:
     return jax.process_index()
 
 
+def feature_slice(num_features: int, rank: int, world: int
+                  ) -> "tuple[int, int]":
+    """Contiguous feature-slice ownership for distributed bin finding
+    (ref: dataset_loader.cpp:1175-1185 — ``num_total_features /
+    num_machines`` blocks, remainder on the early ranks here via the
+    ceiling step). Every feature belongs to exactly one rank, including
+    ragged ``num_features % world != 0`` (late ranks may own an empty
+    slice). Returns ``[lo, hi)``."""
+    if world <= 1:
+        return 0, num_features
+    step = max((num_features + world - 1) // world, 1)
+    lo = min(rank * step, num_features)
+    return lo, min(lo + step, num_features)
+
+
+def row_slice(num_rows: int, rank: int, world: int) -> "tuple[int, int]":
+    """Contiguous row-shard ownership ``[lo, hi)`` over a global table
+    of ``num_rows`` — THE shard-boundary convention of sharded
+    ingestion. Every place that cuts the global table (shared-file
+    slice loading, sidecar slicing, the ingest bench gang, the
+    robustness workers) must use this exact math: the training table is
+    the rank-order concatenation of the slices, and the bit-identity
+    contract depends on all cutters agreeing. Slices partition the rows
+    exactly (late ranks may be one row larger on ragged counts)."""
+    if world <= 1:
+        return 0, num_rows
+    return rank * num_rows // world, (rank + 1) * num_rows // world
+
+
+def allgather_bytes(blob: bytes, what: str = "allgather_bytes") -> list:
+    """Allgather variable-length byte blobs across the process world —
+    the transport of the distributed bin-finding protocol (sample
+    summaries out, serialized BinMappers back; ≡ Network::Allgather of
+    the size-prefixed buffers in dataset_loader.cpp:1221-1260).
+
+    Two fixed-shape ``process_allgather`` rounds (lengths, then padded
+    payloads), each driven through ``retried_collective`` so transport
+    flakiness — injected via the LGBM_TPU_FAULTS ``collective`` class or
+    real — is retried under the shared bounded COLLECTIVE_POLICY.
+    Returns the per-rank blobs in rank order; a world of one returns
+    ``[blob]`` without touching the backend."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return [blob]
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    def _gather(a):
+        return np.asarray(multihost_utils.process_allgather(a))
+
+    arr = np.frombuffer(blob, np.uint8)
+    lens = retried_collective(
+        _gather, np.asarray([arr.size], np.int64),
+        what=f"{what} (lengths)").reshape(-1)
+    buf = np.zeros(max(int(lens.max()), 1), np.uint8)
+    buf[:arr.size] = arr
+    gathered = retried_collective(_gather, buf,
+                                  what=f"{what} (payload)")
+    return [gathered[r, :int(lens[r])].tobytes()
+            for r in range(len(lens))]
+
+
 # ---------------------------------------------------------------------------
 # Launcher convenience layer (the Dask-analog UX).
 #
@@ -170,6 +233,16 @@ def init_from_env() -> int:
             ).strip()
         import jax
         jax.config.update("jax_platforms", "cpu")
+        # the default CPU backend refuses multi-process computations
+        # ("Multiprocess computations aren't implemented on the CPU
+        # backend"); gloo collectives make the hardware-free rehearsal
+        # world real. Best-effort: jaxlibs without gloo keep the old
+        # behavior (and the old error)
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception as e:  # noqa: BLE001 — config absent/renamed
+            log.debug(f"could not select gloo CPU collectives: {e}")
     if coord is None:
         try:
             return init_distributed()     # jax auto-detection
@@ -183,18 +256,14 @@ def init_from_env() -> int:
         process_id=int(os.environ[ENV_PROCESS_ID]))
 
 
-def launch_local(argv: Sequence[str], num_processes: int,
-                 coordinator_port: Optional[int] = None,
-                 cpu_devices_per_process: int = 0,
-                 timeout: float = 600.0) -> list:
-    """Spawn ``num_processes`` copies of ``argv`` on THIS machine, wired
-    into one distributed world (the local analog of spawn-per-host; the
-    per-host version is the same env contract under any real launcher).
-
-    Returns ``[(returncode, combined_output), ...]`` per rank. Kills the
-    whole gang on timeout so a hung rank cannot leak claim-holding
-    children.
-    """
+def spawn_local(argv: Sequence[str], num_processes: int,
+                coordinator_port: Optional[int] = None,
+                cpu_devices_per_process: int = 0,
+                env_extra: Optional[dict] = None) -> list:
+    """Spawn the gang and return the live ``subprocess.Popen`` handles
+    (rank order). The building block under ``launch_local`` — exposed so
+    supervised callers (the ingest bench, the kill-and-relaunch
+    robustness test) can watch, kill or relaunch individual ranks."""
     import socket
     import subprocess
     if coordinator_port is None:
@@ -208,9 +277,32 @@ def launch_local(argv: Sequence[str], num_processes: int,
                          cpu_devices_per_process=cpu_devices_per_process)
         if cpu_devices_per_process:
             env.pop("XLA_FLAGS", None)    # worker rebuilds it itself
+        if env_extra:
+            env.update({k: str(v) for k, v in env_extra.items()})
         procs.append(subprocess.Popen(
             list(argv), env=env, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True))
+    return procs
+
+
+def launch_local(argv: Sequence[str], num_processes: int,
+                 coordinator_port: Optional[int] = None,
+                 cpu_devices_per_process: int = 0,
+                 timeout: float = 600.0,
+                 env_extra: Optional[dict] = None) -> list:
+    """Spawn ``num_processes`` copies of ``argv`` on THIS machine, wired
+    into one distributed world (the local analog of spawn-per-host; the
+    per-host version is the same env contract under any real launcher).
+
+    Returns ``[(returncode, combined_output), ...]`` per rank. Kills the
+    whole gang on timeout so a hung rank cannot leak claim-holding
+    children.
+    """
+    import subprocess
+    procs = spawn_local(argv, num_processes,
+                        coordinator_port=coordinator_port,
+                        cpu_devices_per_process=cpu_devices_per_process,
+                        env_extra=env_extra)
     results = []
     try:
         for p in procs:
@@ -254,9 +346,14 @@ def inject_collectives(reduce_sum, reduce_max=None, rank: int = 0,
     decorrelates per-worker RNG (stochastic rounding).
 
     Rows must be pre-partitioned across workers and bin boundaries
-    shared (build each worker's Dataset with ``reference=`` or the same
-    forcedbins file) — the same contract as the reference's
-    pre_partition=true external-collective mode.
+    shared — the same contract as the reference's pre_partition=true
+    external-collective mode. Inside a jax.distributed world the
+    sharded-ingestion path (``pre_partition=true`` /
+    ``tpu_ingest="sharded"``, io/dataset_core.py) finds globally
+    consistent bins from per-shard samples automatically; with
+    user-owned transport (this injection, no jax world) share bins by
+    building each worker's Dataset with ``reference=`` or the same
+    forcedbins file.
     """
     global _injected
     if not callable(reduce_sum):

@@ -60,6 +60,11 @@ def _mapper_from_dict(d: dict) -> BinMapper:
 
 def save_binary(ds: BinnedDataset, path: str) -> None:
     """Write a constructed BinnedDataset to `path` (ref: dataset.h:710)."""
+    if getattr(ds, "shard", None) is not None:
+        # local bins + global metadata would silently persist a torn
+        # table; the binary format is a replicated-ingestion feature
+        log.fatal("save_binary is not supported on a sharded-ingest "
+                  "dataset (each host holds only its row shard)")
     if ds.bins is None and getattr(ds, "bins_grouped", None) is not None:
         # binary format carries logical bins; reconstruct once (exact up
         # to EFB conflict rows — the values training saw)

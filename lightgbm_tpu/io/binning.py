@@ -17,6 +17,7 @@ device. Semantics follow the reference:
 from __future__ import annotations
 
 import math
+import struct
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -84,6 +85,121 @@ def merge_distinct(sorted_vals: np.ndarray,
         reps = np.concatenate([reps, [0.0]])
         ct = np.concatenate([ct, [zero_cnt]])
     return reps, ct
+
+
+# ---------------------------------------------------------------------------
+# Mergeable per-feature sample summaries (distributed bin finding).
+#
+# The SPMD translation of the reference's pre-partition bin sync
+# (ref: src/io/dataset_loader.cpp:1175-1219): each process samples only
+# ITS row shard, summarizes every feature's sample into one of these,
+# and the summaries — not the rows — go over the wire. A rank that owns
+# a feature slice merges the world's summaries for its features and runs
+# the ordinary find_bin over the merged result; because merging is exact
+# multiset union, the merged summary of per-shard samples is identical
+# to the summary of the concatenated global sample.
+# ---------------------------------------------------------------------------
+
+
+class FeatureSampleSummary:
+    """Compact, mergeable summary of one feature's sampled values.
+
+    Stores the sorted NONZERO non-NaN values plus counts of exact zeros
+    and NaNs — on sparse/Criteo-shaped columns the wire payload is
+    O(nnz in sample), not O(sample). ``sorted_non_na()`` reconstructs
+    the exact ascending array ``np.sort`` of the raw sample would give
+    (zeros re-inserted between the negative and positive runs; −0.0
+    normalizes to +0.0, which every downstream comparison treats
+    identically), so bin finding over a summary is bit-identical to bin
+    finding over the raw sample.
+    """
+
+    __slots__ = ("values", "zero_cnt", "na_cnt", "n_rows")
+
+    def __init__(self, values: np.ndarray, zero_cnt: int, na_cnt: int,
+                 n_rows: int):
+        self.values = np.asarray(values, np.float64)
+        self.zero_cnt = int(zero_cnt)
+        self.na_cnt = int(na_cnt)
+        self.n_rows = int(n_rows)
+
+    @classmethod
+    def from_sample(cls, sample_values: np.ndarray
+                    ) -> "FeatureSampleSummary":
+        vals = np.asarray(sample_values, np.float64).reshape(-1)
+        nan_mask = np.isnan(vals)
+        non_na = vals[~nan_mask]
+        nz = non_na[non_na != 0.0]
+        return cls(np.sort(nz, kind="stable"),
+                   zero_cnt=len(non_na) - len(nz),
+                   na_cnt=int(nan_mask.sum()), n_rows=len(vals))
+
+    @classmethod
+    def merge(cls, summaries: Sequence["FeatureSampleSummary"]
+              ) -> "FeatureSampleSummary":
+        """Exact multiset union: merging per-shard summaries yields the
+        summary of the concatenated global sample."""
+        if not summaries:
+            return cls(np.zeros(0, np.float64), 0, 0, 0)
+        vals = np.sort(np.concatenate([s.values for s in summaries]),
+                       kind="stable")
+        return cls(vals,
+                   zero_cnt=sum(s.zero_cnt for s in summaries),
+                   na_cnt=sum(s.na_cnt for s in summaries),
+                   n_rows=sum(s.n_rows for s in summaries))
+
+    def sorted_non_na(self) -> np.ndarray:
+        """Ascending non-NaN sample values with the zero run restored."""
+        if not self.zero_cnt:
+            return self.values
+        cut = int(np.searchsorted(self.values, 0.0, side="left"))
+        return np.concatenate([self.values[:cut],
+                               np.zeros(self.zero_cnt, np.float64),
+                               self.values[cut:]])
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, FeatureSampleSummary):
+            return NotImplemented
+        return (self.zero_cnt == other.zero_cnt and
+                self.na_cnt == other.na_cnt and
+                self.n_rows == other.n_rows and
+                np.array_equal(self.values, other.values))
+
+
+_SUMMARY_MAGIC = b"LGSS"     # + u16 version
+
+
+def serialize_summaries(summaries: Sequence[FeatureSampleSummary]
+                        ) -> bytes:
+    """Wire encoding of a rank's per-feature summaries (explicit binary,
+    f64-exact; no pickle so the wire contract cannot drift with class
+    internals)."""
+    parts = [_SUMMARY_MAGIC, struct.pack("<HI", 1, len(summaries))]
+    for s in summaries:
+        parts.append(struct.pack("<qqqq", len(s.values), s.zero_cnt,
+                                 s.na_cnt, s.n_rows))
+        parts.append(np.ascontiguousarray(s.values, np.float64)
+                     .tobytes())
+    return b"".join(parts)
+
+
+def deserialize_summaries(blob: bytes) -> List[FeatureSampleSummary]:
+    if blob[:4] != _SUMMARY_MAGIC:
+        raise ValueError("bad sample-summary wire blob (magic mismatch)")
+    ver, n = struct.unpack_from("<HI", blob, 4)
+    if ver != 1:
+        raise ValueError(f"unsupported sample-summary wire version {ver}")
+    off = 10
+    out = []
+    for _ in range(n):
+        n_vals, zero_cnt, na_cnt, n_rows = struct.unpack_from(
+            "<qqqq", blob, off)
+        off += 32
+        vals = np.frombuffer(blob, np.float64, count=n_vals,
+                             offset=off).copy()
+        off += 8 * n_vals
+        out.append(FeatureSampleSummary(vals, zero_cnt, na_cnt, n_rows))
+    return out
 
 
 def greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
@@ -300,28 +416,53 @@ class BinMapper:
         present in the full data are assumed zero (sparse convention), which
         is why ``total_sample_cnt`` can exceed ``len(sample_values)``.
         """
+        return cls.find_bin_from_summary(
+            FeatureSampleSummary.from_sample(sample_values),
+            total_sample_cnt, max_bin, min_data_in_bin, min_split_data,
+            pre_filter=pre_filter, bin_type=bin_type,
+            use_missing=use_missing, zero_as_missing=zero_as_missing,
+            forced_upper_bounds=forced_upper_bounds)
+
+    @classmethod
+    def find_bin_from_summary(cls, summary: FeatureSampleSummary,
+                              total_sample_cnt: int,
+                              max_bin: int, min_data_in_bin: int,
+                              min_split_data: int,
+                              pre_filter: bool = True,
+                              bin_type: str = BIN_NUMERICAL,
+                              use_missing: bool = True,
+                              zero_as_missing: bool = False,
+                              forced_upper_bounds: Sequence[float] = ()
+                              ) -> "BinMapper":
+        """find_bin over a (possibly merged multi-rank) sample summary.
+
+        Bit-identical to ``find_bin`` on the raw sample the summary came
+        from; with per-shard summaries merged via
+        ``FeatureSampleSummary.merge``, bit-identical to ``find_bin`` on
+        the concatenated global sample — the exactness contract of
+        distributed bin finding.
+        """
         self = cls()
-        values = np.asarray(sample_values, dtype=np.float64)
-        non_na = values[~np.isnan(values)]
+        sorted_vals = summary.sorted_non_na()
+        non_na_cnt = len(sorted_vals)
         na_cnt = 0
         if not use_missing:
             self.missing_type = MISSING_NONE
         elif zero_as_missing:
             self.missing_type = MISSING_ZERO
         else:
-            if len(non_na) == len(values):
+            if summary.na_cnt == 0:
                 self.missing_type = MISSING_NONE
             else:
                 self.missing_type = MISSING_NAN
-                na_cnt = len(values) - len(non_na)
+                na_cnt = summary.na_cnt
 
         self.bin_type = bin_type
         self.default_bin = 0
-        zero_cnt = int(total_sample_cnt - len(non_na) - na_cnt)
+        zero_cnt = int(total_sample_cnt - non_na_cnt - na_cnt)
 
         # distinct values with zero merged at |v| <= kZeroThreshold,
         # ulp-adjacent values merged (ref: bin.cpp:360-390)
-        sorted_vals = np.sort(non_na, kind="stable")
         dv, ct = merge_distinct(sorted_vals, zero_cnt)
         self.min_val = float(dv[0])
         self.max_val = float(dv[-1])
@@ -467,3 +608,83 @@ class BinMapper:
                 np.array_equal(self.bin_upper_bound, other.bin_upper_bound,
                                equal_nan=True) and
                 self.bin_2_categorical == other.bin_2_categorical)
+
+    # ------------------------------------------------------------------
+    # Wire (de)serialization — the payload of the distributed bin-
+    # finding allgather (≡ BinMapper::CopyTo/CopyFrom riding
+    # Network::Allgather, ref: dataset_loader.cpp:1221-1260). Explicit
+    # versioned binary, f64-bit-exact; deliberately NOT pickle so the
+    # wire contract cannot drift with class internals.
+    # ------------------------------------------------------------------
+
+    def to_wire(self) -> bytes:
+        bub = np.ascontiguousarray(self.bin_upper_bound, np.float64)
+        cats = np.asarray(self.bin_2_categorical, np.int64)
+        head = struct.pack(
+            "<iBBBiidddqq", self.num_bin,
+            _MISSING_CODE[self.missing_type],
+            _BIN_TYPE_CODE[self.bin_type], int(self.is_trivial),
+            self.default_bin, self.most_freq_bin,
+            float(self.sparse_rate), float(self.min_val),
+            float(self.max_val), len(bub), len(cats))
+        return head + bub.tobytes() + cats.tobytes()
+
+    @classmethod
+    def from_wire(cls, blob: bytes, offset: int = 0
+                  ) -> Tuple["BinMapper", int]:
+        """Decode one mapper starting at ``offset``; returns
+        (mapper, offset past it)."""
+        head_fmt = "<iBBBiidddqq"
+        (num_bin, miss, btype, trivial, default_bin, most_freq,
+         sparse_rate, min_val, max_val, n_bub, n_cat) = \
+            struct.unpack_from(head_fmt, blob, offset)
+        offset += struct.calcsize(head_fmt)
+        self = cls()
+        self.num_bin = num_bin
+        self.missing_type = _MISSING_FROM_CODE[miss]
+        self.bin_type = _BIN_TYPE_FROM_CODE[btype]
+        self.is_trivial = bool(trivial)
+        self.default_bin = default_bin
+        self.most_freq_bin = most_freq
+        self.sparse_rate = sparse_rate
+        self.min_val = min_val
+        self.max_val = max_val
+        self.bin_upper_bound = np.frombuffer(
+            blob, np.float64, count=n_bub, offset=offset).copy()
+        offset += 8 * n_bub
+        cats = np.frombuffer(blob, np.int64, count=n_cat,
+                             offset=offset)
+        offset += 8 * n_cat
+        self.bin_2_categorical = [int(c) for c in cats]
+        self.categorical_2_bin = {c: b for b, c in
+                                  enumerate(self.bin_2_categorical)}
+        return self, offset
+
+
+_MISSING_CODE = {MISSING_NONE: 0, MISSING_ZERO: 1, MISSING_NAN: 2}
+_MISSING_FROM_CODE = {v: k for k, v in _MISSING_CODE.items()}
+_BIN_TYPE_CODE = {BIN_NUMERICAL: 0, BIN_CATEGORICAL: 1}
+_BIN_TYPE_FROM_CODE = {v: k for k, v in _BIN_TYPE_CODE.items()}
+
+_MAPPER_MAGIC = b"LGBM"      # + u16 version
+
+
+def serialize_bin_mappers(mappers: Sequence[BinMapper]) -> bytes:
+    """One rank's feature-slice mappers as a wire blob."""
+    parts = [_MAPPER_MAGIC, struct.pack("<HI", 1, len(mappers))]
+    parts.extend(m.to_wire() for m in mappers)
+    return b"".join(parts)
+
+
+def deserialize_bin_mappers(blob: bytes) -> List[BinMapper]:
+    if blob[:4] != _MAPPER_MAGIC:
+        raise ValueError("bad BinMapper wire blob (magic mismatch)")
+    ver, n = struct.unpack_from("<HI", blob, 4)
+    if ver != 1:
+        raise ValueError(f"unsupported BinMapper wire version {ver}")
+    off = 10
+    out = []
+    for _ in range(n):
+        m, off = BinMapper.from_wire(blob, off)
+        out.append(m)
+    return out

@@ -13,6 +13,7 @@ array (SURVEY.md §7 arch sketch #1).
 """
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -20,7 +21,154 @@ import numpy as np
 from ..config import Config
 from ..utils import log
 from .binning import (BIN_CATEGORICAL, BIN_NUMERICAL, MISSING_NAN,
-                      MISSING_NONE, MISSING_ZERO, BinMapper)
+                      MISSING_NONE, MISSING_ZERO, BinMapper,
+                      FeatureSampleSummary, deserialize_bin_mappers,
+                      deserialize_summaries, serialize_bin_mappers,
+                      serialize_summaries)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardInfo:
+    """Row-shard topology of a sharded-ingest BinnedDataset.
+
+    ``row_counts[r]`` is the number of rows process r holds; the
+    training-visible GLOBAL table is the rank-order concatenation of the
+    shards (so ``num_data`` on a sharded dataset is the global count,
+    while ``bins`` holds only the local shard's columns)."""
+
+    rank: int
+    world: int
+    row_counts: np.ndarray        # int64 [world]
+
+    @property
+    def local_num_data(self) -> int:
+        return int(self.row_counts[self.rank])
+
+    @property
+    def row_offset(self) -> int:
+        """Global (concatenated-table) index of this shard's first row."""
+        return int(self.row_counts[:self.rank].sum())
+
+
+_SHARD_RESOLVE_LOGGED: set = set()
+
+
+def _log_once(key: str, emit) -> None:
+    """The file path resolves the shard world in ``basic.py`` and again
+    inside ``from_columns`` — same answer, but the loud legacy-config
+    warnings must not print twice per rank."""
+    if key not in _SHARD_RESOLVE_LOGGED:
+        _SHARD_RESOLVE_LOGGED.add(key)
+        emit()
+
+
+def _resolve_shard_world(config: Config) -> Optional[Tuple[int, int]]:
+    """(rank, world) when sharded ingestion should engage, else None.
+
+    ``tpu_ingest="sharded"`` (or ``pre_partition=true`` under the
+    default "auto") in a live multi-process world routes construction
+    through ``_from_columns_sharded``; anything else keeps the
+    replicated path. Requested-but-single-process degrades with an info
+    log (the data already IS the global table)."""
+    ingest = str(config.tpu_ingest).lower()
+    if ingest == "replicated":
+        return None
+    if ingest == "auto" and not config.pre_partition:
+        return None
+    try:
+        import jax
+        world = jax.process_count()
+        rank = jax.process_index()
+    except Exception:  # noqa: BLE001 — no backend: nothing to shard over
+        return None
+    if world <= 1:
+        if ingest == "sharded":
+            _log_once("sharded-world1", lambda: log.info(
+                "tpu_ingest='sharded' requested but the process "
+                "world has size 1; loading replicated"))
+        return None
+    if ingest == "auto":
+        # pre_partition used to be a redirected no-op ("row sharding
+        # over the mesh is automatic") — it now MEANS the reference's
+        # pre-partition contract. Be loud so a legacy config that still
+        # passes the GLOBAL table on every rank cannot silently train
+        # on world-times-duplicated rows.
+        _log_once("auto-engaged", lambda: log.warning(
+            "pre_partition=true now engages SHARDED ingestion: each "
+            "process must pass ONLY ITS OWN row shard (the training "
+            "table is the rank-order concatenation). If every rank "
+            "still loads the global table, set pre_partition=false "
+            "(or tpu_ingest='replicated') — otherwise rows would be "
+            f"duplicated {world}x"))
+    return rank, world
+
+
+def _load_forced_bounds(config: Config) -> Dict[int, List[float]]:
+    """User-forced bin upper bounds (ref: config forcedbins_filename,
+    dataset_loader.cpp DatasetLoader::GetForcedBins JSON format:
+    [{"feature": i, "bin_upper_bound": [..]}, ...])."""
+    forced_bounds: Dict[int, List[float]] = {}
+    if config.forcedbins_filename:
+        import json
+        try:
+            with open(config.forcedbins_filename) as fh:
+                for entry in json.load(fh):
+                    forced_bounds[int(entry["feature"])] = [
+                        float(v) for v in entry["bin_upper_bound"]]
+        except (OSError, ValueError, KeyError, TypeError,
+                IndexError) as e:
+            log.fatal(f"could not read forcedbins_filename="
+                      f"{config.forcedbins_filename}: {e}")
+    return forced_bounds
+
+
+def _used_feature_map(bin_mappers: List[BinMapper]) -> np.ndarray:
+    """Non-trivial original feature indices (logged), shared by the
+    replicated and sharded construction paths."""
+    used = np.asarray([i for i, m in enumerate(bin_mappers)
+                       if not m.is_trivial], dtype=np.int32)
+    n_trivial = len(bin_mappers) - len(used)
+    if n_trivial:
+        log.info(f"{n_trivial} trivial feature(s) removed")
+    return used
+
+
+def _quantize_dense(source: "ColumnSource", bin_mappers: List[BinMapper],
+                    used_feature_map: np.ndarray) -> np.ndarray:
+    """Per-feature ``value_to_bin`` into the feature-major u8/u16
+    matrix — the ONE dense quantization loop. Replicated and sharded
+    construction both call this, so their dtype selection and binning
+    can never drift (the bit-identity contract of sharded ingestion
+    depends on it)."""
+    n_used = len(used_feature_map)
+    max_num_bin = max((bin_mappers[i].num_bin
+                       for i in used_feature_map), default=2)
+    dtype = np.uint8 if max_num_bin <= 256 else np.uint16
+    bins = np.empty((n_used, source.num_data), dtype=dtype)
+    for out_i, feat_i in enumerate(used_feature_map):
+        bins[out_i] = bin_mappers[feat_i].value_to_bin(
+            source.get_col(feat_i))
+    return bins
+
+
+def _allgather_rows(arr: Optional[np.ndarray], dtype,
+                    what: str) -> Optional[np.ndarray]:
+    """Allgather an optional per-row metadata array and concatenate in
+    rank order (the global-table layout). Every rank MUST call this the
+    same number of times (it is a collective); ``None`` everywhere stays
+    None, mixed presence is a configuration error."""
+    from ..distributed import allgather_bytes
+    blob = (np.ascontiguousarray(arr, dtype).tobytes()
+            if arr is not None else b"")
+    parts = allgather_bytes(blob, what=what)
+    present = [len(p) > 0 for p in parts]
+    if not any(present):
+        return None
+    if not all(present):
+        log.fatal(f"{what}: some ranks passed this metadata and some "
+                  "did not — sharded ingestion needs it on every rank "
+                  "(and every shard must be non-empty)")
+    return np.concatenate([np.frombuffer(p, dtype) for p in parts])
 
 
 class ColumnSource:
@@ -200,10 +348,18 @@ class BinnedDataset:
         are excluded.
     bin_mappers : per ORIGINAL feature BinMapper (len == num_total_features).
     used_feature_map : original feature index for each row of ``bins``.
+    shard : ShardInfo or None.
+        Set by sharded ingestion (pre_partition / tpu_ingest="sharded"):
+        ``bins`` then holds only THIS process's ``shard.local_num_data``
+        row columns, while ``num_data`` and ``metadata`` describe the
+        GLOBAL rank-order-concatenated table (labels/weights are
+        allgathered — O(rows) — so the boosting loop stays SPMD; the
+        O(rows × features) table is what never materializes per host).
     """
 
     def __init__(self) -> None:
         self.bins: Optional[np.ndarray] = None
+        self.shard: Optional[ShardInfo] = None
         # multi-value sparse storage: (idx [R, K], binv [R, K]) host
         # arrays over USED features, or None (dense `bins` used instead)
         self.bins_mv: Optional[tuple] = None
@@ -258,6 +414,14 @@ class BinnedDataset:
         zoo (ref: src/io/sparse_bin.hpp, include/LightGBM/arrow.h) — all
         sources quantize into the same feature-major u8/u16 matrix; EFB
         bundling then compresses sparse groups physically."""
+        if reference is None:
+            shard_world = _resolve_shard_world(config)
+            if shard_world is not None:
+                return cls._from_columns_sharded(
+                    source, config, *shard_world, label=label,
+                    weight=weight, group=group, init_score=init_score,
+                    position=position, feature_names=feature_names,
+                    categorical_features=categorical_features)
         num_data, num_features = source.num_data, source.num_features
         self = cls()
         self.num_data = num_data
@@ -346,14 +510,8 @@ class BinnedDataset:
             log.info(f"multi-value sparse bin storage: {n_used} features, "
                      f"K={self.bins_mv[0].shape[1]} max nonzeros/row")
         else:
-            max_num_bin = max((self.bin_mappers[i].num_bin
-                               for i in self.used_feature_map), default=2)
-            dtype = np.uint8 if max_num_bin <= 256 else np.uint16
-            bins = np.empty((n_used, num_data), dtype=dtype)
-            for out_i, feat_i in enumerate(self.used_feature_map):
-                bins[out_i] = self.bin_mappers[feat_i].value_to_bin(
-                    source.get_col(feat_i))
-            self.bins = bins
+            self.bins = _quantize_dense(source, self.bin_mappers,
+                                        self.used_feature_map)
 
         if config.linear_tree:
             raw = source.to_dense_f32()
@@ -370,6 +528,120 @@ class BinnedDataset:
         meta.set_query(group)
         meta.set_init_score(init_score)
         meta.set_position(position)
+        self.metadata = meta
+        return self
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def _from_columns_sharded(cls, source: "ColumnSource", config: Config,
+                              rank: int, world: int,
+                              label=None, weight=None, group=None,
+                              init_score=None, position=None,
+                              feature_names: Optional[List[str]] = None,
+                              categorical_features: Sequence[int] = (),
+                              ) -> "BinnedDataset":
+        """Sharded ingestion: ``source`` holds only THIS process's row
+        shard of the global table (the reference's pre_partition
+        convention, dataset_loader.cpp:1175-1219, in SPMD form).
+
+        Protocol (every step collective, SPMD on all ranks):
+        1. allgather per-rank row counts → the global row layout
+           (rank-order concatenation);
+        2. sample the LOCAL shard only, summarize per feature, allgather
+           the mergeable summaries (O(sample), never O(rows));
+        3. each rank runs find_bin for its disjoint feature slice over
+           the merged world summaries;
+        4. allgather the wire-serialized BinMappers → every rank holds
+           the identical global mapper set;
+        5. each rank bins ITS rows only → ``bins`` is [F_used,
+           local_rows]; per-row metadata (label/weight/..., O(rows)
+           scalars) is allgathered so the boosting loop stays SPMD.
+
+        Host memory for the table is O(rows/world × features); the
+        resulting trees are bit-identical to replicated ingestion under
+        use_quantized_grad=true (exact int32 histogram sums make the
+        shard layout invisible)."""
+        num_data, num_features = source.num_data, source.num_features
+        from ..distributed import allgather_bytes
+
+        counts = allgather_bytes(
+            np.asarray([num_data, num_features], np.int64).tobytes(),
+            what="sharded ingest: row counts")
+        pairs = np.stack([np.frombuffer(b, np.int64) for b in counts])
+        row_counts = np.ascontiguousarray(pairs[:, 0])
+        if not np.all(pairs[:, 1] == num_features):
+            log.fatal(
+                "sharded ingest: feature counts disagree across ranks "
+                f"({pairs[:, 1].tolist()}) — every shard must carry the "
+                "same columns")
+        if np.any(row_counts <= 0):
+            log.fatal("sharded ingest: every process must hold at least "
+                      f"one row (row counts: {row_counts.tolist()})")
+        self = cls()
+        self.shard = ShardInfo(rank=rank, world=world,
+                               row_counts=row_counts)
+        self.num_data = int(row_counts.sum())
+        self.num_total_features = num_features
+        self.max_bin = config.max_bin
+        src_names = source.column_names()
+        self.feature_names = (
+            list(feature_names) if feature_names
+            else src_names if src_names
+            else [f"Column_{i}" for i in range(num_features)])
+        log.info(f"sharded ingest: rank {rank}/{world} holds "
+                 f"{num_data}/{self.num_data} rows")
+
+        if config.linear_tree:
+            log.fatal("linear_tree requires the full raw feature table "
+                      "on every host; it is not supported with sharded "
+                      "ingestion (tpu_ingest='sharded'/pre_partition)")
+
+        self.bin_mappers = cls._find_bin_mappers_sharded(
+            source, config, categorical_features, rank, world, row_counts)
+        self.used_feature_map = _used_feature_map(self.bin_mappers)
+
+        # each host quantizes ITS rows only — the whole point: no
+        # process ever materializes the global [F, N] table. Sharded
+        # storage is dense u8/u16 (EFB/multival conflict scans would
+        # need cross-shard agreement; gated off in the engine).
+        self.bins = _quantize_dense(source, self.bin_mappers,
+                                    self.used_feature_map)
+
+        # global per-row metadata, rank-order concatenated — O(rows)
+        # scalars per host vs the table's O(rows × features)
+        meta = Metadata(self.num_data)
+        lab = _allgather_rows(label, np.float32,
+                              "sharded ingest: label")
+        if lab is not None:
+            meta.set_label(lab)
+        meta.set_weight(_allgather_rows(weight, np.float32,
+                                        "sharded ingest: weight"))
+        meta.set_position(_allgather_rows(position, np.int32,
+                                          "sharded ingest: position"))
+        # query/group sizes: queries must be shard-local (never span two
+        # shards — the same contract as the reference's pre-partitioned
+        # query files); the global boundaries are the concatenation
+        meta.set_query(_allgather_rows(group, np.int64,
+                                       "sharded ingest: group"))
+        isc_local = None
+        if init_score is not None:
+            isc_local = np.ascontiguousarray(
+                init_score, np.float64).reshape(-1)
+            if num_data and len(isc_local) % num_data != 0:
+                log.fatal("Length of init_score must be a multiple of "
+                          "the local shard's num_data")
+        flat = _allgather_rows(isc_local, np.float64,
+                               "sharded ingest: init_score")
+        isc = None
+        if flat is not None:
+            # per-rank blocks are class-major over LOCAL rows; restitch
+            # to class-major over the global concatenated table
+            k = len(flat) // max(self.num_data, 1)
+            offs = np.concatenate([[0], np.cumsum(row_counts * k)])
+            isc = np.concatenate(
+                [flat[offs[r]:offs[r + 1]].reshape(k, -1)
+                 for r in range(world)], axis=1).reshape(-1)
+        meta.set_init_score(isc)
         self.metadata = meta
         return self
 
@@ -407,6 +679,83 @@ class BinnedDataset:
 
     # ------------------------------------------------------------------
     @staticmethod
+    def _find_bin_mappers_sharded(source: "ColumnSource", config: Config,
+                                  categorical_features: Sequence[int],
+                                  rank: int, world: int,
+                                  row_counts: np.ndarray
+                                  ) -> List[BinMapper]:
+        """Distributed bin finding over per-process row shards
+        (ref: dataset_loader.cpp:1175-1260 — sample rows locally,
+        allgather the samples, FindBin on a disjoint feature slice per
+        machine, allgather the serialized BinMappers).
+
+        The wire carries mergeable per-feature sample summaries
+        (io/binning.py FeatureSampleSummary) instead of raw sample rows,
+        and the merged-summary find_bin is bit-identical to find_bin
+        over the concatenated global sample — so when the sample covers
+        every row (N <= bin_construct_sample_cnt) the mappers are
+        bit-identical to single-process binning of the whole table."""
+        from ..distributed import allgather_bytes, feature_slice
+        num_data, num_features = source.num_data, source.num_features
+        total_rows = int(row_counts.sum())
+        want = min(config.bin_construct_sample_cnt, total_rows)
+        if want >= total_rows:
+            sample_indices = np.arange(num_data)
+        else:
+            # proportional share of the global sample budget, decorrelated
+            # per rank (each shard samples only its own rows)
+            cnt_r = min(num_data,
+                        max(1, int(round(want * num_data
+                                         / max(total_rows, 1)))))
+            rng = np.random.default_rng(config.data_random_seed + rank)
+            sample_indices = np.sort(rng.choice(
+                num_data, size=cnt_r, replace=False))
+
+        summaries = [
+            FeatureSampleSummary.from_sample(
+                source.get_col_sample(f, sample_indices))
+            for f in range(num_features)]
+        world_blobs = allgather_bytes(
+            serialize_summaries(summaries),
+            what="sharded bin finding: sample summaries")
+        world_summaries = [deserialize_summaries(b) for b in world_blobs]
+        if num_features:
+            total_sample = sum(ws[0].n_rows for ws in world_summaries)
+        else:
+            total_sample = len(sample_indices)
+
+        cat_set = set(int(c) for c in categorical_features)
+        forced_bounds = _load_forced_bounds(config)
+        filter_cnt = int(max(
+            config.min_data_in_leaf * total_sample / max(total_rows, 1),
+            config.min_data_in_bin))
+        max_bin_by_feature = config.max_bin_by_feature
+
+        f_lo, f_hi = feature_slice(num_features, rank, world)
+        local = []
+        for f in range(f_lo, f_hi):
+            merged = FeatureSampleSummary.merge(
+                [ws[f] for ws in world_summaries])
+            mb = (max_bin_by_feature[f] if f < len(max_bin_by_feature)
+                  else config.max_bin)
+            local.append(BinMapper.find_bin_from_summary(
+                merged, total_sample, mb, config.min_data_in_bin,
+                filter_cnt, pre_filter=config.feature_pre_filter,
+                bin_type=(BIN_CATEGORICAL if f in cat_set
+                          else BIN_NUMERICAL),
+                use_missing=config.use_missing,
+                zero_as_missing=config.zero_as_missing,
+                forced_upper_bounds=forced_bounds.get(f, ())))
+
+        blobs = allgather_bytes(
+            serialize_bin_mappers(local),
+            what="sharded bin finding: BinMapper allgather")
+        mappers = [m for b in blobs for m in deserialize_bin_mappers(b)]
+        assert len(mappers) == num_features
+        return mappers
+
+    # ------------------------------------------------------------------
+    @staticmethod
     def _find_bin_mappers(source: "ColumnSource", config: Config,
                           categorical_features: Sequence[int],
                           sample_indices: Optional[np.ndarray] = None,
@@ -431,22 +780,7 @@ class BinnedDataset:
             else:
                 sample_indices = np.arange(num_data)
         cat_set = set(int(c) for c in categorical_features)
-
-        # user-forced bin upper bounds (ref: config forcedbins_filename,
-        # dataset_loader.cpp DatasetLoader::GetForcedBins JSON format:
-        # [{"feature": i, "bin_upper_bound": [..]}, ...])
-        forced_bounds: Dict[int, List[float]] = {}
-        if config.forcedbins_filename:
-            import json
-            try:
-                with open(config.forcedbins_filename) as fh:
-                    for entry in json.load(fh):
-                        forced_bounds[int(entry["feature"])] = [
-                            float(v) for v in entry["bin_upper_bound"]]
-            except (OSError, ValueError, KeyError, TypeError,
-                    IndexError) as e:
-                log.fatal(f"could not read forcedbins_filename="
-                          f"{config.forcedbins_filename}: {e}")
+        forced_bounds = _load_forced_bounds(config)
 
         # pre-filter needs the split constraint (ref: dataset_loader.cpp
         # filter_cnt computation)
@@ -467,11 +801,8 @@ class BinnedDataset:
                 rank = jax.process_index()
             except Exception:
                 n_proc = 1
-        f_lo, f_hi = 0, num_features
-        if n_proc > 1:
-            step = max((num_features + n_proc - 1) // n_proc, 1)
-            f_lo = min(rank * step, num_features)
-            f_hi = min(f_lo + step, num_features)
+        from ..distributed import feature_slice
+        f_lo, f_hi = feature_slice(num_features, rank, n_proc)
 
         max_bin_by_feature = config.max_bin_by_feature
 
@@ -489,23 +820,16 @@ class BinnedDataset:
 
         local = [_bin_one(f) for f in range(f_lo, f_hi)]
         if n_proc > 1:
-            # allgather the per-slice mappers (≡ Network::Allgather of the
-            # serialized BinMappers, dataset_loader.cpp:1221-1260)
-            import pickle
-
-            from jax.experimental import multihost_utils
-
-            blob = np.frombuffer(pickle.dumps(local), np.uint8)
-            lens = np.asarray(multihost_utils.process_allgather(
-                np.asarray([blob.size], np.int64))).reshape(-1)
-            buf = np.zeros(int(lens.max()), np.uint8)
-            buf[:blob.size] = blob
-            gathered = np.asarray(
-                multihost_utils.process_allgather(buf))
-            mappers = []
-            for r in range(n_proc):
-                mappers.extend(pickle.loads(
-                    gathered[r, :int(lens[r])].tobytes()))
+            # allgather the per-slice mappers on the explicit wire format
+            # (≡ Network::Allgather of the serialized BinMappers,
+            # dataset_loader.cpp:1221-1260), retried under the shared
+            # collective policy
+            from ..distributed import allgather_bytes
+            blobs = allgather_bytes(
+                serialize_bin_mappers(local),
+                what="distributed bin finding: BinMapper allgather")
+            mappers = [m for b in blobs
+                       for m in deserialize_bin_mappers(b)]
             assert len(mappers) == num_features
         else:
             mappers = local
@@ -559,6 +883,10 @@ class BinnedDataset:
 
     def subset(self, row_indices: np.ndarray) -> "BinnedDataset":
         """Row-subset copy (ref: Dataset::CopySubrow) — used by cv()."""
+        if self.shard is not None:
+            log.fatal("subset() needs the full table; it is not "
+                      "supported on a sharded-ingest dataset (cv/"
+                      "Dataset.subset require replicated ingestion)")
         out = BinnedDataset()
         out.bins = self.bins[:, row_indices] if self.bins is not None else None
         if self.bins_grouped is not None:

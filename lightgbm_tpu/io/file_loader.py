@@ -54,7 +54,21 @@ def register_parser(detect, parse) -> None:
     _PARSER_PLUGINS.append((detect, parse))
 
 
-def load_svm_or_csv(path: str, config: Config
+def resolve_rank_path(path: str, rank: Optional[int]
+                      ) -> Tuple[str, bool]:
+    """Per-rank file convention for sharded ingestion: a ``{rank}``
+    placeholder in the data path names each process's own shard file
+    (≡ the reference's pre-partitioned per-machine files,
+    docs/Parallel-Learning-Guide.rst pre_partition). Returns the
+    resolved path and whether a substitution happened."""
+    if rank is not None and "{rank}" in path:
+        return path.replace("{rank}", str(rank)), True
+    return path, False
+
+
+def load_svm_or_csv(path: str, config: Config,
+                    rank: Optional[int] = None,
+                    world: Optional[int] = None,
                     ) -> Tuple[np.ndarray, Optional[np.ndarray],
                                Optional[np.ndarray], Optional[np.ndarray]]:
     """Load a data file -> (X, label, weight, group).
@@ -62,7 +76,17 @@ def load_svm_or_csv(path: str, config: Config
     Also reads LightGBM-convention side files: ``<file>.weight``,
     ``<file>.query`` / ``<file>.group``, ``<file>.position``
     (ref: metadata.cpp Metadata::Init loading weight/query files).
+
+    Sharded ingestion (``rank``/``world`` set): with a ``{rank}``
+    placeholder in ``path`` each process loads only its own shard file;
+    without one, each process parses only its contiguous slice of the
+    shared file's data rows — the parsed float matrix (the memory hog)
+    is O(rows/world), though the raw text lines are still read once
+    per process (per-rank files or two_round streaming avoid that too).
     """
+    path, per_rank_file = resolve_rank_path(path, rank)
+    slice_shard = (not per_rank_file and rank is not None
+                   and world is not None and world > 1)
     if not os.path.exists(path):
         log.fatal(f"Data file {path} does not exist")
     with open(path) as f:
@@ -73,6 +97,10 @@ def load_svm_or_csv(path: str, config: Config
 
     for detect, parse in _PARSER_PLUGINS:
         if detect(path, lines[:20]):
+            if slice_shard:
+                log.fatal("parser plugins do not support row-slice "
+                          "sharding; use per-rank files "
+                          "('...{rank}...') instead")
             X, y = parse(lines)
             X = np.asarray(X, np.float64)
             y = None if y is None else np.asarray(y, np.float64)
@@ -86,6 +114,55 @@ def load_svm_or_csv(path: str, config: Config
         sep = "," if fmt == "csv" else "\t"
         header_names = [t.strip() for t in lines[0].split(sep)]
         start = 1
+
+    slice_rows: Optional[Tuple[int, int]] = None
+    ncol_floor = 0
+    n_all_rows = len(lines) - start
+    if slice_shard:
+        from ..distributed import allgather_bytes, row_slice
+
+        # shared-file contract agreement: the reference's OTHER
+        # pre_partition convention is a per-MACHINE file at the same
+        # path (each host's local file already holds only its own rows
+        # — Parallel-Learning-Guide.rst). Row-slicing such files would
+        # silently train on a 1/world mosaic of every host's shard, and
+        # the downstream row/feature-count agreement cannot tell (the
+        # per-rank slice counts are EXPECTED to differ). So agree on a
+        # sampled content digest before slicing and die loudly when the
+        # ranks' bytes differ.
+        import zlib
+        digest = zlib.crc32(str(n_all_rows).encode())
+        step = max(1, n_all_rows // 64)
+        for i in range(0, n_all_rows, step):
+            digest = zlib.crc32(lines[start + i].encode(), digest)
+        got = allgather_bytes(digest.to_bytes(4, "big"),
+                              what="shared-file content agreement")
+        if any(b != got[0] for b in got):
+            log.fatal(
+                f"{path}: file contents differ across ranks — this "
+                "looks like per-machine pre-partitioned files at the "
+                "same path. Row-slice sharding requires one IDENTICAL "
+                "shared file on every rank; for per-host files use the "
+                "'{rank}' placeholder ('data_{rank}.csv') so each "
+                "process loads its own shard whole")
+        lo, hi = row_slice(n_all_rows, rank, world)
+        slice_rows = (lo, hi)
+        if fmt == "libsvm":
+            # per-shard max feature index can differ; the column count
+            # must be agreed globally, which slice loading cannot do
+            log.fatal("LibSVM files cannot be row-slice sharded (the "
+                      "feature count is inferred per slice); use "
+                      "per-rank files ('...{rank}...') or CSV/TSV")
+        else:
+            # ragged CSV/TSV (rows omitting trailing empty fields):
+            # agree the column count over the WHOLE file before
+            # slicing — a slice-local max would make ranks disagree on
+            # num_features and kill the gang at the agreement
+            # allgather. All lines are already in memory, so this scan
+            # costs no extra I/O.
+            sep = "," if fmt == "csv" else "\t"
+            ncol_floor = max(ln.count(sep) for ln in lines[start:]) + 1
+        lines = lines[:start] + lines[start + lo:start + hi]
 
     label_spec = config.label_column or "0"
     weight_col = (_parse_column_spec(config.weight_column, header_names)
@@ -106,7 +183,7 @@ def load_svm_or_csv(path: str, config: Config
     else:
         sep = "," if fmt == "csv" else "\t"
         rows = [ln.split(sep) for ln in lines[start:]]
-        ncol = max(len(r) for r in rows)
+        ncol = max([ncol_floor] + [len(r) for r in rows])
         mat = np.full((len(rows), ncol), np.nan)
         for i, r in enumerate(rows):
             for j, tok in enumerate(r):
@@ -129,7 +206,29 @@ def load_svm_or_csv(path: str, config: Config
         keep = [j for j in range(ncol) if j not in drop]
         X = mat[:, keep]
 
+    inline_weight = weight is not None
     weight, group = load_side_files(path, weight, group_raw)
+    if slice_rows is not None:
+        lo, hi = slice_rows
+        if weight is not None and not inline_weight:
+            # full-length sidecar weight file: take this shard's rows.
+            # Any other length is fatal — a per-shard-sized sidecar
+            # next to the shared file would hand every rank the SAME
+            # weights for DIFFERENT rows, and the allgathered total
+            # would still pass the downstream length check.
+            if len(weight) != n_all_rows:
+                log.fatal(
+                    f"{path}.weight: sidecar has {len(weight)} entries "
+                    f"but the shared data file has {n_all_rows} rows — "
+                    "in row-slice sharded mode the sidecar must hold "
+                    "exactly one entry per data-file row; for per-shard "
+                    "sidecars use per-rank files ('...{rank}...')")
+            weight = weight[lo:hi]
+        if group is not None:
+            log.fatal("query/group metadata cannot be row-slice sharded "
+                      "(queries would straddle shard boundaries); use "
+                      "per-rank files ('...{rank}...') with per-rank "
+                      ".query sidecars")
     return X, y, weight, group
 
 

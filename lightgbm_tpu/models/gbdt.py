@@ -348,6 +348,9 @@ class GBDT:
                 and self._grow is not None
                 and self._gh_fn is not None
                 and not self._linear
+                # stop-check rollback traverses the full training table
+                # (bins_dev), which sharded ingestion never materializes
+                and not getattr(self, "_sharded_ingest", False)
                 and not self._cegb_enabled
                 and not (self.grower_cfg.quantized and
                          self.config.quant_train_renew_leaf)
@@ -684,7 +687,11 @@ class GBDT:
         # (rollback, DART drops, continued training, valid replay) — it is
         # materialized lazily so training doesn't hold a dead full-dataset
         # copy in HBM next to bins_rf / bins_sharded
-        self._bins_fr_host = train.bins
+        self._sharded_ingest = getattr(train, "shard", None) is not None
+        # under sharded ingestion train.bins holds only the LOCAL row
+        # shard — it must never masquerade as the full [F, N] table
+        # (bins_dev guards; continued training replays shard-locally)
+        self._bins_fr_host = None if self._sharded_ingest else train.bins
         self._bins_dev_cache = None
 
         K = self.num_tree_per_iteration
@@ -791,6 +798,11 @@ class GBDT:
         self._mesh = None
         self._row_pad = 0
         self._feat_pad = 0
+        # sharded-ingest row layout (set in _setup_distributed): padded
+        # global slot -> concatenated-table row (-1 = pad), and its
+        # inverse for un-permuting gathered leaf ids
+        self._shard_row_map = None
+        self._shard_inv_map = None
         avail = len(jax.devices())
         want = cfg.tpu_num_devices if cfg.tpu_num_devices > 0 else avail
         self._n_dev = min(want, avail)
@@ -833,6 +845,14 @@ class GBDT:
                        else f"only {avail} device(s) visible")
                 log.warning(f"tree_learner={tl} requested but {cap}; "
                             "running serial")
+        if self._sharded_ingest and self._tree_learner not in ("data",
+                                                               "voting"):
+            log.fatal(
+                "sharded ingestion (pre_partition/tpu_ingest='sharded') "
+                "requires the row-sharded learners: set "
+                "tree_learner=data (or voting) with more than one "
+                f"device — got tree_learner={self._tree_learner!r} over "
+                f"{self._n_dev} device(s)")
         # ---- multi-value sparse storage (≡ SparseBin/MultiValSparseBin,
         # sparse_bin.hpp:858): scatter histogram over the stored
         # nonzeros; default-bin mass reconstructed at scan time.
@@ -865,6 +885,13 @@ class GBDT:
             # num_bin_max / runs the O(F*R) conflict scan
             log.warning("forced splits with EFB bundling are untested; "
                         "disabling bundling")
+        elif cfg.enable_bundle and self._sharded_ingest:
+            # the conflict scan would see only the local row shard —
+            # per-rank bundle disagreement desyncs the SPMD program, so
+            # sharded ingestion trains unbundled (a replicated-sample
+            # bundle agreement is future work)
+            log.info("EFB bundling is disabled under sharded ingestion "
+                     "(conflict scans need the global table)")
         elif (cfg.enable_bundle and
                 self._tree_learner in ("serial", "data", "voting",
                                        "feature") and
@@ -1152,6 +1179,12 @@ class GBDT:
         With multi-value sparse storage the dense matrix is reconstructed
         on demand — only rollback/DART/continued-training traversal needs
         it, and it costs the dense footprint (warned once)."""
+        if getattr(self, "_sharded_ingest", False):
+            log.fatal(
+                "this operation needs the full [F, N] training table, "
+                "which sharded ingestion never materializes on one host "
+                "— rollback/DART/refit over a sharded train set are not "
+                "supported (use tpu_ingest='replicated' for them)")
         mv_pair = None
         if (self._bins_dev_cache is None and self._bins_fr_host is None and
                 self.train_set is not None and
@@ -1264,19 +1297,88 @@ class GBDT:
             if bins_host is None:
                 bins_host = train.bins
             mesh = build_mesh(n_dev, axis_names=(DATA_AXIS,))
-            R_pad = padded_rows(N, n_dev)
-            self._row_pad = R_pad - N
-            bins = bins_host  # EFB-packed groups when bundling engaged
-            if self._row_pad:
-                bins = np.pad(bins, ((0, 0), (0, self._row_pad)))
-            if self._compact:
-                # row-major layout for the gathered O(rows_in_leaf) passes
-                self.bins_sharded = jax.device_put(
-                    np.ascontiguousarray(bins.T),
-                    NamedSharding(mesh, P(DATA_AXIS, None)))
+            if self._sharded_ingest:
+                # row-sharded ingestion (ISSUE 7): each process holds
+                # only its shard's bin columns. The global device array
+                # is assembled from the process-local blocks — no host
+                # ever materializes [F, N]. Padded layout: one
+                # ``region`` of rows per process (its shard + tail pad),
+                # so every process's block covers exactly its own
+                # devices' slots; pad slots carry gh = 0 and are
+                # invisible to training (exact zeros under quantized
+                # int32 histograms — the bit-identity contract).
+                shard = train.shard
+                world = shard.world
+                if n_dev % world:
+                    log.fatal(
+                        f"sharded ingestion: {n_dev} devices do not "
+                        f"divide evenly over {world} processes (set "
+                        "tpu_num_devices=0 to use every device)")
+                d_local = n_dev // world
+                # the region layout below places process p's rows on
+                # mesh slots [p*d_local, (p+1)*d_local) — a truncated
+                # mesh (tpu_num_devices < all devices) can pass the
+                # divisibility check yet exclude some process's devices
+                # entirely, which would crash (or worse, misplace rows)
+                # inside make_array_from_process_local_data
+                mesh_devs = list(mesh.devices.flat)
+                for p in range(world):
+                    block = mesh_devs[p * d_local:(p + 1) * d_local]
+                    if any(d.process_index != p for d in block):
+                        log.fatal(
+                            "sharded ingestion: the device mesh does "
+                            f"not hold {d_local} devices per process "
+                            "in process order (process "
+                            f"{p} owns {[d.process_index for d in block]}"
+                            ") — set tpu_num_devices=0 so every "
+                            "process contributes all its devices")
+                region = padded_rows(int(shard.row_counts.max()),
+                                     d_local)
+                R_pad = region * world
+                self._row_pad = 0
+                row_counts = np.asarray(shard.row_counts, np.int64)
+                offsets = np.concatenate([[0], np.cumsum(row_counts)])
+                row_map = np.full(R_pad, -1, np.int64)
+                for p in range(world):
+                    c = int(row_counts[p])
+                    row_map[p * region:p * region + c] = \
+                        offsets[p] + np.arange(c)
+                inv_map = np.zeros(N, np.int64)
+                inv_map[row_map[row_map >= 0]] = \
+                    np.flatnonzero(row_map >= 0)
+                self._shard_row_map = jnp.asarray(row_map, jnp.int32)
+                self._shard_inv_map = inv_map
+                local = bins_host              # [F_used, local_rows]
+                pad_c = region - local.shape[1]
+                if pad_c:
+                    local = np.pad(local, ((0, 0), (0, pad_c)))
+                if self._compact:
+                    self.bins_sharded = \
+                        jax.make_array_from_process_local_data(
+                            NamedSharding(mesh, P(DATA_AXIS, None)),
+                            np.ascontiguousarray(local.T),
+                            (R_pad, local.shape[0]))
+                else:
+                    self.bins_sharded = \
+                        jax.make_array_from_process_local_data(
+                            NamedSharding(mesh, P(None, DATA_AXIS)),
+                            np.ascontiguousarray(local),
+                            (local.shape[0], R_pad))
             else:
-                self.bins_sharded = jax.device_put(
-                    bins, NamedSharding(mesh, P(None, DATA_AXIS)))
+                R_pad = padded_rows(N, n_dev)
+                self._row_pad = R_pad - N
+                bins = bins_host  # EFB-packed groups when bundling engaged
+                if self._row_pad:
+                    bins = np.pad(bins, ((0, 0), (0, self._row_pad)))
+                if self._compact:
+                    # row-major layout for the gathered O(rows_in_leaf)
+                    # passes
+                    self.bins_sharded = jax.device_put(
+                        np.ascontiguousarray(bins.T),
+                        NamedSharding(mesh, P(DATA_AXIS, None)))
+                else:
+                    self.bins_sharded = jax.device_put(
+                        bins, NamedSharding(mesh, P(None, DATA_AXIS)))
             if tl == "data":
                 grow = make_data_parallel_grower(
                     self.grower_cfg, self.feature_meta, mesh, forced=forced,
@@ -1285,6 +1387,20 @@ class GBDT:
                 grow = make_voting_parallel_grower(
                     self.grower_cfg, self.feature_meta, mesh,
                     top_k=int(cfg.top_k), bundle=self._bundle)
+            if self._shard_row_map is not None:
+                # scatter the replicated [N, 3] gh into the per-region
+                # padded layout INSIDE the jitted program (pad slots get
+                # exact zeros); the base grower's entry shapes are
+                # untouched
+                rm = self._shard_row_map
+                base_grow = grow
+
+                def grow(bins_arr, gh, fmask, cegb, rng_key,
+                         _base=base_grow, _rm=rm):
+                    gh_p = jnp.where((_rm >= 0)[:, None],
+                                     gh[jnp.clip(_rm, 0), :],
+                                     jnp.zeros((), gh.dtype))
+                    return _base(bins_arr, gh_p, fmask, cegb, rng_key)
             self._grow_dist = jax.jit(grow)
         else:  # feature-parallel
             if bins_host is None:
@@ -1338,6 +1454,15 @@ class GBDT:
                         jnp.pad(cegb[1], (0, self._feat_pad)))
             tree, leaf_id = self._grow_dist(self.bins_sharded, gh, fmask,
                                             cegb, rng_key)
+            if self._shard_inv_map is not None:
+                # sharded ingestion: gather the [R_pad] padded layout and
+                # un-permute to the concatenated-table row order (pads
+                # interleave per process region, so this is an index map,
+                # not a suffix slice)
+                from jax.experimental import multihost_utils
+                leaf_all = np.asarray(multihost_utils.process_allgather(
+                    leaf_id, tiled=True)).reshape(-1)
+                return tree, jnp.asarray(leaf_all[self._shard_inv_map])
             if self._row_pad:
                 leaf_id = leaf_id[:N]
             if jax.process_count() > 1:
@@ -1359,6 +1484,11 @@ class GBDT:
     def add_valid_data(self, valid: BinnedDataset,
                        metrics: Optional[List[Metric]] = None,
                        name: Optional[str] = None) -> None:
+        if getattr(valid, "shard", None) is not None:
+            log.fatal(
+                "validation sets must be replicated: construct them "
+                "with reference=<train Dataset> (sharded ingestion "
+                "applies to the training table only)")
         if metrics is None:
             metrics = metrics_for_config(
                 self.config,
@@ -2279,10 +2409,35 @@ class GBDT:
                     t.cat_bins_inner[i, :len(s)] = s
                     t.cat_count_inner[i] = len(s)
             t.from_text = False
+        bins_replay = None
+        if getattr(self, "_sharded_ingest", False):
+            # sharded ingestion: replay each tree over the LOCAL shard's
+            # feature-major bins and allgather the per-row outputs into
+            # the global rank-order layout — elementwise per row, so the
+            # restored score is bit-identical to a replicated replay
+            # (the checkpoint-resume path for multi-host runs). One
+            # allgather PER TREE is deliberate: batching trees into a
+            # local accumulator before gathering would reassociate the
+            # f32 score sum and break the bit-exact-resume contract
+            # (each tree must land on the score in the same order and
+            # rounding as the replicated `.at[k].add` chain)
+            bins_replay = jnp.asarray(self.train_set.bins)
         for i, t in enumerate(self.models):
             k = i % K
-            self.score = self.score.at[k].add(
-                self._tree_outputs(t, self.bins_dev, self.train_set.raw))
+            if bins_replay is not None:
+                from ..distributed import allgather_bytes
+                local = np.asarray(
+                    self._tree_outputs(t, bins_replay, None), np.float32)
+                parts = allgather_bytes(
+                    local.tobytes(),
+                    what="sharded ingest: continued-training replay")
+                self.score = self.score.at[k].add(jnp.asarray(
+                    np.concatenate([np.frombuffer(p, np.float32)
+                                    for p in parts])))
+            else:
+                self.score = self.score.at[k].add(
+                    self._tree_outputs(t, self.bins_dev,
+                                       self.train_set.raw))
             for vd in self.valid_sets:
                 vd.score = vd.score.at[k].add(
                     self._tree_outputs(t, vd.bins_dev, vd.dataset.raw))

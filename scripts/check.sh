@@ -70,6 +70,20 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
+echo "== ingest smoke (sharded ingestion parity + RSS, 2-proc CPU) =="
+# ISSUE 7: a real 2-process launch_local world trains on DISJOINT row
+# shards (distributed bin finding + per-host binning) and must produce
+# trees bit-identical to single-process training on the concatenated
+# table; workers also assert no rank ever materializes the global
+# binned table. The timeout is a backstop around the script's own
+# <30 s budget.
+timeout -k 10 240 env JAX_PLATFORMS=cpu \
+    python scripts/ingest_smoke.py || rc=1
+if [ $rc -ne 0 ]; then
+    echo "check.sh: ingest smoke failed — skipping tier-1 pytest" >&2
+    exit $rc
+fi
+
 echo "== hybrid-path dispatch guards (compile budget + O(levels) shape) =="
 # the round-7 hot path: steady-state hybrid training must stay <=2
 # recompiles over 5 iterations and the level phase must issue

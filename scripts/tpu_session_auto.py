@@ -155,6 +155,9 @@ def run_bench(stage: str, rows: int, iters: int, extra: dict | None = None,
                BENCH_ROWS=str(rows), BENCH_ITERS=str(iters),
                BENCH_WATCHDOG_SEC=str(watchdog))
     env[ENV_COMPILE_CACHE] = SESSION_CACHE
+    # the replicated-vs-sharded ingest A/B runs ONCE as its own stage
+    # (run_ingest_stage), not inside every training stage's window
+    env.setdefault("BENCH_INGEST", "0")
     if scheds is not None:
         env["BENCH_SCHEDS"] = scheds
     if env_extra:
@@ -353,6 +356,56 @@ def _stages() -> int:
     if guard(h1m_lvl):
         git_commit("bench_logs: r6 partial session (compact 1M only)")
         return 3
+
+    # ---- stage 0.8: replicated-vs-sharded ingest A/B at the 10.5M
+    # reference shape (ISSUE 7). The gang runs on VIRTUAL CPU devices
+    # and never touches the device claim — zero wedge risk — so it can
+    # run right after the headlines bank; only wall time is spent.
+    # Never gates the session: a failure logs and moves on.
+    try:
+        ingest_env = dict(os.environ, BENCH_INGEST_ONLY="1",
+                          BENCH_WATCHDOG_SEC="1500")
+        ingest_env[ENV_COMPILE_CACHE] = SESSION_CACHE
+        say("stage ingest_ab: replicated-vs-sharded ingest at 10.5M")
+        ing_out, ing_timeout = _run_stage(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=ingest_env, timeout=1600,
+            logpath=os.path.join(LOGDIR, "r05_ingest_ab.log"))
+        ing_res = None
+        if ing_timeout:
+            # unlike training stages, this gang runs on virtual CPU
+            # devices — it holds NO device claim, so parking semantics
+            # do not apply: stop it and clear the park so the session
+            # continues
+            import signal as _signal
+            p = PARKED.get("proc")
+            if p is not None and p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), _signal.SIGTERM)
+                except OSError:
+                    pass
+            PARKED["proc"] = None
+            say("stage ingest_ab: timed out (CPU-only gang stopped; "
+                "session continues)")
+        else:
+            for ln in ing_out.splitlines():
+                ln = ln.strip()
+                if ln.startswith("{") and '"ingest_synth' in ln:
+                    ing_res = json.loads(ln)
+        if ing_res is not None:
+            ing_res["stage"] = "ingest_ab"
+            RESULTS.append(ing_res)
+            say(f"stage ingest_ab: sharded {ing_res.get('value')}s vs "
+                f"replicated {ing_res.get('replicated_sec')}s, rss "
+                f"ratio {ing_res.get('rss_ratio')}")
+        else:
+            say("stage ingest_ab: no result line (continuing)")
+        STATE["stages"].append({"stage": "ingest_ab",
+                                "ok": bool(ing_res and
+                                           ing_res.get("value", 0) > 0)})
+        dump_state()
+    except Exception as e:  # noqa: BLE001 — informational stage only
+        say(f"stage ingest_ab failed: {e!r} (continuing)")
 
     # ---- stage 00: micro number (16k rows, 31 leaves, seconds of
     # compile); the _L31 suffix keeps it from masquerading as the

@@ -1,0 +1,111 @@
+"""Worker for the sharded-ingestion multi-process tests (subprocess).
+
+Each process joins the world through the launcher env contract
+(distributed.init_from_env), takes its DISJOINT row shard of the
+synthetic table (the reference's pre-partition convention), and trains
+with ``pre_partition=true`` — so bin finding runs distributed (per-shard
+sample summaries → feature-sliced find_bin → BinMapper allgather) and no
+process ever holds the global table. ``use_quantized_grad`` +
+``stochastic_rounding=false`` make the int32 histogram sums exact, which
+is the bit-identity contract: the trees must equal single-process
+training on the concatenated table.
+
+Usage: python mp_sharded_worker.py <outdir>
+Env:   SHARDED_ROUNDS        total boosting rounds (default 8)
+       SHARDED_CKPT_DIR      checkpoint directory; rank 0 writes a
+                             checkpoint every SHARDED_CKPT_EVERY
+                             iterations and EVERY rank resumes from the
+                             shared dir (rank 0's training state is
+                             replicated, so one writer is coherent)
+       SHARDED_ITER_SLEEP    seconds to sleep per iteration (gives the
+                             kill-and-relaunch test a window)
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from lightgbm_tpu.distributed import init_from_env  # noqa: E402
+
+rank = init_from_env()          # must precede any other jax use
+
+import numpy as np              # noqa: E402
+
+import lightgbm_tpu as lgb      # noqa: E402
+
+
+def synth(n=2001, f=8, seed=11):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    X[rng.random((n, f)) < 0.02] = np.nan
+    y = (np.nan_to_num(X[:, 0]) * 2 - np.nan_to_num(X[:, 1])
+         + 0.5 * np.nan_to_num(X[:, 2] * X[:, 3]) > 0).astype(np.float64)
+    return X, y
+
+
+PARAMS = {
+    "objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+    "verbose": -1, "seed": 7, "deterministic": True,
+    "tree_learner": "data", "pre_partition": True,
+    # exact int32 histogram accumulation: the shard layout (and the
+    # padded-slot placement) becomes invisible — bit-identical trees
+    "use_quantized_grad": True, "stochastic_rounding": False,
+}
+
+
+def main():
+    outdir = sys.argv[1]
+    import jax
+
+    from lightgbm_tpu.distributed import row_slice
+    world = jax.process_count()
+    X, y = synth()
+    lo, hi = row_slice(len(X), rank, world)
+    Xs, ys = X[lo:hi], y[lo:hi]        # this process's rows ONLY
+    del X, y
+
+    rounds = int(os.environ.get("SHARDED_ROUNDS", "8"))
+    if os.environ.get("SHARDED_LEAVES"):
+        PARAMS["num_leaves"] = int(os.environ["SHARDED_LEAVES"])
+    ckpt_dir = os.environ.get("SHARDED_CKPT_DIR", "")
+    sleep_s = float(os.environ.get("SHARDED_ITER_SLEEP", "0"))
+    callbacks = []
+    if sleep_s:
+        import time
+
+        def _snooze(env):
+            time.sleep(sleep_s)
+        callbacks.append(_snooze)
+    if ckpt_dir and rank == 0:
+        from lightgbm_tpu.callback import checkpoint_callback
+        callbacks.append(checkpoint_callback(
+            ckpt_dir, every_n=int(os.environ.get("SHARDED_CKPT_EVERY",
+                                                 "2")),
+            keep_last=50))
+
+    bst = lgb.train(PARAMS, lgb.Dataset(Xs, label=ys),
+                    num_boost_round=rounds, callbacks=callbacks,
+                    resume_from=ckpt_dir or None)
+
+    eng = bst._engine
+    assert eng.train_set.shard is not None, "sharded ingestion not engaged"
+    assert eng.train_set.bins.shape[1] == hi - lo, \
+        "local bins must cover only this shard's rows"
+    if rank == 0:
+        with open(os.path.join(outdir, "model_sharded.txt"), "w") as f:
+            f.write(bst.model_to_string())
+        pred = bst.predict(Xs)
+        np.save(os.path.join(outdir, "pred_rank0.npy"), pred)
+    if os.environ.get("SHARDED_SMOKE_RSS"):
+        import json
+        import resource
+        peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        print(json.dumps({"rank": rank,
+                          "peak_rss_mb": round(peak_kb / 1024.0, 1)}),
+              flush=True)
+    print(f"rank {rank} done ({hi - lo} local rows)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
