@@ -172,6 +172,18 @@ def _predict_fail_line(note: str, status: str = "no_result") -> str:
     return json.dumps(_predict_record(0.0, status=status, note=note))
 
 
+def _lat_fields(lats, prefix: str = "") -> dict:
+    """p50/p99 per-chunk latency fields riding the predict record
+    (ISSUE 8) — nearest-rank over the timed chunks, in ms. Banked
+    partials carry the same fields so a salvaged line reports the tail
+    the child actually sustained, not just the mean rate."""
+    if not lats:
+        return {}
+    from lightgbm_tpu.serving.metrics import percentile
+    return {f"{prefix}p50_ms": round(percentile(lats, 50) * 1e3, 3),
+            f"{prefix}p99_ms": round(percentile(lats, 99) * 1e3, 3)}
+
+
 def _force_sync(arr) -> float:
     """Barrier that actually waits for device completion.
 
@@ -370,20 +382,24 @@ def run_child(sched: str) -> None:
 
 
 def _timed_predict(predict_fn, X, tag: str, sched: str,
-                   bank_path: str, extra: dict) -> float:
+                   bank_path: str, extra: dict):
     """Drive predict_fn over PREDICT_ROWS rows in PREDICT_BATCH chunks;
-    returns rows/sec. Each chunk result is host-materialized (a real
-    barrier), beats the heartbeat, and banks a crash-safe partial so a
-    late park/stall still salvages a provably-sustained rate."""
+    returns (rows/sec, per-chunk latencies). Each chunk result is
+    host-materialized (a real barrier), beats the heartbeat, and banks
+    a crash-safe partial so a late park/stall still salvages a
+    provably-sustained rate + latency tail."""
     n = X.shape[0]
     rows_done = 0
+    lats = []
     t0 = time.perf_counter()
     next_bank = t0 + PARTIAL_EVERY_SEC if bank_path else None
     chunk_i = 0
     while rows_done < PREDICT_ROWS:
         off = (chunk_i * PREDICT_BATCH) % n
         chunk = X[off:off + PREDICT_BATCH]
+        t_chunk = time.perf_counter()
         predict_fn(chunk)
+        lats.append(time.perf_counter() - t_chunk)
         rows_done += len(chunk)
         chunk_i += 1
         heartbeat.beat(heartbeat.PHASE_MEASURING, 10_000 + chunk_i)
@@ -392,9 +408,10 @@ def _timed_predict(predict_fn, X, tag: str, sched: str,
                 now >= next_bank:
             _bank_record(bank_path, _predict_record(
                 rows_done / (now - t0), partial=True, path=tag,
-                sched=sched, rows_done=rows_done, **extra))
+                sched=sched, rows_done=rows_done, **_lat_fields(lats),
+                **extra))
             next_bank = time.perf_counter() + PARTIAL_EVERY_SEC
-    return rows_done / (time.perf_counter() - t0)
+    return rows_done / (time.perf_counter() - t0), lats
 
 
 def _measure_predict(lgb, booster, X, sched: str) -> None:
@@ -421,8 +438,8 @@ def _measure_predict(lgb, booster, X, sched: str) -> None:
         raise RuntimeError("binned device route did not serve (host "
                            "fallback engaged) — refusing to publish host "
                            "throughput as the packed-forest metric")
-    binned_rps = _timed_predict(binned, X, "binned", sched, bank_path,
-                                extra)
+    binned_rps, binned_lats = _timed_predict(binned, X, "binned", sched,
+                                             bank_path, extra)
 
     # raw route: round-trip through model text — a loaded model has no
     # bin mappers, so predict_device serves via tree_leaf_raw
@@ -440,7 +457,8 @@ def _measure_predict(lgb, booster, X, sched: str) -> None:
         raise RuntimeError("raw device route did not serve (host "
                            "fallback engaged) — refusing to publish host "
                            "throughput as the packed-forest metric")
-    raw_rps = _timed_predict(raw, X, "raw", sched, bank_path, extra)
+    raw_rps, raw_lats = _timed_predict(raw, X, "raw", sched, bank_path,
+                                       extra)
 
     # parity guard: a serving engine that quietly diverged must not
     # publish a throughput number
@@ -451,7 +469,9 @@ def _measure_predict(lgb, booster, X, sched: str) -> None:
                            f"max|d|={np.abs(host - dev).max():.3e}")
     rec = _predict_record(binned_rps, sched=sched,
                           binned_rows_per_sec=round(binned_rps, 1),
-                          raw_rows_per_sec=round(raw_rps, 1), **extra)
+                          raw_rows_per_sec=round(raw_rps, 1),
+                          **_lat_fields(binned_lats),
+                          **_lat_fields(raw_lats, "raw_"), **extra)
     if bank_path:
         _bank_record(bank_path, dict(rec, partial=True,
                                      rows_done=PREDICT_ROWS))

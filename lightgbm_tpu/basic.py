@@ -819,6 +819,19 @@ class Booster:
         self._engine.rollback_one_iter()
         return self
 
+    def serve(self, **kwargs) -> "ModelServer":
+        """Start a concurrent model server over this booster (ISSUE 8,
+        serving/server.py): a dynamic micro-batcher coalesces concurrent
+        ``submit()`` requests into the packed-forest engine's compiled
+        row buckets, the pack is replicated over the serving mesh with
+        request batches sharded across it, and ``ModelServer.publish()``
+        hot-swaps newly trained trees into the live server with zero
+        downtime. Knobs default from the ``tpu_serving_*`` params;
+        kwargs (``max_batch``, ``linger_ms``, ``num_devices``,
+        ``queue_depth``, ``raw_score``, ``bucket``) override."""
+        from .serving import ModelServer
+        return ModelServer(self, **kwargs)
+
     @property
     def current_iteration(self):
         return self._engine.current_iteration

@@ -309,6 +309,23 @@ _reg("tpu_predict_device", bool, False, ())  # batched device prediction
 # counts reuses XLA programs instead of retracing per distinct size.
 # false = compile at exact request shapes.
 _reg("tpu_predict_buckets", bool, True, ())
+# concurrent serving tier (serving/, Booster.serve() — ISSUE 8): the
+# dynamic micro-batcher coalesces in-flight requests into the bucketed
+# shapes above. max_batch caps coalesced rows per device dispatch;
+# linger_ms is how long a batch may wait (since its OLDEST request) for
+# peers before dispatching — the p50-latency-vs-throughput knob: 0
+# dispatches immediately, a few ms fills batches under concurrent load.
+_reg("tpu_serving_max_batch", int, 4096, (), (1, None, True, False))
+_reg("tpu_serving_linger_ms", float, 2.0, (), (0.0, None, True, False))
+# serving mesh width: the packed forest is replicated across this many
+# devices and each coalesced batch is row-sharded over them
+# (serving/mesh.py naive sharding). 0 = all visible devices; 1 = no
+# mesh (programs identical to the single-device serving engine).
+_reg("tpu_serving_num_devices", int, 0, (), (0, None, True, False))
+# enqueue backpressure: submit() blocks once this many requests are
+# queued, bounding host memory under overload instead of buffering
+# unboundedly.
+_reg("tpu_serving_queue_depth", int, 8192, (), (1, None, True, False))
 # device tracing (SURVEY §5 tracing: jax.profiler traces + the named-
 # section wall-clock table ≡ the reference's USE_TIMETAG global_timer).
 # Set to a directory to capture a jax.profiler trace of the training loop

@@ -291,6 +291,11 @@ class _LoadedEngine:
                                         X, lo, hi)
         return out.T  # [R, K]
 
+    def serving_state(self):
+        """Server-snapshot source (serving/server.py ISSUE 8): a loaded
+        model has no bin mappers, so the server serves the raw route."""
+        return list(self.models), self._model_gen, None, None
+
     def eval_train(self):
         return []
 

@@ -1893,6 +1893,21 @@ class GBDT:
             out = srv.predict_raw(models, self._model_gen, X, lo, hi)
         return out.T  # [R, K]
 
+    def serving_state(self):
+        """Frozen ``(models, generation, mappers, used_feature_map)``
+        for an external model server (serving/server.py ISSUE 8). The
+        list COPY decouples the server's snapshot from trees the
+        training loop appends afterwards (the next ``publish`` picks
+        them up incrementally); the pinned mapper list keeps the
+        server's binner/pack identity caches valid across publishes."""
+        models = list(self.models)        # property: flushes pending
+        if self.train_set is not None and self.train_set.bin_mappers:
+            if self._serving_mappers is None:
+                self._serving_mappers = self.train_set.used_bin_mappers()
+            return (models, self._model_gen, self._serving_mappers,
+                    self.train_set.used_feature_map)
+        return models, self._model_gen, None, None
+
     # ------------------------------------------------------------------
     def _hb_iter_begin(self):
         """Beat the process heartbeat and arm the stall watchdog for one

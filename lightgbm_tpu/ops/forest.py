@@ -416,6 +416,61 @@ def _forest_scores_raw(num_steps, k_trees, stacked, x_dev):
     return outs.reshape(t // k_trees, k_trees, -1).sum(axis=0)
 
 
+class ForestSnapshot(NamedTuple):
+    """Immutable serving state frozen at publish time (ISSUE 8).
+
+    Everything a request needs to be scored — the sliced device forest,
+    the static traversal bound, the binner — with NO reference back to
+    the mutable packs, so a dispatcher thread can keep serving one
+    snapshot while a publisher builds the next (zero-downtime hot-swap:
+    a response is attributable to exactly one snapshot, never a torn
+    pack)."""
+    kind: str                     # "binned" | "raw"
+    win: object                   # stacked [T, ...] window (device pytree)
+    steps: int                    # static traversal step bound
+    k: int                        # trees per iteration (output channels)
+    n_trees: int                  # trees inside the window
+    bucket: bool                  # pad requests to bucket_rows shapes
+    binner: Optional[DeviceBinner]  # binned route only
+
+
+def snapshot_scores(snap: ForestSnapshot, X: np.ndarray,
+                    place=None) -> np.ndarray:
+    """[K, R] f64 raw scores for one frozen snapshot.
+
+    Touches no engine/pack state — safe to call concurrently with
+    ``ServingEngine.snapshot`` building the NEXT snapshot. ``place``
+    (optional ``f(device_array, rows_axis) -> device_array``) reshards
+    the per-request operand over a serving mesh (serving/mesh.py)
+    before the jitted traversal; the packed window was placed at
+    snapshot time."""
+    r = X.shape[0]
+    rows = bucket_rows(r) if snap.bucket else r
+    if snap.kind == "binned":
+        bins = snap.binner.bins(X, rows=rows)
+        if place is not None:
+            bins = place(bins, 1)
+        out = _forest_scores_binned(snap.steps, snap.k, snap.win, bins)
+    else:
+        x = np.zeros((rows, X.shape[1]), np.float32)
+        x[:r] = X
+        with np.errstate(invalid="ignore"):
+            f32_ok = (x[:r].astype(np.float64) == X) | np.isnan(X)
+        if not f32_ok.all():
+            raise ValueError(
+                "raw device serving needs float32-representable requests "
+                f"({int((~f32_ok).sum())} value(s) are f64-only and could "
+                "cross a split threshold under f32 rounding)")
+        xd = jnp.asarray(x)
+        if place is not None:
+            xd = place(xd, 0)
+        out = _forest_scores_raw(snap.steps, snap.k, snap.win, xd)
+    # slice the padding off on the HOST: an on-device out[:, :r]
+    # would trace a new dynamic_slice program per distinct r —
+    # exactly the retrace the bucketing exists to avoid
+    return np.asarray(out, np.float64)[:, :r]
+
+
 class ServingEngine:
     """Per-model serving state: device binner + packed forests. Owned
     lazily by the training engine (models/gbdt.py) and the loaded-model
@@ -434,21 +489,41 @@ class ServingEngine:
     def _padded_rows(self, r: int) -> int:
         return bucket_rows(r) if self.bucket else r
 
+    def snapshot(self, models, gen, lo: int, hi: int, mappers=None,
+                 used_feature_map=None,
+                 place_window=None) -> ForestSnapshot:
+        """Sync the right pack and freeze an immutable snapshot of the
+        [lo, hi) window. ``mappers`` present selects the binned route,
+        absent the raw-threshold route. ``place_window`` (optional
+        ``f(pytree) -> pytree``) replicates the window over a serving
+        mesh. Thread contract: CALLERS serialize snapshot() (it mutates
+        pack state); ``snapshot_scores`` on the result does not."""
+        if not models[lo:hi]:
+            raise ValueError("serving snapshot needs a non-empty tree "
+                             "range")
+        if mappers is not None:
+            self.pack.sync(models, gen, mappers)
+            if self.binner is None or self._binner_src is not mappers:
+                self.binner = DeviceBinner(mappers, used_feature_map)
+                self._binner_src = mappers
+            win, steps = self.pack.window(lo, hi)
+            kind, binner = "binned", self.binner
+        else:
+            self.raw_pack.check_servable(models[lo:hi])
+            self.raw_pack.sync(models, gen)
+            win, steps = self.raw_pack.window(lo, hi)
+            kind, binner = "raw", None
+        if place_window is not None:
+            win = place_window(win)
+        return ForestSnapshot(kind, win, steps, self.k, hi - lo,
+                              self.bucket, binner)
+
     def predict_binned(self, models, gen, X: np.ndarray, lo: int, hi: int,
                        mappers, used_feature_map) -> np.ndarray:
         """[K, R] f32-accumulated raw scores over the binned route."""
-        self.pack.sync(models, gen, mappers)
-        if self.binner is None or self._binner_src is not mappers:
-            self.binner = DeviceBinner(mappers, used_feature_map)
-            self._binner_src = mappers
-        r = X.shape[0]
-        bins = self.binner.bins(X, rows=self._padded_rows(r))
-        win, steps = self.pack.window(lo, hi)
-        out = _forest_scores_binned(steps, self.k, win, bins)
-        # slice the padding off on the HOST: an on-device out[:, :r]
-        # would trace a new dynamic_slice program per distinct r —
-        # exactly the retrace the bucketing exists to avoid
-        return np.asarray(out, np.float64)[:, :r]
+        snap = self.snapshot(models, gen, lo, hi, mappers,
+                             used_feature_map)
+        return snapshot_scores(snap, X)
 
     def predict_raw(self, models, gen, X: np.ndarray,
                     lo: int, hi: int) -> np.ndarray:
@@ -460,19 +535,5 @@ class ServingEngine:
         itself needs the values on device), so f64-only request values
         are REFUSED (ValueError -> the Booster's host fallback) rather
         than served with possible one-ulp boundary misroutes."""
-        self.raw_pack.check_servable(models[lo:hi])
-        r = X.shape[0]
-        rb = self._padded_rows(r)
-        x = np.zeros((rb, X.shape[1]), np.float32)
-        x[:r] = X
-        with np.errstate(invalid="ignore"):
-            f32_ok = (x[:r].astype(np.float64) == X) | np.isnan(X)
-        if not f32_ok.all():
-            raise ValueError(
-                "raw device serving needs float32-representable requests "
-                f"({int((~f32_ok).sum())} value(s) are f64-only and could "
-                "cross a split threshold under f32 rounding)")
-        self.raw_pack.sync(models, gen)
-        win, steps = self.raw_pack.window(lo, hi)
-        out = _forest_scores_raw(steps, self.k, win, jnp.asarray(x))
-        return np.asarray(out, np.float64)[:, :r]  # host-side pad slice
+        snap = self.snapshot(models, gen, lo, hi)
+        return snapshot_scores(snap, X)

@@ -1,0 +1,17 @@
+"""Concurrent serving tier (ISSUE 8): dynamic micro-batching into the
+packed-forest engine's compiled row buckets, mesh replication of the
+pack with request batches sharded over the devices, and zero-downtime
+hot-swap of newly trained trees via immutable forest snapshots.
+
+Entry point: ``Booster.serve(...)`` -> :class:`ModelServer`.
+"""
+from .batcher import MicroBatcher, PendingRequest
+from .mesh import SERVE_AXIS, serving_mesh, shard_rows
+from .metrics import (LatencyRecorder, latency_summary_ms, percentile)
+from .server import Generation, ModelServer
+
+__all__ = [
+    "Generation", "LatencyRecorder", "MicroBatcher", "ModelServer",
+    "PendingRequest", "SERVE_AXIS", "latency_summary_ms", "percentile",
+    "serving_mesh", "shard_rows",
+]

@@ -1,0 +1,235 @@
+"""Request queue + dynamic micro-batcher (ISSUE 8).
+
+Coalesces in-flight requests into one dispatch so many small concurrent
+clients ride the serving engine's batched traversal instead of paying a
+device round-trip each. The coalesced row count is padded by the SAME
+pow2/octave bucketing the single-request path uses (ops/forest.py
+``bucket_rows``), so a server under mixed request sizes costs **zero new
+steady-state traces** — the whole point of the bucket family.
+
+Policy (one knob): a batch dispatches when it reaches ``max_batch`` rows
+OR when ``linger_ms`` has elapsed since the OLDEST queued request —
+linger trades p50 (each request may wait up to one linger for peers) for
+throughput (fuller batches). Under saturation the linger never actually
+expires: the queue refills while the previous batch is on device, so
+batches are full and latency is queue-bound, the classic dynamic
+batching behavior.
+
+Threading model: client threads only enqueue numpy arrays and wait on an
+event; ONE dispatcher thread does all jax work (binning, traversal,
+materialization). That keeps the device program stream serial — no lock
+contention around XLA — and makes response attribution trivial: a batch
+is served by exactly one snapshot.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from .metrics import LatencyRecorder
+
+_SENTINEL = object()
+
+
+class PendingRequest:
+    """Handle for one submitted request: ``result()`` blocks until the
+    dispatcher fulfilled (or failed) it. ``generation`` is the publish
+    version of the snapshot that served it — the hot-swap audit trail."""
+
+    __slots__ = ("X", "n", "t_enq", "t_done", "_event", "_value", "_error",
+                 "generation")
+
+    def __init__(self, X: np.ndarray):
+        self.X = X
+        self.n = X.shape[0]
+        self.t_enq = time.perf_counter()
+        self.t_done: Optional[float] = None
+        self._event = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self.generation = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._event.wait(timeout):
+            raise TimeoutError("serving request not fulfilled in "
+                               f"{timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    @property
+    def latency_sec(self) -> Optional[float]:
+        return None if self.t_done is None else self.t_done - self.t_enq
+
+    # dispatcher side -------------------------------------------------
+    def _fulfill(self, value, generation) -> None:
+        self._value = value
+        self.generation = generation
+        self.t_done = time.perf_counter()
+        self._event.set()
+
+    def _fail(self, error: BaseException) -> None:
+        self._error = error
+        self.t_done = time.perf_counter()
+        self._event.set()
+
+
+class MicroBatcher:
+    """Dynamic micro-batcher over a ``dispatch`` callable.
+
+    ``dispatch(X) -> (values, generation)`` scores one coalesced [R, C]
+    batch and names the model snapshot that served it; ``values`` is
+    row-aligned with X (first axis R). The batcher slices values back
+    per request. Dispatch failures fail every request in that batch —
+    never silently dropped.
+    """
+
+    def __init__(self, dispatch: Callable, max_batch: int = 4096,
+                 linger_ms: float = 2.0, queue_depth: int = 8192):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.dispatch = dispatch
+        self.max_batch = int(max_batch)
+        self.linger_sec = max(float(linger_ms), 0.0) / 1e3
+        self._q: "queue.Queue" = queue.Queue(maxsize=int(queue_depth))
+        self._carry: Optional[PendingRequest] = None
+        self._closed = False
+        # serializes the closed-check+enqueue pair against close(): once
+        # close() holds this lock and sets _closed, no submit can be
+        # mid-put, so "accepted => will be served" has no race window
+        # (an accepted request is visible to the dispatcher's
+        # closed-and-empty exit check before _closed is observable)
+        self._submit_lock = threading.Lock()
+        self.latency = LatencyRecorder()
+        # dispatcher-thread-only counters (read racily by stats(); they
+        # only ever grow, so a torn read is at worst one batch stale)
+        self.n_requests = 0
+        self.n_rows = 0
+        self.n_batches = 0
+        self.n_errors = 0
+        self.max_coalesced = 0
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="lgbm-serving-batcher")
+        self._thread.start()
+
+    # client side ------------------------------------------------------
+    def submit(self, X: np.ndarray) -> PendingRequest:
+        """Enqueue one request (blocks on a full queue — backpressure,
+        not unbounded buffering). Raises after close()."""
+        if X.ndim != 2 or X.shape[0] == 0:
+            raise ValueError("requests must be non-empty [rows, features] "
+                             "matrices")
+        req = PendingRequest(X)
+        with self._submit_lock:
+            if self._closed:
+                raise RuntimeError("serving batcher is closed")
+            # blocking put INSIDE the lock is safe: only the dispatcher
+            # drains the queue and it never takes this lock, so a full
+            # queue empties while we hold it (close() just waits)
+            self._q.put(req)
+        return req
+
+    def close(self, timeout: Optional[float] = 30.0) -> None:
+        """Stop accepting requests, DRAIN everything already queued
+        (every accepted request gets a response), then stop the
+        dispatcher thread."""
+        with self._submit_lock:
+            self._closed = True
+        try:
+            self._q.put_nowait(_SENTINEL)   # wake a blocked dispatcher
+        except queue.Full:
+            pass                            # non-empty queue: already awake
+        self._thread.join(timeout)
+
+    # dispatcher side --------------------------------------------------
+    def _gather(self) -> Optional[List[PendingRequest]]:
+        """Block for the first request, then coalesce until max_batch
+        rows or the oldest request's linger deadline. Returns None when
+        closed and fully drained."""
+        first = None
+        if self._carry is not None:
+            first, self._carry = self._carry, None
+        while first is None:
+            if self._closed and self._q.empty():
+                return None
+            try:
+                got = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if got is not _SENTINEL:
+                first = got
+        batch, rows = [first], first.n
+        deadline = first.t_enq + self.linger_sec
+        while rows < self.max_batch:
+            wait = deadline - time.perf_counter()
+            if self._closed or wait <= 0:
+                # linger expired (the oldest request already waited out
+                # its budget — e.g. queued behind the previous batch
+                # under saturation): still DRAIN everything immediately
+                # available. Linger only ever waits for requests that
+                # have not arrived yet; skipping this drain serves
+                # 1-request batches under exactly the load coalescing
+                # exists for.
+                try:
+                    got = self._q.get_nowait()
+                except queue.Empty:
+                    break
+            else:
+                try:
+                    got = self._q.get(timeout=wait)
+                except queue.Empty:
+                    break
+            if got is _SENTINEL:
+                continue
+            if rows + got.n > self.max_batch:
+                self._carry = got            # honor max_batch strictly
+                break
+            batch.append(got)
+            rows += got.n
+        return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._gather()
+            if batch is None:
+                return
+            rows = sum(r.n for r in batch)
+            try:
+                X = batch[0].X if len(batch) == 1 else \
+                    np.concatenate([r.X for r in batch], axis=0)
+                values, generation = self.dispatch(X)
+            except BaseException as e:      # noqa: BLE001 — relayed
+                self.n_errors += len(batch)
+                for r in batch:
+                    r._fail(e)
+                continue
+            off = 0
+            for r in batch:
+                r._fulfill(values[off:off + r.n], generation)
+                off += r.n
+                if r.latency_sec is not None:
+                    self.latency.record(r.latency_sec)
+            self.n_requests += len(batch)
+            self.n_rows += rows
+            self.n_batches += 1
+            self.max_coalesced = max(self.max_coalesced, len(batch))
+
+    def stats(self) -> dict:
+        s = {"requests": self.n_requests, "rows": self.n_rows,
+             "batches": self.n_batches, "errors": self.n_errors,
+             "max_coalesced": self.max_coalesced,
+             "queue_depth": self._q.qsize()}
+        if self.n_batches:
+            s["mean_requests_per_batch"] = round(
+                self.n_requests / self.n_batches, 2)
+            s["mean_rows_per_batch"] = round(self.n_rows / self.n_batches,
+                                             1)
+        s.update(self.latency.summary_ms())
+        return s

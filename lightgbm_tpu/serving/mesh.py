@@ -1,0 +1,59 @@
+"""Serving-mesh placement: replicate the packed forest, shard requests.
+
+The multi-device serving layout (ISSUE 8; idiom: SNIPPETS.md [2]
+``get_naive_sharding``): the packed forest is small and read-only, so it
+is REPLICATED across every mesh device; the per-request operand (the
+binned [F, R] matrix or the raw [R, C] matrix) is sharded along its rows
+axis so each device traverses its slice of the batch — pure data
+parallelism, no collectives (the per-row outputs are independent).
+
+Naive-sharding rule: shard the rows axis when the (bucketed) row count
+divides evenly by the mesh size, else replicate. Bucketed shapes
+(ops/forest.bucket_rows: pow2 then 1/8-octave steps, all multiples of
+256) divide any power-of-two device count, so under bucketing the
+fallback only triggers for odd mesh sizes.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SERVE_AXIS = "serve"
+
+
+def serving_mesh(num_devices: int = 0) -> Optional[Mesh]:
+    """1-D serving mesh over the first ``num_devices`` visible devices
+    (0 = all). None when only one device would participate — the
+    single-device fast path then skips placement entirely, keeping the
+    compiled programs identical to the non-mesh serving engine."""
+    devs = jax.devices()
+    n = len(devs) if num_devices in (0, None) else min(int(num_devices),
+                                                       len(devs))
+    if n <= 1:
+        return None
+    return Mesh(np.asarray(devs[:n]), (SERVE_AXIS,))
+
+
+def replicate(tree, mesh: Optional[Mesh]):
+    """Replicate a pytree (the packed forest window) on every mesh
+    device. Identity without a mesh."""
+    if mesh is None:
+        return tree
+    return jax.device_put(tree, NamedSharding(mesh, P()))
+
+
+def shard_rows(x, rows_axis: int, mesh: Optional[Mesh]):
+    """Naive sharding of one device array along ``rows_axis``: sharded
+    when divisible by the mesh size, replicated otherwise (SNIPPETS [2]
+    ``get_naive_sharding``). Identity without a mesh."""
+    if mesh is None:
+        return x
+    n = mesh.shape[SERVE_AXIS]
+    if x.shape[rows_axis] % n == 0:
+        spec = [None] * x.ndim
+        spec[rows_axis] = SERVE_AXIS
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+    return jax.device_put(x, NamedSharding(mesh, P()))

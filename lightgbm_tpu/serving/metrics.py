@@ -1,0 +1,82 @@
+"""Latency accounting for the serving tier (ISSUE 8).
+
+Percentile math is nearest-rank on the sorted sample (the convention
+load-testing tools report: p99 is the smallest observed latency that at
+least 99% of requests beat or meet — never an interpolated value that no
+request actually experienced). p999 = 99.9th percentile, the tail the
+north star cares about under "heavy traffic from millions of users".
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, List, Sequence
+
+PERCENTILES = (50.0, 99.0, 99.9)
+
+
+def percentile(samples: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile: the ceil(q/100 * n)-th smallest sample.
+
+    Exact observed values only (p100 == max, p0+ == min); NaN on an
+    empty sample set. ``samples`` need not be sorted."""
+    xs = sorted(samples)
+    n = len(xs)
+    if n == 0:
+        return float("nan")
+    if q <= 0.0:
+        return xs[0]
+    rank = int(math.ceil(q / 100.0 * n))
+    return xs[min(max(rank, 1), n) - 1]
+
+
+def latency_summary_ms(samples_sec: Iterable[float],
+                       percentiles: Sequence[float] = PERCENTILES
+                       ) -> Dict[str, float]:
+    """Summary dict of latencies given in SECONDS, reported in ms with
+    the p50/p99/p999 keys the bench records and the load generator
+    share (p99.9 renders as ``p999_ms``)."""
+    xs = sorted(samples_sec)
+    out: Dict[str, float] = {"n": len(xs)}
+    for q in percentiles:
+        key = f"p{q:g}".replace(".", "")      # 50 -> p50, 99.9 -> p999
+        out[f"{key}_ms"] = round(percentile(xs, q) * 1e3, 3) if xs \
+            else float("nan")
+    if xs:
+        out["mean_ms"] = round(sum(xs) / len(xs) * 1e3, 3)
+        out["max_ms"] = round(xs[-1] * 1e3, 3)
+    return out
+
+
+class LatencyRecorder:
+    """Thread-safe latency sample sink with a bounded memory footprint.
+
+    Keeps up to ``cap`` most-recent samples (a ring); the summary is
+    computed over what is retained. Sized so hours of sustained load
+    cannot grow host memory unboundedly, while percentile resolution at
+    p999 stays meaningful (cap 200k -> 200 samples beyond p999)."""
+
+    def __init__(self, cap: int = 200_000):
+        self.cap = int(cap)
+        self._lock = threading.Lock()
+        self._buf: List[float] = []
+        self._next = 0
+        self.total = 0            # samples ever recorded
+
+    def record(self, latency_sec: float) -> None:
+        with self._lock:
+            self.total += 1
+            if len(self._buf) < self.cap:
+                self._buf.append(latency_sec)
+            else:
+                self._buf[self._next] = latency_sec
+                self._next = (self._next + 1) % self.cap
+
+    def samples(self) -> List[float]:
+        with self._lock:
+            return list(self._buf)
+
+    def summary_ms(self) -> Dict[str, float]:
+        out = latency_summary_ms(self.samples())
+        out["n"] = self.total      # report TRUE count, not the ring size
+        return out

@@ -1,0 +1,52 @@
+"""Shared bench-artifact IO for the serving scripts (ISSUE 8).
+
+ONE copy of the session-driver contract: every `bench_logs/SERVING*.json`
+writer goes through `write_record` (mkdir + pretty JSON + the stdout
+echo the driver tails) and classifies failures through
+`classify_status` (bench.py's grammar: transient device symptoms are
+"device_unreachable", anything else "no_result") — three scripts
+drifting on this grammar is the bug class the helper removes.
+
+Deliberately jax-free: bench_serving_ab.py runs pure-ctypes.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+
+def write_record(path: str, record: dict) -> dict:
+    """Write one status-bearing record and echo it for the driver."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    print(json.dumps(record), flush=True)
+    return record
+
+
+def classify_status(exc: BaseException) -> str:
+    """bench.py's failure grammar: "device_unreachable" only for
+    transient device symptoms (the 0.0 says nothing about the code
+    under test), "no_result" otherwise."""
+    from lightgbm_tpu.robustness.retry import is_transient_error
+    return "device_unreachable" if is_transient_error(exc) \
+        else "no_result"
+
+
+def read_previous_measured(path: str) -> dict | None:
+    """Last MEASURED record at ``path``, if any — either the file
+    itself (a legacy record without "status" WAS a measurement) or the
+    measurement a previous failure run already stashed under
+    "previous", so consecutive failure runs never discard it."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding="utf-8") as f:
+            prev = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if prev.get("status", "measured") == "measured":
+        return prev
+    nested = prev.get("previous")
+    return nested if isinstance(nested, dict) else None

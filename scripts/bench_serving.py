@@ -1,13 +1,21 @@
-"""Serving-throughput benchmark: native C predict vs the Python path.
+"""Serving-throughput benchmark: native C predict vs the Python paths.
 
 The reference serves predictions through an OMP row-parallel C++ loop
 (ref: src/application/predictor.hpp:31); our serving surface is
 native/c_api.cpp's interpreter-free model parser + ParallelRows thread
-pool. This script times both of this framework's paths on the same
-model/data and writes bench_logs/SERVING.json:
+pool, plus the packed-forest device route (ops/forest.py). This script
+times the paths on the same model/data and writes
+bench_logs/SERVING.json under bench.py's status grammar
+("measured" / "device_unreachable" / "no_result" — the session driver
+keys on it):
 
 - native C ABI  (LGBM_BoosterPredictForMat via ctypes, f32 rows)
-- Python API    (Booster.predict -> jitted device path)
+- Python API    (Booster.predict host walk — the API default)
+- device route  (Booster.predict(device=True) -> packed-forest engine)
+
+An already-set JAX_PLATFORMS is honored (ISSUE 8 satellite): inside a
+TPU session the device route measures the real accelerator; only an
+unset environment pins CPU so a bare local run stays deterministic.
 
 Shapes follow the reference's serving sweet spot: a 100-tree, 31-leaf
 binary model over [N, 28] dense f32. Run with N=1000000 for the
@@ -18,14 +26,14 @@ Usage: python scripts/bench_serving.py [nrows] [ntrees]
 from __future__ import annotations
 
 import ctypes
-import json
 import os
 import sys
 import time
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if not os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 
@@ -35,9 +43,7 @@ REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 OUT = os.path.join(REPO, "bench_logs", "SERVING.json")
 
 
-def main() -> int:
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
-    n_trees = int(sys.argv[2]) if len(sys.argv) > 2 else 100
+def run(n: int, n_trees: int) -> dict:
     import lightgbm_tpu as lgb
     from lightgbm_tpu.native import get_lib
 
@@ -80,33 +86,59 @@ def main() -> int:
     native_dt = min(run_native() for _ in range(3))
     native_rps = n / native_dt
 
-    # ---- python path (jitted batch predict) ----
-    bst.predict(X[:1024])              # compile warm-up
+    # ---- python path (host walk, the API default) ----
+    # jaxlint: disable=JL005 — both predict routes return a
+    # host-materialized np.ndarray (predict_device ends in np.asarray),
+    # a real barrier: the timing measures execution, not dispatch
     t = time.perf_counter()
     py_pred = bst.predict(X)
     py_dt = time.perf_counter() - t
     py_rps = n / py_dt
 
-    # agreement guard: both paths must produce the same scores
+    # ---- device route (packed-forest engine; real accelerator when
+    # JAX_PLATFORMS points at one). Warm at the FULL request shape:
+    # N rows land in a different bucket_rows shape than a small
+    # warm-up batch, and the large-batch compile must not sit inside
+    # the timed region the native route measures min-of-3 against ----
+    bst.predict(X, device=True)                  # compile + pack warm-up
+    t = time.perf_counter()
+    dev_pred = bst.predict(X, device=True)
+    dev_dt = time.perf_counter() - t
+    dev_rps = n / dev_dt
+
+    # agreement guard: all paths must produce the same scores
     np.testing.assert_allclose(out, py_pred, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(out, dev_pred, rtol=1e-5, atol=1e-6)
 
     nthreads = os.cpu_count()
-    result = {
+    return {
         "rows": n, "trees": n_trees, "host_threads": nthreads,
+        "backend": jax.default_backend(),
         "native_rows_per_sec": round(native_rps),
         "native_sec": round(native_dt, 3),
         "python_rows_per_sec": round(py_rps),
         "python_sec": round(py_dt, 3),
+        "device_rows_per_sec": round(dev_rps),
+        "device_sec": round(dev_dt, 3),
         # ref CPU-16 Higgs predict is not directly comparable from this
         # 1-core host; record the per-thread figure for scaling math
         "native_rows_per_sec_per_thread": round(native_rps / nthreads),
+        "status": "measured",
     }
-    os.makedirs(os.path.dirname(OUT), exist_ok=True)
-    with open(OUT, "w", encoding="utf-8") as f:
-        json.dump(result, f, indent=1)
-        f.write("\n")
-    print(json.dumps(result), flush=True)
-    return 0
+
+
+def main() -> int:
+    from _bench_io import classify_status, write_record
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    n_trees = int(sys.argv[2]) if len(sys.argv) > 2 else 100
+    base = {"rows": n, "trees": n_trees}
+    try:
+        write_record(OUT, run(n, n_trees))
+        return 0
+    except Exception as e:  # noqa: BLE001 — classified into the grammar
+        write_record(OUT, dict(base, status=classify_status(e),
+                               note=repr(e)))
+        return 1
 
 
 if __name__ == "__main__":

@@ -58,6 +58,19 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
+echo "== serving smoke (micro-batch parity + hot-swap + 0-retrace, 2-dev CPU) =="
+# ISSUE 8: micro-batched responses bit-identical to the direct device
+# path, mixed-size bursts compile nothing (coalesced totals reuse the
+# pow2/octave buckets), trees published into the live server mid-load
+# never produce a torn response, and the queue drains on shutdown —
+# on a 2-virtual-device serving mesh.
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python scripts/serving_smoke.py || rc=1
+if [ $rc -ne 0 ]; then
+    echo "check.sh: serving smoke failed — skipping tier-1 pytest" >&2
+    exit $rc
+fi
+
 echo "== hist smoke (sorted-segment level kernel parity + fallback, CPU) =="
 # ISSUE 6: the one-launch pallas_level kernel must be bit-identical to
 # the blocks/scatter formulations on ragged segments (f32 dyadic +

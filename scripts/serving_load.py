@@ -1,0 +1,338 @@
+"""Concurrent-serving load generator (ISSUE 8): sustained QPS + tail
+latency for the serving tier, device and native-C-ABI routes side by
+side.
+
+Drives N concurrent clients against ``Booster.serve()`` (the dynamic
+micro-batcher + mesh-replicated packed forest) and, when the native
+library is available, against the C ABI's OMP row-parallel predictor
+(the analogue of the reference's src/application/predictor.hpp:31 route)
+— and reports, per route:
+
+- sustained QPS and rows/sec over the measurement window
+- p50 / p99 / p999 request latency (client-observed; open-loop mode
+  measures from the INTENDED Poisson arrival time, so queueing delay
+  from a saturated server is charged to the request — no coordinated
+  omission)
+- the single-stream baseline (one client, direct device predict at the
+  same request size) and the concurrent speedup over it
+
+Traffic modes: ``closed`` (each client submits, waits, repeats —
+throughput-coupled) and ``open`` (Poisson arrivals at --rate req/s
+total, the honest latency-under-load model).
+
+Results land in bench_logs/SERVING_LOAD.json under bench.py's status
+grammar (measured / device_unreachable / no_result) so the session
+driver can key on them.
+
+Usage:
+  python scripts/serving_load.py [--clients 8] [--rows 64]
+      [--duration 10] [--mode closed|open] [--rate 200]
+      [--devices 2] [--trees 60] [--leaves 31] [--linger-ms 2]
+      [--publish-every 0] [--skip-native]
+
+--devices D > 1 on a CPU host re-execs with D virtual XLA devices;
+an already-set JAX_PLATFORMS (e.g. a TPU session) is honored.
+"""
+from __future__ import annotations
+
+import argparse
+import ctypes
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+OUT = os.path.join(REPO, "bench_logs", "SERVING_LOAD.json")
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--clients", type=int, default=16)
+    ap.add_argument("--rows", type=int, default=32,
+                    help="rows per request")
+    ap.add_argument("--duration", type=float, default=10.0,
+                    help="measurement seconds per route")
+    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--rate", type=float, default=200.0,
+                    help="open-loop total arrival rate (req/s)")
+    ap.add_argument("--devices", type=int, default=2,
+                    help="serving mesh width (>1 on CPU re-execs with "
+                         "virtual devices)")
+    ap.add_argument("--trees", type=int, default=60)
+    ap.add_argument("--leaves", type=int, default=31)
+    ap.add_argument("--linger-ms", type=float, default=2.0)
+    ap.add_argument("--max-batch", type=int, default=4096)
+    ap.add_argument("--publish-every", type=float, default=0.0,
+                    help="hot-swap cadence: train+publish one iteration "
+                         "into the live server every S seconds (0=off)")
+    ap.add_argument("--skip-native", action="store_true")
+    ap.add_argument("--out", default=OUT)
+    return ap.parse_args(argv)
+
+
+def ensure_virtual_devices(n: int) -> None:
+    """Re-exec with n virtual CPU devices when needed. Honors an
+    already-set JAX_PLATFORMS: a TPU session's real devices are used
+    as-is (the satellite fix bench_serving.py shares)."""
+    if n <= 1:
+        return
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    if plat and "cpu" not in plat.lower():
+        return                                   # real accelerator mesh
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        return
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.execv(sys.executable, [sys.executable] + sys.argv)
+
+
+def run_clients(n_clients, duration, make_request, do_request):
+    """Closed-loop: each client thread submits, waits, repeats.
+    Returns (latencies_sec, n_done, wall_sec, errors)."""
+    lats, errs = [], []
+    lock = threading.Lock()
+    stop = time.perf_counter() + duration
+
+    def client(i):
+        rng = random.Random(i)
+        my_lats = []
+        try:
+            while time.perf_counter() < stop:
+                X = make_request(rng)
+                t0 = time.perf_counter()
+                try:
+                    do_request(X)
+                except Exception as e:  # noqa: BLE001 — in the record
+                    with lock:
+                        errs.append(repr(e))
+                    return
+                my_lats.append(time.perf_counter() - t0)
+        finally:
+            # a client that dies mid-run still contributes everything
+            # it completed — dropping them would bias QPS and the
+            # percentiles low while the record claims errors=1
+            with lock:
+                lats.extend(my_lats)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(duration + 120)
+    return lats, len(lats), time.perf_counter() - t0, errs
+
+
+def run_open_loop(rate, duration, make_request, submit):
+    """Open loop: Poisson arrivals at `rate` req/s; latency measured
+    from the INTENDED arrival time (queueing under saturation counts)."""
+    rng = random.Random(0)
+    pending = []
+    errs = []
+    t0 = time.perf_counter()
+    next_t = t0
+    while True:
+        next_t += rng.expovariate(rate)
+        if next_t - t0 > duration:
+            break
+        now = time.perf_counter()
+        if next_t > now:
+            time.sleep(next_t - now)
+        try:
+            pending.append((next_t, submit(make_request(rng))))
+        except Exception as e:  # noqa: BLE001
+            errs.append(repr(e))
+    lats = []
+    for intended, fut in pending:
+        try:
+            fut.result(timeout=120)
+            lats.append(fut.t_done - intended)
+        except Exception as e:  # noqa: BLE001
+            errs.append(repr(e))
+    return lats, len(lats), time.perf_counter() - t0, errs
+
+
+def route_record(lats, n_done, wall, rows_per_req, errs) -> dict:
+    from lightgbm_tpu.serving.metrics import latency_summary_ms
+    rec = {"qps": round(n_done / wall, 1),
+           "rows_per_sec": round(n_done * rows_per_req / wall, 1),
+           "requests": n_done, "wall_sec": round(wall, 2),
+           "errors": len(errs)}
+    rec.update(latency_summary_ms(lats))
+    if errs:
+        rec["first_error"] = errs[0]
+    return rec
+
+
+def main() -> int:
+    args = parse_args()
+    ensure_virtual_devices(args.devices)
+
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving.metrics import latency_summary_ms
+
+    record = {"metric": "serving_load_qps", "unit": "req/sec",
+              "value": 0.0, "status": "no_result",
+              "mode": args.mode, "clients": args.clients,
+              "rows_per_request": args.rows,
+              "duration_sec": args.duration, "trees": args.trees,
+              "leaves": args.leaves, "linger_ms": args.linger_ms}
+
+    from _bench_io import classify_status, write_record
+
+    def finish(status, note=None) -> int:
+        record["status"] = status
+        if note:
+            record["note"] = note
+        write_record(args.out, record)
+        return 0 if status == "measured" else 1
+
+    try:
+        import jax
+        record["devices"] = len(jax.devices())
+        rng = np.random.default_rng(0)
+        Xtr = rng.normal(size=(60_000, 28)).astype(np.float32)
+        ytr = (Xtr[:, 0] + 0.5 * Xtr[:, 1] ** 2 > 0.5).astype(np.float32)
+        dtrain = lgb.Dataset(Xtr, label=ytr)
+        t0 = time.perf_counter()
+        bst = lgb.train({"objective": "binary", "num_leaves": args.leaves,
+                         "verbosity": -1}, dtrain,
+                        num_boost_round=args.trees)
+        # jaxlint: disable=JL005 — train() returns host-materialized
+        # trees (a real barrier); this times execution, not dispatch
+        print(f"[load] trained {args.trees}x{args.leaves} "
+              f"({time.perf_counter() - t0:.1f}s)", flush=True)
+        pool = np.ascontiguousarray(
+            rng.normal(size=(200_000, 28)).astype(np.float32)
+            .astype(np.float64))
+
+        def make_request(r):
+            off = r.randrange(0, pool.shape[0] - args.rows)
+            return pool[off:off + args.rows]
+
+        # ---- single-stream baseline: one client, direct device path --
+        bst.predict(make_request(random.Random(0)), device=True,
+                    raw_score=True)                       # warm buckets
+        lats, n, wall, errs = run_clients(
+            1, min(args.duration, 5.0), make_request,
+            lambda X: bst.predict(X, device=True, raw_score=True))
+        if errs:
+            return finish("no_result", f"single-stream: {errs[0]}")
+        record["single_stream"] = route_record(lats, n, wall, args.rows,
+                                               errs)
+        single_rps = record["single_stream"]["rows_per_sec"]
+        print(f"[load] single-stream {single_rps:.0f} rows/s "
+              f"{latency_summary_ms(lats)}", flush=True)
+
+        # ---- device route: micro-batched concurrent server -----------
+        srv = bst.serve(linger_ms=args.linger_ms,
+                        max_batch=args.max_batch,
+                        num_devices=args.devices, raw_score=True)
+        for warm_rows in {args.rows, args.rows * max(args.clients, 1)}:
+            srv.predict(pool[:max(warm_rows, 1)], timeout=300)
+        publisher_stop = threading.Event()
+        publisher_err = []
+
+        def publisher():
+            while not publisher_stop.wait(args.publish_every):
+                try:
+                    bst.update()
+                    srv.publish()
+                except Exception as e:  # noqa: BLE001
+                    publisher_err.append(repr(e))
+                    return
+
+        pub_thread = None
+        if args.publish_every > 0:
+            pub_thread = threading.Thread(target=publisher, daemon=True)
+            pub_thread.start()
+        if args.mode == "closed":
+            lats, n, wall, errs = run_clients(
+                args.clients, args.duration, make_request,
+                lambda X: srv.predict(X, timeout=120))
+        else:
+            lats, n, wall, errs = run_open_loop(
+                args.rate, args.duration, make_request, srv.submit)
+        publisher_stop.set()
+        if pub_thread is not None:
+            pub_thread.join(30)
+        dev = route_record(lats, n, wall, args.rows, errs)
+        dev["server"] = srv.stats()
+        if publisher_err:
+            dev["publish_error"] = publisher_err[0]
+        if args.publish_every > 0:
+            dev["published_generations"] = srv.generation.version
+        dev["speedup_vs_single_stream"] = round(
+            dev["rows_per_sec"] / single_rps, 2) if single_rps else 0.0
+        record["device"] = dev
+        record["value"] = dev["qps"]
+        srv.close()
+        print(f"[load] device route {dev['qps']:.0f} req/s "
+              f"({dev['rows_per_sec']:.0f} rows/s, "
+              f"{dev['speedup_vs_single_stream']}x single-stream) "
+              f"p50={dev.get('p50_ms')}ms p99={dev.get('p99_ms')}ms "
+              f"p999={dev.get('p999_ms')}ms", flush=True)
+
+        # ---- native C-ABI route (OMP row-parallel reference analogue) -
+        if not args.skip_native:
+            record["native"] = native_route(bst, make_request, args)
+            if "qps" in record["native"]:
+                print(f"[load] native route {record['native']['qps']:.0f} "
+                      f"req/s p99={record['native'].get('p99_ms')}ms",
+                      flush=True)
+        if errs and not lats:
+            return finish("no_result", f"device route: {errs[0]}")
+        return finish("measured")
+    except Exception as e:  # noqa: BLE001 — classified into the grammar
+        return finish(classify_status(e), repr(e))
+
+
+def native_route(bst, make_request, args) -> dict:
+    """Closed-loop clients over the native C ABI (ctypes releases the
+    GIL during LGBM_BoosterPredictForMat, so N python threads exercise
+    the ParallelRows pool concurrently)."""
+    from lightgbm_tpu.native import get_lib
+    lib = get_lib()
+    if lib is None:
+        return {"status": "unavailable", "note": "native library missing"}
+    import numpy as np
+    model_file = os.path.join(REPO, "bench_logs", "serving_load_model.txt")
+    os.makedirs(os.path.dirname(model_file), exist_ok=True)
+    bst.save_model(model_file)
+    handle = ctypes.c_void_p()
+    n_iters = ctypes.c_int()
+    rc = lib.LGBM_BoosterCreateFromModelfile(
+        model_file.encode(), ctypes.byref(n_iters), ctypes.byref(handle))
+    if rc != 0:
+        return {"status": "unavailable", "note": "model load failed"}
+    local = threading.local()
+
+    def do_request(X):
+        if not hasattr(local, "buf"):
+            local.buf = np.empty(args.rows, np.float64)
+            local.out_len = ctypes.c_int64()
+        Xf = np.ascontiguousarray(X, np.float32)
+        r = lib.LGBM_BoosterPredictForMat(
+            handle, Xf.ctypes.data_as(ctypes.c_void_p), 0,
+            ctypes.c_int32(args.rows), ctypes.c_int32(X.shape[1]), 1,
+            0, 0, -1, b"", ctypes.byref(local.out_len),
+            local.buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        if r != 0:
+            raise RuntimeError("native predict failed")
+
+    do_request(make_request(random.Random(0)))            # warm
+    lats, n, wall, errs = run_clients(args.clients, args.duration,
+                                      make_request, do_request)
+    return route_record(lats, n, wall, args.rows, errs)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,162 @@
+"""Serving-tier smoke gate (ISSUE 8): coalescing parity, zero-downtime
+hot-swap, and the 0-retrace budget over mixed request sizes — on CPU
+with 2 VIRTUAL devices so the mesh replication + request sharding path
+is exercised, <30 s.
+
+Asserts, end to end through ``Booster.serve()``:
+  1. micro-batched responses are BIT-IDENTICAL to the direct
+     ``predict(device=True)`` path for every coalesced request, and
+     coalescing actually happened (fewer batches than requests);
+  2. after warming the row buckets, a burst of mixed-size concurrent
+     requests compiles NOTHING (<= 2 traces, measured 0) — coalesced
+     totals land in the same pow2/octave bucket family the
+     single-request path uses;
+  3. trees published into the live server mid-load produce zero failed
+     or torn responses: every response matches exactly one published
+     generation's model, versions move forward only;
+  4. the queue drains on shutdown (every accepted request answered).
+
+Wired into scripts/check.sh; exits non-zero on the first violated gate.
+"""
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=2"
+                           ).strip()
+
+import jax  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+T_START = time.perf_counter()
+BUDGET_SEC = 30.0
+
+
+def check(cond, what):
+    took = time.perf_counter() - T_START
+    if not cond:
+        print(f"serving_smoke: FAIL {what} ({took:.1f}s)", file=sys.stderr)
+        sys.exit(1)
+    print(f"serving_smoke: ok {what} ({took:.1f}s)")
+
+
+def main() -> int:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.analysis import guards
+
+    check(len(jax.devices()) == 2, f"2 virtual devices ({jax.devices()})")
+
+    rng = np.random.default_rng(7)
+    n, f = 1200, 8
+    X = rng.normal(size=(n, f)).astype(np.float32).astype(np.float64)
+    y = np.nan_to_num(X[:, 0]) + 0.5 * np.nan_to_num(X[:, 1]) ** 2
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "verbose": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=6,
+                    keep_training_booster=True)
+
+    srv = bst.serve(linger_ms=50.0, raw_score=True, num_devices=2)
+    check(srv.stats()["mesh_devices"] == 2, "serving mesh spans 2 devices")
+
+    # 1. coalescing parity: mixed sizes submitted together, every
+    # response bit-identical to the direct device path
+    sizes = (37, 120, 64, 81, 200)
+    futs = [srv.submit(X[sum(sizes[:i]):sum(sizes[:i]) + s])
+            for i, s in enumerate(sizes)]
+    for i, (s, fut) in enumerate(zip(sizes, futs)):
+        lo = sum(sizes[:i])
+        direct = bst.predict(X[lo:lo + s], device=True, raw_score=True)
+        check(np.array_equal(fut.result(120), direct),
+              f"micro-batched request {i} ({s} rows) bit-identical")
+    check(srv.stats()["batches"] < len(sizes),
+          f"coalescing happened ({srv.stats()['batches']} batches for "
+          f"{len(sizes)} requests)")
+
+    # 2. retrace budget: warm the 256/512 buckets, then mixed-size
+    # bursts whose coalesced totals stay inside them -> 0 new traces
+    for warm in (200, 500):
+        srv.predict(X[:warm], timeout=120)
+    with guards.CompileCounter() as counter:
+        for burst in range(4):
+            fs = [srv.submit(X[j * 80:j * 80 + 10 + 13 * j])
+                  for j in range(5)]          # 10..62 rows, <=230 total
+            for fut in fs:
+                fut.result(120)
+            srv.predict(X[:300], timeout=120)  # lands in the 512 bucket
+    check(counter.count <= 2,
+          f"compile budget: {counter.count} traces over mixed-size "
+          f"bursts (<=2) {counter.names if counter.count else ''}")
+
+    # 3. hot-swap under load: zero failed or torn responses
+    probe = X[:64]
+    expected = {srv.generation.version:
+                bst.predict(probe, device=True, raw_score=True)}
+    stop = threading.Event()
+    seen, errors = [], []
+
+    def client():
+        while not stop.is_set():
+            try:
+                fut = srv.submit(probe)
+                out = fut.result(120)          # fulfills .generation
+                seen.append((fut.generation.version, out))
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    for _ in range(2):
+        time.sleep(0.05)
+        bst.update()
+        info = srv.publish()
+        expected[info.version] = bst.predict(probe, device=True,
+                                             raw_score=True)
+    time.sleep(0.1)
+    stop.set()
+    for t in threads:
+        t.join(60)
+    final = srv.submit(probe)          # deterministic: sees the last gen
+    final_out = final.result(120)
+    check(not errors and len(seen) > 0,
+          f"hot-swap load: {len(seen)} responses, 0 errors {errors[:1]}")
+    versions = [v for v, _ in seen]
+    check(all(np.array_equal(out, expected[v]) for v, out in seen),
+          "every response matches exactly one published generation "
+          "(never torn)")
+    check(versions == sorted(versions) and
+          final.generation.version == 3 and
+          np.array_equal(final_out, expected[3]),
+          f"generations move forward only ({versions[0]}→"
+          f"{final.generation.version})")
+
+    # 4. drain on shutdown
+    tail = [srv.submit(X[:32]) for _ in range(8)]
+    srv.close(timeout=60)
+    check(all(t.done() for t in tail), "queue drained on shutdown")
+    try:
+        srv.submit(X[:8])
+        check(False, "submit after close must raise")
+    except RuntimeError:
+        check(True, "submit after close raises")
+
+    took = time.perf_counter() - T_START
+    # advisory on a cold compile cache (first-ever run pays the grower
+    # compiles, same policy as ingest_smoke)
+    if took >= BUDGET_SEC:
+        print(f"serving_smoke: WARN wall {took:.1f}s >= {BUDGET_SEC:.0f}s "
+              "(cold compile cache?)", file=sys.stderr)
+    print(f"serving_smoke: PASS in {took:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

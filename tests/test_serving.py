@@ -1,0 +1,336 @@
+"""Concurrent serving tier (ISSUE 8): micro-batcher coalescing,
+bit-identity vs the direct device path, zero-downtime hot-swap,
+drain-on-shutdown, mesh placement, and the percentile math units."""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import (Generation, MicroBatcher, ModelServer,
+                                  latency_summary_ms, percentile)
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+@pytest.fixture(scope="module")
+def booster():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(1500, 8)).astype(np.float32).astype(np.float64)
+    y = X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.1 * rng.normal(size=len(X))
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "verbose": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    return bst, X, y
+
+
+# ---------------------------------------------------------------------------
+# percentile math units
+# ---------------------------------------------------------------------------
+
+def test_percentile_nearest_rank():
+    xs = list(range(1, 101))          # 1..100
+    assert percentile(xs, 50) == 50
+    assert percentile(xs, 99) == 99
+    assert percentile(xs, 99.9) == 100
+    assert percentile(xs, 100) == 100
+    assert percentile(xs, 0) == 1
+    assert percentile([42.0], 99.9) == 42.0
+    assert np.isnan(percentile([], 50))
+    # unsorted input must not matter
+    assert percentile([5, 1, 3, 2, 4], 50) == 3
+
+
+def test_percentile_is_an_observed_sample():
+    # nearest-rank never interpolates: the result is always a sample
+    xs = [1.0, 10.0, 100.0, 1000.0]
+    for q in (1, 25, 50, 75, 99, 99.9):
+        assert percentile(xs, q) in xs
+
+
+def test_latency_summary_keys_and_units():
+    s = latency_summary_ms([0.001] * 999 + [0.5])
+    assert s["n"] == 1000
+    assert s["p50_ms"] == 1.0
+    assert s["p99_ms"] == 1.0
+    assert s["p999_ms"] == 500.0      # the 1000th sample is the tail
+    assert s["max_ms"] == 500.0
+    assert latency_summary_ms([])["n"] == 0
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher mechanics (spy dispatch, no jax)
+# ---------------------------------------------------------------------------
+
+def test_batcher_coalesces_and_respects_max_batch():
+    batches = []
+
+    def dispatch(X):
+        batches.append(X.shape[0])
+        return X[:, 0], Generation(1, 0, 0)
+
+    mb = MicroBatcher(dispatch, max_batch=100, linger_ms=200.0)
+    reqs = [mb.submit(np.full((30, 2), i, float)) for i in range(5)]
+    vals = [r.result(10) for r in reqs]
+    mb.close()
+    # 5x30 rows under max_batch=100 -> batches of at most 3 requests
+    assert max(batches) <= 100
+    assert sum(batches) == 150
+    assert len(batches) >= 2          # the 4th request cannot fit in one
+    for i, v in enumerate(vals):      # row-aligned split per request
+        assert v.shape == (30,) and np.all(v == i)
+    assert mb.n_batches == len(batches)
+
+
+def test_batcher_oversize_request_is_its_own_batch():
+    sizes = []
+
+    def dispatch(X):
+        sizes.append(X.shape[0])
+        return X[:, 0], None
+
+    mb = MicroBatcher(dispatch, max_batch=64, linger_ms=1.0)
+    r = mb.submit(np.zeros((300, 2)))
+    assert r.result(10).shape == (300,)
+    mb.close()
+    assert sizes == [300]
+
+
+def test_batcher_queue_drains_on_shutdown():
+    slow = threading.Event()
+
+    def dispatch(X):
+        slow.wait(0.01)
+        return X[:, 0], None
+
+    mb = MicroBatcher(dispatch, max_batch=8, linger_ms=0.0)
+    reqs = [mb.submit(np.zeros((4, 2))) for _ in range(40)]
+    mb.close(timeout=30)              # drain everything already accepted
+    assert all(r.done() for r in reqs)
+    assert all(r.result(0).shape == (4,) for r in reqs)
+    with pytest.raises(RuntimeError):
+        mb.submit(np.zeros((4, 2)))   # closed
+
+
+def test_batcher_dispatch_error_fails_the_batch_only():
+    calls = []
+
+    def dispatch(X):
+        calls.append(X.shape[0])
+        if len(calls) == 1:
+            raise RuntimeError("boom")
+        return X[:, 0], None
+
+    mb = MicroBatcher(dispatch, max_batch=1000, linger_ms=50.0)
+    bad = mb.submit(np.zeros((3, 2)))
+    with pytest.raises(RuntimeError, match="boom"):
+        bad.result(10)
+    ok = mb.submit(np.zeros((3, 2)))
+    assert ok.result(10).shape == (3,)
+    mb.close()
+    assert mb.n_errors == 1
+
+
+def test_batcher_rejects_empty_requests():
+    mb = MicroBatcher(lambda X: (X[:, 0], None))
+    with pytest.raises(ValueError):
+        mb.submit(np.zeros((0, 2)))
+    with pytest.raises(ValueError):
+        mb.submit(np.zeros(3))
+    mb.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end server: bit-identity, hot-swap, lifecycle
+# ---------------------------------------------------------------------------
+
+def test_microbatched_bit_identical_to_predict_device(booster):
+    bst, X, _ = booster
+    with bst.serve(linger_ms=100.0, raw_score=True) as srv:
+        reqs = [X[i * 83:(i + 1) * 83 + 7 * i] for i in range(5)]
+        futs = [srv.submit(r) for r in reqs]
+        for r, f in zip(reqs, futs):
+            direct = bst.predict(r, device=True, raw_score=True)
+            got = f.result(60)
+            # bit-identical: same traversal + same f32 accumulation
+            # order per row, regardless of how requests coalesced
+            assert np.array_equal(got, direct)
+        stats = srv.stats()
+        assert stats["batches"] < len(reqs)       # coalescing happened
+        assert stats["requests"] == len(reqs)
+
+
+def test_server_converted_output_matches_booster_predict(booster):
+    bst, X, _ = booster
+    with bst.serve(linger_ms=1.0) as srv:
+        got = srv.predict(X[:200], timeout=60)
+        assert np.array_equal(got, bst.predict(X[:200], device=True))
+
+
+def test_server_hot_swap_under_load_never_torn(booster):
+    bst, X, _ = booster
+    probe = X[:64]
+    # independent booster so the module fixture stays 5 iterations
+    rng = np.random.default_rng(3)
+    Xb = rng.normal(size=(800, 6)).astype(np.float32).astype(np.float64)
+    yb = Xb[:, 0] - Xb[:, 1]
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "verbose": -1, "min_data_in_leaf": 5},
+                  lgb.Dataset(Xb, label=yb), num_boost_round=3,
+                  keep_training_booster=True)
+    probe = Xb[:64]
+    srv = b.serve(linger_ms=0.5, raw_score=True)
+    expected = {srv.generation.version:
+                b.predict(probe, device=True, raw_score=True)}
+    stop = threading.Event()
+    seen = []                          # (version, matched) per response
+    errors = []
+
+    def client():
+        while not stop.is_set():
+            try:
+                f = srv.submit(probe)
+                v = f.result(60)
+                seen.append((f.generation.version, v))
+            except Exception as e:     # noqa: BLE001
+                errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    for _ in range(3):                 # publish 3 new generations mid-load
+        time.sleep(0.05)
+        b.update()
+        info = srv.publish()
+        expected[info.version] = b.predict(probe, device=True,
+                                           raw_score=True)
+    time.sleep(0.1)
+    stop.set()
+    for t in threads:
+        t.join(60)
+    # one deterministic post-publish request: the LAST generation serves
+    final = srv.submit(probe)
+    final_out = final.result(60)
+    srv.close()
+    assert not errors, errors
+    assert len(seen) > 0
+    versions = [v for v, _ in seen]
+    # every response is attributable to exactly one published
+    # generation and is bit-identical to that generation's model —
+    # a torn pack would match neither
+    for v, out in seen:
+        assert v in expected
+        assert np.array_equal(out, expected[v]), \
+            f"response from generation {v} matches no published model"
+    # generations only move forward (batches serialize on one snapshot)
+    assert versions == sorted(versions)
+    assert final.generation.version == 4   # all 3 publishes visible
+    assert np.array_equal(final_out, expected[4])
+
+
+def test_server_publish_after_rollback_full_repack(booster):
+    rng = np.random.default_rng(5)
+    Xb = rng.normal(size=(600, 5)).astype(np.float32).astype(np.float64)
+    yb = Xb[:, 0] * 2.0
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "verbose": -1, "min_data_in_leaf": 5},
+                  lgb.Dataset(Xb, label=yb), num_boost_round=3,
+                  keep_training_booster=True)
+    srv = b.serve(linger_ms=0.5, raw_score=True)
+    before = srv.predict(Xb[:50], timeout=60)
+    b.rollback_one_iter()              # destructive: bumps model gen
+
+    def fobj(preds, _):
+        g = np.asarray(preds - yb * 1.5, np.float32)
+        return g, np.ones_like(g)
+
+    b.update(fobj=fobj)
+    info = srv.publish()
+    after = srv.predict(Xb[:50], timeout=60)
+    srv.close()
+    assert info.num_trees == 3
+    assert np.array_equal(after, b.predict(Xb[:50], device=True,
+                                           raw_score=True))
+    assert not np.array_equal(before, after)
+
+
+def test_server_loaded_model_raw_route(booster):
+    bst, X, _ = booster
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    Xf = np.asarray(X[:128], np.float32).astype(np.float64)
+    with loaded.serve(linger_ms=1.0, raw_score=True) as srv:
+        got = srv.predict(Xf, timeout=60)
+        assert np.array_equal(
+            got, loaded.predict(Xf, device=True, raw_score=True))
+
+
+def test_server_knobs_resolve_from_params():
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(500, 4)).astype(np.float64)
+    y = X[:, 0]
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbose": -1, "min_data_in_leaf": 5,
+                     "tpu_serving_max_batch": 512,
+                     "tpu_serving_linger_ms": 7.5},
+                    lgb.Dataset(X, label=y), num_boost_round=2)
+    with bst.serve() as srv:
+        s = srv.stats()
+        assert s["max_batch"] == 512
+        assert s["linger_ms"] == pytest.approx(7.5)
+    with bst.serve(max_batch=64) as srv:     # kwarg overrides param
+        assert srv.stats()["max_batch"] == 64
+
+
+def test_generation_tuple_fields(booster):
+    bst, X, _ = booster
+    with bst.serve(linger_ms=0.5) as srv:
+        g = srv.generation
+        assert isinstance(g, Generation)
+        assert g.version == 1
+        assert g.num_trees == bst.num_trees()
+        f = srv.submit(X[:16])
+        f.result(60)
+        assert f.generation == g
+        assert f.latency_sec is not None and f.latency_sec >= 0
+
+
+def test_server_mesh_two_virtual_devices_subprocess(booster):
+    """Mesh replication needs >1 device, which needs XLA_FLAGS before
+    jax import — so the 2-virtual-device parity proof runs in a
+    subprocess (same pattern as the multiprocess suite)."""
+    code = r"""
+import numpy as np
+import jax
+import lightgbm_tpu as lgb
+assert len(jax.devices()) == 2, jax.devices()
+rng = np.random.default_rng(0)
+X = rng.normal(size=(600, 6)).astype(np.float32).astype(np.float64)
+y = X[:, 0] + X[:, 1]
+bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                 "verbose": -1, "min_data_in_leaf": 5},
+                lgb.Dataset(X, label=y), num_boost_round=3)
+srv = bst.serve(linger_ms=20.0, raw_score=True, num_devices=2)
+assert srv.stats()["mesh_devices"] == 2
+futs = [srv.submit(X[i * 100:(i + 1) * 100]) for i in range(4)]
+for i, f in enumerate(futs):
+    direct = bst.predict(X[i * 100:(i + 1) * 100], device=True,
+                         raw_score=True)
+    assert np.array_equal(f.result(120), direct)
+srv.close()
+print("MESH_OK")
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=2").strip()
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         env=env, capture_output=True, text=True,
+                         timeout=240)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "MESH_OK" in out.stdout
