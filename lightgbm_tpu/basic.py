@@ -820,15 +820,22 @@ class Booster:
         return self
 
     def serve(self, **kwargs) -> "ModelServer":
-        """Start a concurrent model server over this booster (ISSUE 8,
+        """Start a concurrent model server over this booster (ISSUE 8/9,
         serving/server.py): a dynamic micro-batcher coalesces concurrent
         ``submit()`` requests into the packed-forest engine's compiled
         row buckets, the pack is replicated over the serving mesh with
         request batches sharded across it, and ``ModelServer.publish()``
         hot-swaps newly trained trees into the live server with zero
-        downtime. Knobs default from the ``tpu_serving_*`` params;
-        kwargs (``max_batch``, ``linger_ms``, ``num_devices``,
-        ``queue_depth``, ``raw_score``, ``bucket``) override."""
+        downtime. The failure path is built in: per-request deadlines
+        (expired requests dropped before coalescing), fail-fast
+        admission control (``OVERLOADED`` on a full queue),
+        retry-then-degrade dispatch that falls back to the host walk and
+        probes the device in the background, and publish rollback (a
+        failed publish keeps serving the old generation). Knobs default
+        from the ``tpu_serving_*`` params; kwargs (``max_batch``,
+        ``linger_ms``, ``num_devices``, ``queue_depth``, ``raw_score``,
+        ``bucket``, ``deadline_ms``, ``max_queue_rows``,
+        ``retry_policy``, ``probe_interval_s``) override."""
         from .serving import ModelServer
         return ModelServer(self, **kwargs)
 

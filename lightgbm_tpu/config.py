@@ -326,6 +326,26 @@ _reg("tpu_serving_num_devices", int, 0, (), (0, None, True, False))
 # queued, bounding host memory under overload instead of buffering
 # unboundedly.
 _reg("tpu_serving_queue_depth", int, 8192, (), (1, None, True, False))
+# serving failure path (ISSUE 9). deadline_ms: default per-request
+# deadline — a request still queued past it is dropped BEFORE
+# coalescing (its future fails with DEADLINE_EXCEEDED; it never poisons
+# or pads the batch it would have joined). 0 = no deadline.
+_reg("tpu_serving_deadline_ms", float, 0.0, (), (0.0, None, True, False))
+# admission control: once this many ROWS are queued, submit() fails
+# fast with an OVERLOADED error carrying the queue depth — loud
+# load-shedding instead of accepting work the server cannot serve.
+# 0 = unbounded (blocking backpressure via tpu_serving_queue_depth
+# only). The default (256 max-batches of backlog) is far past any
+# sustainable queue; hitting it means the tier is genuinely drowning.
+_reg("tpu_serving_max_queue_rows", int, 1_048_576, (),
+     (0, None, True, False))
+# degraded-mode recovery cadence: while the server is on the host-walk
+# route (dispatch retry budget exhausted, or a forced degrade) a
+# background thread probes every serving-mesh device this often
+# (seconds) and un-degrades on the first full success. 0 disables the
+# probe — degradation then sticks until the server closes.
+_reg("tpu_serving_probe_interval_s", float, 5.0, (),
+     (0.0, None, True, False))
 # device tracing (SURVEY §5 tracing: jax.profiler traces + the named-
 # section wall-clock table ≡ the reference's USE_TIMETAG global_timer).
 # Set to a directory to capture a jax.profiler trace of the training loop

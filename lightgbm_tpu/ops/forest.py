@@ -34,6 +34,7 @@ import numpy as np
 from .predict import (RawTreeArrays, depth_steps, forest_leaf_bins,
                       tree_leaf_raw)
 from .split import MISSING_ENUM
+from ..robustness import faults
 from ..core.tree import HostTree, TreeArrays, host_tree_to_arrays, \
     max_leaf_depth
 
@@ -231,13 +232,23 @@ class _IncrementalPack:
 
     def _append(self, models: List[HostTree], tail_stacked,
                 tail: List[HostTree]) -> None:
+        # transactional commit (ISSUE 9): an append that dies here — the
+        # injected publish_fail site, a real allocation failure — must
+        # leave the pack EXACTLY as it was. Build everything into locals
+        # first, then assign; there is no partially-appended state for a
+        # publish retry (or a concurrent reader of the old window) to
+        # trip over.
+        faults.maybe_fail("publish_fail")
         if self.stacked is None:
-            self.stacked = tail_stacked
+            stacked = tail_stacked
         else:
-            self.stacked = jax.tree.map(
+            stacked = jax.tree.map(
                 lambda a, b: jnp.concatenate([a, b]),
                 self.stacked, tail_stacked)
-        self.depths.extend(_host_depth(t, self.max_leaves) for t in tail)
+        depths = self.depths + [_host_depth(t, self.max_leaves)
+                                for t in tail]
+        self.stacked = stacked
+        self.depths = depths
         self.count = len(models)
         self._win = None
 

@@ -31,6 +31,23 @@ Fault classes (the ``site`` argument of :func:`maybe_fail`):
   seconds (default 30) while keepalives keep flowing: a benign slow
   remote compile, the case phase-aware supervision must NOT park.
   Consulted via :func:`maybe_delay` at compile-phase entry.
+- ``dispatch_error`` — the serving dispatcher's device scoring
+  (serving/server.py ``_device_scores``) raises a transient
+  :class:`FaultInjected` BEFORE the real dispatch; each retry under the
+  serving RetryPolicy re-consults the fault, and the degraded server's
+  background recovery probe consults it too (so a persistent plan keeps
+  the server degraded until the plan disarms).
+- ``slow_dispatch`` — stretches ONE serving dispatch by ``sec`` seconds
+  (default 30) via :func:`maybe_delay`: the wedged-device shape that
+  request deadlines must convert into ``DEADLINE_EXCEEDED`` failures
+  for the requests queued behind it, never an unbounded stall.
+- ``publish_fail`` — the serving hot-swap dies: consulted in
+  ``ModelServer.publish()`` (before the snapshot is built — call 1) and
+  again inside the incremental pack append (ops/forest.py
+  ``_IncrementalPack._append``, pre-commit — call 2), so both the
+  server-level rollback and the pack's no-torn-state commit are
+  exercised; a bare spec fires at the server site, ``after=1`` reaches
+  the append site.
 
 Options per spec:
 
@@ -43,7 +60,7 @@ Options per spec:
 - ``seed=<int>`` — per-fault RNG seed (default 0): injections are
   deterministic and reproducible across runs and threads.
 - ``sec=<float>`` — duration for delay-style faults (``slow_compile``
-  only; default 30.0).
+  and ``slow_dispatch``; default 30.0).
 
 Counters are PER-PROCESS: an env-installed plan re-arms in every
 subprocess (each child re-runs install_from_env with fresh counters).
@@ -67,7 +84,8 @@ from ..utils import log
 ENV_FAULTS = "LGBM_TPU_FAULTS"
 
 KNOWN_SITES = ("collective", "probe_timeout", "write_kill", "hang",
-               "slow_compile")
+               "slow_compile", "dispatch_error", "slow_dispatch",
+               "publish_fail")
 
 
 class FaultInjected(Exception):

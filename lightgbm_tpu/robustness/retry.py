@@ -133,6 +133,14 @@ COLLECTIVE_POLICY = RetryPolicy(max_attempts=5, base_delay=0.05,
 DEVICE_POLICY = RetryPolicy(max_attempts=6, base_delay=2.0,
                             max_delay=60.0, deadline=900.0)
 
+# Policy for the serving dispatcher (serving/server.py, ISSUE 9): very
+# short sleeps — every queued request is stalled while a batch retries —
+# and a tight deadline: past it the server flips to the degraded
+# host-walk route instead of holding its whole client population
+# hostage to one wedged device.
+SERVING_POLICY = RetryPolicy(max_attempts=3, base_delay=0.02,
+                             max_delay=0.5, deadline=5.0)
+
 
 def retry_call(fn: Callable, *args,
                policy: RetryPolicy = RetryPolicy(),

@@ -1,17 +1,23 @@
-"""Concurrent serving tier (ISSUE 8): dynamic micro-batching into the
+"""Concurrent serving tier (ISSUE 8/9): dynamic micro-batching into the
 packed-forest engine's compiled row buckets, mesh replication of the
-pack with request batches sharded over the devices, and zero-downtime
-hot-swap of newly trained trees via immutable forest snapshots.
+pack with request batches sharded over the devices, zero-downtime
+hot-swap of newly trained trees via immutable forest snapshots — and
+the failure path that makes it survivable: request deadlines, fail-fast
+admission control, retry-then-degrade dispatch with background
+recovery, and publish rollback.
 
 Entry point: ``Booster.serve(...)`` -> :class:`ModelServer`.
 """
-from .batcher import MicroBatcher, PendingRequest
-from .mesh import SERVE_AXIS, serving_mesh, shard_rows
-from .metrics import (LatencyRecorder, latency_summary_ms, percentile)
+from .batcher import (DeadlineExceeded, MicroBatcher, Overloaded,
+                      PendingRequest, ShutdownError)
+from .mesh import SERVE_AXIS, probe, serving_mesh, shard_rows
+from .metrics import (LatencyRecorder, ServingCounters,
+                      latency_summary_ms, percentile)
 from .server import Generation, ModelServer
 
 __all__ = [
-    "Generation", "LatencyRecorder", "MicroBatcher", "ModelServer",
-    "PendingRequest", "SERVE_AXIS", "latency_summary_ms", "percentile",
-    "serving_mesh", "shard_rows",
+    "DeadlineExceeded", "Generation", "LatencyRecorder", "MicroBatcher",
+    "ModelServer", "Overloaded", "PendingRequest", "SERVE_AXIS",
+    "ServingCounters", "ShutdownError", "latency_summary_ms",
+    "percentile", "probe", "serving_mesh", "shard_rows",
 ]

@@ -18,10 +18,26 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 SERVE_AXIS = "serve"
+
+
+def probe(mesh: Optional[Mesh]) -> int:
+    """One tiny synchronous round-trip on EVERY serving-mesh device
+    (the first visible device without a mesh) — the liveness check the
+    degraded server's background recovery loop runs before flipping
+    back to the device route (ISSUE 9). A single healthy chip is not
+    enough to un-degrade a sharded tier: requests are row-sharded over
+    the whole mesh, so every participant must answer. Raises whatever
+    the runtime raises for a wedged device; returns the count probed."""
+    devs = (list(mesh.devices.flat) if mesh is not None
+            else jax.devices()[:1])
+    for d in devs:
+        jax.block_until_ready(jax.device_put(jnp.zeros(8), d) + 1)
+    return len(devs)
 
 
 def serving_mesh(num_devices: int = 0) -> Optional[Mesh]:

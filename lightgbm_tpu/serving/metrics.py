@@ -1,10 +1,17 @@
-"""Latency accounting for the serving tier (ISSUE 8).
+"""Latency + failure-path accounting for the serving tier (ISSUE 8/9).
 
 Percentile math is nearest-rank on the sorted sample (the convention
 load-testing tools report: p99 is the smallest observed latency that at
 least 99% of requests beat or meet — never an interpolated value that no
 request actually experienced). p999 = 99.9th percentile, the tail the
 north star cares about under "heavy traffic from millions of users".
+
+:class:`ServingCounters` is the failure-path ledger (ISSUE 9): every
+shed, expired, retried, degraded, failed-publish or shutdown-failed
+event increments exactly one counter here, shared between the
+micro-batcher and the server so ``stats()`` reports one consistent
+account — the chaos gate (``serving_load.py --chaos``) reconciles these
+against client-observed outcomes.
 """
 from __future__ import annotations
 
@@ -46,6 +53,52 @@ def latency_summary_ms(samples_sec: Iterable[float],
         out["mean_ms"] = round(sum(xs) / len(xs) * 1e3, 3)
         out["max_ms"] = round(xs[-1] * 1e3, 3)
     return out
+
+
+class ServingCounters:
+    """Thread-safe monotonic event counters for the serving failure
+    path. One instance is shared by a server and its micro-batcher so
+    client-visible failures and internal recoveries land in the same
+    ledger:
+
+    - ``expired``: requests dropped at the dispatcher because their
+      deadline passed before coalescing (DEADLINE_EXCEEDED).
+    - ``shed``: requests refused at ``submit()`` by admission control
+      (OVERLOADED — the queue-row bound was full).
+    - ``dispatch_retries``: transient device-dispatch failures absorbed
+      by the serving RetryPolicy (the batch still served).
+    - ``dispatch_failures``: dispatches whose retry budget ran out
+      (each one flips the server to the degraded host route).
+    - ``degrade_events`` / ``recoveries``: host-route flips and
+      background-probe un-degrades.
+    - ``degraded_batches``: batches served by the host walk.
+    - ``publish_failures``: hot-swaps rolled back (the old generation
+      kept serving).
+    - ``shutdown_failed``: futures failed with SHUTDOWN because
+      ``close(timeout=)`` expired before the drain finished.
+
+    Unknown names raise (a typo'd counter must fail loudly, not create
+    a silent parallel ledger)."""
+
+    NAMES = ("expired", "shed", "dispatch_retries", "dispatch_failures",
+             "degrade_events", "recoveries", "degraded_batches",
+             "publish_failures", "shutdown_failed")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c = {n: 0 for n in self.NAMES}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._c[name] += n
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._c[name]
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._c)
 
 
 class LatencyRecorder:

@@ -1,4 +1,4 @@
-"""Concurrent model server over the packed-forest engine (ISSUE 8).
+"""Concurrent model server over the packed-forest engine (ISSUE 8/9).
 
 ``ModelServer`` turns a Booster into a sustained-QPS serving tier:
 
@@ -15,6 +15,31 @@
   atomically swaps it in. In-flight batches keep the old snapshot; a
   response is attributable to exactly ONE generation, never a torn pack.
 
+Failure path (ISSUE 9) — a tier facing real traffic is defined by its
+failure behavior:
+
+- **deadlines**: requests carry a deadline (``tpu_serving_deadline_ms``
+  default); expired requests are dropped before coalescing and fail
+  with ``DEADLINE_EXCEEDED``. ``predict(timeout=)`` rides the same
+  machinery, so a timed-out predict's queue slot is reclaimed by the
+  dispatcher, never served into the void.
+- **admission control**: ``tpu_serving_max_queue_rows`` bounds the
+  queue; past it ``submit()`` fails fast with ``OVERLOADED`` carrying
+  the queue depth.
+- **retry + graceful degradation**: transient dispatch failures
+  (classified by the shared RetryPolicy — UNAVAILABLE, timeouts) are
+  retried invisibly; once the policy's budget is exhausted the server
+  flips to the HOST-WALK route (the same per-tree walk
+  ``Booster.predict`` owns, bit-identical to it) with a loud one-time
+  warning, keeps answering every request, and probes the device in the
+  background (mesh.probe) to un-degrade. Non-transient errors still
+  fail their batch loudly — a code bug must never masquerade as a
+  flaky device.
+- **publish rollback**: a failed ``publish()`` (injected
+  ``publish_fail``, real OOM) leaves the live snapshot serving the OLD
+  generation intact and the version counter untouched — rollback,
+  never a torn pack.
+
 The reference's serving analogue is an OMP row-parallel pointer walk per
 process (src/application/predictor.hpp:31); this is the batch-coalescing
 device-dispatch counterpart the TPU needs (per-request dispatch would be
@@ -22,6 +47,7 @@ round-trip-bound at ~70 ms tunnel latency).
 """
 from __future__ import annotations
 
+import os
 import threading
 from typing import NamedTuple, Optional
 
@@ -29,7 +55,12 @@ import numpy as np
 
 from . import mesh as mesh_mod
 from .batcher import MicroBatcher, PendingRequest
+from .metrics import ServingCounters
 from ..ops import forest
+from ..robustness import faults
+from ..robustness.retry import (RetryError, RetryPolicy, SERVING_POLICY,
+                                retry_call)
+from ..utils import log
 
 
 class Generation(NamedTuple):
@@ -53,7 +84,14 @@ class ModelServer:
       (the p50-vs-throughput knob)
     - ``num_devices``: serving mesh width (0 = all visible devices;
       1 device -> no mesh, programs identical to the plain engine)
-    - ``queue_depth``: enqueue backpressure bound
+    - ``queue_depth``: enqueue backpressure bound (blocking)
+    - ``deadline_ms``: default per-request deadline (0 = none)
+    - ``max_queue_rows``: admission-control row bound (0 = unbounded)
+    - ``retry_policy``: RetryPolicy for transient dispatch failures
+      (default robustness.retry.SERVING_POLICY, LGBM_TPU_RETRY_* env
+      overrides honored)
+    - ``probe_interval_s``: degraded-mode device-probe cadence
+      (0 = sticky degradation)
     - ``raw_score``: serve raw margins (default False: converted
       outputs, exactly ``Booster.predict``'s tail)
 
@@ -71,7 +109,11 @@ class ModelServer:
                  num_devices: Optional[int] = None,
                  queue_depth: Optional[int] = None,
                  raw_score: bool = False,
-                 bucket: Optional[bool] = None):
+                 bucket: Optional[bool] = None,
+                 deadline_ms: Optional[float] = None,
+                 max_queue_rows: Optional[int] = None,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 probe_interval_s: Optional[float] = None):
         eng = booster._engine
         if eng is None:
             raise ValueError("cannot serve an unconstructed Booster")
@@ -106,8 +148,21 @@ class ModelServer:
         self._srv = forest.ServingEngine(cap, self.k, bucket=bucket)
         self.mesh = mesh_mod.serving_mesh(
             int(knob(num_devices, "tpu_serving_num_devices", 0)))
+        self.deadline_ms = float(knob(deadline_ms,
+                                      "tpu_serving_deadline_ms", 0.0))
+        self._retry_policy = (
+            retry_policy if retry_policy is not None else SERVING_POLICY
+        ).from_env_overrides(os.environ)
+        self._probe_interval = float(knob(
+            probe_interval_s, "tpu_serving_probe_interval_s", 5.0))
+        self.counters = ServingCounters()
+        self._degraded = threading.Event()
+        self._degrade_lock = threading.Lock()
+        self._degrade_reason: Optional[str] = None
+        self._probe_thread: Optional[threading.Thread] = None
+        self._close_evt = threading.Event()
         self._publish_lock = threading.Lock()
-        self._active = None        # (ForestSnapshot, Generation) — ONE ref
+        self._active = None  # (ForestSnapshot, Generation, models) — ONE ref
         self._version = 0
         self.publish()
         self._batcher = MicroBatcher(
@@ -115,7 +170,11 @@ class ModelServer:
             max_batch=int(knob(max_batch, "tpu_serving_max_batch", 4096)),
             linger_ms=float(knob(linger_ms, "tpu_serving_linger_ms", 2.0)),
             queue_depth=int(knob(queue_depth, "tpu_serving_queue_depth",
-                                 8192)))
+                                 8192)),
+            max_queue_rows=int(knob(max_queue_rows,
+                                    "tpu_serving_max_queue_rows",
+                                    1_048_576)),
+            counters=self.counters)
 
     # ---- hot-swap ----------------------------------------------------
     def publish(self) -> Generation:
@@ -127,15 +186,35 @@ class ModelServer:
         every few iterations repacks nothing); a destructive mutation
         (rollback, DART drop, set_leaf_output) bumps the generation and
         triggers a full repack. In-flight batches finish on the snapshot
-        they started with — zero downtime, never a torn pack."""
+        they started with — zero downtime, never a torn pack.
+
+        Failure contract (ISSUE 9): a publish that dies — the injected
+        ``publish_fail`` site here or inside the pack append, a real
+        OOM — leaves the live snapshot serving the OLD generation and
+        the version counter untouched (the pack append itself commits
+        transactionally, ops/forest.py), then re-raises. The caller
+        retries when the booster state allows; generations stay
+        monotonic with no gaps for failed attempts."""
         with self._publish_lock:
             models, gen, mappers, used_map = self._eng.serving_state()
-            snap = self._srv.snapshot(
-                models, gen, 0, len(models), mappers, used_map,
-                place_window=lambda w: mesh_mod.replicate(w, self.mesh))
+            try:
+                faults.maybe_fail("publish_fail")
+                snap = self._srv.snapshot(
+                    models, gen, 0, len(models), mappers, used_map,
+                    place_window=lambda w: mesh_mod.replicate(w, self.mesh))
+            except BaseException as e:  # noqa: BLE001 — rollback + re-raise
+                self.counters.inc("publish_failures")
+                if self._active is not None:
+                    log.warning(
+                        f"serving publish FAILED ({e!r}); still serving "
+                        f"generation {self._active[1].version} — rolled "
+                        "back, not torn")
+                raise
             self._version += 1
             info = Generation(self._version, len(models), gen)
-            self._active = (snap, info)    # GIL-atomic ref swap
+            # the host model list rides along so the degraded host-walk
+            # route serves the SAME frozen generation the snapshot does
+            self._active = (snap, info, models)  # GIL-atomic ref swap
             return info
 
     @property
@@ -143,16 +222,31 @@ class ModelServer:
         return self._active[1]
 
     # ---- request path ------------------------------------------------
-    def _dispatch(self, X: np.ndarray):
-        """Score ONE coalesced batch against exactly one snapshot.
-        Runs on the dispatcher thread only."""
-        snap, info = self._active          # single read: atomic pairing
+    def _device_scores(self, snap, X: np.ndarray) -> np.ndarray:
+        """One device attempt at scoring a batch: [R, K] f64 raw scores.
+        Fault sites sit BEFORE the real dispatch (a fired fault means
+        the device never saw this attempt); every retry re-consults."""
+        faults.maybe_delay("slow_dispatch")
+        faults.maybe_fail("dispatch_error")
         place = None
         if self.mesh is not None:
             place = lambda a, ax: mesh_mod.shard_rows(a, ax, self.mesh)  # noqa: E731
         out = forest.snapshot_scores(snap, X, place=place)   # [K, R]
-        raw = out.T                                          # [R, K]
-        n_iters = snap.n_trees // self.k
+        return out.T                                         # [R, K]
+
+    def _host_scores(self, models, X: np.ndarray) -> np.ndarray:
+        """[R, K] f64 raw scores by the HOST per-tree walk — exactly
+        ``Booster.predict``'s accumulation order, so degraded responses
+        are bit-identical to the host route."""
+        raw = np.zeros((X.shape[0], self.k), np.float64)
+        for i, t in enumerate(models):
+            raw[:, i % self.k] += t.predict(X)
+        return raw
+
+    def _finish(self, raw: np.ndarray, info: Generation):
+        """Shared output tail (average + objective conversion) for both
+        routes; mirrors Booster.predict exactly."""
+        n_iters = info.num_trees // self.k
         if getattr(self._eng, "average_output", False) and n_iters > 0:
             raw /= n_iters
         obj = getattr(self._eng, "objective", None)
@@ -163,10 +257,88 @@ class ModelServer:
                 raw[:, 0] = np.asarray(obj.convert_output(raw[:, 0]))
         return (raw if self.k > 1 else raw[:, 0]), info
 
-    def submit(self, X) -> PendingRequest:
+    def _dispatch(self, X: np.ndarray):
+        """Score ONE coalesced batch against exactly one snapshot.
+        Runs on the dispatcher thread only. Transient device failures
+        retry under the serving policy; budget exhaustion degrades to
+        the host walk and STILL answers this batch — non-transient
+        errors propagate and fail the batch (a code bug must never be
+        absorbed as a flaky device)."""
+        snap, info, models = self._active  # single read: atomic pairing
+        if self._degraded.is_set():
+            self.counters.inc("degraded_batches")
+            return self._finish(self._host_scores(models, X), info)
+        try:
+            raw = retry_call(
+                self._device_scores, snap, X,
+                policy=self._retry_policy, what="serving dispatch",
+                on_retry=lambda _a, _e:
+                    self.counters.inc("dispatch_retries"))
+        except RetryError as e:
+            self.counters.inc("dispatch_failures")
+            self._enter_degraded(
+                f"dispatch retry budget exhausted: {e.last!r}")
+            self.counters.inc("degraded_batches")
+            return self._finish(self._host_scores(models, X), info)
+        return self._finish(raw, info)
+
+    # ---- degradation -------------------------------------------------
+    def degrade(self, reason: str = "forced") -> None:
+        """Flip to the host-walk route now (chaos drills, operator
+        override). The background probe un-degrades as usual."""
+        self._enter_degraded(reason)
+
+    def _enter_degraded(self, reason: str) -> None:
+        with self._degrade_lock:
+            if self._degraded.is_set():
+                return
+            self._degrade_reason = reason
+            self._degraded.set()
+            self.counters.inc("degrade_events")
+            log.warning(
+                "=" * 60 + f"\nSERVING DEGRADED: {reason}\n"
+                "flipping to the host-walk route (bit-identical to "
+                "Booster.predict, correct but slow); a background probe "
+                "will restore device serving when the device answers "
+                "again.\n" + "=" * 60)
+            if self._probe_interval > 0 and not self._close_evt.is_set():
+                self._probe_thread = threading.Thread(
+                    target=self._probe_loop, daemon=True,
+                    name="lgbm-serving-probe")
+                self._probe_thread.start()
+
+    def _probe_loop(self) -> None:
+        """Background recovery: probe every serving-mesh device each
+        interval; the first full success un-degrades. Consults the
+        ``dispatch_error`` fault site so an injected persistent outage
+        keeps the server degraded until the plan disarms."""
+        while self._degraded.is_set():
+            if self._close_evt.wait(self._probe_interval):
+                return
+            try:
+                faults.maybe_fail("dispatch_error")
+                mesh_mod.probe(self.mesh)
+            except Exception as e:  # noqa: BLE001 — stay degraded
+                log.debug(f"serving recovery probe failed: {e!r}")
+                continue
+            with self._degrade_lock:
+                self._degraded.clear()
+                self._degrade_reason = None
+                self.counters.inc("recoveries")
+                log.warning("serving RECOVERED: device probe succeeded — "
+                            "back on the device route")
+            return
+
+    def submit(self, X,
+               deadline_ms: Optional[float] = None) -> PendingRequest:
         """Enqueue one [rows, features] request; returns a handle whose
         ``result()`` blocks and whose ``generation`` names the snapshot
-        that served it.
+        that served it. ``deadline_ms`` (default
+        ``tpu_serving_deadline_ms``; 0/None = none) bounds how long the
+        request may wait: past it the dispatcher drops it BEFORE
+        coalescing and ``result()`` raises ``DeadlineExceeded``. A full
+        queue (``max_queue_rows``) raises ``Overloaded`` here instead
+        of accepting work the server cannot serve.
 
         Per-request validation happens HERE (shape, and the raw route's
         f32-representability contract) so one malformed request raises
@@ -187,10 +359,19 @@ class ModelServer:
                     f"requests ({int((~f32_ok).sum())} value(s) are "
                     "f64-only and could cross a split threshold under "
                     "f32 rounding)")
-        return self._batcher.submit(X)
+        dl = self.deadline_ms if deadline_ms is None else float(deadline_ms)
+        return self._batcher.submit(
+            X, deadline_sec=(dl / 1e3 if dl and dl > 0 else None))
 
     def predict(self, X, timeout: Optional[float] = None) -> np.ndarray:
-        return self.submit(X).result(timeout)
+        """Sync sugar: submit + result. ``timeout`` rides the deadline
+        machinery — the request itself carries the deadline, so a
+        timed-out predict cannot leak its queue slot: the dispatcher
+        drops the expired request before coalescing and the slot is
+        reclaimed (pre-ISSUE 9, the abandoned request was still served
+        into the void and held its slot the whole time)."""
+        dl_ms = None if timeout is None else timeout * 1e3
+        return self.submit(X, deadline_ms=dl_ms).result(timeout)
 
     # ---- lifecycle / observability ----------------------------------
     def stats(self) -> dict:
@@ -201,12 +382,22 @@ class ModelServer:
                              if self.mesh is not None else 1)
         s["linger_ms"] = self._batcher.linger_sec * 1e3
         s["max_batch"] = self._batcher.max_batch
+        s["deadline_ms"] = self.deadline_ms
+        s["degraded"] = self._degraded.is_set()
+        if s["degraded"] and self._degrade_reason is not None:
+            s["degraded_reason"] = self._degrade_reason
         return s
 
     def close(self, timeout: Optional[float] = 30.0) -> None:
         """Stop accepting requests; every already-accepted request is
-        still served before the dispatcher exits (drain-on-shutdown)."""
+        still served before the dispatcher exits (drain-on-shutdown).
+        Past ``timeout`` the drain contract fails still-pending futures
+        with SHUTDOWN instead of abandoning them (batcher.close)."""
+        self._close_evt.set()
         self._batcher.close(timeout)
+        t = self._probe_thread
+        if t is not None:
+            t.join(1.0)
 
     def __enter__(self) -> "ModelServer":
         return self

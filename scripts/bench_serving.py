@@ -123,6 +123,10 @@ def run(n: int, n_trees: int) -> dict:
         # ref CPU-16 Higgs predict is not directly comparable from this
         # 1-core host; record the per-thread figure for scaling math
         "native_rows_per_sec_per_thread": round(native_rps / nthreads),
+        # this writer has no ModelServer (direct predict routes only),
+        # so it can never end on the host fallback; the field exists so
+        # every SERVING*.json carries the same ISSUE 9 status schema
+        "degraded": False,
         "status": "measured",
     }
 

@@ -119,6 +119,9 @@ def main() -> int:
         "ours_sec": round(our_dt, 3),
         "speedup": round(ref_dt / our_dt, 2),
         "max_abs_pred_diff": err,
+        # pure-ctypes head-to-head — no ModelServer, so no host
+        # fallback; field present for the shared SERVING*.json schema
+        "degraded": False,
         "status": "measured",
     })
     return 0

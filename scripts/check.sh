@@ -28,6 +28,20 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
+if [ "${LGBM_TPU_R_SMOKE:-0}" != "0" ]; then
+    echo "== R smoke (execute the R layer under a real Rscript; opt-in) =="
+    # ROADMAP 5(c): the 828-LoC R surface actually evaluated, not just
+    # regex-linted — skips LOUDLY (rc 0) when no Rscript is on PATH.
+    # Budget: r_smoke's own Rscript subprocess timeout is 600 s (cold
+    # CLI compile inside); the wrapper must outlive it to keep the
+    # captured diagnostics.
+    timeout -k 10 660 python scripts/r_smoke.py || rc=1
+    if [ $rc -ne 0 ]; then
+        echo "check.sh: R smoke failed — skipping tier-1 pytest" >&2
+        exit $rc
+    fi
+fi
+
 echo "== fault-matrix smoke (robustness runtime, CPU) =="
 JAX_PLATFORMS=cpu python scripts/fault_smoke.py || rc=1
 if [ $rc -ne 0 ]; then
@@ -68,6 +82,20 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu \
     python scripts/serving_smoke.py || rc=1
 if [ $rc -ne 0 ]; then
     echo "check.sh: serving smoke failed — skipping tier-1 pytest" >&2
+    exit $rc
+fi
+
+echo "== serving chaos smoke (deadlines/shed/degrade/publish rollback, CPU) =="
+# ISSUE 9: injected dispatch faults are retried bit-identically, a
+# failed publish (server site AND pack-append site) leaves the served
+# generation intact — rollback, never torn — retry exhaustion degrades
+# to the host-walk route (bit-identical to Booster.predict) and the
+# background probe un-degrades, deadlines expire queued requests before
+# coalescing, and admission control sheds with OVERLOADED.
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python scripts/serving_chaos_smoke.py || rc=1
+if [ $rc -ne 0 ]; then
+    echo "check.sh: serving chaos smoke failed — skipping tier-1 pytest" >&2
     exit $rc
 fi
 

@@ -14,6 +14,12 @@ guarantee regresses:
                      allreduce; must still match centralized training
   probe_timeout   -> device probe never succeeds; tpu_fallback_to_cpu
                      must finish training anyway
+  serving         -> the ISSUE 9 serving sites speak the grammar end to
+                     end: dispatch_error retried bit-identically,
+                     slow_dispatch expiring a queued deadline,
+                     publish_fail rolling back to the old generation
+                     (the degrade/recovery round-trip lives in
+                     scripts/serving_chaos_smoke.py — not repeated here)
 
 Runs in ~half a minute on CPU.
 """
@@ -21,6 +27,7 @@ import os
 import sys
 import tempfile
 import threading
+import time
 
 os.environ.setdefault("XLA_FLAGS", "")
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -152,11 +159,64 @@ def smoke_probe_fallback() -> None:
     assert b.current_iteration() == 3
 
 
+def smoke_serving() -> None:
+    """ISSUE 9 serving sites in the fault grammar, end to end:
+    dispatch_error is retried invisibly, slow_dispatch expires a
+    deadline-carrying request, publish_fail rolls back to the old
+    generation. The degrade/host-walk/recovery round-trip is gated by
+    scripts/serving_chaos_smoke.py (same check.sh run) — one copy."""
+    from lightgbm_tpu.serving import DeadlineExceeded
+    X, y = _data(n=500, seed=4)
+    bst = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y),
+                    num_boost_round=4, keep_training_booster=True)
+    probe = X[:64]
+    srv = bst.serve(linger_ms=1.0, raw_score=True)
+    try:
+        direct = bst.predict(probe, device=True, raw_score=True)
+        with faults.inject("dispatch_error"):
+            np.testing.assert_array_equal(srv.predict(probe, timeout=60),
+                                          direct)
+        assert srv.counters.get("dispatch_retries") == 1
+        # publish_fail: the live snapshot keeps serving the OLD gen
+        v0 = srv.generation.version
+        bst.update()
+        try:
+            with faults.inject("publish_fail"):
+                srv.publish()
+            raise AssertionError("publish_fail never fired")
+        except faults.FaultInjected:
+            pass
+        assert srv.generation.version == v0
+        np.testing.assert_array_equal(srv.predict(probe, timeout=60),
+                                      direct)
+        assert srv.publish().version == v0 + 1
+        # slow_dispatch wedges one dispatch; a deadline request queued
+        # behind it must expire (dropped before coalescing), the
+        # wedged batch must still be answered
+        with faults.inject("slow_dispatch:sec=0.4:n=1"):
+            slow = srv.submit(probe)
+            t_end = time.monotonic() + 5
+            while srv.stats()["queued_rows"] and time.monotonic() < t_end:
+                time.sleep(0.01)
+            time.sleep(0.05)      # outlive the linger (pop != dispatched)
+            dead = srv.submit(probe, deadline_ms=40.0)
+            slow.result(60)
+        try:
+            dead.result(60)
+            raise AssertionError("expired request was served")
+        except DeadlineExceeded:
+            pass
+        assert srv.counters.get("expired") == 1
+    finally:
+        srv.close(timeout=60)
+
+
 def main() -> int:
     rc = 0
     for name, fn in (("write_kill", smoke_write_kill),
                      ("collective", smoke_collective),
-                     ("probe_timeout", smoke_probe_fallback)):
+                     ("probe_timeout", smoke_probe_fallback),
+                     ("serving", smoke_serving)):
         try:
             fn()
             print(f"fault_smoke: {name} OK")

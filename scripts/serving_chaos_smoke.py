@@ -1,0 +1,180 @@
+"""Serving failure-path smoke gate (ISSUE 9): deadlines, load-shedding,
+publish rollback, degrade round-trip — on CPU, <30 s, wired into
+scripts/check.sh.
+
+Asserts, end to end through ``Booster.serve()``:
+  1. a transient injected dispatch fault is retried INVISIBLY: the
+     response is bit-identical to the direct device path and only the
+     retry counter moved;
+  2. a failed ``publish()`` (both the server-level site and the
+     pack-append site) leaves the live snapshot serving the OLD
+     generation bit-exactly, the version counter untouched — rollback,
+     never a torn pack — and the next publish succeeds gaplessly;
+  3. retry-budget exhaustion degrades to the host-walk route with the
+     batch still answered (bit-identical to ``Booster.predict``'s host
+     path), and the background probe un-degrades within its interval —
+     after which responses are device-route bit-identical again;
+  4. a request whose deadline expires behind a slow dispatch fails with
+     DEADLINE_EXCEEDED and never joins a batch; admission control sheds
+     with OVERLOADED once the queued-row bound fills, and both flow
+     through the counters;
+  5. zero torn responses anywhere: every successful response matches
+     exactly one published generation's model.
+
+Exits non-zero on the first violated gate.
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# fast retry budget for the smoke (read per call site)
+os.environ.setdefault("LGBM_TPU_RETRY_ATTEMPTS", "2")
+os.environ.setdefault("LGBM_TPU_RETRY_BASE_DELAY", "0.001")
+os.environ.setdefault("LGBM_TPU_RETRY_MAX_DELAY", "0.01")
+os.environ.setdefault("LGBM_TPU_RETRY_DEADLINE", "5")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+T_START = time.perf_counter()
+BUDGET_SEC = 30.0
+
+
+def check(cond, what):
+    took = time.perf_counter() - T_START
+    if not cond:
+        print(f"serving_chaos_smoke: FAIL {what} ({took:.1f}s)",
+              file=sys.stderr)
+        sys.exit(1)
+    print(f"serving_chaos_smoke: ok {what} ({took:.1f}s)")
+
+
+def wait_until(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def main() -> int:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.robustness import faults
+    from lightgbm_tpu.serving import DeadlineExceeded, Overloaded
+
+    rng = np.random.default_rng(9)
+    n, f = 900, 8
+    X = rng.normal(size=(n, f)).astype(np.float32).astype(np.float64)
+    y = X[:, 0] + 0.5 * X[:, 1] ** 2
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "verbose": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=4,
+                    keep_training_booster=True)
+    probe = X[:64]
+    srv = bst.serve(linger_ms=1.0, raw_score=True, probe_interval_s=0.1)
+
+    # 1. transient dispatch fault retried invisibly
+    direct = bst.predict(probe, device=True, raw_score=True)
+    with faults.inject("dispatch_error"):
+        got = srv.predict(probe, timeout=60)
+    check(np.array_equal(got, direct) and
+          srv.counters.get("dispatch_retries") == 1 and
+          not srv.stats()["degraded"],
+          "transient dispatch fault retried, response bit-identical")
+
+    # 2a. publish_fail at the server site: rollback, version untouched
+    v0 = srv.generation.version
+    bst.update()
+    raised = False
+    with faults.inject("publish_fail"):
+        try:
+            srv.publish()
+        except faults.FaultInjected:
+            raised = True
+    check(raised and srv.generation.version == v0 and
+          np.array_equal(srv.predict(probe, timeout=60), direct),
+          "failed publish keeps serving the OLD generation (rollback)")
+
+    # 2b. publish_fail INSIDE the pack append (after=1 skips the server
+    # site): the incremental pack must commit transactionally
+    raised = False
+    with faults.inject("publish_fail:after=1:n=1"):
+        try:
+            srv.publish()
+        except faults.FaultInjected:
+            raised = True
+    check(raised and srv.generation.version == v0,
+          "pack-append fault rolls back too (no torn pack state)")
+    info = srv.publish()
+    direct2 = bst.predict(probe, device=True, raw_score=True)
+    check(info.version == v0 + 1 and
+          np.array_equal(srv.predict(probe, timeout=60), direct2) and
+          srv.counters.get("publish_failures") == 2,
+          "next publish succeeds gaplessly and serves the new trees")
+
+    # 3. retry exhaustion -> degraded host walk -> background recovery
+    with faults.inject("dispatch_error:p=1:n=2"):
+        got = srv.predict(probe, timeout=60)
+    host = bst.predict(probe, raw_score=True)
+    check(np.array_equal(got, host) and srv.stats()["degraded"],
+          "retry exhaustion degrades; batch still answered, "
+          "bit-identical to the host walk")
+    check(wait_until(lambda: not srv.stats()["degraded"]),
+          "background probe un-degraded the server")
+    check(np.array_equal(srv.predict(probe, timeout=60), direct2) and
+          srv.counters.get("recoveries") == 1,
+          "recovered server serves the device route bit-identically")
+
+    # 4a. deadline: a request stuck behind a slow dispatch expires and
+    # never joins a batch
+    with faults.inject("slow_dispatch:sec=0.6:n=1"):
+        slow = srv.submit(probe)                  # dispatcher sleeps 0.6s
+        wait_until(lambda: srv.stats()["queued_rows"] == 0, 5)
+        time.sleep(0.05)    # outlive the 1 ms linger: queued_rows hits 0
+        # at POP time, while _gather may still be coalescing — a submit
+        # inside that window would join the wedged batch and be served
+        dead = srv.submit(probe, deadline_ms=50.0)
+        got = slow.result(60)
+    check(np.array_equal(got, direct2), "slow dispatch still answered")
+    try:
+        dead.result(60)
+        check(False, "expired request must fail")
+    except DeadlineExceeded:
+        check(srv.counters.get("expired") == 1,
+              "deadline expired in queue -> DEADLINE_EXCEEDED + counter")
+
+    # 4b. admission control: fail fast with OVERLOADED once the row
+    # bound fills behind a slow dispatch
+    srv2 = bst.serve(linger_ms=1.0, raw_score=True, max_queue_rows=128)
+    with faults.inject("slow_dispatch:sec=0.6:n=1"):
+        blocker = srv2.submit(probe)              # 64 rows, dispatching
+        wait_until(lambda: srv2.stats()["queued_rows"] == 0, 5)
+        time.sleep(0.05)                          # outlive the linger
+        q1 = srv2.submit(probe)                   # 64 rows queued
+        q2 = srv2.submit(probe)                   # 128 rows queued
+        shed = False
+        try:
+            srv2.submit(probe)                    # 129th row -> shed
+        except Overloaded as e:
+            shed = "OVERLOADED" in str(e)
+        outs = [r.result(60) for r in (blocker, q1, q2)]
+    check(shed and srv2.counters.get("shed") == 1,
+          "full queue sheds fast with OVERLOADED + counter")
+    check(all(np.array_equal(o, direct2) for o in outs),
+          "every accepted request still served bit-identically (0 torn)")
+
+    srv2.close(timeout=60)
+    srv.close(timeout=60)
+    took = time.perf_counter() - T_START
+    if took >= BUDGET_SEC:
+        print(f"serving_chaos_smoke: WARN wall {took:.1f}s >= "
+              f"{BUDGET_SEC:.0f}s (cold compile cache?)", file=sys.stderr)
+    print(f"serving_chaos_smoke: PASS in {took:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

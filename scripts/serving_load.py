@@ -1,6 +1,6 @@
-"""Concurrent-serving load generator (ISSUE 8): sustained QPS + tail
+"""Concurrent-serving load generator (ISSUE 8/9): sustained QPS + tail
 latency for the serving tier, device and native-C-ABI routes side by
-side.
+side — plus the chaos gate over the failure path.
 
 Drives N concurrent clients against ``Booster.serve()`` (the dynamic
 micro-batcher + mesh-replicated packed forest) and, when the native
@@ -20,15 +20,29 @@ Traffic modes: ``closed`` (each client submits, waits, repeats —
 throughput-coupled) and ``open`` (Poisson arrivals at --rate req/s
 total, the honest latency-under-load model).
 
+Chaos gate (``--chaos``, ISSUE 9): open-loop Poisson traffic from
+``--clients`` threads while 5% of device dispatches fail transiently
+(``dispatch_error:p=0.05``), exactly one hot-swap publish dies
+(``publish_fail:n=1``), and a mid-run degradation to the host-walk
+route is forced at half-duration. The gate FAILS (status no_result)
+unless: zero torn or wrong responses (every response bit-matches its
+generation's device or host route), per-client generations move forward
+only, every shed/expired/degraded/publish event is accounted in the
+ServingCounters exactly as clients observed it, the forced degradation
+recovers via the background probe, and p999 stays under
+``--chaos-p999-ms``.
+
 Results land in bench_logs/SERVING_LOAD.json under bench.py's status
-grammar (measured / device_unreachable / no_result) so the session
-driver can key on them.
+grammar (measured / degraded / device_unreachable / no_result — a
+"degraded" record means the tier ended on the host fallback) so the
+session driver can key on them.
 
 Usage:
   python scripts/serving_load.py [--clients 8] [--rows 64]
       [--duration 10] [--mode closed|open] [--rate 200]
       [--devices 2] [--trees 60] [--leaves 31] [--linger-ms 2]
-      [--publish-every 0] [--skip-native]
+      [--publish-every 0] [--skip-native] [--deadline-ms 0]
+      [--max-queue-rows 0] [--chaos] [--chaos-p999-ms 10000]
 
 --devices D > 1 on a CPU host re-execs with D virtual XLA devices;
 an already-set JAX_PLATFORMS (e.g. a TPU session) is honored.
@@ -47,6 +61,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 OUT = os.path.join(REPO, "bench_logs", "SERVING_LOAD.json")
+OUT_CHAOS = os.path.join(REPO, "bench_logs", "SERVING_CHAOS.json")
 
 
 def parse_args(argv=None):
@@ -70,8 +85,25 @@ def parse_args(argv=None):
                     help="hot-swap cadence: train+publish one iteration "
                          "into the live server every S seconds (0=off)")
     ap.add_argument("--skip-native", action="store_true")
-    ap.add_argument("--out", default=OUT)
-    return ap.parse_args(argv)
+    ap.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="per-request deadline (0 = the config default)")
+    ap.add_argument("--max-queue-rows", type=int, default=0,
+                    help="admission-control row bound (0 = config "
+                         "default)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the ISSUE 9 chaos gate instead of the "
+                         "plain measurement (implies open-loop; skips "
+                         "the native route)")
+    ap.add_argument("--chaos-p999-ms", type=float, default=10_000.0,
+                    help="chaos gate: p999 latency bound")
+    ap.add_argument("--out", default=None,
+                    help="record path (default SERVING_LOAD.json; "
+                         "SERVING_CHAOS.json under --chaos so the "
+                         "banked throughput record is never clobbered)")
+    args = ap.parse_args(argv)
+    if args.out is None:
+        args.out = OUT_CHAOS if args.chaos else OUT
+    return args
 
 
 def ensure_virtual_devices(n: int) -> None:
@@ -159,6 +191,184 @@ def run_open_loop(rate, duration, make_request, submit):
     return lats, len(lats), time.perf_counter() - t0, errs
 
 
+def chaos_route(args, bst, srv, probe):
+    """Chaos gate (ISSUE 9): open-loop Poisson traffic from
+    ``args.clients`` threads under dispatch_error:p=0.05 + one
+    publish_fail + a forced mid-run degradation. Every response is
+    verified bit-exactly against its generation's device OR host route
+    (anything else is torn/wrong), and the failure counters are
+    reconciled against what the clients actually observed. Returns
+    (record, failures) — a non-empty failures list fails the gate."""
+    import numpy as np
+    from lightgbm_tpu.robustness import faults
+    from lightgbm_tpu.serving import DeadlineExceeded, Overloaded
+    from lightgbm_tpu.serving.metrics import latency_summary_ms
+
+    expected = {}          # version -> (device_bits, host_bits)
+
+    def bank(version):
+        expected[version] = (
+            bst.predict(probe, device=True, raw_score=True),
+            bst.predict(probe, raw_score=True))
+
+    bank(srv.generation.version)
+    s0 = srv.stats()
+    lock = threading.Lock()
+    results = []           # per client: [(version, out, latency_sec)]
+    sheds, expireds, hard = [], [], []
+    pub_failures, pub_ok = [], []
+    stop_pub = threading.Event()
+
+    def publisher():
+        while not stop_pub.wait(args.publish_every):
+            try:
+                bst.update()
+                info = srv.publish()
+                bank(info.version)
+                pub_ok.append(info.version)
+            except Exception as e:  # noqa: BLE001 — rollback keeps serving
+                pub_failures.append(repr(e))
+
+    def client(ci):
+        rng = random.Random(1000 + ci)
+        rate = max(args.rate / max(args.clients, 1), 1e-6)
+        futs = []
+        t0 = time.perf_counter()
+        next_t = t0
+        while True:
+            next_t += rng.expovariate(rate)
+            if next_t - t0 > args.duration:
+                break
+            now = time.perf_counter()
+            if next_t > now:
+                time.sleep(next_t - now)
+            try:
+                futs.append((next_t, srv.submit(
+                    probe, deadline_ms=args.deadline_ms or 8000.0)))
+            except Overloaded as e:
+                with lock:
+                    sheds.append(repr(e))
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    hard.append(repr(e))
+        mine = []
+        for intended, fut in futs:
+            try:
+                out = fut.result(60)
+                mine.append((fut.generation.version, out,
+                             fut.t_done - intended))
+            except DeadlineExceeded as e:
+                with lock:
+                    expireds.append(repr(e))
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    hard.append(repr(e))
+        with lock:
+            results.append(mine)
+
+    def degrader():
+        time.sleep(args.duration / 2.0)
+        srv.degrade("chaos: forced mid-run degradation")
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.clients)]
+    pub = threading.Thread(target=publisher, daemon=True)
+    deg = threading.Thread(target=degrader, daemon=True)
+    t_wall = time.perf_counter()
+    with faults.inject("dispatch_error:p=0.05:seed=11:n=1000000,"
+                       "publish_fail:n=1") as plan:
+        pub.start()
+        deg.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(args.duration + 120)
+        stop_pub.set()
+        pub.join(30)
+        deg.join(args.duration)
+        # let the background probe close the degrade round-trip while
+        # the plan is still installed (the probe consults its sites)
+        t_end = time.perf_counter() + 30
+        while srv.stats()["degraded"] and time.perf_counter() < t_end:
+            time.sleep(0.05)
+    wall = time.perf_counter() - t_wall
+    s1 = srv.stats()
+    d = {k: s1[k] - s0.get(k, 0) for k in (
+        "requests", "expired", "shed", "dispatch_retries",
+        "dispatch_failures", "degrade_events", "recoveries",
+        "degraded_batches", "publish_failures")}
+
+    flat = [r for mine in results for r in mine]
+    lats = [max(lat, 0.0) for _v, _o, lat in flat]
+    torn, monotonic = 0, True
+    for mine in results:
+        last = 0
+        for v, out, _lat in mine:
+            exp = expected.get(v)
+            if exp is None or not (np.array_equal(out, exp[0]) or
+                                   np.array_equal(out, exp[1])):
+                torn += 1
+            if v < last:
+                monotonic = False
+            last = max(last, v)
+
+    failures = []
+
+    def need(cond, what):
+        if not cond:
+            failures.append(what)
+
+    need(not hard, f"{len(hard)} hard client error(s): {hard[:1]}")
+    need(torn == 0, f"{torn} torn/wrong response(s)")
+    need(monotonic, "a client observed generations moving backwards")
+    need(d["requests"] == len(flat),
+         f"fulfilled accounting: server {d['requests']} != "
+         f"client {len(flat)}")
+    need(d["expired"] == len(expireds),
+         f"expired accounting: server {d['expired']} != "
+         f"client {len(expireds)}")
+    need(d["shed"] == len(sheds),
+         f"shed accounting: server {d['shed']} != client {len(sheds)}")
+    need(d["publish_failures"] == 1 and len(pub_failures) == 1,
+         f"exactly one failed publish expected (server "
+         f"{d['publish_failures']}, publisher {len(pub_failures)})")
+    need(srv.generation.version == 1 + len(pub_ok),
+         f"generation counter not gapless-monotonic: "
+         f"v{srv.generation.version} after {len(pub_ok)} good publishes")
+    need(d["degrade_events"] >= 1, "forced degradation never registered")
+    need(d["recoveries"] >= 1 and not s1["degraded"],
+         "server never un-degraded after the forced degradation")
+    need(d["degraded_batches"] >= 1,
+         "no batch was ever served by the degraded host route")
+    # vacuity guard: the fault site must be WIRED (consulted at least
+    # once). Requiring an actual p=0.05 firing would make the gate
+    # flaky under saturation (few, heavily-coalesced batches = few
+    # consults); the retry path itself is gated deterministically by
+    # serving_chaos_smoke.py and tests/test_serving.py.
+    de = plan.faults["dispatch_error"]
+    need(de.calls >= 1,
+         "dispatch_error site never consulted — faults not wired")
+    lat_ms = latency_summary_ms(lats)
+    p999 = lat_ms.get("p999_ms", float("inf"))
+    need(bool(lats) and p999 < args.chaos_p999_ms,
+         f"p999 {p999} ms not under the {args.chaos_p999_ms:.0f} ms "
+         "bound")
+
+    rec = {"wall_sec": round(wall, 2), "responses": len(flat),
+           "qps": round(len(flat) / wall, 1), "torn": torn,
+           "shed": len(sheds), "expired": len(expireds),
+           "publish_failures": len(pub_failures),
+           "publishes_ok": len(pub_ok),
+           "generations_served": sorted({v for v, _o, _lat in flat}),
+           "dispatch_error_consults": de.calls,
+           "dispatch_error_fired": de.fired,
+           "counters_delta": d}
+    rec.update(lat_ms)
+    if failures:
+        rec["failures"] = failures
+    return rec, failures
+
+
 def route_record(lats, n_done, wall, rows_per_req, errs) -> dict:
     from lightgbm_tpu.serving.metrics import latency_summary_ms
     rec = {"qps": round(n_done / wall, 1),
@@ -186,7 +396,7 @@ def main() -> int:
               "duration_sec": args.duration, "trees": args.trees,
               "leaves": args.leaves, "linger_ms": args.linger_ms}
 
-    from _bench_io import classify_status, write_record
+    from _bench_io import classify_status, status_for, write_record
 
     def finish(status, note=None) -> int:
         record["status"] = status
@@ -218,6 +428,37 @@ def main() -> int:
             off = r.randrange(0, pool.shape[0] - args.rows)
             return pool[off:off + args.rows]
 
+        # ---- chaos gate (ISSUE 9): failure-path verification ---------
+        if args.chaos:
+            record["mode"] = "open"              # chaos is always open-loop
+            if args.publish_every <= 0:
+                args.publish_every = 0.5
+            srv = bst.serve(linger_ms=args.linger_ms,
+                            max_batch=args.max_batch,
+                            num_devices=args.devices, raw_score=True,
+                            probe_interval_s=1.0,
+                            deadline_ms=args.deadline_ms or None,
+                            max_queue_rows=args.max_queue_rows or None)
+            probe_req = np.ascontiguousarray(pool[:args.rows])
+            srv.predict(probe_req, timeout=300)          # warm buckets
+            chaos, failures = chaos_route(args, bst, srv, probe_req)
+            stats = srv.stats()
+            srv.close()
+            record["chaos"] = chaos
+            record["degraded"] = bool(stats.get("degraded"))
+            record["value"] = chaos["qps"]
+            print(f"[load] chaos: {chaos['responses']} responses, "
+                  f"{chaos['torn']} torn, shed={chaos['shed']} "
+                  f"expired={chaos['expired']} "
+                  f"p999={chaos.get('p999_ms')}ms "
+                  f"counters={chaos['counters_delta']}", flush=True)
+            if failures:
+                for f in failures:
+                    print(f"[load] CHAOS FAIL: {f}", file=sys.stderr,
+                          flush=True)
+                return finish("no_result", "; ".join(failures))
+            return finish(status_for(stats))
+
         # ---- single-stream baseline: one client, direct device path --
         bst.predict(make_request(random.Random(0)), device=True,
                     raw_score=True)                       # warm buckets
@@ -235,7 +476,9 @@ def main() -> int:
         # ---- device route: micro-batched concurrent server -----------
         srv = bst.serve(linger_ms=args.linger_ms,
                         max_batch=args.max_batch,
-                        num_devices=args.devices, raw_score=True)
+                        num_devices=args.devices, raw_score=True,
+                        deadline_ms=args.deadline_ms or None,
+                        max_queue_rows=args.max_queue_rows or None)
         for warm_rows in {args.rows, args.rows * max(args.clients, 1)}:
             srv.predict(pool[:max(warm_rows, 1)], timeout=300)
         publisher_stop = threading.Event()
@@ -266,6 +509,7 @@ def main() -> int:
             pub_thread.join(30)
         dev = route_record(lats, n, wall, args.rows, errs)
         dev["server"] = srv.stats()
+        record["degraded"] = bool(dev["server"].get("degraded"))
         if publisher_err:
             dev["publish_error"] = publisher_err[0]
         if args.publish_every > 0:
@@ -290,7 +534,7 @@ def main() -> int:
                       flush=True)
         if errs and not lats:
             return finish("no_result", f"device route: {errs[0]}")
-        return finish("measured")
+        return finish(status_for(dev["server"]))
     except Exception as e:  # noqa: BLE001 — classified into the grammar
         return finish(classify_status(e), repr(e))
 

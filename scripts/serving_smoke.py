@@ -63,6 +63,10 @@ def main() -> int:
 
     srv = bst.serve(linger_ms=50.0, raw_score=True, num_devices=2)
     check(srv.stats()["mesh_devices"] == 2, "serving mesh spans 2 devices")
+    s = srv.stats()
+    check(s["degraded"] is False and s["expired"] == 0 and
+          s["shed"] == 0 and s["publish_failures"] == 0,
+          "failure-path counters present and zero on a healthy server")
 
     # 1. coalescing parity: mixed sizes submitted together, every
     # response bit-identical to the direct device path

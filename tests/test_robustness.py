@@ -188,6 +188,36 @@ def test_fault_after_and_n():
         faults.maybe_fail("write_kill")   # disarmed after n=1
 
 
+def test_serving_fault_sites_parse_and_fire():
+    """ISSUE 9: the serving sites speak the existing grammar
+    (p/n/after/seed/sec opts) and raise transient-classified faults."""
+    plan = faults.FaultPlan.parse(
+        "dispatch_error:p=0.5:seed=3,slow_dispatch:sec=0.01,"
+        "publish_fail:n=2:after=1")
+    assert set(plan.faults) == {"dispatch_error", "slow_dispatch",
+                                "publish_fail"}
+    assert plan.faults["slow_dispatch"].sec == 0.01
+    assert plan.faults["publish_fail"].n == 2
+    with faults.inject("dispatch_error"):
+        with pytest.raises(faults.FaultInjected) as ei:
+            faults.maybe_fail("dispatch_error")
+        assert is_transient_error(ei.value)   # retried, not crashed on
+    slept = []
+    with faults.inject("slow_dispatch:sec=1.5"):
+        assert faults.maybe_delay("slow_dispatch",
+                                  sleep=slept.append) == 1.5
+    assert slept == [1.5]
+
+
+def test_faults_docstring_lists_every_known_site():
+    """The module docstring's site list drifts from KNOWN_SITES unless
+    gated (ISSUE 9 satellite): every site must be documented as a
+    ``site`` bullet."""
+    for site in faults.KNOWN_SITES:
+        assert f"``{site}``" in faults.__doc__, \
+            f"fault site {site!r} missing from faults.py docstring"
+
+
 # ---------------------------------------------------------------------------
 # checkpoint.py: atomicity + CRC
 # ---------------------------------------------------------------------------

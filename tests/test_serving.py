@@ -1,6 +1,10 @@
-"""Concurrent serving tier (ISSUE 8): micro-batcher coalescing,
-bit-identity vs the direct device path, zero-downtime hot-swap,
-drain-on-shutdown, mesh placement, and the percentile math units."""
+"""Concurrent serving tier (ISSUE 8, failure path ISSUE 9):
+micro-batcher coalescing, bit-identity vs the direct device path,
+zero-downtime hot-swap, drain-on-shutdown, mesh placement, percentile
+math units — and the failure semantics: request deadlines (expired
+requests never coalesced), fail-fast admission control, publish
+rollback, retry-then-degrade dispatch, and the close(timeout=) drain
+contract."""
 import os
 import subprocess
 import sys
@@ -11,8 +15,12 @@ import numpy as np
 import pytest
 
 import lightgbm_tpu as lgb
-from lightgbm_tpu.serving import (Generation, MicroBatcher, ModelServer,
-                                  latency_summary_ms, percentile)
+from lightgbm_tpu.robustness import faults
+from lightgbm_tpu.robustness.retry import RetryPolicy
+from lightgbm_tpu.serving import (DeadlineExceeded, Generation,
+                                  MicroBatcher, ModelServer, Overloaded,
+                                  ShutdownError, latency_summary_ms,
+                                  percentile)
 
 REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 
@@ -298,6 +306,326 @@ def test_generation_tuple_fields(booster):
         f.result(60)
         assert f.generation == g
         assert f.latency_sec is not None and f.latency_sec >= 0
+
+
+# ---------------------------------------------------------------------------
+# failure path (ISSUE 9): deadlines, admission control, publish
+# rollback, degrade, shutdown drain contract
+# ---------------------------------------------------------------------------
+
+def _gated_batcher(max_batch=1000, linger_ms=5.0, **kw):
+    """Batcher whose dispatch blocks on an Event — deterministic
+    control over when the dispatcher is 'stuck' mid-batch."""
+    gate = threading.Event()
+    entered = threading.Event()
+    dispatched = []
+
+    def dispatch(X):
+        entered.set()
+        gate.wait(30)
+        dispatched.append(X.shape[0])
+        return X[:, 0], None
+
+    mb = MicroBatcher(dispatch, max_batch=max_batch, linger_ms=linger_ms,
+                      **kw)
+    return mb, gate, entered, dispatched
+
+
+def _drain_to_dispatcher(mb, timeout=5.0):
+    """Wait until everything queued has been popped by the dispatcher."""
+    end = time.monotonic() + timeout
+    while mb.stats()["queued_rows"] and time.monotonic() < end:
+        time.sleep(0.005)
+    assert mb.stats()["queued_rows"] == 0
+
+
+def test_batcher_expired_request_never_coalesced():
+    # dispatcher is stuck on a blocker batch; a deadline request queued
+    # behind it expires and must be dropped BEFORE coalescing — its
+    # rows never appear in any dispatched batch
+    mb, gate, entered, dispatched = _gated_batcher()
+    blocker = mb.submit(np.zeros((7, 2)))
+    assert entered.wait(5)
+    _drain_to_dispatcher(mb)
+    bad = mb.submit(np.zeros((3, 2)), deadline_sec=0.05)
+    good = mb.submit(np.zeros((5, 2)))
+    time.sleep(0.15)                      # bad expires while queued
+    gate.set()
+    assert good.result(10).shape == (5,)
+    assert blocker.result(10).shape == (7,)
+    with pytest.raises(DeadlineExceeded, match="DEADLINE_EXCEEDED"):
+        bad.result(10)
+    assert 3 not in dispatched, dispatched
+    assert mb.counters.get("expired") == 1
+    mb.close()
+
+
+def test_server_expired_request_bit_parity_for_survivors(booster):
+    """An expired request must not poison the batch its peers formed:
+    the surviving request's response stays bit-identical to the direct
+    device path."""
+    bst, X, _ = booster
+    with bst.serve(linger_ms=1.0, raw_score=True) as srv:
+        with faults.inject("slow_dispatch:sec=0.4:n=1"):
+            slow = srv.submit(X[:48])     # dispatcher wedges on this
+            end = time.monotonic() + 5
+            while srv.stats()["queued_rows"] and time.monotonic() < end:
+                time.sleep(0.005)
+            time.sleep(0.05)  # outlive the linger: queued_rows hits 0 at
+            # POP time, while _gather may still coalesce late arrivals
+            dead = srv.submit(X[:32], deadline_ms=40.0)
+            good = srv.submit(X[64:128])
+            got_slow = slow.result(60)
+            got_good = good.result(60)
+        with pytest.raises(DeadlineExceeded):
+            dead.result(60)
+        assert np.array_equal(
+            got_slow, bst.predict(X[:48], device=True, raw_score=True))
+        assert np.array_equal(
+            got_good, bst.predict(X[64:128], device=True, raw_score=True))
+        assert srv.counters.get("expired") == 1
+
+
+def test_batcher_overload_fails_fast_with_queue_depth():
+    mb, gate, entered, _ = _gated_batcher(max_queue_rows=16)
+    blocker = mb.submit(np.zeros((4, 2)))
+    assert entered.wait(5)
+    _drain_to_dispatcher(mb)
+    q1 = mb.submit(np.zeros((8, 2)))
+    q2 = mb.submit(np.zeros((8, 2)))      # 16 rows queued: at the bound
+    with pytest.raises(Overloaded, match="OVERLOADED.*16 rows"):
+        mb.submit(np.zeros((1, 2)))
+    assert mb.counters.get("shed") == 1
+    gate.set()
+    for r in (blocker, q1, q2):           # accepted => still served
+        assert r.result(10) is not None
+    mb.close()
+
+
+def test_batcher_oversize_request_admitted_when_idle():
+    """A request larger than max_queue_rows must still be servable on
+    an idle queue — the bound sheds BACKLOG, it does not define a
+    maximum request size."""
+    mb = MicroBatcher(lambda X: (X[:, 0], None), max_batch=64,
+                      linger_ms=1.0, max_queue_rows=32)
+    big = mb.submit(np.zeros((100, 2)))      # 100 > 32, queue empty
+    assert big.result(10).shape == (100,)
+    assert mb.counters.get("shed") == 0
+    mb.close()
+
+
+def test_batcher_close_not_deadlocked_by_blocked_submitter():
+    """close() must honor its timeout even when a submitter is stuck in
+    a blocking put on a full queue behind a wedged dispatcher — the
+    blocked submitter's request is failed with SHUTDOWN too."""
+    mb, gate, entered, _ = _gated_batcher(max_batch=2, linger_ms=0.0,
+                                          queue_depth=2)
+    first = mb.submit(np.zeros((2, 2)))      # dispatcher takes it, wedges
+    assert entered.wait(5)
+    _drain_to_dispatcher(mb)
+    queued = [mb.submit(np.zeros((2, 2))) for _ in range(2)]  # queue full
+    late = []
+
+    def blocked_submit():
+        late.append(mb.submit(np.zeros((2, 2))))  # blocks in q.put
+
+    t = threading.Thread(target=blocked_submit, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    t0 = time.perf_counter()
+    mb.close(timeout=0.3)
+    assert time.perf_counter() - t0 < 10, "close() deadlocked"
+    t.join(5)
+    assert not t.is_alive(), "submitter still blocked after close"
+    for r in [first] + queued + late:
+        assert r.done()
+        with pytest.raises(ShutdownError):
+            r.result(0)
+    gate.set()
+
+
+def test_batcher_late_dispatch_never_double_accounts_shutdown():
+    """A dispatch that completes AFTER close() failed its batch with
+    SHUTDOWN must not also fulfill/count those requests — and anything
+    the resuming dispatcher pops post-abandonment is failed, never
+    served (the drain-race closure)."""
+    mb, gate, entered, dispatched = _gated_batcher(max_batch=4,
+                                                   linger_ms=0.0)
+    reqs = [mb.submit(np.zeros((2, 2))) for _ in range(4)]
+    assert entered.wait(5)                # batch 1 wedged mid-dispatch
+    mb.close(timeout=0.2)
+    assert all(r.done() for r in reqs)
+    assert mb.counters.get("shutdown_failed") == 4
+    gate.set()                            # wedged dispatch completes now
+    mb._thread.join(10)
+    assert not mb._thread.is_alive()
+    # the late completion neither re-served nor re-counted anything
+    assert mb.n_requests == 0
+    assert mb.latency.total == 0
+    for r in reqs:
+        with pytest.raises(ShutdownError):
+            r.result(0)
+
+
+def test_predict_timeout_slot_reclaimed(booster):
+    """predict(timeout=) rides the deadline machinery: after the
+    timeout the dispatcher DROPS the request (slot reclaimed), it is
+    never served into the void."""
+    bst, X, _ = booster
+    with bst.serve(linger_ms=1.0, raw_score=True) as srv:
+        with faults.inject("slow_dispatch:sec=0.5:n=1"):
+            slow = srv.submit(X[:32])     # wedge the dispatcher
+            end = time.monotonic() + 5
+            while srv.stats()["queued_rows"] and time.monotonic() < end:
+                time.sleep(0.005)
+            time.sleep(0.05)              # outlive the linger window
+            with pytest.raises(TimeoutError):
+                srv.predict(X[:16], timeout=0.05)
+            slow.result(60)
+        end = time.monotonic() + 5        # the expired predict's drop
+        while srv.counters.get("expired") < 1 and time.monotonic() < end:
+            time.sleep(0.005)
+        assert srv.counters.get("expired") == 1
+        # the abandoned request's rows never reached a dispatch
+        assert srv.stats()["rows"] == 32
+
+
+def test_publish_fail_rolls_back_generation_monotonic():
+    rng = np.random.default_rng(17)
+    Xb = rng.normal(size=(500, 5)).astype(np.float32).astype(np.float64)
+    yb = Xb[:, 0] * 2.0
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "verbose": -1, "min_data_in_leaf": 5},
+                  lgb.Dataset(Xb, label=yb), num_boost_round=3,
+                  keep_training_booster=True)
+    srv = b.serve(linger_ms=1.0, raw_score=True)
+    old = srv.predict(Xb[:40], timeout=60)
+    v0 = srv.generation.version
+    b.update()
+    with faults.inject("publish_fail"):
+        with pytest.raises(faults.FaultInjected):
+            srv.publish()
+    # rollback: version untouched, OLD generation still serving
+    assert srv.generation.version == v0
+    assert np.array_equal(srv.predict(Xb[:40], timeout=60), old)
+    assert srv.counters.get("publish_failures") == 1
+    # the pack-append site (consult #2, after=1) rolls back too
+    with faults.inject("publish_fail:after=1:n=1"):
+        with pytest.raises(faults.FaultInjected):
+            srv.publish()
+    assert srv.generation.version == v0
+    # next publish succeeds gaplessly and serves the new trees
+    info = srv.publish()
+    assert info.version == v0 + 1
+    assert np.array_equal(
+        srv.predict(Xb[:40], timeout=60),
+        b.predict(Xb[:40], device=True, raw_score=True))
+    srv.close()
+
+
+def test_degraded_route_bit_identical_to_host_walk(booster):
+    bst, X, _ = booster
+    srv = bst.serve(linger_ms=1.0, raw_score=True, probe_interval_s=0.05)
+    try:
+        direct = bst.predict(X[:80], device=True, raw_score=True)
+        srv.degrade("test: forced")
+        got = srv.predict(X[:80], timeout=60)
+        # degraded = the HOST walk, bit-identical to Booster.predict
+        assert np.array_equal(got, bst.predict(X[:80], raw_score=True))
+        assert srv.stats()["degraded"]
+        assert srv.counters.get("degraded_batches") >= 1
+        # background probe un-degrades (device is healthy here)
+        end = time.monotonic() + 10
+        while srv.stats()["degraded"] and time.monotonic() < end:
+            time.sleep(0.02)
+        assert not srv.stats()["degraded"]
+        assert srv.counters.get("recoveries") == 1
+        assert np.array_equal(srv.predict(X[:80], timeout=60), direct)
+    finally:
+        srv.close()
+
+
+def test_retry_exhaustion_degrades_and_still_answers(booster):
+    bst, X, _ = booster
+    srv = bst.serve(linger_ms=1.0, raw_score=True, probe_interval_s=0.0,
+                    retry_policy=RetryPolicy(max_attempts=2,
+                                             base_delay=0.001,
+                                             max_delay=0.01,
+                                             deadline=2.0))
+    try:
+        with faults.inject("dispatch_error:p=1:n=2"):
+            got = srv.predict(X[:64], timeout=60)
+        # the wedged batch is still ANSWERED — via the host walk
+        assert np.array_equal(got, bst.predict(X[:64], raw_score=True))
+        s = srv.stats()
+        assert s["degraded"] and "exhausted" in s["degraded_reason"]
+        assert srv.counters.get("dispatch_failures") == 1
+        assert srv.counters.get("dispatch_retries") == 1
+        # probe_interval_s=0: degradation is sticky (no probe thread)
+        assert srv.counters.get("recoveries") == 0
+    finally:
+        srv.close()
+
+
+def test_transient_dispatch_fault_retried_bit_identical(booster):
+    bst, X, _ = booster
+    with bst.serve(linger_ms=1.0, raw_score=True) as srv:
+        with faults.inject("dispatch_error"):
+            got = srv.predict(X[:64], timeout=60)
+        assert np.array_equal(
+            got, bst.predict(X[:64], device=True, raw_score=True))
+        assert srv.counters.get("dispatch_retries") == 1
+        assert not srv.stats()["degraded"]
+
+
+def test_nontransient_dispatch_error_fails_batch_not_degrades():
+    calls = []
+
+    def dispatch(X):
+        calls.append(X.shape[0])
+        raise ValueError("a code bug, not a flaky device")
+
+    mb = MicroBatcher(dispatch, max_batch=100, linger_ms=1.0)
+    r = mb.submit(np.zeros((3, 2)))
+    with pytest.raises(ValueError, match="code bug"):
+        r.result(10)
+    mb.close()
+    assert mb.n_errors == 1
+
+
+def test_batcher_close_timeout_fails_pending_with_shutdown():
+    """ISSUE 9 satellite: a drain past the timeout must FAIL every
+    still-pending future (SHUTDOWN), never abandon a blocked client."""
+    mb, gate, entered, _ = _gated_batcher(max_batch=4, linger_ms=0.0)
+    reqs = [mb.submit(np.zeros((2, 2))) for _ in range(6)]
+    assert entered.wait(5)                # dispatcher stuck mid-batch
+    t0 = time.perf_counter()
+    mb.close(timeout=0.3)
+    assert time.perf_counter() - t0 < 10
+    assert all(r.done() for r in reqs), "a client would block forever"
+    for r in reqs:
+        with pytest.raises(ShutdownError, match="SHUTDOWN"):
+            r.result(0)
+    assert mb.counters.get("shutdown_failed") == len(reqs)
+    gate.set()                            # unwedge the daemon thread
+
+
+def test_server_deadline_knob_resolves_from_params():
+    rng = np.random.default_rng(23)
+    X = rng.normal(size=(400, 4)).astype(np.float64)
+    y = X[:, 0]
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbose": -1, "min_data_in_leaf": 5,
+                     "tpu_serving_deadline_ms": 1234.0,
+                     "tpu_serving_max_queue_rows": 4096},
+                    lgb.Dataset(X, label=y), num_boost_round=2)
+    with bst.serve() as srv:
+        s = srv.stats()
+        assert s["deadline_ms"] == pytest.approx(1234.0)
+        assert s["max_queue_rows"] == 4096
+    with bst.serve(deadline_ms=0.0, max_queue_rows=0) as srv:
+        assert srv.stats()["deadline_ms"] == 0.0
+        assert srv.stats()["max_queue_rows"] == 0
 
 
 def test_server_mesh_two_virtual_devices_subprocess(booster):
