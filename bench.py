@@ -534,7 +534,7 @@ def run_ingest_child(mode: str) -> None:
     from lightgbm_tpu.robustness import heartbeat as hb
     hb_base = os.environ.get(hb.ENV_HEARTBEAT, "")
     if hb_base:
-        hb.install(f"{hb_base}.r{rank}")
+        hb.install(hb.rank_path(hb_base, rank))
     hb.beat(hb.PHASE_COMPILING, 0)
     import jax
 
@@ -580,25 +580,52 @@ def run_ingest_child(mode: str) -> None:
 
 def _run_ingest_gang(mode: str, deadline: float) -> list:
     """Launch + supervise one ingest gang; returns the per-rank record
-    dicts. Raises on rank failure/timeout (caller maps to status)."""
+    dicts. Raises on rank failure/timeout (caller maps to status).
+
+    Supervision is the ISSUE 10 gang supervisor over the children's
+    per-rank heartbeats: a rank death SIGTERMs the survivors instead of
+    leaving them wedged in the binning allgathers until the blunt
+    timeout, and the raised GangError carries a per-rank last-phase
+    diagnosis for the no_result record."""
+    import dataclasses as _dc
     import tempfile as _tf
 
-    from lightgbm_tpu.distributed import launch_local
+    from lightgbm_tpu.distributed import spawn_local
+    from lightgbm_tpu.robustness.gang import GangSupervisor
+    from lightgbm_tpu.robustness.heartbeat import StallPolicy, rank_path
     fd, hb_base = _tf.mkstemp(prefix=f"bench_ingest_{mode}_",
                               suffix=".hb")
     os.close(fd)
     budget = max(deadline - time.time(), 30.0)
+    # a construct() at bench scale is a legitimately LONG quiet phase
+    # (the replicated leg beats once then bins for minutes; 100M-row
+    # targets far exceed the default 300 s measuring budget), so widen
+    # every per-phase stall budget to the gang budget — death and
+    # file-silence detection (the keepalive thread keeps touching
+    # through construct) still fire fast, which is the supervisor's
+    # whole advantage over the old blunt kill
+    pol = StallPolicy.from_env()
+    pol = _dc.replace(
+        pol,
+        stall_sec={p: max(v, budget) for p, v in pol.stall_sec.items()},
+        default_stall=max(pol.default_stall, budget))
     try:
-        results = launch_local(
+        procs = spawn_local(
             [sys.executable, os.path.abspath(__file__)],
             num_processes=INGEST_WORLD, cpu_devices_per_process=1,
-            timeout=budget,
             env_extra={"_LGBM_BENCH_INGEST_CHILD": mode,
                        heartbeat.ENV_HEARTBEAT: hb_base,
                        ENV_COMPILE_CACHE: _cache_dir()})
+        sup = GangSupervisor(
+            procs, hb_base,
+            hb_paths=[rank_path(hb_base, r)
+                      for r in range(INGEST_WORLD)],
+            policy=pol, label=f"ingest {mode} gang",
+            escalate_kill=True)      # virtual-CPU gang, no device claim
+        results = sup.watch(timeout=budget)
     finally:
         for r in range(INGEST_WORLD):
-            for p in (hb_base, f"{hb_base}.r{r}"):
+            for p in (hb_base, rank_path(hb_base, r)):
                 try:
                     os.unlink(p)
                 except OSError:

@@ -21,6 +21,12 @@ Launch examples:
              print(launch_local(['python', 'pod_train.py'], 2, \
                                 cpu_devices_per_process=2))"
 
+  # SUPERVISED rehearsal (ISSUE 10 fault-tolerant gang): per-rank
+  # heartbeat supervision, rank death SIGTERMs the survivors, and the
+  # whole gang auto-relaunches from the newest gang manifest — one
+  # rank death costs one resume, not the session
+  python pod_train.py --local-gang 2
+
 Each process loads ITS OWN row shard (per-rank slice here; a per-rank
 file via 'data_{rank}.csv' works the same) and ``pre_partition=true``
 engages sharded ingestion: distributed bin finding (per-shard sample
@@ -38,14 +44,19 @@ import sys
 sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "..", ".."))
 
-from lightgbm_tpu.distributed import init_from_env  # noqa: E402
+_GANG_FLAG = "--local-gang"
+_LAUNCHER = _GANG_FLAG in sys.argv
 
-rank = init_from_env()          # must precede any other jax use
+if not _LAUNCHER:
+    from lightgbm_tpu.distributed import init_from_env  # noqa: E402
 
-import numpy as np              # noqa: E402
+    rank = init_from_env()      # must precede any other jax use
 
-import lightgbm_tpu as lgb      # noqa: E402
-from lightgbm_tpu.distributed import num_processes, row_slice  # noqa: E402
+    import numpy as np          # noqa: E402
+
+    import lightgbm_tpu as lgb  # noqa: E402
+    from lightgbm_tpu.distributed import (num_processes,  # noqa: E402
+                                          row_slice)
 
 N_ROWS = int(os.environ.get("POD_TRAIN_ROWS", 40_000))
 N_FEATURES = 16
@@ -78,6 +89,17 @@ def load_data(rank: int, world: int):
 def main() -> None:
     world = num_processes()
     X, y = load_data(rank, world)
+    # fault tolerance (ISSUE 10): with a checkpoint dir set, rank 0
+    # commits CRC checkpoints + gang manifests (world size, per-rank
+    # shard digests) and EVERY rank resumes from the newest committed
+    # manifest — the supervised launcher below relaunches a failed
+    # gang through exactly this path
+    ckpt_dir = os.environ.get("POD_TRAIN_CKPT_DIR", "")
+    callbacks = []
+    if ckpt_dir and rank == 0:
+        callbacks.append(lgb.checkpoint_callback(
+            ckpt_dir, every_n=int(os.environ.get("POD_TRAIN_CKPT_EVERY",
+                                                 "5")), keep_last=5))
     bst = lgb.train(
         {"objective": "binary", "tree_learner": "data",
          "num_leaves": 63, "learning_rate": 0.1, "verbose": -1,
@@ -88,7 +110,8 @@ def main() -> None:
          # accumulation under the global scales
          "use_quantized_grad": True, "stochastic_rounding": False,
          "deterministic": True, "seed": 7},
-        lgb.Dataset(X, label=y), num_boost_round=30)
+        lgb.Dataset(X, label=y), num_boost_round=30,
+        callbacks=callbacks, resume_from=ckpt_dir or None)
     if rank == 0:
         bst.save_model("pod_model.txt")
         pred = bst.predict(X)
@@ -98,5 +121,37 @@ def main() -> None:
               flush=True)
 
 
+def _launch_gang() -> None:
+    """``--local-gang N``: run N ranks of THIS script as a SUPERVISED
+    fault-tolerant gang (robustness/gang.py). The launcher never runs a
+    jax op or initializes a backend — supervisor discipline: backend
+    init is what hangs on a wedged tunnel — and a mid-run rank death
+    SIGTERMs the survivors and relaunches the gang, resuming from the
+    newest valid gang manifest in POD_TRAIN_CKPT_DIR (a tmpdir by
+    default)."""
+    import tempfile
+
+    from lightgbm_tpu.robustness.gang import run_supervised
+
+    i = sys.argv.index(_GANG_FLAG)
+    world = (int(sys.argv[i + 1])
+             if len(sys.argv) > i + 1 and sys.argv[i + 1].isdigit()
+             else 2)
+    ckpt = os.environ.get("POD_TRAIN_CKPT_DIR") or \
+        tempfile.mkdtemp(prefix="pod_train_ckpt_")
+    results = run_supervised(
+        [sys.executable, os.path.abspath(__file__)], world,
+        cpu_devices_per_process=int(
+            os.environ.get("POD_TRAIN_DEVICES", "2")),
+        timeout=float(os.environ.get("POD_TRAIN_TIMEOUT", "600")),
+        env_extra={"POD_TRAIN_CKPT_DIR": ckpt},
+        label="pod_train gang")
+    for r, (rc, out) in enumerate(results):
+        print(f"--- rank {r} (rc={rc}) ---\n{out}", end="", flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    if _LAUNCHER:
+        _launch_gang()
+    else:
+        main()

@@ -170,6 +170,23 @@ def checkpoint_callback(directory: str, every_n: int = 1,
             return
         state = _ckpt.booster_state(env.model, it, eval_history)
         path = _ckpt.write_checkpoint(directory, state)
+        # gang manifest (ISSUE 10): in a sharded world the manifest —
+        # written AFTER its checkpoint — is the commit marker: world
+        # size + per-rank shard digests, so resume refuses a different
+        # sharding and anchors at the newest COMMITTED iteration. A
+        # crash between the two writes leaves an uncommitted checkpoint
+        # that resume skips.
+        eng = getattr(env.model, "_engine", None)
+        shard = getattr(getattr(eng, "train_set", None), "shard", None)
+        if shard is not None and getattr(shard, "digests", None) and \
+                bool(getattr(getattr(eng, "config", None),
+                             "tpu_gang_manifest", True)):
+            import os as _os
+
+            from .robustness import gang
+            gang.write_manifest(directory, it, _os.path.basename(path),
+                                shard)
+            gang.prune_manifests(directory, keep_last)
         _ckpt.prune_checkpoints(directory, keep_last)
         log.debug(f"checkpoint written: {path}")
 

@@ -384,8 +384,30 @@ _reg("tpu_ingest", str, "auto", ())
 # when a supervisor exports LGBM_TPU_HEARTBEAT), the training loop
 # writes crash-safe liveness beats (compiling / iter N) and starts the
 # in-training stall watchdog, which raises DeviceStallError instead of
-# hanging forever at a wedged device sync.
+# hanging forever at a wedged device sync. In a multi-process world
+# each rank writes the rank-suffixed path (<file>.r<rank>) so a gang
+# supervisor (robustness/gang.py) can classify every rank separately.
 _reg("tpu_heartbeat_file", str, "", ())
+# collective liveness deadline (robustness/gang.py ISSUE 10), seconds:
+# host-level collectives (the sharded-ingest allgather rounds, injected
+# -collective transports) raise CollectiveTimeout (DEADLINE_EXCEEDED)
+# when blocked past it — a rank waiting on a DEAD peer dies classified
+# instead of wedging to the whole-gang timeout. 0 = inherit
+# LGBM_TPU_COLLECTIVE_TIMEOUT, default 300 s. Raise it for pod-scale
+# payloads (100M-row metadata allgathers); keep it well under the
+# gang's hard deadline.
+_reg("tpu_gang_collective_timeout_s", float, 0.0, (),
+     (0, None, True, False))
+# coordinated gang checkpoints (robustness/gang.py): sharded runs
+# commit a per-iteration gang manifest next to each CRC checkpoint
+# (world size, per-rank row counts + sampled shard-content digests,
+# atomic commit of the checkpoint it references), and resume_from
+# validates it is resuming the SAME sharding — torn or mixed-world
+# checkpoint sets are refused loudly with a per-rank diagnosis, and
+# resume anchors at the newest COMMITTED iteration so every rank and
+# every relaunch agree. Disable only to resume a trusted legacy
+# (pre-manifest) checkpoint set.
+_reg("tpu_gang_manifest", bool, True, ())
 # stall budget override (seconds) for the in-training watchdog and any
 # supervisor reading this process's heartbeat: how long one phase may
 # sit with no substantive beat before it is classified hung. 0 = the

@@ -140,6 +140,101 @@ def row_slice(num_rows: int, rank: int, world: int) -> "tuple[int, int]":
     return rank * num_rows // world, (rank + 1) * num_rows // world
 
 
+# ---------------------------------------------------------------------------
+# Collective liveness (ISSUE 10): a host-level collective blocked on a
+# dead peer must RAISE within a deadline, never wedge the rank until the
+# whole-gang timeout. Covers allgather_bytes (the sharded-ingest
+# transport) and every injected-collective call site; a rank wedged
+# inside a *jitted* collective is covered by the in-training watchdog
+# (robustness/heartbeat.TrainingWatchdog -> EXIT_STALLED), which the
+# gang supervisor classifies the same way.
+# ---------------------------------------------------------------------------
+
+ENV_COLLECTIVE_TIMEOUT = "LGBM_TPU_COLLECTIVE_TIMEOUT"
+DEFAULT_COLLECTIVE_TIMEOUT = 300.0
+
+_collective_timeout_override: "Optional[float]" = None
+
+
+class CollectiveTimeout(Exception):
+    """A host-level collective exceeded its liveness deadline — a peer
+    is presumed dead or wedged.
+
+    The message carries ``DEADLINE_EXCEEDED`` so OUTER supervision (the
+    gang relaunch policy, session supervisors) classifies the rank's
+    death as transient; ``retried_collective`` itself does NOT retry it
+    in-process — a dead peer does not come back within an in-process
+    retry budget, and re-driving a gloo round while the previous one is
+    still blocked in a leaked thread would desync the collective
+    sequence across the gang. The correct recovery is rank death +
+    whole-gang relaunch from the newest manifest."""
+
+    def __init__(self, msg: str):
+        super().__init__(f"DEADLINE_EXCEEDED: {msg}")
+
+
+def set_collective_timeout(sec: Optional[float]) -> None:
+    """Pin the collective liveness deadline for this process (seconds;
+    ``tpu_gang_collective_timeout_s`` routes through here from dataset
+    construction and the gbdt setup). None or <= 0 clears the pin back
+    to the env/default resolution."""
+    global _collective_timeout_override
+    _collective_timeout_override = (
+        float(sec) if sec is not None and float(sec) > 0 else None)
+
+
+def collective_timeout() -> float:
+    """Effective deadline (seconds; <= 0 disables): explicit
+    :func:`set_collective_timeout` > ``LGBM_TPU_COLLECTIVE_TIMEOUT`` >
+    300 s default. Pod-scale payloads (100M-row metadata allgathers)
+    should raise it; it must stay well under the gang's own hard
+    deadline so a dead peer surfaces as ONE rank's classified death,
+    not a whole-gang timeout."""
+    if _collective_timeout_override is not None:
+        return _collective_timeout_override
+    import os
+    v = (os.environ.get(ENV_COLLECTIVE_TIMEOUT) or "").strip()
+    if v:
+        return float(v)
+    return DEFAULT_COLLECTIVE_TIMEOUT
+
+
+def call_with_deadline(fn, timeout: float, what: str = "collective"):
+    """Run ``fn()`` in a watchdog thread and raise
+    :class:`CollectiveTimeout` if it does not finish within ``timeout``
+    seconds (<= 0 runs inline, no thread). On timeout the worker thread
+    is left blocked (daemon — it holds no locks the caller needs); the
+    caller is expected to let the raise propagate and die so the gang
+    supervisor can relaunch, which is why timeouts are never retried
+    in-process."""
+    if not timeout or timeout <= 0:
+        return fn()
+    import threading
+
+    done = threading.Event()
+    box: dict = {}
+
+    def _run():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — relayed to caller
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_run, name="lgbm-tpu-collective",
+                         daemon=True)
+    t.start()
+    if not done.wait(timeout):
+        raise CollectiveTimeout(
+            f"collective {what!r} exceeded its {timeout:.0f}s liveness "
+            "deadline — a peer is presumed dead or wedged; raising so "
+            "this rank dies classified instead of hanging the gang")
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
 def allgather_bytes(blob: bytes, what: str = "allgather_bytes") -> list:
     """Allgather variable-length byte blobs across the process world —
     the transport of the distributed bin-finding protocol (sample
@@ -289,31 +384,81 @@ def launch_local(argv: Sequence[str], num_processes: int,
                  coordinator_port: Optional[int] = None,
                  cpu_devices_per_process: int = 0,
                  timeout: float = 600.0,
-                 env_extra: Optional[dict] = None) -> list:
+                 env_extra: Optional[dict] = None,
+                 supervised: bool = False,
+                 **gang_kw) -> list:
     """Spawn ``num_processes`` copies of ``argv`` on THIS machine, wired
     into one distributed world (the local analog of spawn-per-host; the
     per-host version is the same env contract under any real launcher).
 
-    Returns ``[(returncode, combined_output), ...]`` per rank. Kills the
-    whole gang on timeout so a hung rank cannot leak claim-holding
-    children.
+    Returns ``[(returncode, combined_output), ...]`` per rank.
+
+    ``supervised=True`` routes through the fault-tolerant gang
+    (robustness/gang.py run_supervised; extra keywords pass through):
+    per-rank heartbeat supervision under the shared StallPolicy, rank
+    death SIGTERMs the survivors instead of letting them wedge in a
+    collective, and the WHOLE gang is auto-relaunched under a bounded
+    RetryPolicy — workers resume from the newest valid gang manifest —
+    so one rank death costs one resume, not the session.
+
+    Unsupervised (the default) keeps the blunt whole-gang timeout kill,
+    but exports a heartbeat base to the workers so the
+    :class:`~.robustness.gang.GangTimeout` it raises on the timeout
+    path carries per-rank last-phase/last-beat forensics instead of
+    nothing (it subclasses ``subprocess.TimeoutExpired`` — existing
+    callers keep catching it).
     """
+    if supervised:
+        from .robustness.gang import run_supervised
+        return run_supervised(
+            argv, num_processes, coordinator_port=coordinator_port,
+            cpu_devices_per_process=cpu_devices_per_process,
+            timeout=timeout, env_extra=env_extra, **gang_kw)
+    if gang_kw:
+        raise TypeError(f"unexpected arguments {sorted(gang_kw)} "
+                        "(supervised=True options)")
+    import os
+    import shutil
     import subprocess
+    import tempfile
+
+    from .robustness.gang import GangTimeout, gang_hb_paths
+    from .robustness.heartbeat import ENV_HEARTBEAT
+
+    extra = dict(env_extra or {})
+    hb_tmp = None
+    hb_base = extra.get(ENV_HEARTBEAT) or os.environ.get(ENV_HEARTBEAT)
+    if not hb_base:
+        hb_tmp = tempfile.mkdtemp(prefix="lgbm_gang_hb_")
+        hb_base = os.path.join(hb_tmp, "gang.hb")
+        extra[ENV_HEARTBEAT] = hb_base
     procs = spawn_local(argv, num_processes,
                         coordinator_port=coordinator_port,
                         cpu_devices_per_process=cpu_devices_per_process,
-                        env_extra=env_extra)
+                        env_extra=extra)
     results = []
     try:
         for p in procs:
             out, _ = p.communicate(timeout=timeout)
             results.append((p.returncode, out))
+        return results
     except subprocess.TimeoutExpired:
+        # hung-gang forensics BEFORE the kill: each rank's last
+        # phase/beat answers "why did it die" (the r03-style gap,
+        # gang edition)
+        from .robustness.gang import rank_diagnosis
+        rcs = [p.poll() for p in procs]
+        diag = rank_diagnosis(gang_hb_paths(hb_base, num_processes),
+                              rcs)
         for p in procs:
             if p.poll() is None:
                 p.kill()
-        raise
-    return results
+        raise GangTimeout(
+            list(argv), timeout,
+            diagnosis="Per-rank diagnosis at the timeout:\n" + diag)
+    finally:
+        if hb_tmp is not None:
+            shutil.rmtree(hb_tmp, ignore_errors=True)
 
 
 # ---------------------------------------------------------------------------
@@ -398,20 +543,39 @@ def retried_collective(fn, arr, what: str = "injected collective"):
     retry itself; the harness's injected faults model the
     request-lost case, which every barrier/rendezvous transport
     handles naturally.
+
+    Collective liveness (ISSUE 10): each attempt runs under
+    :func:`call_with_deadline` (``collective_timeout()`` seconds), so a
+    call blocked on a dead peer raises :class:`CollectiveTimeout`
+    instead of wedging. Timeouts are deliberately NOT retried here —
+    see CollectiveTimeout — the raise propagates, the rank dies
+    classified, and the gang supervisor relaunches. The injected
+    ``collective_delay`` fault stretches an attempt INSIDE the deadline
+    window (the blocked-peer simulation).
     """
+    import dataclasses
     import os
 
     from .robustness import faults
     from .robustness.retry import COLLECTIVE_POLICY, retry_call
 
-    def attempt():
-        faults.maybe_fail("collective")
+    timeout = collective_timeout()
+
+    def op():
+        faults.maybe_delay("collective_delay")
         return fn(arr)
 
-    return retry_call(
-        attempt,
-        policy=COLLECTIVE_POLICY.from_env_overrides(os.environ),
-        what=what)
+    def attempt():
+        faults.maybe_fail("collective")
+        return call_with_deadline(op, timeout, what=what)
+
+    policy = COLLECTIVE_POLICY.from_env_overrides(os.environ)
+    base_classifier = policy.classifier
+    policy = dataclasses.replace(
+        policy,
+        classifier=lambda e: (not isinstance(e, CollectiveTimeout)
+                              and base_classifier(e)))
+    return retry_call(attempt, policy=policy, what=what)
 
 
 def make_injected_hooks():

@@ -114,6 +114,27 @@ def train(params: Dict[str, Any], train_set: Dataset,
     train_set._update_params(params)
     train_set.construct()
 
+    # gang-coordinated resume (ISSUE 10): in a sharded world the
+    # checkpoint set must be proven to belong to THIS sharding, and
+    # resume must anchor at the newest COMMITTED (manifested) iteration
+    # so every rank — and every auto-relaunch — agrees on the restart
+    # point. Runs SPMD on all ranks; the decision depends only on the
+    # shared directory and the allgathered ShardInfo, so ranks cannot
+    # disagree. Refuses torn/mixed-world sets loudly.
+    if resume_from and str(params.get("tpu_gang_manifest", "true")
+                           ).strip().lower() not in ("0", "false",
+                                                     "off", "no"):
+        shard = getattr(getattr(train_set, "_binned", None), "shard",
+                        None)
+        if shard is not None:
+            from .robustness.gang import validate_and_select_resume
+            anchored = validate_and_select_resume(
+                resume_from, shard, resumed_state)
+            if anchored is not resumed_state:
+                resumed_state = anchored
+                init_model = (Booster(model_str=anchored["model"])
+                              if anchored is not None else None)
+
     # continued training (ref: engine.py:233-244)
     if isinstance(init_model, (str,)):
         predictor = Booster(model_file=init_model)

@@ -39,6 +39,12 @@ class ShardInfo:
     rank: int
     world: int
     row_counts: np.ndarray        # int64 [world]
+    # sampled content digest of EVERY rank's binned shard (uint32 per
+    # rank, allgathered once at construction): the gang-manifest
+    # fingerprint (robustness/gang.py) — coordinated checkpoints stamp
+    # these so resume_from refuses a DIFFERENT sharding of the data,
+    # not just a different world size
+    digests: Optional[Tuple[int, ...]] = None
 
     @property
     def local_num_data(self) -> int:
@@ -48,6 +54,22 @@ class ShardInfo:
     def row_offset(self) -> int:
         """Global (concatenated-table) index of this shard's first row."""
         return int(self.row_counts[:self.rank].sum())
+
+
+def _shard_content_digest(bins: np.ndarray) -> int:
+    """Sampled CRC32 fingerprint of one rank's binned shard — the
+    per-rank entry of the gang manifest (robustness/gang.py). Samples
+    ~64 evenly spaced rows (columns of the feature-major matrix) plus
+    the shape/dtype, the same economy as file_loader's shared-file
+    content agreement: cheap at any scale, and a different shard cut,
+    permuted rows, or different data all change it."""
+    import zlib
+    rows = int(bins.shape[1]) if bins.ndim == 2 else len(bins)
+    h = zlib.crc32(f"{bins.dtype}:{bins.shape}".encode())
+    step = max(1, rows // 64)
+    for i in range(0, rows, step):
+        h = zlib.crc32(np.ascontiguousarray(bins[:, i]).tobytes(), h)
+    return h & 0xffffffff
 
 
 _SHARD_RESOLVE_LOGGED: set = set()
@@ -562,8 +584,30 @@ class BinnedDataset:
         use_quantized_grad=true (exact int32 histogram sums make the
         shard layout invisible)."""
         num_data, num_features = source.num_data, source.num_features
+        from .. import distributed
         from ..distributed import allgather_bytes
+        from ..robustness import heartbeat
 
+        # collective liveness (ISSUE 10): the param pins the deadline
+        # for every collective of this construction AND the training
+        # that follows; 0 keeps the env/default resolution
+        if float(config.tpu_gang_collective_timeout_s or 0.0) > 0.0:
+            distributed.set_collective_timeout(
+                float(config.tpu_gang_collective_timeout_s))
+        # per-rank liveness from the FIRST collective: a gang supervisor
+        # exporting LGBM_TPU_HEARTBEAT must see beats during ingestion
+        # too, not only once training starts (models/gbdt.py installs
+        # the same rank-suffixed path later — install is idempotent)
+        import os as _os
+        _hb_env = (_os.environ.get(heartbeat.ENV_HEARTBEAT) or "").strip()
+        if _hb_env:
+            heartbeat.install(heartbeat.rank_path(_hb_env, rank)
+                              if world > 1 else _hb_env)
+
+        def _hb(step: int) -> None:
+            heartbeat.beat(heartbeat.PHASE_INGEST, step)
+
+        _hb(0)
         counts = allgather_bytes(
             np.asarray([num_data, num_features], np.int64).tobytes(),
             what="sharded ingest: row counts")
@@ -596,6 +640,7 @@ class BinnedDataset:
                       "on every host; it is not supported with sharded "
                       "ingestion (tpu_ingest='sharded'/pre_partition)")
 
+        _hb(1)
         self.bin_mappers = cls._find_bin_mappers_sharded(
             source, config, categorical_features, rank, world, row_counts)
         self.used_feature_map = _used_feature_map(self.bin_mappers)
@@ -604,11 +649,25 @@ class BinnedDataset:
         # process ever materializes the global [F, N] table. Sharded
         # storage is dense u8/u16 (EFB/multival conflict scans would
         # need cross-shard agreement; gated off in the engine).
+        _hb(2)
         self.bins = _quantize_dense(source, self.bin_mappers,
                                     self.used_feature_map)
 
+        # gang-manifest fingerprint (ISSUE 10): a sampled content digest
+        # of THIS rank's binned shard, allgathered so every rank holds
+        # the whole gang's digests — coordinated checkpoints stamp them
+        # into the manifest and resume_from refuses a different sharding
+        _hb(3)
+        local_digest = _shard_content_digest(self.bins)
+        got = allgather_bytes(int(local_digest).to_bytes(4, "big"),
+                              what="sharded ingest: shard digests")
+        self.shard = dataclasses.replace(
+            self.shard,
+            digests=tuple(int.from_bytes(b, "big") for b in got))
+
         # global per-row metadata, rank-order concatenated — O(rows)
         # scalars per host vs the table's O(rows × features)
+        _hb(4)
         meta = Metadata(self.num_data)
         lab = _allgather_rows(label, np.float32,
                               "sharded ingest: label")
@@ -643,6 +702,7 @@ class BinnedDataset:
                  for r in range(world)], axis=1).reshape(-1)
         meta.set_init_score(isc)
         self.metadata = meta
+        _hb(5)
         return self
 
     # ------------------------------------------------------------------

@@ -45,7 +45,7 @@ import numpy as np
 
 from .. import tuned
 from ..config import Config
-from ..robustness import heartbeat
+from ..robustness import faults, heartbeat
 from ..core.grower import GrowerConfig, make_tree_grower
 from ..core.metrics import Metric, metrics_for_config
 from ..core.objective import ObjectiveFunction, CustomObjective, K_EPSILON
@@ -630,10 +630,27 @@ class GBDT:
                 _os.environ.get(ENV_JIT_CACHE):
             enable_persistent_cache(
                 str(cfg.tpu_compile_cache_dir) or None)
-        if cfg.tpu_heartbeat_file:
-            heartbeat.install(str(cfg.tpu_heartbeat_file))
-        else:
-            heartbeat.install_from_env()
+        # gang rank wiring (ISSUE 10): in a multi-process world every
+        # rank writes its OWN heartbeat file (rank_path suffix — the
+        # gang supervisor's read convention) so N ranks never clobber
+        # one liveness file, and the rank_kill fault site knows which
+        # rank it is
+        try:
+            self._process_rank = int(jax.process_index())
+            _world = int(jax.process_count())
+        except Exception:  # noqa: BLE001 — no backend/world yet
+            self._process_rank, _world = 0, 1
+        hb_path = str(cfg.tpu_heartbeat_file) or \
+            (_os.environ.get(heartbeat.ENV_HEARTBEAT) or "").strip()
+        if hb_path:
+            if _world > 1:
+                hb_path = heartbeat.rank_path(hb_path,
+                                              self._process_rank)
+            heartbeat.install(hb_path)
+        if float(cfg.tpu_gang_collective_timeout_s or 0.0) > 0.0:
+            from ..distributed import set_collective_timeout
+            set_collective_timeout(
+                float(cfg.tpu_gang_collective_timeout_s))
         policy = heartbeat.StallPolicy.from_env()
         if float(cfg.tpu_stall_sec or 0.0) > 0.0:
             s = float(cfg.tpu_stall_sec)
@@ -1945,6 +1962,11 @@ class GBDT:
         Liveness shell around the sync/async bodies: beats + the stall
         watchdog (armed only while the iteration is in flight) convert
         a forever-hang at a device sync into DeviceStallError."""
+        # injected rank death (ISSUE 10 chaos site): an armed rank_kill
+        # hard-exits THIS rank at the iteration boundary — the gang
+        # supervisor must SIGTERM the survivors and relaunch from the
+        # newest manifest (no-op without an active plan)
+        faults.maybe_kill_rank(getattr(self, "_process_rank", 0))
         wd = self._hb_iter_begin()
         try:
             if gradients is None and hessians is None and \

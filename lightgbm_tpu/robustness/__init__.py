@@ -28,6 +28,14 @@ with ``device_unreachable`` and losing every iteration of progress):
   (:class:`DeviceStallError` is transient under the retry policy), and
   an in-training watchdog raises instead of hanging forever at a
   wedged device sync.
+- :mod:`.gang` — the multi-process extension (ISSUE 10): per-rank
+  heartbeat supervision (:class:`~.gang.GangSupervisor` SIGTERMs the
+  survivors of a dead rank instead of letting them wedge in a
+  collective), coordinated gang manifests (world size + per-rank shard
+  digests committed per checkpoint; resume refuses torn/mixed-world
+  sets loudly), and bounded whole-gang auto-relaunch
+  (:func:`~.gang.run_supervised` /
+  ``distributed.launch_local(supervised=True)``).
 
 jax is never imported at module import time (mirrors analysis/guards.py:
 the CLI and host-side tools must be able to import this package without
@@ -44,8 +52,12 @@ from .faults import (FaultInjected, active_plan, inject, install_from_env,
 from .heartbeat import (DeviceStallError, Heartbeat, HeartbeatRecord,
                         StallPolicy, TrainingWatchdog)
 from .supervisor import StillAlive, watch_child
+from .gang import (GangError, GangSupervisor, GangTimeout,
+                   latest_valid_manifest, run_supervised, write_manifest)
 
 __all__ = [
+    "GangError", "GangSupervisor", "GangTimeout", "run_supervised",
+    "write_manifest", "latest_valid_manifest",
     "RetryPolicy", "RetryError", "retry_call", "is_transient_error",
     "CheckpointError", "atomic_write_text", "write_checkpoint",
     "read_checkpoint", "latest_valid_checkpoint", "list_checkpoints",

@@ -113,9 +113,12 @@ def write_checkpoint(directory: str, state: Dict) -> str:
     return path
 
 
-def read_checkpoint(path: str) -> Dict:
-    """Parse + validate one checkpoint file. Raises CheckpointError on
-    a missing/invalid footer, CRC mismatch, or unparseable header.
+def read_validated_text(path: str) -> str:
+    """CRC-validated payload of an ``atomic_write_text(crc_footer=True)``
+    file. Raises CheckpointError on a missing/invalid footer, length or
+    CRC mismatch — shared by checkpoint reads and the gang-manifest
+    reads (robustness/gang.py), so there is exactly one copy of the
+    footer validation.
 
     Works on raw bytes — CRC validation runs BEFORE any decoding, so
     corruption that breaks UTF-8 is still reported as a checkpoint
@@ -141,9 +144,15 @@ def read_checkpoint(path: str) -> Dict:
             f"{path}: CRC mismatch (footer "
             f"{m.group(1).decode()}, computed {crc:08x})")
     try:
-        body = payload.decode("utf-8")
+        return payload.decode("utf-8")
     except UnicodeDecodeError as e:
         raise CheckpointError(f"{path}: undecodable payload: {e}")
+
+
+def read_checkpoint(path: str) -> Dict:
+    """Parse + validate one checkpoint file. Raises CheckpointError on
+    a missing/invalid footer, CRC mismatch, or unparseable header."""
+    body = read_validated_text(path)
     nl = body.find("\n")
     header_line = body if nl < 0 else body[:nl]
     try:

@@ -48,6 +48,22 @@ Fault classes (the ``site`` argument of :func:`maybe_fail`):
   server-level rollback and the pack's no-torn-state commit are
   exercised; a bare spec fires at the server site, ``after=1`` reaches
   the append site.
+- ``rank_kill`` — one gang rank hard-exits (``os._exit`` with
+  :data:`EXIT_RANK_KILLED` — no cleanup, no flush: a real kill -9
+  shape) at an iteration boundary. Consulted via
+  :func:`maybe_kill_rank` at the top of the gbdt training iteration;
+  the ``rank=R`` option selects which rank dies (default: any rank
+  that consults) and ``after=N`` skips that rank's first N iterations,
+  so a chaos harness can kill rank R after exactly N iterations. The
+  survivors' recovery (collective deadline + gang supervisor SIGTERM +
+  relaunch-from-manifest) is the ISSUE 10 chaos gate
+  (scripts/gang_chaos_smoke.py).
+- ``collective_delay`` — stretches ONE injected-collective /
+  allgather attempt by ``sec`` seconds via :func:`maybe_delay`, INSIDE
+  the collective liveness deadline (distributed.call_with_deadline):
+  the blocked-dead-peer shape that must surface as
+  ``CollectiveTimeout`` (DEADLINE_EXCEEDED) instead of wedging the
+  rank to the whole-gang timeout.
 
 Options per spec:
 
@@ -59,8 +75,10 @@ Options per spec:
   kill the k-th checkpoint write precisely).
 - ``seed=<int>`` — per-fault RNG seed (default 0): injections are
   deterministic and reproducible across runs and threads.
-- ``sec=<float>`` — duration for delay-style faults (``slow_compile``
-  and ``slow_dispatch``; default 30.0).
+- ``sec=<float>`` — duration for delay-style faults (``slow_compile``,
+  ``slow_dispatch`` and ``collective_delay``; default 30.0).
+- ``rank=<int>`` — gang rank filter (``rank_kill``): only the matching
+  rank's consults count or fire (default: every rank).
 
 Counters are PER-PROCESS: an env-installed plan re-arms in every
 subprocess (each child re-runs install_from_env with fresh counters).
@@ -85,7 +103,12 @@ ENV_FAULTS = "LGBM_TPU_FAULTS"
 
 KNOWN_SITES = ("collective", "probe_timeout", "write_kill", "hang",
                "slow_compile", "dispatch_error", "slow_dispatch",
-               "publish_fail")
+               "publish_fail", "rank_kill", "collective_delay")
+
+# exit code of an injected rank_kill: the gang supervisor annotates it
+# in the per-rank diagnosis (distinct from EXIT_STALLED=86 so forensics
+# can tell an injected death from a self-watchdogged wedge)
+EXIT_RANK_KILLED = 87
 
 
 class FaultInjected(Exception):
@@ -101,10 +124,12 @@ class WriteKilled(FaultInjected):
 class _Fault:
     def __init__(self, site: str, p: float = 1.0,
                  n: Optional[int] = None, after: int = 0,
-                 seed: int = 0, sec: float = 30.0):
+                 seed: int = 0, sec: float = 30.0,
+                 rank: Optional[int] = None):
         self.site = site
         self.p = float(p)
         self.sec = float(sec)
+        self.rank = int(rank) if rank is not None else None
         # a bare always-on fault (p=1, no n) fires once then disarms:
         # "kill the write" means one kill, not an unrecoverable loop
         self.n = n if n is not None else (1 if self.p >= 1.0 else None)
@@ -169,6 +194,8 @@ class FaultPlan:
                     kw["seed"] = int(v)
                 elif k == "sec":
                     kw["sec"] = float(v)
+                elif k == "rank":
+                    kw["rank"] = int(v)
                 else:
                     raise ValueError(
                         f"unknown fault option {k!r} in {entry!r}")
@@ -239,6 +266,39 @@ def maybe_delay(site: str, sleep=None) -> float:
     import time
     (sleep if sleep is not None else time.sleep)(f.sec)
     return f.sec
+
+
+def maybe_kill_rank(rank: int, _exit=os._exit) -> None:
+    """``rank_kill`` consult (gbdt iteration boundary): when the fault
+    fires for THIS rank, hard-exit with :data:`EXIT_RANK_KILLED` — an
+    ``os._exit`` so no cleanup or atexit runs, the closest injectable
+    shape to a kill -9 mid-gang. A ``rank=R`` option restricts both the
+    call accounting and the kill to rank R (so ``after=N`` means "after
+    N of rank R's iterations"); without it every consulting rank is
+    eligible, each with per-process counters.
+
+    ``_exit`` is injectable so tests and the fault smoke can observe
+    the exit code without dying."""
+    plan = _active
+    if plan is None:
+        return
+    f = plan.faults.get("rank_kill")
+    if f is None:
+        return
+    if f.rank is not None and int(rank) != f.rank:
+        return
+    if not f.should_fire():
+        return
+    log.warning(f"injected rank_kill: rank {rank} hard-exiting "
+                f"rc={EXIT_RANK_KILLED} (call #{f.calls}, injection "
+                f"#{f.fired})")
+    try:
+        import sys
+        sys.stdout.flush()
+        sys.stderr.flush()
+    except Exception:   # noqa: BLE001 — dying anyway
+        pass
+    _exit(EXIT_RANK_KILLED)
 
 
 class inject:

@@ -1,0 +1,643 @@
+"""Fault-tolerant training gang (ISSUE 10 tentpole).
+
+PR7's sharded ingestion made multi-process training real, but its
+failure story was a blunt whole-gang ``timeout=600`` kill: a rank dying
+mid-run wedged every survivor inside a gloo collective, the supervisor
+learned nothing about *why*, and the kill-and-relaunch-resume path was a
+manual ``@slow`` test. This module extends the single-process
+supervision stack (heartbeats, :class:`~.heartbeat.StallPolicy`,
+:class:`~.retry.RetryPolicy`, CRC checkpoints, the fault grammar) from
+one child to N ranks:
+
+- **Per-rank supervision** (:class:`GangSupervisor`): every rank writes
+  the existing phase-tagged heartbeats to a per-rank file
+  (:func:`~.heartbeat.rank_path` — models/gbdt.py installs the
+  rank-suffixed path automatically in a multi-process world, and the
+  sharded-ingest constructor beats from the first collective). The
+  supervisor generalizes ``supervisor.watch_child`` to N children,
+  classifying each rank stall-vs-alive-vs-dead under the shared
+  StallPolicy; on any rank death or classified stall it SIGTERMs the
+  survivors (never SIGKILL — the claim-holder wedge discipline) instead
+  of letting them hang in a collective, and raises :class:`GangError`
+  carrying a per-rank diagnosis (last phase, beat age, exit codes).
+- **Coordinated checkpoints** (gang manifests): sharded runs commit a
+  per-iteration manifest next to each CRC checkpoint — world size,
+  per-rank row counts, per-rank sampled shard-content digests
+  (io/dataset_core.py), the checkpoint it commits — written with the
+  same atomic tmp+fsync+rename+CRC machinery. A manifest *commits* its
+  checkpoint: :func:`latest_valid_manifest` skips any manifest whose
+  CRC fails or whose referenced checkpoint is missing, corrupt, or
+  disagrees on the iteration (a torn commit), and
+  :func:`validate_and_select_resume` refuses mixed-world or
+  different-sharding checkpoint sets loudly with a per-rank diagnosis.
+- **Auto-relaunch** (:func:`run_supervised`, reachable as
+  ``distributed.launch_local(supervised=True)``): a failed gang is
+  relaunched whole under a bounded RetryPolicy — each rank resumes from
+  the newest valid manifest via the workers' ordinary
+  ``resume_from=`` path — so one rank death costs one resume, not the
+  session. :class:`GangError` carries ``DEADLINE_EXCEEDED`` so the
+  shared transient classifier treats gang failure as retryable.
+
+The collective-liveness half (a rank blocked on a dead peer's
+allgather raising :class:`~..distributed.CollectiveTimeout` within a
+deadline instead of wedging) lives in distributed.py; a rank wedged
+inside a *jitted* collective is covered by the PR4 in-training watchdog
+(beat age → ``EXIT_STALLED``), which this supervisor classifies.
+
+No jax import anywhere in this module — same hazard boundary as
+supervisor.py: a supervisor must never initialize a backend.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import subprocess
+import tempfile
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..utils import log
+from . import checkpoint as _ckpt
+from . import faults
+from .heartbeat import (ALIVE, SILENT, STALLED, WAITING,  # noqa: F401
+                        EXIT_STALLED, HeartbeatRecord, StallPolicy,
+                        rank_path, read)
+from .retry import RetryPolicy, retry_call
+
+__all__ = [
+    "GangError", "GangTimeout", "GangSupervisor", "run_supervised",
+    "rank_diagnosis", "MANIFEST_MAGIC", "manifest_name",
+    "write_manifest", "read_manifest", "list_manifests",
+    "latest_valid_manifest", "prune_manifests",
+    "validate_and_select_resume", "ENV_GANG_RELAUNCHES",
+]
+
+# how many RELAUNCHES a supervised gang earns after its first attempt
+# (total attempts = relaunches + 1); overridable per launch via the
+# attempts= argument
+ENV_GANG_RELAUNCHES = "LGBM_TPU_GANG_RELAUNCHES"
+DEFAULT_GANG_RELAUNCHES = 2
+
+
+class GangError(Exception):
+    """The gang failed as a unit: a rank died, self-watchdogged, was
+    classified hung, or the whole gang overran its deadline. Survivors
+    were SIGTERMed (never SIGKILLed). The message carries
+    ``DEADLINE_EXCEEDED`` so :func:`~.retry.is_transient_error`
+    classifies it transient — the relaunch-from-manifest policy in
+    :func:`run_supervised` retries it under bounded attempts.
+
+    ``reports`` holds one ``(rank, rc, HeartbeatRecord|None)`` triple
+    per rank (rc None = still alive when the gang was torn down)."""
+
+    def __init__(self, msg: str,
+                 reports: Sequence[Tuple[int, Optional[int],
+                                         Optional[HeartbeatRecord]]] = ()):
+        super().__init__(f"DEADLINE_EXCEEDED: {msg}")
+        self.reports = list(reports)
+
+
+class GangTimeout(subprocess.TimeoutExpired):
+    """``launch_local``'s blunt-timeout error, upgraded with per-rank
+    forensics: subclasses TimeoutExpired so every existing caller's
+    ``except subprocess.TimeoutExpired`` still catches it, but the
+    message now answers "why did it die" — each rank's last phase and
+    beat age instead of nothing."""
+
+    def __init__(self, cmd, timeout: float, diagnosis: str = ""):
+        super().__init__(cmd, timeout)
+        self.diagnosis = diagnosis
+
+    def __str__(self) -> str:
+        base = super().__str__()
+        return f"{base}\n{self.diagnosis}" if self.diagnosis else base
+
+
+# ---------------------------------------------------------------------------
+# Per-rank diagnosis (the r03-style forensics gap, gang edition)
+# ---------------------------------------------------------------------------
+
+def _describe_rc(rc: Optional[int]) -> str:
+    if rc is None:
+        return "alive"
+    if rc == EXIT_STALLED:
+        return f"rc={rc} (self-watchdogged: wedged at a device sync)"
+    if rc == faults.EXIT_RANK_KILLED:
+        return f"rc={rc} (injected rank_kill)"
+    return f"rc={rc}"
+
+
+def rank_diagnosis(hb_paths: Sequence[str],
+                   rcs: Optional[Sequence[Optional[int]]] = None,
+                   clock: Callable[[], float] = time.monotonic) -> str:
+    """One line per rank: exit state, last phase/progress, beat and
+    keepalive ages. Heartbeat timestamps are CLOCK_MONOTONIC, which is
+    system-wide on Linux, so ages computed here are directly comparable
+    with the writers' clocks."""
+    now = clock()
+    lines = []
+    for r, path in enumerate(hb_paths):
+        state = _describe_rc(rcs[r] if rcs is not None else None)
+        rec = read(path)
+        if rec is None:
+            lines.append(f"  rank {r}: {state}; no heartbeat written "
+                         f"({path})")
+        else:
+            lines.append(
+                f"  rank {r}: {state}; last phase {rec.phase!r}/"
+                f"{rec.progress}, beat {now - rec.t:.1f}s ago, "
+                f"keepalive {now - rec.ka:.1f}s ago (pid {rec.pid})")
+    return "\n".join(lines)
+
+
+def gang_hb_paths(hb_base: str, world: int) -> List[str]:
+    """The per-rank heartbeat paths a supervised gang writes: the bare
+    base for a world of one (single-process workloads keep their
+    existing file), ``rank_path(base, r)`` otherwise — the SAME
+    convention models/gbdt.py and the sharded-ingest constructor use to
+    pick their write path from ``LGBM_TPU_HEARTBEAT``."""
+    if world <= 1:
+        return [hb_base]
+    return [rank_path(hb_base, r) for r in range(world)]
+
+
+# ---------------------------------------------------------------------------
+# GangSupervisor: watch_child generalized to N ranks
+# ---------------------------------------------------------------------------
+
+class GangSupervisor:
+    """Supervise a gang of rank processes against per-rank heartbeats.
+
+    ``procs`` are ``subprocess.Popen``-likes in rank order (objects with
+    ``poll``/``pid``/``terminate``/``stdout`` — tests pass fakes).
+    Stdout pipes are drained by daemon threads so a chatty rank can
+    never deadlock on a full pipe while the supervisor polls.
+
+    :meth:`watch` returns ``[(rc, combined_output), ...]`` when every
+    rank exits 0, and raises :class:`GangError` — after SIGTERMing all
+    survivors — when any rank dies non-zero, self-watchdogs
+    (:data:`EXIT_STALLED`), is classified ``stalled``/``silent`` under
+    the StallPolicy, or the gang deadline passes. SIGKILL is never
+    sent: on real hardware the ranks are claim-holders and the
+    mid-compile SIGKILL is the documented machine-wide wedge trigger.
+    """
+
+    def __init__(self, procs: Sequence, hb_base: str,
+                 hb_paths: Optional[Sequence[str]] = None,
+                 policy: Optional[StallPolicy] = None,
+                 poll: float = 0.5,
+                 label: str = "gang",
+                 term_grace: float = 15.0,
+                 escalate_kill: bool = False,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 on_status: Optional[Callable] = None):
+        self.procs = list(procs)
+        n = len(self.procs)
+        self.hb_paths = (list(hb_paths) if hb_paths is not None
+                         else gang_hb_paths(hb_base, n))
+        if len(self.hb_paths) != n:
+            raise ValueError(
+                f"{len(self.hb_paths)} heartbeat paths for {n} ranks")
+        self.policy = policy if policy is not None else \
+            StallPolicy.from_env()
+        self.poll = float(poll)
+        self.label = label
+        self.term_grace = float(term_grace)
+        # SIGKILL escalation after the SIGTERM grace. Default OFF — on
+        # real hardware the ranks are device claim-holders and the
+        # mid-compile SIGKILL is the documented machine-wide wedge
+        # trigger. CPU-ONLY gangs (virtual-device rehearsals, the bench
+        # ingest gang, smokes/tests) should pass True: a rank wedged in
+        # a gloo collective can sit out SIGTERM (the distributed
+        # runtime's handler hangs on the dead barrier), and leaking it
+        # would poison the relaunch's cores.
+        self.escalate_kill = bool(escalate_kill)
+        self.clock = clock
+        self.sleep = sleep
+        self.on_status = on_status
+        self._outputs: List[List[str]] = [[] for _ in range(n)]
+        self._readers: List[Optional[threading.Thread]] = [None] * n
+        for r, p in enumerate(self.procs):
+            if getattr(p, "stdout", None) is not None:
+                t = threading.Thread(target=self._drain, args=(r,),
+                                     name=f"lgbm-gang-out-r{r}",
+                                     daemon=True)
+                t.start()
+                self._readers[r] = t
+
+    def _drain(self, r: int) -> None:
+        try:
+            for line in self.procs[r].stdout:
+                self._outputs[r].append(line)
+        except (OSError, ValueError):   # pipe torn down mid-read
+            pass
+
+    def output(self, r: int) -> str:
+        return "".join(self._outputs[r])
+
+    def _join_readers(self, timeout: float = 2.0) -> None:
+        for t in self._readers:
+            if t is not None:
+                t.join(timeout=timeout)
+
+    # -- teardown ------------------------------------------------------
+    def _terminate_all(self, rcs: List[Optional[int]]) -> None:
+        """SIGTERM every live rank, then wait up to ``term_grace`` for
+        the gang to drain. A rank that ignores SIGTERM is left running
+        and noted — never SIGKILLed (wedge discipline)."""
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+        deadline = self.clock() + max(self.term_grace, 1.0)
+        while self.clock() < deadline:
+            if all(p.poll() is not None for p in self.procs):
+                break
+            self.sleep(min(self.poll, 0.2))
+        for r, p in enumerate(self.procs):
+            rcs[r] = p.poll()
+            if rcs[r] is None:
+                if self.escalate_kill:
+                    log.warning(
+                        f"{self.label}: rank {r} (pid={p.pid}) ignored "
+                        f"SIGTERM for {self.term_grace:.0f}s; "
+                        "escalating to SIGKILL (CPU gang)")
+                    try:
+                        p.kill()
+                        p.wait(timeout=5.0)
+                        rcs[r] = p.poll()
+                    except (OSError, subprocess.TimeoutExpired):
+                        pass
+                else:
+                    log.warning(
+                        f"{self.label}: rank {r} (pid={p.pid}) ignored "
+                        f"SIGTERM for {self.term_grace:.0f}s; leaving "
+                        "it running (no SIGKILL — wedge discipline)")
+
+    def _fail(self, reason: str, rcs: List[Optional[int]]) -> None:
+        self._terminate_all(rcs)
+        self._join_readers()
+        diag = rank_diagnosis(self.hb_paths, rcs, clock=self.clock)
+        reports = [(r, rcs[r], read(self.hb_paths[r]))
+                   for r in range(len(self.procs))]
+        raise GangError(
+            f"{self.label}: {reason}; survivors SIGTERMed. "
+            f"Per-rank diagnosis:\n{diag}", reports)
+
+    # -- the watch loop ------------------------------------------------
+    def watch(self, timeout: Optional[float] = None) -> List[Tuple[int,
+                                                                   str]]:
+        n = len(self.procs)
+        start = self.clock()
+        deadline = start + timeout if timeout else None
+        rcs: List[Optional[int]] = [None] * n
+        stall_since: List[Optional[float]] = [None] * n
+        last_verdict = [WAITING] * n
+        while True:
+            for r, p in enumerate(self.procs):
+                if rcs[r] is None:
+                    rc = p.poll()
+                    if rc is None:
+                        continue
+                    rcs[r] = rc
+                    if rc == EXIT_STALLED:
+                        self._fail(f"rank {r} self-watchdogged "
+                                   f"(rc={EXIT_STALLED}: its loop was "
+                                   "wedged at a device sync)", rcs)
+                    if rc != 0:
+                        self._fail(f"rank {r} died ({_describe_rc(rc)})",
+                                   rcs)
+            if all(rc is not None for rc in rcs):
+                self._join_readers()
+                return [(rcs[r], self.output(r)) for r in range(n)]
+            now = self.clock()
+            for r, p in enumerate(self.procs):
+                if rcs[r] is not None:
+                    continue
+                rec = read(self.hb_paths[r])
+                verdict = self.policy.classify(rec, now, start)
+                if verdict != last_verdict[r]:
+                    if self.on_status is not None:
+                        self.on_status(r, verdict, rec)
+                    last_verdict[r] = verdict
+                if verdict in (STALLED, SILENT):
+                    if stall_since[r] is None:
+                        stall_since[r] = now
+                    # one poll of hysteresis: a beat landing between our
+                    # read and the verdict must not tear the gang down
+                    elif now - stall_since[r] >= self.poll:
+                        phase = rec.phase if rec is not None else \
+                            "<no heartbeat>"
+                        self._fail(
+                            f"rank {r} (pid={p.pid}) classified hung: "
+                            f"{verdict} in phase {phase!r}", rcs)
+                else:
+                    stall_since[r] = None
+            if deadline is not None and now >= deadline:
+                self._fail(f"gang exceeded its {timeout:.0f}s deadline",
+                           rcs)
+            self.sleep(self.poll)
+
+
+# ---------------------------------------------------------------------------
+# Auto-relaunch: one rank death costs one resume, not the session
+# ---------------------------------------------------------------------------
+
+def default_attempts(env=None) -> int:
+    e = env if env is not None else os.environ
+    v = (e.get(ENV_GANG_RELAUNCHES) or "").strip()
+    relaunches = int(v) if v else DEFAULT_GANG_RELAUNCHES
+    return max(1, relaunches + 1)
+
+
+def run_supervised(argv: Sequence[str], num_processes: int, *,
+                   cpu_devices_per_process: int = 0,
+                   coordinator_port: Optional[int] = None,
+                   timeout: float = 600.0,
+                   env_extra: Optional[dict] = None,
+                   attempts: Optional[int] = None,
+                   stall_policy: Optional[StallPolicy] = None,
+                   poll: float = 0.5,
+                   label: str = "gang",
+                   term_grace: float = 15.0,
+                   escalate_kill: bool = False,
+                   attempt_env: Optional[Callable[[int], dict]] = None,
+                   on_status: Optional[Callable] = None
+                   ) -> List[Tuple[int, str]]:
+    """Launch ``argv`` × ``num_processes`` as one supervised gang and
+    auto-relaunch it on failure (``launch_local(supervised=True)``).
+
+    Each attempt gets a fresh coordinator port (unless pinned) and a
+    fresh per-attempt heartbeat base exported as ``LGBM_TPU_HEARTBEAT``
+    (a dead attempt's stale heartbeat file must never be classified as
+    this attempt's silence); each rank writes
+    ``rank_path(base, rank)`` — models/gbdt.py derives that path
+    automatically in a multi-process world. On :class:`GangError` the
+    WHOLE gang is relaunched under a bounded RetryPolicy
+    (``attempts`` total; default ``LGBM_TPU_GANG_RELAUNCHES`` + 1 = 3):
+    workers resume from the newest valid gang manifest through their
+    ordinary ``resume_from=`` path, so the relaunch converges instead
+    of restarting from zero.
+
+    ``attempt_env(i)`` (0-based attempt index) merges extra environment
+    per attempt — chaos harnesses use it to inject a fault plan into
+    the first launch only (an env-installed plan re-arms its counters
+    in every subprocess, which would otherwise kill every relaunch
+    too). Returns ``[(rc, output), ...]`` in rank order on success;
+    raises :class:`~.retry.RetryError` (last cause: the final
+    :class:`GangError`) when every attempt failed.
+    """
+    from ..distributed import spawn_local
+    from .heartbeat import ENV_HEARTBEAT
+
+    if attempts is None:
+        attempts = default_attempts()
+    hb_dir = tempfile.mkdtemp(prefix="lgbm_gang_hb_")
+    counter = {"i": -1}
+
+    def _attempt():
+        counter["i"] += 1
+        i = counter["i"]
+        extra = dict(env_extra or {})
+        if attempt_env is not None:
+            extra.update({k: str(v)
+                          for k, v in (attempt_env(i) or {}).items()})
+        hb_base = os.path.join(hb_dir, f"attempt{i}.hb")
+        extra[ENV_HEARTBEAT] = hb_base
+        if i:
+            log.warning(
+                f"{label}: relaunching the whole gang (attempt "
+                f"{i + 1}/{attempts}) — workers resume from the newest "
+                "valid gang manifest")
+        procs = spawn_local(
+            argv, num_processes, coordinator_port=coordinator_port,
+            cpu_devices_per_process=cpu_devices_per_process,
+            env_extra=extra)
+        sup = GangSupervisor(procs, hb_base, policy=stall_policy,
+                             poll=poll,
+                             label=f"{label} (attempt {i + 1})",
+                             term_grace=term_grace,
+                             escalate_kill=escalate_kill,
+                             on_status=on_status)
+        return sup.watch(timeout=timeout)
+
+    try:
+        policy = RetryPolicy(max_attempts=attempts, base_delay=0.5,
+                             max_delay=5.0, deadline=None)
+        return retry_call(_attempt, policy=policy, what=label)
+    finally:
+        shutil.rmtree(hb_dir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# Gang manifests: coordinated checkpoints for sharded worlds
+# ---------------------------------------------------------------------------
+
+MANIFEST_MAGIC = "LGBM_TPU_GANG v1"
+_MANIFEST_RE = re.compile(r"^gang_(\d{9})\.manifest$")
+
+
+class ManifestError(_ckpt.CheckpointError):
+    """A gang manifest failed validation (CRC/parse/fields)."""
+
+
+def manifest_name(iteration: int) -> str:
+    return f"gang_{int(iteration):09d}.manifest"
+
+
+def write_manifest(directory: str, iteration: int,
+                   checkpoint_name: str, shard) -> str:
+    """Atomically commit the gang manifest for ``checkpoint_name``:
+    world size, per-rank row counts, per-rank sampled shard-content
+    digests (``ShardInfo.digests``), CRC footer. Written AFTER its
+    checkpoint — the manifest IS the commit marker: a crash between the
+    two leaves an uncommitted checkpoint that resume skips in favor of
+    the newest manifested one."""
+    digests = getattr(shard, "digests", None)
+    if not digests:
+        raise ValueError("shard carries no content digests — gang "
+                         "manifests require a sharded-ingest dataset")
+    rec = {
+        "magic": MANIFEST_MAGIC,
+        "iteration": int(iteration),
+        "world": int(shard.world),
+        "row_counts": [int(c) for c in shard.row_counts],
+        "digests": [f"{int(d) & 0xffffffff:08x}" for d in digests],
+        "checkpoint": str(checkpoint_name),
+    }
+    path = os.path.join(directory, manifest_name(iteration))
+    _ckpt.atomic_write_text(path, json.dumps(rec), crc_footer=True)
+    return path
+
+
+def read_manifest(path: str) -> dict:
+    """Parse + CRC-validate one manifest. Raises :class:`ManifestError`
+    on a torn/corrupt/foreign file."""
+    try:
+        body = _ckpt.read_validated_text(path)
+    except _ckpt.CheckpointError as e:
+        raise ManifestError(str(e))
+    try:
+        man = json.loads(body)
+    except json.JSONDecodeError as e:
+        raise ManifestError(f"{path}: bad manifest JSON: {e}")
+    if man.get("magic") != MANIFEST_MAGIC:
+        raise ManifestError(f"{path}: wrong magic {man.get('magic')!r}")
+    for key in ("iteration", "world", "row_counts", "digests",
+                "checkpoint"):
+        if key not in man:
+            raise ManifestError(f"{path}: missing field {key!r}")
+    if len(man["digests"]) != int(man["world"]) or \
+            len(man["row_counts"]) != int(man["world"]):
+        raise ManifestError(
+            f"{path}: per-rank fields disagree with world="
+            f"{man['world']}")
+    return man
+
+
+def list_manifests(directory: str) -> List[Tuple[int, str]]:
+    """(iteration, path) pairs, newest first (tmp litter ignored)."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        m = _MANIFEST_RE.match(name)
+        if m:
+            out.append((int(m.group(1)),
+                        os.path.join(directory, name)))
+    out.sort(reverse=True)
+    return out
+
+
+def latest_valid_manifest(directory: str
+                          ) -> Optional[Tuple[dict, str]]:
+    """Newest manifest that is COMMITTED: CRC-valid itself, and its
+    referenced checkpoint exists, passes CRC, and agrees on the
+    iteration. Torn commits (manifest without its checkpoint, or a
+    checkpoint/manifest iteration mismatch) are skipped with a warning
+    in favor of the next-newest — never resumed from.
+
+    Returns ``(manifest_dict, checkpoint_path)`` or None."""
+    for it, path in list_manifests(directory):
+        try:
+            man = read_manifest(path)
+        except ManifestError as e:
+            log.warning(f"skipping invalid gang manifest: {e}")
+            continue
+        ckpt_path = os.path.join(directory, man["checkpoint"])
+        try:
+            state = _ckpt.read_checkpoint(ckpt_path)
+        except _ckpt.CheckpointError as e:
+            log.warning(f"skipping uncommitted gang manifest "
+                        f"{os.path.basename(path)}: its checkpoint "
+                        f"failed validation ({e})")
+            continue
+        if int(state.get("iteration", -1)) != int(man["iteration"]):
+            log.warning(
+                f"skipping torn gang manifest {os.path.basename(path)}: "
+                f"manifest says iteration {man['iteration']} but "
+                f"{man['checkpoint']} holds iteration "
+                f"{state.get('iteration')}")
+            continue
+        return man, ckpt_path
+    return None
+
+
+def prune_manifests(directory: str, keep_last: int) -> int:
+    """Keep the newest ``keep_last`` manifests (+ drop atomic-write tmp
+    litter) — same retention sweep as the checkpoints they commit."""
+    return _ckpt.prune_numbered(directory, _MANIFEST_RE, keep_last)
+
+
+def validate_and_select_resume(directory: str, shard,
+                               selected_state: Optional[dict]
+                               ) -> Optional[dict]:
+    """Gang-resume gate for sharded worlds (called by engine.train after
+    dataset construction, SPMD on every rank — the decision depends only
+    on the shared checkpoint directory and this world's ShardInfo, so
+    all ranks agree deterministically).
+
+    - No checkpoints at all → None (fresh start).
+    - Checkpoints but no committed manifest → FATAL: the set cannot be
+      proven to belong to this sharding (disable via
+      ``tpu_gang_manifest=false`` to resume a legacy set).
+    - Manifest world/row-counts/digests disagreeing with the live
+      ShardInfo → FATAL with a per-rank diagnosis naming every
+      mismatching rank.
+    - Otherwise: returns the loop state of the MANIFESTED checkpoint —
+      which may be older than the newest raw checkpoint
+      (``selected_state``) when the newest write's commit was torn;
+      resuming from the manifested iteration is what keeps every rank
+      (and every relaunch) agreeing on where training restarts.
+    """
+    have_ckpts = bool(_ckpt.list_checkpoints(directory))
+    found = latest_valid_manifest(directory)
+    if found is None:
+        if have_ckpts:
+            log.fatal(
+                f"resume_from={directory!r}: the checkpoint set has no "
+                "valid committed gang manifest, so it cannot be "
+                "verified to belong to this sharded world "
+                f"(world={shard.world}). Refusing to resume — a "
+                "mixed-world or different-sharding resume silently "
+                "trains on wrong data. Set tpu_gang_manifest=false "
+                "only to resume a trusted legacy (pre-manifest) set.")
+        return None
+    man, ckpt_path = found
+    if int(man["world"]) != int(shard.world):
+        log.fatal(
+            f"resume_from={directory!r}: gang manifest "
+            f"{manifest_name(int(man['iteration']))} was written by a "
+            f"world of {man['world']} but this gang has world="
+            f"{shard.world} — refusing a mixed-world resume "
+            "(relaunch with the original world size, or start fresh "
+            "in a new directory)")
+    live_counts = [int(c) for c in shard.row_counts]
+    live_digests = [int(d) & 0xffffffff
+                    for d in (getattr(shard, "digests", None) or ())]
+    man_counts = [int(c) for c in man["row_counts"]]
+    man_digests = [int(d, 16) for d in man["digests"]]
+    bad = []
+    for r in range(int(man["world"])):
+        problems = []
+        if man_counts[r] != live_counts[r]:
+            problems.append(f"rows {man_counts[r]} != {live_counts[r]}")
+        if live_digests and man_digests[r] != live_digests[r]:
+            problems.append(f"shard digest {man_digests[r]:08x} != "
+                            f"{live_digests[r]:08x}")
+        if problems:
+            bad.append(f"  rank {r}: " + ", ".join(problems))
+    if bad:
+        log.fatal(
+            f"resume_from={directory!r}: the checkpoint set belongs to "
+            "a DIFFERENT sharding of the data — refusing to resume. "
+            "Per-rank diagnosis (manifest vs this run):\n"
+            + "\n".join(bad))
+    if selected_state is not None and \
+            int(selected_state.get("iteration", -1)) == \
+            int(man["iteration"]):
+        # common case: the newest checkpoint IS the manifested one —
+        # return the state the caller already read/parsed so the
+        # engine keeps its Booster instead of rebuilding it
+        state = selected_state
+    else:
+        state = _ckpt.read_checkpoint(ckpt_path)
+        if selected_state is not None:
+            log.warning(
+                f"newest checkpoint (iteration "
+                f"{selected_state.get('iteration')}) has no committed "
+                f"gang manifest (torn commit); resuming from the "
+                f"manifested iteration {man['iteration']} so every "
+                "rank and every relaunch agree on the restart point")
+    log.info(f"gang manifest validated: world={man['world']}, "
+             f"resuming at iteration {man['iteration']} "
+             f"({man['checkpoint']})")
+    return state

@@ -73,6 +73,20 @@ PHASE_COMPILING = "compiling"
 PHASE_WARMUP = "warmup"
 PHASE_MEASURING = "measuring"
 PHASE_ITER = "iter"
+# sharded-ingest construction (dataset_core._from_columns_sharded):
+# beaten per protocol step (counts / summaries / mappers / binning /
+# metadata) so a gang supervisor can tell a rank grinding through a big
+# allgather from one wedged on a dead peer
+PHASE_INGEST = "ingest"
+
+
+def rank_path(path: str, rank: int) -> str:
+    """Per-rank heartbeat file for gang workers: the supervisor exports
+    ONE base path (``LGBM_TPU_HEARTBEAT``) and every rank writes
+    ``base.r<rank>`` — the shared convention between the gang
+    supervisor (robustness/gang.py), models/gbdt.py's install, the
+    sharded-ingest constructor, and the bench ingest children."""
+    return f"{path}.r{int(rank)}"
 
 # exit code of a self-watchdogged child: the supervisor maps it to the
 # same DeviceStallError classification a silent child earns
@@ -363,6 +377,10 @@ DEFAULT_STALL: Dict[str, float] = {
     PHASE_WARMUP: 420.0,
     PHASE_MEASURING: 300.0,
     PHASE_ITER: 300.0,
+    # one sharded-ingest protocol step (each is a collective round or a
+    # local binning pass; the 10.5M×28 A/B measured 63 s end to end —
+    # pod-scale payloads should raise LGBM_TPU_STALL_SEC_INGEST)
+    PHASE_INGEST: 600.0,
 }
 DEFAULT_STALL_FALLBACK = 420.0
 # keepalives come every ~5 s; 60 s of file silence means even the

@@ -1,0 +1,83 @@
+"""Native parser-fuzz + predict smoke driver (ctypes + numpy ONLY).
+
+Usage: python _native_fuzz_driver.py <lgbm_native.so> <model.txt>
+
+ONE copy of the fuzz body shared by tests/test_c_api_fuzz.py (plain
+build, subprocess so a segfault fails the test) and
+scripts/native_sanitize.sh (ASan/UBSan build under LD_PRELOAD — which
+is exactly why this driver must not import jax or lightgbm_tpu: the
+sanitizer interposes the whole interpreter, and the minimal import set
+keeps the run fast and the leak/report noise at zero).
+
+Mutated/truncated model text must produce rc=-1 (with an error message)
+or a valid load followed by a surviving prediction — never a crash; the
+intact model must load and predict cleanly (rc=0). Prints FUZZ-OK on
+success.
+"""
+import ctypes
+import random
+import sys
+
+import numpy as np
+
+so_path, model_path = sys.argv[1], sys.argv[2]
+lib = ctypes.CDLL(so_path)
+lib.LGBM_GetLastError.restype = ctypes.c_char_p
+model = open(model_path).read()
+rng = random.Random(1234)
+
+
+def try_load(s, must_load=False):
+    handle = ctypes.c_void_p()
+    n = ctypes.c_int()
+    rc = lib.LGBM_BoosterLoadModelFromString(
+        s.encode("utf-8", "replace"), ctypes.byref(n),
+        ctypes.byref(handle))
+    if must_load and rc != 0:
+        raise SystemExit(
+            f"intact model failed to load: {lib.LGBM_GetLastError()}")
+    if rc == 0:
+        # a parsed model must also survive a prediction call
+        X = np.zeros((4, 64), np.float64)
+        out = np.zeros(4 * 16, np.float64)
+        out_len = ctypes.c_int64()
+        prc = lib.LGBM_BoosterPredictForMat(
+            handle, X.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+            ctypes.c_int32(4), ctypes.c_int32(64), ctypes.c_int(1),
+            ctypes.c_int(1), ctypes.c_int(0), ctypes.c_int(0), b"",
+            ctypes.byref(out_len),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        if must_load and prc != 0:
+            raise SystemExit(
+                f"intact model failed to predict: "
+                f"{lib.LGBM_GetLastError()}")
+        lib.LGBM_BoosterFree(handle)
+
+
+# predict smoke: the intact model must load + predict cleanly
+try_load(model, must_load=True)
+# truncations
+for frac in (0.1, 0.3, 0.5, 0.7, 0.9, 0.99):
+    try_load(model[: int(len(model) * frac)])
+# line deletions / duplications
+lines = model.split("\n")
+for _ in range(60):
+    mutated = list(lines)
+    op = rng.randrange(3)
+    i = rng.randrange(len(mutated))
+    if op == 0:
+        del mutated[i]
+    elif op == 1:
+        mutated.insert(i, mutated[i])
+    else:
+        # corrupt numbers on the line
+        mutated[i] = mutated[i].replace("1", "999999999").replace(
+            "2", "-7")
+    try_load("\n".join(mutated))
+# byte noise
+for _ in range(40):
+    b = list(model)
+    for _ in range(10):
+        b[rng.randrange(len(b))] = chr(rng.randrange(32, 127))
+    try_load("".join(b))
+print("FUZZ-OK")

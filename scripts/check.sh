@@ -28,6 +28,19 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
+if [ "${LGBM_TPU_SANITIZE:-0}" != "0" ]; then
+    echo "== native sanitize (ASan/UBSan build + parser-fuzz/predict, opt-in) =="
+    # ROADMAP 5(c) / ISSUE 10 satellite: the 3.7k-LoC native ABI built
+    # with -fsanitize=address,undefined and fuzzed with the SAME driver
+    # tier-1 runs against the plain build — skips LOUDLY (rc 0) when no
+    # compiler/ASan runtime is available.
+    timeout -k 10 420 bash scripts/native_sanitize.sh || rc=1
+    if [ $rc -ne 0 ]; then
+        echo "check.sh: native sanitize failed — skipping tier-1 pytest" >&2
+        exit $rc
+    fi
+fi
+
 if [ "${LGBM_TPU_R_SMOKE:-0}" != "0" ]; then
     echo "== R smoke (execute the R layer under a real Rscript; opt-in) =="
     # ROADMAP 5(c): the 828-LoC R surface actually evaluated, not just
@@ -122,6 +135,22 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu \
     python scripts/ingest_smoke.py || rc=1
 if [ $rc -ne 0 ]; then
     echo "check.sh: ingest smoke failed — skipping tier-1 pytest" >&2
+    exit $rc
+fi
+
+echo "== gang chaos smoke (rank kill -> relaunch -> bit-identical, 2-proc CPU) =="
+# ISSUE 10: a supervised 2-process sharded training gang loses rank 1
+# to an injected rank_kill mid-run; the gang supervisor SIGTERMs the
+# survivor (no SIGKILL of claim-holders on real hardware; CPU gangs
+# escalate), auto-relaunches, every rank resumes from the newest valid
+# gang manifest, and the final model is bit-identical to fault-free.
+# Also gates: a collective blocked on a dead peer raises within the
+# deadline (never wedges to the gang timeout), and torn/mixed-world
+# checkpoint sets are refused loudly with a per-rank diagnosis.
+timeout -k 10 240 env JAX_PLATFORMS=cpu \
+    python scripts/gang_chaos_smoke.py || rc=1
+if [ $rc -ne 0 ]; then
+    echo "check.sh: gang chaos smoke failed — skipping tier-1 pytest" >&2
     exit $rc
 fi
 

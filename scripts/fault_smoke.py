@@ -20,6 +20,13 @@ guarantee regresses:
                      publish_fail rolling back to the old generation
                      (the degrade/recovery round-trip lives in
                      scripts/serving_chaos_smoke.py — not repeated here)
+  gang            -> the ISSUE 10 gang sites, parse + fire accounting
+                     only (<5 s, no subprocesses): rank_kill's rank
+                     filter / after / n accounting and exit code,
+                     collective_delay surfacing as CollectiveTimeout
+                     within the deadline (the end-to-end rank-kill ->
+                     relaunch -> bit-identical round trip lives in
+                     scripts/gang_chaos_smoke.py — not repeated here)
 
 Runs in ~half a minute on CPU.
 """
@@ -211,12 +218,62 @@ def smoke_serving() -> None:
         srv.close(timeout=60)
 
 
+def smoke_gang() -> None:
+    """ISSUE 10 gang sites: grammar + fire accounting only, no
+    subprocesses (<5 s). The end-to-end chaos round trip is gated by
+    scripts/gang_chaos_smoke.py in the same check.sh run — one copy."""
+    from lightgbm_tpu.distributed import (CollectiveTimeout,
+                                          retried_collective,
+                                          set_collective_timeout)
+
+    # rank_kill: rank filter, after/n accounting, exit code — via an
+    # injected _exit so the smoke survives its own kill
+    exits = []
+    with faults.inject("rank_kill:rank=1:after=2") as plan:
+        f = plan.faults["rank_kill"]
+        assert (f.rank, f.after, f.n) == (1, 2, 1)
+        for _ in range(4):
+            faults.maybe_kill_rank(0, _exit=exits.append)
+        assert exits == [] and f.calls == 0, "rank filter leaked"
+        faults.maybe_kill_rank(1, _exit=exits.append)
+        faults.maybe_kill_rank(1, _exit=exits.append)
+        assert exits == [], "after=2 did not skip"
+        faults.maybe_kill_rank(1, _exit=exits.append)
+        assert exits == [faults.EXIT_RANK_KILLED], exits
+        faults.maybe_kill_rank(1, _exit=exits.append)
+        assert len(exits) == 1, "n=1 did not disarm"
+
+    # collective_delay far past the deadline -> CollectiveTimeout fires
+    # promptly (never wedges), and is NOT retried in-process
+    set_collective_timeout(0.3)
+    try:
+        calls = []
+        t0 = time.monotonic()
+        try:
+            with faults.inject("collective_delay:sec=30"):
+                retried_collective(lambda a: (calls.append(1), a)[1],
+                                   np.zeros(3), what="smoke gang")
+            raise AssertionError("collective deadline never fired")
+        except CollectiveTimeout as e:
+            assert "DEADLINE_EXCEEDED" in str(e)
+        assert time.monotonic() - t0 < 5.0, "deadline wedged"
+        assert calls == [], "delayed attempt completed the transport"
+        # a short delay under a generous deadline completes normally
+        set_collective_timeout(10.0)
+        with faults.inject("collective_delay:sec=0.05"):
+            out = retried_collective(lambda a: a + 1, np.zeros(2))
+        assert (out == 1).all()
+    finally:
+        set_collective_timeout(0)
+
+
 def main() -> int:
     rc = 0
     for name, fn in (("write_kill", smoke_write_kill),
                      ("collective", smoke_collective),
                      ("probe_timeout", smoke_probe_fallback),
-                     ("serving", smoke_serving)):
+                     ("serving", smoke_serving),
+                     ("gang", smoke_gang)):
         try:
             fn()
             print(f"fault_smoke: {name} OK")

@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Gang chaos smoke gate (2-process CPU, no hardware).
+
+The ISSUE 10 done bar, end to end on a REAL gang:
+
+1. collective liveness (in-process): a collective blocked on a dead
+   peer — simulated by an injected ``collective_delay`` far past the
+   deadline — raises CollectiveTimeout (DEADLINE_EXCEEDED) within the
+   deadline, never wedging toward the whole-gang timeout;
+2. torn/mixed-world refusal (in-process): a checkpoint set from a
+   different world size or a different sharding is refused loudly with
+   a per-rank diagnosis; resume anchors at the newest COMMITTED
+   manifest iteration;
+3. chaos round trip (2-process gangs): a supervised sharded training
+   gang with ``rank_kill:rank=1:after=1`` injected into its FIRST
+   launch loses rank 1 mid-run; the gang supervisor SIGTERMs the
+   survivor (escalating to SIGKILL only because this is a CPU gang
+   with no device claim), auto-relaunches the whole gang, every rank
+   resumes from the newest valid gang manifest, and the final model is
+   BIT-IDENTICAL to the fault-free run.
+
+Run: python scripts/gang_chaos_smoke.py      (wired into scripts/check.sh)
+"""
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# warm-cache wall budget. The chaos leg inherently pays TWO gang
+# launches (the killed attempt + its relaunch) and one 2-process gang
+# launch measures 12.6 s on the 2-core reference box (jax import +
+# gloo init dominate), so the floor is ~26 s before any kill/grace/
+# backoff overhead — 45 s is the regression line, not a target.
+BUDGET_SEC = 45.0
+_t0 = time.monotonic()
+
+
+def say(msg):
+    print(f"[gang_chaos_smoke +{time.monotonic() - _t0:5.1f}s] {msg}",
+          flush=True)
+
+
+def _strip_params_block(model_str):
+    return model_str.split("\nparameters:")[0]
+
+
+def leg_collective_deadline():
+    """A dead/wedged peer must surface as CollectiveTimeout within the
+    deadline — and the timeout is NOT retried in-process (the rank dies
+    classified; the gang supervisor owns recovery)."""
+    import numpy as np
+
+    from lightgbm_tpu.distributed import (CollectiveTimeout,
+                                          retried_collective,
+                                          set_collective_timeout)
+    from lightgbm_tpu.robustness import faults
+
+    set_collective_timeout(0.3)
+    try:
+        calls = []
+
+        def transport(a):
+            calls.append(1)
+            return a
+
+        t0 = time.monotonic()
+        try:
+            with faults.inject("collective_delay:sec=30"):
+                retried_collective(transport, np.zeros(4),
+                                   what="smoke dead-peer collective")
+            raise AssertionError("collective deadline never fired")
+        except CollectiveTimeout as e:
+            assert "DEADLINE_EXCEEDED" in str(e)
+        took = time.monotonic() - t0
+        assert took < 5.0, f"deadline took {took:.1f}s (wedged?)"
+        assert len(calls) == 0, "delayed attempt completed the transport"
+        # a healthy collective under the same deadline passes through
+        out = retried_collective(lambda a: a + 1, np.zeros(2))
+        assert (out == 1).all()
+    finally:
+        set_collective_timeout(0)
+    say(f"collective deadline OK (fired in {took:.2f}s)")
+
+
+def leg_manifest_refusal(tmp):
+    """Torn and mixed-world checkpoint sets refused loudly, with the
+    per-rank diagnosis; resume anchors at the committed iteration."""
+    import numpy as np
+
+    from lightgbm_tpu.io.dataset_core import ShardInfo
+    from lightgbm_tpu.robustness import checkpoint as ck
+    from lightgbm_tpu.robustness import gang
+    from lightgbm_tpu.utils.log import LightGBMError
+
+    d = os.path.join(tmp, "refusal")
+    os.makedirs(d)
+    shard = ShardInfo(rank=0, world=2,
+                      row_counts=np.asarray([10, 11], np.int64),
+                      digests=(0xAB, 0xCD))
+    p = ck.write_checkpoint(d, {"iteration": 3, "model": "M3"})
+    gang.write_manifest(d, 3, os.path.basename(p), shard)
+    ck.write_checkpoint(d, {"iteration": 5, "model": "M5"})  # torn
+    sel = ck.latest_valid_checkpoint(d)[1]
+    state = gang.validate_and_select_resume(d, shard, sel)
+    assert state["iteration"] == 3, "did not anchor at the manifest"
+    for bad, needle in (
+            (ShardInfo(rank=0, world=3,
+                       row_counts=np.asarray([7, 7, 7], np.int64),
+                       digests=(1, 2, 3)), "mixed-world"),
+            (ShardInfo(rank=0, world=2,
+                       row_counts=np.asarray([10, 11], np.int64),
+                       digests=(0xAB, 0x99)), "rank 1")):
+        try:
+            gang.validate_and_select_resume(d, bad, sel)
+            raise AssertionError(f"{needle}: not refused")
+        except LightGBMError as e:
+            assert needle in str(e), str(e)
+    say("torn/mixed-world refusal OK")
+
+
+ROUNDS = 4
+ROWS = 800
+
+
+def _run_gang(outdir, ckpt_dir, attempt_env=None, attempts=1):
+    from lightgbm_tpu.robustness.gang import run_supervised
+    worker = os.path.join(REPO, "tests", "mp_sharded_worker.py")
+    env = {"SHARDED_ROUNDS": str(ROUNDS), "SHARDED_LEAVES": "7",
+           "SHARDED_ROWS": str(ROWS),
+           "SHARDED_CKPT_DIR": ckpt_dir, "SHARDED_CKPT_EVERY": "1",
+           "LGBM_TPU_COMPILE_CACHE": os.environ["LGBM_TPU_COMPILE_CACHE"]}
+    return run_supervised(
+        [sys.executable, worker, outdir], 2,
+        cpu_devices_per_process=1, timeout=240, env_extra=env,
+        attempts=attempts, attempt_env=attempt_env, poll=0.1,
+        term_grace=2.0, escalate_kill=True,   # virtual-CPU gang
+        label="chaos gang")
+
+
+def leg_chaos_round_trip(tmp):
+    """rank_kill mid-run → supervisor SIGTERMs the survivor →
+    auto-relaunch → manifest resume → bit-identical final model.
+
+    The fault-free reference is single-process training on the
+    concatenated table: sharded-gang ≡ single-process bit-identity is
+    the ingest contract already gated by scripts/ingest_smoke.py (same
+    check.sh run), so chaos ≡ single-process ⇒ chaos ≡ fault-free
+    gang — one gang launch instead of two keeps the gate under budget.
+    """
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.robustness.gang import list_manifests
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from mp_sharded_worker import PARAMS, synth
+
+    X, y = synth(n=ROWS)
+    ref = lgb.train(dict(PARAMS, pre_partition=False, num_leaves=7),
+                    lgb.Dataset(X, label=y), num_boost_round=ROUNDS)
+    ref_model = ref.model_to_string()
+
+    chaos_out = os.path.join(tmp, "chaos")
+    chaos_ckpt = os.path.join(tmp, "chaos_ckpt")
+    os.makedirs(chaos_out)
+    os.makedirs(chaos_ckpt)
+    seen = []
+
+    def attempt_env(i):
+        seen.append(i)
+        # kill rank 1 after 1 of its iterations — FIRST launch only
+        # (an env plan re-arms its per-process counters in every
+        # subprocess, so leaving it armed would kill every relaunch)
+        return ({"LGBM_TPU_FAULTS": "rank_kill:rank=1:after=1"}
+                if i == 0 else {"LGBM_TPU_FAULTS": "off"})
+
+    say("chaos gang: rank_kill:rank=1:after=1 on the first launch")
+    results = _run_gang(chaos_out, chaos_ckpt,
+                        attempt_env=attempt_env, attempts=3)
+    assert [rc for rc, _ in results] == [0, 0], results
+    assert seen[0] == 0 and len(seen) >= 2, \
+        f"gang never relaunched (attempts seen: {seen}) — vacuous chaos"
+    assert list_manifests(chaos_ckpt), "no manifests in the chaos run"
+    with open(os.path.join(chaos_out, "model_sharded.txt")) as f:
+        chaos_model = f.read()
+    assert _strip_params_block(chaos_model) == \
+        _strip_params_block(ref_model), \
+        "relaunched+resumed model is NOT bit-identical to fault-free"
+    say(f"chaos round trip OK ({len(seen)} launches, bit-identical)")
+
+
+def main() -> int:
+    import tempfile
+
+    from lightgbm_tpu.utils.jit_cache import resolve_cache_dir
+
+    # warm repo compile cache (the ingest_smoke convention): the gangs
+    # and their relaunches share it, so only the first-ever run on a
+    # machine pays the grower compiles
+    cache_dir = resolve_cache_dir()
+    os.makedirs(cache_dir, exist_ok=True)
+    os.environ.setdefault("LGBM_TPU_COMPILE_CACHE", cache_dir)
+    cold_cache = not os.listdir(cache_dir)
+
+    tmp = tempfile.mkdtemp(prefix="gang_chaos_smoke_")
+    leg_collective_deadline()
+    leg_manifest_refusal(tmp)
+    leg_chaos_round_trip(tmp)
+
+    took = time.monotonic() - _t0
+    if took > BUDGET_SEC:
+        # the wall budget is a WARM-cache regression gate; a cold cache
+        # pays every grower compile, so the overrun is advisory there
+        if cold_cache:
+            say(f"over budget ({took:.1f}s > {BUDGET_SEC:.0f}s) on a "
+                "COLD compile cache — advisory only")
+        else:
+            say(f"FAIL: {took:.1f}s > {BUDGET_SEC:.0f}s budget")
+            return 1
+    say(f"OK ({took:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# Opt-in sanitizer build of the native ABI (ROADMAP 5(c) down-payment,
+# ISSUE 10 satellite): compile the ~3.7k-LoC c_api/parser/shap/arrow
+# sources with -fsanitize=address,undefined and run the existing
+# parser-fuzz + predict smoke (scripts/_native_fuzz_driver.py — the
+# SAME driver tier-1's test_c_api_fuzz runs against the plain build)
+# under it. Any ASan/UBSan report aborts (-fno-sanitize-recover) and
+# fails the gate.
+#
+#   bash scripts/native_sanitize.sh          # standalone
+#   LGBM_TPU_SANITIZE=1 bash scripts/check.sh  # as a check.sh step
+#
+# Skips LOUDLY (rc 0) when no compiler or no ASan runtime is available
+# — the gate must be honest about not having run, never silently green.
+set -u
+cd "$(dirname "$0")/.."
+
+NATIVE=lightgbm_tpu/native
+OUT=$NATIVE/_build/lgbm_native_asan.so
+SRCS="$NATIVE/parser.cpp $NATIVE/c_api.cpp $NATIVE/c_api_train.cpp \
+      $NATIVE/shap.cpp $NATIVE/arrow_ingest.cpp"
+
+if ! command -v g++ >/dev/null 2>&1; then
+    echo "native_sanitize: SKIP — no g++ on PATH (the sanitizer build needs a compiler)"
+    exit 0
+fi
+LIBASAN=$(g++ -print-file-name=libasan.so)
+if [ ! -e "$LIBASAN" ]; then
+    echo "native_sanitize: SKIP — g++ has no libasan runtime ($LIBASAN)"
+    exit 0
+fi
+
+echo "== native_sanitize: building with -fsanitize=address,undefined =="
+mkdir -p "$NATIVE/_build"
+# shellcheck disable=SC2086 — SRCS is a word list on purpose
+if ! g++ -O1 -g -shared -fPIC -std=c++17 -pthread \
+        -fsanitize=address,undefined -fno-sanitize-recover=all \
+        $SRCS -ldl -o "$OUT.tmp"; then
+    echo "native_sanitize: FAIL — sanitizer build did not compile" >&2
+    exit 1
+fi
+mv "$OUT.tmp" "$OUT"
+
+# train a tiny model with the PLAIN interpreter (jax must not run under
+# the sanitizer), then fuzz the ASan .so in a minimal ctypes+numpy
+# process with libasan preloaded. detect_leaks=0: the interpreter and
+# numpy hold reachable allocations at exit by design — the gate hunts
+# heap corruption / UB in OUR native code, not CPython leak noise.
+WORK=$(mktemp -d /tmp/native_sanitize.XXXXXX)
+trap 'rm -rf "$WORK"' EXIT
+echo "== native_sanitize: training the fuzz seed model (plain build) =="
+if ! JAX_PLATFORMS=cpu python - "$WORK/m.txt" <<'PY'; then
+import sys
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+rng = np.random.default_rng(5)
+X = rng.normal(size=(400, 6))
+X[:, 2] = rng.integers(0, 5, size=400)
+y = (X[:, 0] > 0).astype(np.float64)
+bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                 "min_data_in_leaf": 5},
+                lgb.Dataset(X, label=y, categorical_feature=[2]),
+                num_boost_round=4)
+bst.save_model(sys.argv[1])
+PY
+    echo "native_sanitize: FAIL — could not train the seed model" >&2
+    exit 1
+fi
+
+echo "== native_sanitize: parser-fuzz + predict smoke under ASan/UBSan =="
+if LD_PRELOAD="$LIBASAN" \
+   ASAN_OPTIONS="detect_leaks=0:abort_on_error=1" \
+   UBSAN_OPTIONS="print_stacktrace=1" \
+   python scripts/_native_fuzz_driver.py "$OUT" "$WORK/m.txt"; then
+    echo "native_sanitize: OK (no ASan/UBSan reports)"
+    exit 0
+fi
+echo "native_sanitize: FAIL — sanitizer reported (or the driver died)" >&2
+exit 1

@@ -12,6 +12,9 @@ training on the concatenated table.
 
 Usage: python mp_sharded_worker.py <outdir>
 Env:   SHARDED_ROUNDS        total boosting rounds (default 8)
+       SHARDED_ROWS          synthetic table rows (default 2001; the
+                             gang chaos smoke shrinks it to stay under
+                             its wall budget)
        SHARDED_CKPT_DIR      checkpoint directory; rank 0 writes a
                              checkpoint every SHARDED_CKPT_EVERY
                              iterations and EVERY rank resumes from the
@@ -60,7 +63,7 @@ def main():
 
     from lightgbm_tpu.distributed import row_slice
     world = jax.process_count()
-    X, y = synth()
+    X, y = synth(n=int(os.environ.get("SHARDED_ROWS", "2001")))
     lo, hi = row_slice(len(X), rank, world)
     Xs, ys = X[lo:hi], y[lo:hi]        # this process's rows ONLY
     del X, y

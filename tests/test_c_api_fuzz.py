@@ -20,61 +20,10 @@ pytestmark = pytest.mark.skipif(get_lib() is None,
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-_FUZZ_CODE = r"""
-import ctypes, random, sys
-import numpy as np
-
-so_path, model_path = sys.argv[1], sys.argv[2]
-lib = ctypes.CDLL(so_path)
-lib.LGBM_GetLastError.restype = ctypes.c_char_p
-model = open(model_path).read()
-rng = random.Random(1234)
-
-def try_load(s):
-    handle = ctypes.c_void_p()
-    n = ctypes.c_int()
-    rc = lib.LGBM_BoosterLoadModelFromString(
-        s.encode("utf-8", "replace"), ctypes.byref(n),
-        ctypes.byref(handle))
-    if rc == 0:
-        # a parsed model must also survive a prediction call
-        X = np.zeros((4, 64), np.float64)
-        out = np.zeros(4 * 16, np.float64)
-        out_len = ctypes.c_int64()
-        lib.LGBM_BoosterPredictForMat(
-            handle, X.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
-            ctypes.c_int32(4), ctypes.c_int32(64), ctypes.c_int(1),
-            ctypes.c_int(1), ctypes.c_int(0), ctypes.c_int(0), b"",
-            ctypes.byref(out_len),
-            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
-        lib.LGBM_BoosterFree(handle)
-
-# truncations
-for frac in (0.1, 0.3, 0.5, 0.7, 0.9, 0.99):
-    try_load(model[: int(len(model) * frac)])
-# line deletions / duplications
-lines = model.split("\n")
-for _ in range(60):
-    mutated = list(lines)
-    op = rng.randrange(3)
-    i = rng.randrange(len(mutated))
-    if op == 0:
-        del mutated[i]
-    elif op == 1:
-        mutated.insert(i, mutated[i])
-    else:
-        # corrupt numbers on the line
-        mutated[i] = mutated[i].replace("1", "999999999").replace(
-            "2", "-7")
-    try_load("\n".join(mutated))
-# byte noise
-for _ in range(40):
-    b = list(model)
-    for _ in range(10):
-        b[rng.randrange(len(b))] = chr(rng.randrange(32, 127))
-    try_load("".join(b))
-print("FUZZ-OK")
-"""
+# ONE copy of the native fuzz body: scripts/_native_fuzz_driver.py is
+# shared with the opt-in ASan/UBSan gate (scripts/native_sanitize.sh),
+# which runs the same driver against the sanitizer build.
+_FUZZ_DRIVER = os.path.join(REPO, "scripts", "_native_fuzz_driver.py")
 
 
 def test_model_parser_fuzz(rng, tmp_path):
@@ -90,9 +39,7 @@ def test_model_parser_fuzz(rng, tmp_path):
 
     so_path = os.path.join(REPO, "lightgbm_tpu", "native", "_build",
                            "lgbm_native.so")
-    script = tmp_path / "fuzz.py"
-    script.write_text(_FUZZ_CODE)
-    out = subprocess.run([sys.executable, str(script), so_path, path],
+    out = subprocess.run([sys.executable, _FUZZ_DRIVER, so_path, path],
                          capture_output=True, text=True, timeout=300)
     assert out.returncode == 0, (
         f"parser fuzz crashed (rc={out.returncode}):\n"

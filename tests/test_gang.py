@@ -1,0 +1,464 @@
+"""Fault-tolerant training gang (ISSUE 10): the fast tier-1 family.
+
+Promotes the old ``@slow`` kill-one-rank-relaunch-resume subprocess test
+to unit coverage that needs no gang:
+
+- gang manifests: round-trip, CRC corruption fallback, torn-commit
+  skipping (manifest without its checkpoint / iteration disagreement);
+- resume refusal: world-size mismatch, shard-digest mismatch (with the
+  per-rank diagnosis), checkpoints-without-manifest;
+- resume-iteration agreement: resume anchors at the newest COMMITTED
+  iteration, not the newest raw checkpoint;
+- collective liveness: a blocked collective raises CollectiveTimeout
+  within the deadline and is NOT retried in-process;
+- fault grammar: ``rank_kill`` (rank filter + after/fire accounting)
+  and ``collective_delay``;
+- GangSupervisor: rank death SIGTERMs the survivors with a per-rank
+  diagnosis; a silent rank is classified; ``launch_local``'s blunt
+  timeout carries forensics.
+
+The end-to-end chaos round-trip (rank kill → auto-relaunch →
+bit-identical model) is gated by scripts/gang_chaos_smoke.py in
+check.sh.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import distributed
+from lightgbm_tpu.distributed import (CollectiveTimeout, launch_local,
+                                      retried_collective,
+                                      set_collective_timeout)
+from lightgbm_tpu.io.dataset_core import ShardInfo
+from lightgbm_tpu.robustness import checkpoint as ck
+from lightgbm_tpu.robustness import faults, gang
+from lightgbm_tpu.robustness.gang import (GangError, GangSupervisor,
+                                          GangTimeout,
+                                          latest_valid_manifest,
+                                          validate_and_select_resume,
+                                          write_manifest)
+from lightgbm_tpu.robustness.heartbeat import StallPolicy, rank_path
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _shard(world=2, counts=(10, 11), digests=(0xDEADBEEF, 0x12345678),
+           rank=0):
+    return ShardInfo(rank=rank, world=world,
+                     row_counts=np.asarray(counts, np.int64),
+                     digests=tuple(digests) if digests else None)
+
+
+def _commit(d, iteration, shard, model=None):
+    """One committed checkpoint+manifest pair."""
+    path = ck.write_checkpoint(
+        str(d), {"iteration": iteration,
+                 "model": model or f"MODEL{iteration}"})
+    write_manifest(str(d), iteration, os.path.basename(path), shard)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Gang manifests
+# ---------------------------------------------------------------------------
+
+def test_manifest_round_trip(tmp_path):
+    shard = _shard()
+    _commit(tmp_path, 4, shard)
+    man, ckpt_path = latest_valid_manifest(str(tmp_path))
+    assert man["iteration"] == 4
+    assert man["world"] == 2
+    assert man["row_counts"] == [10, 11]
+    assert man["digests"] == ["deadbeef", "12345678"]
+    assert man["checkpoint"] == "ckpt_000000004.lgbmckpt"
+    assert ck.read_checkpoint(ckpt_path)["model"] == "MODEL4"
+
+
+def test_manifest_requires_digests(tmp_path):
+    with pytest.raises(ValueError, match="digests"):
+        write_manifest(str(tmp_path), 1, "ckpt_000000001.lgbmckpt",
+                       _shard(digests=None))
+
+
+def test_manifest_crc_corruption_falls_back(tmp_path):
+    shard = _shard()
+    _commit(tmp_path, 2, shard)
+    _commit(tmp_path, 4, shard)
+    newest = tmp_path / gang.manifest_name(4)
+    blob = bytearray(newest.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    newest.write_bytes(bytes(blob))
+    man, _ = latest_valid_manifest(str(tmp_path))
+    assert man["iteration"] == 2
+
+
+def test_torn_commit_manifest_without_checkpoint_skipped(tmp_path):
+    shard = _shard()
+    _commit(tmp_path, 2, shard)
+    # a manifest whose checkpoint never landed (or was corrupted) is an
+    # uncommitted torn write — skipped, never resumed from
+    write_manifest(str(tmp_path), 5, ck.checkpoint_name(5), shard)
+    man, _ = latest_valid_manifest(str(tmp_path))
+    assert man["iteration"] == 2
+    # corrupt (not just missing) checkpoint is equally torn
+    p6 = ck.write_checkpoint(str(tmp_path), {"iteration": 6,
+                                             "model": "M6"})
+    write_manifest(str(tmp_path), 6, os.path.basename(p6), shard)
+    blob = bytearray(open(p6, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(p6, "wb").write(bytes(blob))
+    man, _ = latest_valid_manifest(str(tmp_path))
+    assert man["iteration"] == 2
+
+
+def test_torn_commit_iteration_disagreement_skipped(tmp_path):
+    shard = _shard()
+    _commit(tmp_path, 2, shard)
+    # manifest 7 pointing at checkpoint holding iteration 3: torn
+    p3 = ck.write_checkpoint(str(tmp_path), {"iteration": 3,
+                                             "model": "M3"})
+    write_manifest(str(tmp_path), 7, os.path.basename(p3), shard)
+    man, _ = latest_valid_manifest(str(tmp_path))
+    assert man["iteration"] == 2
+
+
+def test_prune_manifests_keeps_newest(tmp_path):
+    shard = _shard()
+    for it in (1, 2, 3, 4):
+        _commit(tmp_path, it, shard)
+    removed = gang.prune_manifests(str(tmp_path), keep_last=2)
+    assert removed == 2
+    left = sorted(p.name for p in tmp_path.iterdir()
+                  if p.name.endswith(".manifest"))
+    assert left == [gang.manifest_name(3), gang.manifest_name(4)]
+
+
+# ---------------------------------------------------------------------------
+# Resume validation / selection
+# ---------------------------------------------------------------------------
+
+def test_fresh_start_with_no_checkpoints(tmp_path):
+    assert validate_and_select_resume(str(tmp_path), _shard(),
+                                      None) is None
+
+
+def test_checkpoints_without_manifest_refused(tmp_path):
+    ck.write_checkpoint(str(tmp_path), {"iteration": 3, "model": "M"})
+    with pytest.raises(LightGBMError, match="no valid committed gang"):
+        validate_and_select_resume(str(tmp_path), _shard(), None)
+
+
+def test_world_size_mismatch_refused(tmp_path):
+    _commit(tmp_path, 4, _shard())
+    sel = ck.latest_valid_checkpoint(str(tmp_path))[1]
+    with pytest.raises(LightGBMError, match="mixed-world"):
+        validate_and_select_resume(
+            str(tmp_path),
+            _shard(world=3, counts=(7, 7, 7), digests=(1, 2, 3)), sel)
+
+
+def test_shard_digest_mismatch_refused_with_rank_diagnosis(tmp_path):
+    _commit(tmp_path, 4, _shard())
+    sel = ck.latest_valid_checkpoint(str(tmp_path))[1]
+    with pytest.raises(LightGBMError) as ei:
+        validate_and_select_resume(
+            str(tmp_path), _shard(digests=(0xDEADBEEF, 0x1)), sel)
+    msg = str(ei.value)
+    assert "DIFFERENT sharding" in msg
+    assert "rank 1" in msg and "rank 0" not in msg
+
+
+def test_row_count_mismatch_refused(tmp_path):
+    _commit(tmp_path, 4, _shard())
+    sel = ck.latest_valid_checkpoint(str(tmp_path))[1]
+    with pytest.raises(LightGBMError, match="rank 0"):
+        validate_and_select_resume(str(tmp_path),
+                                   _shard(counts=(9, 11)), sel)
+
+
+def test_resume_iteration_agreement_anchors_at_manifest(tmp_path):
+    """The newest RAW checkpoint may be an uncommitted torn write;
+    resume must anchor at the newest COMMITTED iteration so every rank
+    and every relaunch agree."""
+    shard = _shard()
+    _commit(tmp_path, 4, shard)
+    ck.write_checkpoint(str(tmp_path), {"iteration": 6, "model": "M6"})
+    sel = ck.latest_valid_checkpoint(str(tmp_path))[1]
+    assert sel["iteration"] == 6
+    state = validate_and_select_resume(str(tmp_path), shard, sel)
+    assert state["iteration"] == 4
+    assert state["model"] == "MODEL4"
+
+
+# ---------------------------------------------------------------------------
+# Collective liveness
+# ---------------------------------------------------------------------------
+
+def test_collective_timeout_raises_within_deadline_not_retried():
+    calls = []
+
+    def blocked(a):
+        calls.append(1)
+        time.sleep(10)
+        return a
+
+    set_collective_timeout(0.2)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(CollectiveTimeout) as ei:
+            retried_collective(blocked, np.zeros(2), what="t")
+        took = time.monotonic() - t0
+    finally:
+        set_collective_timeout(0)
+    assert took < 3.0, f"deadline took {took:.1f}s"
+    # DEADLINE_EXCEEDED marker for OUTER (gang-level) classification...
+    assert "DEADLINE_EXCEEDED" in str(ei.value)
+    # ...but never retried in-process: re-driving a collective round
+    # while the previous one is still blocked would desync the gang
+    assert len(calls) == 1
+
+
+def test_collective_timeout_passthrough_and_errors():
+    set_collective_timeout(5.0)
+    try:
+        out = retried_collective(lambda a: a + 1, np.zeros(3))
+        np.testing.assert_array_equal(out, np.ones(3))
+        # a non-transient error inside the deadline thread propagates
+        # as itself (a code bug must never burn the retry budget)
+        with pytest.raises(ZeroDivisionError):
+            retried_collective(lambda a: 1 / 0, np.zeros(1))
+    finally:
+        set_collective_timeout(0)
+
+
+def test_collective_timeout_resolution(monkeypatch):
+    set_collective_timeout(0)
+    monkeypatch.delenv(distributed.ENV_COLLECTIVE_TIMEOUT,
+                       raising=False)
+    assert distributed.collective_timeout() == \
+        distributed.DEFAULT_COLLECTIVE_TIMEOUT
+    monkeypatch.setenv(distributed.ENV_COLLECTIVE_TIMEOUT, "42.5")
+    assert distributed.collective_timeout() == 42.5
+    set_collective_timeout(7.0)          # explicit pin wins over env
+    try:
+        assert distributed.collective_timeout() == 7.0
+    finally:
+        set_collective_timeout(0)
+
+
+# ---------------------------------------------------------------------------
+# Fault grammar: rank_kill / collective_delay
+# ---------------------------------------------------------------------------
+
+def test_rank_kill_grammar_and_accounting():
+    exits = []
+    with faults.inject("rank_kill:rank=1:after=2") as plan:
+        f = plan.faults["rank_kill"]
+        assert (f.rank, f.after, f.n) == (1, 2, 1)
+        for _ in range(5):                       # wrong rank: filtered
+            faults.maybe_kill_rank(0, _exit=exits.append)
+        assert exits == [] and f.calls == 0
+        faults.maybe_kill_rank(1, _exit=exits.append)
+        faults.maybe_kill_rank(1, _exit=exits.append)
+        assert exits == []                       # after=2 skips two
+        faults.maybe_kill_rank(1, _exit=exits.append)
+        assert exits == [faults.EXIT_RANK_KILLED]
+        faults.maybe_kill_rank(1, _exit=exits.append)
+        assert len(exits) == 1                   # n defaults to 1
+    # bare rank_kill fires for ANY rank
+    exits = []
+    with faults.inject("rank_kill"):
+        faults.maybe_kill_rank(3, _exit=exits.append)
+    assert exits == [faults.EXIT_RANK_KILLED]
+
+
+def test_collective_delay_fires_inside_deadline():
+    slept = []
+    with faults.inject("collective_delay:sec=1.5"):
+        assert faults.maybe_delay("collective_delay",
+                                  sleep=slept.append) == 1.5
+    assert slept == [1.5]
+    # and through retried_collective, a short delay under a generous
+    # deadline completes normally (delay != failure)
+    set_collective_timeout(10.0)
+    try:
+        with faults.inject("collective_delay:sec=0.05"):
+            out = retried_collective(lambda a: a + 2, np.zeros(2))
+        np.testing.assert_array_equal(out, np.full(2, 2.0))
+    finally:
+        set_collective_timeout(0)
+
+
+def test_known_sites_cover_gang_grammar():
+    assert "rank_kill" in faults.KNOWN_SITES
+    assert "collective_delay" in faults.KNOWN_SITES
+
+
+# ---------------------------------------------------------------------------
+# GangSupervisor (tiny real subprocesses, no jax in the children)
+# ---------------------------------------------------------------------------
+
+_POLICY = StallPolicy(stall_sec={}, default_stall=60.0, silent_sec=60.0,
+                      startup_grace=60.0)
+
+
+def _sleeper(seconds=60):
+    return subprocess.Popen([sys.executable, "-c",
+                             f"import time; time.sleep({seconds})"],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+def test_gang_supervisor_rank_death_terminates_survivors(tmp_path):
+    hb = str(tmp_path / "g.hb")
+    procs = [_sleeper(),
+             subprocess.Popen([sys.executable, "-c",
+                               "import sys; sys.exit(3)"],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)]
+    sup = GangSupervisor(procs, hb, policy=_POLICY, poll=0.05,
+                         label="testgang", term_grace=10.0)
+    with pytest.raises(GangError) as ei:
+        sup.watch(timeout=30)
+    msg = str(ei.value)
+    assert "rank 1 died" in msg and "rc=3" in msg
+    assert "DEADLINE_EXCEEDED" in msg          # transient for relaunch
+    assert "rank 0" in msg                     # per-rank diagnosis
+    assert procs[0].poll() is not None, "survivor was not terminated"
+
+
+def test_gang_supervisor_annotates_special_exit_codes(tmp_path):
+    hb = str(tmp_path / "g.hb")
+    procs = [_sleeper(),
+             subprocess.Popen(
+                 [sys.executable, "-c",
+                  f"import sys; sys.exit({faults.EXIT_RANK_KILLED})"],
+                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                 text=True)]
+    sup = GangSupervisor(procs, hb, policy=_POLICY, poll=0.05)
+    with pytest.raises(GangError, match="injected rank_kill"):
+        sup.watch(timeout=30)
+    assert procs[0].poll() is not None
+
+
+_HB_WRITER = """
+import json, os, sys, time
+path = sys.argv[1]
+rec = {"phase": "iter", "progress": 5, "t": time.monotonic(),
+       "ka": time.monotonic(), "pid": os.getpid(), "seq": 1,
+       "wall": time.time()}
+with open(path, "w") as f:
+    f.write(json.dumps(rec))
+time.sleep(60)
+"""
+
+
+def test_gang_supervisor_classifies_silent_rank(tmp_path):
+    """A rank that wrote one beat then went silent (keepalive dead) is
+    classified within silent_sec and torn down with its phase in the
+    diagnosis — never waited out to the gang deadline."""
+    hb = str(tmp_path / "g.hb")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _HB_WRITER, rank_path(hb, r)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(2)]
+    policy = StallPolicy(stall_sec={}, default_stall=60.0,
+                         silent_sec=1.0, startup_grace=20.0)
+    sup = GangSupervisor(procs, hb, policy=policy, poll=0.1)
+    t0 = time.monotonic()
+    with pytest.raises(GangError) as ei:
+        sup.watch(timeout=60)
+    assert time.monotonic() - t0 < 30
+    msg = str(ei.value)
+    assert "classified hung" in msg and "silent" in msg
+    assert "'iter'" in msg and "/5" in msg     # phase forensics
+    for p in procs:
+        assert p.poll() is not None
+
+
+def test_gang_supervisor_success_returns_outputs(tmp_path):
+    hb = str(tmp_path / "g.hb")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", f"print('hello {r}')"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(2)]
+    sup = GangSupervisor(procs, hb, policy=_POLICY, poll=0.05)
+    results = sup.watch(timeout=30)
+    assert [rc for rc, _ in results] == [0, 0]
+    assert [out.strip() for _, out in results] == ["hello 0", "hello 1"]
+
+
+# ---------------------------------------------------------------------------
+# launch_local forensics + run_supervised relaunch
+# ---------------------------------------------------------------------------
+
+_ENV_HB_WRITER = """
+import json, os, time
+path = os.environ["LGBM_TPU_HEARTBEAT"]
+rec = {"phase": "compiling", "progress": 0, "t": time.monotonic(),
+       "ka": time.monotonic(), "pid": os.getpid(), "seq": 1,
+       "wall": time.time()}
+with open(path, "w") as f:
+    f.write(json.dumps(rec))
+time.sleep(60)
+"""
+
+
+def test_launch_local_timeout_carries_rank_diagnosis():
+    """The blunt-timeout path must report per-rank last-phase/last-beat
+    (the r03-style forensics gap, gang edition) — and stay catchable as
+    subprocess.TimeoutExpired."""
+    with pytest.raises(subprocess.TimeoutExpired) as ei:
+        launch_local([sys.executable, "-c", _ENV_HB_WRITER], 1,
+                     timeout=1.5)
+    assert isinstance(ei.value, GangTimeout)
+    msg = str(ei.value)
+    assert "Per-rank diagnosis" in msg
+    assert "'compiling'" in msg and "beat" in msg
+
+
+_ATTEMPT_WORKER = """
+import os, sys
+sys.exit(2 if os.environ.get("GANG_TEST_FAIL") else 0)
+"""
+
+
+def test_run_supervised_relaunches_then_succeeds(tmp_path):
+    """Attempt 0 dies (injected via attempt_env), attempt 1 succeeds —
+    the bounded relaunch loop converges and returns rank outputs."""
+    seen = []
+
+    def attempt_env(i):
+        seen.append(i)
+        return {"GANG_TEST_FAIL": "1"} if i == 0 else {}
+
+    results = gang.run_supervised(
+        [sys.executable, "-c", _ATTEMPT_WORKER], 2, timeout=30,
+        attempts=3, attempt_env=attempt_env, poll=0.05,
+        stall_policy=_POLICY, label="testgang")
+    assert [rc for rc, _ in results] == [0, 0]
+    assert seen == [0, 1]
+
+
+def test_run_supervised_bounded_attempts(tmp_path):
+    """Every attempt failing exhausts the bounded policy and raises
+    RetryError carrying the final GangError."""
+    from lightgbm_tpu.robustness.retry import RetryError
+    with pytest.raises(RetryError) as ei:
+        gang.run_supervised(
+            [sys.executable, "-c", "import sys; sys.exit(2)"], 2,
+            timeout=30, attempts=2, poll=0.05, stall_policy=_POLICY,
+            label="testgang")
+    assert isinstance(ei.value.last, GangError)
+    assert ei.value.attempts == 2
+
+
+def test_gang_hb_paths_convention():
+    assert gang.gang_hb_paths("/x/b.hb", 1) == ["/x/b.hb"]
+    assert gang.gang_hb_paths("/x/b.hb", 2) == ["/x/b.hb.r0",
+                                                "/x/b.hb.r1"]
+    assert rank_path("/x/b.hb", 1) == "/x/b.hb.r1"
