@@ -133,6 +133,30 @@ RC_DEVICE_UNREACHABLE = 4
 # to a kernel config — every record now carries the resolution.
 _LEVEL_BACKEND = "unknown"
 
+# resolved histogram-collective attribution (ISSUE 12, same contract):
+# "n/a" = no row-sharded learner ran, else the engine's resolved mode
+# with fallback attribution (e.g. "allreduce(fallback:efb)"); banked
+# partials and salvage carry the child's value like level_backend.
+_HIST_REDUCE = "unknown"
+
+# comms A/B (ISSUE 12): allreduce-vs-reduce_scatter data-parallel arms
+# on virtual CPU devices — mechanics for the queued device stage
+# (tpu_session_auto ab_hist_reduce_*). Opt-in: two full trainings.
+BENCH_COMMS = os.environ.get("BENCH_COMMS", "0") == "1"
+COMMS_ROWS = int(os.environ.get("BENCH_COMMS_ROWS", 1_000_000))
+COMMS_ITERS = int(os.environ.get("BENCH_COMMS_ITERS", 6))
+COMMS_DEPTH = int(os.environ.get("BENCH_COMMS_DEPTH", 10))
+COMMS_DEVICES = int(os.environ.get("BENCH_COMMS_DEVICES", 2))
+COMMS_MIN_BUDGET = float(os.environ.get("BENCH_COMMS_MIN_BUDGET", 300))
+# write the winner into TUNED.json's hist_reduce (3% margin, allreduce
+# incumbent) — the same key + margin the session's DEVICE arms
+# (ab_hist_reduce_*) re-learn. Default OFF: these arms run on virtual
+# CPU devices, and resolve_hist_reduce consults the cache only on
+# device precisely because shared-memory collective timings don't
+# predict ICI behavior — a CPU win must not steer device defaults
+# (review finding). Opt in to exercise the write mechanics.
+COMMS_TUNED_WRITE = os.environ.get("BENCH_COMMS_TUNED_WRITE", "0") == "1"
+
 
 def _result_record(ips: float, **extra) -> dict:
     """The ONE place the benchmark record shape lives (metric name,
@@ -147,6 +171,7 @@ def _result_record(ips: float, **extra) -> dict:
         "unit": "iters/sec",
         "vs_baseline": round(ips / ref_ips_at_n, 4) if ips else 0.0,
         "level_backend": _LEVEL_BACKEND,
+        "hist_reduce": _HIST_REDUCE,
         **extra,
     }
 
@@ -268,7 +293,7 @@ def run_child(sched: str) -> None:
         del probe_b
     heartbeat.beat(heartbeat.PHASE_COMPILING, 1)
     booster = lgb.Booster(params, ds)
-    global _LEVEL_BACKEND
+    global _LEVEL_BACKEND, _HIST_REDUCE
     try:
         gcfg = booster._engine.grower_cfg
         if gcfg.row_sched == "level":
@@ -280,6 +305,13 @@ def run_child(sched: str) -> None:
             # say "no level kernel ran", attributably
     except Exception as e:
         print(f"[bench] level-backend attribution failed: {e!r}",
+              file=sys.stderr)
+    try:
+        # ISSUE 12: the resolved histogram collective (with fallback
+        # attribution) — "n/a" when no row-sharded learner ran
+        _HIST_REDUCE = getattr(booster._engine, "_hist_reduce", "n/a")
+    except Exception as e:
+        print(f"[bench] hist-reduce attribution failed: {e!r}",
               file=sys.stderr)
     for w in range(WARMUP_ITERS):      # compile + cache warm
         heartbeat.beat(heartbeat.PHASE_WARMUP, w)
@@ -682,6 +714,142 @@ def maybe_run_ingest(deadline: float) -> None:
             flush=True)
 
 
+def _comms_record(value: float, **extra) -> dict:
+    """The ONE shape of the comms A/B line (status grammar shared with
+    the training/ingest lines): ``value`` is the reduce_scatter arm's
+    iters/sec, the allreduce arm rides along as a field."""
+    return {
+        "metric": f"comms_ab_{COMMS_ROWS}x{N_FEATURES}_d{COMMS_DEPTH}"
+                  f"_w{COMMS_DEVICES}_iters_per_sec",
+        "value": round(value, 4),
+        "unit": "iters/sec",
+        **extra,
+    }
+
+
+def run_comms_child(mode: str) -> None:
+    """One arm of the hist-reduce A/B: train the depth-capped shape
+    with tree_learner=data over COMMS_DEVICES virtual CPU devices under
+    ``tpu_hist_reduce=mode``; print one JSON line with the rate AND the
+    engine's resolved attribution (the parent refuses to compare arms
+    that silently resolved to the same collective)."""
+    _apply_platform_override()
+    heartbeat.install_from_env()
+    heartbeat.beat(heartbeat.PHASE_COMPILING, 0)
+    from lightgbm_tpu.utils.jit_cache import enable_persistent_cache
+    enable_persistent_cache()
+    import jax
+
+    import lightgbm_tpu as lgb
+    ndev = len(jax.devices())
+    if ndev < COMMS_DEVICES:
+        raise RuntimeError(
+            f"comms child needs {COMMS_DEVICES} devices, got {ndev} "
+            "(parent must export xla_force_host_platform_device_count)")
+    X, y = synth_higgs(COMMS_ROWS, N_FEATURES, seed=5)
+    params = {
+        "objective": "binary",
+        "num_leaves": NUM_LEAVES,
+        "learning_rate": 0.1,
+        "max_bin": MAX_BIN,
+        "min_data_in_leaf": 20,
+        "max_depth": COMMS_DEPTH,
+        "verbose": -1,
+        "tree_learner": "data",
+        "tpu_num_devices": COMMS_DEVICES,
+        "tpu_hist_reduce": mode,
+        **BENCH_EXTRA,
+    }
+    booster = lgb.Booster(params, lgb.Dataset(X, label=y))
+    resolved = getattr(booster._engine, "_hist_reduce", "unknown")
+    for w in range(2):
+        heartbeat.beat(heartbeat.PHASE_WARMUP, w)
+        booster.update()
+    _force_sync(booster._engine.score)
+    heartbeat.beat(heartbeat.PHASE_MEASURING, 0)
+    t0 = time.perf_counter()
+    for i in range(COMMS_ITERS):
+        booster.update()
+        heartbeat.beat(heartbeat.PHASE_MEASURING, i + 1)
+    _force_sync(booster._engine.score)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "comms_mode": mode, "hist_reduce": resolved,
+        "ips": round(COMMS_ITERS / dt, 4),
+        "rows": COMMS_ROWS, "devices": COMMS_DEVICES}), flush=True)
+
+
+def maybe_run_comms_ab(deadline: float) -> None:
+    """allreduce-vs-reduce_scatter A/B on virtual CPU devices
+    (ISSUE 12): CPU mechanics for the queued device stage — the arms,
+    the record grammar and the TUNED.json ``hist_reduce`` re-learn
+    (3% margin, allreduce incumbent; the write requires BOTH arms to
+    have attributed to their requested collective, so an eligibility
+    fallback can never tune on two identical programs). Same contract
+    as the ingest stage: its own failure never poisons earlier lines.
+    """
+    if not BENCH_COMMS:
+        return
+    remaining = deadline - time.time()
+    if remaining < COMMS_MIN_BUDGET:
+        print(f"[bench] comms A/B skipped: {remaining:.0f}s of watchdog "
+              f"left (< {COMMS_MIN_BUDGET:.0f}s floor)", file=sys.stderr)
+        return
+    try:
+        arms = {}
+        for mode in ("allreduce", "reduce_scatter"):
+            env = dict(os.environ,
+                       _LGBM_BENCH_COMMS_CHILD=mode,
+                       BENCH_PLATFORM="cpu", JAX_PLATFORMS="cpu")
+            for k in ("_LGBM_BENCH_CHILD", "_LGBM_BENCH_PROBE",
+                      "_LGBM_BENCH_INGEST_CHILD"):
+                env.pop(k, None)
+            xf = env.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in xf:
+                env["XLA_FLAGS"] = (
+                    xf + " --xla_force_host_platform_device_count="
+                    f"{COMMS_DEVICES}").strip()
+            env[ENV_COMPILE_CACHE] = _cache_dir()
+            budget = max(deadline - time.time(), 60.0)
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True, timeout=budget)
+            rec = None
+            for ln in p.stdout.splitlines():
+                ln = ln.strip()
+                if ln.startswith("{") and '"comms_mode"' in ln:
+                    rec = json.loads(ln)
+            if p.returncode != 0 or rec is None:
+                raise RuntimeError(
+                    f"comms arm {mode} rc={p.returncode}: "
+                    f"{p.stderr[-400:]!r}")
+            arms[mode] = rec
+        ar, rs = arms["allreduce"], arms["reduce_scatter"]
+        attributed = (ar["hist_reduce"] == "allreduce" and
+                      rs["hist_reduce"] == "reduce_scatter")
+        win = (attributed and ar["ips"] > 0 and
+               rs["ips"] > ar["ips"] * 1.03)
+        tuned_written = False
+        if win and COMMS_TUNED_WRITE:
+            from lightgbm_tpu import tuned
+            path = tuned.write({"hist_reduce": "reduce_scatter"})
+            tuned_written = True
+            print(f"[bench] hist_reduce=reduce_scatter written to "
+                  f"{path} ({rs['ips']:.3f} vs {ar['ips']:.3f} it/s)",
+                  file=sys.stderr)
+        print(json.dumps(_comms_record(
+            rs["ips"], allreduce_ips=ar["ips"],
+            hist_reduce=rs["hist_reduce"],
+            allreduce_attr=ar["hist_reduce"], attributed=attributed,
+            winner=("reduce_scatter" if win else "allreduce"),
+            tuned_written=tuned_written)), flush=True)
+    except Exception as e:  # noqa: BLE001 — never poison earlier lines
+        print(f"[bench] comms A/B failed: {e!r}", file=sys.stderr)
+        print(json.dumps(_comms_record(
+            0.0, status="no_result", note=f"comms A/B: {e}")),
+            flush=True)
+
+
 def _apply_platform_override() -> None:
     """Honor BENCH_PLATFORM=cpu for hardware-free testing.
 
@@ -871,10 +1039,20 @@ def main() -> int:
     if os.environ.get("_LGBM_BENCH_INGEST_CHILD"):
         return _run_instrumented(
             run_ingest_child, os.environ["_LGBM_BENCH_INGEST_CHILD"])
+    if os.environ.get("_LGBM_BENCH_COMMS_CHILD"):
+        return _run_instrumented(
+            run_comms_child, os.environ["_LGBM_BENCH_COMMS_CHILD"])
     if os.environ.get("BENCH_INGEST_ONLY"):
         # standalone ingest A/B (PARITY.md numbers, smoke): no device
         # probe, no training — the gang runs on virtual CPU devices
         maybe_run_ingest(time.time() + BENCH_WATCHDOG_SEC)
+        return 0
+    if os.environ.get("BENCH_COMMS_ONLY"):
+        # standalone hist-reduce A/B (ISSUE 12): no device probe — the
+        # arms run on virtual CPU devices (device arms live in the
+        # session's ab_hist_reduce_* stage)
+        globals()["BENCH_COMMS"] = True
+        maybe_run_comms_ab(time.time() + BENCH_WATCHDOG_SEC)
         return 0
 
     deadline = time.time() + BENCH_WATCHDOG_SEC
@@ -1229,6 +1407,7 @@ def main() -> int:
                 emit_predict_line(predict_line, f"sched={sched}",
                                   "child exited without a predict line")
                 maybe_run_ingest(deadline)
+                maybe_run_comms_ab(deadline)
                 return 0
             except _ParkedChild as e:
                 # status "parked" (or a salvaged line with parked=true) is
@@ -1263,8 +1442,10 @@ def main() -> int:
                 last_note = str(e)
                 continue
         # exiting without a training result; children were reaped (the
-        # parked path returned above), so the ingest line can still bank
+        # parked path returned above), so the CPU-only ingest/comms
+        # lines can still bank
         maybe_run_ingest(deadline)
+        maybe_run_comms_ab(deadline)
         if emit_salvaged("all scheduling modes", last_note):
             emit_predict_line(None, "all scheduling modes", last_note)
             return 0

@@ -1,0 +1,87 @@
+"""Collective-traffic inspection over compiled HLO text (ISSUE 12).
+
+The reduce-scatter histogram contract makes a measurable wire claim —
+collective bytes per reduction drop from allreduce's 2(N-1)/N·|H| to
+(N-1)/N·|H| — and the claim must be checkable WITHOUT a device: the
+compiled program names its collectives (``all-reduce`` /
+``reduce-scatter`` / ``all-gather`` HLO ops with result shapes), so the
+bytes-on-the-wire of each program are a pure function of its text.
+``scripts/comms_smoke.py`` and the tier-1 bit-identity suite assert on
+these numbers; a regression that silently reintroduces a full-histogram
+broadcast (an all-reduce at the histogram shape in the reduce_scatter
+program) fails here instead of shipping 2x the ICI traffic.
+
+Wire-cost model (ring algorithms, the standard N-device lower bounds):
+
+- all-reduce of S result bytes      -> 2 * (N-1)/N * S
+- reduce-scatter of S result bytes  -> (N-1) * S   (input is N*S)
+- all-gather of S result bytes      -> (N-1)/N * S (input is S/N)
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Tuple
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_COLLECTIVES = ("all-reduce", "reduce-scatter", "all-gather")
+
+# `f32[28,256,3]{...}` (tuple results repeat the token per element)
+_SHAPE_RE = re.compile(r"\b([a-z]+\d*)\[([\d,]*)\]")
+_OP_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.+?)\s+"
+    r"(all-reduce|reduce-scatter|all-gather)(?:-start)?\(")
+
+
+def _shape_bytes(type_text: str) -> int:
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(type_text):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def collective_ops(hlo_text: str) -> List[Tuple[str, int]]:
+    """[(op_kind, result_bytes)] for every collective in the program
+    (``-start`` async forms fold into their base op; ``-done`` and
+    constant/metadata lines don't match)."""
+    out = []
+    for line in hlo_text.splitlines():
+        m = _OP_RE.match(line)
+        if m:
+            out.append((m.group(2), _shape_bytes(m.group(1))))
+    return out
+
+
+def collective_wire_bytes(hlo_text: str, n_dev: int) -> Dict[str, float]:
+    """Ring-model wire bytes per collective kind plus their sum.
+
+    Returns ``{"all-reduce": b, "reduce-scatter": b, "all-gather": b,
+    "total": b, "max_allreduce_result": bytes}`` — the last is the
+    largest single all-reduce result in the program (the "is a full
+    histogram still being broadcast?" probe).
+    """
+    per = {k: 0.0 for k in _COLLECTIVES}
+    max_ar = 0
+    for kind, size in collective_ops(hlo_text):
+        if kind == "all-reduce":
+            per[kind] += 2.0 * (n_dev - 1) / n_dev * size
+            max_ar = max(max_ar, size)
+        elif kind == "reduce-scatter":
+            per[kind] += float(n_dev - 1) * size
+        elif kind == "all-gather":
+            per[kind] += (n_dev - 1) / n_dev * size
+    per["total"] = sum(per[k] for k in _COLLECTIVES)
+    per["max_allreduce_result"] = float(max_ar)
+    return per

@@ -43,6 +43,13 @@ _CHOICES: Dict[str, Tuple[str, ...]] = {
     "tpu_packed_bins": ("auto", "true", "false", "1", "0", "yes", "no",
                         "on", "off"),
     "tpu_ingest": ("auto", "replicated", "sharded"),
+    # histogram collective for the row-sharded learners (ISSUE 12):
+    # allreduce psums full histograms and scans replicated;
+    # reduce_scatter leaves each device a feature slice + scans its
+    # window + combines winners (≡ Network::ReduceScatter +
+    # SyncUpGlobalBestSplit). auto = allreduce unless the tuned cache
+    # recorded a measured reduce_scatter win (allreduce incumbent).
+    "tpu_hist_reduce": ("auto", "allreduce", "reduce_scatter"),
 }
 
 
@@ -255,6 +262,20 @@ _reg("tpu_hist_kernel", str, "auto", ())     # auto | einsum | scatter |
                                              #  compact path resolves as
                                              #  auto under it)
 _reg("tpu_row_scheduling", str, "compact", ())  # compact | full | level
+# histogram collective for the row-sharded learners (tree_learner=
+# data/voting; ISSUE 12, ≡ Network::ReduceScatter network.h:90-276):
+# "allreduce" psums the full [F, B, 3] histograms so every device scans
+# replicated; "reduce_scatter" leaves each device one contiguous
+# feature slice (2x fewer collective bytes per reduction) and scans
+# only its window, with the global best split combined from tiny
+# packed per-device records (≡ SyncUpGlobalBestSplit). Trees are
+# bit-identical between the modes (exact int32 psum_scatter under
+# use_quantized_grad; f32 ties resolve by global feature index). auto
+# consults the tuned cache (allreduce incumbent). Ineligible configs
+# (EFB bundles, multival, forced splits, categorical, monotone) fall
+# back to allreduce, logged once at INFO.
+_reg("tpu_hist_reduce", str, "auto", ())     # auto | allreduce |
+                                             # reduce_scatter
 # hybrid level+tail growth (tpu_row_scheduling="level" with unbounded or
 # > MAX_LEVEL_DEPTH max_depth): depth the level-synchronous phase runs
 # to before the sequential tail takes over. 0 = auto

@@ -322,6 +322,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                      forced: Optional[tuple] = None,
                      prepare_split_hist: Optional[Callable] = None,
                      select_best: Optional[Callable] = None,
+                     scan_window: Optional[Callable] = None,
                      fetch_bin_column: Optional[Callable] = None,
                      partition_meta: Optional[FeatureMeta] = None,
                      bundle=None,
@@ -370,6 +371,16 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     - select_best(rec) -> rec: cross-device winner selection
       (≡ SyncUpGlobalBestSplit, parallel_tree_learner.h:210) — used by the
       feature-parallel learner, where each device scans its feature slice.
+    - scan_window(hist, ctx, feature_mask, gain_penalty, rand_u) ->
+      (hist_w, meta_w, fids, fm_w, gp_w, rand_w): feature-sharded split
+      scanning (tpu_hist_reduce=reduce_scatter, ≡ the owned-feature scan
+      after Network::ReduceScatter). The hook maps the per-leaf histogram
+      plus the per-feature vectors into THIS device's feature window with
+      globally-correct ids; the scan then runs on the window and
+      ``select_best`` combines the per-device winners. Replaces
+      prepare_split_hist in the scan path (the two do not compose).
+      Numerical dense only: no categorical/EFB/multival/forced/monotone —
+      callers fall back to the allreduce contract for those.
     - fetch_bin_column(bins_t, f) -> [R] i32: the split feature's bin
       column for partitioning; feature-parallel broadcasts the owner's
       column. ``partition_meta`` is the GLOBAL FeatureMeta used for the
@@ -410,7 +421,8 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     # voting's vote/psum and feature-parallel's select are not
     has_scan_hooks = ((prepare_split_hist is not None and
                        not prepare_is_pure) or
-                      select_best is not None)
+                      select_best is not None or
+                      scan_window is not None)
     # feature-sharded layout (feature-parallel): bins hold a LOCAL column
     # slice; the partition column comes from the owner via the
     # fetch_bin_column hook (one [R] psum per split, outside control flow)
@@ -424,6 +436,22 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     # bit-identical-splits path survives sharding.
     hist_dtype = jnp.int32 if quantized else jnp.float32
     has_cat = meta_has_categorical(meta)
+    if scan_window is not None:
+        # the reduce-scatter scan contract (models/gbdt resolves
+        # ineligible configs back to allreduce BEFORE building; these
+        # raises keep direct grower users honest)
+        if select_best is None:
+            raise ValueError("scan_window needs a select_best combine "
+                             "(the per-device winners must be merged)")
+        if has_cat or bundle is not None or mv_mode or \
+                fetch_bin_column is not None or forced is not None or \
+                meta.monotone is not None or prepare_split_hist is not None:
+            raise ValueError(
+                "scan_window (tpu_hist_reduce=reduce_scatter) supports "
+                "dense numerical features without EFB bundles, multival "
+                "storage, feature sharding, forced splits, monotone "
+                "constraints or a prepare hook — resolve those configs "
+                "to the allreduce contract instead")
     MAXK = min(hp.max_cat_threshold, B) if has_cat else 0
     NB = 13 if has_cat else 12
     NN = 10 if has_cat else 9
@@ -593,11 +621,24 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             # local-sums channel (voting): ctx grows to 7 entries —
             # (global sg/sh/cnt/out, LOCAL sg/sh/cnt)
             ctx = ctx + (lsum3[0], lsum3[1], lsum3[2])
+        gp = None if cegb is None else cegb[0] + cegb[1] * cnt
+        if scan_window is not None:
+            # feature-sharded scan (reduce_scatter): the hook windows the
+            # histogram/masks/penalties with globally-correct ids; the
+            # combine below merges the per-device winners into the one
+            # replicated record every device applies (≡ owned-feature
+            # FindBestSplits + SyncUpGlobalBestSplit)
+            hist_w, meta_w, fids, fm_w, gp_w, rand_w = scan_window(
+                hist, ctx, feature_mask, gp, rand_u)
+            out = best_split_for_leaf(
+                hist_w, sg, sh, cnt, parent_out, meta_w, hp, fm_w,
+                leaf_range=leaf_range, leaf_depth=leaf_depth,
+                gain_penalty=gp_w, rand_u=rand_w, feature_ids=fids)
+            return select_best(out)
         hist, extra_mask = prepare_split_hist(hist, ctx, feature_mask)
         if extra_mask is not None:
             feature_mask = (extra_mask if feature_mask is None
                             else feature_mask & extra_mask)
-        gp = None if cegb is None else cegb[0] + cegb[1] * cnt
         out = best_split_for_leaf(hist, sg, sh, cnt, parent_out, meta, hp,
                                   feature_mask, leaf_range=leaf_range,
                                   leaf_depth=leaf_depth, gain_penalty=gp,
@@ -844,14 +885,19 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                                 leaf_depth=jnp.int32(0), cegb=cegb,
                                 rand_u=root_rand, lsum3=root_lsum)
 
+            # pool slots take the REDUCED root histogram's shape: under
+            # reduce_scatter aggregation the pool holds each device's
+            # feature WINDOW ([Fp/D, B, 3] — the mesh shards the pool's
+            # memory too), under allreduce/serial it stays [Fp, B, 3]
+            slot_shape = tuple(hist_root.shape)
             if pool_none:
                 hist_pool = None
             elif pool_bounded:
-                hist_pool = jnp.zeros((P_slots, Fp, B, 3),
+                hist_pool = jnp.zeros((P_slots,) + slot_shape,
                                       hist_dtype).at[0].set(hist_root)
             else:
-                hist_pool = jnp.zeros((L, Fp, B, 3), hist_dtype).at[0].set(
-                    hist_root)
+                hist_pool = jnp.zeros((L,) + slot_shape,
+                                      hist_dtype).at[0].set(hist_root)
             stats0 = jnp.zeros((L, NS), jnp.float32)
             stats0 = stats0.at[:, S_LMIN].set(-jnp.inf)
             stats0 = stats0.at[:, S_LMAX].set(jnp.inf)

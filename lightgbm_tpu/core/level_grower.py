@@ -86,18 +86,12 @@ from .tree import TreeArrays
 MAX_LEVEL_DEPTH = 10
 
 
-_LOGGED_ONCE: set = set()
-
-
-def _log_once(msg: str) -> None:
-    """INFO-log a backend-resolution decision exactly once per process.
-
-    The r05 A/B confusion started with an INVISIBLE mapping (pallas
-    silently running as einsum under blocks mode), so every silent
-    remap now announces itself — once, not per-level/per-tree."""
-    if msg not in _LOGGED_ONCE:
-        _LOGGED_ONCE.add(msg)
-        log.info(msg)
+# INFO-log a backend-resolution decision exactly once per process: the
+# r05 A/B confusion started with an INVISIBLE mapping (pallas silently
+# running as einsum under blocks mode), so every silent remap announces
+# itself — once, not per-level/per-tree. One shared helper
+# (utils/log.info_once) so the grower modules can't drift.
+from ..utils.log import info_once as _log_once  # noqa: E402
 
 
 def _resolve_rm_backend(requested: str) -> str:

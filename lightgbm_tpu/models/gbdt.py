@@ -217,6 +217,29 @@ def resolve_hist_kernel(requested: str, hist_dtype: str, use_quant: bool,
     return tk if tk in ("einsum", "pallas", "scatter") else "einsum"
 
 
+def resolve_hist_reduce(requested: str, num_data, platform: str) -> str:
+    """Resolve ``tpu_hist_reduce=auto`` to a concrete histogram
+    collective for the row-sharded learners (ISSUE 12).
+
+    Explicit values pass through (eligibility fallback happens at the
+    learner, attributably). ``auto``: allreduce on CPU (virtual-device
+    collectives are shared-memory copies — the reduce_scatter win is
+    ICI bytes + the divided scan, both device properties); on TPU the
+    tuned cache's ``hist_reduce`` (re-learned by the session
+    ``ab_hist_reduce_*`` arms at the 1M depth-10 shape, 3% margin),
+    size-gated like every tuned flip, allreduce incumbent. Unknown
+    cache values fall back — tuning must never be able to break
+    training.
+    """
+    if requested != "auto":
+        return requested
+    if platform == "cpu":
+        return "allreduce"
+    tk = (tuned.get("hist_reduce", "allreduce")
+          if tuned.applies(num_data) else "allreduce")
+    return tk if tk in ("allreduce", "reduce_scatter") else "allreduce"
+
+
 def resolve_level_hist_kernel(requested: str, num_data,
                               platform: str) -> str:
     """Resolve ``tpu_hist_kernel`` for the LEVEL phase's per-node
@@ -269,6 +292,12 @@ class GBDT:
         # in-place tree edits via invalidate_serving_cache); tail appends
         # leave it alone so the packed forest can grow incrementally
         self._model_gen = 0
+        # resolved histogram collective attribution (ISSUE 12): "n/a"
+        # for non-row-sharded learners, else the resolved mode with
+        # fallback attribution (e.g. "allreduce(fallback:efb)") — the
+        # ONE string bench records carry (same contract as PR6's
+        # level_backend: numbers must be attributable to a comm config)
+        self._hist_reduce = "n/a"
         self._serving: Optional[ServingEngine] = None
         self._serving_mappers = None  # stable identity for binner caching
         self.models: List[HostTree] = []
@@ -862,6 +891,19 @@ class GBDT:
                        else f"only {avail} device(s) visible")
                 log.warning(f"tree_learner={tl} requested but {cap}; "
                             "running serial")
+        if (self._tree_learner not in ("data", "voting") and
+                cfg.tpu_hist_reduce == "reduce_scatter"):
+            # _hist_reduce stays "n/a": no histogram collective runs at
+            # all outside the row-sharded learners (feature-parallel
+            # ships one winner record + one column; serial — including
+            # the injected-collectives per-worker program, whose
+            # host-side hooks are allreduce by construction — has no
+            # mesh), so there is nothing to scatter
+            log.info(
+                "tpu_hist_reduce=reduce_scatter applies to the "
+                "row-sharded learners (tree_learner=data/voting); "
+                f"tree_learner={self._tree_learner!r} keeps its "
+                "existing collective contract")
         if self._sharded_ingest and self._tree_learner not in ("data",
                                                                "voting"):
             log.fatal(
@@ -1236,6 +1278,54 @@ class GBDT:
         return self._bins_dev_cache
 
     # ------------------------------------------------------------------
+    def _resolve_hist_reduce_mode(self, tl: str, forced) -> str:
+        """Resolve + eligibility-gate the histogram collective for the
+        row-sharded learners (ISSUE 12), recording the attribution
+        string bench reads (``self._hist_reduce``).
+
+        The reduce-scatter contract scans feature WINDOWS with a packed
+        small-record combine — numerical dense only for now. Everything
+        else resolves to the existing allreduce path, logged once at
+        INFO with the reason (the PR6 backend-fallback rule: silent
+        remaps make A/B numbers unattributable)."""
+        cfg = self.config
+        mode = resolve_hist_reduce(cfg.tpu_hist_reduce, self.num_data,
+                                   jax.default_backend())
+        if tl not in ("data", "voting"):
+            self._hist_reduce = "n/a"   # no histogram collective at all
+            return "allreduce"
+        if mode != "reduce_scatter":
+            self._hist_reduce = "allreduce"
+            return "allreduce"
+        reasons = []
+        if self._bundle is not None:
+            reasons.append("efb")
+        if self._multival:
+            reasons.append("multival")
+        if forced is not None and tl == "data":
+            reasons.append("forced-splits")
+        meta = self.feature_meta
+        try:
+            has_cat = bool(np.any(np.asarray(meta.is_categorical)))
+        except Exception:
+            has_cat = True
+        if has_cat:
+            reasons.append("categorical")
+        if meta.monotone is not None:
+            reasons.append("monotone")
+        if reasons:
+            why = "+".join(reasons)
+            log.info(
+                f"tpu_hist_reduce={cfg.tpu_hist_reduce} resolves to "
+                f"allreduce: reduce_scatter is not yet eligible with "
+                f"{why} (feature windows carry dense numerical scan "
+                "state only)")
+            self._hist_reduce = f"allreduce(fallback:{why})"
+            return "allreduce"
+        self._hist_reduce = "reduce_scatter"
+        return "reduce_scatter"
+
+    # ------------------------------------------------------------------
     def _setup_distributed(self, train: BinnedDataset, forced,
                            bins_host=None) -> None:
         """Build the mesh + sharded grower for tree_learner=data/voting/
@@ -1263,6 +1353,11 @@ class GBDT:
             log.warning(f"forcedsplits_filename is not supported with "
                         f"tree_learner={tl}; ignoring forced splits")
             forced = None
+        # histogram collective (ISSUE 12): allreduce | reduce_scatter,
+        # with the eligibility ladder + attribution recorded in
+        # self._hist_reduce (returns "allreduce" wherever the
+        # reduce-scatter window contract is not yet eligible)
+        hist_reduce = self._resolve_hist_reduce_mode(tl, forced)
         if self.grower_cfg.interaction_groups and tl == "feature":
             log.fatal("interaction_constraints are not supported with "
                       "tree_learner=feature")
@@ -1399,11 +1494,12 @@ class GBDT:
             if tl == "data":
                 grow = make_data_parallel_grower(
                     self.grower_cfg, self.feature_meta, mesh, forced=forced,
-                    bundle=self._bundle)
+                    bundle=self._bundle, hist_reduce=hist_reduce)
             else:
                 grow = make_voting_parallel_grower(
                     self.grower_cfg, self.feature_meta, mesh,
-                    top_k=int(cfg.top_k), bundle=self._bundle)
+                    top_k=int(cfg.top_k), bundle=self._bundle,
+                    hist_reduce=hist_reduce)
             if self._shard_row_map is not None:
                 # scatter the replicated [N, 3] gh into the per-region
                 # padded layout INSIDE the jitted program (pad slots get

@@ -226,7 +226,8 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
                         leaf_range=None, leaf_depth=None,
                         gain_penalty: jnp.ndarray = None,
                         rand_u: jnp.ndarray = None,
-                        want_row: bool = False):
+                        want_row: bool = False,
+                        feature_ids: jnp.ndarray = None):
     """Find the best split over all features for one leaf.
 
     Parameters
@@ -244,6 +245,16 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
     gain_penalty : optional f32 [F] — per-feature penalty subtracted from
         the net gain before the cross-feature argmax (CEGB DeltaGain,
         cost_effective_gradient_boosting.hpp:81-98).
+    feature_ids : optional i32 [F] — GLOBAL feature index of each scanned
+        row when ``hist`` is a feature *window* of a sharded histogram
+        (tpu_hist_reduce=reduce_scatter; ≡ the per-machine feature slice
+        DataParallelTreeLearner scans after Network::ReduceScatter). The
+        cross-feature winner is then chosen by global id — byte-equal
+        gain ties resolve to the SMALLER global feature index, so a
+        sharded argmax composed with a cross-device combine can never
+        disagree with the serial scan (SplitInfo::operator> semantics) —
+        and the returned record's ``feature`` carries the global id.
+        Numerical-only (windows do not carry categorical scan state).
     rand_u : optional f32 [F] in [0, 1) — extremely-randomized mode
         (config extra_trees): one random candidate per feature. Numerical
         scans restrict to threshold bin floor(u * (num_bin - 2)) (ref:
@@ -267,14 +278,15 @@ def best_split_for_leaf(hist: jnp.ndarray, sum_gradient, sum_hessian,
                              parent_output, meta, hp, leaf_range,
                              rand_bins=rand_bins)
     cat = None
-    if meta_has_categorical(meta):
+    if feature_ids is None and meta_has_categorical(meta):
         cat = _categorical_scan(hist, sum_gradient,
                                 sum_hessian + 2 * K_EPSILON, num_data,
                                 parent_output, meta, hp, leaf_range,
                                 rand_u=rand_u)
     return _select_across_features(scan, meta, hp, feature_mask, leaf_depth,
                                    gain_penalty, parent_output, cat=cat,
-                                   want_row=want_row)
+                                   want_row=want_row,
+                                   feature_ids=feature_ids)
 
 
 def _per_feature_scan(hist, sum_gradient, sum_hessian, num_data,
@@ -663,8 +675,14 @@ def _select_across_features(scan: dict, meta: FeatureMeta,
                             hp: SplitHyperParams, feature_mask,
                             leaf_depth, gain_penalty,
                             parent_output, cat: dict = None,
-                            want_row: bool = False):
+                            want_row: bool = False,
+                            feature_ids: jnp.ndarray = None):
     """Cross-feature selection over _per_feature_scan output.
+
+    ``feature_ids`` (numerical-only) marks ``scan`` as a feature WINDOW
+    of a sharded histogram: the winner is picked by (max net gain, min
+    GLOBAL feature id) instead of first-position argmax, and the record
+    carries the global id — see best_split_for_leaf.
 
     ``want_row`` (numerical-only) additionally returns the grower's
     packed f32 [12] row — assembled here from the [3]-vector
@@ -719,7 +737,20 @@ def _select_across_features(scan: dict, meta: FeatureMeta,
         penalty = jnp.where(pen >= depth + 1.0, K_EPSILON, penalty)
         net_gain = jnp.where(valid_any & (mono[:, 0] != 0),
                              net_gain * penalty, net_gain)
-    best_f = jnp.argmax(net_gain).astype(jnp.int32)  # ties -> smaller f
+    if feature_ids is not None:
+        if cat is not None:
+            raise ValueError("feature_ids windows are numerical-only")
+        # window selection: max gain, ties to the SMALLEST global id
+        # (window ids need not be ascending — voting's vote order isn't —
+        # so positional argmax cannot stand in for the id tie-break)
+        mg = jnp.max(net_gain)
+        at_max = net_gain == mg
+        win_fid = jnp.min(jnp.where(at_max, feature_ids,
+                                    jnp.int32(2 ** 30)))
+        best_f = jnp.argmax(at_max &
+                            (feature_ids == win_fid)).astype(jnp.int32)
+    else:
+        best_f = jnp.argmax(net_gain).astype(jnp.int32)  # ties -> smaller f
     sel = lambda a: a[best_f]
     gain_out = sel(net_gain)
     has_valid = sel(valid_any)
@@ -816,9 +847,11 @@ def _select_across_features(scan: dict, meta: FeatureMeta,
 
     dl_w = (jnp.where(is_cat_win, False, sel(best_dl))
             if cat is not None else sel(best_dl))
+    feat_win = (feature_ids[best_f] if feature_ids is not None
+                else best_f)
     rec = SplitRecord(
         gain=jnp.where(has_valid, gain_out, K_MIN_SCORE),
-        feature=jnp.where(has_valid, best_f, -1).astype(jnp.int32),
+        feature=jnp.where(has_valid, feat_win, -1).astype(jnp.int32),
         threshold=jnp.where(is_cat_win, 0, best_t_w) if cat is not None
         else best_t_w,
         default_left=dl_w,

@@ -5,18 +5,20 @@ TPU-native replacement for the reference's parallel learners + Network stack
 §2.4). machine_list/ports become a `Mesh`; socket/MPI collectives become XLA
 collectives over ICI/DCN.
 """
-from .mesh import (DATA_AXIS, FEATURE_AXIS, build_mesh, pad_rows_np,
-                   padded_rows, replicated, row_sharding)
+from .mesh import (DATA_AXIS, FEATURE_AXIS, build_mesh, feature_tile,
+                   pad_rows_np, padded_rows, replicated, row_sharding)
 from .data_parallel import (make_data_parallel_grower,
-                            make_distributed_train_step)
+                            make_distributed_train_step,
+                            make_feature_window, make_global_best_combine)
 from .feature_parallel import (make_feature_parallel_grower,
                                pad_feature_meta, padded_features)
 from .voting_parallel import make_voting_parallel_grower
 
 __all__ = [
     "DATA_AXIS", "FEATURE_AXIS", "build_mesh", "padded_rows", "pad_rows_np",
-    "row_sharding", "replicated",
+    "row_sharding", "replicated", "feature_tile",
     "make_data_parallel_grower", "make_distributed_train_step",
+    "make_feature_window", "make_global_best_combine",
     "make_feature_parallel_grower", "pad_feature_meta", "padded_features",
     "make_voting_parallel_grower",
 ]

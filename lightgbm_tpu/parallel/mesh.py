@@ -48,6 +48,15 @@ def padded_rows(num_rows: int, num_shards: int) -> int:
     return ((num_rows + num_shards - 1) // num_shards) * num_shards
 
 
+def feature_tile(num_features: int, num_shards: int) -> int:
+    """Per-device feature-window width under reduce-scatter histogram
+    aggregation (tpu_hist_reduce=reduce_scatter): Fp padded up to a
+    mesh-divisible tile, then split evenly — the TPU expression of
+    Network::ReduceScatter's per-machine buffer blocks
+    (ref: network.h:90-276 PrepareBufferPos block layout)."""
+    return padded_rows(num_features, num_shards) // num_shards
+
+
 def pad_rows_np(arr: np.ndarray, target: int, axis: int,
                 fill=0) -> np.ndarray:
     """Pad `arr` along `axis` to `target` length with `fill` (host side).

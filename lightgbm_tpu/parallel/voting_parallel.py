@@ -36,15 +36,16 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.grower import GrowerConfig, make_tree_grower
 from ..ops.split import FeatureMeta, per_feature_net_gains
-from .data_parallel import _make_sharded
-from .mesh import DATA_AXIS
+from .data_parallel import _make_sharded, make_global_best_combine
+from .mesh import DATA_AXIS, feature_tile
 
 
 def make_voting_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
                                 mesh: Mesh, top_k: int = 20,
                                 data_axis: str = DATA_AXIS,
                                 bundle=None, fetch_bin_column=None,
-                                bins_spec=None, pre_fix=None):
+                                bins_spec=None, pre_fix=None,
+                                hist_reduce: str = "allreduce"):
     """Build grow(bins_t, gh, feature_mask) with rows sharded over
     `data_axis` ([F, R] on dim 1, gh on dim 0), aggregating only the
     globally voted 2*top_k features per leaf (top_k ≡ config.top_k,
@@ -66,8 +67,23 @@ def make_voting_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
     k = max(1, min(top_k, F))
     k2 = min(2 * k, F)
     hp = cfg.hparams
+    if hist_reduce not in ("allreduce", "reduce_scatter"):
+        raise ValueError(f"hist_reduce={hist_reduce!r}; expected "
+                         "'allreduce' or 'reduce_scatter' (resolve "
+                         "'auto' upstream)")
+    use_rs = hist_reduce == "reduce_scatter"
+    if use_rs and (bundle is not None or fetch_bin_column is not None or
+                   pre_fix is not None):
+        raise ValueError(
+            "tpu_hist_reduce=reduce_scatter voting supports dense "
+            "numerical storage only (EFB/multival resolve to allreduce "
+            "in models/gbdt)")
 
-    def prepare(hist_local, ctx, feature_mask=None):
+    def vote(hist_local, ctx, feature_mask):
+        """Local top-k vote -> replicated global top-2k selection [k2]
+        (≡ local SplitInfo gains -> Allgather votes -> GlobalVoting,
+        voting_parallel_tree_learner.cpp:152,373). Shared verbatim by
+        both reduce modes, so their candidate sets cannot drift."""
         parent_out = ctx[3]
         # the LOCAL vote ranks by LOCAL gains (ref: voting learner votes
         # with this->smaller_leaf_splits_, the local sums) — the
@@ -93,10 +109,53 @@ def make_voting_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
         keyed = (votes.astype(jnp.int32) * F
                  + (F - 1 - jnp.arange(F, dtype=jnp.int32)))
         _, sel = lax.top_k(keyed, k2)                               # [k2]
+        return hist_local, sel.astype(jnp.int32)
+
+    def prepare(hist_local, ctx, feature_mask=None):
+        hist_local, sel = vote(hist_local, ctx, feature_mask)
         hist_sel = lax.psum(hist_local[sel], data_axis)         # [k2, B, 3]
         hist_global = jnp.zeros_like(hist_local).at[sel].set(hist_sel)
         sel_mask = jnp.zeros(F, bool).at[sel].set(True)
         return hist_global, sel_mask
+
+    n_shards = int(mesh.shape[data_axis])
+    k2l = feature_tile(k2, n_shards)       # selected features per device
+    k2p = k2l * n_shards
+
+    def scan_window(hist_local, ctx, feature_mask, gain_penalty, rand_u):
+        """reduce_scatter composition: the voted top-2k histograms
+        reduce-scatter over the mesh instead of psum+replicate — each
+        device keeps GLOBAL sums for k2/D of the selected features and
+        scans only those (with their true global ids; the combine merges
+        winners). Same vote, same candidate set, same per-feature sums
+        as the allreduce path — only the layout of who holds/scans what
+        changes, so trees stay bit-identical."""
+        hist_local, sel = vote(hist_local, ctx, feature_mask)
+        if k2p > k2:
+            # pad the selection to a mesh-divisible tile; sentinel id F
+            # is masked off below (its gathered hist is garbage by
+            # construction and never scanned as valid)
+            sel = jnp.concatenate(
+                [sel, jnp.full((k2p - k2,), F, jnp.int32)])
+        ssafe = jnp.clip(sel, 0, F - 1)
+        hist_w = lax.psum_scatter(hist_local[ssafe], data_axis,
+                                  scatter_dimension=0,
+                                  tiled=True)               # [k2l, B, 3]
+        i = lax.axis_index(data_axis)
+        fids = lax.dynamic_slice_in_dim(sel, i * k2l, k2l, 0)
+        valid = fids < F
+        fsafe = jnp.clip(fids, 0, F - 1)
+        gather = lambda a: None if a is None else a[fsafe]
+        meta_w = FeatureMeta(
+            num_bin=meta.num_bin[fsafe],
+            missing_type=meta.missing_type[fsafe],
+            default_bin=meta.default_bin[fsafe],
+            is_categorical=jnp.zeros((k2l,), bool),
+            penalty=gather(meta.penalty))
+        fm_w = (valid if feature_mask is None
+                else valid & feature_mask[fsafe])
+        return (hist_w, meta_w, fids, fm_w, gather(gain_penalty),
+                gather(rand_u))
 
     grow = make_tree_grower(
         cfg, meta,
@@ -105,7 +164,10 @@ def make_voting_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
         reduce_max=lambda x: lax.pmax(x, data_axis),
         localize_key=lambda k: jax.random.fold_in(
             k, lax.axis_index(data_axis)),
-        prepare_split_hist=prepare,
+        prepare_split_hist=None if use_rs else prepare,
+        scan_window=scan_window if use_rs else None,
+        select_best=make_global_best_combine(data_axis) if use_rs
+        else None,
         bundle=bundle, fetch_bin_column=fetch_bin_column,
         local_pool=True,
         # the vote/psum is a pure function of (hist, ctx, mask) and the

@@ -66,6 +66,13 @@ def get(key: str, default: Any = None) -> Any:
 #                       kernel table is informational). Seeded
 #                       "einsum" (conservative) until a device
 #                       session measures the sorted-segment kernel.
+#   hist_reduce         histogram collective for the row-sharded
+#                       learners — allreduce | reduce_scatter
+#                       (models/gbdt.resolve_hist_reduce under
+#                       tpu_hist_reduce=auto); re-learned by the
+#                       session ab_hist_reduce_* arms (and the bench
+#                       comms A/B) at the 1M depth-10 data-parallel
+#                       shape with the 3% margin, allreduce incumbent.
 #   flip_min_rows       row-count floor below which flips don't apply
 #
 # The session A/Bs its flips at 100k rows; at small sizes the winners

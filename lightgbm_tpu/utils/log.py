@@ -59,5 +59,19 @@ def warning(msg: str) -> None:
         _emit(f"[LightGBM-TPU] [Warning] {msg}")
 
 
+# once-only resolution notices (the PR6 rule: silent backend/learner
+# remaps made A/B numbers unattributable, so every remap announces
+# itself — once per process, not per call). One shared set so growers
+# don't each carry a drifting copy; tests reset via logged_once.clear().
+logged_once: set = set()
+
+
+def info_once(msg: str) -> None:
+    """INFO-log a resolution decision exactly once per process."""
+    if msg not in logged_once:
+        logged_once.add(msg)
+        info(msg)
+
+
 def fatal(msg: str) -> None:
     raise LightGBMError(msg)

@@ -362,13 +362,98 @@ def bench_multival():
               f"{dt*1e3:8.3f} ms", flush=True)
 
 
+def bench_comms():
+    """Histogram-collective A/B (ISSUE 12): the per-split reduce+scan
+    unit under shard_map — allreduce (psum the full [F, B, 3] hist,
+    replicated scan) vs reduce_scatter (psum_scatter to a feature
+    window, window scan, packed-record combine). Prints the ring-model
+    bytes-on-the-wire next to each timing so device numbers can be read
+    against the 2(N-1)/N·|H| -> (N-1)/N·|H| claim. Needs >= 2 devices
+    (on CPU run under XLA_FLAGS=--xla_force_host_platform_device_count=2
+    — the __main__ hook sets it when the suite is selected first)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from lightgbm_tpu.ops.split import (FeatureMeta, SplitHyperParams,
+                                        best_split_for_leaf)
+    from lightgbm_tpu.parallel import build_mesh
+    from lightgbm_tpu.parallel.data_parallel import (
+        _make_sharded, make_feature_window, make_global_best_combine)
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        print("comms: SKIP (needs >= 2 devices; on CPU set XLA_FLAGS="
+              "--xla_force_host_platform_device_count=2)", flush=True)
+        return
+    mesh = build_mesh(n_dev)
+    hp = SplitHyperParams(min_data_in_leaf=20)
+    B = 255
+    rng = np.random.default_rng(0)
+    for F in (28, 200):
+        meta = FeatureMeta(
+            num_bin=jnp.full(F, B, jnp.int32),
+            missing_type=jnp.zeros(F, jnp.int32),
+            default_bin=jnp.zeros(F, jnp.int32),
+            is_categorical=jnp.zeros(F, bool))
+        h = (rng.integers(0, 64, (n_dev, F, B, 3)) * 0.25).astype(
+            np.float32)
+        sg = float(h[..., 0].sum())
+        sh_ = float(h[..., 1].sum()) + 1.0
+        cn = float(h[..., 2].sum()) + 1.0
+        reduce_rs, scan_window = make_feature_window(meta, n_dev, "data")
+        combine = make_global_best_combine("data")
+        fm = jnp.ones(F, bool)
+
+        def ar_unit(hl):
+            hg = lax.psum(hl[0], "data")
+            rec = best_split_for_leaf(hg, sg, sh_, cn, 0.0, meta, hp, fm)
+            return rec.gain, rec.feature
+
+        def rs_unit(hl):
+            hw = reduce_rs(hl[0])
+            hw, meta_w, fids, fm_w, gp, ru = scan_window(
+                hw, None, fm, None, None)
+            rec = best_split_for_leaf(hw, sg, sh_, cn, 0.0, meta_w, hp,
+                                      fm_w, feature_ids=fids)
+            rec = combine(rec)
+            return rec.gain, rec.feature
+
+        spec = P("data", None, None, None)
+        hist_mb = F * B * 3 * 4 / 2 ** 20
+        for name, fn, factor in (("allreduce", ar_unit,
+                                  2 * (n_dev - 1) / n_dev),
+                                 ("reduce_scatter", rs_unit,
+                                  (n_dev - 1) / n_dev)):
+            # jaxlint: disable=JL003 — one DISTINCT program per arm
+            # (allreduce vs reduce_scatter), each jitted exactly once
+            unit = jax.jit(_make_sharded(fn, mesh, in_specs=(spec,),
+                                         out_specs=(P(), P())))
+            dt = timeit(unit, jnp.asarray(h))
+            print(f"comms {name:14s} F={F:3d} B={B}: {dt*1e3:8.3f} ms  "
+                  f"(wire ~{hist_mb*factor:6.2f} MB/reduce of "
+                  f"{hist_mb:.2f} MB hist, {n_dev} dev)", flush=True)
+
+
 SUITES = {"hist": bench_hist, "pallas": bench_pallas,
           "pallas_rm": bench_pallas_rm, "hist_level": bench_hist_level,
           "part": bench_part, "fullpass": bench_fullpass,
-          "multival": bench_multival}
+          "multival": bench_multival, "comms": bench_comms}
 
 if __name__ == "__main__":
     picks = sys.argv[1:] or list(SUITES)
+    if "comms" in picks and "jax" not in sys.modules:
+        # the comms suite needs a mesh: on a 1-device CPU box expose 2
+        # virtual devices BEFORE the backend initializes (no-op when
+        # the flag — or a real multi-device platform — is already set)
+        import os
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags and \
+                os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
     import jax
     print(f"backend={jax.default_backend()} devices={jax.devices()}",
           flush=True)

@@ -124,6 +124,19 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
+echo "== comms smoke (reduce-scatter split finding parity + wire bytes, 2-dev CPU) =="
+# ISSUE 12: tpu_hist_reduce=reduce_scatter trees must be bit-identical
+# to allreduce AND serial (quantized + dyadic f32, ragged feature pad),
+# retrace nothing at a fixed shape, fall back to allreduce (attributed,
+# not silent) on ineligible configs, and the compiled program must ship
+# fewer collective wire bytes with NO full-histogram all-reduce left.
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python scripts/comms_smoke.py || rc=1
+if [ $rc -ne 0 ]; then
+    echo "check.sh: comms smoke failed — skipping tier-1 pytest" >&2
+    exit $rc
+fi
+
 echo "== ingest smoke (sharded ingestion parity + RSS, 2-proc CPU) =="
 # ISSUE 7: a real 2-process launch_local world trains on DISJOINT row
 # shards (distributed bin finding + per-host binning) and must produce

@@ -1,0 +1,174 @@
+"""Histogram-collective smoke gate (ISSUE 12): reduce-scatter split
+finding parity + compile budget + the eligibility fallback ladder + the
+bytes-on-the-wire claim, on 2 virtual CPU devices, <30 s.
+
+Asserts:
+  1. data-parallel trees under tpu_hist_reduce=reduce_scatter are
+     BIT-identical to allreduce AND to the serial scan (quantized int32
+     exact; dyadic f32 association-free), voting included;
+  2. after one warmup call, repeated grows at the same shape compile
+     NOTHING — the feature-window slicing and the packed-record combine
+     are static inside the one jitted program;
+  3. an ineligible config (categorical features) under an explicit
+     reduce_scatter request FALLS BACK to allreduce with the reason in
+     the engine's attribution string — the ladder, not a crash and not
+     a silent remap;
+  4. the compiled reduce_scatter program ships FEWER collective wire
+     bytes than the allreduce program (ring model over HLO text:
+     2(N-1)/N·|H| -> (N-1)/N·|H|) and contains NO all-reduce at the
+     full-histogram shape — the full-histogram broadcast is absent.
+
+Wired into scripts/check.sh; exits non-zero on the first violated gate.
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] = (os.environ["XLA_FLAGS"] +
+                               " --xla_force_host_platform_device_count=2"
+                               ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+T_START = time.perf_counter()
+BUDGET_SEC = 30.0
+N_DEV = 2
+
+
+def check(cond, what):
+    took = time.perf_counter() - T_START
+    if not cond:
+        print(f"comms_smoke: FAIL {what} ({took:.1f}s)", file=sys.stderr)
+        sys.exit(1)
+    print(f"comms_smoke: ok {what} ({took:.1f}s)")
+
+
+def _tree_bytes(tree):
+    n = int(tree.num_leaves)
+    return (n,
+            np.asarray(tree.split_feature[:n - 1]).tobytes(),
+            np.asarray(tree.threshold_bin[:n - 1]).tobytes(),
+            np.asarray(tree.leaf_value[:n]).tobytes())
+
+
+def main():
+    from lightgbm_tpu.analysis import guards
+    from lightgbm_tpu.analysis.hlo import collective_wire_bytes
+    from lightgbm_tpu.core.grower import GrowerConfig, make_tree_grower
+    from lightgbm_tpu.ops.split import FeatureMeta, SplitHyperParams
+    from lightgbm_tpu.parallel import (build_mesh,
+                                       make_data_parallel_grower,
+                                       make_voting_parallel_grower,
+                                       row_sharding)
+
+    rng = np.random.default_rng(0)
+    n, F, B = 1536, 5, 32       # ragged F: the 2-dev tile pads 5 -> 6
+    bins = rng.integers(0, B, (F, n)).astype(np.uint8)
+    grad = (rng.integers(-8, 8, n) * 0.25).astype(np.float32)  # dyadic
+    gh = np.stack([grad, np.ones(n, np.float32),
+                   np.ones(n, np.float32)], axis=1)
+    meta = FeatureMeta(num_bin=jnp.full(F, B, jnp.int32),
+                       missing_type=jnp.zeros(F, jnp.int32),
+                       default_bin=jnp.zeros(F, jnp.int32),
+                       is_categorical=jnp.zeros(F, bool))
+    mesh = build_mesh(N_DEV)
+    bins_rm = np.ascontiguousarray(bins.T)
+    b = jax.device_put(bins_rm, row_sharding(mesh, 0, 2))
+    g = jax.device_put(gh, row_sharding(mesh, 0, 2))
+
+    # ---- 1. parity: serial == allreduce == reduce_scatter ----------
+    grows = {}
+    for quant in (False, True):
+        cfg = GrowerConfig(num_leaves=15, num_bin=B,
+                           hparams=SplitHyperParams(min_data_in_leaf=5),
+                           block_rows=512, row_sched="compact",
+                           hist_rm_backend="scatter", quantized=quant,
+                           stochastic_rounding=False)
+        # jaxlint: disable=JL003 — every arm of the parity matrix is a
+        # DISTINCT program (serial/data/voting × reduce mode × dtype),
+        # each jitted exactly once
+        t_s = jax.jit(make_tree_grower(cfg, meta))(
+            jnp.asarray(bins_rm), jnp.asarray(gh), None)[0]
+        ref = _tree_bytes(t_s)
+        for mode in ("allreduce", "reduce_scatter"):
+            # jaxlint: disable=JL003 — one program per reduce mode
+            grow = jax.jit(make_data_parallel_grower(
+                cfg, meta, mesh, hist_reduce=mode))
+            if not quant:
+                grows[mode] = (grow, cfg)
+            t_d = grow(b, g, None)[0]
+            check(_tree_bytes(t_d) == ref,
+                  f"serial == data[{mode}] "
+                  f"[{'int8' if quant else 'dyadic f32'}, ragged F={F}]")
+        if not quant:
+            # (the quantized voting leg lives in tier-1
+            # test_hist_reduce.py — one voting compile keeps this gate
+            # inside its budget on cold machines)
+            # jaxlint: disable=JL003 — one voting program, jitted once
+            t_v = jax.jit(make_voting_parallel_grower(
+                cfg, meta, mesh, top_k=F,
+                hist_reduce="reduce_scatter"))(b, g, None)[0]
+            check(_tree_bytes(t_v) == ref,
+                  "serial == voting[reduce_scatter] [dyadic f32]")
+
+    # ---- 2. compile budget: same shape => no retrace ---------------
+    grow_rs = grows["reduce_scatter"][0]
+    with guards.CompileCounter() as counter:
+        for _ in range(3):
+            out = grow_rs(b, g, None)
+        jax.block_until_ready(out[1])
+    check(counter.count == 0,
+          f"steady-state compile budget (0 retraces over 3 grows, "
+          f"got {counter.count}: {counter.names})")
+
+    # ---- 3. eligibility fallback ladder ----------------------------
+    import lightgbm_tpu as lgb
+    X = rng.normal(size=(400, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    booster = lgb.train(
+        {"objective": "binary", "verbose": -1, "num_leaves": 7,
+         "min_data_in_leaf": 5, "tree_learner": "data",
+         "tpu_num_devices": 2, "tpu_hist_reduce": "reduce_scatter"},
+        lgb.Dataset(X, label=y, categorical_feature=[0]),
+        num_boost_round=1)
+    attr = booster._engine._hist_reduce
+    check(attr == "allreduce(fallback:categorical)",
+          f"categorical falls back to allreduce, attributed ({attr!r})")
+    check(len(booster._engine.models) == 1, "fallback mode still trains")
+
+    # ---- 4. wire bytes: rs < ar, full-hist broadcast absent --------
+    texts = {}
+    for mode, (grow, cfg) in grows.items():
+        texts[mode] = grow.lower(b, g, None).compile().as_text()
+    hist_bytes = F * B * 3 * 4
+    ar = collective_wire_bytes(texts["allreduce"], N_DEV)
+    rs = collective_wire_bytes(texts["reduce_scatter"], N_DEV)
+    check("reduce-scatter" in texts["reduce_scatter"],
+          "psum_scatter lowers to a reduce-scatter HLO op")
+    check(ar["max_allreduce_result"] >= hist_bytes,
+          f"allreduce program broadcasts the full histogram "
+          f"({ar['max_allreduce_result']:.0f} >= {hist_bytes} B)")
+    check(rs["max_allreduce_result"] < hist_bytes,
+          f"full-histogram broadcast ABSENT from the reduce_scatter "
+          f"program (largest all-reduce {rs['max_allreduce_result']:.0f}"
+          f" < {hist_bytes} B)")
+    check(rs["total"] < ar["total"],
+          f"per-program collective wire bytes drop "
+          f"({rs['total']:.0f} < {ar['total']:.0f})")
+
+    took = time.perf_counter() - T_START
+    check(took < BUDGET_SEC, f"within the {BUDGET_SEC:.0f}s budget")
+    print(f"comms_smoke: PASS ({took:.1f}s)")
+
+
+if __name__ == "__main__":
+    main()

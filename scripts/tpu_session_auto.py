@@ -567,6 +567,52 @@ def _stages() -> int:
                    "cut short; headlines landed)")
         return 3
 
+    # ---- stage 4.8 (ISSUE 12): histogram-collective A/B + the
+    # TUNED.json hist_reduce re-learn. Two end-to-end data-parallel
+    # arms at the 1M depth-10 shape differing ONLY in tpu_hist_reduce;
+    # every BENCH record carries the engine's resolved collective
+    # (bench.py hist_reduce field), and the write REQUIRES both arms to
+    # have attributed to their requested mode — a 1-core window remaps
+    # tree_learner=data to serial (hist_reduce "n/a") and two identical
+    # programs must never tune the cache. Same 3% noise margin as
+    # pick_flips; allreduce is the incumbent.
+    hr_arms = {}
+    hr_attr = {}
+    hr_window_closed = False
+    for hr in ("allreduce", "reduce_scatter"):
+        res = run_bench(f"ab_hist_reduce_{hr}", 1_000_000, 15,
+                        {"max_depth": 10, "tree_learner": "data",
+                         "tpu_hist_reduce": hr},
+                        scheds="compact")
+        hr_arms[hr] = value(res)
+        hr_attr[hr] = (res or {}).get("hist_reduce", "unknown")
+        if guard(res):
+            hr_window_closed = True
+            break
+    hr_attributed = (hr_attr.get("allreduce") == "allreduce" and
+                     hr_attr.get("reduce_scatter") == "reduce_scatter")
+    if (hr_attributed and hr_arms.get("allreduce", 0) > 0 and
+            hr_arms.get("reduce_scatter", 0) >
+            hr_arms["allreduce"] * 1.03):
+        sys.path.insert(0, REPO)
+        from lightgbm_tpu import tuned
+        restore_tuned()
+        tuned.reload()
+        path = tuned.write({"hist_reduce": "reduce_scatter"})
+        say(f"hist_reduce=reduce_scatter written to {path} "
+            f"({hr_arms['reduce_scatter']:.3f} vs allreduce "
+            f"{hr_arms['allreduce']:.3f} it/s)")
+    else:
+        say(f"hist_reduce stays allreduce (arms {hr_arms}, "
+            f"attribution {hr_attr})")
+    STATE["hist_reduce_ab"] = dict(hr_arms, attribution=hr_attr)
+    dump_state()
+    if hr_window_closed:
+        say("window closed during the hist-reduce A/B — bailing")
+        git_commit("bench_logs: partial session (hist-reduce A/B cut "
+                   "short; headlines landed)")
+        return 3
+
     # ---- stage 5: leaves ladder at 1M (fixed-cost curve for the
     # runbook) runs BEFORE the 10.5M stage: the big shape's compiles
     # through the remote-compile tunnel are pathological (a 31-leaf

@@ -72,6 +72,27 @@ def test_hybrid_pallas_level_steady_state_compile_budget(compile_budget):
             booster.update()
 
 
+def test_reduce_scatter_steady_state_compile_budget(compile_budget):
+    """The reduce-scatter histogram collective (ISSUE 12) under the
+    data-parallel learner: 5 post-warmup iterations stay within the
+    same 2-compile budget — the feature-window slice indices and the
+    psum_scatter padding are static inside the one jitted grow program,
+    so neither the per-device window math nor the packed-record combine
+    may respecialize per tree."""
+    X, y = _data()
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "tree_learner": "data", "tpu_num_devices": 2,
+              "tpu_hist_reduce": "reduce_scatter",
+              "use_quantized_grad": True}
+    booster = lgb.Booster(params, lgb.Dataset(X, label=y))
+    assert booster._engine._hist_reduce == "reduce_scatter"
+    for _ in range(3):  # warmup: trace + compile the training programs
+        booster.update()
+    with compile_budget(2, "train_one_iter x5 [data/reduce_scatter]"):
+        for _ in range(5):
+            booster.update()
+
+
 def _grower_compiled_text(make, cfg_kw):
     """Compile a grower at a tiny CPU geometry; return optimized HLO."""
     import re
