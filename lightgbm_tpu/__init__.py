@@ -74,6 +74,10 @@ def __getattr__(name):
     if name in ("LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"):
         from . import sklearn as _sk
         return getattr(_sk, name)
+    if name in ("FleetServer", "ModelServer", "TenantHandle",
+                "serve_fleet"):
+        from . import serving as _srv
+        return getattr(_srv, name)
     if name in ("plot_importance", "plot_metric", "plot_tree",
                 "create_tree_digraph", "plot_split_value_histogram"):
         from . import plotting as _pl

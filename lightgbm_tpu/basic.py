@@ -819,7 +819,7 @@ class Booster:
         self._engine.rollback_one_iter()
         return self
 
-    def serve(self, **kwargs) -> "ModelServer":
+    def serve(self, fleet=None, tenant=None, **kwargs) -> "ModelServer":
         """Start a concurrent model server over this booster (ISSUE 8/9,
         serving/server.py): a dynamic micro-batcher coalesces concurrent
         ``submit()`` requests into the packed-forest engine's compiled
@@ -835,9 +835,46 @@ class Booster:
         from the ``tpu_serving_*`` params; kwargs (``max_batch``,
         ``linger_ms``, ``num_devices``, ``queue_depth``, ``raw_score``,
         ``bucket``, ``deadline_ms``, ``max_queue_rows``,
-        ``retry_policy``, ``probe_interval_s``) override."""
+        ``retry_policy``, ``probe_interval_s``) override.
+
+        Multi-tenant fleet serving (ISSUE 13): ``serve(fleet=server)``
+        registers this booster as one TENANT of an existing
+        :class:`FleetServer` (``tenant=`` names it; default
+        ``tenant<N>``) and returns a :class:`TenantHandle` — one shared
+        dispatcher, device arena and trace budget for the whole fleet
+        instead of a server per model. Per-tenant kwargs there:
+        ``deadline_ms``, ``quota_rows``, ``raw_score``.
+
+        A booster has at most ONE live solo server: calling ``serve()``
+        again while one is open returns the live server (kwarg-less
+        call) or refuses loudly (a kwarg'd call cannot be honored
+        without a second dispatcher over the same pack — the bug class
+        this guard exists to kill). A closed server is replaced."""
+        if fleet is not None:
+            if tenant is None:
+                # probe for a free default name: len() alone collides
+                # once any tenant was removed
+                i = len(fleet.tenants)
+                while f"tenant{i}" in fleet.tenants:
+                    i += 1
+                tenant = f"tenant{i}"
+            return fleet.add_tenant(tenant, self, **kwargs)
+        live = getattr(self, "_live_server", None)
+        if live is not None and not live.closed:
+            if kwargs:
+                raise LightGBMError(
+                    "this Booster already has a live ModelServer; a "
+                    "second serve() with different knobs would spawn a "
+                    "second dispatcher thread over the same pack. Use "
+                    "the existing server (serve() with no kwargs "
+                    "returns it) or close() it first.")
+            log.warning("serve(): returning this Booster's live "
+                        "ModelServer (one dispatcher per booster)")
+            return live
         from .serving import ModelServer
-        return ModelServer(self, **kwargs)
+        srv = ModelServer(self, **kwargs)
+        self._live_server = srv
+        return srv
 
     @property
     def current_iteration(self):
@@ -1345,8 +1382,11 @@ class Booster:
     # handle, exactly like the reference) ------------------------------
     def __getstate__(self):
         state = self.__dict__.copy()
+        # _live_server (ISSUE 13): a ModelServer holds locks, a queue
+        # and a dispatcher thread — unpicklable and meaningless in a
+        # copy; the unpickled booster simply has no live server
         for heavy in ("_engine", "train_set", "valid_sets",
-                      "_train_metrics"):
+                      "_train_metrics", "_live_server"):
             state.pop(heavy, None)
         state["_model_str"] = (self.model_to_string()
                                if self._engine is not None else None)

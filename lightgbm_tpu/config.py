@@ -50,6 +50,11 @@ _CHOICES: Dict[str, Tuple[str, ...]] = {
     # SyncUpGlobalBestSplit). auto = allreduce unless the tuned cache
     # recorded a measured reduce_scatter win (allreduce incumbent).
     "tpu_hist_reduce": ("auto", "allreduce", "reduce_scatter"),
+    # fleet serving placement (serving/fleet.py, ISSUE 13): replicate
+    # packs + row-shard requests (small fleets) vs shard the model
+    # axis with batches routed to each bucket's owner device (big
+    # fleets); auto decides by pack bytes vs the per-device budget.
+    "tpu_serving_fleet_shard": ("auto", "replicate", "model"),
 }
 
 
@@ -367,6 +372,27 @@ _reg("tpu_serving_max_queue_rows", int, 1_048_576, (),
 # probe — degradation then sticks until the server closes.
 _reg("tpu_serving_probe_interval_s", float, 5.0, (),
      (0.0, None, True, False))
+# multi-tenant fleet serving (serving/fleet.py, ISSUE 13). fleet_shard
+# selects the placement of the capacity-bucketed mega-packs over the
+# serving mesh: "replicate" copies every bucket's pack to every device
+# and row-shards request batches (the small-fleet layout); "model"
+# shards the MODEL axis — each shape bucket's pack lives on ONE owner
+# device and its coalesced batches are routed there (SNIPPETS [3]
+# MODEL_SHARDING; the big-fleet layout when the packs no longer fit
+# replicated). "auto" picks by total pack bytes vs the per-device
+# budget below.
+_reg("tpu_serving_fleet_shard", str, "auto", ())
+# per-device pack budget (MB) for the auto decision above: a fleet
+# whose mega-packs total under this replicates; past it, buckets are
+# model-sharded across the mesh.
+_reg("tpu_serving_fleet_pack_budget_mb", float, 256.0, (),
+     (0.0, None, False, False))
+# per-tenant admission quota: once a tenant has this many ROWS queued,
+# ITS submits shed with OVERLOADED (backlog-only, like
+# tpu_serving_max_queue_rows) while other tenants keep submitting —
+# one noisy tenant cannot starve the fleet. 0 = no per-tenant quota
+# (the fleet-wide row bound still applies).
+_reg("tpu_serving_fleet_quota_rows", int, 0, (), (0, None, True, False))
 # device tracing (SURVEY §5 tracing: jax.profiler traces + the named-
 # section wall-clock table ≡ the reference's USE_TIMETAG global_timer).
 # Set to a directory to capture a jax.profiler trace of the training loop

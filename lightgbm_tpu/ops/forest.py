@@ -30,9 +30,10 @@ from typing import List, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
-from .predict import (RawTreeArrays, depth_steps, forest_leaf_bins,
-                      tree_leaf_raw)
+from .predict import (RawTreeArrays, depth_steps, fleet_leaf_bins,
+                      fleet_leaf_raw, forest_leaf_bins, tree_leaf_raw)
 from .split import MISSING_ENUM
 from ..robustness import faults
 from ..core.tree import HostTree, TreeArrays, host_tree_to_arrays, \
@@ -404,6 +405,22 @@ class RawForestPack(_IncrementalPack):
 # (num_steps, k_trees) are static, shapes key the rest
 # ---------------------------------------------------------------------------
 
+def _accumulate_iters(outs, k_trees):
+    """Per-channel SEQUENTIAL f32 accumulation of [T, R] per-tree leaf
+    values: acc[c] += outs[i*k + c] in iteration order, starting from
+    exact zeros. Deliberately NOT ``.sum(axis=0)``: an XLA tree-reduce
+    associates by SHAPE, so a fleet window padded to a capacity bucket
+    could never reproduce the unpadded sum bit-exactly. A fixed
+    sequential order can — the fleet scorer performs the identical f32
+    add sequence per (row, channel) with padded slots masked out, which
+    is what makes per-tenant fleet responses bit-identical to each
+    tenant's own ``predict_device`` (ISSUE 13 acceptance)."""
+    t = outs.shape[0]
+    outs = outs.reshape(t // k_trees, k_trees, -1)
+    return lax.fori_loop(0, outs.shape[0], lambda i, a: a + outs[i],
+                         jnp.zeros_like(outs[0]))
+
+
 @partial(jax.jit, static_argnums=(0, 1))
 def _forest_scores_binned(num_steps, k_trees, packed, bins_t):
     def one(p):
@@ -411,9 +428,7 @@ def _forest_scores_binned(num_steps, k_trees, packed, bins_t):
                                 num_steps=num_steps)
         return p.tree.leaf_value[leaf]
 
-    outs = jax.vmap(one)(packed)
-    t = outs.shape[0]
-    return outs.reshape(t // k_trees, k_trees, -1).sum(axis=0)
+    return _accumulate_iters(jax.vmap(one)(packed), k_trees)
 
 
 @partial(jax.jit, static_argnums=(0, 1))
@@ -422,9 +437,64 @@ def _forest_scores_raw(num_steps, k_trees, stacked, x_dev):
         leaf = tree_leaf_raw(tr, x_dev, num_steps=num_steps)
         return tr.leaf_value[leaf]
 
-    outs = jax.vmap(one)(stacked)
-    t = outs.shape[0]
-    return outs.reshape(t // k_trees, k_trees, -1).sum(axis=0)
+    return _accumulate_iters(jax.vmap(one)(stacked), k_trees)
+
+
+# ---------------------------------------------------------------------------
+# fleet scorers (ISSUE 13): one program serves rows of MANY tenants — each
+# row r traverses its own tenant's window [lo[r], lo[r]+win_slots) of a
+# shared capacity-bucketed mega-pack; slots past n_live[r] are masked out
+# of the accumulation WITHOUT touching the partial sum (a bit-preserving
+# skip — ``where`` keeps acc, never adds a +0.0 that could flip -0.0).
+# Accumulation order per (row, channel) is exactly _accumulate_iters'
+# sequential order, so a tenant's fleet response is bit-identical to its
+# own predict_device.
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def _fleet_scores_binned(num_steps, k_trees, win_slots, packed, lo,
+                         n_live, bins_t):
+    """[k, R] f32 raw scores for one coalesced multi-tenant batch.
+    packed: stacked PackedTree [T_total, ...] (the bucket mega-pack);
+    lo/n_live: i32 [R] per-row window start / live tree count;
+    bins_t: [F, R] bins in each row's own tenant layout."""
+    R = bins_t.shape[1]
+
+    def body(i, acc):
+        for c in range(k_trees):
+            slot = i * k_trees + c
+            tid = lo + slot
+            leaf = fleet_leaf_bins(packed.tree, packed.special,
+                                   packed.flip, tid, bins_t,
+                                   num_steps=num_steps)
+            v = packed.tree.leaf_value[tid, leaf]
+            acc = acc.at[c].set(
+                jnp.where(slot < n_live, acc[c] + v, acc[c]))
+        return acc
+
+    return lax.fori_loop(0, max(win_slots // k_trees, 0), body,
+                         jnp.zeros((k_trees, R), jnp.float32))
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def _fleet_scores_raw(num_steps, k_trees, win_slots, stacked, lo,
+                      n_live, x_dev):
+    """Raw-route counterpart of ``_fleet_scores_binned``; x_dev [R, C]."""
+    R = x_dev.shape[0]
+
+    def body(i, acc):
+        for c in range(k_trees):
+            slot = i * k_trees + c
+            tid = lo + slot
+            leaf = fleet_leaf_raw(stacked, tid, x_dev,
+                                  num_steps=num_steps)
+            v = stacked.leaf_value[tid, leaf]
+            acc = acc.at[c].set(
+                jnp.where(slot < n_live, acc[c] + v, acc[c]))
+        return acc
+
+    return lax.fori_loop(0, max(win_slots // k_trees, 0), body,
+                         jnp.zeros((k_trees, R), jnp.float32))
 
 
 class ForestSnapshot(NamedTuple):
@@ -480,6 +550,119 @@ def snapshot_scores(snap: ForestSnapshot, X: np.ndarray,
     # would trace a new dynamic_slice program per distinct r —
     # exactly the retrace the bucketing exists to avoid
     return np.asarray(out, np.float64)[:, :r]
+
+
+# ---------------------------------------------------------------------------
+# fleet capacity bucketing (ISSUE 13): tenants are grouped into shape
+# buckets so a hundred mixed-shape models never all pad to the global
+# max — each bucket holds one stacked mega-pack and every tenant inside
+# it owns a fixed window of ``win_slots`` tree slots (unused slots are
+# zero trees, masked out of the accumulation). The bucket key is fully
+# determined by the tenant's shape, so the compiled-program family is
+# keyed by SHAPE DIVERSITY, never by fleet size.
+# ---------------------------------------------------------------------------
+
+def pow2_cap(n: int, lo: int = 1) -> int:
+    """Smallest power of two >= max(n, lo) — the capacity-bucket rule
+    shared by leaf caps, feature caps and window slots."""
+    m = max(int(n), int(lo), 1)
+    return 1 << (m - 1).bit_length()
+
+
+class TenantShape(NamedTuple):
+    """Capacity-bucket key of one tenant model. Tenants with equal keys
+    share one mega-pack (and therefore one compiled-program family);
+    every field is a bucketed capacity, so near-miss shape drift across
+    a fleet collapses onto a handful of buckets."""
+    kind: str       # "binned" | "raw"
+    k: int          # trees per iteration (output channels)
+    steps: int      # static traversal bound (depth_steps, multiple of 4)
+    leaf_cap: int   # pow2 cap of num_leaves
+    feat_cap: int   # pow2 cap of the feature axis (used features for
+    #                 binned, original columns for raw)
+    win_slots: int  # per-tenant window capacity in tree slots (k * pow2)
+
+
+def tenant_shape(models: List[HostTree], k: int, n_features: int,
+                 kind: str) -> TenantShape:
+    """Bucket one tenant's model list. ``n_features`` is the length of
+    the feature axis its requests are laid out on (used-feature count
+    for the binned route, original column count for raw)."""
+    leaf_cap = pow2_cap(max([int(t.num_leaves) for t in models] + [2]), 4)
+    max_d = max(_host_depth(t, leaf_cap) for t in models)
+    steps = max(depth_steps(max_d, leaf_cap), 4)
+    k = max(int(k), 1)
+    iters = -(-len(models) // k)
+    return TenantShape(kind=kind, k=k, steps=steps, leaf_cap=leaf_cap,
+                       feat_cap=pow2_cap(n_features, 4),
+                       win_slots=k * pow2_cap(iters, 1))
+
+
+def _host_pytree(tree):
+    """Device pytree -> host numpy pytree (fleet packs assemble bucket
+    mega-packs on the HOST: one upload per rebuild, zero eager device
+    ops — a publish never traces anything)."""
+    # jaxlint: disable=JL001 — pack-time helper, never jit-traced: the
+    # device->host pull is the point (host-side bucket assembly)
+    return jax.tree.map(lambda a: np.asarray(a), tree)
+
+
+def pad_window(stacked_np, win_slots: int):
+    """Pad a host-stacked [T, ...] window to ``win_slots`` slots with
+    zero trees (num_leaves 0 -> traversal inactive, and the fleet
+    scorers mask dead slots out of the accumulation anyway)."""
+    leaves = jax.tree.leaves(stacked_np)
+    t = leaves[0].shape[0]
+    if t == win_slots:
+        return stacked_np
+    if t > win_slots:
+        raise ValueError(f"window of {t} trees exceeds its capacity "
+                         f"bucket ({win_slots} slots)")
+    return jax.tree.map(
+        lambda a: np.concatenate(
+            [a, np.zeros((win_slots - t,) + a.shape[1:], a.dtype)]),
+        stacked_np)
+
+
+def pack_window_binned(models: List[HostTree], mappers, shape: TenantShape,
+                       cat_width: int = 0):
+    """One tenant's binned window as a HOST numpy PackedTree
+    [win_slots, ...] at the bucket's leaf cap / cat width."""
+    fp = ForestPack(shape.leaf_cap)
+    fp._set_mappers(mappers)
+    packed = [fp._pack_tree(t) for t in models]
+    if cat_width or any(p.tree.cat_bins is not None for p in packed):
+        width = max([cat_width] + [p.tree.cat_bins.shape[1]
+                                   for p in packed
+                                   if p.tree.cat_bins is not None])
+        packed = [p._replace(tree=_with_cat_width(p.tree, width,
+                                                  shape.leaf_cap))
+                  for p in packed]
+    host = [_host_pytree(p) for p in packed]
+    stacked = jax.tree.map(lambda *xs: np.stack(xs), *host)
+    return pad_window(stacked, shape.win_slots)
+
+
+def pack_window_raw(models: List[HostTree], shape: TenantShape):
+    """One tenant's raw window as a HOST numpy RawTreeArrays
+    [win_slots, ...]; refuses unservable windows loudly."""
+    RawForestPack.check_servable(models)
+    host = [_host_pytree(_host_tree_to_raw(t, shape.leaf_cap))
+            for t in models]
+    stacked = jax.tree.map(lambda *xs: np.stack(xs), *host)
+    return pad_window(stacked, shape.win_slots)
+
+
+def window_cat_width(window_np) -> int:
+    """Cat-bin width of a packed binned window (0 = no cat fields)."""
+    cb = getattr(window_np, "tree", window_np).cat_bins
+    return 0 if cb is None else int(cb.shape[2])
+
+
+def pytree_nbytes(tree) -> int:
+    """Total bytes of a (host or device) pytree — the fleet's
+    replicate-vs-model-shard decision input."""
+    return int(sum(a.nbytes for a in jax.tree.leaves(tree)))
 
 
 class ServingEngine:

@@ -177,6 +177,53 @@ def forest_leaf_bins(tree: TreeArrays, special: jnp.ndarray,
     return leaf
 
 
+def fleet_leaf_bins(trees: TreeArrays, special: jnp.ndarray,
+                    flip: jnp.ndarray, tid: jnp.ndarray,
+                    bins_t: jnp.ndarray, num_steps: int = None
+                    ) -> jnp.ndarray:
+    """Per-row-tree binned traversal for multi-tenant fleet serving
+    (ISSUE 13): ``trees`` is a STACKED [T, ...] forest (one mega-pack
+    holding many tenants' windows), ``tid`` [R] names the tree each ROW
+    traverses — a coalesced batch of rows from different tenants walks
+    each row through its own tenant's tree in one program. Identical
+    per-row leaves to ``forest_leaf_bins`` on the single tree
+    ``trees[tid[r]]``: the only change is that every per-node gather is
+    a 2-D ``[tid, node]`` gather instead of a 1-D ``[node]`` gather.
+
+    bins_t: [F, R] bins (row r's columns laid out by ITS tenant's
+    used-feature order; F is the bucket's padded feature cap, trailing
+    rows unused by that tenant's trees). Returns i32 [R].
+    """
+    R = bins_t.shape[1]
+    steps = _resolve_steps(num_steps, None, trees.leaf_value.shape[1])
+    rr = jnp.arange(R)
+    node = jnp.zeros(R, jnp.int32)
+    leaf = jnp.zeros(R, jnp.int32)
+    active = trees.num_leaves[tid] > 1
+
+    def body(_, carry):
+        node, leaf, active = carry
+        f = trees.split_feature[tid, node]
+        b = bins_t[f, rr].astype(jnp.int32)
+        go_left = (b <= trees.threshold_bin[tid, node]) ^ \
+            ((b == special[tid, node]) & flip[tid, node])
+        if trees.cat_bins is not None:
+            in_set = jnp.any(trees.cat_bins[tid, node] == b[:, None],
+                             axis=1)
+            go_left = jnp.where(trees.cat_count[tid, node] > 0, in_set,
+                                go_left)
+        child = jnp.where(go_left, trees.left_child[tid, node],
+                          trees.right_child[tid, node])
+        hit_leaf = active & (child < 0)
+        leaf = jnp.where(hit_leaf, -(child + 1), leaf)
+        active = active & (child >= 0)
+        node = jnp.where(active, jnp.maximum(child, 0), node)
+        return node, leaf, active
+
+    node, leaf, active = lax.fori_loop(0, steps, body, (node, leaf, active))
+    return leaf
+
+
 class RawTreeArrays(NamedTuple):
     """One tree in raw-serving form: ORIGINAL column indices, real-valued
     thresholds and PER-NODE missing handling decoded from decision_type —
@@ -234,6 +281,47 @@ def tree_leaf_raw(tree: RawTreeArrays, X: jnp.ndarray,
         go_left = jnp.where(is_missing, dl, le)
         child = jnp.where(go_left, tree.left_child[node],
                           tree.right_child[node])
+        hit_leaf = active & (child < 0)
+        leaf = jnp.where(hit_leaf, -(child + 1), leaf)
+        active = active & (child >= 0)
+        node = jnp.where(active, jnp.maximum(child, 0), node)
+        return node, leaf, active
+
+    node, leaf, active = lax.fori_loop(0, steps, body, (node, leaf, active))
+    return leaf
+
+
+def fleet_leaf_raw(trees: RawTreeArrays, tid: jnp.ndarray,
+                   X: jnp.ndarray, num_steps: int = None) -> jnp.ndarray:
+    """Per-row-tree raw traversal for fleet serving (ISSUE 13): the
+    stacked-[T, ...] counterpart of ``tree_leaf_raw`` where ``tid`` [R]
+    selects each row's tree — identical per-row leaves to
+    ``tree_leaf_raw`` on ``trees[tid[r]]``. X: [R, C] f32 (row r's
+    columns in ITS tenant's original layout, C = bucket feature cap)."""
+    R = X.shape[0]
+    steps = _resolve_steps(num_steps, None, trees.leaf_value.shape[1])
+    rr = jnp.arange(R)
+    node = jnp.zeros(R, jnp.int32)
+    leaf = jnp.zeros(R, jnp.int32)
+    active = trees.num_leaves[tid] > 1
+
+    def body(_, carry):
+        node, leaf, active = carry
+        f = trees.split_feature[tid, node]
+        thr = trees.threshold[tid, node]
+        dl = trees.default_left[tid, node]
+        miss = trees.missing_type[tid, node]
+        x = X[rr, f]
+        isnan = jnp.isnan(x)
+        x0 = jnp.where(isnan, jnp.float32(0.0), x)
+        le = x0 <= thr
+        is_missing = jnp.where(
+            miss == MISSING_ENUM["nan"], isnan,
+            (miss == MISSING_ENUM["zero"]) &
+            (jnp.abs(x0) <= jnp.float32(K_ZERO_THRESHOLD_F32)))
+        go_left = jnp.where(is_missing, dl, le)
+        child = jnp.where(go_left, trees.left_child[tid, node],
+                          trees.right_child[tid, node])
         hit_leaf = active & (child < 0)
         leaf = jnp.where(hit_leaf, -(child + 1), leaf)
         active = active & (child >= 0)

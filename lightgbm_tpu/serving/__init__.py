@@ -6,18 +6,29 @@ the failure path that makes it survivable: request deadlines, fail-fast
 admission control, retry-then-degrade dispatch with background
 recovery, and publish rollback.
 
-Entry point: ``Booster.serve(...)`` -> :class:`ModelServer`.
+Multi-tenant fleet serving (ISSUE 13) rides the same machinery: ONE
+:class:`FleetServer` hosts hundreds of boosters on a shared device
+arena — capacity-bucketed mega-packs with a tenant->window routing
+table, cross-tenant batch coalescing whose trace budget is flat in
+fleet size, per-tenant deadlines/quotas/counters and atomic per-tenant
+hot-swap (serving/fleet.py).
+
+Entry points: ``Booster.serve(...)`` -> :class:`ModelServer`;
+``serve_fleet({name: booster})`` / ``Booster.serve(fleet=...)`` ->
+:class:`FleetServer` / :class:`TenantHandle`.
 """
 from .batcher import (DeadlineExceeded, MicroBatcher, Overloaded,
                       PendingRequest, ShutdownError)
+from .fleet import FleetServer, TenantHandle, serve_fleet
 from .mesh import SERVE_AXIS, probe, serving_mesh, shard_rows
 from .metrics import (LatencyRecorder, ServingCounters,
                       latency_summary_ms, percentile)
-from .server import Generation, ModelServer
+from .server import DegradeControl, Generation, ModelServer
 
 __all__ = [
-    "DeadlineExceeded", "Generation", "LatencyRecorder", "MicroBatcher",
-    "ModelServer", "Overloaded", "PendingRequest", "SERVE_AXIS",
-    "ServingCounters", "ShutdownError", "latency_summary_ms",
-    "percentile", "probe", "serving_mesh", "shard_rows",
+    "DeadlineExceeded", "DegradeControl", "FleetServer", "Generation",
+    "LatencyRecorder", "MicroBatcher", "ModelServer", "Overloaded",
+    "PendingRequest", "SERVE_AXIS", "ServingCounters", "ShutdownError",
+    "TenantHandle", "latency_summary_ms", "percentile", "probe",
+    "serve_fleet", "serving_mesh", "shard_rows",
 ]

@@ -86,11 +86,17 @@ class PendingRequest:
 
     __slots__ = ("X", "n", "t_enq", "t_done", "deadline", "_event",
                  "_value", "_error", "_settle_lock", "_settled",
-                 "generation")
+                 "generation", "tenant")
 
-    def __init__(self, X: np.ndarray, deadline_sec: Optional[float] = None):
+    def __init__(self, X: np.ndarray, deadline_sec: Optional[float] = None,
+                 tenant: Optional[str] = None):
         self.X = X
         self.n = X.shape[0]
+        # fleet serving (ISSUE 13): the tenant whose model serves this
+        # request; None on a single-model server. Set at construction —
+        # BEFORE the request is visible to the dispatcher — so routing
+        # and per-tenant accounting never race the enqueue.
+        self.tenant = tenant
         self.t_enq = time.perf_counter()
         self.t_done: Optional[float] = None
         self.deadline = (None if deadline_sec is None
@@ -167,10 +173,19 @@ class MicroBatcher:
     def __init__(self, dispatch: Callable, max_batch: int = 4096,
                  linger_ms: float = 2.0, queue_depth: int = 8192,
                  max_queue_rows: int = 0,
-                 counters: Optional[ServingCounters] = None):
+                 counters: Optional[ServingCounters] = None,
+                 grouped: bool = False):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.dispatch = dispatch
+        # grouped mode (fleet serving, ISSUE 13): ``dispatch(batch)``
+        # receives the coalesced REQUEST LIST (the callee groups by
+        # tenant shape bucket, concatenates per group and slices back)
+        # and returns one outcome per request in order — either a
+        # ``(values, generation)`` pair or a BaseException. A failure
+        # settles only ITS request: one tenant's bad batch never fails
+        # rows it merely shared a pop with.
+        self.grouped = bool(grouped)
         self.max_batch = int(max_batch)
         self.linger_sec = max(float(linger_ms), 0.0) / 1e3
         self.max_queue_rows = int(max_queue_rows)
@@ -185,9 +200,11 @@ class MicroBatcher:
         # queue while the dispatcher is wedged, defeating the very
         # drain contract it exists to enforce
         self._submit_lock = threading.Lock()
-        # row/queue accounting (admission control + dispatcher)
+        # row/queue accounting (admission control + dispatcher);
+        # _tqrows is the per-tenant backlog for fleet admission quotas
         self._rows_lock = threading.Lock()
         self._qrows = 0
+        self._tqrows = {}
         # submits past the closed check but not yet enqueued: the
         # dispatcher's closed-and-empty exit ALSO waits for these, so
         # "accepted => will be answered" holds without holding the
@@ -213,31 +230,48 @@ class MicroBatcher:
 
     # client side ------------------------------------------------------
     def submit(self, X: np.ndarray,
-               deadline_sec: Optional[float] = None) -> PendingRequest:
+               deadline_sec: Optional[float] = None,
+               tenant: Optional[str] = None,
+               max_tenant_rows: int = 0) -> PendingRequest:
         """Enqueue one request (blocks on a full queue — backpressure,
         not unbounded buffering). With ``max_queue_rows`` set, fails
         fast with :class:`Overloaded` instead of blocking once that
-        many rows are waiting. Raises after close()."""
+        many rows are waiting; ``max_tenant_rows`` applies the same
+        backlog-only shed rule to THIS tenant's queued rows (the fleet
+        per-tenant admission quota — one noisy tenant sheds against its
+        own backlog while its neighbors keep submitting). Raises after
+        close()."""
         if X.ndim != 2 or X.shape[0] == 0:
             raise ValueError("requests must be non-empty [rows, features] "
                              "matrices")
-        req = PendingRequest(X, deadline_sec)
+        req = PendingRequest(X, deadline_sec, tenant=tenant)
         with self._submit_lock:
             if self._closed:
                 raise RuntimeError("serving batcher is closed")
             with self._rows_lock:
                 depth = self._qrows
+                tdepth = self._tqrows.get(tenant, 0) \
+                    if tenant is not None else 0
                 # shed only on BACKLOG: a request bigger than the bound
                 # is still admitted on an empty queue (it would
                 # otherwise be unservable at any load level)
                 if self.max_queue_rows and depth and \
                         depth + req.n > self.max_queue_rows:
-                    self.counters.inc("shed")
+                    self.counters.inc("shed", tenant=tenant)
                     raise Overloaded(
                         f"OVERLOADED: serving queue holds {depth} rows "
                         f"(max_queue_rows={self.max_queue_rows}); request "
                         f"of {req.n} rows shed — retry with backoff")
+                if max_tenant_rows and tdepth and \
+                        tdepth + req.n > max_tenant_rows:
+                    self.counters.inc("shed", tenant=tenant)
+                    raise Overloaded(
+                        f"OVERLOADED: tenant {tenant!r} holds {tdepth} "
+                        f"queued rows (quota {max_tenant_rows}); request "
+                        f"of {req.n} rows shed — retry with backoff")
                 self._qrows += req.n
+                if tenant is not None:
+                    self._tqrows[tenant] = tdepth + req.n
                 self._submitting += 1
         enqueued = False
         try:
@@ -255,6 +289,8 @@ class MicroBatcher:
                     # queue, so roll the accounting back or admission
                     # control sheds against phantom backlog forever
                     self._qrows -= req.n
+                    if tenant is not None:
+                        self._tqrows[tenant] -= req.n
         return req
 
     def close(self, timeout: Optional[float] = 30.0) -> None:
@@ -300,9 +336,9 @@ class MicroBatcher:
                 continue
             if got is _SENTINEL:
                 continue
-            with self._rows_lock:
-                self._qrows -= got.n
+            self._pop_rows(got)
             if got._fail(err):
+                self.counters.inc("shutdown_failed", tenant=got.tenant)
                 failed += 1
         # the batch the stuck dispatcher holds (carry is dispatcher-owned
         # state; reading it here is racy only against a dispatcher that
@@ -317,9 +353,9 @@ class MicroBatcher:
             pending.append(carry)
         for r in pending:
             if r._fail(err):
+                self.counters.inc("shutdown_failed", tenant=r.tenant)
                 failed += 1
         if failed:
-            self.counters.inc("shutdown_failed", failed)
             log.warning(f"serving shutdown abandoned by dispatcher: "
                         f"failed {failed} still-pending request(s) with "
                         "SHUTDOWN after the drain timeout")
@@ -337,13 +373,26 @@ class MicroBatcher:
                 f"{waited:.1f} ms (deadline was "
                 f"{(req.deadline - req.t_enq) * 1e3:.1f} ms); dropped "
                 "before coalescing")):
-            self.counters.inc("expired")
+            self.counters.inc("expired", tenant=req.tenant)
         return True
+
+    def _pop_rows(self, got: PendingRequest) -> None:
+        """Release one popped request's rows from the queue accounting
+        (global + per-tenant quota). Drained tenants drop out of the
+        dict — a churning fleet must not accumulate one zeroed entry
+        per historical tenant forever."""
+        with self._rows_lock:
+            self._qrows -= got.n
+            if got.tenant is not None:
+                left = self._tqrows.get(got.tenant, 0) - got.n
+                if left > 0:
+                    self._tqrows[got.tenant] = left
+                else:
+                    self._tqrows.pop(got.tenant, None)
 
     def _take(self, got: PendingRequest) -> Optional[PendingRequest]:
         """Account one freshly-popped request and apply its deadline."""
-        with self._rows_lock:
-            self._qrows -= got.n
+        self._pop_rows(got)
         return None if self._expire(got) else got
 
     def _gather(self) -> Optional[List[PendingRequest]]:
@@ -415,10 +464,18 @@ class MicroBatcher:
                 # dispatch (see close())
                 for r in batch:
                     if r._fail(abandoned):
-                        self.counters.inc("shutdown_failed")
+                        self.counters.inc("shutdown_failed",
+                                          tenant=r.tenant)
                 continue
             with self._rows_lock:
                 self._inflight = batch
+            if self.grouped:
+                self._run_grouped(batch)
+                with self._rows_lock:
+                    self._inflight = []
+                self.n_batches += 1
+                self.max_coalesced = max(self.max_coalesced, len(batch))
+                continue
             try:
                 X = batch[0].X if len(batch) == 1 else \
                     np.concatenate([r.X for r in batch], axis=0)
@@ -450,6 +507,39 @@ class MicroBatcher:
             self.n_batches += 1
             self.max_coalesced = max(self.max_coalesced, len(batch))
 
+    def _run_grouped(self, batch: List[PendingRequest]) -> None:
+        """Fleet-mode dispatch of one coalesced batch: the callee
+        returns one outcome PER REQUEST (a ``(values, generation)``
+        pair or a BaseException), so one tenant's failure settles only
+        its own requests — cross-tenant isolation at the batch level.
+        A dispatch that raises outright (or returns a malformed result
+        list) still fails the whole batch, like the ungrouped path."""
+        try:
+            results = self.dispatch(batch)
+            if len(results) != len(batch):
+                raise RuntimeError(
+                    f"grouped dispatch returned {len(results)} outcomes "
+                    f"for {len(batch)} requests")
+        except BaseException as e:          # noqa: BLE001 — relayed
+            for r in batch:
+                if r._fail(e):
+                    self.n_errors += 1
+            return
+        for r, res in zip(batch, results):
+            if isinstance(res, BaseException):
+                if r._fail(res):
+                    self.n_errors += 1
+                continue
+            values, generation = res
+            if r._fulfill(values, generation):
+                self.n_requests += 1
+                self.n_rows += r.n
+                if r.tenant is not None:
+                    self.counters.inc_tenant(r.tenant, "requests")
+                    self.counters.inc_tenant(r.tenant, "rows", r.n)
+                if r.latency_sec is not None:
+                    self.latency.record(r.latency_sec)
+
     def stats(self) -> dict:
         s = {"requests": self.n_requests, "rows": self.n_rows,
              "batches": self.n_batches, "errors": self.n_errors,
@@ -464,4 +554,7 @@ class MicroBatcher:
                                              1)
         s.update(self.counters.snapshot())
         s.update(self.latency.summary_ms())
+        tenants = self.counters.tenant_snapshot()
+        if tenants:
+            s["tenants"] = tenants
         return s

@@ -1,0 +1,783 @@
+"""Multi-tenant fleet serving (ISSUE 13): ONE server, hundreds of
+boosters.
+
+Production inference is never one model — it is per-country /
+per-surface / A-B fleets. Before this module, each ``Booster.serve()``
+owned its own dispatcher thread, device arena and compiled traces: 200
+models meant 200 packs and zero cross-model batching. ``FleetServer``
+hosts a model FLEET on one shared device arena:
+
+- **tenant -> window routing table over capacity-bucketed mega-packs**:
+  tenants are grouped into shape buckets keyed by
+  ``ops/forest.TenantShape`` (kind, k, depth steps, pow2 caps of
+  leaves/features/window slots). Each bucket holds ONE stacked device
+  forest; every tenant inside it owns a fixed window of ``win_slots``
+  tree slots. A hundred mixed-shape models never all pad to the global
+  max — padding is bounded per bucket by the pow2 rule.
+- **cross-tenant batch coalescing**: the micro-batcher coalesces
+  requests ACROSS tenants; the dispatcher groups a popped batch by
+  shape bucket and scores each group in one jitted program
+  (``ops/forest._fleet_scores_*``) where a per-row tenant-id gather
+  selects each row's forest window. Programs are keyed by
+  (shape bucket, row bucket) only, so the steady-state trace count is
+  **flat in fleet size** — it tracks shape DIVERSITY, and a
+  single-shape fleet of any size compiles exactly the single-model
+  program family.
+- **bit-exactness**: each row's window accumulates sequentially with
+  dead slots masked out bit-preservingly, reproducing
+  ``predict_device``'s f32 add sequence exactly — a tenant's fleet
+  response is bit-identical to its own direct device predict. Request
+  binning runs on the HOST with each tenant's own BinMapper
+  (``value_to_bin``), which is the exactness oracle the device binner
+  is proven against.
+- **per-tenant failure domain** (rides the PR8/PR9 machinery): each
+  tenant gets its own deadline default, admission quota
+  (``max_tenant_rows`` backlog shed), counters
+  (``ServingCounters.tenant_snapshot``) and ATOMIC ``publish()`` — a
+  tenant's hot-swap builds a whole new immutable fleet state and swaps
+  one reference; a failed publish (injected ``publish_fail``, real
+  OOM) leaves every tenant serving exactly what it served before.
+- **two placement modes** (SNIPPETS [3] ``MODEL_SHARDING`` /
+  ``HYBRID_SHARDING``): small fleets REPLICATE every mega-pack over
+  the serving mesh and shard request rows (today's layout); big fleets
+  shard the MODEL axis — each bucket's pack lives on one owner device
+  and its batches are routed there. ``tpu_serving_fleet_shard``
+  selects (auto = by total pack bytes vs the per-device budget).
+
+Entry points: ``lightgbm_tpu.serve_fleet({name: booster, ...})`` and
+``Booster.serve(fleet=server, tenant=name)``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import mesh as mesh_mod
+from .batcher import MicroBatcher, PendingRequest
+from .metrics import ServingCounters
+from .server import (DegradeControl, Generation, finish_scores,
+                     host_walk_scores)
+from ..ops import forest
+from ..ops.forest import TenantShape
+from ..robustness import faults
+from ..robustness.retry import (RetryError, RetryPolicy, SERVING_POLICY,
+                                retry_call)
+from ..utils import log
+
+
+class TenantRoute(NamedTuple):
+    """Immutable routing-table entry for one tenant inside one fleet
+    state: where its window lives (``key``/``lo``) and everything a
+    dispatch needs to serve or host-walk its rows without touching the
+    mutable tenant registry."""
+    name: str
+    key: TenantShape
+    lo: int                   # absolute first tree slot in the bucket pack
+    n_trees: int              # live trees inside the window
+    k: int                    # output channels (trees per iteration)
+    mappers: Optional[tuple]  # binned route: the tenant's BinMappers
+    used: Optional[np.ndarray]  # binned route: original column per mapper
+    n_features: int           # request width (original columns)
+    models: tuple             # host trees — the degraded-walk route
+    objective: object
+    average_output: bool
+    raw_score: bool
+    generation: Generation
+
+
+class _Bucket(NamedTuple):
+    """One shape bucket's device state: the stacked mega-pack, capacity
+    bookkeeping and the model-shard owner (None = replicated /
+    row-sharded). Rebuilds re-assemble from the per-tenant window
+    caches, so no host copy is retained here."""
+    key: TenantShape
+    dev: object               # device pytree [slot_cap * win_slots, ...]
+    members: Tuple[str, ...]  # tenant names, slot order
+    slot_cap: int
+    nbytes: int
+    device: object            # owner device or None
+
+
+class _FleetState(NamedTuple):
+    """The whole fleet's immutable serving state. ``FleetServer``
+    publishes by building a NEW state and swapping one reference —
+    in-flight dispatches finish on the state they started with, so one
+    tenant's hot-swap can neither tear nor stall another tenant's
+    responses."""
+    buckets: Dict[TenantShape, _Bucket]
+    routes: Dict[str, TenantRoute]
+    shard: str                # resolved "replicate" | "model"
+
+
+class _Tenant:
+    """Mutable per-tenant registry entry (guarded by the publish
+    lock): the engine handle, knobs, publish version and the cached
+    packed window."""
+
+    def __init__(self, name, booster, engine, deadline_ms, quota_rows,
+                 raw_score):
+        self.name = name
+        self.booster = booster
+        self.engine = engine
+        self.k = max(int(engine.num_tree_per_iteration), 1)
+        self.n_features = int(getattr(engine, "max_feature_idx", 0)) + 1
+        self.deadline_ms = float(deadline_ms)
+        self.quota_rows = int(quota_rows)
+        self.raw_score = bool(raw_score)
+        self.raw_route = engine.serving_state()[2] is None
+        self.version = 0
+        # window cache: (model_gen, n_trees, shape, cat_width) -> np pytree
+        self._win_token = None
+        self._win = None
+
+
+class TenantHandle:
+    """Per-tenant facade over a :class:`FleetServer` — what
+    ``Booster.serve(fleet=...)`` and ``FleetServer.add_tenant`` return.
+    ``submit``/``predict``/``publish``/``stats`` scope every operation
+    to this tenant; ``close()`` removes the tenant from the fleet
+    (other tenants keep serving)."""
+
+    def __init__(self, fleet: "FleetServer", name: str):
+        self.fleet = fleet
+        self.name = name
+
+    def submit(self, X, deadline_ms: Optional[float] = None
+               ) -> PendingRequest:
+        return self.fleet.submit(self.name, X, deadline_ms=deadline_ms)
+
+    def predict(self, X, timeout: Optional[float] = None) -> np.ndarray:
+        return self.fleet.predict(self.name, X, timeout=timeout)
+
+    def publish(self) -> Generation:
+        return self.fleet.publish(self.name)
+
+    @property
+    def generation(self) -> Generation:
+        return self.fleet._state.routes[self.name].generation
+
+    def stats(self) -> dict:
+        return self.fleet.tenant_stats(self.name)
+
+    def close(self) -> None:
+        self.fleet.remove_tenant(self.name)
+
+    def __enter__(self) -> "TenantHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class FleetServer:
+    """Micro-batching, capacity-bucketed, hot-swappable MULTI-TENANT
+    model server: one dispatcher thread, one device arena, one trace
+    budget for the whole fleet.
+
+    Fleet-level knobs mirror ``ModelServer``'s (``max_batch``,
+    ``linger_ms``, ``num_devices``, ``queue_depth``, ``deadline_ms``,
+    ``max_queue_rows``, ``retry_policy``, ``probe_interval_s``,
+    ``bucket``) and default from ``config`` (any Booster Config) when
+    given; ``fleet_shard`` / ``pack_budget_mb`` select the placement
+    mode (``tpu_serving_fleet_shard`` /
+    ``tpu_serving_fleet_pack_budget_mb``). Per-tenant knobs
+    (``deadline_ms``, ``quota_rows``, ``raw_score``) ride
+    ``add_tenant``.
+
+    Usage::
+
+        fleet = lgb.serve_fleet({"us": bst_us, "eu": bst_eu})
+        y = fleet.predict("us", X)
+        with bst_jp.serve(fleet=fleet, tenant="jp") as jp:
+            jp.predict(Xjp)
+            bst_jp.update(); jp.publish()      # hot-swap ONE tenant
+    """
+
+    def __init__(self, max_batch: Optional[int] = None,
+                 linger_ms: Optional[float] = None,
+                 num_devices: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 max_queue_rows: Optional[int] = None,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 probe_interval_s: Optional[float] = None,
+                 bucket: Optional[bool] = None,
+                 fleet_shard: Optional[str] = None,
+                 pack_budget_mb: Optional[float] = None,
+                 config=None):
+        def knob(value, name, fallback):
+            if value is not None:
+                return value
+            if config is not None and hasattr(config, name):
+                return getattr(config, name)
+            return fallback
+
+        self.bucket = bool(knob(bucket, "tpu_predict_buckets", True))
+        self.mesh = mesh_mod.serving_mesh(
+            int(knob(num_devices, "tpu_serving_num_devices", 0)))
+        self.deadline_ms = float(knob(deadline_ms,
+                                      "tpu_serving_deadline_ms", 0.0))
+        self._default_quota = int(knob(None, "tpu_serving_fleet_quota_rows",
+                                       0))
+        shard = str(knob(fleet_shard, "tpu_serving_fleet_shard",
+                         "auto")).lower()
+        if shard not in ("auto", "replicate", "model"):
+            raise ValueError(f"fleet_shard must be auto|replicate|model "
+                             f"(got {shard!r})")
+        self._shard_mode = shard
+        self._pack_budget = float(knob(
+            pack_budget_mb, "tpu_serving_fleet_pack_budget_mb", 256.0)) * 1e6
+        self._retry_policy = (
+            retry_policy if retry_policy is not None else SERVING_POLICY
+        ).from_env_overrides(os.environ)
+        self.counters = ServingCounters()
+        self._degrade = DegradeControl(
+            self.counters, self._recovery_probe,
+            float(knob(probe_interval_s, "tpu_serving_probe_interval_s",
+                       5.0)),
+            what="fleet serving")
+        self._publish_lock = threading.Lock()
+        self._tenants: Dict[str, _Tenant] = {}
+        self._state = _FleetState({}, {}, "replicate")
+        self._closed = False
+        self._batcher = MicroBatcher(
+            self._dispatch_many, grouped=True,
+            max_batch=int(knob(max_batch, "tpu_serving_max_batch", 4096)),
+            linger_ms=float(knob(linger_ms, "tpu_serving_linger_ms", 2.0)),
+            queue_depth=int(knob(queue_depth, "tpu_serving_queue_depth",
+                                 8192)),
+            max_queue_rows=int(knob(max_queue_rows,
+                                    "tpu_serving_max_queue_rows",
+                                    1_048_576)),
+            counters=self.counters)
+
+    # ---- tenant lifecycle -------------------------------------------
+    def add_tenant(self, name: str, booster,
+                   deadline_ms: Optional[float] = None,
+                   quota_rows: Optional[int] = None,
+                   raw_score: bool = False) -> TenantHandle:
+        """Register one booster as tenant ``name`` and publish its
+        current model. Duplicate names are refused loudly (a silent
+        replace would re-route live traffic); per-tenant knobs default
+        from the booster's own ``tpu_serving_*`` params."""
+        eng = getattr(booster, "_engine", booster)
+        if eng is None:
+            raise ValueError("cannot serve an unconstructed Booster")
+        cfg = getattr(booster, "config", None)
+
+        def knob(value, cname, fallback):
+            # kwarg > the booster's EXPLICITLY-set param > the fleet
+            # default. Config exposes every registered param with its
+            # default, so a bare hasattr would make the fleet-level
+            # fallback unreachable (a fleet deadline_ms would be
+            # silently shadowed by every tenant's implicit 0.0)
+            if value is not None:
+                return value
+            if cfg is not None and hasattr(cfg, cname) and \
+                    not cfg.is_default(cname):
+                return getattr(cfg, cname)
+            return fallback
+
+        with self._publish_lock:
+            if self._closed:
+                raise RuntimeError("fleet server is closed")
+            if name in self._tenants:
+                raise ValueError(
+                    f"tenant {name!r} is already served by this fleet — "
+                    "publish() updates it; pick a new name for a new "
+                    "model")
+            t = _Tenant(
+                name, booster, eng,
+                deadline_ms=float(knob(deadline_ms,
+                                       "tpu_serving_deadline_ms",
+                                       self.deadline_ms)),
+                quota_rows=int(knob(quota_rows,
+                                    "tpu_serving_fleet_quota_rows",
+                                    self._default_quota)),
+                raw_score=raw_score)
+            self._tenants[name] = t
+            try:
+                self._publish_locked(t)
+            except BaseException:
+                del self._tenants[name]     # rollback: never half-added
+                raise
+        return TenantHandle(self, name)
+
+    def remove_tenant(self, name: str) -> None:
+        """Drop one tenant: its window leaves the routing table and its
+        bucket is rebuilt without it; queued requests for it fail at
+        dispatch. Other tenants are untouched."""
+        with self._publish_lock:
+            t = self._tenants.pop(name, None)
+            if t is None:
+                return
+            self.counters.drop_tenant(name)
+            routes = dict(self._state.routes)
+            routes.pop(name, None)
+            buckets = dict(self._state.buckets)
+            for key, b in list(buckets.items()):
+                if name in b.members:
+                    members = tuple(m for m in b.members if m != name)
+                    if members:
+                        buckets[key] = self._build_bucket(
+                            key, members, self._state.shard, routes)
+                    else:
+                        del buckets[key]
+            self._swap_state(buckets, routes)
+
+    # ---- publish -----------------------------------------------------
+    def publish(self, name: str) -> Generation:
+        """Atomically hot-swap tenant ``name`` to its booster's CURRENT
+        model. Builds a whole new immutable fleet state (only the
+        tenant's shape bucket is re-assembled; untouched buckets are
+        reused by reference) and swaps one reference — in-flight
+        batches finish on the old state, and a publish that dies at ANY
+        point (the injected ``publish_fail`` site, a packing error, a
+        real OOM) leaves every tenant serving exactly what it served
+        before: rollback, never torn, and never a stall for the other
+        tenants."""
+        with self._publish_lock:
+            t = self._tenants.get(name)
+            if t is None:
+                raise KeyError(f"unknown tenant {name!r}")
+            return self._publish_locked(t)
+
+    def _publish_locked(self, t: _Tenant) -> Generation:
+        try:
+            models, gen, mappers, used_map = t.engine.serving_state()
+            if not models:
+                raise ValueError(f"tenant {t.name!r} has no trees to "
+                                 "serve")
+            faults.maybe_fail("publish_fail")
+            kind = "binned" if mappers is not None else "raw"
+            t.raw_route = kind == "raw"
+            n_axis = len(mappers) if kind == "binned" else t.n_features
+            shape = forest.tenant_shape(models, t.k, n_axis, kind)
+            token = (gen, len(models), shape)
+            if t._win_token != token:
+                if kind == "binned":
+                    win = forest.pack_window_binned(models, mappers, shape)
+                else:
+                    win = forest.pack_window_raw(models, shape)
+                t._win_token, t._win = token, win
+            info = Generation(t.version + 1, len(models), gen)
+            route = TenantRoute(
+                name=t.name, key=shape, lo=0, n_trees=len(models), k=t.k,
+                mappers=tuple(mappers) if mappers is not None else None,
+                used=(np.asarray(used_map, np.int64)
+                      if used_map is not None else None),
+                n_features=t.n_features, models=tuple(models),
+                objective=getattr(t.engine, "objective", None),
+                average_output=bool(getattr(t.engine, "average_output",
+                                            False)),
+                raw_score=t.raw_score, generation=info)
+            routes = dict(self._state.routes)
+            old = routes.get(t.name)
+            routes[t.name] = route
+            buckets = dict(self._state.buckets)
+            # rebuild the new bucket (and the old one when the tenant
+            # moved buckets — outgrew its window/leaf/feature caps)
+            affected = {shape}
+            if old is not None and old.key != shape:
+                affected.add(old.key)
+            for key in affected:
+                members = tuple(sorted(
+                    n for n, r in routes.items() if r.key == key))
+                if members:
+                    buckets[key] = self._build_bucket(
+                        key, members, self._state.shard, routes)
+                else:
+                    buckets.pop(key, None)
+            self._swap_state(buckets, routes)
+        except BaseException as e:  # noqa: BLE001 — rollback + re-raise
+            self.counters.inc("publish_failures", tenant=t.name)
+            served = self._state.routes.get(t.name)
+            if served is not None:
+                log.warning(
+                    f"fleet publish FAILED for tenant {t.name!r} "
+                    f"({e!r}); still serving generation "
+                    f"{served.generation.version} — rolled back, not "
+                    "torn, other tenants unaffected")
+            raise
+        t.version = info.version
+        return info
+
+    def _build_bucket(self, key: TenantShape, members: Tuple[str, ...],
+                      shard: str, routes: Dict[str, TenantRoute],
+                      owner=None) -> _Bucket:
+        """Assemble one shape bucket's mega-pack on the HOST (numpy
+        concat of the members' cached windows, zero-padded to the pow2
+        slot capacity) and upload it once. Also rewrites the members'
+        routes with their slot offsets. No eager device ops — a
+        publish never traces anything."""
+        wins = []
+        cat_w = 0
+        for m in members:
+            win = self._tenants[m]._win
+            if key.kind == "binned":
+                cat_w = max(cat_w, forest.window_cat_width(win))
+            wins.append(win)
+        if cat_w:
+            wins = [_widen_window_np(w, cat_w, key.leaf_cap) for w in wins]
+        slot_cap = forest.pow2_cap(len(members), 1)
+        if slot_cap > len(members):
+            zero = _np_map(np.zeros_like, wins[0])
+            wins = wins + [zero] * (slot_cap - len(members))
+        host = _np_map(lambda *xs: np.concatenate(xs), *wins)
+        nbytes = forest.pytree_nbytes(host)
+        dev = _np_map(jnp.asarray, host)
+        device = None
+        if shard == "model":
+            device = owner if owner is not None \
+                else self._owner_for(key, nbytes)
+            dev = mesh_mod.place_on(dev, device)
+        else:
+            dev = mesh_mod.replicate(dev, self.mesh)
+        for slot, m in enumerate(members):
+            routes[m] = routes[m]._replace(lo=slot * key.win_slots)
+        return _Bucket(key, dev, members, slot_cap, nbytes, device)
+
+    def _owner_for(self, key: TenantShape, nbytes: int):
+        """Model-shard owner of one bucket: keep the current owner when
+        the bucket already has one (stability under rebuilds), else the
+        least-loaded mesh device."""
+        cur = self._state.buckets.get(key)
+        if cur is not None and cur.device is not None:
+            return cur.device
+        devs = mesh_mod.mesh_devices(self.mesh)
+        load = {d: 0 for d in devs}
+        for b in self._state.buckets.values():
+            if b.device is not None and b.device in load:
+                load[b.device] += b.nbytes
+        return min(devs, key=lambda d: (load[d], devs.index(d)))
+
+    def _swap_state(self, buckets, routes) -> None:
+        """Resolve the placement mode for the new total pack size,
+        re-place buckets whose mode changed, and atomically publish the
+        new fleet state."""
+        total = sum(b.nbytes for b in buckets.values())
+        shard = self._resolve_shard(total)
+        if shard != self._state.shard and buckets:
+            log.info_once(
+                f"fleet placement -> {shard} (total pack {total / 1e6:.1f}"
+                f" MB vs {self._pack_budget / 1e6:.0f} MB per-device "
+                "budget)")
+            # a flip re-places EVERY bucket: assign all owners in one
+            # balanced pass (incremental _owner_for would read the
+            # stale pre-flip state, where no bucket has an owner, and
+            # pile the whole fleet onto device 0)
+            owners = {}
+            if shard == "model":
+                owners = mesh_mod.assign_owners(
+                    [(key, b.nbytes) for key, b in buckets.items()],
+                    mesh_mod.mesh_devices(self.mesh))
+            rebuilt = {}
+            for key, b in buckets.items():
+                rebuilt[key] = self._build_bucket(
+                    key, b.members, shard, routes, owner=owners.get(key))
+            buckets = rebuilt
+        self._state = _FleetState(buckets, routes, shard)  # GIL-atomic
+
+    def _resolve_shard(self, total_bytes: int) -> str:
+        n_dev = len(mesh_mod.mesh_devices(self.mesh))
+        mode = self._shard_mode
+        if mode == "model" and n_dev <= 1:
+            log.info_once("tpu_serving_fleet_shard=model needs >1 device; "
+                          "replicating")
+            mode = "replicate"
+        if mode != "auto":
+            return mode
+        if n_dev <= 1 or total_bytes <= self._pack_budget:
+            return "replicate"
+        return "model"
+
+    # ---- request path ------------------------------------------------
+    def submit(self, tenant: str, X,
+               deadline_ms: Optional[float] = None) -> PendingRequest:
+        """Enqueue one request for ``tenant``. Validation happens HERE
+        (tenant existence, shape, the raw route's f32-representability
+        contract) so a malformed request raises to ITS submitter and
+        never joins — let alone poisons — the cross-tenant batch its
+        peers form."""
+        t = self._tenants.get(tenant)
+        if t is None:
+            raise KeyError(f"unknown tenant {tenant!r}")
+        X = np.ascontiguousarray(np.asarray(X, np.float64))
+        if X.ndim != 2 or X.shape[1] != t.n_features:
+            raise ValueError(
+                f"tenant {tenant!r} requests must be "
+                f"[rows, {t.n_features}] (got {X.shape})")
+        if t.raw_route and X.shape[0]:
+            with np.errstate(invalid="ignore"):
+                f32_ok = (X.astype(np.float32).astype(np.float64) == X) \
+                    | np.isnan(X)
+            if not f32_ok.all():
+                raise ValueError(
+                    "raw device serving needs float32-representable "
+                    f"requests ({int((~f32_ok).sum())} value(s) are "
+                    "f64-only and could cross a split threshold under "
+                    "f32 rounding)")
+        dl = t.deadline_ms if deadline_ms is None else float(deadline_ms)
+        return self._batcher.submit(
+            X, deadline_sec=(dl / 1e3 if dl and dl > 0 else None),
+            tenant=tenant, max_tenant_rows=t.quota_rows)
+
+    def predict(self, tenant: str, X,
+                timeout: Optional[float] = None) -> np.ndarray:
+        """Sync sugar: submit + result, timeout riding the deadline
+        machinery like ``ModelServer.predict``."""
+        dl_ms = None if timeout is None else timeout * 1e3
+        return self.submit(tenant, X, deadline_ms=dl_ms).result(timeout)
+
+    # ---- dispatch ----------------------------------------------------
+    def _dispatch_many(self, batch: List[PendingRequest]) -> list:
+        """Serve one coalesced cross-tenant batch: group by shape
+        bucket, one jitted dispatch per group against ONE fleet state,
+        per-request outcomes back to the batcher. A group's transient
+        failure retries then degrades (host walk still answers it); a
+        non-transient error fails that GROUP only — never the rows
+        other buckets coalesced alongside."""
+        state = self._state            # single read: atomic pairing
+        outcomes: list = [None] * len(batch)
+        groups: Dict[TenantShape, list] = {}
+        for i, r in enumerate(batch):
+            route = state.routes.get(r.tenant)
+            if route is None:
+                outcomes[i] = KeyError(
+                    f"tenant {r.tenant!r} was removed before dispatch")
+            else:
+                groups.setdefault(route.key, []).append((i, r, route))
+        for key, items in groups.items():
+            degraded = self._degrade.degraded
+            raw = None
+            if not degraded:
+                try:
+                    raw = retry_call(
+                        self._bucket_scores, state, key, items,
+                        policy=self._retry_policy, what="fleet dispatch",
+                        on_retry=lambda _a, _e:
+                            self.counters.inc("dispatch_retries"))
+                except RetryError as e:
+                    self.counters.inc("dispatch_failures")
+                    self._degrade.enter(
+                        f"dispatch retry budget exhausted: {e.last!r}")
+                    degraded = True
+                except BaseException as e:  # noqa: BLE001 — group-scoped
+                    for i, _r, _route in items:
+                        outcomes[i] = e
+                    continue
+            off = 0
+            if degraded:
+                # global ledger: one per degraded bucket-group (the
+                # solo-server batch semantics); tenant ledgers: once
+                # per tenant PRESENT in the group — "how many degraded
+                # batches carried my rows", so the per-tenant counts
+                # are comparable across tenants, not inflated by
+                # request fan-in
+                self.counters.inc("degraded_batches")
+                for t in {r.tenant for _i, r, _route in items}:
+                    self.counters.inc_tenant(t, "degraded_batches")
+            for i, r, route in items:
+                if degraded:
+                    vals = self._host_scores(route, r.X)
+                else:
+                    vals = raw[off:off + r.n]
+                outcomes[i] = self._finish(vals, route)
+                off += r.n
+        return outcomes
+
+    def _bucket_scores(self, state: _FleetState, key: TenantShape,
+                       items) -> np.ndarray:
+        """One device attempt at a bucket group: [R_total, k] f64 raw
+        scores, rows in item order. Fault sites sit BEFORE the real
+        dispatch; every retry re-consults."""
+        faults.maybe_delay("slow_dispatch")
+        faults.maybe_fail("dispatch_error")
+        bucket = state.buckets[key]
+        total = sum(r.n for _i, r, _route in items)
+        rows = forest.bucket_rows(total) if self.bucket else total
+        lo = np.zeros(rows, np.int32)
+        nl = np.zeros(rows, np.int32)
+        if key.kind == "binned":
+            operand = np.zeros((key.feat_cap, rows), np.int32)
+        else:
+            operand = np.zeros((rows, key.feat_cap), np.float32)
+        off = 0
+        for _i, r, route in items:
+            n = r.n
+            lo[off:off + n] = route.lo
+            nl[off:off + n] = route.n_trees
+            if key.kind == "binned":
+                operand[:len(route.mappers), off:off + n] = \
+                    _host_bins(route, r.X)
+            else:
+                operand[off:off + n, :r.X.shape[1]] = r.X
+            off += n
+        lo_d, nl_d, op_d = jnp.asarray(lo), jnp.asarray(nl), \
+            jnp.asarray(operand)
+        if bucket.device is not None:
+            lo_d = mesh_mod.place_on(lo_d, bucket.device)
+            nl_d = mesh_mod.place_on(nl_d, bucket.device)
+            op_d = mesh_mod.place_on(op_d, bucket.device)
+        elif self.mesh is not None:
+            lo_d = mesh_mod.shard_rows(lo_d, 0, self.mesh)
+            nl_d = mesh_mod.shard_rows(nl_d, 0, self.mesh)
+            op_d = mesh_mod.shard_rows(
+                op_d, 1 if key.kind == "binned" else 0, self.mesh)
+        if key.kind == "binned":
+            out = forest._fleet_scores_binned(
+                key.steps, key.k, key.win_slots, bucket.dev, lo_d, nl_d,
+                op_d)
+        else:
+            out = forest._fleet_scores_raw(
+                key.steps, key.k, key.win_slots, bucket.dev, lo_d, nl_d,
+                op_d)
+        # pad slice on the HOST (an on-device slice would retrace per r)
+        return np.asarray(out, np.float64).T[:total]
+
+    def _host_scores(self, route: TenantRoute, X: np.ndarray
+                     ) -> np.ndarray:
+        """[R, K] f64 raw scores by the tenant's HOST per-tree walk
+        (server.host_walk_scores — ONE copy with the solo server)."""
+        return host_walk_scores(route.models, route.k, X)
+
+    def _finish(self, raw: np.ndarray, route: TenantRoute):
+        """Per-tenant output tail (server.finish_scores — ONE copy
+        with the solo server)."""
+        info = route.generation
+        vals = finish_scores(raw, route.k, info.num_trees,
+                             route.average_output, route.objective,
+                             route.raw_score)
+        return vals, info
+
+    # ---- degradation / lifecycle ------------------------------------
+    def degrade(self, reason: str = "forced") -> None:
+        """Flip the whole fleet to the host-walk route (chaos drills,
+        operator override); the background probe un-degrades."""
+        self._degrade.enter(reason)
+
+    def _recovery_probe(self) -> None:
+        faults.maybe_fail("dispatch_error")
+        mesh_mod.probe(self.mesh)
+
+    def stats(self) -> dict:
+        s = self._batcher.stats()
+        state = self._state
+        s["n_tenants"] = len(state.routes)
+        s["n_buckets"] = len(state.buckets)
+        s["fleet_shard"] = state.shard
+        s["pack_bytes"] = sum(b.nbytes for b in state.buckets.values())
+        s["mesh_devices"] = (self.mesh.shape[mesh_mod.SERVE_AXIS]
+                             if self.mesh is not None else 1)
+        s["linger_ms"] = self._batcher.linger_sec * 1e3
+        s["max_batch"] = self._batcher.max_batch
+        s["degraded"] = self._degrade.degraded
+        if s["degraded"] and self._degrade.reason is not None:
+            s["degraded_reason"] = self._degrade.reason
+        return s
+
+    def tenant_stats(self, name: str) -> dict:
+        """One tenant's view: its counters ledger + routing info."""
+        t = self._tenants.get(name)
+        route = self._state.routes.get(name)
+        s = dict(self.counters.tenant_snapshot().get(name, {}))
+        if route is not None:
+            s["generation"] = route.generation.version
+            s["num_trees"] = route.n_trees
+            s["bucket"] = route.key._asdict()
+            s["window_lo"] = route.lo
+        if t is not None:
+            s["deadline_ms"] = t.deadline_ms
+            s["quota_rows"] = t.quota_rows
+        s["degraded"] = self._degrade.degraded
+        return s
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @property
+    def tenants(self) -> Tuple[str, ...]:
+        return tuple(sorted(self._state.routes))
+
+    def close(self, timeout: Optional[float] = 30.0) -> None:
+        """Drain-and-stop the whole fleet (same contract as
+        ``ModelServer.close``)."""
+        self._closed = True
+        self._degrade.close()
+        self._batcher.close(timeout)
+
+    def __enter__(self) -> "FleetServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# module helpers
+# ---------------------------------------------------------------------------
+
+def _np_map(fn, *trees):
+    """jax.tree.map without importing jax at call sites that only
+    shuffle numpy — kept separate for readability."""
+    import jax
+    return jax.tree.map(fn, *trees)
+
+
+def _widen_window_np(win, width: int, leaf_cap: int):
+    """Normalize one host binned window's cat fields to the bucket's
+    common width (numpy counterpart of ops/forest._widen_stacked_cat;
+    windows without cat fields grow empty ones)."""
+    tree = win.tree
+    li = leaf_cap - 1
+    T = tree.leaf_value.shape[0]
+    if tree.cat_bins is None:
+        tree = tree._replace(
+            cat_count=np.zeros((T, li), np.int32),
+            cat_bins=np.full((T, li, width), -1, np.int32))
+    elif tree.cat_bins.shape[2] < width:
+        pad = np.full((T, li, width - tree.cat_bins.shape[2]), -1,
+                      np.int32)
+        tree = tree._replace(
+            cat_bins=np.concatenate([tree.cat_bins, pad], axis=2))
+    return win._replace(tree=tree)
+
+
+def _host_bins(route: TenantRoute, X: np.ndarray) -> np.ndarray:
+    """[F_used, n] i32 bins of one tenant's request rows via ITS OWN
+    host BinMappers — the exactness oracle (``value_to_bin`` IS the
+    mapping the training-time binning and the host walk agree on, for
+    every f64 value, categorical or numeric)."""
+    cols = X[:, route.used].T
+    return np.stack([
+        m.value_to_bin(np.ascontiguousarray(cols[j], np.float64))
+        for j, m in enumerate(route.mappers)]).astype(np.int32)
+
+
+def serve_fleet(boosters, **knobs) -> FleetServer:
+    """Build a :class:`FleetServer` hosting every ``{name: booster}``
+    entry (any mapping, or an iterable of ``(name, booster)`` pairs).
+    ``raw_score=`` applies to all tenants; other knobs are fleet-level
+    (see :class:`FleetServer`). Fleet knobs default from the FIRST
+    booster's config."""
+    items = list(boosters.items()) if hasattr(boosters, "items") \
+        else list(boosters)
+    if not items:
+        raise ValueError("serve_fleet needs at least one (name, booster)")
+    raw_score = bool(knobs.pop("raw_score", False))
+    cfg = knobs.pop("config", None)
+    if cfg is None:
+        cfg = getattr(items[0][1], "config", None)
+    fleet = FleetServer(config=cfg, **knobs)
+    try:
+        for name, bst in items:
+            fleet.add_tenant(name, bst, raw_score=raw_score)
+    except BaseException:
+        fleet.close(timeout=5.0)
+        raise
+    return fleet

@@ -78,27 +78,73 @@ class ServingCounters:
       ``close(timeout=)`` expired before the drain finished.
 
     Unknown names raise (a typo'd counter must fail loudly, not create
-    a silent parallel ledger)."""
+    a silent parallel ledger).
+
+    Multi-tenant fleet serving (ISSUE 13) adds a PER-TENANT dimension:
+    ``inc(name, tenant=...)`` files the event in the tenant's own
+    ledger as well as the global one, and ``inc_tenant`` covers the
+    tenant-only volume counters (``requests``/``rows``, which the
+    batcher tracks globally outside this class). ``tenant_snapshot()``
+    returns the per-tenant ledgers; the fleet chaos gate reconciles
+    them EXACTLY against per-tenant client-observed outcomes."""
 
     NAMES = ("expired", "shed", "dispatch_retries", "dispatch_failures",
              "degrade_events", "recoveries", "degraded_batches",
              "publish_failures", "shutdown_failed")
+    # the per-tenant ledger: request/row volume plus every failure-path
+    # event that is attributable to ONE tenant (retry/degrade/recovery
+    # events are fleet-wide device state, deliberately not per-tenant)
+    TENANT_NAMES = ("requests", "rows", "expired", "shed",
+                    "degraded_batches", "dispatch_failures",
+                    "publish_failures", "shutdown_failed")
 
     def __init__(self):
         self._lock = threading.Lock()
         self._c = {n: 0 for n in self.NAMES}
+        self._t: Dict[str, Dict[str, int]] = {}
 
-    def inc(self, name: str, n: int = 1) -> None:
+    def _tenant_ledger(self, tenant: str) -> Dict[str, int]:
+        led = self._t.get(tenant)
+        if led is None:
+            led = self._t[tenant] = {n: 0 for n in self.TENANT_NAMES}
+        return led
+
+    def inc(self, name: str, n: int = 1, tenant: str = None) -> None:
         with self._lock:
             self._c[name] += n
+            if tenant is not None and name in self.TENANT_NAMES:
+                self._tenant_ledger(tenant)[name] += n
+
+    def inc_tenant(self, tenant: str, name: str, n: int = 1) -> None:
+        """Tenant-only increment for names outside the global ledger
+        (``requests``/``rows``); unknown names still raise."""
+        if name not in self.TENANT_NAMES:
+            raise KeyError(name)
+        with self._lock:
+            self._tenant_ledger(tenant)[name] += n
 
     def get(self, name: str) -> int:
         with self._lock:
             return self._c[name]
 
+    def get_tenant(self, tenant: str, name: str) -> int:
+        with self._lock:
+            return self._t.get(tenant, {}).get(name, 0)
+
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._c)
+
+    def drop_tenant(self, tenant: str) -> None:
+        """Forget one tenant's ledger (tenant removed from the fleet):
+        bounded memory under tenant churn beats retaining dead
+        history."""
+        with self._lock:
+            self._t.pop(tenant, None)
+
+    def tenant_snapshot(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {t: dict(led) for t, led in self._t.items()}
 
 
 class LatencyRecorder:

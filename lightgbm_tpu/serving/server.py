@@ -73,6 +73,104 @@ class Generation(NamedTuple):
     model_gen: int
 
 
+def host_walk_scores(models, k: int, X: np.ndarray) -> np.ndarray:
+    """[R, K] f64 raw scores by the HOST per-tree walk — exactly
+    ``Booster.predict``'s accumulation order, so degraded responses are
+    bit-identical to the host route. ONE copy shared by the
+    single-model and fleet servers (a drifted duplicate here is a
+    drifted degraded-parity contract)."""
+    raw = np.zeros((X.shape[0], max(int(k), 1)), np.float64)
+    for i, t in enumerate(models):
+        raw[:, i % max(int(k), 1)] += t.predict(X)
+    return raw
+
+
+def finish_scores(raw: np.ndarray, k: int, n_trees: int,
+                  average_output: bool, objective, raw_score: bool):
+    """Shared output tail (average + objective conversion) mirroring
+    ``Booster.predict`` exactly; [R, K] raw scores in, per-request
+    values out (squeezed for k == 1)."""
+    n_iters = n_trees // max(int(k), 1)
+    if average_output and n_iters > 0:
+        raw = raw / n_iters
+    if not raw_score and objective is not None:
+        if k > 1:
+            raw = objective.convert_output(raw)
+        else:
+            raw = np.array(raw, copy=True)
+            raw[:, 0] = np.asarray(objective.convert_output(raw[:, 0]))
+    return raw if k > 1 else raw[:, 0]
+
+
+class DegradeControl:
+    """Retry-exhaustion degradation state shared by the single-model
+    server and the fleet server (ISSUE 9/13): a sticky ``degraded``
+    flag flipped on dispatch-budget exhaustion (or a forced drill),
+    plus the background recovery loop that runs ``probe`` every
+    ``probe_interval_s`` seconds and un-degrades on the first full
+    success. ``probe`` must raise while the device is unhealthy; it is
+    the caller's job to make it consult the injected fault sites so a
+    planned outage keeps the tier degraded until the plan disarms."""
+
+    def __init__(self, counters: ServingCounters, probe,
+                 probe_interval_s: float, what: str = "serving"):
+        self.counters = counters
+        self._probe = probe
+        self._interval = float(probe_interval_s)
+        self._what = what
+        self._evt = threading.Event()
+        self._lock = threading.Lock()
+        self._close_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.reason: Optional[str] = None
+
+    @property
+    def degraded(self) -> bool:
+        return self._evt.is_set()
+
+    def enter(self, reason: str) -> None:
+        with self._lock:
+            if self._evt.is_set():
+                return
+            self.reason = reason
+            self._evt.set()
+            self.counters.inc("degrade_events")
+            log.warning(
+                "=" * 60 + f"\n{self._what.upper()} DEGRADED: {reason}\n"
+                "flipping to the host-walk route (bit-identical to "
+                "Booster.predict, correct but slow); a background probe "
+                "will restore device serving when the device answers "
+                "again.\n" + "=" * 60)
+            if self._interval > 0 and not self._close_evt.is_set():
+                self._thread = threading.Thread(
+                    target=self._probe_loop, daemon=True,
+                    name=f"lgbm-{self._what}-probe")
+                self._thread.start()
+
+    def _probe_loop(self) -> None:
+        while self._evt.is_set():
+            if self._close_evt.wait(self._interval):
+                return
+            try:
+                self._probe()
+            except Exception as e:  # noqa: BLE001 — stay degraded
+                log.debug(f"{self._what} recovery probe failed: {e!r}")
+                continue
+            with self._lock:
+                self._evt.clear()
+                self.reason = None
+                self.counters.inc("recoveries")
+                log.warning(f"{self._what} RECOVERED: device probe "
+                            "succeeded — back on the device route")
+            return
+
+    def close(self) -> None:
+        self._close_evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(1.0)
+
+
 class ModelServer:
     """Micro-batching, mesh-replicated, hot-swappable model server.
 
@@ -156,11 +254,9 @@ class ModelServer:
         self._probe_interval = float(knob(
             probe_interval_s, "tpu_serving_probe_interval_s", 5.0))
         self.counters = ServingCounters()
-        self._degraded = threading.Event()
-        self._degrade_lock = threading.Lock()
-        self._degrade_reason: Optional[str] = None
-        self._probe_thread: Optional[threading.Thread] = None
-        self._close_evt = threading.Event()
+        self._degrade = DegradeControl(
+            self.counters, self._recovery_probe, self._probe_interval)
+        self._closed = False
         self._publish_lock = threading.Lock()
         self._active = None  # (ForestSnapshot, Generation, models) — ONE ref
         self._version = 0
@@ -235,27 +331,16 @@ class ModelServer:
         return out.T                                         # [R, K]
 
     def _host_scores(self, models, X: np.ndarray) -> np.ndarray:
-        """[R, K] f64 raw scores by the HOST per-tree walk — exactly
-        ``Booster.predict``'s accumulation order, so degraded responses
-        are bit-identical to the host route."""
-        raw = np.zeros((X.shape[0], self.k), np.float64)
-        for i, t in enumerate(models):
-            raw[:, i % self.k] += t.predict(X)
-        return raw
+        return host_walk_scores(models, self.k, X)
 
     def _finish(self, raw: np.ndarray, info: Generation):
-        """Shared output tail (average + objective conversion) for both
-        routes; mirrors Booster.predict exactly."""
-        n_iters = info.num_trees // self.k
-        if getattr(self._eng, "average_output", False) and n_iters > 0:
-            raw /= n_iters
-        obj = getattr(self._eng, "objective", None)
-        if not self.raw_score and obj is not None:
-            if self.k > 1:
-                raw = obj.convert_output(raw)
-            else:
-                raw[:, 0] = np.asarray(obj.convert_output(raw[:, 0]))
-        return (raw if self.k > 1 else raw[:, 0]), info
+        """Output tail for both routes (module-level ``finish_scores``,
+        shared with the fleet server)."""
+        vals = finish_scores(
+            raw, self.k, info.num_trees,
+            bool(getattr(self._eng, "average_output", False)),
+            getattr(self._eng, "objective", None), self.raw_score)
+        return vals, info
 
     def _dispatch(self, X: np.ndarray):
         """Score ONE coalesced batch against exactly one snapshot.
@@ -265,7 +350,7 @@ class ModelServer:
         errors propagate and fail the batch (a code bug must never be
         absorbed as a flaky device)."""
         snap, info, models = self._active  # single read: atomic pairing
-        if self._degraded.is_set():
+        if self._degrade.degraded:
             self.counters.inc("degraded_batches")
             return self._finish(self._host_scores(models, X), info)
         try:
@@ -276,7 +361,7 @@ class ModelServer:
                     self.counters.inc("dispatch_retries"))
         except RetryError as e:
             self.counters.inc("dispatch_failures")
-            self._enter_degraded(
+            self._degrade.enter(
                 f"dispatch retry budget exhausted: {e.last!r}")
             self.counters.inc("degraded_batches")
             return self._finish(self._host_scores(models, X), info)
@@ -286,48 +371,15 @@ class ModelServer:
     def degrade(self, reason: str = "forced") -> None:
         """Flip to the host-walk route now (chaos drills, operator
         override). The background probe un-degrades as usual."""
-        self._enter_degraded(reason)
+        self._degrade.enter(reason)
 
-    def _enter_degraded(self, reason: str) -> None:
-        with self._degrade_lock:
-            if self._degraded.is_set():
-                return
-            self._degrade_reason = reason
-            self._degraded.set()
-            self.counters.inc("degrade_events")
-            log.warning(
-                "=" * 60 + f"\nSERVING DEGRADED: {reason}\n"
-                "flipping to the host-walk route (bit-identical to "
-                "Booster.predict, correct but slow); a background probe "
-                "will restore device serving when the device answers "
-                "again.\n" + "=" * 60)
-            if self._probe_interval > 0 and not self._close_evt.is_set():
-                self._probe_thread = threading.Thread(
-                    target=self._probe_loop, daemon=True,
-                    name="lgbm-serving-probe")
-                self._probe_thread.start()
-
-    def _probe_loop(self) -> None:
-        """Background recovery: probe every serving-mesh device each
-        interval; the first full success un-degrades. Consults the
-        ``dispatch_error`` fault site so an injected persistent outage
-        keeps the server degraded until the plan disarms."""
-        while self._degraded.is_set():
-            if self._close_evt.wait(self._probe_interval):
-                return
-            try:
-                faults.maybe_fail("dispatch_error")
-                mesh_mod.probe(self.mesh)
-            except Exception as e:  # noqa: BLE001 — stay degraded
-                log.debug(f"serving recovery probe failed: {e!r}")
-                continue
-            with self._degrade_lock:
-                self._degraded.clear()
-                self._degrade_reason = None
-                self.counters.inc("recoveries")
-                log.warning("serving RECOVERED: device probe succeeded — "
-                            "back on the device route")
-            return
+    def _recovery_probe(self) -> None:
+        """One recovery attempt: every serving-mesh device must answer.
+        Consults the ``dispatch_error`` fault site so an injected
+        persistent outage keeps the server degraded until the plan
+        disarms."""
+        faults.maybe_fail("dispatch_error")
+        mesh_mod.probe(self.mesh)
 
     def submit(self, X,
                deadline_ms: Optional[float] = None) -> PendingRequest:
@@ -383,21 +435,26 @@ class ModelServer:
         s["linger_ms"] = self._batcher.linger_sec * 1e3
         s["max_batch"] = self._batcher.max_batch
         s["deadline_ms"] = self.deadline_ms
-        s["degraded"] = self._degraded.is_set()
-        if s["degraded"] and self._degrade_reason is not None:
-            s["degraded_reason"] = self._degrade_reason
+        s["degraded"] = self._degrade.degraded
+        if s["degraded"] and self._degrade.reason is not None:
+            s["degraded_reason"] = self._degrade.reason
         return s
+
+    @property
+    def closed(self) -> bool:
+        """True once ``close()`` ran — a closed server never serves
+        again; ``Booster.serve()`` uses this to decide whether a prior
+        server is still live (ISSUE 13 satellite)."""
+        return self._closed
 
     def close(self, timeout: Optional[float] = 30.0) -> None:
         """Stop accepting requests; every already-accepted request is
         still served before the dispatcher exits (drain-on-shutdown).
         Past ``timeout`` the drain contract fails still-pending futures
         with SHUTDOWN instead of abandoning them (batcher.close)."""
-        self._close_evt.set()
+        self._closed = True
+        self._degrade.close()       # before the drain: no new probe
         self._batcher.close(timeout)
-        t = self._probe_thread
-        if t is not None:
-            t.join(1.0)
 
     def __enter__(self) -> "ModelServer":
         return self

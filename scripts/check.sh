@@ -112,6 +112,20 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
+echo "== fleet smoke (multi-tenant coalescing + flat trace budget, 2-dev CPU) =="
+# ISSUE 13: 16 mixed-shape tenants (binned + raw routes) on ONE
+# FleetServer — capacity buckets stay flat in fleet size, cross-tenant
+# coalesced responses are bit-identical to each tenant's own
+# predict_device, mixed-tenant bursts + one in-window hot-swap compile
+# nothing after warmup, a publish under cross-tenant load never tears,
+# and the model-shard placement serves the same bits.
+timeout -k 10 150 env JAX_PLATFORMS=cpu \
+    python scripts/fleet_smoke.py || rc=1
+if [ $rc -ne 0 ]; then
+    echo "check.sh: fleet smoke failed — skipping tier-1 pytest" >&2
+    exit $rc
+fi
+
 echo "== hist smoke (sorted-segment level kernel parity + fallback, CPU) =="
 # ISSUE 6: the one-launch pallas_level kernel must be bit-identical to
 # the blocks/scatter formulations on ragged segments (f32 dyadic +

@@ -1,0 +1,213 @@
+"""Multi-tenant fleet serving smoke gate (ISSUE 13): 16+ mixed-shape
+tenants on ONE FleetServer, on CPU with 2 VIRTUAL devices, <30 s.
+
+Asserts, end to end through ``serve_fleet()`` / ``Booster.serve(fleet=)``:
+  1. 16 tenants with mixed (leaves, trees, F) shapes collapse onto a
+     handful of capacity buckets — never one bucket per tenant, never
+     one global max pad;
+  2. cross-tenant coalescing bit-parity: concurrent submits from every
+     tenant coalesce into shared dispatches and each response is
+     BIT-IDENTICAL to that tenant's own ``predict(device=True)``;
+  3. the trace budget is flat in fleet size: after warming each
+     (shape bucket, row bucket), a burst of mixed-size mixed-tenant
+     traffic — including one hot-swap publish — compiles NOTHING
+     (<= 2 traces, measured 0);
+  4. one hot-swap under cross-tenant load: publishing one tenant while
+     other tenants' clients hammer the fleet produces zero failed or
+     torn responses on every tenant, generations move forward only;
+  5. the model-shard placement (tpu_serving_fleet_shard=model) serves
+     the same bits with each bucket's mega-pack owned by one device.
+
+Wired into scripts/check.sh; exits non-zero on the first violated gate.
+"""
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=2"
+                           ).strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+T_START = time.perf_counter()
+BUDGET_SEC = 30.0
+N_TENANTS = 16
+
+
+def check(cond, what):
+    took = time.perf_counter() - T_START
+    if not cond:
+        print(f"fleet_smoke: FAIL {what} ({took:.1f}s)", file=sys.stderr)
+        sys.exit(1)
+    print(f"fleet_smoke: ok {what} ({took:.1f}s)")
+
+
+def main() -> int:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.analysis import guards
+
+    check(len(jax.devices()) == 2, f"2 virtual devices ({jax.devices()})")
+
+    # 16 tenants over 4 shape archetypes (mixed leaves/trees/features);
+    # one request pool per feature width so Dataset binning and the
+    # grower programs are shared across same-shape tenants (train time,
+    # not serving, is this gate's wall-clock risk)
+    # the first archetype keeps window headroom (3 trees in a 4-slot
+    # capacity) so the in-window hot-swap inside the trace-budget gate
+    # stays a pure pack rewrite, not a bucket move
+    archetypes = [(7, 3, 5), (15, 3, 8), (31, 2, 5), (15, 4, 8)]
+    rng = np.random.default_rng(3)
+    pools = {f: rng.normal(size=(399, f)).astype(np.float32)
+             .astype(np.float64) for f in {a[2] for a in archetypes}}
+    tenants = {}
+    for i in range(N_TENANTS // 2):
+        leaves, trees, f = archetypes[i % len(archetypes)]
+        X = pools[f]
+        y = X[:, 0] * (1 + 0.2 * i) + 0.4 * X[:, 1] ** 2 * (1 + i % 3)
+        bst = lgb.train({"objective": "regression", "num_leaves": leaves,
+                         "verbose": -1, "min_data_in_leaf": 5},
+                        lgb.Dataset(X, label=y), num_boost_round=trees,
+                        keep_training_booster=True)
+        tenants[f"t{i:02d}"] = (bst, X)
+    # the other half are LOADED models (mapperless -> the fleet RAW
+    # route): one fleet serving binned and raw tenants side by side
+    for i in range(N_TENANTS // 2, N_TENANTS):
+        src, X = tenants[f"t{i - N_TENANTS // 2:02d}"]
+        tenants[f"t{i:02d}"] = (
+            lgb.Booster(model_str=src.model_to_string()), X)
+    check(True, f"trained {N_TENANTS // 2} mixed-shape tenants + loaded "
+          f"{N_TENANTS - N_TENANTS // 2} raw-route tenants")
+
+    fleet = lgb.serve_fleet({k: b for k, (b, _x) in tenants.items()},
+                            raw_score=True, linger_ms=40.0, num_devices=2)
+    st = fleet.stats()
+    check(st["n_tenants"] == N_TENANTS and
+          2 <= st["n_buckets"] <= len(archetypes) * 3,
+          f"{N_TENANTS} tenants collapse onto {st['n_buckets']} capacity "
+          "buckets (flat in fleet size, keyed by shape)")
+    check(st["mesh_devices"] == 2, "fleet spans the 2-device mesh")
+
+    # 1+2. cross-tenant coalescing parity: all tenants submit together
+    futs = {k: fleet.submit(k, x[:40]) for k, (_b, x) in tenants.items()}
+    for k, fut in futs.items():
+        b, x = tenants[k]
+        direct = b.predict(x[:40], device=True, raw_score=True)
+        if not np.array_equal(fut.result(120), direct):
+            check(False, f"tenant {k} response != its own predict_device")
+    check(True, f"all {N_TENANTS} tenants bit-identical to their own "
+          "predict_device")
+    check(fleet.stats()["batches"] < N_TENANTS,
+          f"coalescing crossed tenants ({fleet.stats()['batches']} "
+          f"dispatch pops for {N_TENANTS} requests)")
+
+    # 3. trace budget flat in fleet size: warm each (bucket, row-bucket),
+    # then mixed bursts + one in-capacity hot-swap compile NOTHING
+    for warm in (200, 399):
+        for k, (_b, x) in tenants.items():
+            fleet.predict(k, x[:warm], timeout=120)
+    keys = list(tenants)
+    pub_bst = tenants[keys[0]][0]
+    pub_bst.update()
+    # flush the engine's pending device trees NOW: host materialization
+    # of freshly grown trees is training machinery, not serving traces
+    pub_bst.num_trees()
+    with guards.CompileCounter() as counter:
+        for burst in range(3):
+            # mixed request sizes whose coalesced totals stay inside the
+            # warmed 256/512 row buckets
+            fs = [fleet.submit(k, tenants[k][1][:4 + 3 * j])
+                  for j, k in enumerate(keys[: 8 + burst * 4])]
+            for f in fs:
+                f.result(120)
+        fleet.publish(keys[0])               # hot-swap inside the window
+        fleet.predict(keys[0], tenants[keys[0]][1][:64], timeout=120)
+        fleet.predict(keys[3], tenants[keys[3]][1][:300], timeout=120)
+    check(counter.count <= 2,
+          f"compile budget: {counter.count} traces over mixed-tenant "
+          f"bursts + one hot-swap (<=2) "
+          f"{counter.names if counter.count else ''}")
+    check(np.array_equal(
+        fleet.predict(keys[0], tenants[keys[0]][1][:40], timeout=120),
+        pub_bst.predict(tenants[keys[0]][1][:40], device=True,
+                        raw_score=True)),
+        "post-hot-swap responses serve the NEW trees bit-exactly")
+
+    # 4. hot-swap under cross-tenant load: zero failed/torn anywhere
+    pub_key, load_keys = keys[1], keys[2:6]
+    pub_b, pub_x = tenants[pub_key]
+    expected = {1: pub_b.predict(pub_x[:32], device=True, raw_score=True)}
+    refs = {k: tenants[k][0].predict(tenants[k][1][:32], device=True,
+                                     raw_score=True) for k in load_keys}
+    stop = threading.Event()
+    errors, torn = [], []
+    pub_seen = []
+
+    def client(k):
+        while not stop.is_set():
+            try:
+                fut = fleet.submit(k, tenants[k][1][:32])
+                out = fut.result(120)
+                if k == pub_key:
+                    pub_seen.append(fut.generation.version)
+                    if not np.array_equal(out,
+                                          expected[fut.generation.version]):
+                        torn.append(k)
+                elif not np.array_equal(out, refs[k]):
+                    torn.append(k)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=client, args=(k,), daemon=True)
+               for k in [pub_key] + load_keys]
+    for t in threads:
+        t.start()
+    for _ in range(2):
+        time.sleep(0.05)
+        pub_b.update()
+        # bank the next generation's expectation BEFORE it can serve
+        expected[max(expected) + 1] = pub_b.predict(
+            pub_x[:32], device=True, raw_score=True)
+        fleet.publish(pub_key)
+    time.sleep(0.1)
+    stop.set()
+    for t in threads:
+        t.join(60)
+    check(not errors and not torn and pub_seen,
+          f"hot-swap under load: {len(pub_seen)} publisher-tenant "
+          f"responses, 0 errors, 0 torn {errors[:1] or torn[:1]}")
+    check(pub_seen == sorted(pub_seen),
+          "generations move forward only under load")
+    fleet.close()
+
+    # 5. model-shard placement: same bits, packs owned per device
+    sub = {k: tenants[k][0] for k in keys[:6]}
+    with lgb.serve_fleet(sub, raw_score=True, num_devices=2,
+                         fleet_shard="model", linger_ms=10.0) as fs:
+        check(fs.stats()["fleet_shard"] == "model",
+              "model-shard placement selected")
+        for k in sub:
+            want = sub[k].predict(tenants[k][1][:24], device=True,
+                                  raw_score=True)
+            if not np.array_equal(
+                    fs.predict(k, tenants[k][1][:24], timeout=120), want):
+                check(False, f"model-shard parity broke for {k}")
+        check(True, "model-shard route bit-identical for every tenant")
+
+    took = time.perf_counter() - T_START
+    # advisory on a cold compile cache (same policy as serving_smoke)
+    if took >= BUDGET_SEC:
+        print(f"fleet_smoke: WARN wall {took:.1f}s >= {BUDGET_SEC:.0f}s "
+              "(cold compile cache?)", file=sys.stderr)
+    print(f"fleet_smoke: PASS in {took:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -147,7 +147,10 @@ def main() -> int:
               "deadline expired in queue -> DEADLINE_EXCEEDED + counter")
 
     # 4b. admission control: fail fast with OVERLOADED once the row
-    # bound fills behind a slow dispatch
+    # bound fills behind a slow dispatch. Close the first server before
+    # opening the re-knobbed one: a booster has ONE live server
+    # (ISSUE 13 — a kwarg'd serve() on a live server refuses loudly)
+    srv.close(timeout=60)
     srv2 = bst.serve(linger_ms=1.0, raw_score=True, max_queue_rows=128)
     with faults.inject("slow_dispatch:sec=0.6:n=1"):
         blocker = srv2.submit(probe)              # 64 rows, dispatching
@@ -167,7 +170,6 @@ def main() -> int:
           "every accepted request still served bit-identically (0 torn)")
 
     srv2.close(timeout=60)
-    srv.close(timeout=60)
     took = time.perf_counter() - T_START
     if took >= BUDGET_SEC:
         print(f"serving_chaos_smoke: WARN wall {took:.1f}s >= "

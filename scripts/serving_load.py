@@ -37,12 +37,23 @@ grammar (measured / degraded / device_unreachable / no_result — a
 "degraded" record means the tier ended on the host fallback) so the
 session driver can key on them.
 
+Fleet mode (``--fleet N``, ISSUE 13): N tenants with mixed (leaves,
+trees, F) shapes served by ONE FleetServer — open-loop Poisson traffic
+picks a tenant per arrival with mixed request sizes, banking QPS +
+p50/p99/p999, the measured steady-state trace count (the flat-in-fleet-
+size budget, via guards.CompileCounter over the warmed measurement
+window) and a CHAOS LEG (one tenant's publish_fail + a forced mid-run
+degrade; verified: 0 torn responses per tenant against that tenant's
+device or host bits, exact per-tenant counter accounting) to
+``bench_logs/SERVING_FLEET.json`` in the shared _bench_io grammar.
+
 Usage:
   python scripts/serving_load.py [--clients 8] [--rows 64]
       [--duration 10] [--mode closed|open] [--rate 200]
       [--devices 2] [--trees 60] [--leaves 31] [--linger-ms 2]
       [--publish-every 0] [--skip-native] [--deadline-ms 0]
       [--max-queue-rows 0] [--chaos] [--chaos-p999-ms 10000]
+      [--fleet N] [--fleet-rows 3000]
 
 --devices D > 1 on a CPU host re-execs with D virtual XLA devices;
 an already-set JAX_PLATFORMS (e.g. a TPU session) is honored.
@@ -62,6 +73,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 OUT = os.path.join(REPO, "bench_logs", "SERVING_LOAD.json")
 OUT_CHAOS = os.path.join(REPO, "bench_logs", "SERVING_CHAOS.json")
+OUT_FLEET = os.path.join(REPO, "bench_logs", "SERVING_FLEET.json")
 
 
 def parse_args(argv=None):
@@ -96,13 +108,22 @@ def parse_args(argv=None):
                          "the native route)")
     ap.add_argument("--chaos-p999-ms", type=float, default=10_000.0,
                     help="chaos gate: p999 latency bound")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="ISSUE 13: serve this many mixed-shape tenant "
+                         "models from ONE FleetServer (0 = single-model "
+                         "modes); banks SERVING_FLEET.json incl. the "
+                         "chaos leg")
+    ap.add_argument("--fleet-rows", type=int, default=3000,
+                    help="training rows per fleet tenant")
     ap.add_argument("--out", default=None,
                     help="record path (default SERVING_LOAD.json; "
-                         "SERVING_CHAOS.json under --chaos so the "
+                         "SERVING_CHAOS.json under --chaos / "
+                         "SERVING_FLEET.json under --fleet so the "
                          "banked throughput record is never clobbered)")
     args = ap.parse_args(argv)
     if args.out is None:
-        args.out = OUT_CHAOS if args.chaos else OUT
+        args.out = OUT_FLEET if args.fleet else \
+            (OUT_CHAOS if args.chaos else OUT)
     return args
 
 
@@ -369,6 +390,272 @@ def chaos_route(args, bst, srv, probe):
     return rec, failures
 
 
+def fleet_route(args, record):
+    """Fleet mode (ISSUE 13): N mixed-shape tenants on one FleetServer.
+    Returns (status, note): open-loop Poisson traffic across tenants
+    with mixed request sizes, measuring QPS/percentiles AND the
+    steady-state trace count over the warmed window, then the chaos leg
+    (one tenant's publish_fail + a forced degrade) with exact
+    per-tenant accounting and 0-torn verification."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.analysis import guards
+    from lightgbm_tpu.robustness import faults
+    from lightgbm_tpu.serving import DeadlineExceeded, Overloaded
+    from lightgbm_tpu.serving.metrics import latency_summary_ms
+
+    rng = np.random.default_rng(0)
+    archetypes = [(31, 20, 28), (15, 12, 12), (63, 16, 20), (15, 24, 12)]
+    pools = {f: np.ascontiguousarray(
+        rng.normal(size=(max(args.fleet_rows, 2048), f))
+        .astype(np.float32).astype(np.float64))
+        for f in {a[2] for a in archetypes}}
+    t0 = time.perf_counter()
+    tenants = {}
+    for i in range(args.fleet):
+        leaves, trees, f = archetypes[i % len(archetypes)]
+        X = pools[f][:args.fleet_rows]
+        y = (X[:, 0] * (1 + 0.1 * (i % 7)) +
+             0.5 * X[:, 1] ** 2 > 0.4).astype(np.float32)
+        bst = lgb.train({"objective": "binary", "num_leaves": leaves,
+                         "verbosity": -1}, lgb.Dataset(X, label=y),
+                        num_boost_round=trees,
+                        keep_training_booster=True)
+        tenants[f"t{i:03d}"] = (bst, f)
+    print(f"[load] trained {args.fleet} tenants over "
+          f"{len(archetypes)} archetypes "
+          f"({time.perf_counter() - t0:.1f}s)", flush=True)
+
+    fleet = lgb.serve_fleet({k: b for k, (b, _f) in tenants.items()},
+                            raw_score=True, linger_ms=args.linger_ms,
+                            max_batch=args.max_batch,
+                            num_devices=args.devices,
+                            probe_interval_s=1.0)
+    st = fleet.stats()
+    record["tenants"] = args.fleet
+    record["buckets"] = st["n_buckets"]
+    record["fleet_shard"] = st["fleet_shard"]
+    record["pack_bytes"] = st["pack_bytes"]
+    sizes = sorted({max(args.rows // 2, 1), args.rows, args.rows * 2})
+    keys = list(tenants)
+
+    def request_for(r):
+        k = keys[r.randrange(len(keys))]
+        pool = pools[tenants[k][1]]
+        n = min(sizes[r.randrange(len(sizes))], pool.shape[0])
+        off = r.randrange(0, pool.shape[0] - n + 1)
+        return k, pool[off:off + n]
+
+    # warm every (shape bucket, row bucket) the traffic can touch, then
+    # a short unmeasured traffic burst to warm the COALESCED totals
+    for k in keys:
+        for warm in (200, 500):
+            fleet.predict(k, pools[tenants[k][1]][:warm], timeout=300)
+    r0 = random.Random(5)
+    warm_until = time.perf_counter() + min(2.0, args.duration / 4)
+    while time.perf_counter() < warm_until:
+        k, X = request_for(r0)
+        fleet.predict(k, X, timeout=300)
+
+    # ---- measured window: QPS/percentiles + steady-state traces ------
+    lats, errs = [], []
+    with guards.CompileCounter() as counter:
+        rgen = random.Random(1)
+        pending = []
+        t0 = time.perf_counter()
+        next_t = t0
+        while True:
+            next_t += rgen.expovariate(args.rate)
+            if next_t - t0 > args.duration:
+                break
+            now = time.perf_counter()
+            if next_t > now:
+                time.sleep(next_t - now)
+            k, X = request_for(rgen)
+            try:
+                pending.append((next_t, fleet.submit(k, X)))
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+        for intended, fut in pending:
+            try:
+                fut.result(timeout=120)
+                lats.append(max(fut.t_done - intended, 0.0))
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+        wall = time.perf_counter() - t0
+    record["steady_state_new_traces"] = counter.count
+    if counter.count:
+        record["trace_names"] = counter.names[:8]
+    rec = {"qps": round(len(lats) / wall, 1), "requests": len(lats),
+           "wall_sec": round(wall, 2), "errors": len(errs)}
+    rec.update(latency_summary_ms(lats))
+    if errs:
+        rec["first_error"] = errs[0]
+    record["open_loop"] = rec
+    record["value"] = rec["qps"]
+    print(f"[load] fleet route {rec['qps']:.0f} req/s over "
+          f"{args.fleet} tenants, p50={rec.get('p50_ms')}ms "
+          f"p99={rec.get('p99_ms')}ms p999={rec.get('p999_ms')}ms, "
+          f"{counter.count} new traces", flush=True)
+
+    # ---- chaos leg: one tenant's publish_fail + a forced degrade -----
+    chaos_key = keys[0]
+    chaos_b = tenants[chaos_key][0]
+    probe = {k: pools[tenants[k][1]][:args.rows] for k in keys}
+    expected = {}
+
+    def bank(k):
+        v = fleet._state.routes[k].generation.version
+        expected[(k, v)] = (
+            tenants[k][0].predict(probe[k], device=True, raw_score=True),
+            tenants[k][0].predict(probe[k], raw_score=True))
+
+    for k in keys:
+        bank(k)
+    base = fleet.counters.tenant_snapshot()
+    observed = {k: {"requests": 0, "shed": 0, "expired": 0}
+                for k in keys}
+    results, hard = [], []
+    pub_failures, pub_ok = [], []
+    stop = threading.Event()
+
+    def publisher():
+        while not stop.wait(0.5):
+            try:
+                chaos_b.update()
+                chaos_b.num_trees()          # flush outside the server
+                # bank the NEXT generation's bits BEFORE it can serve —
+                # banking after publish() races the clients (a fast
+                # response on the new generation would read as torn)
+                v = fleet._state.routes[chaos_key].generation.version
+                expected[(chaos_key, v + 1)] = (
+                    chaos_b.predict(probe[chaos_key], device=True,
+                                    raw_score=True),
+                    chaos_b.predict(probe[chaos_key], raw_score=True))
+                fleet.publish(chaos_key)
+                pub_ok.append(1)
+            except Exception as e:  # noqa: BLE001 — rollback keeps serving
+                pub_failures.append(repr(e))
+
+    def degrader():
+        time.sleep(args.duration / 2)
+        fleet.degrade("fleet chaos: forced mid-run degradation")
+
+    lock = threading.Lock()
+
+    def client(ci):
+        r = random.Random(100 + ci)
+        futs = []
+        t0 = time.perf_counter()
+        next_t = t0
+        rate = max(args.rate / max(args.clients, 1), 1e-6)
+        while True:
+            next_t += r.expovariate(rate)
+            if next_t - t0 > args.duration:
+                break
+            now = time.perf_counter()
+            if next_t > now:
+                time.sleep(next_t - now)
+            k = keys[r.randrange(len(keys))]
+            try:
+                futs.append((k, fleet.submit(k, probe[k],
+                                             deadline_ms=8000.0)))
+            except Overloaded:
+                with lock:
+                    observed[k]["shed"] += 1
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    hard.append(repr(e))
+        for k, fut in futs:
+            try:
+                out = fut.result(60)
+                with lock:
+                    observed[k]["requests"] += 1
+                    results.append((k, fut.generation.version, out))
+            except DeadlineExceeded:
+                with lock:
+                    observed[k]["expired"] += 1
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    hard.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.clients)]
+    pub = threading.Thread(target=publisher, daemon=True)
+    deg = threading.Thread(target=degrader, daemon=True)
+    # after=1: the publisher's pre-publish BANKING predict consults the
+    # same publish_fail site first (the solo engine's pack append);
+    # consult #2 is the fleet publish itself — the site under test
+    with faults.inject("publish_fail:after=1:n=1"):
+        pub.start()
+        deg.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(args.duration + 120)
+        stop.set()
+        pub.join(30)
+        deg.join(args.duration)
+    # let the background probe close the degrade round-trip
+    t_end = time.perf_counter() + 30
+    while fleet.stats()["degraded"] and time.perf_counter() < t_end:
+        time.sleep(0.05)
+
+    torn = 0
+    for k, v, out in results:
+        exp = expected.get((k, v))
+        if exp is None or not (np.array_equal(out, exp[0]) or
+                               np.array_equal(out, exp[1])):
+            torn += 1
+    ledger = fleet.counters.tenant_snapshot()
+    stats = fleet.stats()
+    failures = []
+
+    def need(cond, what):
+        if not cond:
+            failures.append(what)
+
+    need(not hard, f"{len(hard)} hard client error(s): {hard[:1]}")
+    need(torn == 0, f"{torn} torn/wrong response(s)")
+    need(len(pub_failures) == 1,
+         f"exactly one failed publish expected "
+         f"(got {len(pub_failures)})")
+    need(ledger[chaos_key]["publish_failures"] -
+         base.get(chaos_key, {}).get("publish_failures", 0) == 1,
+         "the failed publish is not in the chaos tenant's ledger")
+    for k in keys:
+        led = {n: ledger[k][n] - base.get(k, {}).get(n, 0)
+               for n in ("requests", "shed", "expired")}
+        for n in ("requests", "shed", "expired"):
+            need(led[n] == observed[k][n],
+                 f"tenant {k} {n} accounting: server {led[n]} != "
+                 f"client {observed[k][n]}")
+    need(stats["degraded"] is False,
+         "fleet never un-degraded after the forced degradation")
+    need(fleet.counters.get("degrade_events") >= 1 and
+         fleet.counters.get("recoveries") >= 1,
+         "forced degradation/recovery never registered")
+    record["chaos"] = {
+        "responses": len(results), "torn": torn,
+        "publish_failures": len(pub_failures),
+        "publishes_ok": len(pub_ok),
+        "degrade_events": fleet.counters.get("degrade_events"),
+        "recoveries": fleet.counters.get("recoveries"),
+        "tenant_ledger_sample": {k: ledger[k] for k in keys[:3]}}
+    if failures:
+        record["chaos"]["failures"] = failures
+        for f in failures:
+            print(f"[load] FLEET CHAOS FAIL: {f}", file=sys.stderr,
+                  flush=True)
+    print(f"[load] fleet chaos: {len(results)} responses, {torn} torn, "
+          f"{len(pub_failures)} publish failure(s), "
+          f"recoveries={fleet.counters.get('recoveries')}", flush=True)
+    fleet.close()
+    if failures:
+        return "no_result", "; ".join(failures)
+    return ("measured" if not stats["degraded"] else "degraded"), None
+
+
 def route_record(lats, n_done, wall, rows_per_req, errs) -> dict:
     from lightgbm_tpu.serving.metrics import latency_summary_ms
     rec = {"qps": round(n_done / wall, 1),
@@ -408,6 +695,14 @@ def main() -> int:
     try:
         import jax
         record["devices"] = len(jax.devices())
+
+        # ---- fleet mode (ISSUE 13): N tenants, one server -----------
+        if args.fleet:
+            record["metric"] = "serving_fleet_qps"
+            record["mode"] = "open"
+            record["rate"] = args.rate
+            status, note = fleet_route(args, record)
+            return finish(status, note)
         rng = np.random.default_rng(0)
         Xtr = rng.normal(size=(60_000, 28)).astype(np.float32)
         ytr = (Xtr[:, 0] + 0.5 * Xtr[:, 1] ** 2 > 0.5).astype(np.float32)
