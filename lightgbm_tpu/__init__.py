@@ -78,6 +78,10 @@ def __getattr__(name):
                 "serve_fleet"):
         from . import serving as _srv
         return getattr(_srv, name)
+    if name in ("ContinualService", "FrontDoor", "ServerGateway",
+                "serve_continual"):
+        from . import service as _svc
+        return getattr(_svc, name)
     if name in ("plot_importance", "plot_metric", "plot_tree",
                 "create_tree_digraph", "plot_split_value_histogram"):
         from . import plotting as _pl

@@ -55,6 +55,11 @@ _CHOICES: Dict[str, Tuple[str, ...]] = {
     # axis with batches routed to each bucket's owner device (big
     # fleets); auto decides by pack bytes vs the per-device budget.
     "tpu_serving_fleet_shard": ("auto", "replicate", "model"),
+    # continual-learning service (service/, ISSUE 14): where the
+    # resident trainer runs — "process" = supervised child with bounded
+    # relaunch-and-resume (crash-isolated from serving), "thread" =
+    # in-process (tests, single-process deployments).
+    "tpu_service_trainer": ("process", "thread"),
 }
 
 
@@ -393,6 +398,34 @@ _reg("tpu_serving_fleet_pack_budget_mb", float, 256.0, (),
 # one noisy tenant cannot starve the fleet. 0 = no per-tenant quota
 # (the fleet-wide row bound still applies).
 _reg("tpu_serving_fleet_quota_rows", int, 0, (), (0, None, True, False))
+# continual-learning service (lightgbm_tpu/service/, ISSUE 14): one
+# process joining the resident trainer, the publish pump and the HTTP
+# front door. port 0 binds an ephemeral port (ContinualService.frontdoor
+# .port carries the real one).
+_reg("tpu_service_port", int, 0, (), (0, 65535, True, True))
+# rolling training window: the resident trainer boosts on the newest
+# this-many stream rows each cycle (fresh rows push old ones out).
+_reg("tpu_service_window_rows", int, 8192, (), (1, None, True, False))
+# boosting iterations per window refresh cycle.
+_reg("tpu_service_iters_per_cycle", int, 4, (), (1, None, True, False))
+# publish cadence: a checkpoint (the publish channel — the serving
+# process hot-swaps every newly committed one) is committed every this
+# many boosting iterations.
+_reg("tpu_service_publish_iters", int, 4, (), (1, None, True, False))
+# stream/pump poll cadence (seconds): how often the trainer polls the
+# stream for fresh rows and the serving process polls the checkpoint
+# directory for a new generation.
+_reg("tpu_service_poll_sec", float, 0.2, (), (0.0, None, False, False))
+# resident trainer placement: supervised child process (default) or an
+# in-process thread — see _CHOICES.
+_reg("tpu_service_trainer", str, "process", ())
+# front door request-body cap (MB): larger POST bodies are refused with
+# HTTP 413 before any parsing.
+_reg("tpu_service_max_body_mb", float, 64.0, (), (0.0, None, False,
+                                                  False))
+# front door streaming threshold: predict responses over this many rows
+# go out with Transfer-Encoding: chunked instead of one body buffer.
+_reg("tpu_service_chunk_rows", int, 4096, (), (1, None, True, False))
 # device tracing (SURVEY §5 tracing: jax.profiler traces + the named-
 # section wall-clock table ≡ the reference's USE_TIMETAG global_timer).
 # Set to a directory to capture a jax.profiler trace of the training loop

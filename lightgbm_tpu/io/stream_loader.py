@@ -110,6 +110,82 @@ def _quantize_sparse_chunk(bins: np.ndarray, lo: int, n_chunk_rows: int,
                 np.ascontiguousarray(vs[s:e]))
 
 
+class StreamFollower:
+    """Tail-follow a GROWING numeric CSV/TSV file (the continual-learning
+    service's ingest cursor, ISSUE 14 — ``service/trainer.py``).
+
+    The two-round loader above consumes a finished file; a resident
+    trainer instead consumes rows as producers append them. ``poll()``
+    reads only the bytes appended since the last call, consumes up to
+    the last complete line (a torn trailing line — a producer mid-write
+    — is left for the next poll; the producer's own append must be a
+    single ``write`` of whole lines), and parses them with the same
+    native chunk kernel (:func:`~..native.parse_dense_chunk`) the
+    two-round path uses. Column count is locked from the first complete
+    line; short/ragged later lines fail loudly (a corrupt stream must
+    never silently train).
+
+    The cursor state is three numbers — byte ``offset``, ``rows_seen``
+    and ``last_row_time`` (host wall clock of the newest ingested row,
+    the freshness watermark) — small enough to ride inside a training
+    checkpoint.
+    """
+
+    def __init__(self, path: str, sep: str = ",",
+                 n_cols: Optional[int] = None):
+        self.path = path
+        self.sep = sep
+        self.n_cols = n_cols
+        self.offset = 0
+        self.rows_seen = 0
+        self.last_row_time: Optional[float] = None
+
+    def poll(self, max_bytes: int = 64 << 20) -> Optional[np.ndarray]:
+        """New complete rows as an [n, n_cols] f64 matrix (None when
+        nothing new). Bounded by ``max_bytes`` per call so a huge
+        backlog cannot stall the caller's loop indefinitely."""
+        import time as _time
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return None
+        if size <= self.offset:
+            return None
+        with open(self.path, "rb") as f:
+            f.seek(self.offset)
+            blob = f.read(min(size - self.offset, max_bytes))
+        nl = blob.rfind(b"\n")
+        if nl < 0:
+            return None                    # only a torn partial line yet
+        blob = blob[:nl + 1]
+        if self.n_cols is None:
+            first = blob.split(b"\n", 1)[0]
+            self.n_cols = first.decode("utf-8", "replace").count(
+                self.sep) + 1
+        # structural guard BEFORE parsing: every complete line must
+        # carry exactly n_cols-1 separators. The aggregate count catches
+        # a short/ragged line (a non-atomic producer write) that would
+        # otherwise parse with NaN-filled tail columns and silently
+        # train as missing values.
+        n_lines = blob.count(b"\n")
+        seps = blob.count(self.sep.encode())
+        if seps != n_lines * (self.n_cols - 1):
+            raise ValueError(
+                f"stream {self.path}: ragged line(s) after byte "
+                f"{self.offset} ({seps} separators over {n_lines} "
+                f"lines; column count locked at {self.n_cols}) — "
+                "producers must append whole lines atomically")
+        mat = parse_dense_chunk(blob, self.sep, self.n_cols)
+        if np.isnan(mat).all(axis=1).any():
+            raise ValueError(
+                f"stream {self.path}: unparseable row(s) after byte "
+                f"{self.offset} (column count locked at {self.n_cols})")
+        self.offset += nl + 1
+        self.rows_seen += len(mat)
+        self.last_row_time = _time.time()
+        return mat
+
+
 def load_binned_two_round(path: str, config: Config,
                           categorical_feature=None,
                           reference: Optional[BinnedDataset] = None,

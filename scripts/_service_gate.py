@@ -1,0 +1,82 @@
+"""Shared harness pieces for the continual-service gates (ISSUE 14).
+
+ONE copy of (a) the synthetic stream producer and (b) the
+torn-response/monotone-generation/staleness verification pass, used by
+both ``scripts/service_smoke.py`` (check.sh, thread trainer) and
+``scripts/serving_load.py --live`` (freshness chaos gate, supervised
+child trainer + injected crash). The bit-match contract — map a
+response's generation to its training iteration via
+``svc.freshness(version)``, load THAT checkpoint's model, accept its
+device-route or host-walk bits — must never drift between the two
+gates, which is exactly what a second copy would eventually do.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+import numpy as np
+
+
+def synth_rows(rng, n: int, f: int = 6) -> np.ndarray:
+    """[n, 1+f] block of ``label, features...`` rows (binary target)."""
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    return np.column_stack([y, X])
+
+
+def append_rows(path: str, block: np.ndarray) -> None:
+    """Append whole lines atomically enough for the follower's
+    torn-tail contract (one write call of complete lines)."""
+    with open(path, "a") as fh:
+        fh.write("\n".join(",".join(repr(float(v)) for v in r)
+                           for r in block) + "\n")
+
+
+def verify_responses(svc, ckpt_dir: str, probe: np.ndarray,
+                     responses: Iterable[Tuple[int, int, np.ndarray,
+                                               float]],
+                     failures: List[str]) -> Tuple[int, int]:
+    """The torn/monotone/staleness pass over ``(client, generation,
+    scores, staleness_ms)`` records.
+
+    Every response must bit-match ITS generation's checkpointed model —
+    either the device route or the host walk (both are legitimate
+    bit-exact routes; the PR9 chaos-gate contract) — with generations
+    monotone per client and staleness non-negative. Responses whose
+    checkpoint was already pruned count as ``unverifiable`` (the caller
+    bounds the tolerable fraction). Appends human-readable failure
+    strings to ``failures``; returns ``(torn, unverifiable)``."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.robustness.checkpoint import (list_checkpoints,
+                                                    read_checkpoint)
+
+    by_iter = {it: read_checkpoint(p)["model"]
+               for it, p in list_checkpoints(ckpt_dir)}
+    expected = {}
+    torn = unverifiable = 0
+    last_by_client = {}
+    backwards = set()
+    for ci, v, out, stale in responses:
+        if v < last_by_client.get(ci, 0) and ci not in backwards:
+            backwards.add(ci)
+            failures.append(
+                f"client {ci} saw generations move backwards")
+        last_by_client[ci] = max(last_by_client.get(ci, 0), v)
+        if stale < 0:
+            failures.append(f"negative staleness {stale}")
+        mark = svc.freshness(v)
+        model = by_iter.get(mark["iteration"]) if mark else None
+        if model is None:
+            unverifiable += 1
+            continue
+        if v not in expected:
+            b = lgb.Booster(model_str=model)
+            expected[v] = (
+                b.predict(probe, device=True, raw_score=True),
+                b.predict(probe, raw_score=True))
+        dev, host = expected[v]
+        if not (np.array_equal(out, dev) or np.array_equal(out, host)):
+            torn += 1
+    if torn:
+        failures.append(f"{torn} torn response(s)")
+    return torn, unverifiable

@@ -126,6 +126,20 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
+echo "== service smoke (continual train-and-serve join, CPU) =="
+# ISSUE 14: the full continual-learning service — resident trainer on a
+# growing synthetic stream, publish pump hot-swapping each committed
+# checkpoint into the live server, HTTP front door — must publish >= 2
+# generations UNDER live HTTP traffic with 0 torn responses (every
+# response bit-matches its generation's checkpointed model), monotonic
+# generations and sane staleness, then shut down cleanly.
+timeout -k 10 150 env JAX_PLATFORMS=cpu \
+    python scripts/service_smoke.py || rc=1
+if [ $rc -ne 0 ]; then
+    echo "check.sh: service smoke failed — skipping tier-1 pytest" >&2
+    exit $rc
+fi
+
 echo "== hist smoke (sorted-segment level kernel parity + fallback, CPU) =="
 # ISSUE 6: the one-launch pallas_level kernel must be bit-identical to
 # the blocks/scatter formulations on ragged segments (f32 dyadic +

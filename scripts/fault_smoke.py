@@ -36,7 +36,19 @@ import tempfile
 import threading
 import time
 
-os.environ.setdefault("XLA_FLAGS", "")
+# The COLLECTIVE leg runs a 2-thread in-process world whose injected
+# allreduce rendezvouses INSIDE two concurrently executing jitted
+# programs (io_callback). A 1-device CPU client sizes its host-callback
+# executor for one device — on a 1-core box the second rank's callback
+# then queues behind the first rank's blocked one and the rendezvous
+# can never complete (rank 0 wedges to CollectiveTimeout, the peer
+# takes the abort — the PR13-noted regression: this box shrank to one
+# core). Force >= 2 virtual CPU devices BEFORE jax initializes, exactly
+# like tests/conftest.py does for tier-1.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2").strip()
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 # fast retry budget for the smoke (read per call site)
@@ -88,6 +100,89 @@ def smoke_write_kill() -> None:
     np.testing.assert_array_equal(resumed.predict(X), full.predict(X))
 
 
+class _RoundRendezvous:
+    """Retry-safe 2-worker in-process allreduce for the fault smoke.
+
+    The PR13-noted regression ("rank 0 wedges 300 s to
+    CollectiveTimeout in the injected reduce_max, peer hits
+    BrokenBarrierError under collective:p=0.2") had TWO causes:
+
+    1. **Environment** (the actual trigger): this box shrank to one
+       core, and a 1-device CPU client serializes host callbacks — the
+       second rank's in-jit io_callback queues behind the first rank's
+       blocked one, so ANY blocking 2-party rendezvous deadlocks.
+       Fixed at the top of this file by forcing >= 2 virtual CPU
+       devices before jax initializes (the conftest discipline).
+    2. **Harness fragility**: the old transport reused ONE
+       ``threading.Barrier`` for the entry AND exit rendezvous of
+       every collective, so a fired fault's retry interleaving with
+       the peer's waits could drift the ranks a barrier GENERATION
+       apart — wedging one rank alone at a barrier.
+
+    This transport closes (2) structurally: each successful call
+    advances a per-rank round counter, every wait is a
+    condition-variable predicate on THAT round's blackboard (never a
+    generation-counting barrier), and the per-round result is computed
+    exactly once and cached until both ranks consumed it. A fired
+    fault leaves the round state untouched and the retry joins the
+    same round — no interleaving can desync the ranks. ``abort()``
+    fails every waiter loudly (peer died) instead of letting it wedge
+    to the collective deadline.
+    """
+
+    def __init__(self, world: int = 2):
+        self.world = world
+        self.cv = threading.Condition()
+        self.rounds = [0] * world      # next round index per rank
+        self.posted = {}               # round -> {rank: array}
+        self.results = {}              # round -> reduced array
+        self.consumed = {}             # round -> ranks done
+        self.broken = None
+
+    def abort(self, why: str) -> None:
+        with self.cv:
+            self.broken = why
+            self.cv.notify_all()
+
+    def __call__(self, rank, a, op):
+        with self.cv:
+            r = self.rounds[rank]
+            self.posted.setdefault(r, {})[rank] = np.asarray(a).copy()
+            self.cv.notify_all()
+            while len(self.posted.get(r, ())) < self.world \
+                    and r not in self.results:
+                if self.broken:
+                    # deliberately free of transient-classifier keywords
+                    # (UNAVAILABLE / ABORTED / timeout): a dead peer is
+                    # terminal for this harness, the survivor must fail
+                    # fast, not spin its retry budget against an empty
+                    # chair
+                    raise RuntimeError(
+                        f"rendezvous halted ({self.broken})")
+                # no rendezvous-level timeout: a slow peer (a >60 s
+                # grower compile on a loaded 1-core box) is NOT dead;
+                # peer death arrives via abort(), a genuine wedge via
+                # the 300 s collective liveness deadline that wraps
+                # every attempt (distributed.call_with_deadline)
+                self.cv.wait(timeout=5.0)
+            if r not in self.results:
+                vals = [self.posted[r][k] for k in range(self.world)]
+                if op == "sum":
+                    out = sum(v.astype(np.float64) for v in vals)
+                else:
+                    out = vals[0]
+                    for v in vals[1:]:
+                        out = np.maximum(out, v)
+                self.results[r] = out.astype(a.dtype)
+            out = self.results[r]
+            self.rounds[rank] += 1
+            done = self.consumed.setdefault(r, set())
+            done.add(rank)
+            if len(done) == self.world:    # bounded memory per run
+                del self.posted[r], self.results[r], self.consumed[r]
+            return out
+
+
 def smoke_collective() -> None:
     from lightgbm_tpu.distributed import (clear_collectives,
                                           inject_collectives)
@@ -109,16 +204,13 @@ def smoke_collective() -> None:
     pred_c = lgb.train(dict(params), full,
                        num_boost_round=rounds).predict(X)
 
-    barrier = threading.Barrier(2)
-    bufs = [None, None]
-
-    def allreduce(rank, a, op):
-        bufs[rank] = np.asarray(a).copy()
-        barrier.wait()
-        out = bufs[0].astype(np.float64) if op == "sum" else bufs[0]
-        out = (out + bufs[1]) if op == "sum" else np.maximum(out, bufs[1])
-        barrier.wait()
-        return out.astype(a.dtype)
+    allreduce = _RoundRendezvous(2)
+    # a peer mid-compile on a loaded 1-core box is slow, not dead: give
+    # the liveness deadline real headroom for this leg (peer DEATH is
+    # still fast — the rendezvous aborts every waiter the moment a rank
+    # exits; the deadline only backstops a genuine wedge)
+    from lightgbm_tpu.distributed import set_collective_timeout
+    set_collective_timeout(900.0)
 
     boosters = [None, None]
     for rank in range(2):
@@ -139,18 +231,19 @@ def smoke_collective() -> None:
                 boosters[rank].update()
         except Exception as e:
             errs.append((rank, e))
-            try:
-                barrier.abort()
-            except Exception:
-                pass
+            allreduce.abort(f"peer rank {rank} exited")
 
-    with faults.inject("collective:p=0.2:seed=5:n=100000") as plan:
-        ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join(timeout=300)
-        fired = plan.faults["collective"].fired
+    try:
+        with faults.inject("collective:p=0.2:seed=5:n=100000") as plan:
+            ts = [threading.Thread(target=run, args=(r,))
+                  for r in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=900)
+            fired = plan.faults["collective"].fired
+    finally:
+        set_collective_timeout(0)
     assert not errs, errs
     assert fired > 0, "collective fault never fired — vacuous smoke"
     assert boosters[0].model_to_string() == boosters[1].model_to_string()

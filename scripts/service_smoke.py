@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Continual-learning service smoke (ISSUE 14) — the <30 s check.sh
+gate for the train-and-serve join:
+
+- boot the full service (resident trainer + publish pump + HTTP front
+  door) on a synthetic stream that keeps producing rows;
+- drive live HTTP traffic (npy bodies — bit-exact f64 on the wire)
+  while the trainer publishes; require >= 2 NEW generations to land
+  mid-traffic;
+- verify 0 torn responses: every response's scores must bit-match the
+  checkpointed model of the generation named in its headers (device or
+  degraded-host bits — the chaos-gate contract), with generations
+  monotonic per client and staleness present and sane;
+- clean shutdown: close() drains, the trainer stops, and a post-close
+  request is refused instead of hanging.
+
+The trainer runs IN-THREAD here (budget: a supervised child pays a
+subprocess boot per launch; the crash/relaunch leg is gated by
+scripts/serving_load.py --live and tests/test_service.py instead).
+"""
+import io
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("XLA_FLAGS", "")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from _service_gate import (append_rows, synth_rows,  # noqa: E402
+                           verify_responses)
+
+BUDGET_SEC = 30.0
+PARAMS = dict(objective="binary", num_leaves=15, learning_rate=0.1,
+              verbose=-1, seed=5)
+
+
+def _post_npy(url, X, timeout=60):
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(X, np.float64), allow_pickle=False)
+    req = urllib.request.Request(
+        url, data=buf.getvalue(),
+        headers={"Content-Type": "application/x-npy"})
+    r = urllib.request.urlopen(req, timeout=timeout)
+    return np.load(io.BytesIO(r.read()), allow_pickle=False), r.headers
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    rng = np.random.default_rng(0)
+    d = tempfile.mkdtemp(prefix="lgbm_service_smoke_")
+    stream = os.path.join(d, "rows.csv")
+    ck = os.path.join(d, "ck")
+    append_rows(stream, synth_rows(rng, 700))
+
+    svc = lgb.serve_continual(
+        dict(PARAMS), stream, ck, trainer_mode="thread",
+        window_rows=900, min_rows=256, iters_per_cycle=2,
+        publish_every_iters=2, target_iterations=40, raw_score=True,
+        boot_timeout_s=120, poll_sec=0.05,
+        keep_last=64)   # the torn check reads every generation back
+    boot_gen = svc.generation.version
+    print(f"service_smoke: booted gen v{boot_gen} "
+          f"({time.monotonic() - t0:.1f}s) at {svc.frontdoor.address}")
+
+    probe = synth_rows(np.random.default_rng(99),
+                       32)[:, 1:].astype(np.float64)
+    url = svc.frontdoor.address + "/v1/predict"
+    stop = threading.Event()
+    responses, errors = [], []
+
+    def producer():
+        while not stop.wait(0.1):
+            append_rows(stream, synth_rows(rng, 60))
+
+    def client(ci):
+        while not stop.is_set():
+            try:
+                out, hdr = _post_npy(url, probe)
+                responses.append(
+                    (ci, int(hdr["X-Model-Generation"]), out,
+                     float(hdr["X-Staleness-Ms"])))
+            except Exception as e:  # noqa: BLE001 — the gate reports
+                errors.append(repr(e))
+                return
+            time.sleep(0.02)
+
+    threads = [threading.Thread(target=producer, daemon=True)] + \
+        [threading.Thread(target=client, args=(i,), daemon=True)
+         for i in range(3)]
+    for t in threads:
+        t.start()
+    # traffic window: until 2 generations past boot or 15 s
+    t_end = time.monotonic() + 15.0
+    while time.monotonic() < t_end and \
+            svc.generation.version < boot_gen + 2:
+        time.sleep(0.1)
+    lived_gens = svc.generation.version - boot_gen
+    stop.set()
+    for t in threads:
+        t.join(30)
+
+    failures = []
+    if errors:
+        failures.append(f"{len(errors)} client error(s): {errors[:2]}")
+    if lived_gens < 2:
+        failures.append(f"only {lived_gens} generations published under "
+                        "traffic (need >= 2)")
+    if not responses:
+        failures.append("no responses")
+
+    # torn check: every response bit-matches ITS generation's
+    # checkpointed model (device route or host walk — either is a
+    # legitimate bit-exact route, the chaos-gate contract); ONE shared
+    # verification pass with the --live chaos gate (_service_gate.py)
+    torn, unverifiable = verify_responses(svc, ck, probe, responses,
+                                          failures)
+    if unverifiable > len(responses) // 2:
+        failures.append(f"{unverifiable}/{len(responses)} responses "
+                        "unverifiable (checkpoints pruned too fast)")
+
+    # clean shutdown/drain: close, then the door must refuse not hang
+    svc.close(timeout=30)
+    try:
+        _post_npy(url, probe, timeout=10)
+        failures.append("post-close request was served")
+    except Exception:  # noqa: BLE001 — refused/unreachable is correct
+        pass
+    if svc.trainer.alive:
+        failures.append("trainer still alive after close()")
+
+    took = time.monotonic() - t0
+    print(f"service_smoke: {len(responses)} responses over "
+          f"{lived_gens} live generations, {torn} torn, "
+          f"{unverifiable} unverifiable, staleness p50 "
+          f"{np.median([s for *_x, s in responses]) if responses else 0:.0f}ms "
+          f"({took:.1f}s)")
+    if took > BUDGET_SEC:
+        print(f"service_smoke: over the {BUDGET_SEC:.0f}s budget "
+              f"({took:.1f}s) — advisory on a cold compile cache",
+              file=sys.stderr)
+    if failures:
+        for f in failures:
+            print(f"service_smoke: FAIL: {f}", file=sys.stderr)
+        return 1
+    print("service_smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

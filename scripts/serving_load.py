@@ -47,13 +47,28 @@ degrade; verified: 0 torn responses per tenant against that tenant's
 device or host bits, exact per-tenant counter accounting) to
 ``bench_logs/SERVING_FLEET.json`` in the shared _bench_io grammar.
 
+Live mode (``--live``, ISSUE 14 — the freshness chaos gate): boots the
+FULL continual-learning service (resident trainer in a SUPERVISED child
+process, publish pump, HTTP front door) on a synthetic stream that keeps
+producing rows, then drives open-loop Poisson HTTP traffic while the
+trainer publishes continuously AND one injected trainer crash
+(``rank_kill`` on launch 1 only; the gang supervisor relaunches and the
+trainer resumes from its newest committed checkpoint). The gate FAILS
+(status no_result) unless: 0 torn responses (every response bit-matches
+its generation's checkpointed model — device or host bits), per-client
+generations move forward only with the published set gapless, >= 2
+generations land AFTER the crash (the relaunch proved itself), and the
+wire carried staleness on every response. Banks QPS + latency
+percentiles + measured model-staleness p50/p99 to
+``bench_logs/SERVING_LIVE.json`` in the shared _bench_io grammar.
+
 Usage:
   python scripts/serving_load.py [--clients 8] [--rows 64]
       [--duration 10] [--mode closed|open] [--rate 200]
       [--devices 2] [--trees 60] [--leaves 31] [--linger-ms 2]
       [--publish-every 0] [--skip-native] [--deadline-ms 0]
       [--max-queue-rows 0] [--chaos] [--chaos-p999-ms 10000]
-      [--fleet N] [--fleet-rows 3000]
+      [--fleet N] [--fleet-rows 3000] [--live] [--live-crash-iter 6]
 
 --devices D > 1 on a CPU host re-execs with D virtual XLA devices;
 an already-set JAX_PLATFORMS (e.g. a TPU session) is honored.
@@ -74,6 +89,7 @@ REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 OUT = os.path.join(REPO, "bench_logs", "SERVING_LOAD.json")
 OUT_CHAOS = os.path.join(REPO, "bench_logs", "SERVING_CHAOS.json")
 OUT_FLEET = os.path.join(REPO, "bench_logs", "SERVING_FLEET.json")
+OUT_LIVE = os.path.join(REPO, "bench_logs", "SERVING_LIVE.json")
 
 
 def parse_args(argv=None):
@@ -115,15 +131,28 @@ def parse_args(argv=None):
                          "chaos leg")
     ap.add_argument("--fleet-rows", type=int, default=3000,
                     help="training rows per fleet tenant")
+    ap.add_argument("--live", action="store_true",
+                    help="ISSUE 14 freshness chaos gate: the full "
+                         "continual-learning service (supervised child "
+                         "trainer + HTTP front door) under Poisson "
+                         "HTTP load, continuous publishes and one "
+                         "injected trainer crash; banks "
+                         "SERVING_LIVE.json")
+    ap.add_argument("--live-crash-iter", type=int, default=6,
+                    help="inject the trainer crash after this many "
+                         "boosting iterations of launch 1 (0 = no "
+                         "crash)")
     ap.add_argument("--out", default=None,
                     help="record path (default SERVING_LOAD.json; "
                          "SERVING_CHAOS.json under --chaos / "
-                         "SERVING_FLEET.json under --fleet so the "
+                         "SERVING_FLEET.json under --fleet / "
+                         "SERVING_LIVE.json under --live so the "
                          "banked throughput record is never clobbered)")
     args = ap.parse_args(argv)
     if args.out is None:
-        args.out = OUT_FLEET if args.fleet else \
-            (OUT_CHAOS if args.chaos else OUT)
+        args.out = OUT_LIVE if args.live else \
+            (OUT_FLEET if args.fleet else
+             (OUT_CHAOS if args.chaos else OUT))
     return args
 
 
@@ -656,6 +685,225 @@ def fleet_route(args, record):
     return ("measured" if not stats["degraded"] else "degraded"), None
 
 
+def live_route(args, record):
+    """ISSUE 14 freshness chaos gate. Returns (status, note).
+
+    Topology: a SUPERVISED child-process trainer boosting on a rolling
+    window of a growing synthetic stream; the serving process's publish
+    pump hot-swaps each committed checkpoint; open-loop Poisson HTTP
+    clients hit the front door with npy bodies (bit-exact f64 wire).
+    One injected ``rank_kill`` fires on trainer launch 1 only — the
+    supervisor relaunches, the trainer resumes, publishes continue.
+    Verified: 0 torn responses, per-client monotone + gapless published
+    generations, >= 2 post-crash generations, staleness on every
+    response; banked: QPS, latency p50/p99/p999, model-staleness
+    p50/p99."""
+    import io as _io
+    import tempfile
+    import urllib.request
+
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving.metrics import latency_summary_ms
+    from _service_gate import append_rows, synth_rows, verify_responses
+
+    rng = np.random.default_rng(0)
+    d = tempfile.mkdtemp(prefix="lgbm_serving_live_")
+    stream = os.path.join(d, "rows.csv")
+    ck = os.path.join(d, "ck")
+
+    def rows(n):
+        return synth_rows(rng, n, f=8)
+
+    def append(block):
+        append_rows(stream, block)
+
+    append(rows(1200))
+    crash = int(args.live_crash_iter)
+    t0 = time.perf_counter()
+    svc = lgb.serve_continual(
+        {"objective": "binary", "num_leaves": args.leaves,
+         "verbosity": -1},
+        stream, ck, trainer_mode="process", window_rows=2000,
+        min_rows=512, iters_per_cycle=2, publish_every_iters=2,
+        target_iterations=0, raw_score=True, boot_timeout_s=600,
+        poll_sec=0.1, keep_last=256,
+        serve_kwargs=dict(linger_ms=args.linger_ms,
+                          max_batch=args.max_batch),
+        attempt_env=lambda i: (
+            {"LGBM_TPU_FAULTS":
+             f"rank_kill:rank=0:after={max(crash - 1, 0)}"}
+            if (i == 0 and crash) else {"LGBM_TPU_FAULTS": ""}))
+    record["boot_sec"] = round(time.perf_counter() - t0, 1)
+    record["trainer_mode"] = "process"
+    record["crash_iteration"] = crash
+    try:
+        return _live_route_body(args, record, svc, rows, append, crash)
+    finally:
+        # ANY raise after boot must still stop the supervised child —
+        # target_iterations=0 means an orphan polls its tmpdir stream
+        # and commits checkpoints forever (close() is idempotent)
+        svc.close()
+
+
+def _live_route_body(args, record, svc, rows, append, crash):
+    import io as _io
+    import urllib.request
+
+    import numpy as np
+    import lightgbm_tpu as lgb  # noqa: F401 — verify_responses path
+    from lightgbm_tpu.serving.metrics import latency_summary_ms
+    from _service_gate import verify_responses
+
+    ck = svc.ckpt_dir
+    url = svc.frontdoor.address + "/v1/predict"
+    probe = rows(args.rows)[:, 1:].astype(np.float64)
+    buf = _io.BytesIO()
+    np.save(buf, probe, allow_pickle=False)
+    payload = buf.getvalue()
+    print(f"[load] live service booted in {record['boot_sec']}s "
+          f"(gen v{svc.generation.version}) at {url}", flush=True)
+
+    stop = threading.Event()
+
+    def producer():
+        while not stop.wait(0.15):
+            append(rows(80))
+
+    lock = threading.Lock()
+    responses, hard = [], []
+
+    def client(ci):
+        r = random.Random(500 + ci)
+        rate = max(args.rate / max(args.clients, 1), 1e-6)
+        t0 = time.perf_counter()
+        next_t = t0
+        while True:
+            next_t += r.expovariate(rate)
+            if next_t - t0 > args.duration:
+                return
+            now = time.perf_counter()
+            if next_t > now:
+                time.sleep(next_t - now)
+            try:
+                req = urllib.request.Request(
+                    url, data=payload,
+                    headers={"Content-Type": "application/x-npy"})
+                resp = urllib.request.urlopen(req, timeout=60)
+                out = np.load(_io.BytesIO(resp.read()),
+                              allow_pickle=False)
+                with lock:
+                    responses.append((
+                        ci, int(resp.headers["X-Model-Generation"]),
+                        out,
+                        float(resp.headers["X-Staleness-Ms"]),
+                        time.perf_counter() - next_t))
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    hard.append(repr(e))
+
+    prod = threading.Thread(target=producer, daemon=True)
+    clients = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.clients)]
+    relaunch_seen_at_gen = None
+    t_wall = time.perf_counter()
+    prod.start()
+    for t in clients:
+        t.start()
+    while any(t.is_alive() for t in clients):
+        if relaunch_seen_at_gen is None and svc.trainer.relaunches:
+            relaunch_seen_at_gen = svc.generation.version
+        time.sleep(0.2)
+    for t in clients:
+        t.join(60)
+    # let post-crash publishes land before stopping the world
+    t_end = time.perf_counter() + 60
+    while crash and time.perf_counter() < t_end:
+        if relaunch_seen_at_gen is None and svc.trainer.relaunches:
+            relaunch_seen_at_gen = svc.generation.version
+        if relaunch_seen_at_gen is not None and \
+                svc.generation.version >= relaunch_seen_at_gen + 2:
+            break
+        time.sleep(0.2)
+    stop.set()
+    wall = time.perf_counter() - t_wall
+    stats = svc.stats()
+    final_gen = svc.generation.version
+    trainer = svc.trainer.describe()
+
+    # ---- verification ------------------------------------------------
+    failures = []
+
+    def need(cond, what):
+        if not cond:
+            failures.append(what)
+
+    # ONE shared torn/monotone/staleness pass with service_smoke.py
+    # (_service_gate.py — the bit-match contract must not drift)
+    torn, unverifiable = verify_responses(
+        svc, ck, probe,
+        ((ci, v, out, stale) for ci, v, out, stale, _lat in responses),
+        failures)
+    served_versions = sorted({v for _c, v, *_r in responses})
+    need(not hard, f"{len(hard)} hard client error(s): {hard[:2]}")
+    need(responses, "no responses")
+    need(unverifiable <= len(responses) // 2,
+         f"{unverifiable}/{len(responses)} unverifiable")
+    # gapless: the pump's version counter only advances on a successful
+    # publish, so served versions must be a subset of 1..final with no
+    # version the service cannot account a watermark for
+    need(all(1 <= v <= final_gen for v in served_versions),
+         f"served versions {served_versions} outside 1..{final_gen}")
+    need(all(svc.freshness(v) is not None for v in served_versions),
+         "a served generation has no watermark entry")
+    if crash:
+        need(trainer.get("relaunches", 0) >= 1,
+             f"injected trainer crash never relaunched: {trainer}")
+        need(relaunch_seen_at_gen is not None and
+             final_gen >= relaunch_seen_at_gen + 2,
+             f"fewer than 2 generations after the relaunch "
+             f"(at-relaunch v{relaunch_seen_at_gen}, final "
+             f"v{final_gen})")
+        need(stats["service"]["publish_errors"] == 0,
+             f"{stats['service']['publish_errors']} publish error(s)")
+
+    lat = latency_summary_ms([lt for *_a, lt in responses])
+    stale_ms = sorted(s for _c, _v, _o, s, _l in responses)
+    rec = {"responses": len(responses),
+           "qps": round(len(responses) / wall, 1),
+           "wall_sec": round(wall, 2), "torn": torn,
+           "unverifiable": unverifiable,
+           "generations_served": served_versions,
+           "final_generation": final_gen,
+           "served_iteration": stats["service"]["served_iteration"],
+           "publishes": stats["service"]["publishes"],
+           "trainer": trainer,
+           "relaunch_seen_at_gen": relaunch_seen_at_gen}
+    rec.update(lat)
+    if stale_ms:
+        from lightgbm_tpu.serving.metrics import percentile
+        rec["staleness_p50_ms"] = round(percentile(stale_ms, 50.0), 1)
+        rec["staleness_p99_ms"] = round(percentile(stale_ms, 99.0), 1)
+        rec["staleness_max_ms"] = round(stale_ms[-1], 1)
+    record["live"] = rec
+    record["value"] = rec["qps"]
+    record["degraded"] = bool(stats.get("degraded"))
+    print(f"[load] live route {rec['qps']:.1f} req/s, "
+          f"{len(responses)} responses over generations "
+          f"{served_versions[:1]}..{served_versions[-1:]}, {torn} torn, "
+          f"relaunches={trainer.get('relaunches')}, staleness "
+          f"p50={rec.get('staleness_p50_ms')}ms "
+          f"p99={rec.get('staleness_p99_ms')}ms, "
+          f"p99 lat={rec.get('p99_ms')}ms", flush=True)
+    if failures:
+        record["live"]["failures"] = failures
+        for f in failures:
+            print(f"[load] LIVE CHAOS FAIL: {f}", file=sys.stderr,
+                  flush=True)
+        return "no_result", "; ".join(failures)
+    return ("degraded" if record["degraded"] else "measured"), None
+
+
 def route_record(lats, n_done, wall, rows_per_req, errs) -> dict:
     from lightgbm_tpu.serving.metrics import latency_summary_ms
     rec = {"qps": round(n_done / wall, 1),
@@ -695,6 +943,14 @@ def main() -> int:
     try:
         import jax
         record["devices"] = len(jax.devices())
+
+        # ---- live mode (ISSUE 14): continual service over HTTP ------
+        if args.live:
+            record["metric"] = "serving_live_qps"
+            record["mode"] = "open"
+            record["rate"] = args.rate
+            status, note = live_route(args, record)
+            return finish(status, note)
 
         # ---- fleet mode (ISSUE 13): N tenants, one server -----------
         if args.fleet:

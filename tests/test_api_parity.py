@@ -142,6 +142,7 @@ def test_trees_to_dataframe_categorical(rng):
                for v in cat_rows["threshold"])
 
 
+@pytest.mark.slow
 def test_cvbooster_save_load(rng, tmp_path):
     X, y = _ds(rng)
     res = lgb.cv({"objective": "binary", "verbose": -1,

@@ -152,6 +152,7 @@ def test_async_first_iteration_degenerate_terminal_flush():
     assert abs(out["false"][1] - 0.75) < 0.05   # base rate, not 0.5
 
 
+@pytest.mark.slow
 def test_async_randomized_config_sweep():
     """Property sweep: random hyperparameter combinations must produce
     equivalent models in async and sync modes. Exact threshold-bin
@@ -206,6 +207,7 @@ def test_async_model_io_roundtrip():
                                atol=1e-6)
 
 
+@pytest.mark.slow
 def test_async_fallback_features_use_sync():
     """Features requiring per-iteration host work silently fall back."""
     X, y = _data()
@@ -219,6 +221,7 @@ def test_async_fallback_features_use_sync():
         assert not eng._pending  # nothing left on device
 
 
+@pytest.mark.slow
 def test_async_goss_device_sampling():
     """GOSS stays on the async path via the device sampler (stateless
     jax keys — a valid GOSS draw, not bit-identical to the host RNG).
@@ -264,9 +267,11 @@ _SHARD_HIST_XFAIL = pytest.mark.xfail(
 
 @pytest.mark.parametrize("learner", [
     pytest.param("data", marks=_SHARD_HIST_XFAIL),
-    pytest.param("voting", marks=_SHARD_HIST_XFAIL),
-    "feature",
+    pytest.param("voting", marks=(_SHARD_HIST_XFAIL,
+                                   pytest.mark.slow)),
+    pytest.param("feature", marks=pytest.mark.slow),
 ])
+@pytest.mark.slow
 def test_async_distributed_learners_match_serial_sync(learner):
     """Async composes with every sharded learner: async on the 8-device
     mesh must match serial sync structure-for-structure (the learners'
@@ -323,6 +328,7 @@ def test_async_continued_training_matches_sync():
     assert out["true"] == out["false"]
 
 
+@pytest.mark.slow
 def test_async_early_stopping_flow():
     """early_stopping callback over a valid set stops at the same
     iteration in async and sync modes."""
@@ -342,6 +348,7 @@ def test_async_early_stopping_flow():
     assert best["true"] == best["false"]
 
 
+@pytest.mark.slow
 def test_async_device_bagging_optin():
     """tpu_device_bagging: the mask draws on device (approximate
     fraction, stateless keys); the model still trains well and the

@@ -1,5 +1,6 @@
 """Auxiliary subsystems (SURVEY §5): jax.profiler tracing hook, the
 multi-host entry points, and the generated parameter docs."""
+import pytest
 import os
 
 import sys
@@ -9,6 +10,7 @@ import numpy as np
 import lightgbm_tpu as lgb
 
 
+@pytest.mark.slow
 def test_profiler_trace_capture(rng, tmp_path):
     X = rng.normal(size=(2000, 6))
     y = X[:, 0]

@@ -12,6 +12,7 @@ path (ref: src/io/dataset.cpp:251). These tests pin:
 - the storage claim itself (bins stays None, [G, R] much smaller),
 - ensure_logical_bins reconstruction parity and the subset/cv path.
 """
+import pytest
 import numpy as np
 import scipy.sparse as sp
 
@@ -77,6 +78,7 @@ def test_pack_parity_nonzero_default_fallback(rng):
         pack_bins(ds.bins, info))
 
 
+@pytest.mark.slow
 def test_sparse_dataset_goes_direct_and_matches_dense(rng):
     X, y, _ = _onehot_csr(rng)
     params = {"objective": "regression", "num_leaves": 15,
@@ -110,6 +112,7 @@ def test_ensure_logical_reconstruction(rng):
     np.testing.assert_array_equal(rec, ds_dense.bins)
 
 
+@pytest.mark.slow
 def test_grouped_subset_and_cv(rng):
     X, y, _ = _onehot_csr(rng, n=3000)
     res = lgb.cv({"objective": "regression", "num_leaves": 7,

@@ -51,6 +51,7 @@ def test_c_train_harness(tmp_path):
     assert "C-TRAIN-OK" in out.stdout
 
 
+@pytest.mark.slow
 def test_c_wave2_harness(tmp_path):
     """Wave-2 C surface end-to-end: streaming creation, CSC, dataset
     ops, introspection, single-row fast (multi-threaded), contrib +
@@ -81,6 +82,7 @@ def test_c_wave2_harness(tmp_path):
     assert "C-WAVE2-OK" in out.stdout
 
 
+@pytest.mark.slow
 def test_c_train_concurrent_harness(tmp_path):
     """Per-handle locking: independent boosters train concurrently from
     two host threads; a contended booster serializes (exact iteration

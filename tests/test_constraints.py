@@ -221,7 +221,8 @@ def test_monotone_constraints_aliases(rng):
     assert _is_monotone(bst, X, 0, +1)
 
 
-@pytest.mark.parametrize("method", ["intermediate", "advanced"])
+@pytest.mark.parametrize("method", [
+    "intermediate", pytest.param("advanced", marks=pytest.mark.slow)])
 def test_monotone_intermediate_enforced(rng, method):
     """Intermediate mode (ref: monotone_constraints.hpp:517
     IntermediateLeafConstraints): monotonicity must hold, and the looser
@@ -245,6 +246,7 @@ def test_monotone_intermediate_enforced(rng, method):
     assert r2_inter > r2_basic - 0.02, (r2_inter, r2_basic)
 
 
+@pytest.mark.slow
 def test_monotone_intermediate_data_parallel(rng):
     """Intermediate mode composes with the data-parallel learner (the
     pool holds GLOBAL histograms, so the re-scan is collective-free)."""

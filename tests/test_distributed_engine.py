@@ -31,7 +31,10 @@ def _train(X, y, params, extra=None, rounds=15, **ds_kw):
     return lgb.train(p, ds, num_boost_round=rounds)
 
 
-@pytest.mark.parametrize("tl", ["data", "voting", "feature"])
+@pytest.mark.parametrize("tl", [
+    "data",
+    pytest.param("voting", marks=pytest.mark.slow),
+    pytest.param("feature", marks=pytest.mark.slow)])
 def test_distributed_binary_parity(rng, tl):
     X, y = _binary_data(rng)
     serial = _train(X, y, {"objective": "binary"})
@@ -50,6 +53,7 @@ def test_distributed_binary_parity(rng, tl):
         np.testing.assert_allclose(ps, pd_, atol=5e-2)
 
 
+@pytest.mark.slow
 def test_distributed_multiclass(rng):
     n = 2005
     X = rng.normal(size=(n, 8))
@@ -64,6 +68,7 @@ def test_distributed_multiclass(rng):
     assert acc_d > acc_s - 0.03, (acc_s, acc_d)
 
 
+@pytest.mark.slow
 def test_distributed_lambdarank(rng):
     n_query, per_q = 80, 25
     n = n_query * per_q
@@ -94,6 +99,7 @@ def test_distributed_lambdarank(rng):
     assert ndcg5(pd_) > ndcg5(ps) - 0.03, (ndcg5(ps), ndcg5(pd_))
 
 
+@pytest.mark.slow
 def test_distributed_bagging_goss(rng):
     X, y = _binary_data(rng, n=2531)
     dist = _train(X, y, {"objective": "binary", "tree_learner": "data",
@@ -126,6 +132,7 @@ def test_distributed_compact_matches_full(rng, tl):
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("tl", ["data", "voting", "feature"])
 def test_distributed_quantized(rng, tl):
     """Quantized int8 gradients under the distributed learners: global
@@ -160,6 +167,7 @@ def test_distributed_quantized_stochastic(rng):
     assert acc > 0.8
 
 
+@pytest.mark.slow
 def test_distributed_extra_trees(rng):
     """extra_trees composes with the row-sharded learners: the random
     thresholds come from the replicated per-tree key, so the sharded run

@@ -63,6 +63,7 @@ def test_train_binary_auc(rng):
     assert acc > 0.75  # label noise bounds accuracy near 0.80
 
 
+@pytest.mark.slow
 def test_train_multiclass(rng):
     X, y = _multiclass_data(rng)
     train = lgb.Dataset(X, label=y)
@@ -139,6 +140,7 @@ def test_goss(rng):
     assert acc > 0.85
 
 
+@pytest.mark.slow
 def test_dart(rng):
     X, y = _regression_data(rng)
     train = lgb.Dataset(X, label=y)
@@ -191,6 +193,7 @@ def test_continued_training(rng):
     assert mse2 < mse1
 
 
+@pytest.mark.slow
 def test_categorical_train_serve_consistency(rng):
     n = 2000
     X = rng.normal(size=(n, 3))

@@ -6,6 +6,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 import numpy as np
 
 import lightgbm_tpu as lgb
@@ -106,6 +108,7 @@ def test_redirected_params_warn(capsys):
     assert "num_threads" in err
 
 
+@pytest.mark.slow
 def test_extra_trees_categorical_randomized(rng):
     # categorical candidates must be randomized too (USE_RAND applies to
     # one-hot and sorted-subset categorical scans in the reference)

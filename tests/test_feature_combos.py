@@ -17,9 +17,13 @@ COMBOS = [
     dict(extra_trees=True, feature_fraction=0.7,
          feature_fraction_bynode=0.8),
     dict(use_quantized_grad=True, data_sample_strategy="goss"),
-    dict(use_quantized_grad=True, max_depth=4,
-         interaction_constraints="[0,1,2],[3,4,5,6,7,8,9]"),
-    dict(extra_trees=True, tree_learner="data", tpu_num_devices=-1),
+    pytest.param(
+        dict(use_quantized_grad=True, max_depth=4,
+             interaction_constraints="[0,1,2],[3,4,5,6,7,8,9]"),
+        marks=pytest.mark.slow),
+    pytest.param(
+        dict(extra_trees=True, tree_learner="data", tpu_num_devices=-1),
+        marks=pytest.mark.slow),
     dict(use_quantized_grad=True, histogram_pool_size=0.0001),  # poolless
     # bounded LRU pool (a few slots) x quantized int32 histograms
     dict(use_quantized_grad=True, histogram_pool_size=0.3),
@@ -133,6 +137,7 @@ def test_voting_topk_restriction_still_learns():
     assert auc_like > 0.7
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("method", ["intermediate", "advanced"])
 def test_voting_refined_monotone_matches_serial(method):
     """Refined monotone modes under the voting learner (rescan's
@@ -163,6 +168,7 @@ def test_voting_refined_monotone_matches_serial(method):
     assert np.all(b_vote.predict(Xp) >= p_vote - 1e-6)
 
 
+@pytest.mark.slow
 def test_feature_parallel_efb_matches_serial():
     """EFB under the feature-parallel learner: physical GROUPS shard
     across the mesh, each device expands/scans its own logical
@@ -180,6 +186,7 @@ def test_feature_parallel_efb_matches_serial():
     np.testing.assert_allclose(p_feat, p_serial, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("method", ["intermediate", "advanced"])
 def test_feature_parallel_refined_monotone_matches_serial(method):
     """Refined monotone modes under the FEATURE-parallel learner: the

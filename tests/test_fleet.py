@@ -111,6 +111,7 @@ def test_counters_tenant_dimension():
 # cross-tenant coalescing: bit-parity + trace budget
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_fleet_mixed_shapes_bit_parity():
     """Tenants with mixed (leaves, trees, F) shapes — multiple buckets —
     all bit-identical to their own predict_device through one fleet."""

@@ -108,6 +108,7 @@ def test_hist_backends_agree(rng):
     np.testing.assert_array_equal(l1, l2)
 
 
+@pytest.mark.slow
 def test_split_parity_randomized(rng):
     """Property sweep: random hyper-parameter combinations must stay
     split-for-split identical to the numpy oracle (broadens the fixed

@@ -297,6 +297,7 @@ for i in range(int(os.environ.get("SMOKE_ITERS", "40"))):
 """
 
 
+@pytest.mark.slow
 def test_injected_hang_recovered_by_retry(tmp_path):
     """Attempt 1 runs under LGBM_TPU_FAULTS=hang → goes silent, is
     classified + terminated; attempt 2 (fault clear) completes. The
@@ -479,6 +480,7 @@ def test_gbdt_writes_phase_tagged_beats(tmp_path):
 # bench.py partial-result salvage (end-to-end, CPU)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_bench_salvages_partial_on_hang(tmp_path):
     """A measurement child that hangs mid-measuring: the bench
     supervisor classifies the stall within the stall budget, retries

@@ -106,6 +106,7 @@ def test_exact_parity_int8_quantized():
     np.testing.assert_array_equal(pl_h, sc_h)
 
 
+@pytest.mark.slow
 def test_depth10_max_level_nodes():
     """n_d = 2^MAX_LEVEL_DEPTH = 1024 nodes with far fewer rows than
     nodes — the extreme ragged shape (most nodes empty, the rest 1-2
@@ -201,6 +202,7 @@ def test_train_pure_level_pallas_level_exact():  # the pure path too
     np.testing.assert_array_equal(b_pl.predict(X), b_sc.predict(X))
 
 
+@pytest.mark.slow
 def test_train_hybrid_pallas_level_exact():
     """The driver-shaped hybrid path (max_depth=-1) under pallas_level:
     bit-identical to the compact sequential grower — level hists from
@@ -215,6 +217,7 @@ def test_train_hybrid_pallas_level_exact():
     np.testing.assert_array_equal(b_hyb.predict(X), b_seq.predict(X))
 
 
+@pytest.mark.slow
 def test_train_quantized_pallas_level_exact():
     """int8 gradient rows through the kernel's int8 MXU path: exact
     int32 level hists keep the hybrid handoff bit-exact."""
@@ -241,6 +244,7 @@ def _bundle_data(seed=11, n=3000, groups=4, per=5):
     return X, y
 
 
+@pytest.mark.slow
 def test_train_efb_pallas_level_exact():
     """EFB bundles: the kernel histograms PHYSICAL group columns and
     the unchanged make_expand_hist expands per node at scan time —

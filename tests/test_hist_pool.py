@@ -19,6 +19,7 @@ from lightgbm_tpu.core.grower import GrowerConfig, make_tree_grower
 from lightgbm_tpu.core.tree import HostTree
 
 
+@pytest.mark.slow
 def test_poolless_matches_pooled(rng):
     X = rng.normal(size=(3000, 6))
     y = X[:, 0] * 1.5 + np.sin(X[:, 1] * 3) + rng.normal(
@@ -59,6 +60,7 @@ def test_poolless_matches_pooled(rng):
                                    rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_wide_data_auto_engages_bounded_pool(rng):
     """Allstate-shaped axis: hundreds of features under a small
     histogram_pool_size budget auto-engage the bounded LRU pool."""
@@ -76,6 +78,7 @@ def test_wide_data_auto_engages_bounded_pool(rng):
     assert np.mean((pred - y) ** 2) < y.var()
 
 
+@pytest.mark.slow
 def test_tiny_budget_falls_back_to_poolless(rng):
     """A budget below two slots cannot host an LRU -> poolless."""
     n, f = 800, 600

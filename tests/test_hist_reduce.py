@@ -103,8 +103,12 @@ def _grow_all(cfg, meta, bins, gh, modes=("allreduce", "reduce_scatter"),
 # ragged Fp (pad slice), 255 leaves}
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("F", [16, 11])     # even tiles and a pad slice
-@pytest.mark.parametrize("quant", [False, True])
+# even tiles and a pad slice; one fast representative (dyadic F=16),
+# the other three cells behind -m slow (comms_smoke.py gates parity on
+# both dtypes every check.sh run)
+@pytest.mark.parametrize("F", [16, pytest.param(11, marks=pytest.mark.slow)])
+@pytest.mark.parametrize("quant", [
+    False, pytest.param(True, marks=pytest.mark.slow)])
 def test_matrix_serial_vs_data_both_modes(rng, F, quant):
     bins, gh = _toy(rng, 2048, F, 32)
     tree_s, leaf_s, out = _grow_all(_cfg(32, quant=quant), _meta(F, 32),
@@ -115,6 +119,7 @@ def test_matrix_serial_vs_data_both_modes(rng, F, quant):
                                       np.asarray(leaf_d))
 
 
+@pytest.mark.slow
 def test_matrix_full_sched_and_weighted(rng):
     """full (masked-pass) scheduling + weighted rows legs."""
     bins, gh = _toy(rng, 2048, 16, 32, weights=True)
@@ -126,6 +131,7 @@ def test_matrix_full_sched_and_weighted(rng):
                                       np.asarray(leaf_d))
 
 
+@pytest.mark.slow
 def test_matrix_255_leaves(rng):
     bins, gh = _toy(rng, 8192, 12, 64)
     cfg = _cfg(64, quant=True, leaves=255)
@@ -137,6 +143,7 @@ def test_matrix_255_leaves(rng):
     np.testing.assert_array_equal(np.asarray(leaf_s), np.asarray(leaf_d))
 
 
+@pytest.mark.slow
 def test_matrix_poolless(rng):
     """hist_pool='none' (the wide-table downgrade: both children
     histogrammed per split, no pool) composes with reduce_scatter —
@@ -154,6 +161,7 @@ def test_matrix_poolless(rng):
                                       np.asarray(leaf_d))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("quant", [False, True])
 def test_voting_modes_match(rng, quant):
     """Voting composes: with full coverage (2*top_k >= F) both reduce
@@ -168,6 +176,7 @@ def test_voting_modes_match(rng, quant):
                                       np.asarray(leaf_v))
 
 
+@pytest.mark.slow
 def test_voting_small_k_modes_match(rng):
     """Partial coverage (the lossy-vote regime): the two reduce modes
     must still agree with EACH OTHER bit-for-bit (same vote, same
@@ -302,6 +311,7 @@ def test_train_step_serial_remap_logs_and_trains(rng):
     assert not np.array_equal(np.asarray(new_score), np.zeros(n))
 
 
+@pytest.mark.slow
 def test_train_step_reduce_scatter_mode(rng):
     """hist_reduce threads through the step builder for both learners."""
     F, B, n = 8, 32, 2048
@@ -349,6 +359,7 @@ def _trees_only(booster):
     return s.split("parameters:")[0].split("feature_importances")[0]
 
 
+@pytest.mark.slow
 def test_engine_quantized_bit_parity_and_attribution(rng):
     import lightgbm_tpu as lgb
     X, y = _engine_data(rng)
@@ -364,6 +375,7 @@ def test_engine_quantized_bit_parity_and_attribution(rng):
     assert _trees_only(serial) == _trees_only(rs)
 
 
+@pytest.mark.slow
 def test_engine_fallback_attribution(rng):
     """Ineligible configs resolve to allreduce with the reason recorded
     (the PR6 level_backend contract: bench numbers must be attributable

@@ -62,6 +62,7 @@ class ThreadAllreduce:
                 lambda a: self._exchange(rank, a, "max"))
 
 
+@pytest.mark.slow
 def test_injected_two_worker_matches_centralized(rng):
     n, f = 600, 6
     X = rng.normal(size=(n, f)).astype(np.float32)

@@ -45,8 +45,11 @@ def _dump_splits(bst, it=0):
     return out
 
 
-@pytest.mark.parametrize("depth,leaves", [(4, 31), (6, 31), (6, 9),
-                                          (3, 64)])
+@pytest.mark.parametrize("depth,leaves", [
+    pytest.param(4, 31, marks=pytest.mark.slow),
+    (6, 31),
+    pytest.param(6, 9, marks=pytest.mark.slow),
+    (3, 64)])
 def test_single_tree_exact_parity(depth, leaves):
     """Dyadic first-tree gradients: trees must match split for split,
     including leaf numbering (via identical predictions)."""
@@ -62,6 +65,7 @@ def test_single_tree_exact_parity(depth, leaves):
     np.testing.assert_array_equal(b_lvl.predict(X), b_seq.predict(X))
 
 
+@pytest.mark.slow
 def test_multi_iteration_close():
     X, y = _data(seed=9)
     b_seq = lgb.train(_params("compact"), lgb.Dataset(X, label=y),
@@ -119,7 +123,10 @@ def test_fallback_configs_warn_and_work():
     assert np.isfinite(bst2.predict(X)).all()
 
 
-@pytest.mark.parametrize("tl", ["data", "feature", "voting"])
+@pytest.mark.parametrize("tl", [
+    "data",
+    pytest.param("feature", marks=pytest.mark.slow),
+    pytest.param("voting", marks=pytest.mark.slow)])
 def test_fallback_distributed_learners(tl):
     """A level request with a distributed learner must fall back BEFORE
     the learner builds its grower (an early review caught the full-mode
@@ -131,6 +138,7 @@ def test_fallback_distributed_learners(tl):
     assert np.isfinite(bst.predict(X)).all()
 
 
+@pytest.mark.slow
 def test_feature_fraction_parity():
     """The per-tree column sample reaches the level scan as the same
     feature mask the sequential grower uses (same seed => same mask =>
@@ -168,7 +176,8 @@ def test_multiclass_level_close():
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("d0", [1, 5])
+@pytest.mark.parametrize("d0", [
+    pytest.param(1, marks=pytest.mark.slow), 5])
 def test_hybrid_unbounded_depth_exact_parity(d0):
     """max_depth=-1 (the previously-excluded DEFAULT shape): the level
     phase to D0 + sequential tail must reproduce the compact grower's
@@ -186,6 +195,7 @@ def test_hybrid_unbounded_depth_exact_parity(d0):
     np.testing.assert_array_equal(b_hyb.predict(X), b_seq.predict(X))
 
 
+@pytest.mark.slow
 def test_hybrid_default_255_leaf_exact_parity():
     """The driver-shaped default config (255 leaves, max_depth=-1,
     serial): level-eligible AND bit-identical to compact — the
@@ -206,6 +216,7 @@ def test_hybrid_default_255_leaf_exact_parity():
     assert b_hyb._engine.grower_cfg.row_sched == "level"
 
 
+@pytest.mark.slow
 def test_hybrid_multi_iteration_close():
     X, y = _data(seed=9)
     kw = dict(max_depth=-1, num_leaves=63)
@@ -217,7 +228,8 @@ def test_hybrid_multi_iteration_close():
                                rtol=1e-4, atol=1e-5)
 
 
-@pytest.mark.parametrize("depth", [6, -1])
+@pytest.mark.parametrize("depth", [
+    6, pytest.param(-1, marks=pytest.mark.slow)])
 def test_quantized_admission_parity(depth):
     """Quantized int8 gradients in level/hybrid mode: the shared
     quantize_gradients helper (same rng fold) + exact int32 histogram
@@ -233,7 +245,8 @@ def test_quantized_admission_parity(depth):
     np.testing.assert_array_equal(b_lvl.predict(X), b_seq.predict(X))
 
 
-@pytest.mark.parametrize("depth", [6, -1])
+@pytest.mark.parametrize("depth", [
+    6, pytest.param(-1, marks=pytest.mark.slow)])
 def test_categorical_admission_parity(depth):
     """Categorical features in level/hybrid mode: the vmapped scan's
     per-node category sets + the per-row membership partition must
@@ -268,7 +281,8 @@ def _bundle_data(seed=11, n=3000, groups=4, per=5):
     return X, y
 
 
-@pytest.mark.parametrize("depth", [6, -1])
+@pytest.mark.parametrize("depth", [
+    6, pytest.param(-1, marks=pytest.mark.slow)])
 def test_efb_admission_parity(depth):
     """EFB bundles in level/hybrid mode: level histograms run over the
     PHYSICAL group columns and expand per node at scan time
@@ -288,6 +302,7 @@ def test_efb_admission_parity(depth):
     np.testing.assert_array_equal(b_lvl.predict(X), b_seq.predict(X))
 
 
+@pytest.mark.slow
 def test_hybrid_with_bagging_close():
     """Bagged rows ride through the level phase AND the handoff
     (physical seg counts include mask-zero rows on both sides)."""
@@ -305,6 +320,7 @@ def test_hybrid_with_bagging_close():
     assert np.abs(p_hyb - p_seq).max() < 0.2
 
 
+@pytest.mark.slow
 def test_pallas_blocks_parity_interpret(monkeypatch):
     """The blocks-mode level histogram under the REAL pallas kernel
     (interpret mode on CPU), vmapped over nodes with edge windows as
@@ -322,6 +338,7 @@ def test_pallas_blocks_parity_interpret(monkeypatch):
     np.testing.assert_array_equal(b_pl.predict(X), b_sc.predict(X))
 
 
+@pytest.mark.slow
 def test_blocks_hist_matches_scatter_hist():
     """The blocks formulation (sorted rows + block prefix + edge
     windows — the TPU shape) must produce the same trees as the
@@ -336,6 +353,7 @@ def test_blocks_hist_matches_scatter_hist():
     np.testing.assert_array_equal(b_bl.predict(X), b_sc.predict(X))
 
 
+@pytest.mark.slow
 def test_level_with_bagging_close():
     """Bagged rows stay physically present with zero mask weight; the
     level partition must carry them like the sequential one does.

@@ -22,6 +22,7 @@ expansion with the production split scan, e-ranking, and comparison of
 the chosen split set against the tree the production grower builds.
 """
 import numpy as np
+import pytest
 import jax.numpy as jnp
 
 import lightgbm_tpu as lgb
@@ -60,6 +61,7 @@ def _full_expand(bins, g, h, meta, hp, max_nodes=4096):
     return out
 
 
+@pytest.mark.slow
 def test_best_first_equals_topk_by_path_min():
     rng = np.random.default_rng(11)
     n, F, L = 1500, 5, 15

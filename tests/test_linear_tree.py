@@ -53,6 +53,7 @@ def test_linear_nan_rows_fall_back_to_const(rng):
     assert np.isfinite(p).all()
 
 
+@pytest.mark.slow
 def test_linear_train_serve_consistency(rng):
     X, y = _pw_linear(rng, n=3000)
     lin = lgb.train({"objective": "regression", "num_leaves": 8,
@@ -77,6 +78,7 @@ def test_linear_valid_set_and_early_stopping(rng):
     assert l2s[-1] < l2s[0] * 0.7  # valid scores track the LINEAR model
 
 
+@pytest.mark.slow
 def test_linear_cv_subset(rng):
     X, y = _pw_linear(rng, n=1200)
     out = lgb.cv({"objective": "regression", "num_leaves": 6,

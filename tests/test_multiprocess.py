@@ -24,6 +24,7 @@ from lightgbm_tpu.distributed import launch_local
 HERE = os.path.dirname(os.path.abspath(__file__))
 
 
+@pytest.mark.slow
 def test_two_process_data_parallel(tmp_path):
     out = tmp_path / "mp_pred.npy"
     try:

@@ -43,6 +43,7 @@ def test_multival_matches_dense(rng, objective, sched):
                                rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_multival_auto_engages(rng):
     # high-conflict wide-sparse: bundling fails (random co-occurrence),
     # multival storage is ~8*K bytes/row vs F dense -> auto picks it
@@ -87,7 +88,8 @@ def test_multival_monotone_and_sampling(rng):
     assert acc > 0.8
 
 
-@pytest.mark.parametrize("sched", ["compact", "full"])
+@pytest.mark.parametrize("sched", [
+    pytest.param("compact", marks=pytest.mark.slow), "full"])
 def test_multival_data_parallel_matches_serial(rng, sched):
     """Multival sparse storage under tree_learner=data on the 8-device
     mesh: the psum'd stored-bin histograms + global default-bin fix must
@@ -105,6 +107,7 @@ def test_multival_data_parallel_matches_serial(rng, sched):
                                rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_multival_data_parallel_quantized_exact(rng):
     """Quantized int8 gradients compose with multival x data-parallel —
     and int32 scatter histograms psum EXACTLY, so sharded and serial
@@ -146,6 +149,7 @@ def test_multival_data_parallel_rollback(rng):
     np.testing.assert_allclose(b.predict(X), p4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_multival_cv(rng):
     """cv() row-subsets the multival storage directly (CopySubrow on the
     [R, K] layout) -- sparse users keep cross-validation."""

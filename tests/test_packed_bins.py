@@ -2,6 +2,7 @@
 must reproduce the unpacked path's models exactly — the packing only
 changes how the per-leaf row gather reads memory (grower.py unpack_rows).
 """
+import pytest
 import numpy as np
 
 import lightgbm_tpu as lgb
@@ -39,6 +40,7 @@ def test_packed_matches_unpacked_plain():
             _trees_only(out["false"].model_to_string()))
 
 
+@pytest.mark.slow
 def test_packed_matches_unpacked_odd_features():
     # 10 features -> W=3 words with 2 dead pad bytes exercised
     X, out = _models(dict(objective="binary", num_leaves=7,
@@ -47,6 +49,7 @@ def test_packed_matches_unpacked_odd_features():
                                   out["false"].predict(X))
 
 
+@pytest.mark.slow
 def test_packed_with_efb_bundling():
     rng = np.random.default_rng(3)
     n = 2000
@@ -64,6 +67,7 @@ def test_packed_with_efb_bundling():
     assert out["true"] == out["false"]
 
 
+@pytest.mark.slow
 def test_packed_quantized():
     X, out = _models(dict(objective="binary", num_leaves=15,
                           use_quantized_grad=True,

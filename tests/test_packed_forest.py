@@ -61,6 +61,7 @@ def _engine_meta(eng):
 # depth-bounded traversal
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_depth_bounded_identical_to_exhaustive_on_ragged_forest(rng):
     """Trees of different depths (natural raggedness from min_data
     constraints): the depth-bounded loop must land every row in exactly
@@ -113,7 +114,12 @@ def test_depth_steps_bucketing():
 # parity matrix: leaf-identical across missing types and adversarial values
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("missing", ["none", "zero", "nan"])
+# one fast representative (nan: the adversarial missing type); the
+# other two cells behind -m slow (predict_smoke.py gates all three
+# missing types every check.sh run)
+@pytest.mark.parametrize("missing", [
+    pytest.param("none", marks=pytest.mark.slow),
+    pytest.param("zero", marks=pytest.mark.slow), "nan"])
 def test_leaf_parity_matrix_binned_and_raw(rng, missing):
     """Bit-identical per-tree LEAF INDICES between the host walk, the
     device binned route (device binning + forest_leaf_bins) and the raw
@@ -192,6 +198,7 @@ def test_f32_floor_exact_boundary():
     assert out[3] == np.inf and out[4] == -np.inf
 
 
+@pytest.mark.slow
 def test_device_binning_matches_host_mapper(rng):
     bst, X = _train(rng, missing="nan", cat=True, n_round=3)
     eng = bst._engine

@@ -42,7 +42,8 @@ def _tree_tuple(tree):
             np.asarray(tree.leaf_value[:n]).round(5).tolist())
 
 
-@pytest.mark.parametrize("F", [16, 11])  # even and ragged feature counts
+@pytest.mark.parametrize("F", [  # even and ragged feature counts
+    16, pytest.param(11, marks=pytest.mark.slow)])
 def test_feature_parallel_matches_serial(rng, F):
     n, B = 2048, 32
     bins, gh = _toy(rng, n, F, B)
@@ -90,6 +91,7 @@ def test_voting_full_coverage_matches_data_parallel(rng):
     np.testing.assert_array_equal(np.asarray(leaf_d), np.asarray(leaf_v))
 
 
+@pytest.mark.slow
 def test_voting_small_k_trains(rng):
     """Small top_k: reduced communication but the model still fits
     (PV-Tree accuracy claim, docs/Features.rst distributed section)."""

@@ -43,6 +43,7 @@ def _make_frames(rng, n=1500, f=6):
     return X, y, table
 
 
+@pytest.mark.slow
 def test_train_predict_from_capsule_frame(rng):
     X, y, table = _make_frames(rng)
     params = {"objective": "regression", "num_leaves": 15,

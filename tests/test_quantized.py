@@ -5,6 +5,7 @@ stochastic rounding; histogram sums accumulate exactly in integers, so any
 scheduling/reduction order produces bit-identical splits (the determinism
 property the reference gets from integer HistogramSumReducers, bin.h:49-82).
 """
+import pytest
 import numpy as np
 
 import jax
@@ -34,6 +35,7 @@ def _auc(y, s):
         pos.sum() * (~pos).sum())
 
 
+@pytest.mark.slow
 def test_quantized_close_to_fp32(rng):
     X, y = _binary(rng)
     base = {"objective": "binary", "num_leaves": 31, "verbose": -1,
@@ -66,6 +68,7 @@ def test_quantized_renew_leaf(rng):
     assert _auc(y, bst.predict(X)) > 0.85
 
 
+@pytest.mark.slow
 def test_quantized_compact_equals_full(rng):
     """Integer histograms make the two schedulings BIT-IDENTICAL, not just
     statistically equivalent — the determinism property itself."""

@@ -36,6 +36,7 @@ def _gradients(obj_cls, meta, score, min_width):
     return np.asarray(g), np.asarray(h), len(obj.buckets)
 
 
+@pytest.mark.slow
 def test_lambdarank_bucketed_equals_single_bucket(rng):
     sizes = rng.integers(3, 90, size=40)     # spans several pow2 buckets
     meta, score = _rank_data(rng, sizes)

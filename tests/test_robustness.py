@@ -311,6 +311,7 @@ def _structure(model):
             for t in model._engine.models]
 
 
+@pytest.mark.slow
 def test_resume_equivalence_after_write_kill(tmp_path, rng):
     """Kill training mid-checkpoint-write at iteration 6; resume from
     the newest valid checkpoint (iteration 5); the final ensemble must
@@ -391,6 +392,7 @@ def test_resume_already_complete_returns_immediately(tmp_path, rng):
     assert b.current_iteration() == 5
 
 
+@pytest.mark.slow
 def test_checkpoint_eval_history_persists(tmp_path, rng):
     """Eval history accumulated before the kill is carried into
     checkpoints written after resume."""
@@ -488,6 +490,7 @@ class ThreadAllreduce:
                 lambda a: self._exchange(rank, a, "max"))
 
 
+@pytest.mark.slow
 def test_collective_faults_converge_bit_exact(rng, monkeypatch):
     """20% injected transient collective failures: the 2-worker
     injected-collectives training retries through the shared policy and

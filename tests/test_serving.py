@@ -179,6 +179,7 @@ def test_server_converted_output_matches_booster_predict(booster):
         assert np.array_equal(got, bst.predict(X[:200], device=True))
 
 
+@pytest.mark.slow
 def test_server_hot_swap_under_load_never_torn(booster):
     bst, X, _ = booster
     probe = X[:64]
@@ -242,6 +243,7 @@ def test_server_hot_swap_under_load_never_torn(booster):
     assert np.array_equal(final_out, expected[4])
 
 
+@pytest.mark.slow
 def test_server_publish_after_rollback_full_repack(booster):
     rng = np.random.default_rng(5)
     Xb = rng.normal(size=(600, 5)).astype(np.float32).astype(np.float64)
@@ -628,6 +630,7 @@ def test_server_deadline_knob_resolves_from_params():
         assert srv.stats()["max_queue_rows"] == 0
 
 
+@pytest.mark.slow
 def test_server_mesh_two_virtual_devices_subprocess(booster):
     """Mesh replication needs >1 device, which needs XLA_FLAGS before
     jax import — so the 2-virtual-device parity proof runs in a

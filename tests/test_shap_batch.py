@@ -47,6 +47,7 @@ def test_batch_matches_scalar_missing(rng):
     _parity(bst, X[:40], 5)
 
 
+@pytest.mark.slow
 def test_batch_matches_scalar_categorical(rng):
     n = 500
     cat = rng.integers(0, 8, size=n).astype(np.float64)
@@ -72,6 +73,7 @@ def test_batch_matches_scalar_multiclass_api(rng):
     np.testing.assert_allclose(c.sum(axis=2), raw, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_batch_throughput_smoke(rng):
     """100k rows through a real model in seconds, not minutes."""
     import time

@@ -303,6 +303,7 @@ def _strip_params_block(model_str: str) -> str:
     return model_str.split("\nparameters:")[0]
 
 
+@pytest.mark.slow
 def test_two_process_sharded_bit_identical(tmp_path):
     """The ROADMAP item-1 acceptance bar: 2-process training on disjoint
     row shards produces trees BIT-IDENTICAL to single-process training

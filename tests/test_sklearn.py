@@ -114,6 +114,7 @@ def test_custom_objective(rng):
     assert 1 - np.var(y - pred) / np.var(y) > 0.5
 
 
+@pytest.mark.slow
 def test_sklearn_integration(rng):
     from sklearn.model_selection import GridSearchCV, cross_val_score
     X, y = _make_reg(rng, n=200)
