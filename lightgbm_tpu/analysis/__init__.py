@@ -1,12 +1,21 @@
-"""Static + runtime JAX-hazard analysis ("jaxlint") for the hot path.
+"""Static + runtime hazard analysis for the hot path and threaded tier.
 
-Two halves, one contract:
+Four pieces, one contract:
 
 - :mod:`.jaxlint` — pure-stdlib AST pass (rules JL001-JL005, suppression
   comments, baseline diff). CLI: ``python scripts/jaxlint.py``.
+- :mod:`.concurrency` — the concurrency analogue ("conlint", rules
+  CL001-CL005) over the lock-bearing serving/service/robustness/native
+  modules: lock-order inversions, blocking calls under locks,
+  shared-state escapes, Condition.wait discipline, thread lifecycle.
+  CLI: ``python scripts/jaxlint.py --pass concurrency``.
 - :mod:`.guards` — opt-in runtime guards (compile-count budgets, transfer
   guards, ``LGBM_TPU_GUARDS`` env toggle). Imports jax lazily; import it
   explicitly where needed so the lint CLI never initializes a backend.
+- :mod:`.lockorder` — opt-in runtime lock-order tracker
+  (``LGBM_TPU_GUARDS=lockorder``): wraps Lock/RLock/Condition creation
+  in the instrumented modules, records the cross-thread acquisition
+  graph, raises on a cycle. Pure stdlib.
 
 See README "Static analysis & dispatch guards" for the workflow.
 """
@@ -21,3 +30,9 @@ from .jaxlint import (  # noqa: F401
     save_baseline,
 )
 from .rules import ALL_RULES, RULE_IDS  # noqa: F401
+from .concurrency import (  # noqa: F401
+    CONCURRENCY_RULE_IDS,
+    CONCURRENCY_RULES,
+    LockGraph,
+    TARGET_MODULES,
+)

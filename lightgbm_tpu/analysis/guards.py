@@ -21,6 +21,11 @@ these guards catch the same hazard classes at runtime:
   disallow implicit transfers; ``LIGHTGBM_TPU_GUARDS`` is an alias).
   lightgbm_tpu/__init__.py calls it at import, so any run — bench,
   scripts, tests — is audited without code changes.
+- ``LGBM_TPU_GUARDS`` is comma-separable: the ``lockorder`` token
+  installs the runtime lock-order tracker (:mod:`.lockorder` — pure
+  stdlib, no jax) and the REMAINING tokens keep their transfer-guard
+  meaning, so ``LGBM_TPU_GUARDS=lockorder,strict`` turns on both.
+  ``lockorder`` alone does not initialize a backend.
 
 jax is imported lazily: importing this module (e.g. from the jaxlint CLI
 process) must not initialize a backend.
@@ -155,25 +160,45 @@ def install_from_env(env=None) -> bool:
       implicit transfer and every compile shows up on stderr.
     - ``strict`` / ``disallow``: implicit transfers RAISE (the training
       hot path must be transfer-free); compiles are logged.
+    - ``lockorder`` (combinable: ``lockorder,strict``): install the
+      runtime lock-order tracker over the instrumented threaded modules
+      — pure stdlib, raises LockOrderViolation at the acquisition that
+      closes an inversion cycle. This token alone never imports jax.
     """
+    tokens = _guard_tokens(env)
+    on = False
+    if "lockorder" in tokens:
+        # BEFORE any jax work and before package submodules import, so
+        # their module-level locks are created through the patched
+        # factories
+        from . import lockorder
+        lockorder.install()
+        on = True
     mode = guard_mode(env)
     if mode is None:
-        return False
+        return on
     import jax
     jax.config.update("jax_transfer_guard", mode)
     jax.config.update("jax_log_compiles", True)
     return True
 
 
+def _guard_tokens(env=None) -> List[str]:
+    e = env if env is not None else os.environ
+    val = (e.get("LGBM_TPU_GUARDS") or
+           e.get("LIGHTGBM_TPU_GUARDS") or "").strip().lower()
+    return [t.strip() for t in val.split(",") if t.strip()]
+
+
 def guard_mode(env=None) -> Optional[str]:
-    """The LGBM_TPU_GUARDS mode that install_from_env would apply.
+    """The LGBM_TPU_GUARDS transfer-guard mode install_from_env applies
+    (the ``lockorder`` token is orthogonal and ignored here).
 
     ``LIGHTGBM_TPU_GUARDS`` is honored as an alias so the toggle also
     answers to the package's established env-var prefix
     (LIGHTGBM_TPU_PLATFORM / LIGHTGBM_TPU_DEBUG_CHECKS)."""
-    e = env if env is not None else os.environ
-    val = (e.get("LGBM_TPU_GUARDS") or
-           e.get("LIGHTGBM_TPU_GUARDS") or "").strip().lower()
-    if not val or val in ("0", "false", "off", "no"):
+    tokens = [t for t in _guard_tokens(env) if t != "lockorder"]
+    if not tokens or tokens[0] in ("0", "false", "off", "no"):
         return None
-    return "disallow" if val in ("strict", "disallow", "2") else "log"
+    return ("disallow" if any(t in ("strict", "disallow", "2")
+                              for t in tokens) else "log")

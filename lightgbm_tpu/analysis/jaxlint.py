@@ -62,9 +62,12 @@ TRACE_HOFS = {
 KERNEL_PATTERNS = ("lightgbm_tpu/ops/", "core/grower.py",
                    "core/level_grower.py")
 # capture only the comma-separated rule list so a plain-word reason after
-# it ("# jaxlint: disable=JL001 trace-time probe") can't swallow the token
+# it ("# jaxlint: disable=JL001 trace-time probe") can't swallow the token.
+# `conlint:` is the concurrency pass's tag (analysis/concurrency.py);
+# one regex serves both passes, so either tag suppresses either family.
 _SUPPRESS_RE = re.compile(
-    r"#\s*jaxlint:\s*disable=([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+    r"#\s*(?:jax|con)lint:\s*disable="
+    r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
 
 
 def _local_call_map(tree: ast.AST) -> Dict[str, str]:

@@ -59,6 +59,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("LIGHTGBM_TPU_NO_NATIVE"):
             return None
+        # conlint: disable=CL002 — deliberate: double-checked one-time
+        # build; holding _lock across the g++ run is the point (every
+        # other thread needs the built .so before it can do anything)
         so = _build()
         if so is None:
             return None

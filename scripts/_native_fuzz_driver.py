@@ -1,18 +1,28 @@
 """Native parser-fuzz + predict smoke driver (ctypes + numpy ONLY).
 
 Usage: python _native_fuzz_driver.py <lgbm_native.so> <model.txt>
+       python _native_fuzz_driver.py <so> <model> --threads 8
 
 ONE copy of the fuzz body shared by tests/test_c_api_fuzz.py (plain
 build, subprocess so a segfault fails the test) and
-scripts/native_sanitize.sh (ASan/UBSan build under LD_PRELOAD — which
-is exactly why this driver must not import jax or lightgbm_tpu: the
-sanitizer interposes the whole interpreter, and the minimal import set
-keeps the run fast and the leak/report noise at zero).
+scripts/native_sanitize.sh (ASan/UBSan/TSan build under LD_PRELOAD —
+which is exactly why this driver must not import jax or lightgbm_tpu:
+the sanitizer interposes the whole interpreter, and the minimal import
+set keeps the run fast and the leak/report noise at zero).
 
-Mutated/truncated model text must produce rc=-1 (with an error message)
-or a valid load followed by a surviving prediction — never a crash; the
-intact model must load and predict cleanly (rc=0). Prints FUZZ-OK on
-success.
+Default (single-thread) mode: mutated/truncated model text must produce
+rc=-1 (with an error message) or a valid load followed by a surviving
+prediction — never a crash; the intact model must load and predict
+cleanly (rc=0).
+
+``--threads N`` (the TSan leg): N threads hammer the ABI concurrently —
+(a) shared-handle predicts (the serving pattern: one resident booster,
+many predict threads), (b) private load/predict/free churn interleaved
+with a few mutated loads (concurrent model-load against the same global
+error slot + allocator). Any data race in OUR instrumented .so is a
+TSan report; any Python-level exception or bad rc fails the driver.
+
+Prints FUZZ-OK on success either way.
 """
 import ctypes
 import random
@@ -20,7 +30,13 @@ import sys
 
 import numpy as np
 
-so_path, model_path = sys.argv[1], sys.argv[2]
+_argv = sys.argv[1:]
+N_THREADS = 0
+if "--threads" in _argv:
+    _i = _argv.index("--threads")
+    N_THREADS = int(_argv[_i + 1])
+    del _argv[_i:_i + 2]
+so_path, model_path = _argv[0], _argv[1]
 lib = ctypes.CDLL(so_path)
 lib.LGBM_GetLastError.restype = ctypes.c_char_p
 model = open(model_path).read()
@@ -54,8 +70,98 @@ def try_load(s, must_load=False):
         lib.LGBM_BoosterFree(handle)
 
 
+class _Scratch:
+    """Per-thread predict buffers, allocated ONCE per worker.
+
+    Fresh-per-call buffers would be correct too, but under TSan the
+    allocator hands thread B memory thread A just released with only
+    GIL/pymalloc ordering in between; persistent per-thread scratch
+    keeps the race surface exactly the ABI under test, nothing else."""
+
+    def __init__(self, rows=8):
+        self.rows = rows
+        self.X = np.zeros((rows, 64), np.float64)
+        self.out = np.zeros(rows * 16, np.float64)
+        self.out_len = ctypes.c_int64()
+
+
+def _predict_on(handle, s):
+    return lib.LGBM_BoosterPredictForMat(
+        handle, s.X.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int32(s.rows), ctypes.c_int32(64), ctypes.c_int(1),
+        ctypes.c_int(1), ctypes.c_int(0), ctypes.c_int(0), b"",
+        ctypes.byref(s.out_len),
+        s.out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+
+
+def run_threaded(n_threads):
+    import threading
+
+    errors = []
+
+    def check(cond, msg):
+        if not cond:
+            errors.append(msg + f": {lib.LGBM_GetLastError()}")
+
+    # (a) one shared resident handle, every thread predicting on it
+    shared = ctypes.c_void_p()
+    n = ctypes.c_int()
+    rc = lib.LGBM_BoosterLoadModelFromString(
+        model.encode(), ctypes.byref(n), ctypes.byref(shared))
+    if rc != 0:
+        raise SystemExit(
+            f"threaded: seed model failed to load: "
+            f"{lib.LGBM_GetLastError()}")
+
+    start = threading.Barrier(n_threads)
+
+    def worker(tid):
+        try:
+            start.wait()
+            local_rng = random.Random(tid)
+            scratch = _Scratch()
+            for it in range(30):
+                check(_predict_on(shared, scratch) == 0,
+                      f"t{tid} shared predict {it}")
+                if it % 3 == tid % 3:
+                    # (b) private load/predict/free churn: concurrent
+                    # parses against the same global error slot
+                    h = ctypes.c_void_p()
+                    k = ctypes.c_int()
+                    if local_rng.random() < 0.25:
+                        txt = model[: int(len(model)
+                                          * local_rng.random())]
+                    else:
+                        txt = model
+                    lrc = lib.LGBM_BoosterLoadModelFromString(
+                        txt.encode(), ctypes.byref(k), ctypes.byref(h))
+                    if lrc == 0:
+                        check(_predict_on(h, scratch) == 0,
+                              f"t{tid} private predict {it}")
+                        lib.LGBM_BoosterFree(h)
+        except Exception as e:  # surface, don't swallow
+            errors.append(f"t{tid}: {type(e).__name__}: {e}")
+
+    ts = [threading.Thread(target=worker, args=(i,), daemon=True)
+          for i in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(120)
+        if t.is_alive():
+            errors.append(f"{t.name} wedged (join timeout)")
+    lib.LGBM_BoosterFree(shared)
+    if errors:
+        raise SystemExit("threaded fuzz FAILED:\n  "
+                         + "\n  ".join(errors[:20]))
+
+
 # predict smoke: the intact model must load + predict cleanly
 try_load(model, must_load=True)
+if N_THREADS:
+    run_threaded(N_THREADS)
+    print("FUZZ-OK")
+    raise SystemExit(0)
 # truncations
 for frac in (0.1, 0.3, 0.5, 0.7, 0.9, 0.99):
     try_load(model[: int(len(model) * frac)])

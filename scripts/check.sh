@@ -5,16 +5,18 @@
 #   bash scripts/check.sh --fast     # lints only (seconds, no jax)
 #
 # Mirrors the reference repo's lint-gates-CI model: jaxlint (JAX hazards
-# JL001-JL005 vs jaxlint_baseline.json), r_lint (R-package structural
-# gate), then the tier-1 pytest suite on CPU. Fails on the first gate
-# that fails; the jaxlint new-finding count also appears in the pytest
-# header (tests/conftest.py) so the verify log carries it either way.
+# JL001-JL005 vs jaxlint_baseline.json) + conlint (concurrency hazards
+# CL001-CL005 vs concurrency_baseline.json, one scripts/jaxlint.py
+# invocation runs both passes), r_lint (R-package structural gate), then
+# the tier-1 pytest suite on CPU. Fails on the first gate that fails;
+# the jaxlint new-finding count also appears in the pytest header
+# (tests/conftest.py) so the verify log carries it either way.
 set -u
 cd "$(dirname "$0")/.."
 
 rc=0
 
-echo "== jaxlint (JAX-hazard static analysis) =="
+echo "== jaxlint + conlint (JAX-hazard + concurrency static analysis) =="
 python scripts/jaxlint.py || rc=1
 
 echo "== r_lint (R-package structural gate) =="
@@ -29,16 +31,29 @@ if [ $rc -ne 0 ]; then
 fi
 
 if [ "${LGBM_TPU_SANITIZE:-0}" != "0" ]; then
-    echo "== native sanitize (ASan/UBSan build + parser-fuzz/predict, opt-in) =="
-    # ROADMAP 5(c) / ISSUE 10 satellite: the 3.7k-LoC native ABI built
-    # with -fsanitize=address,undefined and fuzzed with the SAME driver
-    # tier-1 runs against the plain build — skips LOUDLY (rc 0) when no
-    # compiler/ASan runtime is available.
+    echo "== native sanitize (sanitizer build + fuzz/predict, opt-in) =="
+    # ROADMAP 5(c): the 3.7k-LoC native ABI built under a sanitizer and
+    # fuzzed with the SAME driver tier-1 runs against the plain build —
+    # LGBM_TPU_SANITIZE=thread selects the TSan leg (concurrent predict
+    # + model-load, --threads driver mode); any other value the
+    # ASan/UBSan leg. Skips LOUDLY (rc 0) when no compiler/runtime.
     timeout -k 10 420 bash scripts/native_sanitize.sh || rc=1
     if [ $rc -ne 0 ]; then
         echo "check.sh: native sanitize failed — skipping tier-1 pytest" >&2
         exit $rc
     fi
+fi
+
+echo "== concurrency smoke (conlint gate + lock-order tracker, CPU) =="
+# ISSUE 16: conlint clean vs its reasoned baseline, the runtime
+# lock-order tracker green through a serving publish-under-load cycle
+# (the smoke sets LGBM_TPU_GUARDS=lockorder itself), and a seeded
+# inversion trips LockOrderViolation at the acquisition attempt.
+timeout -k 10 90 env JAX_PLATFORMS=cpu \
+    python scripts/concurrency_smoke.py || rc=1
+if [ $rc -ne 0 ]; then
+    echo "check.sh: concurrency smoke failed — skipping tier-1 pytest" >&2
+    exit $rc
 fi
 
 if [ "${LGBM_TPU_R_SMOKE:-0}" != "0" ]; then

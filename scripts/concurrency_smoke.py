@@ -1,0 +1,145 @@
+"""Concurrency gate smoke (<30 s, wired into scripts/check.sh):
+
+  1. conlint static pass (CL001-CL005) is clean against
+     concurrency_baseline.json AND every baseline entry carries a
+     one-line triage reason — the reasonless-entry gate is what keeps
+     "baselined" from degrading into "ignored";
+  2. the runtime lock-order tracker (LGBM_TPU_GUARDS=lockorder,
+     installed by the package import below) stays green through a real
+     serving publish-under-load cycle — concurrent submits + a live
+     tree publish + close, with the serving tier's locks actually
+     wrapped (tracked-lock count > 0 proves the factory patch caught
+     them);
+  3. a seeded lock-order inversion TRIPS the tracker — proof the guard
+     fires, raised at the acquisition attempt, not by deadlocking.
+
+Exits non-zero on the first violated gate.
+"""
+import importlib
+import importlib.util
+import os
+import sys
+import threading
+import time
+
+# the guard must be in the environment BEFORE lightgbm_tpu imports:
+# install_from_env runs at package import, ahead of the submodule
+# imports that create the serving tier's locks
+os.environ["LGBM_TPU_GUARDS"] = "lockorder"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+T_START = time.perf_counter()
+
+
+def check(cond, what):
+    took = time.perf_counter() - T_START
+    if not cond:
+        print(f"concurrency_smoke: FAIL {what} ({took:.1f}s)",
+              file=sys.stderr)
+        sys.exit(1)
+    print(f"concurrency_smoke: ok {what} ({took:.1f}s)")
+
+
+def main() -> int:
+    # -- 1. static pass, loaded by file path (jax-free, same loader as
+    # scripts/jaxlint.py) ---------------------------------------------
+    pkg_dir = os.path.join(REPO, "lightgbm_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "_consmoke_analysis", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    pkg = importlib.util.module_from_spec(spec)
+    sys.modules["_consmoke_analysis"] = pkg
+    spec.loader.exec_module(pkg)
+    concurrency = importlib.import_module("_consmoke_analysis.concurrency")
+
+    rc = concurrency.main([], root=REPO)
+    check(rc == 0, "conlint static pass clean vs baseline")
+    records = concurrency.load_baseline_records(
+        concurrency.default_baseline_path(REPO))
+    bad = concurrency.reasonless_entries(records)
+    check(records and not bad,
+          f"all {len(records)} baseline entries carry a triage reason")
+
+    # -- 2. lockorder guard through a serving publish-under-load cycle
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.analysis import lockorder
+
+    t = lockorder.current_tracker()
+    check(lockorder.installed() and t is not None,
+          "lockorder tracker installed via LGBM_TPU_GUARDS")
+
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(400, 6))
+    y = np.nan_to_num(X[:, 0]) + 0.25 * np.nan_to_num(X[:, 1])
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbose": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=3,
+                    keep_training_booster=True)
+    srv = bst.serve(linger_ms=20.0, raw_score=True)
+    check(t.n_tracked > 0,
+          f"serving-tier locks are wrapped ({t.n_tracked} tracked)")
+
+    stop = threading.Event()
+    errors = []
+
+    def client():
+        while not stop.is_set():
+            try:
+                srv.submit(X[:48]).result(60)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(3)]
+    for th in threads:
+        th.start()
+    time.sleep(0.1)
+    bst.update()
+    srv.publish()                  # live publish under load
+    time.sleep(0.1)
+    stop.set()
+    for th in threads:
+        th.join(30)
+    srv.close(timeout=30)
+    check(not errors and not t.violations,
+          f"publish-under-load cycle green under the tracker "
+          f"(0 violations, {t.n_tracked} locks tracked)")
+
+    # -- 3. seeded inversion trips the guard --------------------------
+    priv = lockorder.LockOrderTracker()
+    a = lockorder.wrap(threading.Lock(), "seed-A", priv)
+    b = lockorder.wrap(threading.Lock(), "seed-B", priv)
+    with a:
+        with b:
+            pass
+    tripped = []
+
+    def inverted():
+        try:
+            with b:
+                with a:       # closes the cycle -> must raise
+                    pass
+        except lockorder.LockOrderViolation as e:
+            tripped.append(e)
+
+    th = threading.Thread(target=inverted, daemon=True)
+    th.start()
+    th.join(10)
+    check(not th.is_alive() and len(tripped) == 1 and
+          "seed-A" in tripped[0].cycle and "seed-B" in tripped[0].cycle,
+          "seeded deadlock trips LockOrderViolation at the attempt "
+          f"({tripped[0].cycle if tripped else 'NOT RAISED'})")
+
+    took = time.perf_counter() - T_START
+    print(f"concurrency_smoke: PASS ({took:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,40 +1,63 @@
 #!/usr/bin/env bash
-# Opt-in sanitizer build of the native ABI (ROADMAP 5(c) down-payment,
-# ISSUE 10 satellite): compile the ~3.7k-LoC c_api/parser/shap/arrow
-# sources with -fsanitize=address,undefined and run the existing
-# parser-fuzz + predict smoke (scripts/_native_fuzz_driver.py — the
-# SAME driver tier-1's test_c_api_fuzz runs against the plain build)
-# under it. Any ASan/UBSan report aborts (-fno-sanitize-recover) and
-# fails the gate.
+# Opt-in sanitizer build of the native ABI (ROADMAP 5(c)): compile the
+# ~3.7k-LoC c_api/parser/shap/arrow sources under a sanitizer and run
+# the existing parser-fuzz + predict smoke (scripts/_native_fuzz_driver.py
+# — the SAME driver tier-1's test_c_api_fuzz runs against the plain
+# build) under it. Any sanitizer report aborts and fails the gate.
 #
-#   bash scripts/native_sanitize.sh          # standalone
-#   LGBM_TPU_SANITIZE=1 bash scripts/check.sh  # as a check.sh step
+# Two legs, selected by LGBM_TPU_SANITIZE:
+#   (default / 1 / address)  ASan+UBSan: heap corruption + UB, single-
+#                            threaded mutation fuzz (-fno-sanitize-recover).
+#   thread                   TSan: data races in the ABI under concurrent
+#                            predict + model-load (--threads driver mode;
+#                            suppressions w/ reasons in
+#                            scripts/tsan_suppressions.txt).
 #
-# Skips LOUDLY (rc 0) when no compiler or no ASan runtime is available
-# — the gate must be honest about not having run, never silently green.
+#   bash scripts/native_sanitize.sh                      # ASan/UBSan
+#   LGBM_TPU_SANITIZE=thread bash scripts/native_sanitize.sh   # TSan
+#   LGBM_TPU_SANITIZE=1 bash scripts/check.sh            # as a check.sh step
+#
+# Skips LOUDLY (rc 0) when no compiler or no sanitizer runtime is
+# available — the gate must be honest about not having run, never
+# silently green.
 set -u
 cd "$(dirname "$0")/.."
 
 NATIVE=lightgbm_tpu/native
-OUT=$NATIVE/_build/lgbm_native_asan.so
 SRCS="$NATIVE/parser.cpp $NATIVE/c_api.cpp $NATIVE/c_api_train.cpp \
       $NATIVE/shap.cpp $NATIVE/arrow_ingest.cpp"
+MODE="${LGBM_TPU_SANITIZE:-address}"
 
 if ! command -v g++ >/dev/null 2>&1; then
     echo "native_sanitize: SKIP — no g++ on PATH (the sanitizer build needs a compiler)"
     exit 0
 fi
-LIBASAN=$(g++ -print-file-name=libasan.so)
-if [ ! -e "$LIBASAN" ]; then
-    echo "native_sanitize: SKIP — g++ has no libasan runtime ($LIBASAN)"
-    exit 0
+
+if [ "$MODE" = "thread" ]; then
+    OUT=$NATIVE/_build/lgbm_native_tsan.so
+    SANFLAGS="-fsanitize=thread"
+    LIBSAN=$(g++ -print-file-name=libtsan.so)
+    if [ ! -e "$LIBSAN" ]; then
+        echo "native_sanitize: SKIP — g++ has no libtsan runtime ($LIBSAN); the TSan leg DID NOT RUN"
+        exit 0
+    fi
+    LABEL="TSan (-fsanitize=thread)"
+else
+    OUT=$NATIVE/_build/lgbm_native_asan.so
+    SANFLAGS="-fsanitize=address,undefined -fno-sanitize-recover=all"
+    LIBSAN=$(g++ -print-file-name=libasan.so)
+    if [ ! -e "$LIBSAN" ]; then
+        echo "native_sanitize: SKIP — g++ has no libasan runtime ($LIBSAN)"
+        exit 0
+    fi
+    LABEL="ASan/UBSan"
 fi
 
-echo "== native_sanitize: building with -fsanitize=address,undefined =="
+echo "== native_sanitize: building with $LABEL =="
 mkdir -p "$NATIVE/_build"
-# shellcheck disable=SC2086 — SRCS is a word list on purpose
+# shellcheck disable=SC2086 — SRCS/SANFLAGS are word lists on purpose
 if ! g++ -O1 -g -shared -fPIC -std=c++17 -pthread \
-        -fsanitize=address,undefined -fno-sanitize-recover=all \
+        $SANFLAGS \
         $SRCS -ldl -o "$OUT.tmp"; then
     echo "native_sanitize: FAIL — sanitizer build did not compile" >&2
     exit 1
@@ -42,10 +65,10 @@ fi
 mv "$OUT.tmp" "$OUT"
 
 # train a tiny model with the PLAIN interpreter (jax must not run under
-# the sanitizer), then fuzz the ASan .so in a minimal ctypes+numpy
-# process with libasan preloaded. detect_leaks=0: the interpreter and
-# numpy hold reachable allocations at exit by design — the gate hunts
-# heap corruption / UB in OUR native code, not CPython leak noise.
+# the sanitizer), then fuzz the sanitized .so in a minimal ctypes+numpy
+# process with the runtime preloaded. detect_leaks=0: the interpreter
+# and numpy hold reachable allocations at exit by design — the gate
+# hunts corruption/UB/races in OUR native code, not CPython leak noise.
 WORK=$(mktemp -d /tmp/native_sanitize.XXXXXX)
 trap 'rm -rf "$WORK"' EXIT
 echo "== native_sanitize: training the fuzz seed model (plain build) =="
@@ -70,8 +93,23 @@ PY
     exit 1
 fi
 
+if [ "$MODE" = "thread" ]; then
+    echo "== native_sanitize: concurrent predict + model-load under TSan =="
+    # halt_on_error: first unsuppressed race report kills the run (and
+    # the driver exits nonzero); exitcode backs it up if TSan chooses
+    # to report-and-continue on some interceptor path.
+    if LD_PRELOAD="$LIBSAN" \
+       TSAN_OPTIONS="suppressions=scripts/tsan_suppressions.txt:halt_on_error=1:exitcode=66:report_thread_leaks=0" \
+       python scripts/_native_fuzz_driver.py "$OUT" "$WORK/m.txt" --threads 8; then
+        echo "native_sanitize: OK (no TSan reports; suppressions: scripts/tsan_suppressions.txt)"
+        exit 0
+    fi
+    echo "native_sanitize: FAIL — TSan reported a race (or the driver died)" >&2
+    exit 1
+fi
+
 echo "== native_sanitize: parser-fuzz + predict smoke under ASan/UBSan =="
-if LD_PRELOAD="$LIBASAN" \
+if LD_PRELOAD="$LIBSAN" \
    ASAN_OPTIONS="detect_leaks=0:abort_on_error=1" \
    UBSAN_OPTIONS="print_stacktrace=1" \
    python scripts/_native_fuzz_driver.py "$OUT" "$WORK/m.txt"; then

@@ -1,0 +1,536 @@
+"""conlint static pass + runtime lock-order tracker: rule coverage,
+suppression, baseline reason semantics, LockGraph units, tracker
+fire/no-fire.
+
+Mirror of tests/test_jaxlint.py for the concurrency leg (ISSUE 16):
+one positive + one negative fixture per rule ID (CL001-CL005) linted as
+source strings, suppression via either comment tag (the regex is shared
+with jaxlint), the reason-preserving baseline merge plus the
+reasonless-entry gate, cycle units on the shared LockGraph, and the
+runtime tracker raising on a seeded inversion while staying silent on
+consistent order / reentrancy / Condition.wait.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from lightgbm_tpu.analysis import concurrency, lockorder
+from lightgbm_tpu.analysis.concurrency import (
+    CONCURRENCY_RULE_IDS,
+    LockGraph,
+    lint_source,
+    load_baseline_records,
+    reasonless_entries,
+    run_paths,
+    save_baseline,
+)
+
+REPO_ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def lint(src):
+    return lint_source(textwrap.dedent(src), "lightgbm_tpu/serving/x.py")
+
+
+# ---------------------------------------------------------------------------
+# per-rule positive/negative fixtures
+# ---------------------------------------------------------------------------
+
+def test_cl001_lock_order_inversion_fires():
+    findings = lint('''\
+        import threading
+
+
+        class S:
+            def __init__(self):
+                self.a_lock = threading.Lock()
+                self.b_lock = threading.Lock()
+
+            def one(self):
+                with self.a_lock:
+                    with self.b_lock:
+                        pass
+
+            def two(self):
+                with self.b_lock:
+                    with self.a_lock:
+                        pass
+        ''')
+    assert "CL001" in rules_of(findings)
+
+
+def test_cl001_consistent_order_silent():
+    findings = lint('''\
+        import threading
+
+
+        class S:
+            def __init__(self):
+                self.a_lock = threading.Lock()
+                self.b_lock = threading.Lock()
+
+            def one(self):
+                with self.a_lock:
+                    with self.b_lock:
+                        pass
+
+            def two(self):
+                with self.a_lock:
+                    with self.b_lock:
+                        pass
+        ''')
+    assert "CL001" not in rules_of(findings)
+
+
+def test_cl002_blocking_call_under_lock_fires():
+    findings = lint('''\
+        import threading
+        import time
+
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def hot(self):
+                with self._lock:
+                    time.sleep(0.1)
+        ''')
+    assert [f.rule for f in findings if f.rule == "CL002"]
+
+
+def test_cl002_blocking_outside_lock_silent():
+    findings = lint('''\
+        import threading
+        import time
+
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def hot(self):
+                with self._lock:
+                    x = 1
+                time.sleep(0.1)
+                return x
+        ''')
+    assert "CL002" not in rules_of(findings)
+
+
+def test_cl002_transitive_through_same_module_call():
+    findings = lint('''\
+        import threading
+        import time
+
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _slow(self):
+                time.sleep(0.1)
+
+            def hot(self):
+                with self._lock:
+                    self._slow()
+        ''')
+    assert "CL002" in rules_of(findings)
+
+
+def test_cl003_unlocked_shared_write_fires():
+    findings = lint('''\
+        import threading
+
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                self.count += 1
+
+            def stats(self):
+                return self.count
+        ''')
+    cl3 = [f for f in findings if f.rule == "CL003"]
+    assert cl3 and "count" in cl3[0].line_text
+
+
+def test_cl003_locked_write_silent():
+    findings = lint('''\
+        import threading
+
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                with self._lock:
+                    self.count += 1
+
+            def stats(self):
+                with self._lock:
+                    return self.count
+        ''')
+    assert "CL003" not in rules_of(findings)
+
+
+def test_cl004_condition_wait_outside_while_fires():
+    findings = lint('''\
+        import threading
+
+
+        class S:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._ready = False
+
+            def get(self):
+                with self._cv:
+                    if not self._ready:
+                        self._cv.wait()
+        ''')
+    assert "CL004" in rules_of(findings)
+
+
+def test_cl004_wait_in_predicate_while_silent():
+    findings = lint('''\
+        import threading
+
+
+        class S:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._ready = False
+
+            def get(self):
+                with self._cv:
+                    while not self._ready:
+                        self._cv.wait()
+        ''')
+    assert "CL004" not in rules_of(findings)
+
+
+def test_cl005_undisciplined_thread_fires():
+    findings = lint('''\
+        import threading
+
+
+        def go():
+            t = threading.Thread(target=print)
+            t.start()
+        ''')
+    assert "CL005" in rules_of(findings)
+
+
+def test_cl005_daemon_thread_silent():
+    findings = lint('''\
+        import threading
+
+
+        def go():
+            t = threading.Thread(target=print, daemon=True)
+            t.start()
+        ''')
+    assert "CL005" not in rules_of(findings)
+
+
+def test_syntax_error_reports_cl000():
+    findings = lint_source("def broken(:\n", "lightgbm_tpu/serving/x.py")
+    assert [f.rule for f in findings] == ["CL000"]
+
+
+# ---------------------------------------------------------------------------
+# suppression: either comment tag silences a conlint rule
+# ---------------------------------------------------------------------------
+
+BLOCKING = '''\
+    import threading
+    import time
+
+
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def hot(self):
+            with self._lock:
+                {comment}
+                time.sleep(0.1)
+    '''
+
+
+def test_suppression_conlint_tag():
+    src = textwrap.dedent(BLOCKING).format(
+        comment="# conlint: disable=CL002 — deliberate for this test")
+    assert "CL002" not in rules_of(
+        lint_source(src, "lightgbm_tpu/serving/x.py"))
+
+
+def test_suppression_shared_jaxlint_tag():
+    # one suppression regex serves both passes: the jaxlint spelling
+    # also silences a CL rule (and vice versa)
+    src = textwrap.dedent(BLOCKING).format(
+        comment="# jaxlint: disable=CL002")
+    assert "CL002" not in rules_of(
+        lint_source(src, "lightgbm_tpu/serving/x.py"))
+
+
+def test_suppression_other_rule_does_not_silence():
+    src = textwrap.dedent(BLOCKING).format(
+        comment="# conlint: disable=CL001")
+    assert "CL002" in rules_of(
+        lint_source(src, "lightgbm_tpu/serving/x.py"))
+
+
+# ---------------------------------------------------------------------------
+# baseline: reason preservation + the reasonless gate
+# ---------------------------------------------------------------------------
+
+def _some_findings():
+    return lint('''\
+        import threading
+        import time
+
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def hot(self):
+                with self._lock:
+                    time.sleep(0.1)
+        ''')
+
+
+def test_baseline_new_entries_get_todo_and_fail_gate(tmp_path):
+    path = str(tmp_path / "b.json")
+    save_baseline(path, _some_findings())
+    records = load_baseline_records(path)
+    assert records and all(
+        e["reason"].startswith("TODO") for e in records)
+    assert reasonless_entries(records) == records
+
+
+def test_baseline_reasons_survive_regeneration(tmp_path):
+    path = str(tmp_path / "b.json")
+    findings = _some_findings()
+    save_baseline(path, findings)
+    records = load_baseline_records(path)
+    for e in records:
+        e["reason"] = "single-writer telemetry, GIL-atomic reads"
+    # regeneration with prior_records keeps the human-entered reason
+    save_baseline(path, findings, prior_records=records)
+    again = load_baseline_records(path)
+    assert [e["reason"] for e in again] == [
+        "single-writer telemetry, GIL-atomic reads"] * len(records)
+    assert reasonless_entries(again) == []
+
+
+def test_repo_gate_zero_new_findings_and_reasoned_baseline():
+    # the actual repo state: the ten lock-bearing modules vs
+    # concurrency_baseline.json — 0 new, every entry reasoned
+    findings = run_paths(concurrency.default_targets(REPO_ROOT),
+                         REPO_ROOT)
+    records = load_baseline_records(
+        concurrency.default_baseline_path(REPO_ROOT))
+    known = {e["fingerprint"] for e in records}
+    new = [f for f in findings if f.fingerprint not in known]
+    assert new == [], [f"{f.path}:{f.line} {f.rule}" for f in new]
+    assert records and reasonless_entries(records) == []
+
+
+def test_cli_exit_codes():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "jaxlint.py"),
+         "--pass", "concurrency"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stdout + rc.stderr
+    bad = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "jaxlint.py"),
+         "--pass", "nonsense"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True)
+    assert bad.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# LockGraph units
+# ---------------------------------------------------------------------------
+
+def test_lockgraph_reports_cycle_path():
+    g = LockGraph()
+    assert g.add_edge("a", "b", "s1") is None
+    assert g.add_edge("b", "c", "s2") is None
+    cycle = g.add_edge("c", "a", "s3")
+    assert cycle is not None and cycle[0] == cycle[-1] == "a"
+    assert set(cycle) == {"a", "b", "c"}
+
+
+def test_lockgraph_reentrant_and_duplicate_edges():
+    g = LockGraph()
+    assert g.add_edge("a", "a", "s") is None        # reentrant: ignored
+    assert g.add_edge("a", "b", "s1") is None
+    assert g.add_edge("a", "b", "s2") is None       # duplicate: no recheck
+    assert g.site("a", "b") == "s1"                 # first site wins
+
+
+# ---------------------------------------------------------------------------
+# runtime tracker fire/no-fire
+# ---------------------------------------------------------------------------
+
+def _in_thread(fn, timeout=10):
+    out = {}
+
+    def run():
+        try:
+            out["r"] = fn()
+        except BaseException as e:  # noqa: BLE001
+            out["e"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout)
+    assert not t.is_alive(), "worker wedged"
+    return out
+
+
+def test_tracker_consistent_order_is_silent():
+    t = lockorder.LockOrderTracker()
+    a = lockorder.wrap(threading.Lock(), "A", t)
+    b = lockorder.wrap(threading.Lock(), "B", t)
+
+    def ordered():
+        with a:
+            with b:
+                pass
+
+    ordered()
+    out = _in_thread(ordered)
+    assert "e" not in out and t.violations == []
+
+
+def test_tracker_inversion_raises_at_attempt():
+    t = lockorder.LockOrderTracker()
+    a = lockorder.wrap(threading.Lock(), "A", t)
+    b = lockorder.wrap(threading.Lock(), "B", t)
+    with a:
+        with b:
+            pass
+
+    def inverted():
+        with b:
+            with a:
+                pass
+
+    out = _in_thread(inverted)
+    assert isinstance(out.get("e"), lockorder.LockOrderViolation)
+    assert out["e"].cycle[0] == out["e"].cycle[-1]
+    assert {"A", "B"} <= set(out["e"].cycle)
+    assert t.violations  # recorded as well as raised
+
+
+def test_tracker_reentrant_rlock_silent():
+    t = lockorder.LockOrderTracker()
+    r = lockorder.wrap(threading.RLock(), "R", t)
+    with r:
+        with r:
+            pass
+    assert t.violations == [] and t.held_names() == []
+
+
+def test_tracker_condition_wait_roundtrip():
+    t = lockorder.LockOrderTracker()
+    cv = threading.Condition(
+        lockorder.wrap(threading.RLock(), "CV", t))
+    flag = []
+
+    def waiter():
+        with cv:
+            while not flag:
+                cv.wait(timeout=5)
+
+    th = threading.Thread(target=waiter, daemon=True)
+    th.start()
+    with cv:
+        flag.append(1)
+        cv.notify_all()
+    th.join(10)
+    assert not th.is_alive()
+    assert t.violations == [] and t.held_names() == []
+
+
+def test_tracker_non_raising_mode_records_only():
+    t = lockorder.LockOrderTracker(raise_on_cycle=False)
+    a = lockorder.wrap(threading.Lock(), "A", t)
+    b = lockorder.wrap(threading.Lock(), "B", t)
+    with a:
+        with b:
+            pass
+    out = _in_thread(lambda: b.acquire() and (a.acquire(), a.release(),
+                                              b.release()))
+    assert "e" not in out
+    assert len(t.violations) == 1
+
+
+def test_factory_patch_frame_filter():
+    # locks created from an instrumented file get wrapped; everyone
+    # else keeps the primitive
+    with lockorder.tracking() as t:
+        inst = lockorder._instrumented_files()[0]
+        ns = {}
+        exec(compile("import threading\n"
+                     "lk = threading.Lock()\n"
+                     "cv = threading.Condition()\n", inst, "exec"), ns)
+        assert isinstance(ns["lk"], lockorder.TrackedLock)
+        assert isinstance(ns["cv"]._lock, lockorder.TrackedLock)
+        assert not isinstance(threading.Lock(), lockorder.TrackedLock)
+        assert t.n_tracked >= 2
+    assert not lockorder.installed()
+    assert threading.Lock is lockorder._ORIG_LOCK
+
+
+def test_install_idempotent_and_uninstall_restores():
+    try:
+        t1 = lockorder.install()
+        assert lockorder.install() is t1          # idempotent
+        assert lockorder.current_tracker() is t1
+    finally:
+        lockorder.uninstall()
+    assert threading.Condition is lockorder._ORIG_CONDITION
+    assert lockorder.current_tracker() is None
+
+
+def test_rule_ids_exported():
+    assert CONCURRENCY_RULE_IDS == ("CL001", "CL002", "CL003", "CL004",
+                                    "CL005")
+
+
+def test_baseline_file_is_valid_json_with_tool_tag():
+    with open(os.path.join(REPO_ROOT, "concurrency_baseline.json"),
+              encoding="utf-8") as fh:
+        data = json.load(fh)
+    assert data["tool"] == "conlint"
+    assert data["findings"], "baseline unexpectedly empty"
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -1,0 +1,99 @@
+"""ServingCounters / per-tenant ledger stress under the lock-order
+tracker (ISSUE 16 satellite): N threads hammer ``inc`` /
+``inc_tenant`` / ``tenant_snapshot`` / ``drop_tenant`` concurrently;
+totals must come out EXACT (the lock is real, not decorative) and the
+runtime tracker must stay silent (no ordering violation anywhere in
+the counters path).
+
+The counters object is built through the patched factories (tracking()
+installed before instantiation), so its ``_lock`` is a TrackedLock —
+the stress run is itself tracker coverage, not just a GIL test.
+"""
+import threading
+
+from lightgbm_tpu.analysis import lockorder
+from lightgbm_tpu.serving.metrics import ServingCounters
+
+N_THREADS = 8
+N_ITERS = 400
+STABLE = tuple(f"tenant-{i}" for i in range(4))
+
+
+def test_counters_exact_totals_under_tracker():
+    with lockorder.tracking() as tracker:
+        counters = ServingCounters()
+        assert isinstance(counters._lock, lockorder.TrackedLock), (
+            "metrics.py lock not wrapped — frame filter regressed")
+
+        start = threading.Barrier(N_THREADS + 2)
+        stop = threading.Event()
+        errors = []
+
+        def worker(tid):
+            try:
+                start.wait()
+                tenant = STABLE[tid % len(STABLE)]
+                for _ in range(N_ITERS):
+                    counters.inc("shed", tenant=tenant)
+                    counters.inc("expired")
+                    counters.inc_tenant(tenant, "requests", 2)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        def churner():
+            # volatile tenants appear and vanish while workers run:
+            # drop_tenant must never corrupt the stable ledgers
+            try:
+                start.wait()
+                i = 0
+                while not stop.is_set():
+                    name = f"volatile-{i % 3}"
+                    counters.inc_tenant(name, "rows", 1)
+                    counters.drop_tenant(name)
+                    i += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        def snapshotter():
+            # concurrent readers: snapshots must always be internally
+            # consistent dicts, never half-built ledgers
+            try:
+                start.wait()
+                while not stop.is_set():
+                    snap = counters.tenant_snapshot()
+                    for led in snap.values():
+                        assert set(led) == set(ServingCounters.TENANT_NAMES)
+                    counters.snapshot()
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        threads = ([threading.Thread(target=worker, args=(i,), daemon=True)
+                    for i in range(N_THREADS)]
+                   + [threading.Thread(target=churner, daemon=True),
+                      threading.Thread(target=snapshotter, daemon=True)])
+        for t in threads:
+            t.start()
+        for t in threads[:N_THREADS]:
+            t.join(60)
+        stop.set()
+        for t in threads[N_THREADS:]:
+            t.join(30)
+        assert not any(t.is_alive() for t in threads), "stress wedged"
+        assert errors == []
+
+        total = N_THREADS * N_ITERS
+        assert counters.get("shed") == total
+        assert counters.get("expired") == total
+        per_tenant = total // len(STABLE)
+        snap = counters.tenant_snapshot()
+        for tenant in STABLE:
+            assert snap[tenant]["shed"] == per_tenant
+            assert snap[tenant]["requests"] == 2 * per_tenant
+        # the volatile churn left nothing behind once dropped
+        for name in list(snap):
+            if name.startswith("volatile-"):
+                counters.drop_tenant(name)
+        assert set(counters.tenant_snapshot()) == set(STABLE)
+
+        assert tracker.violations == []
+        assert tracker.held_names() == []
