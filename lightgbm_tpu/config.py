@@ -398,6 +398,14 @@ _reg("tpu_serving_fleet_pack_budget_mb", float, 256.0, (),
 # one noisy tenant cannot starve the fleet. 0 = no per-tenant quota
 # (the fleet-wide row bound still applies).
 _reg("tpu_serving_fleet_quota_rows", int, 0, (), (0, None, True, False))
+# HBM budget (MB) for RESIDENT fleet packs (ISSUE 17): the fleet keeps
+# a byte ledger of device-resident bucket mega-packs; over this budget
+# cold buckets are LRU-evicted (device pack dropped, host pack
+# retained) and lazily rebuilt bit-exactly on next touch — one upload,
+# no trace, generations preserved. A publish that would not fit
+# force-evicts the coldest pack instead of failing. 0 = unbounded.
+_reg("tpu_serving_mem_budget_mb", float, 0.0, (),
+     (0.0, None, True, False))
 # continual-learning service (lightgbm_tpu/service/, ISSUE 14): one
 # process joining the resident trainer, the publish pump and the HTTP
 # front door. port 0 binds an ephemeral port (ContinualService.frontdoor
@@ -406,6 +414,12 @@ _reg("tpu_service_port", int, 0, (), (0, 65535, True, True))
 # rolling training window: the resident trainer boosts on the newest
 # this-many stream rows each cycle (fresh rows push old ones out).
 _reg("tpu_service_window_rows", int, 8192, (), (1, None, True, False))
+# window auto-shrink floor (ISSUE 17): when a re-bin / train cycle dies
+# with MemoryError/OOM the trainer HALVES its rolling window (freshness
+# regression, never a crash loop) down to this floor, and grows it back
+# toward tpu_service_window_rows after sustained pressure-free cycles.
+# At the floor an OOM is re-raised — genuine exhaustion must be loud.
+_reg("tpu_service_window_floor", int, 1024, (), (1, None, True, False))
 # boosting iterations per window refresh cycle.
 _reg("tpu_service_iters_per_cycle", int, 4, (), (1, None, True, False))
 # publish cadence: a checkpoint (the publish channel — the serving

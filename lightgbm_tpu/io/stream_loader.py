@@ -122,8 +122,19 @@ class StreamFollower:
     single ``write`` of whole lines), and parses them with the same
     native chunk kernel (:func:`~..native.parse_dense_chunk`) the
     two-round path uses. Column count is locked from the first complete
-    line; short/ragged later lines fail loudly (a corrupt stream must
-    never silently train).
+    line.
+
+    Poison rows (ISSUE 17): a ragged or unparseable line used to be
+    fatal, which turns ONE corrupt producer write into a trainer crash
+    loop — the follower restarts, re-reads the same bytes, and dies on
+    the same line forever. Instead, bad complete lines (wrong separator
+    count, or parsing to an all-NaN row) are quarantined verbatim to a
+    ``<path>.deadletter`` sidecar, counted in ``rows_skipped`` (the
+    trainer surfaces the count in its freshness watermark), warned
+    about once, and the surrounding good rows still train. The skip
+    budget ``max_skips`` bounds silent data loss: exceeding it raises,
+    because a stream that is MOSTLY garbage is a config error (wrong
+    separator, wrong file), not a few torn writes.
 
     The cursor state is three numbers — byte ``offset``, ``rows_seen``
     and ``last_row_time`` (host wall clock of the newest ingested row,
@@ -132,13 +143,41 @@ class StreamFollower:
     """
 
     def __init__(self, path: str, sep: str = ",",
-                 n_cols: Optional[int] = None):
+                 n_cols: Optional[int] = None, max_skips: int = 64):
         self.path = path
         self.sep = sep
         self.n_cols = n_cols
         self.offset = 0
         self.rows_seen = 0
         self.last_row_time: Optional[float] = None
+        self.max_skips = int(max_skips)
+        self.rows_skipped = 0
+        self.deadletter_path = path + ".deadletter"
+        self._skip_warned = False
+
+    def _quarantine(self, lines: List[bytes], why: str) -> None:
+        """Append poison lines verbatim to the deadletter sidecar and
+        charge them to the skip budget (fatal only past budget)."""
+        with open(self.deadletter_path, "ab") as f:
+            for ln in lines:
+                f.write(ln + b"\n")
+        self.rows_skipped += len(lines)
+        if not self._skip_warned:
+            self._skip_warned = True
+            log.warning(
+                f"stream {self.path}: quarantined {len(lines)} {why} "
+                f"line(s) to {self.deadletter_path} (column count "
+                f"locked at {self.n_cols}); further skips logged at "
+                "info level")
+        else:
+            log.info(f"stream {self.path}: quarantined {len(lines)} "
+                     f"{why} line(s) ({self.rows_skipped} total)")
+        if self.rows_skipped > self.max_skips:
+            raise ValueError(
+                f"stream {self.path}: {self.rows_skipped} poison rows "
+                f"exceed the skip budget ({self.max_skips}) — the "
+                "stream is malformed (wrong separator or column "
+                f"count?); see {self.deadletter_path}")
 
     def poll(self, max_bytes: int = 64 << 20) -> Optional[np.ndarray]:
         """New complete rows as an [n, n_cols] f64 matrix (None when
@@ -163,25 +202,35 @@ class StreamFollower:
             self.n_cols = first.decode("utf-8", "replace").count(
                 self.sep) + 1
         # structural guard BEFORE parsing: every complete line must
-        # carry exactly n_cols-1 separators. The aggregate count catches
-        # a short/ragged line (a non-atomic producer write) that would
-        # otherwise parse with NaN-filled tail columns and silently
-        # train as missing values.
+        # carry exactly n_cols-1 separators. The cheap aggregate count
+        # detects a short/ragged line (a non-atomic producer write)
+        # that would otherwise parse with NaN-filled tail columns and
+        # silently train as missing values; only when it trips do we
+        # pay the per-line scan to quarantine the offenders.
         n_lines = blob.count(b"\n")
-        seps = blob.count(self.sep.encode())
-        if seps != n_lines * (self.n_cols - 1):
-            raise ValueError(
-                f"stream {self.path}: ragged line(s) after byte "
-                f"{self.offset} ({seps} separators over {n_lines} "
-                f"lines; column count locked at {self.n_cols}) — "
-                "producers must append whole lines atomically")
+        want = self.n_cols - 1
+        sep_b = self.sep.encode()
+        if blob.count(sep_b) != n_lines * want:
+            lines = blob.split(b"\n")[:n_lines]
+            good = [ln for ln in lines if ln.count(sep_b) == want]
+            self._quarantine(
+                [ln for ln in lines if ln.count(sep_b) != want],
+                "ragged")
+            if not good:
+                self.offset += nl + 1
+                return None
+            blob = b"\n".join(good) + b"\n"
         mat = parse_dense_chunk(blob, self.sep, self.n_cols)
-        if np.isnan(mat).all(axis=1).any():
-            raise ValueError(
-                f"stream {self.path}: unparseable row(s) after byte "
-                f"{self.offset} (column count locked at {self.n_cols})")
+        bad = np.isnan(mat).all(axis=1)
+        if bad.any():
+            lines = blob.split(b"\n")
+            self._quarantine(
+                [lines[i] for i in np.flatnonzero(bad)], "unparseable")
+            mat = mat[~bad]
         self.offset += nl + 1
         self.rows_seen += len(mat)
+        if len(mat) == 0:
+            return None
         self.last_row_time = _time.time()
         return mat
 

@@ -661,8 +661,21 @@ def window_cat_width(window_np) -> int:
 
 def pytree_nbytes(tree) -> int:
     """Total bytes of a (host or device) pytree — the fleet's
-    replicate-vs-model-shard decision input."""
+    replicate-vs-model-shard decision input and the byte ledger the
+    HBM budget (``tpu_serving_mem_budget_mb``) is enforced against."""
     return int(sum(a.nbytes for a in jax.tree.leaves(tree)))
+
+
+def upload_window(host):
+    """ONE device upload of a host-assembled pack pytree (ISSUE 17):
+    the fleet's pack-upload point, both at publish (``_build_bucket``)
+    and at the lazy rebuild of an evicted bucket. Consults the ``oom``
+    fault site immediately before the transfer — a fired fault means
+    the allocation failed and nothing reached the device. No trace:
+    ``jnp.asarray`` of a concrete numpy array is a transfer, not a
+    program."""
+    faults.maybe_fail("oom")
+    return jax.tree.map(jnp.asarray, host)
 
 
 class ServingEngine:

@@ -64,6 +64,16 @@ Fault classes (the ``site`` argument of :func:`maybe_fail`):
   the blocked-dead-peer shape that must surface as
   ``CollectiveTimeout`` (DEADLINE_EXCEEDED) instead of wedging the
   rank to the whole-gang timeout.
+- ``oom`` — an allocation fails: raises :class:`OOMInjected`, whose
+  message carries ``RESOURCE_EXHAUSTED`` so the retry classifier files
+  it as non-transient (retrying the same allocation is futile — the
+  caller must adapt, ISSUE 17). One site name, three consult points
+  selected with ``p=``/``after=`` exactly like ``publish_fail``: the
+  serving dispatch (serving/server.py ``_device_scores`` and
+  serving/fleet.py ``_bucket_scores`` — the bisection ladder), the
+  fleet pack upload (ops/forest.py ``upload_window`` — publish-forced
+  eviction), and the trainer re-bin (service/trainer.py — window
+  auto-shrink).
 
 Options per spec:
 
@@ -103,7 +113,7 @@ ENV_FAULTS = "LGBM_TPU_FAULTS"
 
 KNOWN_SITES = ("collective", "probe_timeout", "write_kill", "hang",
                "slow_compile", "dispatch_error", "slow_dispatch",
-               "publish_fail", "rank_kill", "collective_delay")
+               "publish_fail", "rank_kill", "collective_delay", "oom")
 
 # exit code of an injected rank_kill: the gang supervisor annotates it
 # in the per-rank diagnosis (distinct from EXIT_STALLED=86 so forensics
@@ -119,6 +129,13 @@ class FaultInjected(Exception):
 class WriteKilled(FaultInjected):
     """An injected mid-write kill: the write never completed; whatever
     bytes hit the disk are garbage that recovery must survive."""
+
+
+class OOMInjected(FaultInjected):
+    """An injected allocation failure — the NON-transient member of the
+    family: its message carries ``RESOURCE_EXHAUSTED`` so the retry
+    classifier refuses to burn budget on it and the call site must
+    adapt (bisect / evict / shrink) instead."""
 
 
 class _Fault:
@@ -231,6 +248,10 @@ def maybe_fail(site: str) -> None:
     if site == "write_kill":
         raise WriteKilled(
             f"injected mid-write kill (write #{f.calls})")
+    if site == "oom":
+        raise OOMInjected(
+            f"RESOURCE_EXHAUSTED: injected oom fault "
+            f"(call #{f.calls}, injection #{f.fired})")
     raise FaultInjected(
         f"UNAVAILABLE: injected {site} fault "
         f"(call #{f.calls}, injection #{f.fired})")

@@ -16,6 +16,32 @@ Jitter", AWS builders' library): ``sleep = min(cap, uniform(base,
 prev_sleep * 3))`` — spreads concurrent retriers apart instead of
 re-synchronizing them the way plain exponential backoff does.
 
+Classifier table (ISSUE 17) — every failure a call site may see falls
+in exactly one class, and this table is the single place the classes
+are defined (tests assert the table, the docstring and the classifiers
+stay in sync):
+
+- ``TRANSIENT`` — device/network flake (UNAVAILABLE / ABORTED /
+  connection errors): a later attempt of the SAME call may succeed, so
+  :func:`retry_call` burns budget on it. Markers:
+  :data:`TRANSIENT_MARKERS` / :data:`TRANSIENT_TYPES`.
+- ``DEADLINE`` — a liveness budget expired (DEADLINE_EXCEEDED /
+  timeouts). Retried like TRANSIENT (the next attempt gets a fresh
+  sub-slot), but reported distinctly by :func:`classify_error` so
+  forensics can tell a flake from a wedge. Markers:
+  :data:`DEADLINE_MARKERS` / ``TimeoutError``.
+- ``RESOURCE_EXHAUSTED`` — an allocation failed (XLA
+  RESOURCE_EXHAUSTED / "out of memory" / ``MemoryError``). Retrying
+  the SAME allocation is futile, so the classifier returns
+  non-transient and :func:`retry_call` propagates immediately; the
+  call site must ADAPT the request instead — the serving dispatcher
+  bisects the batch (serving/server.py), the fleet evicts cold packs
+  (serving/fleet.py), the trainer shrinks its window
+  (service/trainer.py). Markers: :data:`OOM_MARKERS` /
+  :data:`OOM_TYPES`.
+- ``FATAL`` — everything else (a code bug): propagates immediately,
+  never retried, never adapted around.
+
 No jax import at module scope (the classifier matches on type/message
 strings precisely so it can run in processes that must not initialize a
 backend).
@@ -52,22 +78,96 @@ TRANSIENT_TYPES = (
     "BrokenPipeError",
 )
 
+# The DEADLINE sub-class of the transient markers: budget expiries that
+# classify_error reports distinctly (still retried by retry_call).
+DEADLINE_MARKERS = (
+    "DEADLINE_EXCEEDED",
+    "timed out",
+    "timeout",
+)
+
+# Substrings marking RESOURCE_EXHAUSTED: the allocation itself failed,
+# so re-attempting the SAME call is futile — the caller must shrink,
+# bisect or evict (ISSUE 17). XLA's OOM status is the gRPC name; the
+# plain phrases cover allocator messages and host MemoryError reprs.
+OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "out of memory",
+    "failed to allocate",
+)
+
+# Exception type names treated as RESOURCE_EXHAUSTED regardless of
+# message (host-side allocation failures during re-bin / pack build).
+OOM_TYPES = (
+    "MemoryError",
+)
+
+# The classifier table, machine-readable: class name -> one-line
+# contract. tests/test_robustness.py asserts every class here appears
+# in the module docstring (the drift check of the ISSUE 17 satellite).
+ERROR_CLASSES = {
+    "TRANSIENT": "device/network flake — retry the same call",
+    "DEADLINE": "liveness budget expired — retry with a fresh slot",
+    "RESOURCE_EXHAUSTED": "allocation failed — adapt, never retry",
+    "FATAL": "code bug — propagate immediately",
+}
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    """True when ``exc`` is RESOURCE_EXHAUSTED-classified: the
+    allocation failed, so retrying the identical call cannot succeed.
+    Callers adapt instead (bisect the batch / evict a pack / shrink
+    the window)."""
+    for t in type(exc).__mro__:
+        if t.__name__ in OOM_TYPES:
+            return True
+    text = f"{type(exc).__name__}: {exc}"
+    upper = text.upper()
+    return any(m.upper() in upper for m in OOM_MARKERS)
+
 
 def is_transient_error(exc: BaseException) -> bool:
     """True when ``exc`` looks like a device/network failure that a
     later attempt may survive (UNAVAILABLE / DEADLINE_EXCEEDED /
     timeouts), False for anything that smells like a code bug.
 
+    RESOURCE_EXHAUSTED is explicitly NOT transient even when the
+    runtime dresses it in otherwise-transient text: retrying the same
+    allocation burns the whole budget on attempts that cannot succeed
+    (ISSUE 17) — :func:`retry_call` propagates it so the dispatch
+    layer can adapt.
+
     jaxlib's XlaRuntimeError carries the gRPC status name in its
     message, so string matching is the stable contract across jaxlib
     versions (the exception classes themselves moved modules twice).
     """
+    if is_oom_error(exc):
+        return False
     for t in type(exc).__mro__:
         if t.__name__ in TRANSIENT_TYPES:
             return True
     text = f"{type(exc).__name__}: {exc}"
     upper = text.upper()
     return any(m.upper() in upper for m in TRANSIENT_MARKERS)
+
+
+def classify_error(exc: BaseException) -> str:
+    """Classify ``exc`` into one of :data:`ERROR_CLASSES`.
+
+    Precedence: RESOURCE_EXHAUSTED beats DEADLINE beats TRANSIENT
+    (an OOM whose message also mentions a timeout is still an OOM);
+    anything unrecognized is FATAL."""
+    if is_oom_error(exc):
+        return "RESOURCE_EXHAUSTED"
+    if not is_transient_error(exc):
+        return "FATAL"
+    for t in type(exc).__mro__:
+        if t.__name__ == "TimeoutError":
+            return "DEADLINE"
+    upper = f"{type(exc).__name__}: {exc}".upper()
+    if any(m.upper() in upper for m in DEADLINE_MARKERS):
+        return "DEADLINE"
+    return "TRANSIENT"
 
 
 class RetryError(Exception):

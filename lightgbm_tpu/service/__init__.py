@@ -67,6 +67,7 @@ class ContinualService:
                  *, host: str = "127.0.0.1", port: Optional[int] = None,
                  trainer_mode: Optional[str] = None,
                  window_rows: Optional[int] = None,
+                 window_floor_rows: Optional[int] = None,
                  min_rows: int = 256,
                  iters_per_cycle: Optional[int] = None,
                  publish_every_iters: Optional[int] = None,
@@ -102,6 +103,8 @@ class ContinualService:
             ckpt_dir=ckpt_dir, label_col=int(label_col),
             window_rows=int(knob(window_rows,
                                  "tpu_service_window_rows")),
+            window_floor_rows=int(knob(window_floor_rows,
+                                       "tpu_service_window_floor")),
             min_rows=int(min_rows),
             iters_per_cycle=int(knob(iters_per_cycle,
                                      "tpu_service_iters_per_cycle")),

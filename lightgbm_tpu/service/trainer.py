@@ -63,6 +63,7 @@ class TrainerSpec:
     ckpt_dir: str                 # checkpoint/publish directory
     label_col: int = 0
     window_rows: int = 8192      # rolling training window
+    window_floor_rows: int = 1024  # OOM auto-shrink floor (ISSUE 17)
     min_rows: int = 256          # first fit waits for this many rows
     iters_per_cycle: int = 4     # boosting rounds per window refresh
     publish_every_iters: int = 4  # checkpoint/publish cadence
@@ -101,7 +102,9 @@ def run_resident_trainer(spec: TrainerSpec,
     import lightgbm_tpu as lgb
     from ..io.stream_loader import StreamFollower
     from ..robustness import checkpoint as ckpt
+    from ..robustness import faults
     from ..robustness import heartbeat
+    from ..robustness.retry import is_oom_error
 
     heartbeat.install_from_env()
     heartbeat.beat("boot", 0)
@@ -109,6 +112,14 @@ def run_resident_trainer(spec: TrainerSpec,
     window: Optional[np.ndarray] = None
     model_str: Optional[str] = None
     iteration = 0
+    # memory-pressure auto-shrink (ISSUE 17): the EFFECTIVE rolling
+    # window, halved on an OOM'd cycle down to the floor and grown back
+    # after sustained pressure-free cycles — a freshness regression,
+    # never a crash loop
+    win_rows = int(spec.window_rows)
+    win_floor = max(1, min(int(spec.window_floor_rows), win_rows))
+    ok_cycles = 0
+    shrink_warned = False
 
     found = ckpt.latest_valid_checkpoint(spec.ckpt_dir)
     if found is not None:
@@ -154,8 +165,8 @@ def run_resident_trainer(spec: TrainerSpec,
                 return
             window = fresh if window is None else \
                 np.concatenate([window, fresh], axis=0)
-            if len(window) > spec.window_rows:
-                window = window[-spec.window_rows:]
+            if len(window) > win_rows:
+                window = window[-win_rows:]
             # a large backlog drains in many 64MB polls: keep beating
             # so catch-up reads as alive, never as a stall
             heartbeat.beat("ingest", int(follower.rows_seen))
@@ -180,6 +191,8 @@ def run_resident_trainer(spec: TrainerSpec,
             "watermark_ts": float(follower.last_row_time or time.time()),
             "stream_offset": int(follower.offset),
             "window_rows": int(len(window)),
+            "window_rows_target": int(win_rows),
+            "skipped_rows": int(follower.rows_skipped),
         }
         ckpt.write_checkpoint(spec.ckpt_dir, state)
         ckpt.prune_checkpoints(spec.ckpt_dir, spec.keep_last)
@@ -197,14 +210,48 @@ def run_resident_trainer(spec: TrainerSpec,
         k = spec.iters_per_cycle
         if spec.target_iterations:
             k = min(k, spec.target_iterations - iteration)
-        X, y = _split_window(window, spec.label_col)
-        ds = lgb.Dataset(X, label=y)
-        init = lgb.Booster(model_str=model_str) \
-            if model_str is not None else None
-        booster = lgb.train(dict(spec.params), ds, num_boost_round=k,
-                            init_model=init)
+        try:
+            faults.maybe_fail("oom")       # the re-bin oom site
+            X, y = _split_window(window, spec.label_col)
+            ds = lgb.Dataset(X, label=y)
+            init = lgb.Booster(model_str=model_str) \
+                if model_str is not None else None
+            booster = lgb.train(dict(spec.params), ds,
+                                num_boost_round=k, init_model=init)
+        except BaseException as e:  # noqa: BLE001 — classifier decides
+            # window auto-shrink (ISSUE 17): an OOM'd re-bin/train
+            # cycle halves the rolling window down to the floor and
+            # keeps publishing — freshness regression, never a crash
+            # loop. At the floor a genuine exhaustion is re-raised.
+            if not is_oom_error(e) or win_rows <= win_floor:
+                raise
+            win_rows = max(win_rows // 2, win_floor)
+            ok_cycles = 0
+            if len(window) > win_rows:
+                window = window[-win_rows:]
+            if not shrink_warned:
+                shrink_warned = True
+                log.warning(
+                    f"resident trainer cycle OOM'd ({e!r}); rolling "
+                    f"window halved to {win_rows} rows (floor "
+                    f"{win_floor}) — training continues on less "
+                    "history; the window grows back when pressure "
+                    "clears (warned once)")
+            else:
+                log.info(f"trainer cycle OOM'd again; window now "
+                         f"{win_rows} rows")
+            continue
         iteration = booster.current_iteration()
         model_str = booster.model_to_string()
+        if win_rows < spec.window_rows:
+            # pressure-clear recovery: grow the window back after a
+            # few consecutive clean cycles
+            ok_cycles += 1
+            if ok_cycles >= 4:
+                ok_cycles = 0
+                win_rows = min(win_rows * 2, int(spec.window_rows))
+                log.info(f"memory pressure cleared: rolling window "
+                         f"grown back to {win_rows} rows")
         if iteration - last_commit >= spec.publish_every_iters or \
                 (spec.target_iterations and
                  iteration >= spec.target_iterations):

@@ -35,6 +35,15 @@ dispatch itself failing, each with a typed error and a counter
   still-pending future is FAILED rather than abandoned — no client
   blocks forever on a server that already gave up.
 
+Memory-pressure contract (ISSUE 17): the dispatch callable handed to
+the batcher may serve a coalesced batch PIECEWISE — on an OOM-classified
+failure the server bisects along the same pow2/octave bucket family and
+may host-walk the rows that still fail at the floor. The batcher is
+agnostic to that: whatever the callable does internally, it must return
+row-aligned values for the WHOLE coalesced batch (ungrouped) or a
+per-request outcome per item (grouped), so per-request slicing below
+stays correct under partial device failure.
+
 Threading model: client threads only enqueue numpy arrays and wait on an
 event; ONE dispatcher thread does all jax work (binning, traversal,
 materialization). That keeps the device program stream serial — no lock

@@ -43,6 +43,14 @@ hosts a model FLEET on one shared device arena:
   shard the MODEL axis — each bucket's pack lives on one owner device
   and its batches are routed there. ``tpu_serving_fleet_shard``
   selects (auto = by total pack bytes vs the per-device budget).
+- **HBM budget + cold-tenant eviction** (ISSUE 17): a byte ledger of
+  RESIDENT packs against ``tpu_serving_mem_budget_mb``; over budget,
+  cold buckets are LRU-evicted (device pack dropped, host mega-pack
+  retained) and lazily rebuilt on next touch — one upload, no trace,
+  bit-exact, generations preserved. A publish that OOMs force-evicts
+  the coldest pack instead of failing; OOM-classified dispatch
+  failures bisect the request group down to a per-request host-walk
+  floor, never whole-fleet degradation.
 
 Entry points: ``lightgbm_tpu.serve_fleet({name: booster, ...})`` and
 ``Booster.serve(fleet=server, tenant=name)``.
@@ -65,7 +73,7 @@ from ..ops import forest
 from ..ops.forest import TenantShape
 from ..robustness import faults
 from ..robustness.retry import (RetryError, RetryPolicy, SERVING_POLICY,
-                                retry_call)
+                                is_oom_error, retry_call)
 from ..utils import log
 
 
@@ -92,14 +100,18 @@ class TenantRoute(NamedTuple):
 class _Bucket(NamedTuple):
     """One shape bucket's device state: the stacked mega-pack, capacity
     bookkeeping and the model-shard owner (None = replicated /
-    row-sharded). Rebuilds re-assemble from the per-tenant window
-    caches, so no host copy is retained here."""
+    row-sharded). ``dev is None`` marks an EVICTED bucket (ISSUE 17):
+    the device pack was dropped to fit the HBM budget, but ``host`` —
+    the exact numpy mega-pack the routes were built against — is
+    retained, so the lazy rebuild is one upload, no trace, bit-exact,
+    generations preserved."""
     key: TenantShape
-    dev: object               # device pytree [slot_cap * win_slots, ...]
+    dev: object               # device pytree, or None when evicted
     members: Tuple[str, ...]  # tenant names, slot order
     slot_cap: int
     nbytes: int
     device: object            # owner device or None
+    host: object              # numpy pytree — the rebuild source
 
 
 class _FleetState(NamedTuple):
@@ -184,7 +196,10 @@ class FleetServer:
     ``bucket``) and default from ``config`` (any Booster Config) when
     given; ``fleet_shard`` / ``pack_budget_mb`` select the placement
     mode (``tpu_serving_fleet_shard`` /
-    ``tpu_serving_fleet_pack_budget_mb``). Per-tenant knobs
+    ``tpu_serving_fleet_pack_budget_mb``); ``mem_budget_mb``
+    (``tpu_serving_mem_budget_mb``, 0 = unbounded) bounds the RESIDENT
+    pack bytes — over it cold buckets are LRU-evicted and lazily
+    rebuilt bit-exactly on next touch (ISSUE 17). Per-tenant knobs
     (``deadline_ms``, ``quota_rows``, ``raw_score``) ride
     ``add_tenant``.
 
@@ -208,6 +223,7 @@ class FleetServer:
                  bucket: Optional[bool] = None,
                  fleet_shard: Optional[str] = None,
                  pack_budget_mb: Optional[float] = None,
+                 mem_budget_mb: Optional[float] = None,
                  config=None):
         def knob(value, name, fallback):
             if value is not None:
@@ -231,6 +247,17 @@ class FleetServer:
         self._shard_mode = shard
         self._pack_budget = float(knob(
             pack_budget_mb, "tpu_serving_fleet_pack_budget_mb", 256.0)) * 1e6
+        # HBM budget for RESIDENT packs (ISSUE 17): 0 = unbounded. Over
+        # it, cold buckets are LRU-evicted (device pack dropped, host
+        # pack retained) and lazily rebuilt on next touch.
+        self._mem_budget = float(knob(
+            mem_budget_mb, "tpu_serving_mem_budget_mb", 0.0)) * 1e6
+        # last-touch sequence per bucket key: written by the ONE
+        # dispatcher thread only (GIL-atomic dict store), read under
+        # the publish lock by the eviction pass — an approximate LRU
+        # signal, not a synchronization point
+        self._touch: Dict[TenantShape, int] = {}
+        self._touch_seq = 0
         self._retry_policy = (
             retry_policy if retry_policy is not None else SERVING_POLICY
         ).from_env_overrides(os.environ)
@@ -387,12 +414,27 @@ class FleetServer:
             for key in affected:
                 members = tuple(sorted(
                     n for n, r in routes.items() if r.key == key))
-                if members:
+                if not members:
+                    buckets.pop(key, None)
+                    continue
+                try:
                     buckets[key] = self._build_bucket(
                         key, members, self._state.shard, routes)
-                else:
-                    buckets.pop(key, None)
-            self._swap_state(buckets, routes)
+                except BaseException as e:  # noqa: BLE001 — classify
+                    # publish-forced eviction (ISSUE 17): an upload
+                    # that OOMs evicts the coldest resident pack and
+                    # retries once — a new generation displaces cold
+                    # tenants instead of failing
+                    if not is_oom_error(e) or not self._evict_coldest(
+                            buckets, exclude={key}):
+                        raise
+                    log.warning(
+                        f"fleet publish upload OOM for tenant "
+                        f"{t.name!r} ({e!r}); retrying after evicting "
+                        "the coldest resident pack")
+                    buckets[key] = self._build_bucket(
+                        key, members, self._state.shard, routes)
+            self._swap_state(buckets, routes, keep=affected)
         except BaseException as e:  # noqa: BLE001 — rollback + re-raise
             self.counters.inc("publish_failures", tenant=t.name)
             served = self._state.routes.get(t.name)
@@ -429,7 +471,7 @@ class FleetServer:
             wins = wins + [zero] * (slot_cap - len(members))
         host = _np_map(lambda *xs: np.concatenate(xs), *wins)
         nbytes = forest.pytree_nbytes(host)
-        dev = _np_map(jnp.asarray, host)
+        dev = forest.upload_window(host)   # the pack-upload oom site
         device = None
         if shard == "model":
             device = owner if owner is not None \
@@ -439,7 +481,7 @@ class FleetServer:
             dev = mesh_mod.replicate(dev, self.mesh)
         for slot, m in enumerate(members):
             routes[m] = routes[m]._replace(lo=slot * key.win_slots)
-        return _Bucket(key, dev, members, slot_cap, nbytes, device)
+        return _Bucket(key, dev, members, slot_cap, nbytes, device, host)
 
     def _owner_for(self, key: TenantShape, nbytes: int):
         """Model-shard owner of one bucket: keep the current owner when
@@ -455,10 +497,12 @@ class FleetServer:
                 load[b.device] += b.nbytes
         return min(devs, key=lambda d: (load[d], devs.index(d)))
 
-    def _swap_state(self, buckets, routes) -> None:
+    def _swap_state(self, buckets, routes, keep=()) -> None:
         """Resolve the placement mode for the new total pack size,
-        re-place buckets whose mode changed, and atomically publish the
-        new fleet state."""
+        re-place buckets whose mode changed, enforce the HBM budget
+        (``keep`` names buckets that must stay resident — the ones
+        this very publish built), and atomically publish the new fleet
+        state."""
         total = sum(b.nbytes for b in buckets.values())
         shard = self._resolve_shard(total)
         if shard != self._state.shard and buckets:
@@ -480,7 +524,103 @@ class FleetServer:
                 rebuilt[key] = self._build_bucket(
                     key, b.members, shard, routes, owner=owners.get(key))
             buckets = rebuilt
+        buckets = self._enforce_budget(buckets, keep=keep)
         self._state = _FleetState(buckets, routes, shard)  # GIL-atomic
+
+    def _enforce_budget(self, buckets, keep=(), incoming: int = 0):
+        """LRU-evict cold resident packs until resident bytes (plus
+        ``incoming`` about to be uploaded) fit the HBM budget (0 =
+        unbounded). Mutates and returns ``buckets``. Eviction drops
+        ONLY the device reference — the host pack stays for the lazy
+        rebuild, and in-flight dispatches finish on the old state's
+        reference, so eviction never strands a batch. Caller holds the
+        publish lock."""
+        if self._mem_budget <= 0:
+            return buckets
+        resident = sum(b.nbytes for b in buckets.values()
+                       if b.dev is not None)
+        if resident + incoming <= self._mem_budget:
+            return buckets
+        order = sorted(
+            (k for k, b in buckets.items()
+             if b.dev is not None and k not in keep),
+            key=lambda k: self._touch.get(k, -1))
+        for k in order:
+            if resident + incoming <= self._mem_budget:
+                break
+            b = buckets[k]
+            resident -= b.nbytes
+            buckets[k] = b._replace(dev=None)
+            self.counters.inc("evictions")
+            log.info(f"fleet pack evicted (LRU, {b.nbytes / 1e6:.2f} MB,"
+                     f" members {b.members}): resident bytes over the "
+                     f"{self._mem_budget / 1e6:.1f} MB budget")
+        return buckets
+
+    def _evict_coldest(self, buckets, exclude=()) -> bool:
+        """Force-evict the single coldest resident pack in ``buckets``
+        (the OOM'd-upload recovery step); False when nothing is left to
+        evict. Caller holds the publish lock."""
+        order = sorted(
+            (k for k, b in buckets.items()
+             if b.dev is not None and k not in exclude),
+            key=lambda k: self._touch.get(k, -1))
+        if not order:
+            return False
+        k = order[0]
+        buckets[k] = buckets[k]._replace(dev=None)
+        self.counters.inc("evictions")
+        log.warning(f"fleet pack force-evicted (coldest, "
+                    f"{buckets[k].nbytes / 1e6:.2f} MB): freeing device "
+                    "memory for an upload that OOM'd")
+        return True
+
+    def _upload_pack(self, b: _Bucket):
+        """Upload one bucket's retained host pack (forest.upload_window
+        — the oom consult point) and place it per the bucket's mode."""
+        dev = forest.upload_window(b.host)
+        if b.device is not None:
+            return mesh_mod.place_on(dev, b.device)
+        return mesh_mod.replicate(dev, self.mesh)
+
+    def _ensure_resident(self, state: _FleetState,
+                         key: TenantShape) -> _Bucket:
+        """Lazily rebuild an evicted bucket's device pack (ISSUE 17):
+        ONE upload of the retained host mega-pack — no trace, bit-exact
+        and generation-preserving, because ``host`` is the exact bytes
+        the routes in ``state`` were built against. The resident bucket
+        is installed back into the live state only when the live state
+        still serves this exact bucket object (a raced publish means
+        the upload serves just this dispatch and is then dropped). An
+        upload that itself OOMs force-evicts the coldest other resident
+        pack and retries once."""
+        b = state.buckets[key]
+        if b.dev is not None:
+            return b
+        with self._publish_lock:
+            cur = self._state
+            live = cur.buckets.get(key) is b
+            buckets = dict(cur.buckets) if live else {}
+            if live:
+                # pre-evict so the rebuild fits the ledger
+                buckets = self._enforce_budget(
+                    buckets, keep={key}, incoming=b.nbytes)
+            try:
+                dev = self._upload_pack(b)
+            except BaseException as e:  # noqa: BLE001 — classify
+                if not is_oom_error(e) or not self._evict_coldest(
+                        buckets, exclude={key}):
+                    raise
+                dev = self._upload_pack(b)
+            nb = b._replace(dev=dev)
+            self.counters.inc("rebuilds")
+            log.info(f"fleet pack rebuilt after eviction "
+                     f"({b.nbytes / 1e6:.2f} MB, members {b.members})")
+            if live:
+                buckets[key] = nb
+                self._state = _FleetState(buckets, cur.routes,
+                                          cur.shard)  # GIL-atomic
+            return nb
 
     def _resolve_shard(self, total_bytes: int) -> str:
         n_dev = len(mesh_mod.mesh_devices(self.mesh))
@@ -551,16 +691,16 @@ class FleetServer:
                     f"tenant {r.tenant!r} was removed before dispatch")
             else:
                 groups.setdefault(route.key, []).append((i, r, route))
+        for key in groups:
+            # LRU signal for the eviction pass (dispatcher thread only)
+            self._touch_seq += 1
+            self._touch[key] = self._touch_seq
         for key, items in groups.items():
             degraded = self._degrade.degraded
             raw = None
             if not degraded:
                 try:
-                    raw = retry_call(
-                        self._bucket_scores, state, key, items,
-                        policy=self._retry_policy, what="fleet dispatch",
-                        on_retry=lambda _a, _e:
-                            self.counters.inc("dispatch_retries"))
+                    raw = self._adaptive_group_scores(state, key, items)
                 except RetryError as e:
                     self.counters.inc("dispatch_failures")
                     self._degrade.enter(
@@ -590,14 +730,61 @@ class FleetServer:
                 off += r.n
         return outcomes
 
+    def _adaptive_group_scores(self, state: _FleetState,
+                               key: TenantShape, items) -> np.ndarray:
+        """Bucket-group scoring with the OOM bisection ladder (ISSUE
+        17), the fleet analogue of ``ModelServer._adaptive_scores``.
+        Transient failures retry under the serving policy (RetryError
+        propagates — the caller keeps today's whole-fleet degrade). An
+        OOM-classified failure is answered by splitting the group's
+        REQUESTS in half and retrying each half — sub-groups land back
+        in the same pow2/octave row-bucket family, zero new steady-
+        state traces. A single request that still OOMs is host-walked
+        alone: per-request degrade, its coalesced peers stay on the
+        device."""
+        try:
+            return retry_call(
+                self._bucket_scores, state, key, items,
+                policy=self._retry_policy, what="fleet dispatch",
+                on_retry=lambda _a, _e:
+                    self.counters.inc("dispatch_retries"))
+        except RetryError:
+            raise
+        except BaseException as e:  # noqa: BLE001 — classifier decides
+            if not is_oom_error(e):
+                raise
+            if len(items) > 1:
+                self.counters.inc("oom_bisects")
+                mid = len(items) // 2
+                log.warning(
+                    f"fleet dispatch OOM over {len(items)} requests "
+                    f"({e!r}); bisecting into {mid}+{len(items) - mid}")
+                return np.concatenate(
+                    [self._adaptive_group_scores(state, key, items[:mid]),
+                     self._adaptive_group_scores(state, key, items[mid:])],
+                    axis=0)
+            _i, r, route = items[0]
+            if not getattr(self, "_oom_floor_warned", False):
+                self._oom_floor_warned = True
+                log.warning(
+                    f"fleet dispatch OOM at the single-request floor "
+                    f"({e!r}); host-walking ONLY tenant "
+                    f"{route.name!r}'s rows — coalesced peers stay on "
+                    "the device (warned once per fleet)")
+            return self._host_scores(route, r.X)
+
     def _bucket_scores(self, state: _FleetState, key: TenantShape,
                        items) -> np.ndarray:
         """One device attempt at a bucket group: [R_total, k] f64 raw
         scores, rows in item order. Fault sites sit BEFORE the real
-        dispatch; every retry re-consults."""
+        dispatch; every retry re-consults. An EVICTED bucket is lazily
+        made resident first (``_ensure_resident``)."""
         faults.maybe_delay("slow_dispatch")
         faults.maybe_fail("dispatch_error")
+        faults.maybe_fail("oom")
         bucket = state.buckets[key]
+        if bucket.dev is None:
+            bucket = self._ensure_resident(state, key)
         total = sum(r.n for _i, r, _route in items)
         rows = forest.bucket_rows(total) if self.bucket else total
         lo = np.zeros(rows, np.int32)
@@ -671,6 +858,11 @@ class FleetServer:
         s["n_buckets"] = len(state.buckets)
         s["fleet_shard"] = state.shard
         s["pack_bytes"] = sum(b.nbytes for b in state.buckets.values())
+        s["resident_pack_bytes"] = sum(
+            b.nbytes for b in state.buckets.values() if b.dev is not None)
+        s["evicted_buckets"] = sum(
+            1 for b in state.buckets.values() if b.dev is None)
+        s["mem_budget_mb"] = self._mem_budget / 1e6
         s["mesh_devices"] = (self.mesh.shape[mesh_mod.SERVE_AXIS]
                              if self.mesh is not None else 1)
         s["linger_ms"] = self._batcher.linger_sec * 1e3

@@ -77,6 +77,17 @@ class ServingCounters:
     - ``shutdown_failed``: futures failed with SHUTDOWN because
       ``close(timeout=)`` expired before the drain finished.
 
+    Memory-pressure survival (ISSUE 17) adds:
+
+    - ``oom_bisects``: OOM-classified dispatch failures answered by
+      splitting the coalesced batch in half and retrying each half
+      (one increment per split event, not per half).
+    - ``evictions``: resident bucket packs dropped from the device to
+      fit the ``tpu_serving_mem_budget_mb`` ledger (host windows
+      retained).
+    - ``rebuilds``: evicted packs lazily re-uploaded on next touch
+      (bit-exact, one upload, no trace).
+
     Unknown names raise (a typo'd counter must fail loudly, not create
     a silent parallel ledger).
 
@@ -90,7 +101,8 @@ class ServingCounters:
 
     NAMES = ("expired", "shed", "dispatch_retries", "dispatch_failures",
              "degrade_events", "recoveries", "degraded_batches",
-             "publish_failures", "shutdown_failed")
+             "publish_failures", "shutdown_failed", "oom_bisects",
+             "evictions", "rebuilds")
     # the per-tenant ledger: request/row volume plus every failure-path
     # event that is attributable to ONE tenant (retry/degrade/recovery
     # events are fleet-wide device state, deliberately not per-tenant)

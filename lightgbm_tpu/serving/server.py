@@ -59,7 +59,7 @@ from .metrics import ServingCounters
 from ..ops import forest
 from ..robustness import faults
 from ..robustness.retry import (RetryError, RetryPolicy, SERVING_POLICY,
-                                retry_call)
+                                is_oom_error, retry_call)
 from ..utils import log
 
 
@@ -324,6 +324,7 @@ class ModelServer:
         the device never saw this attempt); every retry re-consults."""
         faults.maybe_delay("slow_dispatch")
         faults.maybe_fail("dispatch_error")
+        faults.maybe_fail("oom")
         place = None
         if self.mesh is not None:
             place = lambda a, ax: mesh_mod.shard_rows(a, ax, self.mesh)  # noqa: E731
@@ -332,6 +333,51 @@ class ModelServer:
 
     def _host_scores(self, models, X: np.ndarray) -> np.ndarray:
         return host_walk_scores(models, self.k, X)
+
+    def _adaptive_scores(self, snap, models, X: np.ndarray) -> np.ndarray:
+        """Device scoring with the OOM bisection ladder (ISSUE 17).
+
+        Transient failures retry under the serving policy as before. An
+        OOM-classified failure is NOT retried (the identical allocation
+        cannot succeed) — instead the batch is split in half and each
+        half retried: halves of a coalesced batch land back in the same
+        pow2/octave bucket family, so in steady state bisection costs
+        zero new traces. Rows that still OOM at the minimum bucket size
+        are served by the host walk — a per-request degrade for ONLY
+        the failing rows; the server never flips to whole-server
+        degradation for a size-induced OOM. Raises RetryError upward
+        (transient exhaustion keeps today's degrade path) and
+        non-transient non-OOM errors untouched."""
+        try:
+            return retry_call(
+                self._device_scores, snap, X,
+                policy=self._retry_policy, what="serving dispatch",
+                on_retry=lambda _a, _e:
+                    self.counters.inc("dispatch_retries"))
+        except RetryError:
+            raise
+        except BaseException as e:  # noqa: BLE001 — classifier decides
+            if not is_oom_error(e):
+                raise
+            n = int(X.shape[0])
+            if n > forest.ROW_BUCKET_MIN:
+                self.counters.inc("oom_bisects")
+                mid = n // 2
+                log.warning(
+                    f"serving dispatch OOM at {n} rows ({e!r}); "
+                    f"bisecting into {mid}+{n - mid} and retrying")
+                return np.concatenate(
+                    [self._adaptive_scores(snap, models, X[:mid]),
+                     self._adaptive_scores(snap, models, X[mid:])],
+                    axis=0)
+            if not getattr(self, "_oom_floor_warned", False):
+                self._oom_floor_warned = True
+                log.warning(
+                    f"serving dispatch OOM at the {n}-row bisection "
+                    f"floor ({e!r}); host-walking ONLY these rows — "
+                    "peers in the coalesced batch stay on the device "
+                    "(warned once per server)")
+            return self._host_scores(models, X)
 
     def _finish(self, raw: np.ndarray, info: Generation):
         """Output tail for both routes (module-level ``finish_scores``,
@@ -346,19 +392,16 @@ class ModelServer:
         """Score ONE coalesced batch against exactly one snapshot.
         Runs on the dispatcher thread only. Transient device failures
         retry under the serving policy; budget exhaustion degrades to
-        the host walk and STILL answers this batch — non-transient
-        errors propagate and fail the batch (a code bug must never be
-        absorbed as a flaky device)."""
+        the host walk and STILL answers this batch; OOM-classified
+        failures bisect the batch instead (``_adaptive_scores``) —
+        non-transient non-OOM errors propagate and fail the batch (a
+        code bug must never be absorbed as a flaky device)."""
         snap, info, models = self._active  # single read: atomic pairing
         if self._degrade.degraded:
             self.counters.inc("degraded_batches")
             return self._finish(self._host_scores(models, X), info)
         try:
-            raw = retry_call(
-                self._device_scores, snap, X,
-                policy=self._retry_policy, what="serving dispatch",
-                on_retry=lambda _a, _e:
-                    self.counters.inc("dispatch_retries"))
+            raw = self._adaptive_scores(snap, models, X)
         except RetryError as e:
             self.counters.inc("dispatch_failures")
             self._degrade.enter(

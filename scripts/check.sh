@@ -210,6 +210,21 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
+echo "== oom smoke (memory-pressure survival: bisect/evict/shrink, CPU) =="
+# ISSUE 17: an OOM-classified dispatch bisects the coalesced batch along
+# the warm pow2/octave buckets (bit-identical, 0 new traces, no retry
+# budget burned) and host-walks ONLY the rows that keep failing; a fleet
+# under an HBM budget LRU-evicts cold packs and lazily rebuilds them
+# bit-exactly; a publish whose pack upload OOMs force-evicts the coldest
+# pack instead of failing; the resident trainer halves its rolling
+# window on an OOM'd re-bin and grows it back when pressure clears.
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python scripts/oom_smoke.py || rc=1
+if [ $rc -ne 0 ]; then
+    echo "check.sh: oom smoke failed — skipping tier-1 pytest" >&2
+    exit $rc
+fi
+
 echo "== hybrid-path dispatch guards (compile budget + O(levels) shape) =="
 # the round-7 hot path: steady-state hybrid training must stay <=2
 # recompiles over 5 iterations and the level phase must issue

@@ -1,0 +1,189 @@
+"""Memory-pressure survival smoke gate (ISSUE 17), CPU-only, <30 s.
+
+Asserts, end to end:
+  1. OOM-classified dispatch bisection: an injected ``oom`` on a
+     600-row coalesced batch bisects along the pow2/octave bucket
+     family and the response stays BIT-IDENTICAL to predict_device,
+     with the server NOT degraded and ZERO retry-budget burned;
+  2. the bisection costs zero new steady-state traces: halves land in
+     already-warm row buckets (CompileCounter == 0);
+  3. the bisection floor degrades ONLY the failing rows: persistent
+     OOM host-walks the slice that keeps failing while the rest of the
+     SAME batch is served on the device;
+  4. fleet HBM budget: under a budget too small for every pack, cold
+     buckets are LRU-evicted and lazily rebuilt bit-exactly on next
+     touch (evictions >= 1, rebuilds >= 1, per-tenant parity);
+  5. publish-forced eviction: a pack upload that OOMs during publish
+     evicts the coldest resident pack and retries — the new generation
+     lands, publish_failures stays 0;
+  6. trainer window auto-shrink: an OOM'd re-bin cycle halves the
+     rolling window to the floor and the trainer KEEPS publishing;
+     once pressure clears the window grows back to the spec size.
+
+Wired into scripts/check.sh; exits non-zero on the first violated gate.
+"""
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+T_START = time.perf_counter()
+BUDGET_SEC = 30.0
+
+
+def check(cond, what):
+    took = time.perf_counter() - T_START
+    if not cond:
+        print(f"oom_smoke: FAIL {what} ({took:.1f}s)", file=sys.stderr)
+        sys.exit(1)
+    print(f"oom_smoke: ok {what} ({took:.1f}s)")
+
+
+def _make_booster(seed, leaves=15, trees=4, f=6, rows=700):
+    import lightgbm_tpu as lgb
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(rows, f)).astype(np.float32).astype(np.float64)
+    y = X[:, 0] + 0.3 * X[:, 1] ** 2
+    bst = lgb.train({"objective": "regression", "num_leaves": leaves,
+                     "verbose": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=trees,
+                    keep_training_booster=True)
+    return bst, X
+
+
+def main() -> int:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.analysis import guards
+    from lightgbm_tpu.robustness import faults
+
+    # ---- 1+2+3: solo-server bisection ladder -------------------------
+    bst, X = _make_booster(1)
+    ref_dev = bst.predict(X[:600], device=True, raw_score=True)
+    ref_host = bst.predict(X[:600], device=False, raw_score=True)
+    with bst.serve(linger_ms=1.0, raw_score=True) as srv:
+        # warm the 1024 (600 rows), 512 (300) and 256 (150) row buckets
+        for warm in (600, 300, 150):
+            srv.predict(X[:warm], timeout=120)
+        with guards.CompileCounter() as counter:
+            with faults.inject("oom:n=1"):
+                got = srv.predict(X[:600], timeout=120)
+        st = srv.stats()
+        check(np.array_equal(got, ref_dev),
+              "bisected batch bit-identical to predict_device")
+        check(st["oom_bisects"] >= 1 and not st["degraded"] and
+              st["dispatch_retries"] == 0,
+              f"oom_bisects={st['oom_bisects']}, not degraded, 0 retries "
+              "(OOM never burned the retry budget)")
+        check(counter.count == 0,
+              f"bisection compiled NOTHING ({counter.count} traces) — "
+              "halves land in warm row buckets")
+        # floor: oom on the full batch, its left half and left quarter
+        # -> rows 0:150 host-walked, everything else on the device
+        with faults.inject("oom:p=1:n=3"):
+            part = srv.predict(X[:600], timeout=120)
+        check(np.allclose(part[:150], ref_host[:150], rtol=1e-12,
+                          atol=1e-12) and
+              np.array_equal(part[150:], ref_dev[150:]) and
+              not srv.stats()["degraded"],
+              "bisection floor host-walked ONLY the failing 150 rows; "
+              "450 peers stayed on the device; server not degraded")
+
+    # ---- 4: fleet HBM budget, eviction -> lazy rebuild ---------------
+    tenants = {f"t{i}": _make_booster(10 + i, leaves=7 + 8 * i,
+                                      trees=3 + i) for i in range(3)}
+    with lgb.serve_fleet({k: b for k, (b, _x) in tenants.items()},
+                         raw_score=True, linger_ms=10.0,
+                         mem_budget_mb=1e-4) as fleet:
+        st = fleet.stats()
+        check(st["evicted_buckets"] >= 1,
+              f"budget {st['mem_budget_mb']:.4f} MB evicted "
+              f"{st['evicted_buckets']}/{st['n_buckets']} buckets at "
+              "startup")
+        for _round in range(2):
+            for name, (b, x) in tenants.items():
+                if not np.array_equal(
+                        fleet.predict(name, x[:64], timeout=120),
+                        b.predict(x[:64], device=True, raw_score=True)):
+                    check(False, f"eviction churn broke parity for {name}")
+        st = fleet.stats()
+        check(st["evictions"] >= 1 and st["rebuilds"] >= 1,
+              f"eviction churn under budget: evictions={st['evictions']} "
+              f"rebuilds={st['rebuilds']}, every response bit-exact")
+
+        # ---- 5: publish-forced eviction ------------------------------
+        b0, x0 = tenants["t0"]
+        b0.update()
+        with faults.inject("oom:n=1"):      # fails the publish upload
+            info = fleet.publish("t0")
+        check(info.version == 2 and
+              fleet.counters.get("publish_failures") == 0,
+              "publish upload OOM force-evicted the coldest pack and "
+              "landed generation 2 (publish_failures=0)")
+        check(np.array_equal(
+            fleet.predict("t0", x0[:48], timeout=120),
+            b0.predict(x0[:48], device=True, raw_score=True)),
+            "post-forced-eviction publish serves the NEW trees exactly")
+
+    # ---- 6: trainer window auto-shrink + recovery --------------------
+    from lightgbm_tpu.robustness.checkpoint import latest_valid_checkpoint
+    from lightgbm_tpu.service import TrainerSpec, run_resident_trainer
+    rng = np.random.default_rng(5)
+    Xs = rng.normal(size=(600, 6)).astype(np.float32)
+    ys = (Xs[:, 0] + 0.5 * Xs[:, 1] > 0).astype(np.float32)
+    with tempfile.TemporaryDirectory() as td:
+        stream = os.path.join(td, "s.csv")
+        with open(stream, "w") as fh:
+            fh.write("\n".join(
+                ",".join(repr(float(v)) for v in [y] + list(x))
+                for y, x in zip(ys, Xs)) + "\n")
+        params = {"objective": "binary", "num_leaves": 15,
+                  "verbose": -1, "seed": 7}
+        spec = TrainerSpec(params=params, stream_path=stream,
+                           ckpt_dir=os.path.join(td, "ck1"),
+                           window_rows=600, window_floor_rows=128,
+                           min_rows=256, iters_per_cycle=2,
+                           publish_every_iters=2, target_iterations=4,
+                           poll_sec=0.05)
+        with faults.inject("oom:p=1:n=2"):  # first TWO cycles OOM
+            rc = run_resident_trainer(spec)
+        _p, st1 = latest_valid_checkpoint(spec.ckpt_dir)
+        svc = st1["service"]
+        check(rc == 0 and st1["iteration"] == 4 and
+              svc["window_rows_target"] == 150,
+              "trainer OOM'd twice, window 600->300->150, still "
+              f"published to iteration {st1['iteration']}")
+        # fresh run: one OOM'd cycle (600 -> 300) then clear -> after 4
+        # clean cycles the window must have GROWN BACK to spec
+        # (deterministic because oom:n=1 always fires exactly once)
+        spec2 = TrainerSpec(params=params, stream_path=stream,
+                            ckpt_dir=os.path.join(td, "ck2"),
+                            window_rows=600, window_floor_rows=128,
+                            min_rows=256, iters_per_cycle=2,
+                            publish_every_iters=2, target_iterations=8,
+                            poll_sec=0.05)
+        with faults.inject("oom:n=1"):
+            rc = run_resident_trainer(spec2)
+        _p, st2 = latest_valid_checkpoint(spec2.ckpt_dir)
+        check(rc == 0 and st2["iteration"] == 8 and
+              st2["service"]["window_rows_target"] == 600,
+              "pressure cleared: window grew back to 600 by iteration "
+              f"{st2['iteration']}")
+        check(st2["service"]["skipped_rows"] == 0,
+              "clean stream: watermark counts 0 skipped rows")
+
+    took = time.perf_counter() - T_START
+    if took >= BUDGET_SEC:
+        print(f"oom_smoke: WARN wall {took:.1f}s >= {BUDGET_SEC:.0f}s "
+              "(cold compile cache?)", file=sys.stderr)
+    print(f"oom_smoke: PASS in {took:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,6 +62,21 @@ wire carried staleness on every response. Banks QPS + latency
 percentiles + measured model-staleness p50/p99 to
 ``bench_logs/SERVING_LIVE.json`` in the shared _bench_io grammar.
 
+Memory-chaos mode (``--mem-chaos``, ISSUE 17): a tenant fleet under an
+HBM budget sized BELOW its total pack bytes (forced eviction churn),
+open-loop Poisson traffic while 5% of allocations OOM
+(``oom:p=0.05`` — consulted at the dispatch, pack-upload and rebuild
+sites), then exactly one pack-upload OOM during a publish (the
+forced-eviction path). The gate FAILS (status no_result) unless: zero
+torn responses (every response bit-matches its tenant's own
+predict_device bits or its host-walk bits — the bisection floor may
+host-walk a single request), exact per-tenant requests/shed/expired
+accounting, oom_bisects/evictions/rebuilds all registered (>= 1, and
+surfaced through the same stats() the front door serves as /v1/stats),
+the fleet is NEVER whole-server degraded by a size-induced OOM, and
+the steady-state trace count stays flat (bisection halves land in
+warm row buckets). Banks ``bench_logs/SERVING_MEM.json``.
+
 Usage:
   python scripts/serving_load.py [--clients 8] [--rows 64]
       [--duration 10] [--mode closed|open] [--rate 200]
@@ -69,6 +84,7 @@ Usage:
       [--publish-every 0] [--skip-native] [--deadline-ms 0]
       [--max-queue-rows 0] [--chaos] [--chaos-p999-ms 10000]
       [--fleet N] [--fleet-rows 3000] [--live] [--live-crash-iter 6]
+      [--mem-chaos]
 
 --devices D > 1 on a CPU host re-execs with D virtual XLA devices;
 an already-set JAX_PLATFORMS (e.g. a TPU session) is honored.
@@ -90,6 +106,7 @@ OUT = os.path.join(REPO, "bench_logs", "SERVING_LOAD.json")
 OUT_CHAOS = os.path.join(REPO, "bench_logs", "SERVING_CHAOS.json")
 OUT_FLEET = os.path.join(REPO, "bench_logs", "SERVING_FLEET.json")
 OUT_LIVE = os.path.join(REPO, "bench_logs", "SERVING_LIVE.json")
+OUT_MEM = os.path.join(REPO, "bench_logs", "SERVING_MEM.json")
 
 
 def parse_args(argv=None):
@@ -142,17 +159,28 @@ def parse_args(argv=None):
                     help="inject the trainer crash after this many "
                          "boosting iterations of launch 1 (0 = no "
                          "crash)")
+    ap.add_argument("--mem-chaos", action="store_true",
+                    help="ISSUE 17 memory-pressure gate: fleet under an "
+                         "HBM budget below its pack bytes + oom:p=0.05 "
+                         "injection + one pack-upload OOM; banks "
+                         "SERVING_MEM.json")
+    ap.add_argument("--mem-budget-frac", type=float, default=0.6,
+                    help="mem-chaos: HBM budget as a fraction of the "
+                         "fleet's total pack bytes (must force "
+                         "eviction churn)")
     ap.add_argument("--out", default=None,
                     help="record path (default SERVING_LOAD.json; "
                          "SERVING_CHAOS.json under --chaos / "
                          "SERVING_FLEET.json under --fleet / "
-                         "SERVING_LIVE.json under --live so the "
+                         "SERVING_LIVE.json under --live / "
+                         "SERVING_MEM.json under --mem-chaos so the "
                          "banked throughput record is never clobbered)")
     args = ap.parse_args(argv)
     if args.out is None:
-        args.out = OUT_LIVE if args.live else \
-            (OUT_FLEET if args.fleet else
-             (OUT_CHAOS if args.chaos else OUT))
+        args.out = OUT_MEM if args.mem_chaos else \
+            (OUT_LIVE if args.live else
+             (OUT_FLEET if args.fleet else
+              (OUT_CHAOS if args.chaos else OUT)))
     return args
 
 
@@ -685,6 +713,273 @@ def fleet_route(args, record):
     return ("measured" if not stats["degraded"] else "degraded"), None
 
 
+def mem_chaos_route(args, record):
+    """ISSUE 17 memory-pressure survival gate. Returns (status, note).
+
+    Topology: N mixed-shape tenants on one FleetServer whose HBM budget
+    is sized BELOW the fleet's total pack bytes (measured first on an
+    unbounded probe fleet), so serving rotates packs through eviction /
+    lazy rebuild continuously. Load: open-loop Poisson traffic from
+    ``--clients`` threads with mixed request sizes while ``oom:p=0.05``
+    fires at the dispatch, pack-upload and rebuild consult points; then
+    one publish whose pack upload OOMs deterministically (``oom:n=1`` —
+    the forced-eviction path). Verified: 0 torn (every response
+    bit-matches its tenant's banked predict_device bits or host-walk
+    bits), exact per-tenant requests/shed/expired accounting,
+    oom_bisects/evictions/rebuilds all >= 1 in the same counters stats()
+    surfaces as /v1/stats, never whole-fleet degraded, trace count flat
+    over the measured window."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.analysis import guards
+    from lightgbm_tpu.robustness import faults
+    from lightgbm_tpu.serving import DeadlineExceeded, Overloaded
+    from lightgbm_tpu.serving.metrics import latency_summary_ms
+
+    n_tenants = args.fleet or 6
+    rng = np.random.default_rng(0)
+    archetypes = [(31, 20, 28), (15, 12, 12), (63, 16, 20), (15, 24, 12)]
+    pools = {f: np.ascontiguousarray(
+        rng.normal(size=(max(args.fleet_rows, 2048), f))
+        .astype(np.float32).astype(np.float64))
+        for f in {a[2] for a in archetypes}}
+    t0 = time.perf_counter()
+    tenants = {}
+    for i in range(n_tenants):
+        leaves, trees, f = archetypes[i % len(archetypes)]
+        X = pools[f][:args.fleet_rows]
+        y = (X[:, 0] * (1 + 0.1 * (i % 7)) +
+             0.5 * X[:, 1] ** 2 > 0.4).astype(np.float32)
+        bst = lgb.train({"objective": "binary", "num_leaves": leaves,
+                         "verbosity": -1}, lgb.Dataset(X, label=y),
+                        num_boost_round=trees,
+                        keep_training_booster=True)
+        tenants[f"t{i:03d}"] = (bst, f)
+    print(f"[load] trained {n_tenants} tenants over "
+          f"{len(archetypes)} archetypes "
+          f"({time.perf_counter() - t0:.1f}s)", flush=True)
+    keys = list(tenants)
+
+    # size the budget BELOW the real pack bytes: probe unbounded first
+    with lgb.serve_fleet({k: b for k, (b, _f) in tenants.items()},
+                         raw_score=True, linger_ms=args.linger_ms,
+                         num_devices=args.devices) as probe_fleet:
+        pack_bytes = probe_fleet.stats()["pack_bytes"]
+    budget_mb = pack_bytes * args.mem_budget_frac / 1e6
+    fleet = lgb.serve_fleet({k: b for k, (b, _f) in tenants.items()},
+                            raw_score=True, linger_ms=args.linger_ms,
+                            max_batch=args.max_batch,
+                            num_devices=args.devices,
+                            probe_interval_s=1.0,
+                            mem_budget_mb=budget_mb)
+    st = fleet.stats()
+    record["tenants"] = n_tenants
+    record["buckets"] = st["n_buckets"]
+    record["pack_bytes"] = pack_bytes
+    record["mem_budget_mb"] = round(budget_mb, 4)
+    record["evicted_at_start"] = st["evicted_buckets"]
+
+    # every request is a prefix slice of its tenant's pool at one of
+    # these sizes, so every (tenant, size, generation) response can be
+    # banked bit-for-bit against BOTH routes ahead of time
+    sizes = sorted({max(args.rows // 2, 1), args.rows, args.rows * 2})
+    expected = {}
+
+    def bank(k):
+        v = fleet._state.routes[k].generation.version
+        b = tenants[k][0]
+        for n in sizes:
+            X = pools[tenants[k][1]][:n]
+            expected[(k, n, v)] = (
+                b.predict(X, device=True, raw_score=True),
+                b.predict(X, raw_score=True))
+
+    for k in keys:
+        bank(k)
+
+    # warm every (shape bucket, row bucket) the traffic and its
+    # bisection halves can touch, then warm the coalesced totals
+    for k in keys:
+        for warm in (200, 500):
+            fleet.predict(k, pools[tenants[k][1]][:warm], timeout=300)
+    r0 = random.Random(5)
+    warm_until = time.perf_counter() + min(2.0, args.duration / 4)
+    while time.perf_counter() < warm_until:
+        k = keys[r0.randrange(len(keys))]
+        n = sizes[r0.randrange(len(sizes))]
+        fleet.predict(k, pools[tenants[k][1]][:n], timeout=300)
+
+    base = fleet.counters.tenant_snapshot()
+    base_ev = {c: fleet.counters.get(c)
+               for c in ("oom_bisects", "evictions", "rebuilds")}
+    observed = {k: {"requests": 0, "shed": 0, "expired": 0}
+                for k in keys}
+    results, hard, lats = [], [], []
+    lock = threading.Lock()
+
+    def client(ci):
+        r = random.Random(100 + ci)
+        futs = []
+        t0 = time.perf_counter()
+        next_t = t0
+        rate = max(args.rate / max(args.clients, 1), 1e-6)
+        while True:
+            next_t += r.expovariate(rate)
+            if next_t - t0 > args.duration:
+                break
+            now = time.perf_counter()
+            if next_t > now:
+                time.sleep(next_t - now)
+            k = keys[r.randrange(len(keys))]
+            n = sizes[r.randrange(len(sizes))]
+            try:
+                futs.append((k, n, next_t,
+                             fleet.submit(k, pools[tenants[k][1]][:n],
+                                          deadline_ms=8000.0)))
+            except Overloaded:
+                with lock:
+                    observed[k]["shed"] += 1
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    hard.append(repr(e))
+        for k, n, intended, fut in futs:
+            try:
+                out = fut.result(120)
+                with lock:
+                    observed[k]["requests"] += 1
+                    results.append((k, n, fut.generation.version, out))
+                    lats.append(max(fut.t_done - intended, 0.0))
+            except DeadlineExceeded:
+                with lock:
+                    observed[k]["expired"] += 1
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    hard.append(repr(e))
+
+    # measured window: Poisson load under oom:p=0.05 (the dispatch,
+    # pack-upload and rebuild consult points all draw from this plan)
+    # with the steady-state trace budget measured over the same window
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.clients)]
+    t0 = time.perf_counter()
+    with guards.CompileCounter() as counter:
+        with faults.inject("oom:p=0.05:seed=9:n=1000000"):
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(args.duration + 120)
+    wall = time.perf_counter() - t0
+    # snapshot the ledger NOW: the publish leg's own parity predicts
+    # below are server-side traffic, not part of the measured window
+    ledger = fleet.counters.tenant_snapshot()
+    record["steady_state_new_traces"] = counter.count
+    if counter.count:
+        record["trace_names"] = counter.names[:8]
+    rec = {"qps": round(len(results) / wall, 1),
+           "requests": len(results), "wall_sec": round(wall, 2),
+           "errors": len(hard)}
+    rec.update(latency_summary_ms(lats))
+    record["open_loop"] = rec
+    record["value"] = rec["qps"]
+    print(f"[load] mem chaos {rec['qps']:.0f} req/s, "
+          f"p50={rec.get('p50_ms')}ms p999={rec.get('p999_ms')}ms, "
+          f"{counter.count} new traces", flush=True)
+
+    # the deterministic pack-upload OOM: one publish whose upload dies
+    # -> the coldest resident pack is force-evicted, the generation
+    # still lands (bank the new bits BEFORE they can serve)
+    pub_key = keys[0]
+    pub_b = tenants[pub_key][0]
+    pub_b.update()
+    pub_b.num_trees()                    # flush outside the server
+    v = fleet._state.routes[pub_key].generation.version
+    for n in sizes:
+        X = pools[tenants[pub_key][1]][:n]
+        expected[(pub_key, n, v + 1)] = (
+            pub_b.predict(X, device=True, raw_score=True),
+            pub_b.predict(X, raw_score=True))
+    with faults.inject("oom:n=1"):
+        pub_info = fleet.publish(pub_key)
+    post_pub = [fleet.predict(pub_key, pools[tenants[pub_key][1]][:n],
+                              timeout=120) for n in sizes]
+
+    torn = 0
+    for k, n, v, out in results:
+        exp = expected.get((k, n, v))
+        if exp is None or not (np.array_equal(out, exp[0]) or
+                               np.array_equal(out, exp[1])):
+            torn += 1
+    for n, out in zip(sizes, post_pub):
+        exp = expected[(pub_key, n, pub_info.version)]
+        if not (np.array_equal(out, exp[0]) or
+                np.array_equal(out, exp[1])):
+            torn += 1
+    stats = fleet.stats()
+    ev = {c: fleet.counters.get(c) - base_ev[c]
+          for c in ("oom_bisects", "evictions", "rebuilds")}
+    failures = []
+
+    def need(cond, what):
+        if not cond:
+            failures.append(what)
+
+    need(not hard, f"{len(hard)} hard client error(s): {hard[:1]}")
+    need(torn == 0, f"{torn} torn/wrong response(s)")
+    need(results, "no responses measured")
+    for k in keys:
+        led = {n: ledger[k][n] - base.get(k, {}).get(n, 0)
+               for n in ("requests", "shed", "expired")}
+        for n in ("requests", "shed", "expired"):
+            need(led[n] == observed[k][n],
+                 f"tenant {k} {n} accounting: server {led[n]} != "
+                 f"client {observed[k][n]}")
+    need(record["evicted_at_start"] >= 1 or ev["evictions"] >= 1,
+         "the budget never forced an eviction (not tight enough?)")
+    need(ev["oom_bisects"] >= 1,
+         "oom:p=0.05 never triggered a bisection")
+    need(ev["evictions"] >= 1 and ev["rebuilds"] >= 1,
+         f"eviction churn never registered ({ev})")
+    need(all(c in stats for c in
+             ("oom_bisects", "evictions", "rebuilds",
+              "resident_pack_bytes", "evicted_buckets")),
+         "stats() (the /v1/stats payload) is missing the ISSUE 17 "
+         "counters")
+    need(stats["degraded"] is False,
+         "a size-induced OOM degraded the WHOLE fleet (bisection "
+         "should scope the blast radius to the failing requests)")
+    need(pub_info.version == 2,
+         f"the pack-upload-OOM publish never landed ({pub_info})")
+    # a single pack larger than the whole budget must stay resident
+    # while it serves, so the ledger is bounded by max(budget, biggest)
+    biggest = max(b.nbytes for b in fleet._state.buckets.values())
+    need(stats["resident_pack_bytes"] <= max(budget_mb * 1e6, biggest) + 1,
+         f"resident bytes {stats['resident_pack_bytes']} over the "
+         f"{budget_mb:.3f} MB budget (biggest pack {biggest})")
+    need(counter.count <= 2,
+         f"steady-state traces not flat: {counter.count} new "
+         f"({record.get('trace_names')})")
+    record["mem_chaos"] = {
+        "responses": len(results), "torn": torn,
+        "oom_bisects": ev["oom_bisects"],
+        "evictions": ev["evictions"], "rebuilds": ev["rebuilds"],
+        "resident_pack_bytes": stats["resident_pack_bytes"],
+        "evicted_buckets": stats["evicted_buckets"],
+        "publish_version": pub_info.version,
+        "tenant_ledger_sample": {k: ledger[k] for k in keys[:3]}}
+    if failures:
+        record["mem_chaos"]["failures"] = failures
+        for f in failures:
+            print(f"[load] MEM CHAOS FAIL: {f}", file=sys.stderr,
+                  flush=True)
+    print(f"[load] mem chaos: {len(results)} responses, {torn} torn, "
+          f"bisects={ev['oom_bisects']} evictions={ev['evictions']} "
+          f"rebuilds={ev['rebuilds']}", flush=True)
+    fleet.close()
+    if failures:
+        return "no_result", "; ".join(failures)
+    return "measured", None
+
+
 def live_route(args, record):
     """ISSUE 14 freshness chaos gate. Returns (status, note).
 
@@ -950,6 +1245,15 @@ def main() -> int:
             record["mode"] = "open"
             record["rate"] = args.rate
             status, note = live_route(args, record)
+            return finish(status, note)
+
+        # ---- mem-chaos mode (ISSUE 17): OOM + eviction churn --------
+        if args.mem_chaos:
+            record["metric"] = "serving_mem_qps"
+            record["mode"] = "open"
+            record["rate"] = args.rate
+            record["mem_budget_frac"] = args.mem_budget_frac
+            status, note = mem_chaos_route(args, record)
             return finish(status, note)
 
         # ---- fleet mode (ISSUE 13): N tenants, one server -----------

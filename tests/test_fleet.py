@@ -661,3 +661,138 @@ def test_second_serve_returns_live_server_no_second_dispatcher():
             b.predict(x[:16], device=True, raw_score=True))
     finally:
         srv2.close()
+
+
+# ---------------------------------------------------------------------------
+# HBM budget + cold-tenant eviction (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def test_fleet_evicted_then_rebuilt_bucket_bit_identical():
+    """Under a budget too small for every pack, cold buckets are
+    LRU-evicted (device pack dropped, host pack kept) and lazily
+    rebuilt on next touch — every tenant's response stays bit-identical
+    to its own direct device predict, generations preserved."""
+    tenants = {f"t{i}": _make_booster(60 + i, leaves=7 + 8 * i,
+                                      trees=3 + i) for i in range(3)}
+    with serve_fleet({k: b for k, (b, _x) in tenants.items()},
+                     raw_score=True, linger_ms=10.0,
+                     mem_budget_mb=1e-4) as fleet:
+        st = fleet.stats()
+        assert st["n_buckets"] == 3
+        assert st["evicted_buckets"] >= 1, st
+        assert st["resident_pack_bytes"] <= st["pack_bytes"]
+        gens = {}
+        for name, (b, x) in tenants.items():
+            got = fleet.predict(name, x[:64], timeout=120)
+            assert np.array_equal(
+                got, b.predict(x[:64], device=True, raw_score=True)), name
+            gens[name] = fleet.tenant_stats(name)["generation"]
+        # touching every bucket under the budget churned: something was
+        # evicted AND rebuilt, and nothing re-published (gen still 1)
+        st = fleet.stats()
+        assert st["evictions"] >= 1 and st["rebuilds"] >= 1, st
+        assert all(g == 1 for g in gens.values()), gens
+        # second pass: rebuilds keep serving exact bits
+        for name, (b, x) in tenants.items():
+            assert np.array_equal(
+                fleet.predict(name, x[:64], timeout=120),
+                b.predict(x[:64], device=True, raw_score=True)), name
+
+
+def test_fleet_hot_swap_of_evicted_tenant_lands(trio):
+    """publish() of a tenant whose bucket is currently evicted builds
+    and serves the NEW generation correctly (the publish path uploads a
+    fresh pack; the stale evicted one is simply dropped)."""
+    tenants = {f"e{i}": _make_booster(70 + i, leaves=7 + 8 * i,
+                                      trees=3 + i) for i in range(3)}
+    with serve_fleet({k: b for k, (b, _x) in tenants.items()},
+                     raw_score=True, linger_ms=10.0,
+                     mem_budget_mb=1e-4) as fleet:
+        assert fleet.stats()["evicted_buckets"] >= 1
+        # find an evicted tenant
+        state = fleet._state
+        name = next(n for n, r in state.routes.items()
+                    if state.buckets[r.key].dev is None)
+        b, x = tenants[name]
+        b.update()
+        info = fleet.publish(name)
+        assert info.version == 2
+        got = fleet.predict(name, x[:48], timeout=120)
+        assert np.array_equal(
+            got, b.predict(x[:48], device=True, raw_score=True))
+        assert fleet.tenant_stats(name)["generation"] == 2
+
+
+def test_fleet_eviction_never_strands_inflight_batch(trio):
+    """A dispatch wedged on the device keeps the OLD state's pack
+    reference; a concurrent publish that evicts that bucket in the NEW
+    state cannot strand it — the wedged batch still answers exactly."""
+    tenants = {f"s{i}": _make_booster(85 + i, leaves=7 + 8 * i,
+                                      trees=3 + i) for i in range(2)}
+    (b0, x0), (b1, x1) = tenants["s0"], tenants["s1"]
+    with serve_fleet({k: b for k, (b, _x) in tenants.items()},
+                     raw_score=True, linger_ms=1.0,
+                     mem_budget_mb=1e-4) as fleet:
+        with faults.inject("slow_dispatch:sec=0.5:n=1"):
+            slow = fleet.submit("s0", x0[:48])     # wedges in dispatch
+            time.sleep(0.1)
+            # publish s1 while s0's batch is in flight: the budget pass
+            # may evict s0's bucket in the NEW state
+            b1.update()
+            fleet.publish("s1")
+            got = slow.result(120)
+        assert np.array_equal(
+            got, b0.predict(x0[:48], device=True, raw_score=True))
+        # and the possibly-evicted bucket still rebuilds exactly
+        assert np.array_equal(
+            fleet.predict("s0", x0[:48], timeout=120),
+            b0.predict(x0[:48], device=True, raw_score=True))
+
+
+def test_fleet_oom_floor_host_walks_one_request_peers_on_device(trio):
+    """oom:n=2 fails the 2-request group and its left 1-request half:
+    that request is host-walked ALONE; its coalesced peer retries clean
+    and stays on the device. No degrade, per-request blast radius."""
+    (b0, x0) = trio["t0"]
+    (b1, x1) = trio["t1"]
+    with serve_fleet({"t0": b0, "t1": b1}, raw_score=True,
+                     linger_ms=60.0) as fleet:
+        fleet.predict("t0", x0[:32], timeout=120)          # warm
+        with faults.inject("oom:p=1:n=2"):
+            f0 = fleet.submit("t0", x0[:32])
+            f1 = fleet.submit("t1", x1[:32])
+            r0 = f0.result(120)
+            r1 = f1.result(120)
+        st = fleet.stats()
+        assert st["oom_bisects"] == 1
+        assert not st["degraded"]
+    np.testing.assert_allclose(
+        r0, b0.predict(x0[:32], device=False, raw_score=True),
+        rtol=1e-12, atol=1e-12)
+    assert np.array_equal(
+        r1, b1.predict(x1[:32], device=True, raw_score=True))
+
+
+def test_fleet_publish_forced_eviction_instead_of_failing(trio):
+    """A pack upload that OOMs during publish evicts the coldest
+    resident pack and retries — the new generation lands instead of
+    the publish failing."""
+    tenants = {f"p{i}": _make_booster(95 + i, leaves=7 + 8 * i,
+                                      trees=3 + i) for i in range(2)}
+    (b0, x0), (b1, x1) = tenants["p0"], tenants["p1"]
+    with serve_fleet({k: b for k, (b, _x) in tenants.items()},
+                     raw_score=True, linger_ms=10.0) as fleet:
+        b0.update()
+        with faults.inject("oom:n=1"):     # fails the publish upload
+            info = fleet.publish("p0")
+        assert info.version == 2
+        st = fleet.stats()
+        assert st["evictions"] >= 1, st
+        assert fleet.counters.get("publish_failures") == 0
+        assert np.array_equal(
+            fleet.predict("p0", x0[:48], timeout=120),
+            b0.predict(x0[:48], device=True, raw_score=True))
+        # the force-evicted peer rebuilds on next touch, still exact
+        assert np.array_equal(
+            fleet.predict("p1", x1[:48], timeout=120),
+            b1.predict(x1[:48], device=True, raw_score=True))

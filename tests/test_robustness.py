@@ -219,6 +219,76 @@ def test_faults_docstring_lists_every_known_site():
 
 
 # ---------------------------------------------------------------------------
+# error classification (ISSUE 17): the RESOURCE_EXHAUSTED class
+# ---------------------------------------------------------------------------
+
+def test_error_classifier_table():
+    """classify_error files every exception into exactly one of the
+    documented classes; OOM is recognized by type AND by message, and
+    beats a transient-looking message (retrying the same allocation is
+    futile)."""
+    from lightgbm_tpu.robustness.retry import (ERROR_CLASSES,
+                                               classify_error,
+                                               is_oom_error)
+    cases = {
+        "TRANSIENT": [_Unavailable("UNAVAILABLE: socket closed"),
+                      RuntimeError("ABORTED: chip reset"),
+                      ConnectionResetError("peer")],
+        "DEADLINE": [TimeoutError("slot wait"),
+                     RuntimeError("DEADLINE_EXCEEDED: 5s")],
+        "RESOURCE_EXHAUSTED": [
+            MemoryError("malloc"),
+            RuntimeError("RESOURCE_EXHAUSTED: out of memory"),
+            RuntimeError("failed to allocate 2.5G hbm"),
+            # OOM text inside a transient-looking envelope: still OOM
+            RuntimeError("UNAVAILABLE: failed to allocate 1G"),
+        ],
+        "FATAL": [ValueError("a code bug"), KeyError("t0")],
+    }
+    for expected, excs in cases.items():
+        for e in excs:
+            assert classify_error(e) == expected, (e, classify_error(e))
+            assert is_oom_error(e) == (expected == "RESOURCE_EXHAUSTED")
+            # DEADLINE is retried like TRANSIENT (fresh sub-slot); OOM
+            # and FATAL are not
+            assert is_transient_error(e) == \
+                (expected in ("TRANSIENT", "DEADLINE"))
+    assert set(cases) == set(ERROR_CLASSES)
+
+
+def test_error_classes_documented():
+    """Every recognized class is documented in retry.py's classifier
+    table (the same drift contract the faults docstring carries)."""
+    from lightgbm_tpu.robustness import retry
+    for cls in retry.ERROR_CLASSES:
+        assert cls in retry.__doc__, \
+            f"error class {cls!r} missing from retry.py docstring"
+
+
+def test_oom_site_known_and_nontransient():
+    """The ``oom`` site speaks the grammar, raises the RESOURCE_EXHAUSTED
+    class and is NEVER retried: retry_call propagates it unwrapped on
+    the first attempt (adaptation is the caller's job)."""
+    from lightgbm_tpu.robustness.retry import is_oom_error
+    assert "oom" in faults.KNOWN_SITES
+    with faults.inject("oom"):
+        with pytest.raises(faults.OOMInjected) as ei:
+            faults.maybe_fail("oom")
+    assert is_oom_error(ei.value)
+    assert not is_transient_error(ei.value)
+    calls = []
+
+    def allocate():
+        calls.append(1)
+        raise faults.OOMInjected("RESOURCE_EXHAUSTED: injected")
+
+    with pytest.raises(faults.OOMInjected):
+        retry_call(allocate, policy=RetryPolicy(max_attempts=5,
+                                                base_delay=0.001))
+    assert len(calls) == 1   # the retry budget was never burned
+
+
+# ---------------------------------------------------------------------------
 # checkpoint.py: atomicity + CRC
 # ---------------------------------------------------------------------------
 

@@ -77,15 +77,57 @@ def test_stream_follower_tail_and_torn_lines(tmp_path):
     assert f.poll() is None                     # idempotent at EOF
 
 
-def test_stream_follower_locks_column_count(tmp_path):
+def test_stream_follower_quarantines_unparseable_rows(tmp_path):
+    """A poison row (right shape, no numbers) is quarantined to the
+    deadletter sidecar — not fatal (ISSUE 17: one corrupt producer
+    write must not become a trainer crash loop)."""
     p = str(tmp_path / "s.csv")
     _append(p, _rows(3))
     f = StreamFollower(p)
     assert f.poll().shape == (3, 7)
     with open(p, "a") as fh:
         fh.write("not,numbers,at,all,x,y,z\n")
-    with pytest.raises(ValueError, match="unparseable"):
+    assert f.poll() is None                     # nothing good to train
+    assert f.rows_skipped == 1
+    with open(f.deadletter_path, "rb") as fh:
+        assert fh.read() == b"not,numbers,at,all,x,y,z\n"
+    # the stream keeps flowing: later good rows still train
+    block = _rows(2, seed=5)
+    _append(p, block)
+    got = f.poll()
+    np.testing.assert_allclose(got, block, rtol=0, atol=0)
+
+
+def test_stream_follower_quarantines_ragged_lines(tmp_path):
+    """A short line (non-atomic producer write) is quarantined; the
+    good lines around it in the SAME poll still parse, in order."""
+    p = str(tmp_path / "s.csv")
+    block = _rows(4)
+    _append(p, block[:1])
+    f = StreamFollower(p)
+    assert f.poll().shape == (1, 7)
+    with open(p, "a") as fh:
+        fh.write("0.5,0.25\n")                  # ragged: 2 of 7 cols
+    _append(p, block[1:])
+    got = f.poll()
+    np.testing.assert_allclose(got, block[1:], rtol=0, atol=0)
+    assert f.rows_skipped == 1 and f.rows_seen == 4
+    with open(f.deadletter_path, "rb") as fh:
+        assert fh.read() == b"0.5,0.25\n"
+
+
+def test_stream_follower_skip_budget_is_fatal(tmp_path):
+    """Past ``max_skips`` the follower raises: a stream that is MOSTLY
+    garbage is a config error, not a few torn writes."""
+    p = str(tmp_path / "s.csv")
+    _append(p, _rows(1))
+    f = StreamFollower(p, max_skips=2)
+    f.poll()
+    with open(p, "a") as fh:
+        fh.write("a\nb\nc\n")
+    with pytest.raises(ValueError, match="skip budget"):
         f.poll()
+    assert f.rows_skipped == 3
 
 
 # ---------------------------------------------------------------------------
@@ -119,6 +161,67 @@ def test_trainer_resume_continues_iteration(tmp_path):
     for t4, t8 in zip(b4._engine.models, b8._engine.models):
         np.testing.assert_array_equal(np.asarray(t4.leaf_value),
                                       np.asarray(t8.leaf_value))
+
+
+def test_trainer_window_autoshrink_on_oom(tmp_path):
+    """An OOM'd re-bin cycle halves the rolling window down to the
+    floor and the trainer KEEPS publishing (ISSUE 17): a freshness
+    regression, never a crash loop."""
+    from lightgbm_tpu.robustness import faults
+    from lightgbm_tpu.robustness.checkpoint import latest_valid_checkpoint
+    stream = str(tmp_path / "s.csv")
+    ck = str(tmp_path / "ck")
+    _append(stream, _rows(600))
+    spec = TrainerSpec(params=dict(PARAMS), stream_path=stream,
+                       ckpt_dir=ck, window_rows=600,
+                       window_floor_rows=128, min_rows=256,
+                       iters_per_cycle=2, publish_every_iters=2,
+                       target_iterations=4, poll_sec=0.05)
+    with faults.inject("oom:n=2"):      # first TWO cycles OOM
+        assert run_resident_trainer(spec) == 0
+    _p, st = latest_valid_checkpoint(ck)
+    assert st["iteration"] == 4         # still reached the target
+    svc = st["service"]
+    assert svc["window_rows_target"] == 150      # 600 -> 300 -> 150
+    assert svc["window_rows"] <= 150
+    assert svc["skipped_rows"] == 0
+
+
+def test_trainer_window_grows_back_when_pressure_clears(tmp_path):
+    """After sustained clean cycles the shrunken window recovers to the
+    spec size — the shrink is adaptive, not a ratchet."""
+    from lightgbm_tpu.robustness import faults
+    from lightgbm_tpu.robustness.checkpoint import latest_valid_checkpoint
+    stream = str(tmp_path / "s.csv")
+    ck = str(tmp_path / "ck")
+    _append(stream, _rows(600))
+    spec = TrainerSpec(params=dict(PARAMS), stream_path=stream,
+                       ckpt_dir=ck, window_rows=600,
+                       window_floor_rows=128, min_rows=256,
+                       iters_per_cycle=2, publish_every_iters=2,
+                       target_iterations=10, poll_sec=0.05)
+    with faults.inject("oom:n=1"):      # one OOM'd cycle, then clear
+        assert run_resident_trainer(spec) == 0
+    _p, st = latest_valid_checkpoint(ck)
+    assert st["iteration"] == 10
+    assert st["service"]["window_rows_target"] == 600   # grew back
+
+
+def test_trainer_oom_at_floor_is_fatal(tmp_path):
+    """Persistent OOM that survives shrinking to the floor re-raises:
+    genuine exhaustion must surface, not spin forever on a floor-sized
+    window that still doesn't fit."""
+    from lightgbm_tpu.robustness import faults
+    stream = str(tmp_path / "s.csv")
+    _append(stream, _rows(400))
+    spec = TrainerSpec(params=dict(PARAMS), stream_path=stream,
+                       ckpt_dir=str(tmp_path / "ck"), window_rows=400,
+                       window_floor_rows=400, min_rows=256,
+                       iters_per_cycle=2, publish_every_iters=2,
+                       target_iterations=4, poll_sec=0.05)
+    with faults.inject("oom:p=1:n=100000"):
+        with pytest.raises(faults.OOMInjected):
+            run_resident_trainer(spec)
 
 
 # ---------------------------------------------------------------------------

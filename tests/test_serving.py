@@ -630,6 +630,64 @@ def test_server_deadline_knob_resolves_from_params():
         assert srv.stats()["max_queue_rows"] == 0
 
 
+# ---------------------------------------------------------------------------
+# memory-pressure survival (ISSUE 17): OOM-classified adaptive dispatch
+# ---------------------------------------------------------------------------
+
+def test_oom_dispatch_bisects_bit_identical_not_degraded(booster):
+    """A size-induced OOM on the coalesced batch bisects and retries —
+    halves are already-warm bucket shapes, results bit-identical to the
+    full-batch device dispatch, and the server is NOT degraded (the
+    whole-server host route is for retry exhaustion, not for a batch
+    that was merely too big)."""
+    bst, X, _ = booster
+    with bst.serve(linger_ms=1.0, raw_score=True) as srv:
+        with faults.inject("oom:n=1"):
+            got = srv.predict(X[:600], timeout=120)
+        st = srv.stats()
+        assert st["oom_bisects"] >= 1
+        assert not st["degraded"]
+        assert srv.counters.get("dispatch_retries") == 0  # never retried
+    assert np.array_equal(
+        got, bst.predict(X[:600], device=True, raw_score=True))
+
+
+def test_oom_bisection_floor_degrades_only_failing_rows(booster):
+    """oom:n=3 fails the 600-row batch, its left 300 half, and the left
+    150 quarter (under the 256-row floor -> host walk); every OTHER row
+    stays on the device. Per-request blast radius, not per-server."""
+    bst, X, _ = booster
+    with bst.serve(linger_ms=1.0, raw_score=True) as srv:
+        with faults.inject("oom:p=1:n=3"):
+            got = srv.predict(X[:600], timeout=120)
+        st = srv.stats()
+        assert st["oom_bisects"] == 2      # 600 and 300 bisected
+        assert not st["degraded"]
+    ref_dev = bst.predict(X[:600], device=True, raw_score=True)
+    ref_host = bst.predict(X[:600], device=False, raw_score=True)
+    np.testing.assert_allclose(got[:150], ref_host[:150],
+                               rtol=1e-12, atol=1e-12)
+    assert np.array_equal(got[150:], ref_dev[150:])
+
+
+def test_oom_floor_everywhere_host_walks_without_degrading(booster):
+    """Persistent OOM (every attempt) floors every slice to the host
+    walk — the batch is still answered and the server still is NOT
+    degraded: the background probe has nothing to un-degrade, and the
+    next OOM-free batch runs on the device again."""
+    bst, X, _ = booster
+    with bst.serve(linger_ms=1.0, raw_score=True) as srv:
+        with faults.inject("oom:p=1:n=1000000"):
+            got = srv.predict(X[:100], timeout=120)
+        assert not srv.stats()["degraded"]
+        clean = srv.predict(X[:100], timeout=120)
+    np.testing.assert_allclose(
+        got, bst.predict(X[:100], device=False, raw_score=True),
+        rtol=1e-12, atol=1e-12)
+    assert np.array_equal(
+        clean, bst.predict(X[:100], device=True, raw_score=True))
+
+
 @pytest.mark.slow
 def test_server_mesh_two_virtual_devices_subprocess(booster):
     """Mesh replication needs >1 device, which needs XLA_FLAGS before
