@@ -509,6 +509,42 @@ _reg("tpu_gang_manifest", bool, True, ())
 # iterations 300 s), overridable per phase via LGBM_TPU_STALL_SEC_*.
 _reg("tpu_stall_sec", float, 0.0, (), (0, None, True, False))
 
+# integrity defense (robustness/integrity.py, ISSUE 19). probe_interval
+# arms the serving tier's silent-corruption canary: at each publish the
+# server records a golden canary score vector (device replay, anchored
+# against the bit-identical host walk) and a background probe replays
+# it every interval seconds, bit-comparing against the golden — a
+# mismatch quarantines ONLY the afflicted route/tenant to the host
+# walk, repairs (re-upload from the CRC-verified host pack, or full
+# rebuild on host-side corruption) and un-quarantines on clean parity.
+# 0 = disarmed (no probe thread, no per-publish replay — the default,
+# so latency-critical tiers opt in). Probes ride the existing row
+# buckets: zero new steady-state traces.
+_reg("tpu_integrity_probe_interval_s", float, 0.0, (),
+     (0.0, None, True, False))
+# rows in the fixed canary batch (deterministic per feature width —
+# every process regenerates identical bits); padded into the minimum
+# row bucket either way, so bigger buys coverage, not cost.
+_reg("tpu_integrity_canary_rows", int, 16, (), (1, 4096, True, True))
+# per-iteration numeric-health guard in the boosting loop: NaN/Inf
+# grad/hess sums, NaN/Inf leaf outputs, and gradient-norm spike
+# detection over a rolling window raise NumericHealthError (classified
+# DATA_CORRUPTION — never retried; the continual trainer answers by
+# rolling back to the newest CRC-valid checkpoint). Costs one tiny
+# fused reduction + host sync per iteration; off by default, armed by
+# the resident trainer (service/trainer.py) automatically.
+_reg("tpu_integrity_numeric_guard", bool, False, ())
+# spike factor for the guard's rolling-window loss/grad-norm series:
+# an observation > factor x the window median is classified corrupt.
+_reg("tpu_integrity_loss_spike_factor", float, 100.0, (),
+     (1.0, None, False, False))
+# gang agreement cadence (iterations): every N iterations the ranks of
+# an injected-collective world allreduce a cheap digest of the just-
+# committed trees and raise GangDivergence (DATA_CORRUPTION) on
+# disagreement, so the gang supervisor relaunches from the manifest
+# instead of committing a forked model. 0 = off.
+_reg("tpu_integrity_digest_every", int, 0, (), (0, None, True, False))
+
 # objective alias names accepted for each canonical objective
 OBJECTIVE_ALIASES = {
     "regression": ("regression", "regression_l2", "l2", "mean_squared_error",

@@ -45,7 +45,7 @@ import numpy as np
 
 from .. import tuned
 from ..config import Config
-from ..robustness import faults, heartbeat
+from ..robustness import faults, heartbeat, integrity
 from ..core.grower import GrowerConfig, make_tree_grower
 from ..core.metrics import Metric, metrics_for_config
 from ..core.objective import ObjectiveFunction, CustomObjective, K_EPSILON
@@ -432,6 +432,13 @@ class GBDT:
             host.shrink(p.shrinkage)
             if abs(p.bias) > K_EPSILON:
                 host.add_bias(p.bias)
+            guard = self._numeric_guard()
+            if guard is not None:
+                # async commit point (ISSUE 19): the deferred trees are
+                # first observable HERE — non-finite leaf outputs must
+                # not reach the model list on this path either
+                guard.check_leaves(host.leaf_value[:host.num_leaves],
+                                   self.iter)
             self._models.append(host)
 
     def _async_stop_check(self) -> bool:
@@ -2050,6 +2057,76 @@ class GBDT:
             hb.beat(heartbeat.PHASE_ITER if self._hb_warm
                     else heartbeat.PHASE_COMPILING, self.iter)
 
+    def _numeric_guard(self) -> Optional[integrity.NumericHealthGuard]:
+        """The per-iteration numeric-health watchdog (ISSUE 19), built
+        lazily when ``tpu_integrity_numeric_guard`` is armed (off by
+        default; the resident trainer arms it). Catches NaN/Inf
+        grad/hess sums, non-finite committed leaf outputs and
+        loss-proxy spikes BEFORE a poisoned tree reaches the model —
+        raising the DATA_CORRUPTION-classified NumericHealthError the
+        continual trainer answers with a checkpoint rollback."""
+        if not bool(getattr(self.config, "tpu_integrity_numeric_guard",
+                            False)):
+            return None
+        g = getattr(self, "_nguard", None)
+        if g is None:
+            g = integrity.NumericHealthGuard(
+                spike_factor=float(getattr(
+                    self.config, "tpu_integrity_loss_spike_factor",
+                    100.0)),
+                what="training")
+            self._nguard = g
+        return g
+
+    def _guard_sums(self, grad, hess) -> Tuple[float, float, float]:
+        """(sum g, sum h, mean |g|) in ONE fused jitted reduction —
+        the guard's whole per-iteration device cost. mean |g| is the
+        loss PROXY the spike check watches: it tracks the training
+        loss's gradient magnitude without a per-iteration [K, N]
+        device->host score pull."""
+        fn = getattr(self, "_guard_sums_fn", None)
+        if fn is None:
+            fn = jax.jit(lambda g, h: (jnp.sum(g), jnp.sum(h),
+                                       jnp.mean(jnp.abs(g))))
+            self._guard_sums_fn = fn
+        gs, hs, ga = fn(grad, hess)
+        return float(gs), float(hs), float(ga)
+
+    def _gang_digest_check(self) -> None:
+        """Gang agreement check (ISSUE 19): every
+        ``tpu_integrity_digest_every`` iterations, all ranks allreduce
+        a cheap CRC digest of the freshly committed iteration's trees
+        and verify agreement through the sum-based reduction identity
+        (``integrity.check_digest_reduction`` — injected transports
+        only guarantee ``reduce_sum``). Divergence raises the
+        classified ``GangDivergence``: the worker exits nonzero and the
+        gang supervisor (robustness/gang.py) relaunches the whole gang
+        from the newest manifest. No-op unless this booster trains
+        under injected collectives with world > 1."""
+        every = int(getattr(self.config, "tpu_integrity_digest_every",
+                            0) or 0)
+        inj = getattr(self, "_inj", None)
+        if every <= 0 or inj is None or int(inj["num_machines"]) <= 1:
+            return
+        if self.iter % every != 0:
+            return
+        from ..distributed import retried_collective
+        K = self.num_tree_per_iteration
+        models = self.models          # flushes pending device trees
+        digest = integrity.iteration_digest(models[-K:])
+        if faults.check("bitflip", where="digest"):
+            # gang-divergence drill: THIS rank's digest lies — the
+            # agreement check must refuse the iteration on every rank
+            log.warning("fault injection: bit-flipped this rank's tree "
+                        "digest before the gang agreement sync")
+            digest ^= 0x1
+        total = np.asarray(retried_collective(
+            inj["reduce_sum"], integrity.digest_reduction(digest),
+            what="integrity tree-digest sync"))
+        integrity.check_digest_reduction(
+            total, int(inj["num_machines"]), digest, self.iter,
+            rank=int(inj["rank"]), what="gang")
+
     def train_one_iter(self, gradients: Optional[np.ndarray] = None,
                        hessians: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration (ref: gbdt.cpp:353 TrainOneIter).
@@ -2071,6 +2148,8 @@ class GBDT:
             else:
                 done = self._train_one_iter_sync(gradients, hessians)
             self._hb_warm = True
+            if not done:
+                self._gang_digest_check()
             return done
         except KeyboardInterrupt:
             # the watchdog unblocks a wedged iteration via
@@ -2117,6 +2196,23 @@ class GBDT:
                 np.asarray(gradients, np.float32).reshape(K, self.num_data))
             hess = jnp.asarray(
                 np.asarray(hessians, np.float32).reshape(K, self.num_data))
+
+        # -- integrity defense (ISSUE 19) -------------------------------
+        # the nan_grad fault site poisons the gradient stream (silent
+        # data corruption: with no guard armed, the NaN walks into a
+        # committed tree's leaf outputs); the numeric-health guard —
+        # armed via tpu_integrity_numeric_guard — catches it HERE,
+        # before a tree is grown from the poisoned stream
+        if faults.check("nan_grad"):
+            log.warning("fault injection: poisoning this iteration's "
+                        "gradient stream with NaN (silent data "
+                        "corruption)")
+            grad = jnp.asarray(grad).at[0, 0].set(jnp.nan)
+        guard = self._numeric_guard()
+        if guard is not None:
+            gsum, hsum, gabs = self._guard_sums(grad, hess)
+            guard.check_gradients(gsum, hsum, self.iter)
+            guard.observe_loss(gabs, self.iter, what="loss proxy")
 
         # -- bagging / GOSS (host decision, device apply) ---------------
         # only GOSS reads gradients; skip the [K, N] device->host pull
@@ -2292,6 +2388,9 @@ class GBDT:
                 host.shrink(self.shrinkage_rate)
             if abs(init_scores[k]) > K_EPSILON:
                 host.add_bias(init_scores[k])
+            if guard is not None:
+                guard.check_leaves(host.leaf_value[:host.num_leaves],
+                                   self.iter)
             self.models.append(host)
 
         if not should_continue:

@@ -673,9 +673,24 @@ def upload_window(host):
     fault site immediately before the transfer — a fired fault means
     the allocation failed and nothing reached the device. No trace:
     ``jnp.asarray`` of a concrete numpy array is a transfer, not a
-    program."""
+    program.
+
+    Silent-corruption injection (ISSUE 19): the ``bitflip:where=dev``
+    fault corrupts the DEVICE copy after the transfer (sign bits of
+    the slot-0 tree's leaf outputs — guaranteed observable by a canary
+    replay), leaving the host pack intact, so the integrity probe's
+    repair path (evict + re-upload from the CRC-verified host copy)
+    genuinely restores correct bits."""
     faults.maybe_fail("oom")
-    return jax.tree.map(jnp.asarray, host)
+    dev = jax.tree.map(jnp.asarray, host)
+    if faults.check("bitflip", where="dev"):
+        from ..robustness import integrity
+        from ..utils import log
+        corrupt = integrity.corrupt_pack(jax.tree.map(np.asarray, dev))
+        dev = jax.tree.map(jnp.asarray, corrupt)
+        log.warning("injected bitflip: device pack corrupted "
+                    "(slot-0 leaf-output sign bits)")
+    return dev
 
 
 class ServingEngine:

@@ -25,6 +25,7 @@ so tier-1 can prove the atomicity contract on CPU.
 """
 from __future__ import annotations
 
+import errno
 import json
 import os
 import re
@@ -64,7 +65,10 @@ def atomic_write_text(path: str, text: str, crc_footer: bool = False
 
     Honors the ``write_kill`` injected fault: the kill fires after a
     partial flush of the tmp file, before the rename — the final path
-    is never touched by a killed write."""
+    is never touched by a killed write. The ``disk_full`` fault fires
+    at the same point as ``OSError(ENOSPC)`` — a disk that filled
+    mid-payload; the stale tmp file is removed (freeing what it did
+    claim) and the error propagates for the caller to classify."""
     if crc_footer:
         payload = text.encode("utf-8")
         text = text + (f"\n#CRC32={zlib.crc32(payload) & 0xffffffff:08x}"
@@ -74,16 +78,33 @@ def atomic_write_text(path: str, text: str, crc_footer: bool = False
     os.makedirs(d, exist_ok=True)
     tmp = f"{path}.tmp.{os.getpid()}"
     fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    closed = False
     try:
-        half = len(data) // 2
-        os.write(fd, data[:half])
-        # injected kill-9 point: partial tmp bytes are on disk, final
-        # file untouched
-        faults.maybe_fail("write_kill")
-        os.write(fd, data[half:])
-        os.fsync(fd)
+        try:
+            half = len(data) // 2
+            os.write(fd, data[:half])
+            # injected kill-9 point: partial tmp bytes are on disk,
+            # final file untouched
+            faults.maybe_fail("write_kill")
+            # injected/real ENOSPC point: same mid-payload spot
+            faults.maybe_fail("disk_full")
+            os.write(fd, data[half:])
+            os.fsync(fd)
+        except OSError as e:
+            if e.errno == errno.ENOSPC:
+                # a full disk must not also LEAK the partial tmp file:
+                # reclaim it so the caller's prune-and-retry has the
+                # bytes it just freed
+                os.close(fd)
+                closed = True
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+            raise
     finally:
-        os.close(fd)
+        if not closed:
+            os.close(fd)
     os.replace(tmp, path)
     dfd = os.open(d, os.O_RDONLY)
     try:
@@ -100,17 +121,64 @@ def checkpoint_name(iteration: int) -> str:
     return f"ckpt_{int(iteration):09d}.lgbmckpt"
 
 
-def write_checkpoint(directory: str, state: Dict) -> str:
+def write_checkpoint(directory: str, state: Dict,
+                     keep_last: Optional[int] = None) -> str:
     """Atomically persist ``state`` (must carry ``iteration`` and
-    ``model``; everything else is loop state) and return the path."""
+    ``model``; everything else is loop state) and return the path.
+
+    Disk-full survival (ISSUE 19): an ``ENOSPC`` from the atomic
+    writer — the publish channel's disk filled mid-write — prunes
+    checkpoints beyond ``keep_last`` (plus tmp litter) to reclaim
+    space and retries ONCE; a second ENOSPC propagates loudly. The
+    committed generation set is never touched by the failure: the
+    atomic writer's tmp-file discipline means a failed write leaves
+    every existing checkpoint intact, and the prune keeps the newest
+    ``keep_last`` — the retry can only ADD a newer generation.
+    ``keep_last=None`` keeps the prior fail-fast behavior (callers
+    that manage retention themselves).
+
+    The ``bitflip:where=ckpt`` fault corrupts one byte of the COMMITTED
+    file after a successful write: the CRC32 footer catches it on the
+    next validated read, so recovery anchors on the previous valid
+    generation (tested via ``latest_valid_checkpoint``)."""
     it = int(state["iteration"])
     model = state["model"]
     loop = {k: v for k, v in state.items() if k != "model"}
     header = json.dumps({"magic": MAGIC, **loop},
                         default=_json_default)
     path = os.path.join(directory, checkpoint_name(it))
-    atomic_write_text(path, header + "\n" + model, crc_footer=True)
+    try:
+        atomic_write_text(path, header + "\n" + model, crc_footer=True)
+    except OSError as e:
+        if e.errno != errno.ENOSPC or keep_last is None:
+            raise
+        removed = prune_checkpoints(directory, max(int(keep_last), 1))
+        log.warning(
+            f"checkpoint write hit ENOSPC ({e}); pruned {removed} "
+            f"old checkpoint file(s) beyond keep_last={keep_last} "
+            "and retrying once — a second failure is fatal")
+        atomic_write_text(path, header + "\n" + model, crc_footer=True)
+    if faults.check("bitflip", where="ckpt"):
+        _flip_committed_byte(path)
     return path
+
+
+def _flip_committed_byte(path: str) -> None:
+    """``bitflip:where=ckpt`` payload: XOR one mid-payload byte of the
+    committed checkpoint file in place — silent at-rest corruption the
+    CRC footer must catch on the next validated read."""
+    try:
+        with open(path, "r+b") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            pos = size // 2
+            f.seek(pos)
+            b = f.read(1)
+            f.seek(pos)
+            f.write(bytes([b[0] ^ 0x01]))
+        log.warning(f"injected bitflip: corrupted one byte of {path}")
+    except OSError as e:   # injection best-effort; never crash a write
+        log.warning(f"bitflip injection failed on {path}: {e}")
 
 
 def read_validated_text(path: str) -> str:

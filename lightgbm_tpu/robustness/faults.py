@@ -74,6 +74,37 @@ Fault classes (the ``site`` argument of :func:`maybe_fail`):
   fleet pack upload (ops/forest.py ``upload_window`` — publish-forced
   eviction), and the trainer re-bin (service/trainer.py — window
   auto-shrink).
+- ``bitflip`` — silent data corruption (ISSUE 19): wrong bits appear
+  where correct bits were written, via :func:`check` at four
+  site-targeted consult points selected with the ``where=`` option:
+  ``where=dev`` corrupts a freshly uploaded device pack
+  (ops/forest.py ``upload_window`` and the solo server's published
+  snapshot — sign bits of the slot-0 tree's leaf outputs, guaranteed
+  canary-observable), ``where=host`` corrupts the retained HOST
+  window copy (serving/fleet.py ``_build_bucket`` — caught by the CRC
+  fingerprint before any re-upload), ``where=ckpt`` flips one byte of
+  a committed checkpoint file (robustness/checkpoint.py — caught by
+  the CRC32 footer on read, so recovery anchors on the previous valid
+  generation), ``where=digest`` lies about one rank's committed-tree
+  digest (models/gbdt.py ``_gang_digest_check`` — the gang agreement
+  sync must refuse the iteration on every rank). Without ``where=``
+  the first consulted point fires.
+- ``nan_grad`` — one boosting iteration's gradients are poisoned to
+  NaN after the objective computes them (models/gbdt.py sync path,
+  via :func:`check`): the numeric-health guard must fail the
+  iteration as ``DATA_CORRUPTION`` and the continual trainer must
+  roll back to the newest CRC-valid checkpoint instead of committing
+  or publishing the poisoned model.
+- ``loss_spike`` — the numeric-health guard's loss observation is
+  inflated past its spike threshold (robustness/integrity.py
+  ``NumericHealthGuard.observe_loss`` via :func:`check`): the
+  finite-but-wrong corruption signature, distinct from NaN.
+- ``disk_full`` — the atomic checkpoint writer's payload write raises
+  ``ENOSPC`` (robustness/checkpoint.py ``atomic_write_text``): the
+  publish channel's disk filled mid-write. ``write_checkpoint``
+  answers by pruning beyond ``keep_last`` and retrying ONCE — the
+  continual service survives one full-disk episode without losing its
+  newest committed generation.
 
 Options per spec:
 
@@ -89,6 +120,10 @@ Options per spec:
   ``slow_dispatch`` and ``collective_delay``; default 30.0).
 - ``rank=<int>`` — gang rank filter (``rank_kill``): only the matching
   rank's consults count or fire (default: every rank).
+- ``where=<name>`` — consult-point filter (``bitflip``): only consults
+  passing a matching ``where=`` count or fire (``dev`` / ``host`` /
+  ``ckpt``); without it the first consulted point fires. The same
+  targeting idea as ``rank=``, for corruption sites.
 
 Counters are PER-PROCESS: an env-installed plan re-arms in every
 subprocess (each child re-runs install_from_env with fresh counters).
@@ -113,7 +148,8 @@ ENV_FAULTS = "LGBM_TPU_FAULTS"
 
 KNOWN_SITES = ("collective", "probe_timeout", "write_kill", "hang",
                "slow_compile", "dispatch_error", "slow_dispatch",
-               "publish_fail", "rank_kill", "collective_delay", "oom")
+               "publish_fail", "rank_kill", "collective_delay", "oom",
+               "bitflip", "nan_grad", "loss_spike", "disk_full")
 
 # exit code of an injected rank_kill: the gang supervisor annotates it
 # in the per-rank diagnosis (distinct from EXIT_STALLED=86 so forensics
@@ -142,11 +178,13 @@ class _Fault:
     def __init__(self, site: str, p: float = 1.0,
                  n: Optional[int] = None, after: int = 0,
                  seed: int = 0, sec: float = 30.0,
-                 rank: Optional[int] = None):
+                 rank: Optional[int] = None,
+                 where: Optional[str] = None):
         self.site = site
         self.p = float(p)
         self.sec = float(sec)
         self.rank = int(rank) if rank is not None else None
+        self.where = str(where) if where is not None else None
         # a bare always-on fault (p=1, no n) fires once then disarms:
         # "kill the write" means one kill, not an unrecoverable loop
         self.n = n if n is not None else (1 if self.p >= 1.0 else None)
@@ -213,6 +251,8 @@ class FaultPlan:
                     kw["sec"] = float(v)
                 elif k == "rank":
                     kw["rank"] = int(v)
+                elif k == "where":
+                    kw["where"] = v.strip()
                 else:
                     raise ValueError(
                         f"unknown fault option {k!r} in {entry!r}")
@@ -248,6 +288,14 @@ def maybe_fail(site: str) -> None:
     if site == "write_kill":
         raise WriteKilled(
             f"injected mid-write kill (write #{f.calls})")
+    if site == "disk_full":
+        # the REAL exception shape (OSError/ENOSPC), not a FaultInjected
+        # wrapper: the writer's recovery path must classify by errno,
+        # exactly as it would for a genuinely full disk
+        import errno
+        raise OSError(errno.ENOSPC,
+                      f"injected disk_full fault (write #{f.calls}, "
+                      f"injection #{f.fired})")
     if site == "oom":
         raise OOMInjected(
             f"RESOURCE_EXHAUSTED: injected oom fault "
@@ -257,18 +305,26 @@ def maybe_fail(site: str) -> None:
         f"(call #{f.calls}, injection #{f.fired})")
 
 
-def check(site: str) -> bool:
+def check(site: str, where: Optional[str] = None) -> bool:
     """Non-raising consult: True when ``site``'s fault fires this call.
 
     For fault kinds whose effect is behavioral rather than an exception
-    (``hang`` suppresses heartbeat writes) the call site decides what
-    "failing" means; counters/probability/arming work exactly like
-    :func:`maybe_fail`."""
+    (``hang`` suppresses heartbeat writes, ``bitflip`` corrupts bytes)
+    the call site decides what "failing" means; counters/probability/
+    arming work exactly like :func:`maybe_fail`. ``where`` names the
+    consult point for site-targeted faults: a fault armed with
+    ``where=X`` only counts or fires at consults passing ``where="X"``
+    (consults elsewhere don't burn ``after=`` budget, mirroring the
+    ``rank=`` filter)."""
     plan = _active
     if plan is None:
         return False
     f = plan.faults.get(site)
-    return f is not None and f.should_fire()
+    if f is None:
+        return False
+    if f.where is not None and where != f.where:
+        return False
+    return f.should_fire()
 
 
 def maybe_delay(site: str, sleep=None) -> float:

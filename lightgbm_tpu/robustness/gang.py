@@ -44,6 +44,21 @@ deadline instead of wedging) lives in distributed.py; a rank wedged
 inside a *jitted* collective is covered by the PR4 in-training watchdog
 (beat age → ``EXIT_STALLED``), which this supervisor classifies.
 
+**Gang agreement** (ISSUE 19): silent model divergence — ranks that
+are all alive, all beating, but no longer training the SAME model
+(bit-rot in one rank's committed trees, a miscompiled reduction, a
+corrupted host buffer) — is invisible to liveness supervision. The
+integrity leg closes it: every ``tpu_integrity_digest_every``
+iterations each rank folds its freshly committed trees into a
+fingerprint (robustness/integrity.py ``iteration_digest``) and the
+gang allreduces the :func:`~.integrity.digest_reduction` moments;
+any disagreement makes EVERY rank raise
+:class:`~.integrity.GangDivergence` (a nonzero exit) at the same
+iteration boundary. To this supervisor a divergence is just N ranks
+dying loudly — the ordinary relaunch path resumes the whole gang from
+the newest valid manifest, whose checkpoint predates the divergence,
+so the rot is discarded rather than trained forward.
+
 No jax import anywhere in this module — same hazard boundary as
 supervisor.py: a supervisor must never initialize a backend.
 """

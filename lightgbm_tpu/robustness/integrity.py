@@ -1,0 +1,369 @@
+"""Silent-corruption defense: canaries, numeric health, gang agreement.
+
+Every robustness layer before this one (retry/backoff, heartbeat
+supervision, serving chaos, the gang, OOM survival) defends against
+processes that die, stall, or run out of memory. This module defends
+against the worse failure: a process that KEEPS RUNNING and returns
+wrong answers — a flipped bit in a device pack serving wrong scores, a
+NaN-poisoned boosting iteration committing a garbage model, a diverged
+rank committing a forked model, a full disk tearing the publish
+channel. The contract is always the same: detect, quarantine, repair,
+account — never silently serve or commit wrong bits, and never crash
+-loop on a fault the caller can adapt past.
+
+Four legs, one fault grammar (``robustness/faults.py``: ``bitflip``,
+``nan_grad``, ``loss_spike``, ``disk_full``) and one counter contract
+(``serving/metrics.py``: ``integrity_probes`` / ``integrity_mismatches``
+/ ``quarantines`` / ``repairs``):
+
+1. **Serving canary parity probes** — at pack/publish/rebuild time the
+   serving tier records a host-walk golden score vector for a small
+   fixed canary batch (:func:`canary_batch`, deterministic per feature
+   width, padded through the EXISTING row buckets so probes add zero
+   steady-state traces). A background :class:`IntegrityProbe` replays
+   the canary through every resident device route and bit-compares
+   against the golden; a mismatch quarantines only the afflicted
+   route/tenant to the bit-identical host walk, repairs (re-upload from
+   the CRC-verified host pack, or full rebuild when the host pack
+   itself is corrupt), re-probes and un-quarantines on clean parity.
+2. **Host pack fingerprints** — :func:`crc32_fingerprint` over a host
+   pack pytree, recorded at pack time and re-verified on lazy rebuild
+   and repair, distinguishes host-side from device-side corruption.
+3. **Training numeric health** — :class:`NumericHealthGuard` checks
+   grad/hess sums, leaf outputs and the eval/loss series every
+   iteration and raises :class:`NumericHealthError` (classified
+   ``DATA_CORRUPTION`` by ``retry.classify_error``; NOT transient —
+   retrying the same poisoned iteration is futile). The continual
+   trainer answers by rolling back to the newest CRC-valid checkpoint.
+4. **Gang agreement** — ranks periodically compare a cheap digest of
+   the freshly grown trees (the direct product of the post-reduce root
+   histograms, compared BEFORE the iteration's model is committed);
+   :func:`check_gang_digests` raises :class:`GangDivergence` on
+   disagreement so the gang supervisor relaunches from the manifest
+   instead of committing a forked model.
+
+No ``jax`` import at module scope (same hazard boundary as
+``checkpoint.py``/``gang.py``: supervisors import this before choosing
+a backend); pytree walking is structural over tuples/lists/dicts.
+"""
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import log
+
+#: substring every integrity exception carries — retry.classify_error
+#: files anything with this marker under the DATA_CORRUPTION class.
+CORRUPTION_MARKER = "DATA_CORRUPTION"
+
+
+class IntegrityError(RuntimeError):
+    """Base of the corruption family; the message always carries the
+    DATA_CORRUPTION marker so string-level classification (the same
+    convention FaultInjected/OOMInjected use) works across process
+    boundaries."""
+
+    def __init__(self, msg: str):
+        if CORRUPTION_MARKER not in msg:
+            msg = f"{CORRUPTION_MARKER}: {msg}"
+        super().__init__(msg)
+
+
+class NumericHealthError(IntegrityError):
+    """A boosting iteration produced non-finite or wildly spiked
+    numerics (NaN/Inf grad/hess/leaf outputs, loss spike). Retrying the
+    same iteration is futile; the caller must roll back."""
+
+
+class CanaryMismatch(IntegrityError):
+    """A device route returned canary scores that differ bit-wise from
+    the host-walk golden — the pack (device or host side) is corrupt."""
+
+
+class GangDivergence(IntegrityError):
+    """Ranks disagree on the post-reduce tree digest: at least one rank
+    reduced different bits. Relaunch from the manifest; do not commit."""
+
+
+# ---------------------------------------------------------------------------
+# Fingerprints + canaries
+# ---------------------------------------------------------------------------
+
+def _walk_arrays(obj):
+    """Yield every ndarray in a host pytree (tuples — incl. NamedTuples
+    — lists, dicts and scalars; no jax dependency)."""
+    if obj is None:
+        return
+    if isinstance(obj, np.ndarray):
+        yield obj
+        return
+    if isinstance(obj, dict):
+        for k in sorted(obj):
+            yield from _walk_arrays(obj[k])
+        return
+    if isinstance(obj, (tuple, list)):
+        for v in obj:
+            yield from _walk_arrays(v)
+        return
+    if isinstance(obj, (int, float, bool, np.generic)):
+        yield np.asarray(obj)
+
+
+def crc32_fingerprint(tree) -> int:
+    """CRC32 over every array's dtype, shape and bytes in ``tree``.
+
+    Structure-sensitive (an array moved between leaves changes the
+    digest) and cheap: one pass over host memory, no copies beyond
+    non-contiguous leaves. This is the host mega-pack fingerprint —
+    recorded at pack time, re-verified before any re-upload, so repair
+    never pushes corrupt host bytes back to the device."""
+    crc = 0
+    for a in _walk_arrays(tree):
+        crc = zlib.crc32(str((a.dtype.str, a.shape)).encode(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(a).tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
+def canary_batch(n_features: int, rows: int = 16,
+                 seed: int = 0) -> np.ndarray:
+    """Deterministic canary rows for one feature width: f64 values that
+    are exactly f32-representable (the serving tier's raw route demands
+    it), derived from ``(seed, n_features)`` alone so every process —
+    publisher, prober, chaos gate — regenerates identical bits. Small
+    enough that the replay pads into the minimum row bucket: the probe
+    rides shapes steady-state traffic already compiled, adding zero
+    traces."""
+    rng = np.random.default_rng(1_000_003 * (seed + 1) + n_features)
+    x = rng.standard_normal((int(rows), int(n_features)))
+    return x.astype(np.float32).astype(np.float64)
+
+
+def corrupt_pack(host):
+    """Return a copy of a host window/pack pytree with the sign bit of
+    every leaf output of the FIRST tree slot flipped — the ``bitflip``
+    fault's host-side payload. Slot 0 is always a real tree and its
+    leaf outputs feed every request of the slot-0 tenant, so the
+    corruption is deterministic AND guaranteed observable by a canary
+    replay (a flip landing in pad bytes would be an injection that
+    proves nothing). Works on both window layouts (binned ``PackedTree``
+    — leaf values under ``.tree`` — and raw ``RawTreeArrays``)."""
+    inner = getattr(host, "tree", None)
+    carrier = inner if inner is not None else host
+    lv = np.array(carrier.leaf_value, copy=True)
+    lv[0] = np.negative(lv[0])
+    carrier = carrier._replace(leaf_value=lv)
+    return host._replace(tree=carrier) if inner is not None else carrier
+
+
+# ---------------------------------------------------------------------------
+# Training numeric health
+# ---------------------------------------------------------------------------
+
+class NumericHealthGuard:
+    """Per-iteration numeric watchdog for the boosting loop.
+
+    Three checks, all host-side floats (the caller reduces on device
+    and hands tiny scalars over — one fused reduction dispatch per
+    iteration, no [K, N] pulls):
+
+    - :meth:`check_gradients`: NaN/Inf in the grad/hess sums poisons
+      every histogram downstream; fail the iteration immediately.
+    - :meth:`check_leaves`: NaN/Inf leaf outputs would be committed
+      into the model text and served forever.
+    - :meth:`observe_loss`: a rolling-window spike detector over the
+      train/eval loss series — ``spike_factor`` × the rolling median
+      (plus an absolute epsilon floor so near-zero converged losses
+      don't false-positive) flags corruption that stays finite. The
+      ``loss_spike`` fault site injects exactly this signature.
+
+    All raises are :class:`NumericHealthError` → ``DATA_CORRUPTION``:
+    not transient (the same window re-poisons), not fatal (the caller
+    rolls back to the newest CRC-valid checkpoint and continues).
+    """
+
+    def __init__(self, window: int = 8, spike_factor: float = 100.0,
+                 what: str = "training"):
+        self.window = max(int(window), 2)
+        self.spike_factor = float(spike_factor)
+        self.what = what
+        self._losses: List[float] = []
+
+    def check_gradients(self, grad_sum: float, hess_sum: float,
+                        iteration: int) -> None:
+        if not (np.isfinite(grad_sum) and np.isfinite(hess_sum)):
+            raise NumericHealthError(
+                f"{self.what} iteration {iteration}: non-finite "
+                f"gradient/hessian sums (grad_sum={grad_sum!r}, "
+                f"hess_sum={hess_sum!r}) — the objective saw corrupt "
+                "scores or labels; this iteration must not be "
+                "committed")
+
+    def check_leaves(self, leaf_values: np.ndarray,
+                     iteration: int) -> None:
+        if not np.isfinite(leaf_values).all():
+            bad = int(np.count_nonzero(~np.isfinite(leaf_values)))
+            raise NumericHealthError(
+                f"{self.what} iteration {iteration}: {bad} non-finite "
+                "leaf output(s) in the freshly grown tree — refusing "
+                "to commit a model that scores NaN")
+
+    def observe_loss(self, loss: float, iteration: int,
+                     what: str = "loss") -> None:
+        from . import faults
+        if faults.check("loss_spike"):
+            loss = (abs(loss) + 1.0) * self.spike_factor * 10.0
+        if not np.isfinite(loss):
+            raise NumericHealthError(
+                f"{self.what} iteration {iteration}: non-finite {what} "
+                f"({loss!r})")
+        hist = self._losses
+        if len(hist) >= self.window:
+            med = float(np.median(hist[-self.window:]))
+            if abs(loss) > self.spike_factor * max(abs(med), 1e-6):
+                spiked = loss
+                self._losses = []     # re-seed after the rollback
+                raise NumericHealthError(
+                    f"{self.what} iteration {iteration}: {what} spiked "
+                    f"to {spiked!r} (> {self.spike_factor}× the rolling "
+                    f"median {med!r} over the last {self.window} "
+                    "observations) — numeric poisoning, roll back")
+        hist.append(float(loss))
+        if len(hist) > 4 * self.window:
+            del hist[:-self.window]
+
+
+# ---------------------------------------------------------------------------
+# Gang agreement
+# ---------------------------------------------------------------------------
+
+def iteration_digest(host_trees) -> int:
+    """CRC32 digest of one iteration's freshly grown tree(s): split
+    features, thresholds/bins and leaf outputs. These arrays are pure
+    functions of the post-reduce root histograms, so ranks whose
+    reductions diverged produce different digests HERE — one iteration
+    before the committed models fork. 8 bytes on the wire per rank."""
+    crc = 0
+    for t in host_trees:
+        n = int(t.num_leaves)
+        for name in ("split_feature", "threshold", "threshold_bin",
+                     "left_child", "right_child", "leaf_value"):
+            a = getattr(t, name, None)
+            if a is None:
+                continue
+            a = np.ascontiguousarray(np.asarray(a)[:max(n - 1, 0)]
+                                     if name != "leaf_value"
+                                     else np.asarray(a)[:n])
+            crc = zlib.crc32(a.tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
+def check_gang_digests(digests: Sequence[int], iteration: int,
+                       rank: Optional[int] = None,
+                       what: str = "gang") -> None:
+    """All ranks must report the same digest; raise
+    :class:`GangDivergence` (listing every rank's value) otherwise.
+    Pure function — the transport (allgather/allreduce) is the
+    caller's; the smoke gates exercise this logic without a world."""
+    vals = [int(d) & 0xFFFFFFFF for d in digests]
+    if len(set(vals)) <= 1:
+        return
+    who = f" (this rank: {rank})" if rank is not None else ""
+    listing = ", ".join(f"r{i}={v:08x}" for i, v in enumerate(vals))
+    raise GangDivergence(
+        f"{what} iteration {iteration}: post-reduce tree digests "
+        f"diverged across ranks{who}: {listing} — at least one rank "
+        "reduced different bits; refusing to commit a forked model "
+        "(relaunch from the newest committed manifest)")
+
+
+def digest_reduction(digest: int) -> np.ndarray:
+    """One rank's digest encoded for an allreduce-SUM transport (the
+    only collective every injected world guarantees): the crc32 split
+    into two 16-bit halves plus their squares, ``[hi, lo, hi², lo²]``
+    f64. All values stay < 2**32, so a world's sums are exact in f64
+    and :func:`check_digest_reduction` can decide agreement from the
+    sums alone — no allgather needed, and every rank reaches the SAME
+    verdict from the same reduced bytes."""
+    d = int(digest) & 0xFFFFFFFF
+    hi, lo = float(d >> 16), float(d & 0xFFFF)
+    return np.asarray([hi, lo, hi * hi, lo * lo], np.float64)
+
+
+def check_digest_reduction(total: np.ndarray, world: int, digest: int,
+                           iteration: int, rank: Optional[int] = None,
+                           what: str = "gang") -> None:
+    """Verify an allreduce-summed :func:`digest_reduction`: per half,
+    ``world × Σd² == (Σd)²`` holds iff every rank contributed the same
+    value (Cauchy–Schwarz equality; sums are exact — each half is
+    < 2**16, so ``world × Σd²`` fits f64 for any real world size).
+    Raises :class:`GangDivergence` otherwise. Deterministic across
+    ranks: the verdict is a pure function of the reduced array."""
+    t = np.asarray(total, np.float64).reshape(-1)
+    w = max(int(world), 1)
+    agree = (w * t[2] == t[0] * t[0]) and (w * t[3] == t[1] * t[1])
+    if agree:
+        return
+    who = f" (this rank: {rank}, digest {int(digest):08x})" \
+        if rank is not None else ""
+    raise GangDivergence(
+        f"{what} iteration {iteration}: post-reduce tree digests "
+        f"diverged across {w} ranks{who} — at least one rank reduced "
+        "different bits; refusing to commit a forked model (relaunch "
+        "from the newest committed manifest)")
+
+
+# ---------------------------------------------------------------------------
+# Background probe
+# ---------------------------------------------------------------------------
+
+class IntegrityProbe:
+    """Always-on background canary prober (the steady-state sibling of
+    ``DegradeControl._probe_loop``, which only runs while degraded).
+
+    Runs ``fn()`` every ``interval_s`` seconds until closed; ``fn`` owns
+    detection/quarantine/repair and must never raise for control flow —
+    an escaped exception is logged and the cadence continues (a broken
+    prober must not take serving down; it fails toward MORE probing,
+    not less)."""
+
+    def __init__(self, fn: Callable[[], None], interval_s: float,
+                 what: str = "serving"):
+        self._fn = fn
+        self._interval = float(interval_s)
+        self._close_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._what = what
+        if self._interval > 0:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"lgbm-{what}-integrity-probe")
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._close_evt.wait(self._interval):
+            try:
+                self._fn()
+            except Exception as e:  # noqa: BLE001 — keep probing
+                log.warning(f"{self._what} integrity probe error "
+                            f"(probing continues): {e!r}")
+
+    def close(self) -> None:
+        self._close_evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(2.0)
+
+
+def parity_equal(a, b) -> bool:
+    """Bit-for-bit score comparison (NaN-safe, shape-strict) — the
+    canary acceptance predicate. ``array_equal`` with NaN equality:
+    a golden that legitimately contains NaN (it never should) must not
+    read as a permanent mismatch loop."""
+    a = np.asarray(a)
+    b = np.asarray(b)
+    return a.shape == b.shape and bool(
+        np.array_equal(a, b, equal_nan=True))

@@ -39,6 +39,16 @@ stay in sync):
   (serving/fleet.py), the trainer shrinks its window
   (service/trainer.py). Markers: :data:`OOM_MARKERS` /
   :data:`OOM_TYPES`.
+- ``DATA_CORRUPTION`` — the call RAN but produced wrong bits
+  (NaN-poisoned gradients, a canary parity mismatch, diverged gang
+  digests — the :mod:`.integrity` exception family). NOT transient:
+  retrying the identical call re-produces the identical corruption, so
+  :func:`retry_call` propagates immediately and the call site must
+  RECOVER — the continual trainer rolls back to the newest CRC-valid
+  checkpoint (service/trainer.py), the serving tier quarantines the
+  afflicted route and repairs the pack (serving/fleet.py), the gang
+  supervisor relaunches from the manifest (robustness/gang.py).
+  Markers: :data:`CORRUPTION_MARKERS`.
 - ``FATAL`` — everything else (a code bug): propagates immediately,
   never retried, never adapted around.
 
@@ -102,6 +112,14 @@ OOM_TYPES = (
     "MemoryError",
 )
 
+# Substrings marking DATA_CORRUPTION: the call ran and returned wrong
+# bits (ISSUE 19). Every integrity.IntegrityError message carries the
+# marker, so classification works across process boundaries (a child
+# trainer's corruption surfaces to its supervisor as text).
+CORRUPTION_MARKERS = (
+    "DATA_CORRUPTION",
+)
+
 # The classifier table, machine-readable: class name -> one-line
 # contract. tests/test_robustness.py asserts every class here appears
 # in the module docstring (the drift check of the ISSUE 17 satellite).
@@ -109,6 +127,7 @@ ERROR_CLASSES = {
     "TRANSIENT": "device/network flake — retry the same call",
     "DEADLINE": "liveness budget expired — retry with a fresh slot",
     "RESOURCE_EXHAUSTED": "allocation failed — adapt, never retry",
+    "DATA_CORRUPTION": "wrong bits produced — roll back, never retry",
     "FATAL": "code bug — propagate immediately",
 }
 
@@ -126,6 +145,17 @@ def is_oom_error(exc: BaseException) -> bool:
     return any(m.upper() in upper for m in OOM_MARKERS)
 
 
+def is_corruption_error(exc: BaseException) -> bool:
+    """True when ``exc`` is DATA_CORRUPTION-classified: the call ran
+    but produced wrong bits, so retrying it re-produces the identical
+    corruption. Callers roll back / quarantine / relaunch instead
+    (integrity.py is the exception family; matching is on the message
+    marker so child-process corruption classifies identically)."""
+    text = f"{type(exc).__name__}: {exc}"
+    upper = text.upper()
+    return any(m.upper() in upper for m in CORRUPTION_MARKERS)
+
+
 def is_transient_error(exc: BaseException) -> bool:
     """True when ``exc`` looks like a device/network failure that a
     later attempt may survive (UNAVAILABLE / DEADLINE_EXCEEDED /
@@ -135,13 +165,15 @@ def is_transient_error(exc: BaseException) -> bool:
     runtime dresses it in otherwise-transient text: retrying the same
     allocation burns the whole budget on attempts that cannot succeed
     (ISSUE 17) — :func:`retry_call` propagates it so the dispatch
-    layer can adapt.
+    layer can adapt. DATA_CORRUPTION is NOT transient for the same
+    reason (ISSUE 19): the retried call would re-produce the same
+    wrong bits; the caller must roll back or repair instead.
 
     jaxlib's XlaRuntimeError carries the gRPC status name in its
     message, so string matching is the stable contract across jaxlib
     versions (the exception classes themselves moved modules twice).
     """
-    if is_oom_error(exc):
+    if is_oom_error(exc) or is_corruption_error(exc):
         return False
     for t in type(exc).__mro__:
         if t.__name__ in TRANSIENT_TYPES:
@@ -154,11 +186,13 @@ def is_transient_error(exc: BaseException) -> bool:
 def classify_error(exc: BaseException) -> str:
     """Classify ``exc`` into one of :data:`ERROR_CLASSES`.
 
-    Precedence: RESOURCE_EXHAUSTED beats DEADLINE beats TRANSIENT
-    (an OOM whose message also mentions a timeout is still an OOM);
-    anything unrecognized is FATAL."""
+    Precedence: RESOURCE_EXHAUSTED beats DATA_CORRUPTION beats
+    DEADLINE beats TRANSIENT (an OOM whose message also mentions a
+    timeout is still an OOM); anything unrecognized is FATAL."""
     if is_oom_error(exc):
         return "RESOURCE_EXHAUSTED"
+    if is_corruption_error(exc):
+        return "DATA_CORRUPTION"
     if not is_transient_error(exc):
         return "FATAL"
     for t in type(exc).__mro__:

@@ -7,7 +7,16 @@ in-process Python threads calling a method:
 
 - **routes**: ``POST /v1/predict`` (solo server), ``POST
   /v1/tenants/<name>/predict`` (fleet), ``GET /healthz``, ``GET
-  /v1/stats``.
+  /readyz``, ``GET /v1/stats``.
+- **liveness vs readiness** (ISSUE 19): ``/healthz`` answers "is the
+  process alive and able to speak HTTP" — it stays 200 even while the
+  serving tier is degraded to the host walk, because restarting a live
+  process never fixes degradation. ``/readyz`` answers "should a load
+  balancer route fresh traffic here" and goes **503** the moment the
+  tier is degraded OR any tenant route is quarantined by the integrity
+  probe (serving/fleet.py) — correctness is preserved either way (host
+  walk), but capacity is reduced, and the balancer should prefer a
+  clean replica while repair runs.
 - **bodies**: ``application/json`` (``{"rows": [[...], ...]}``) or raw
   ``application/x-npy`` (an ``np.save`` payload — bit-exact f64 on the
   wire; the response mirrors the request format).
@@ -203,6 +212,24 @@ class _Handler(BaseHTTPRequestHandler):
                         "uptime_sec": round(time.time() - door.t_started,
                                             1)}
                 self._send_body(200 if status != "closed" else 503,
+                                json.dumps(body).encode(),
+                                "application/json")
+                return
+            if self.path == "/readyz":
+                gw = door.gateway
+                closed = bool(getattr(gw, "closed", False))
+                st = {} if closed else gw.stats()
+                quarantined = sorted(st.get("quarantined") or [])
+                degraded = bool(st.get("degraded"))
+                ready = not (closed or degraded or quarantined)
+                body = {"ready": ready,
+                        "status": ("closed" if closed else
+                                   "degraded" if degraded else
+                                   "quarantined" if quarantined
+                                   else "ok")}
+                if quarantined:
+                    body["quarantined"] = quarantined
+                self._send_body(200 if ready else 503,
                                 json.dumps(body).encode(),
                                 "application/json")
                 return

@@ -104,7 +104,7 @@ def run_resident_trainer(spec: TrainerSpec,
     from ..robustness import checkpoint as ckpt
     from ..robustness import faults
     from ..robustness import heartbeat
-    from ..robustness.retry import is_oom_error
+    from ..robustness.retry import is_corruption_error, is_oom_error
 
     heartbeat.install_from_env()
     heartbeat.beat("boot", 0)
@@ -120,6 +120,18 @@ def run_resident_trainer(spec: TrainerSpec,
     win_floor = max(1, min(int(spec.window_floor_rows), win_rows))
     ok_cycles = 0
     shrink_warned = False
+    # numeric-health rollback (ISSUE 19): consecutive cycles refused as
+    # DATA_CORRUPTION — one refusal retries the SAME window against the
+    # rolled-back model (a transient poisoning replays clean and
+    # bit-identical); a second in a row condemns the window itself and
+    # training resumes PAST it on fresh stream rows
+    corrupt_cycles = 0
+    # the resident trainer always trains under the numeric-health guard
+    # unless the operator explicitly disabled it: a long-lived
+    # unattended loop must refuse poisoned iterations instead of
+    # committing them to the publish channel
+    params = dict(spec.params)
+    params.setdefault("tpu_integrity_numeric_guard", True)
 
     found = ckpt.latest_valid_checkpoint(spec.ckpt_dir)
     if found is not None:
@@ -137,6 +149,11 @@ def run_resident_trainer(spec: TrainerSpec,
         # the watermark is monitoring, not accounting).
         offset = int(svc.get("stream_offset", 0))
         rows_seen = int(svc.get("watermark_rows", 0))
+        # the poison-row count survives relaunch: a relaunched trainer
+        # must not report skipped_rows=0 while the .deadletter sidecar
+        # holds quarantined lines (the tail re-read may re-skip a few —
+        # monitoring, not accounting, same as rows_seen)
+        follower.rows_skipped = int(svc.get("skipped_rows", 0))
         if offset > 0 and rows_seen > 0:
             bytes_per_row = max(offset // rows_seen, 1)
             rewind = min(offset,
@@ -171,18 +188,25 @@ def run_resident_trainer(spec: TrainerSpec,
             # so catch-up reads as alive, never as a stall
             heartbeat.beat("ingest", int(follower.rows_seen))
 
+    def wait_for_window() -> bool:
+        """Block until the rolling window holds ``min_rows`` (False =
+        stop requested). Used for the first window AND to refill after
+        a condemned-window rollback drops the poisoned rows."""
+        while True:
+            drain()
+            if window is not None and len(window) >= spec.min_rows:
+                return True
+            if stop is not None and stop.is_set():
+                return False
+            heartbeat.beat("waiting_for_rows",
+                           0 if window is None else len(window))
+            time.sleep(spec.poll_sec)
+
     # first window: wait for min_rows (resume re-reads the stream tail —
     # the window itself is deliberately NOT checkpointed; fresh rows are
     # strictly better training data than the dead trainer's snapshot)
-    while True:
-        drain()
-        if window is not None and len(window) >= spec.min_rows:
-            break
-        if stop is not None and stop.is_set():
-            return 0
-        heartbeat.beat("waiting_for_rows",
-                       0 if window is None else len(window))
-        time.sleep(spec.poll_sec)
+    if not wait_for_window():
+        return 0
 
     def commit(booster) -> None:
         state = ckpt.booster_state(booster, iteration)
@@ -194,7 +218,11 @@ def run_resident_trainer(spec: TrainerSpec,
             "window_rows_target": int(win_rows),
             "skipped_rows": int(follower.rows_skipped),
         }
-        ckpt.write_checkpoint(spec.ckpt_dir, state)
+        # keep_last rides into the writer for the ENOSPC survival path
+        # (ISSUE 19): a full disk prunes beyond the retention floor and
+        # retries the write ONCE before giving up
+        ckpt.write_checkpoint(spec.ckpt_dir, state,
+                              keep_last=spec.keep_last)
         ckpt.prune_checkpoints(spec.ckpt_dir, spec.keep_last)
 
     last_commit = iteration
@@ -216,9 +244,38 @@ def run_resident_trainer(spec: TrainerSpec,
             ds = lgb.Dataset(X, label=y)
             init = lgb.Booster(model_str=model_str) \
                 if model_str is not None else None
-            booster = lgb.train(dict(spec.params), ds,
+            booster = lgb.train(dict(params), ds,
                                 num_boost_round=k, init_model=init)
         except BaseException as e:  # noqa: BLE001 — classifier decides
+            if is_corruption_error(e):
+                # numeric-health rollback (ISSUE 19): the cycle was
+                # refused as DATA_CORRUPTION (NaN gradients, poisoned
+                # leaves, a loss spike). Roll back to the newest CRC-
+                # valid checkpoint — the publish channel never saw the
+                # poisoned trees — and retry; a second consecutive
+                # refusal condemns the window and resumes past it.
+                corrupt_cycles += 1
+                found = ckpt.latest_valid_checkpoint(spec.ckpt_dir)
+                if found is not None:
+                    model_str = found[1]["model"]
+                    iteration = int(found[1]["iteration"])
+                else:
+                    model_str, iteration = None, 0
+                last_commit = iteration
+                log.warning(
+                    f"resident trainer cycle refused as corrupt ({e}); "
+                    "rolled back to the newest CRC-valid checkpoint "
+                    f"(iteration {iteration})")
+                if corrupt_cycles >= 2:
+                    log.warning(
+                        "second consecutive corrupt cycle: condemning "
+                        f"the {len(window)}-row rolling window and "
+                        "resuming past it on fresh stream rows")
+                    window = None
+                    corrupt_cycles = 0
+                    if not wait_for_window():
+                        return 0
+                continue
             # window auto-shrink (ISSUE 17): an OOM'd re-bin/train
             # cycle halves the rolling window down to the floor and
             # keeps publishing — freshness regression, never a crash
@@ -243,6 +300,7 @@ def run_resident_trainer(spec: TrainerSpec,
             continue
         iteration = booster.current_iteration()
         model_str = booster.model_to_string()
+        corrupt_cycles = 0
         if win_rows < spec.window_rows:
             # pressure-clear recovery: grow the window back after a
             # few consecutive clean cycles

@@ -71,9 +71,10 @@ from .server import (DegradeControl, Generation, finish_scores,
                      host_walk_scores)
 from ..ops import forest
 from ..ops.forest import TenantShape
-from ..robustness import faults
+from ..robustness import faults, integrity
 from ..robustness.retry import (RetryError, RetryPolicy, SERVING_POLICY,
-                                is_oom_error, retry_call)
+                                is_corruption_error, is_oom_error,
+                                retry_call)
 from ..utils import log
 
 
@@ -104,7 +105,12 @@ class _Bucket(NamedTuple):
     the device pack was dropped to fit the HBM budget, but ``host`` —
     the exact numpy mega-pack the routes were built against — is
     retained, so the lazy rebuild is one upload, no trace, bit-exact,
-    generations preserved."""
+    generations preserved. ``host_crc`` is the pack-time CRC32
+    fingerprint of ``host`` (ISSUE 19): re-verified before every
+    re-upload, it distinguishes HOST-side corruption (the retained
+    bytes rotted — rebuild from the tenants' cached windows) from
+    DEVICE-side corruption (the resident copy rotted — the CRC-clean
+    host pack is a valid repair source)."""
     key: TenantShape
     dev: object               # device pytree, or None when evicted
     members: Tuple[str, ...]  # tenant names, slot order
@@ -112,6 +118,7 @@ class _Bucket(NamedTuple):
     nbytes: int
     device: object            # owner device or None
     host: object              # numpy pytree — the rebuild source
+    host_crc: int             # pack-time CRC32 fingerprint of ``host``
 
 
 class _FleetState(NamedTuple):
@@ -123,6 +130,15 @@ class _FleetState(NamedTuple):
     buckets: Dict[TenantShape, _Bucket]
     routes: Dict[str, TenantRoute]
     shard: str                # resolved "replicate" | "model"
+
+
+class _CanaryReq(NamedTuple):
+    """Minimal ``PendingRequest`` stand-in for canary replays through
+    ``_group_scores`` (ISSUE 19) — integrity probes never enter the
+    batcher, they replay the pure dispatch math directly."""
+    n: int
+    X: np.ndarray
+    tenant: str
 
 
 class _Tenant:
@@ -281,6 +297,25 @@ class FleetServer:
                                     "tpu_serving_max_queue_rows",
                                     1_048_576)),
             counters=self.counters)
+        # integrity defense (ISSUE 19): silent-corruption canary parity
+        # probes. 0 = disarmed — no probe thread, no per-publish canary
+        # replay, zero behavior change. Goldens are DEVICE replays of a
+        # fixed canary batch per (tenant, generation), anchored at
+        # publish against the bit-identical host walk; the probe
+        # bit-compares fresh replays against them and quarantines ONLY
+        # the afflicted tenants to the host-walk route until repaired.
+        self._integrity_interval = float(knob(
+            None, "tpu_integrity_probe_interval_s", 0.0))
+        self._canary_rows = int(knob(None, "tpu_integrity_canary_rows",
+                                     16))
+        self._goldens: Dict[str, tuple] = {}  # name->(version, X, golden)
+        self._quarantined: frozenset = frozenset()  # GIL-atomic swaps
+        self._qlock = threading.Lock()
+        self._iprobe = None
+        if self._integrity_interval > 0:
+            self._iprobe = integrity.IntegrityProbe(
+                self._integrity_check, self._integrity_interval,
+                what="fleet serving")
 
     # ---- tenant lifecycle -------------------------------------------
     def add_tenant(self, name: str, booster,
@@ -343,6 +378,10 @@ class FleetServer:
             if t is None:
                 return
             self.counters.drop_tenant(name)
+            self._goldens.pop(name, None)
+            with self._qlock:
+                if name in self._quarantined:
+                    self._quarantined = self._quarantined - {name}
             routes = dict(self._state.routes)
             routes.pop(name, None)
             buckets = dict(self._state.buckets)
@@ -374,6 +413,7 @@ class FleetServer:
             return self._publish_locked(t)
 
     def _publish_locked(self, t: _Tenant) -> Generation:
+        prev = self._state
         try:
             models, gen, mappers, used_map = t.engine.serving_state()
             if not models:
@@ -435,6 +475,16 @@ class FleetServer:
                     buckets[key] = self._build_bucket(
                         key, members, self._state.shard, routes)
             self._swap_state(buckets, routes, keep=affected)
+            if self._integrity_interval > 0:
+                try:
+                    self._record_golden(t.name)
+                except BaseException:
+                    # unpublish: never serve a generation whose canary
+                    # could not be anchored (fleet states are immutable,
+                    # so restoring the previous reference is atomic and
+                    # in-flight dispatches are unaffected)
+                    self._state = prev
+                    raise
         except BaseException as e:  # noqa: BLE001 — rollback + re-raise
             self.counters.inc("publish_failures", tenant=t.name)
             served = self._state.routes.get(t.name)
@@ -470,6 +520,16 @@ class FleetServer:
             zero = _np_map(np.zeros_like, wins[0])
             wins = wins + [zero] * (slot_cap - len(members))
         host = _np_map(lambda *xs: np.concatenate(xs), *wins)
+        host_crc = integrity.crc32_fingerprint(host)
+        if faults.check("bitflip", where="host"):
+            # host-side silent corruption (ISSUE 19): rot the retained
+            # mega-pack AFTER its CRC fingerprint was recorded — the
+            # re-upload path must catch it by CRC and refuse to treat
+            # these bytes as a rebuild source
+            host = integrity.corrupt_pack(host)
+            log.warning("fault injection: bit-flipped the assembled "
+                        "host mega-pack after its CRC fingerprint was "
+                        "recorded (host-side silent corruption)")
         nbytes = forest.pytree_nbytes(host)
         dev = forest.upload_window(host)   # the pack-upload oom site
         device = None
@@ -481,7 +541,8 @@ class FleetServer:
             dev = mesh_mod.replicate(dev, self.mesh)
         for slot, m in enumerate(members):
             routes[m] = routes[m]._replace(lo=slot * key.win_slots)
-        return _Bucket(key, dev, members, slot_cap, nbytes, device, host)
+        return _Bucket(key, dev, members, slot_cap, nbytes, device, host,
+                       host_crc)
 
     def _owner_for(self, key: TenantShape, nbytes: int):
         """Model-shard owner of one bucket: keep the current owner when
@@ -577,7 +638,18 @@ class FleetServer:
 
     def _upload_pack(self, b: _Bucket):
         """Upload one bucket's retained host pack (forest.upload_window
-        — the oom consult point) and place it per the bucket's mode."""
+        — the oom + ``bitflip where=dev`` consult point) and place it
+        per the bucket's mode. The host bytes are CRC-verified against
+        the pack-time fingerprint first (ISSUE 19): a mismatch means
+        the RETAINED HOST pack rotted — it is not a valid rebuild
+        source, and the caller must re-assemble the bucket from the
+        tenants' cached windows instead."""
+        crc = integrity.crc32_fingerprint(b.host)
+        if crc != b.host_crc:
+            raise integrity.IntegrityError(
+                f"host mega-pack CRC mismatch for bucket {b.members}: "
+                f"recorded {b.host_crc:#010x}, recomputed {crc:#010x} — "
+                "host-side corruption of the retained rebuild source")
         dev = forest.upload_window(b.host)
         if b.device is not None:
             return mesh_mod.place_on(dev, b.device)
@@ -606,13 +678,48 @@ class FleetServer:
                 buckets = self._enforce_budget(
                     buckets, keep={key}, incoming=b.nbytes)
             try:
-                dev = self._upload_pack(b)
+                nb = b._replace(dev=self._upload_pack(b))
             except BaseException as e:  # noqa: BLE001 — classify
-                if not is_oom_error(e) or not self._evict_coldest(
+                if isinstance(e, integrity.IntegrityError):
+                    # the retained host mega-pack no longer matches its
+                    # pack-time CRC (ISSUE 19): host-side corruption —
+                    # those bytes are not a rebuild source. Re-assemble
+                    # the bucket from the tenants' cached windows.
+                    self.counters.inc("integrity_mismatches")
+                    log.warning(
+                        f"fleet lazy rebuild refused: {e}; "
+                        f"re-assembling bucket {b.members} from the "
+                        "tenants' cached windows")
+                    nb = self._build_bucket(key, b.members, cur.shard,
+                                            dict(cur.routes),
+                                            owner=b.device)
+                elif not is_oom_error(e) or not self._evict_coldest(
                         buckets, exclude={key}):
                     raise
-                dev = self._upload_pack(b)
-            nb = b._replace(dev=dev)
+                else:
+                    nb = b._replace(dev=self._upload_pack(b))
+            if self._integrity_interval > 0:
+                # conlint: disable=CL002 — deliberate: the candidate
+                # pack must be canary-verified atomically with its
+                # installation into the live state (a 16-row replay,
+                # bounded); dropping the lock would race a publish
+                bad = self._verify_pack(cur.routes, nb,
+                                        skip=self._quarantined)
+                if bad:
+                    # never install corrupt bits: the afflicted tenants
+                    # are quarantined to the host walk, the bucket
+                    # stays evicted, and the probe repairs it
+                    for m in bad:
+                        self.counters.inc("integrity_mismatches",
+                                          tenant=m)
+                        self._quarantine(
+                            m, "lazily rebuilt pack failed canary "
+                               "parity before install")
+                    raise integrity.CanaryMismatch(
+                        f"rebuilt mega-pack for bucket members "
+                        f"{nb.members} failed canary parity for "
+                        f"{sorted(bad)} — refusing to install corrupt "
+                        "bits; the probe repairs and un-quarantines")
             self.counters.inc("rebuilds")
             log.info(f"fleet pack rebuilt after eviction "
                      f"({b.nbytes / 1e6:.2f} MB, members {b.members})")
@@ -682,15 +789,35 @@ class FleetServer:
         non-transient error fails that GROUP only — never the rows
         other buckets coalesced alongside."""
         state = self._state            # single read: atomic pairing
+        q = self._quarantined           # single read: GIL-atomic
         outcomes: list = [None] * len(batch)
         groups: Dict[TenantShape, list] = {}
+        quarantined: list = []
         for i, r in enumerate(batch):
             route = state.routes.get(r.tenant)
             if route is None:
                 outcomes[i] = KeyError(
                     f"tenant {r.tenant!r} was removed before dispatch")
+            elif route.name in q:
+                quarantined.append((i, r, route))
             else:
                 groups.setdefault(route.key, []).append((i, r, route))
+        if quarantined:
+            # quarantined tenants (integrity defense, ISSUE 19): their
+            # rows take the bit-identical host walk until the probe
+            # repairs their pack; coalesced peers stay on the device.
+            # Ledger semantics match the degraded-group accounting
+            # below: one global increment per dispatch that carried
+            # quarantined rows, one per tenant present
+            self.counters.inc("degraded_batches")
+            for t in {r.tenant for _i, r, _route in quarantined}:
+                self.counters.inc_tenant(t, "degraded_batches")
+            for i, r, route in quarantined:
+                try:
+                    outcomes[i] = self._finish(
+                        self._host_scores(route, r.X), route)
+                except BaseException as e:  # noqa: BLE001 — per-request
+                    outcomes[i] = e
         for key in groups:
             # LRU signal for the eviction pass (dispatcher thread only)
             self._touch_seq += 1
@@ -751,6 +878,22 @@ class FleetServer:
         except RetryError:
             raise
         except BaseException as e:  # noqa: BLE001 — classifier decides
+            if is_corruption_error(e):
+                # a rebuilt pack failed canary parity (ISSUE 19): the
+                # afflicted tenants are already quarantined and the
+                # corrupt pack was NOT installed — answer THIS group by
+                # the bit-identical host walk so no wrong bits ever
+                # leave the server; the probe repairs in the background
+                log.warning(
+                    f"fleet dispatch refused a corrupt pack ({e}); "
+                    f"host-walking {len(items)} coalesced request(s) "
+                    "this once")
+                self.counters.inc("degraded_batches")
+                for t in {r.tenant for _i, r, _route in items}:
+                    self.counters.inc_tenant(t, "degraded_batches")
+                return np.concatenate(
+                    [self._host_scores(route, r.X)
+                     for _i, r, route in items], axis=0)
             if not is_oom_error(e):
                 raise
             if len(items) > 1:
@@ -785,6 +928,15 @@ class FleetServer:
         bucket = state.buckets[key]
         if bucket.dev is None:
             bucket = self._ensure_resident(state, key)
+        return self._group_scores(bucket, items)
+
+    def _group_scores(self, bucket: _Bucket, items) -> np.ndarray:
+        """The PURE device dispatch math for one resident bucket group
+        — no fault consults, no residency management. Shared by client
+        dispatch (``_bucket_scores``) and the integrity canary replays
+        (``_replay_route``), so a background probe can never burn a
+        counted fault plan armed for client traffic."""
+        key = bucket.key
         total = sum(r.n for _i, r, _route in items)
         rows = forest.bucket_rows(total) if self.bucket else total
         lo = np.zeros(rows, np.int32)
@@ -815,14 +967,15 @@ class FleetServer:
             nl_d = mesh_mod.shard_rows(nl_d, 0, self.mesh)
             op_d = mesh_mod.shard_rows(
                 op_d, 1 if key.kind == "binned" else 0, self.mesh)
-        if key.kind == "binned":
-            out = forest._fleet_scores_binned(
-                key.steps, key.k, key.win_slots, bucket.dev, lo_d, nl_d,
-                op_d)
-        else:
-            out = forest._fleet_scores_raw(
-                key.steps, key.k, key.win_slots, bucket.dev, lo_d, nl_d,
-                op_d)
+        run = (forest._fleet_scores_binned if key.kind == "binned"
+               else forest._fleet_scores_raw)
+        # a bucket placed on one owner device compiles a single-device
+        # program — only the row-sharded (replicated-pack) path launches
+        # mesh collectives and needs the process-global launch lock
+        out = mesh_mod.locked_launch(
+            self.mesh if bucket.device is None else None, run,
+            key.steps, key.k, key.win_slots, bucket.dev, lo_d, nl_d,
+            op_d)
         # pad slice on the HOST (an on-device slice would retrace per r)
         return np.asarray(out, np.float64).T[:total]
 
@@ -851,6 +1004,209 @@ class FleetServer:
         faults.maybe_fail("dispatch_error")
         mesh_mod.probe(self.mesh)
 
+    # ---- integrity defense (ISSUE 19) --------------------------------
+    def evict(self, tenant: str) -> bool:
+        """Operator / chaos-drill API: drop ``tenant``'s bucket from
+        the device (host pack retained — the next touch lazily rebuilds
+        it bit-exactly, ISSUE 17 semantics). Integrity drills pair this
+        with an armed ``bitflip`` fault so the rebuild upload rots
+        deterministically. Returns True when a resident pack was
+        evicted."""
+        with self._publish_lock:
+            cur = self._state
+            route = cur.routes.get(tenant)
+            b = None if route is None else cur.buckets.get(route.key)
+            if b is None or b.dev is None:
+                return False
+            buckets = dict(cur.buckets)
+            buckets[route.key] = b._replace(dev=None)
+            self.counters.inc("evictions")
+            log.warning(f"fleet pack force-evicted (operator drill) for "
+                        f"tenant {tenant!r}: members {b.members}")
+            self._state = _FleetState(buckets, cur.routes, cur.shard)
+            return True
+
+    def _replay_route(self, bucket: _Bucket, route: TenantRoute,
+                      Xc: np.ndarray) -> np.ndarray:
+        """[rows, k] f64 canary scores for one tenant through one
+        resident pack — the PURE dispatch math (``_group_scores``),
+        consulting NO fault sites: a background probe must never burn
+        a counted fault plan armed for client traffic."""
+        req = _CanaryReq(int(Xc.shape[0]), Xc, route.name)
+        return self._group_scores(bucket, [(0, req, route)])
+
+    def _record_golden(self, name: str) -> None:
+        """Record tenant ``name``'s canary golden for the generation
+        just published: a DEVICE replay through its live bucket (the
+        bit-deterministic probe baseline — same program, same input,
+        same pack bits give identical output), ANCHORED against the
+        bit-identical host walk within f32-accumulation tolerance. A
+        pack corrupted before this point disagrees with the anchor by
+        orders of magnitude and the publish is refused (the caller
+        unpublishes). Caller holds the publish lock."""
+        state = self._state
+        route = state.routes[name]
+        b = state.buckets.get(route.key)
+        if b is None or b.dev is None:
+            self._goldens.pop(name, None)   # nothing resident to attest
+            return
+        Xc = integrity.canary_batch(route.n_features,
+                                    rows=self._canary_rows)
+        golden = self._replay_route(b, route, Xc)
+        anchor = self._host_scores(route, Xc)
+        if not np.allclose(golden, anchor, rtol=1e-5, atol=1e-6):
+            self.counters.inc("integrity_mismatches", tenant=name)
+            raise integrity.CanaryMismatch(
+                f"tenant {name!r} publish canary replay disagrees with "
+                "the host-walk anchor — the freshly built pack is "
+                "corrupt; refusing to publish it")
+        self._goldens[name] = (route.generation.version, Xc, golden)
+
+    def _verify_pack(self, routes: Dict[str, TenantRoute], b: _Bucket,
+                     skip=frozenset()) -> list:
+        """Replay every member's current-generation canary against one
+        CANDIDATE resident pack; returns the members whose replay is
+        not bit-identical to their golden ([] = bit-clean). Members in
+        ``skip`` (already quarantined) and members without a
+        current-generation golden are not replayed."""
+        bad = []
+        for m in b.members:
+            if m in skip:
+                continue
+            route = routes.get(m)
+            g = self._goldens.get(m)
+            if route is None or g is None or \
+                    g[0] != route.generation.version:
+                continue
+            if not integrity.parity_equal(
+                    self._replay_route(b, route, g[1]), g[2]):
+                bad.append(m)
+        return bad
+
+    def _quarantine(self, name: str, reason: str) -> None:
+        """Route ONLY tenant ``name`` to the bit-identical host walk;
+        its coalesced peers stay on the device. Idempotent — a tenant
+        already quarantined is not re-counted."""
+        with self._qlock:
+            if name in self._quarantined:
+                return
+            self._quarantined = self._quarantined | {name}
+        self.counters.inc("quarantines", tenant=name)
+        log.warning(
+            "=" * 60 + f"\nFLEET TENANT QUARANTINED: {name!r}: {reason}\n"
+            "serving this tenant by the host walk (bit-identical to "
+            "Booster.predict); peers stay on the device route. The\n"
+            "integrity probe repairs the pack and un-quarantines on "
+            "clean canary parity.\n" + "=" * 60)
+
+    def _unquarantine(self, name: str) -> None:
+        with self._qlock:
+            if name not in self._quarantined:
+                return
+            self._quarantined = self._quarantined - {name}
+        self.counters.inc("repairs", tenant=name)
+        log.warning(f"fleet tenant {name!r} un-quarantined: the "
+                    "repaired pack replayed its canary bit-clean — "
+                    "back on the device route")
+
+    def _repair_bucket(self, key: TenantShape) -> None:
+        """Repair one bucket's device pack under the publish lock:
+        re-upload the retained host mega-pack when its CRC still
+        matches (device-side corruption), else a full rebuild from the
+        tenants' cached windows (host-side corruption). The candidate
+        is canary-verified BEFORE install — a still-corrupt pack is
+        never installed and its afflicted members stay quarantined."""
+        with self._publish_lock:
+            cur = self._state
+            b = cur.buckets.get(key)
+            if b is None:
+                return
+            routes = dict(cur.routes)
+            try:
+                try:
+                    nb = b._replace(dev=self._upload_pack(b))
+                    how = "re-upload of the CRC-verified host pack"
+                except integrity.IntegrityError:
+                    nb = self._build_bucket(key, b.members, cur.shard,
+                                            routes, owner=b.device)
+                    how = ("full rebuild from the tenants' cached "
+                           "windows (host pack failed its CRC)")
+            except BaseException as e:  # noqa: BLE001 — stay quarantined
+                log.warning(
+                    f"fleet integrity repair failed for bucket "
+                    f"{b.members} ({e!r}); quarantined members stay on "
+                    "the host walk until the next probe cycle")
+                return
+            # conlint: disable=CL002 — deliberate: verify-before-
+            # install must be atomic with the state swap (16-row
+            # canary replay, bounded hold)
+            bad = self._verify_pack(cur.routes, nb)
+            if bad:
+                for m in bad:
+                    self._quarantine(m, "repaired pack STILL fails "
+                                        "canary parity")
+                log.warning(
+                    f"fleet integrity repair produced a pack that still "
+                    f"fails canary parity for {sorted(bad)} — not "
+                    "installing it")
+                return
+            buckets = dict(cur.buckets)
+            buckets[key] = nb
+            self._state = _FleetState(buckets, routes, cur.shard)
+            log.warning(f"fleet integrity repair: bucket {nb.members} "
+                        f"repaired by {how}")
+
+    def _try_unquarantine(self, key: TenantShape) -> None:
+        """Un-quarantine every quarantined member of ``key``'s bucket
+        whose canary replays bit-clean through the CURRENT resident
+        pack (counts one ``repairs`` per tenant restored)."""
+        state = self._state
+        b = state.buckets.get(key)
+        if b is None or b.dev is None:
+            return
+        for m in b.members:
+            if m not in self._quarantined:
+                continue
+            route = state.routes.get(m)
+            g = self._goldens.get(m)
+            if route is None or g is None or \
+                    g[0] != route.generation.version:
+                continue
+            if integrity.parity_equal(
+                    self._replay_route(b, route, g[1]), g[2]):
+                self._unquarantine(m)
+
+    def _integrity_check(self) -> None:
+        """One background canary parity cycle over the whole fleet:
+        replay every resident bucket member's canary against its
+        publish-time golden; on mismatch quarantine ONLY the afflicted
+        tenants, repair the pack and un-quarantine each tenant once its
+        repaired pack replays bit-clean. Buckets that are evicted AND
+        healthy are skipped — no device bits to rot, and probing must
+        not defeat the HBM-budget eviction."""
+        if self._closed or self._degrade.degraded:
+            return
+        state = self._state
+        if not state.buckets:
+            return
+        self.counters.inc("integrity_probes")
+        for key in list(state.buckets):
+            b = state.buckets.get(key)
+            if b is None:
+                continue
+            qmembers = [m for m in b.members if m in self._quarantined]
+            bad = []
+            if b.dev is not None:
+                bad = self._verify_pack(state.routes, b,
+                                        skip=self._quarantined)
+                for m in bad:
+                    self.counters.inc("integrity_mismatches", tenant=m)
+                    self._quarantine(
+                        m, "resident pack failed canary parity")
+            if bad or qmembers:
+                self._repair_bucket(key)
+                self._try_unquarantine(key)
+
     def stats(self) -> dict:
         s = self._batcher.stats()
         state = self._state
@@ -870,6 +1226,10 @@ class FleetServer:
         s["degraded"] = self._degrade.degraded
         if s["degraded"] and self._degrade.reason is not None:
             s["degraded_reason"] = self._degrade.reason
+        if self._integrity_interval > 0:
+            s["integrity_probe_interval_s"] = self._integrity_interval
+        if self._quarantined:
+            s["quarantined"] = sorted(self._quarantined)
         return s
 
     def tenant_stats(self, name: str) -> dict:
@@ -886,6 +1246,7 @@ class FleetServer:
             s["deadline_ms"] = t.deadline_ms
             s["quota_rows"] = t.quota_rows
         s["degraded"] = self._degrade.degraded
+        s["quarantined"] = name in self._quarantined
         return s
 
     @property
@@ -900,6 +1261,8 @@ class FleetServer:
         """Drain-and-stop the whole fleet (same contract as
         ``ModelServer.close``)."""
         self._closed = True
+        if self._iprobe is not None:
+            self._iprobe.close()
         self._degrade.close()
         self._batcher.close(timeout)
 

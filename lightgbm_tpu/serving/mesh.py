@@ -5,7 +5,10 @@ The multi-device serving layout (ISSUE 8; idiom: SNIPPETS.md [2]
 is REPLICATED across every mesh device; the per-request operand (the
 binned [F, R] matrix or the raw [R, C] matrix) is sharded along its rows
 axis so each device traverses its slice of the batch — pure data
-parallelism, no collectives (the per-row outputs are independent).
+parallelism (the per-row outputs are independent), though XLA still
+gathers the sharded output through a cross-device rendezvous, so
+concurrent multi-device launches from different threads must be
+serialized (``locked_launch``).
 
 Naive-sharding rule: shard the rows axis when the (bucketed) row count
 divides evenly by the mesh size, else replicate. Bucketed shapes
@@ -15,6 +18,7 @@ fallback only triggers for odd mesh sizes.
 """
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 import jax
@@ -23,6 +27,27 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 SERVE_AXIS = "serve"
+
+# Serializes MULTI-DEVICE program launches process-wide (ISSUE 19).
+# XLA's sharded programs synchronize the mesh through rendezvous
+# points; two programs launched concurrently from different threads
+# (the batcher's dispatch vs. an integrity-probe canary replay or a
+# publish-time golden recording) can enqueue in opposite orders on
+# different devices and deadlock the rendezvous. One process-global
+# lock held through completion makes every mesh program an atomic
+# step. Single-device launches never take it.
+_LAUNCH_LOCK = threading.Lock()
+
+
+def locked_launch(mesh: Optional[Mesh], fn, *args, **kwargs):
+    """Run ONE compiled-program launch; when it targets a multi-device
+    mesh, hold the process-global launch lock until the program
+    completes (see ``_LAUNCH_LOCK``). Identity wrapper without a
+    mesh — the single-device path stays lock-free and async."""
+    if mesh is None:
+        return fn(*args, **kwargs)
+    with _LAUNCH_LOCK:
+        return jax.block_until_ready(fn(*args, **kwargs))
 
 
 def probe(mesh: Optional[Mesh]) -> int:

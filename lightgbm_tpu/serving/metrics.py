@@ -88,6 +88,18 @@ class ServingCounters:
     - ``rebuilds``: evicted packs lazily re-uploaded on next touch
       (bit-exact, one upload, no trace).
 
+    Integrity defense (ISSUE 19) adds the silent-corruption ledger:
+
+    - ``integrity_probes``: background canary parity probes completed
+      (one increment per probe CYCLE, not per route replayed).
+    - ``integrity_mismatches``: canary replays whose device scores
+      differed bit-wise from the host-walk golden, or host packs whose
+      CRC fingerprint failed verification — wrong bits DETECTED.
+    - ``quarantines``: routes/tenants flipped to the bit-identical
+      host walk because of a detected mismatch (per entry event).
+    - ``repairs``: quarantined routes restored to the device after a
+      successful repair (re-upload or rebuild) re-probed clean parity.
+
     Unknown names raise (a typo'd counter must fail loudly, not create
     a silent parallel ledger).
 
@@ -102,13 +114,17 @@ class ServingCounters:
     NAMES = ("expired", "shed", "dispatch_retries", "dispatch_failures",
              "degrade_events", "recoveries", "degraded_batches",
              "publish_failures", "shutdown_failed", "oom_bisects",
-             "evictions", "rebuilds")
+             "evictions", "rebuilds", "integrity_probes",
+             "integrity_mismatches", "quarantines", "repairs")
     # the per-tenant ledger: request/row volume plus every failure-path
     # event that is attributable to ONE tenant (retry/degrade/recovery
-    # events are fleet-wide device state, deliberately not per-tenant)
+    # events are fleet-wide device state, deliberately not per-tenant;
+    # integrity mismatch/quarantine/repair ARE per-tenant — the whole
+    # point of the canary is blaming exactly one route)
     TENANT_NAMES = ("requests", "rows", "expired", "shed",
                     "degraded_batches", "dispatch_failures",
-                    "publish_failures", "shutdown_failed")
+                    "publish_failures", "shutdown_failed",
+                    "integrity_mismatches", "quarantines", "repairs")
 
     def __init__(self):
         self._lock = threading.Lock()

@@ -57,7 +57,7 @@ from . import mesh as mesh_mod
 from .batcher import MicroBatcher, PendingRequest
 from .metrics import ServingCounters
 from ..ops import forest
-from ..robustness import faults
+from ..robustness import faults, integrity
 from ..robustness.retry import (RetryError, RetryPolicy, SERVING_POLICY,
                                 is_oom_error, retry_call)
 from ..utils import log
@@ -260,7 +260,29 @@ class ModelServer:
         self._publish_lock = threading.Lock()
         self._active = None  # (ForestSnapshot, Generation, models) — ONE ref
         self._version = 0
+        # silent-corruption canary (ISSUE 19): armed by
+        # tpu_integrity_probe_interval_s > 0. The golden is the
+        # publish-time device replay of a fixed canary batch (the
+        # device accumulates in f32, so the host f64 walk is the
+        # ANCHOR — allclose at record time — not the bit-compare
+        # reference); the background probe bit-compares later replays
+        # against it, and a mismatch quarantines the server to the
+        # host walk (solo quarantine == whole-server degrade; there is
+        # only one route) until a repair re-publish probes clean.
+        self._integrity_interval = float(knob(
+            None, "tpu_integrity_probe_interval_s", 0.0))
+        self._canary_rows = int(knob(None, "tpu_integrity_canary_rows",
+                                     16))
+        self._canary_X = integrity.canary_batch(self.n_features,
+                                                rows=self._canary_rows)
+        self._canary = None   # (golden [rows, K], version) — ONE ref
+        self._integrity_quarantined = False
         self.publish()
+        self._iprobe = None
+        if self._integrity_interval > 0:
+            self._iprobe = integrity.IntegrityProbe(
+                self._integrity_check, self._integrity_interval,
+                what="serving")
         self._batcher = MicroBatcher(
             self._dispatch,
             max_batch=int(knob(max_batch, "tpu_serving_max_batch", 4096)),
@@ -298,6 +320,26 @@ class ModelServer:
                 snap = self._srv.snapshot(
                     models, gen, 0, len(models), mappers, used_map,
                     place_window=lambda w: mesh_mod.replicate(w, self.mesh))
+                golden = None
+                if self._integrity_interval > 0:
+                    # record the canary golden from THIS snapshot and
+                    # anchor it against the host walk: a device replay
+                    # outside f32-accumulation tolerance of the host
+                    # truth means the pack corrupted at/under the
+                    # upload itself — fail the publish (the old clean
+                    # generation keeps serving) instead of recording a
+                    # poisoned golden
+                    golden = self._canary_replay(snap)
+                    anchor = host_walk_scores(models, self.k,
+                                              self._canary_X)
+                    if not np.allclose(golden, anchor, rtol=1e-5,
+                                       atol=1e-6):
+                        self.counters.inc("integrity_mismatches")
+                        raise integrity.CanaryMismatch(
+                            "publish canary replay disagrees with the "
+                            "host-walk anchor beyond f32 accumulation "
+                            "tolerance — the freshly placed pack is "
+                            "corrupt; refusing to publish it")
             except BaseException as e:  # noqa: BLE001 — rollback + re-raise
                 self.counters.inc("publish_failures")
                 if self._active is not None:
@@ -306,8 +348,25 @@ class ModelServer:
                         f"generation {self._active[1].version} — rolled "
                         "back, not torn")
                 raise
+            # in-residency rot injection (ISSUE 19): corrupt the PLACED
+            # window AFTER the golden is recorded — modeling bits that
+            # flip while the pack sits on the device, which is exactly
+            # what the canary probe exists to catch. (Corruption at the
+            # upload itself is the fleet's upload_window consult and
+            # the anchor check above.)
+            if faults.check("bitflip", where="dev"):
+                import jax
+                import jax.numpy as jnp
+                corrupt = integrity.corrupt_pack(
+                    jax.tree.map(np.asarray, snap.win))
+                snap = snap._replace(win=mesh_mod.replicate(
+                    jax.tree.map(jnp.asarray, corrupt), self.mesh))
+                log.warning("injected bitflip: published device pack "
+                            "corrupted (slot-0 leaf-output sign bits)")
             self._version += 1
             info = Generation(self._version, len(models), gen)
+            if golden is not None:
+                self._canary = (golden, self._version)  # GIL-atomic
             # the host model list rides along so the degraded host-walk
             # route serves the SAME frozen generation the snapshot does
             self._active = (snap, info, models)  # GIL-atomic ref swap
@@ -328,7 +387,9 @@ class ModelServer:
         place = None
         if self.mesh is not None:
             place = lambda a, ax: mesh_mod.shard_rows(a, ax, self.mesh)  # noqa: E731
-        out = forest.snapshot_scores(snap, X, place=place)   # [K, R]
+        out = mesh_mod.locked_launch(
+            self.mesh, forest.snapshot_scores, snap, X,
+            place=place)                                     # [K, R]
         return out.T                                         # [R, K]
 
     def _host_scores(self, models, X: np.ndarray) -> np.ndarray:
@@ -410,6 +471,64 @@ class ModelServer:
             return self._finish(self._host_scores(models, X), info)
         return self._finish(raw, info)
 
+    # ---- integrity (ISSUE 19) ---------------------------------------
+    def _canary_replay(self, snap) -> np.ndarray:
+        """[rows, K] device scores of the fixed canary batch against
+        ``snap`` — NO fault-site consults (the canary detects wrong
+        bits; availability faults belong to the retry/degrade path,
+        and a probe must never burn a counted fault plan armed for
+        client traffic). Rides the same row buckets as steady-state
+        traffic: zero new traces."""
+        place = None
+        if self.mesh is not None:
+            place = lambda a, ax: mesh_mod.shard_rows(a, ax, self.mesh)  # noqa: E731
+        return mesh_mod.locked_launch(
+            self.mesh, forest.snapshot_scores, snap, self._canary_X,
+            place=place).T
+
+    def _integrity_check(self) -> None:
+        """One canary probe cycle: replay against the live snapshot and
+        bit-compare with the publish-time golden. A mismatch means the
+        resident pack's bits CHANGED since publish — quarantine the
+        server to the bit-identical host walk (solo quarantine ==
+        degrade: there is only one route) and repair by re-publishing,
+        which re-places the pack from the engine's clean host state and
+        re-records the golden; the recovery probe un-quarantines only
+        after the repaired pack replays bit-clean."""
+        if self._closed or self._degrade.degraded:
+            return
+        active, canary = self._active, self._canary
+        if active is None or canary is None:
+            return
+        snap, info, _models = active
+        golden, version = canary
+        if info.version != version:
+            return     # raced a publish; next cycle sees the new golden
+        self.counters.inc("integrity_probes")
+        try:
+            got = self._canary_replay(snap)
+        except Exception as e:  # noqa: BLE001 — availability, not bits
+            log.debug(f"integrity probe replay failed: {e!r}")
+            return
+        if integrity.parity_equal(got, golden):
+            return
+        self.counters.inc("integrity_mismatches")
+        self.counters.inc("quarantines")
+        self._integrity_quarantined = True
+        self._degrade.enter(
+            f"canary parity mismatch on generation {info.version}: the "
+            "resident device pack no longer replays the publish-time "
+            "golden bits — silent corruption; serving the host walk "
+            "while the pack is re-published")
+        try:
+            self.publish()       # repair: re-place from host truth
+            log.warning("integrity repair: pack re-published after the "
+                        "canary mismatch; the recovery probe will "
+                        "un-quarantine on clean parity")
+        except Exception as e:  # noqa: BLE001 — stay quarantined
+            log.warning(f"integrity repair publish failed ({e!r}); "
+                        "still quarantined on the host walk")
+
     # ---- degradation -------------------------------------------------
     def degrade(self, reason: str = "forced") -> None:
         """Flip to the host-walk route now (chaos drills, operator
@@ -420,9 +539,27 @@ class ModelServer:
         """One recovery attempt: every serving-mesh device must answer.
         Consults the ``dispatch_error`` fault site so an injected
         persistent outage keeps the server degraded until the plan
-        disarms."""
+        disarms. With the integrity canary armed, un-degrading ALSO
+        requires the live snapshot to replay the golden bit-for-bit —
+        a quarantined server must never return to a still-corrupt
+        device route."""
         faults.maybe_fail("dispatch_error")
         mesh_mod.probe(self.mesh)
+        if self._integrity_interval <= 0:
+            return
+        active, canary = self._active, self._canary
+        if active is None or canary is None or \
+                active[1].version != canary[1]:
+            return
+        if not integrity.parity_equal(self._canary_replay(active[0]),
+                                      canary[0]):
+            raise integrity.CanaryMismatch(
+                "recovery probe: the device canary replay still "
+                "differs bit-wise from the golden — staying on the "
+                "host walk")
+        if self._integrity_quarantined:
+            self._integrity_quarantined = False
+            self.counters.inc("repairs")
 
     def submit(self, X,
                deadline_ms: Optional[float] = None) -> PendingRequest:
@@ -481,6 +618,10 @@ class ModelServer:
         s["degraded"] = self._degrade.degraded
         if s["degraded"] and self._degrade.reason is not None:
             s["degraded_reason"] = self._degrade.reason
+        if self._integrity_interval > 0:
+            s["integrity_probe_interval_s"] = self._integrity_interval
+            if self._integrity_quarantined:
+                s["integrity_quarantined"] = True
         return s
 
     @property
@@ -496,6 +637,8 @@ class ModelServer:
         Past ``timeout`` the drain contract fails still-pending futures
         with SHUTDOWN instead of abandoning them (batcher.close)."""
         self._closed = True
+        if self._iprobe is not None:
+            self._iprobe.close()    # before the drain: no probe replay
         self._degrade.close()       # before the drain: no new probe
         self._batcher.close(timeout)
 

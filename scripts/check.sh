@@ -225,6 +225,21 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
+echo "== integrity smoke (canary/rollback/digest refusal + 0-trace probe, CPU) =="
+# ISSUE 19: an injected device-pack bitflip is detected by the canary
+# parity verify, quarantines ONLY the afflicted tenant to the host walk
+# (0 wrong responses), is repaired and un-quarantined by the probe with
+# exact counter accounting; a nan_grad-poisoned trainer cycle rolls
+# back to the newest CRC-valid checkpoint and reconverges BIT-IDENTICAL
+# to fault-free; a lying rank's tree digest makes every rank refuse the
+# iteration; and the armed probe adds 0 steady-state traces.
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python scripts/integrity_smoke.py || rc=1
+if [ $rc -ne 0 ]; then
+    echo "check.sh: integrity smoke failed — skipping tier-1 pytest" >&2
+    exit $rc
+fi
+
 echo "== hybrid-path dispatch guards (compile budget + O(levels) shape) =="
 # the round-7 hot path: steady-state hybrid training must stay <=2
 # recompiles over 5 iterations and the level phase must issue

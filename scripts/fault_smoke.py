@@ -27,6 +27,14 @@ guarantee regresses:
                      within the deadline (the end-to-end rank-kill ->
                      relaunch -> bit-identical round trip lives in
                      scripts/gang_chaos_smoke.py — not repeated here)
+  integrity       -> the ISSUE 19 corruption sites, grammar + fire
+                     accounting only (<5 s): bitflip's where= filter
+                     preserving the after/n budget across non-matching
+                     consults, nan_grad/loss_spike/disk_full exception
+                     shapes, the DATA_CORRUPTION marker on every
+                     integrity exception, docstring drift (the
+                     detect->quarantine->repair round trips live in
+                     scripts/integrity_smoke.py — not repeated here)
 
 Runs in ~half a minute on CPU.
 """
@@ -360,13 +368,86 @@ def smoke_gang() -> None:
         set_collective_timeout(0)
 
 
+def smoke_integrity() -> None:
+    """ISSUE 19 integrity sites: grammar + fire accounting + docstring
+    drift only, no training (<5 s). The detect -> quarantine -> repair
+    -> un-quarantine round trips are gated by
+    scripts/integrity_smoke.py in the same check.sh run — one copy."""
+    import errno
+
+    from lightgbm_tpu.robustness import integrity
+    from lightgbm_tpu.robustness.retry import (is_corruption_error,
+                                               is_transient_error)
+
+    # every ISSUE 19 site speaks the grammar AND is documented in the
+    # faults.py site table (the KNOWN_SITES drift contract)
+    for site in ("bitflip", "nan_grad", "loss_spike", "disk_full"):
+        assert site in faults.KNOWN_SITES, site
+        assert f"``{site}``" in faults.__doc__, \
+            f"{site} missing from the faults.py docstring site table"
+    for where in ("dev", "host", "ckpt", "digest"):
+        assert f"``where={where}``" in faults.__doc__, \
+            f"where={where} missing from the faults.py docstring"
+
+    # where= filter: consults at OTHER sites must not burn the plan's
+    # after/n budget (the probe replay discipline)
+    with faults.inject("bitflip:p=1:where=dev:n=2") as plan:
+        f = plan.faults["bitflip"]
+        assert (f.where, f.n) == ("dev", 2)
+        assert not faults.check("bitflip", where="ckpt")
+        assert not faults.check("bitflip", where="host")
+        assert not faults.check("bitflip")          # untargeted consult
+        assert f.calls == 0, "non-matching where burned the budget"
+        assert faults.check("bitflip", where="dev")
+        assert faults.check("bitflip", where="dev")
+        assert not faults.check("bitflip", where="dev"), "n=2 leaked"
+        assert (f.calls, f.fired) == (3, 2), (f.calls, f.fired)
+
+    # after= accounting on the training-poison site
+    with faults.inject("nan_grad:p=1:after=1") as plan:
+        f = plan.faults["nan_grad"]
+        assert not faults.check("nan_grad"), "after=1 did not skip"
+        assert faults.check("nan_grad")
+        assert not faults.check("nan_grad"), "bare p=1 did not disarm"
+
+    # disk_full raises the REAL errno shape — classified exhaustion,
+    # never transient (retrying the same full disk is futile)
+    with faults.inject("disk_full:p=1"):
+        try:
+            faults.maybe_fail("disk_full")
+            raise AssertionError("disk_full never fired")
+        except OSError as e:
+            assert e.errno == errno.ENOSPC
+            assert not is_transient_error(e)
+
+    # loss_spike inflates the guard's observation into a refusal
+    g = integrity.NumericHealthGuard(window=4, spike_factor=10.0)
+    for i in range(4):
+        g.observe_loss(1.0, i)
+    with faults.inject("loss_spike:p=1"):
+        try:
+            g.observe_loss(1.0, 4)
+            raise AssertionError("loss_spike never tripped the guard")
+        except integrity.NumericHealthError as e:
+            assert is_corruption_error(e)
+
+    # every integrity exception carries the DATA_CORRUPTION marker —
+    # the rollback-never-retry classification the trainer relies on
+    for exc in (integrity.IntegrityError("host pack CRC"),
+                integrity.NumericHealthError("NaN gradients"),
+                integrity.CanaryMismatch("route parity"),
+                integrity.GangDivergence("rank digest")):
+        assert is_corruption_error(exc), exc
+
+
 def main() -> int:
     rc = 0
     for name, fn in (("write_kill", smoke_write_kill),
                      ("collective", smoke_collective),
                      ("probe_timeout", smoke_probe_fallback),
                      ("serving", smoke_serving),
-                     ("gang", smoke_gang)):
+                     ("gang", smoke_gang),
+                     ("integrity", smoke_integrity)):
         try:
             fn()
             print(f"fault_smoke: {name} OK")

@@ -1,0 +1,207 @@
+"""Integrity-defense smoke gate (ISSUE 19), on CPU, <30 s.
+
+Asserts the four corruption legs end to end through the REAL surfaces
+(``serve_fleet()``, ``run_resident_trainer``, the digest-agreement
+algebra), exiting non-zero on the first violated gate:
+
+  1. canary round-trip: an injected device-pack bitflip on a shared
+     fleet mega-pack is DETECTED (canary parity verify), quarantines
+     ONLY the afflicted tenant to the host walk (the co-tenant keeps
+     its device route), every response during the incident is correct,
+     the background probe REPAIRS the pack and un-quarantines, and the
+     ``integrity_probes/mismatches/quarantines/repairs`` accounting is
+     exact;
+  2. trainer numeric-health rollback: a single-fire ``nan_grad``
+     poisoning makes the resident trainer's guarded cycle raise
+     DATA_CORRUPTION; the trainer rolls back to the newest CRC-valid
+     checkpoint, retries the window clean, and the final model is
+     BIT-IDENTICAL to the fault-free run (the poison never reached the
+     publish channel);
+  3. gang digest-divergence refusal: one rank lying about its
+     committed-tree digest makes EVERY rank refuse the iteration with
+     ``GangDivergence`` — agreement is verified from reduce_sum moments
+     alone (the only collective the injection API guarantees);
+  4. steady-state trace budget: with the probe ARMED and firing, warm
+     traffic plus several probe cycles compile NOTHING — the canary
+     replay rides the same row buckets as client traffic.
+
+Wired into scripts/check.sh before tier-1.
+"""
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+T_START = time.perf_counter()
+BUDGET_SEC = 30.0
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+          "verbose": -1, "deterministic": True, "seed": 7,
+          "tpu_integrity_probe_interval_s": 0.1}
+
+
+def check(cond, what):
+    took = time.perf_counter() - T_START
+    if not cond:
+        print(f"integrity_smoke: FAIL {what} ({took:.1f}s)",
+              file=sys.stderr)
+        sys.exit(1)
+    print(f"integrity_smoke: ok {what} ({took:.1f}s)")
+
+
+def canary_roundtrip(lgb, faults, guards):
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((500, 8))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    ds = lgb.Dataset(X, label=y)
+    b1 = lgb.train(dict(PARAMS), ds, num_boost_round=6)
+    b2 = lgb.train(dict(PARAMS, seed=11), ds, num_boost_round=6)
+    fleet = lgb.serve_fleet({"a": b1, "b": b2})
+    try:
+        check(fleet.stats()["n_buckets"] == 1,
+              "same-shape tenants share one mega-pack")
+        ya0, yb0 = fleet.predict("a", X), fleet.predict("b", X)
+
+        # rot the rebuilt upload: the canary verify must catch the
+        # corrupt pack BEFORE install — 0 wrong responses by design
+        assert fleet.evict("a")
+        with faults.inject("bitflip:p=1:where=dev"):
+            ya1 = fleet.predict("a", X)
+            yb1 = fleet.predict("b", X)
+        check(np.allclose(ya1, ya0, rtol=1e-5, atol=1e-6),
+              "afflicted tenant answered correctly (host walk)")
+        check(np.array_equal(yb1, yb0),
+              "co-tenant kept its device route (bit-identical)")
+        snap = fleet.counters.snapshot()
+        check(snap["integrity_mismatches"] == 1 and
+              snap["quarantines"] == 1,
+              "detection accounting exact (1 mismatch, 1 quarantine)")
+        check(fleet.tenant_stats("a")["quarantined"] and
+              not fleet.tenant_stats("b")["quarantined"],
+              "blast radius = ONLY the afflicted tenant")
+
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if fleet.counters.snapshot().get("repairs", 0) >= 1 and \
+                    not fleet.tenant_stats("a")["quarantined"]:
+                break
+            time.sleep(0.05)
+        snap = fleet.counters.snapshot()
+        check(snap["repairs"] == 1 and
+              not fleet.tenant_stats("a")["quarantined"],
+              "probe repaired the pack and un-quarantined")
+        check(snap["integrity_probes"] >= 1 and
+              snap["integrity_mismatches"] == 1,
+              "no recount after repair")
+        check(np.array_equal(fleet.predict("a", X), ya0),
+              "repaired device route bit-identical to pre-rot")
+
+        # steady-state trace budget with the probe ARMED and firing:
+        # warm sizes + several probe cycles compile NOTHING
+        for n in (64, 300):
+            fleet.predict("a", X[:n])
+            fleet.predict("b", X[:n])
+        probes0 = fleet.counters.snapshot()["integrity_probes"]
+        with guards.CompileCounter() as counter:
+            t_end = time.time() + 0.5
+            while time.time() < t_end:
+                fleet.predict("a", X[:64])
+                fleet.predict("b", X[:300])
+                time.sleep(0.05)
+        check(fleet.counters.snapshot()["integrity_probes"] > probes0,
+              "probe cycles fired during the trace window")
+        check(counter.count == 0,
+              f"0 new steady-state traces with the probe armed "
+              f"({counter.count})")
+    finally:
+        fleet.close()
+
+
+def trainer_rollback(lgb, faults):
+    from lightgbm_tpu.robustness import checkpoint as ckpt
+    from lightgbm_tpu.service import TrainerSpec, run_resident_trainer
+
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((600, 6))
+    y = (X[:, 0] - 0.3 * X[:, 2] > 0).astype(np.float64)
+    rows = np.concatenate([y[:, None], X], axis=1)
+
+    def run(d, spec_fault=None):
+        spec = TrainerSpec(
+            params={k: v for k, v in PARAMS.items()
+                    if k != "tpu_integrity_probe_interval_s"},
+            stream_path=stream, ckpt_dir=d, window_rows=4096,
+            min_rows=256, iters_per_cycle=3, publish_every_iters=3,
+            target_iterations=6, poll_sec=0.05, keep_last=3)
+        if spec_fault:
+            with faults.inject(spec_fault):
+                rc = run_resident_trainer(spec)
+        else:
+            rc = run_resident_trainer(spec)
+        assert rc == 0, rc
+        found = ckpt.latest_valid_checkpoint(d)
+        assert found is not None and int(found[1]["iteration"]) == 6
+        return found[1]["model"]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        stream = os.path.join(tmp, "stream.csv")
+        with open(stream, "w") as fh:
+            for r in rows:
+                fh.write(",".join(f"{v:.9g}" for v in r) + "\n")
+        clean = run(os.path.join(tmp, "clean"))
+        # poison the cycle AFTER the first commit: the guard refuses,
+        # the trainer rolls back to the CRC-valid checkpoint, retries
+        # the SAME window clean
+        poisoned = run(os.path.join(tmp, "poisoned"),
+                       "nan_grad:p=1:after=1")
+    check(poisoned == clean,
+          "nan_grad rollback: final model BIT-IDENTICAL to fault-free")
+
+
+def gang_refusal(integrity):
+    digest = 0x1234_5678_9ABC_DEF0
+    world = 3
+    # clean agreement: the reduce_sum moments verify on every rank
+    total = world * integrity.digest_reduction(digest)
+    integrity.check_digest_reduction(total, world, digest, 7, rank=0)
+    # one lying rank: EVERY rank's verification refuses the iteration
+    bad = digest ^ 0x1
+    total = (2 * integrity.digest_reduction(digest) +
+             integrity.digest_reduction(bad))
+    refused = 0
+    for rank, d in enumerate((digest, digest, bad)):
+        try:
+            integrity.check_digest_reduction(total, world, d, 7,
+                                             rank=rank)
+        except integrity.GangDivergence:
+            refused += 1
+    check(refused == world,
+          f"digest divergence refused on every rank ({refused}/{world})")
+
+
+def main() -> int:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.analysis import guards
+    from lightgbm_tpu.robustness import faults, integrity
+
+    canary_roundtrip(lgb, faults, guards)
+    trainer_rollback(lgb, faults)
+    gang_refusal(integrity)
+
+    took = time.perf_counter() - T_START
+    # advisory on a cold compile cache (same policy as fleet_smoke)
+    if took >= BUDGET_SEC:
+        print(f"integrity_smoke: WARN wall {took:.1f}s >= "
+              f"{BUDGET_SEC:.0f}s (cold compile cache?)",
+              file=sys.stderr)
+    print(f"integrity_smoke: PASS in {took:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,23 @@ the fleet is NEVER whole-server degraded by a size-induced OOM, and
 the steady-state trace count stays flat (bisection halves land in
 warm row buckets). Banks ``bench_logs/SERVING_MEM.json``.
 
+Integrity-chaos mode (``--integrity-chaos``, ISSUE 19): a canary-armed
+tenant fleet under open-loop Poisson traffic while the victim tenant's
+evicted pack is lazily rebuilt through an injected device-upload
+bitflip (``bitflip:p=1:where=dev``), plus a resident-trainer run whose
+gradients are poisoned once (``nan_grad:p=1:after=1``). The gate FAILS
+(status no_result) unless: the corrupt upload is DETECTED within one
+probe interval and never installed, ONLY the afflicted tenant is
+quarantined to the host walk, zero torn/wrong responses (every response
+bit-matches its tenant's banked device or host-walk bits), the
+background probe repairs the pack and un-quarantines automatically
+(device route bit-identical to pre-rot), the
+``integrity_probes/integrity_mismatches/quarantines/repairs``
+accounting is EXACT through the same stats() the front door serves as
+/v1/stats, and the poisoned trainer's numeric-health rollback yields a
+final model BIT-IDENTICAL to the fault-free run. Banks
+``bench_logs/SERVING_INTEGRITY.json``.
+
 Usage:
   python scripts/serving_load.py [--clients 8] [--rows 64]
       [--duration 10] [--mode closed|open] [--rate 200]
@@ -84,7 +101,7 @@ Usage:
       [--publish-every 0] [--skip-native] [--deadline-ms 0]
       [--max-queue-rows 0] [--chaos] [--chaos-p999-ms 10000]
       [--fleet N] [--fleet-rows 3000] [--live] [--live-crash-iter 6]
-      [--mem-chaos]
+      [--mem-chaos] [--integrity-chaos]
 
 --devices D > 1 on a CPU host re-execs with D virtual XLA devices;
 an already-set JAX_PLATFORMS (e.g. a TPU session) is honored.
@@ -107,6 +124,7 @@ OUT_CHAOS = os.path.join(REPO, "bench_logs", "SERVING_CHAOS.json")
 OUT_FLEET = os.path.join(REPO, "bench_logs", "SERVING_FLEET.json")
 OUT_LIVE = os.path.join(REPO, "bench_logs", "SERVING_LIVE.json")
 OUT_MEM = os.path.join(REPO, "bench_logs", "SERVING_MEM.json")
+OUT_INTEGRITY = os.path.join(REPO, "bench_logs", "SERVING_INTEGRITY.json")
 
 
 def parse_args(argv=None):
@@ -168,19 +186,28 @@ def parse_args(argv=None):
                     help="mem-chaos: HBM budget as a fraction of the "
                          "fleet's total pack bytes (must force "
                          "eviction churn)")
+    ap.add_argument("--integrity-chaos", action="store_true",
+                    help="ISSUE 19 integrity gate: canary-armed fleet "
+                         "under load + an injected device-pack bitflip "
+                         "(detect / quarantine / repair) + a nan_grad-"
+                         "poisoned trainer rollback proof; banks "
+                         "SERVING_INTEGRITY.json")
     ap.add_argument("--out", default=None,
                     help="record path (default SERVING_LOAD.json; "
                          "SERVING_CHAOS.json under --chaos / "
                          "SERVING_FLEET.json under --fleet / "
                          "SERVING_LIVE.json under --live / "
-                         "SERVING_MEM.json under --mem-chaos so the "
-                         "banked throughput record is never clobbered)")
+                         "SERVING_MEM.json under --mem-chaos / "
+                         "SERVING_INTEGRITY.json under "
+                         "--integrity-chaos so the banked throughput "
+                         "record is never clobbered)")
     args = ap.parse_args(argv)
     if args.out is None:
-        args.out = OUT_MEM if args.mem_chaos else \
-            (OUT_LIVE if args.live else
-             (OUT_FLEET if args.fleet else
-              (OUT_CHAOS if args.chaos else OUT)))
+        args.out = OUT_INTEGRITY if args.integrity_chaos else \
+            (OUT_MEM if args.mem_chaos else
+             (OUT_LIVE if args.live else
+              (OUT_FLEET if args.fleet else
+               (OUT_CHAOS if args.chaos else OUT))))
     return args
 
 
@@ -980,6 +1007,312 @@ def mem_chaos_route(args, record):
     return "measured", None
 
 
+def integrity_chaos_route(args, record):
+    """ISSUE 19 integrity-defense chaos gate. Returns (status, note).
+
+    Topology: a mixed-shape tenant fleet on one FleetServer with the
+    canary probe ARMED (``tpu_integrity_probe_interval_s`` via the
+    fleet config), under open-loop Poisson traffic. Mid-window the
+    victim tenant's pack is evicted and its lazy rebuild is rotted
+    (``bitflip:p=1:where=dev``): the publish-channel canary verify must
+    catch the corrupt upload BEFORE install, quarantine ONLY the victim
+    to the host walk, and the background probe must repair the pack and
+    un-quarantine — all while every response stays bit-correct. A
+    second leg poisons a resident trainer's gradients
+    (``nan_grad:p=1:after=1``) and proves the numeric-health rollback:
+    the final model is BIT-IDENTICAL to the fault-free run. Verified:
+    detection within one probe interval, blast radius = the victim
+    tenant alone, 0 torn/wrong responses (each bit-matches its tenant's
+    banked device or host-walk bits), automatic repair + un-quarantine,
+    and EXACT ``integrity_probes/integrity_mismatches/quarantines/
+    repairs`` accounting through the same ``stats()`` the front door
+    serves as ``/v1/stats``. Banks ``bench_logs/SERVING_INTEGRITY.json``.
+    """
+    import tempfile
+
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.robustness import checkpoint as ckpt
+    from lightgbm_tpu.robustness import faults
+    from lightgbm_tpu.serving import DeadlineExceeded, Overloaded
+    from lightgbm_tpu.serving.metrics import latency_summary_ms
+    from lightgbm_tpu.service import TrainerSpec, run_resident_trainer
+
+    probe_s = 1.0
+    n_tenants = args.fleet or 4
+    rng = np.random.default_rng(0)
+    # the victim (keys[0]) gets a UNIQUE shape so it owns its bucket:
+    # the blast-radius assertion is then exact under concurrent load
+    archetypes = [(31, 20, 28), (15, 12, 12), (63, 16, 20), (15, 24, 12)]
+    pools = {f: np.ascontiguousarray(
+        rng.normal(size=(max(args.fleet_rows, 2048), f))
+        .astype(np.float32).astype(np.float64))
+        for f in {a[2] for a in archetypes}}
+    t0 = time.perf_counter()
+    tenants = {}
+    for i in range(n_tenants):
+        leaves, trees, f = archetypes[i % len(archetypes)]
+        X = pools[f][:args.fleet_rows]
+        y = (X[:, 0] * (1 + 0.1 * (i % 7)) +
+             0.5 * X[:, 1] ** 2 > 0.4).astype(np.float32)
+        bst = lgb.train({"objective": "binary", "num_leaves": leaves,
+                         "verbosity": -1}, lgb.Dataset(X, label=y),
+                        num_boost_round=trees)
+        tenants[f"t{i:03d}"] = (bst, f)
+    print(f"[load] trained {n_tenants} tenants "
+          f"({time.perf_counter() - t0:.1f}s)", flush=True)
+    keys = list(tenants)
+    victim = keys[0]
+
+    cfg = tenants[victim][0].config.copy()
+    cfg.set("tpu_integrity_probe_interval_s", probe_s)
+    fleet = lgb.serve_fleet({k: b for k, (b, _f) in tenants.items()},
+                            raw_score=True, linger_ms=args.linger_ms,
+                            max_batch=args.max_batch,
+                            num_devices=args.devices, config=cfg)
+    st = fleet.stats()
+    record["tenants"] = n_tenants
+    record["buckets"] = st["n_buckets"]
+    record["probe_interval_s"] = probe_s
+
+    # bank every (tenant, size) response bit-for-bit against BOTH
+    # routes: a quarantined tenant answers with its host-walk bits
+    sizes = sorted({max(args.rows // 2, 1), args.rows, args.rows * 2})
+    expected = {}
+    for k in keys:
+        b = tenants[k][0]
+        for n in sizes:
+            X = pools[tenants[k][1]][:n]
+            expected[(k, n)] = (b.predict(X, device=True, raw_score=True),
+                                b.predict(X, raw_score=True))
+    for k in keys:                                   # warm every bucket
+        for n in sizes:
+            fleet.predict(k, pools[tenants[k][1]][:n], timeout=300)
+
+    base = fleet.counters.tenant_snapshot()
+    observed = {k: {"requests": 0, "shed": 0, "expired": 0}
+                for k in keys}
+    results, hard, lats = [], [], []
+    lock = threading.Lock()
+
+    def client(ci):
+        r = random.Random(100 + ci)
+        futs = []
+        t0 = time.perf_counter()
+        next_t = t0
+        rate = max(args.rate / max(args.clients, 1), 1e-6)
+        while True:
+            next_t += r.expovariate(rate)
+            if next_t - t0 > args.duration:
+                break
+            now = time.perf_counter()
+            if next_t > now:
+                time.sleep(next_t - now)
+            k = keys[r.randrange(len(keys))]
+            n = sizes[r.randrange(len(sizes))]
+            try:
+                futs.append((k, n, next_t,
+                             fleet.submit(k, pools[tenants[k][1]][:n],
+                                          deadline_ms=8000.0)))
+            except Overloaded:
+                with lock:
+                    observed[k]["shed"] += 1
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    hard.append(repr(e))
+        for k, n, intended, fut in futs:
+            try:
+                out = fut.result(120)
+                with lock:
+                    observed[k]["requests"] += 1
+                    results.append((k, n, out))
+                    lats.append(max(fut.t_done - intended, 0.0))
+            except DeadlineExceeded:
+                with lock:
+                    observed[k]["expired"] += 1
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    hard.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.clients)]
+    t_wall = time.perf_counter()
+    for t in threads:
+        t.start()
+
+    # the rot drill, mid-window: evict the victim's pack, arm a
+    # device-upload bitflip, and force the lazy rebuild with one
+    # predict — the canary verify catches the corrupt pack BEFORE
+    # install, so this very response is already the host walk
+    time.sleep(max(args.duration * 0.35, 1.0))
+    n_v = args.rows
+    Xv = pools[tenants[victim][1]][:n_v]
+    t_rot = time.perf_counter()
+    # arm BEFORE evicting: whichever dispatch (ours or a client's)
+    # triggers the lazy rebuild inside this window uploads corrupt bits
+    with faults.inject("bitflip:p=1:where=dev"):
+        evicted = fleet.evict(victim)
+        y_rot = fleet.predict(victim, Xv, timeout=120)
+    detect_sec = time.perf_counter() - t_rot
+    detected = fleet.tenant_stats(victim)["quarantined"]
+    with lock:
+        observed[victim]["requests"] += 1
+        results.append((victim, n_v, y_rot))
+    print(f"[load] integrity rot drill: detected={detected} in "
+          f"{detect_sec * 1e3:.0f}ms", flush=True)
+
+    # the probe must now repair the pack and un-quarantine on its own,
+    # while traffic keeps flowing
+    repair_sec = None
+    deadline = time.time() + args.duration + 30
+    while time.time() < deadline:
+        snap = fleet.counters.tenant_snapshot().get(victim, {})
+        if snap.get("repairs", 0) >= 1 and \
+                not fleet.tenant_stats(victim)["quarantined"]:
+            repair_sec = time.perf_counter() - t_rot
+            break
+        time.sleep(0.05)
+    for t in threads:
+        t.join(args.duration + 120)
+    wall = time.perf_counter() - t_wall
+    ledger = fleet.counters.tenant_snapshot()
+    stats = fleet.stats()
+
+    rec = {"qps": round(len(results) / wall, 1),
+           "requests": len(results), "wall_sec": round(wall, 2),
+           "errors": len(hard)}
+    rec.update(latency_summary_ms(lats))
+    record["open_loop"] = rec
+    record["value"] = rec["qps"]
+    print(f"[load] integrity chaos {rec['qps']:.0f} req/s, "
+          f"p50={rec.get('p50_ms')}ms p999={rec.get('p999_ms')}ms",
+          flush=True)
+
+    torn = 0
+    for k, n, out in results:
+        exp = expected.get((k, n))
+        if exp is None or not (np.array_equal(out, exp[0]) or
+                               np.array_equal(out, exp[1])):
+            torn += 1
+    failures = []
+
+    def need(cond, what):
+        if not cond:
+            failures.append(what)
+
+    need(not hard, f"{len(hard)} hard client error(s): {hard[:1]}")
+    need(results, "no responses measured")
+    need(torn == 0, f"{torn} torn/wrong response(s)")
+    need(evicted, "the victim's pack was never evicted")
+    need(detected, "the rotted rebuild was never detected")
+    need(detect_sec <= probe_s,
+         f"detection took {detect_sec:.2f}s > one probe interval "
+         f"({probe_s}s)")
+    need(np.allclose(y_rot, expected[(victim, n_v)][1],
+                     rtol=1e-5, atol=1e-6),
+         "the quarantined response is not the host walk")
+    vled = ledger.get(victim, {})
+    need(vled.get("integrity_mismatches", 0) == 1 and
+         vled.get("quarantines", 0) == 1 and
+         vled.get("repairs", 0) == 1,
+         f"victim integrity accounting not exact: {vled}")
+    for k in keys[1:]:
+        led = ledger.get(k, {})
+        need(all(led.get(c, 0) == 0 for c in
+                 ("integrity_mismatches", "quarantines", "repairs")),
+             f"blast radius leaked to tenant {k}: {led}")
+    need(repair_sec is not None,
+         "the probe never repaired + un-quarantined the victim")
+    need(stats.get("quarantined") is None,
+         f"tenants still quarantined at end: {stats.get('quarantined')}")
+    need(stats.get("integrity_probes", 0) >= 1 and
+         stats.get("integrity_mismatches", 0) == 1 and
+         stats.get("quarantines", 0) == 1 and
+         stats.get("repairs", 0) == 1,
+         "stats() (the /v1/stats payload) integrity accounting not "
+         f"exact: probes={stats.get('integrity_probes')} "
+         f"mismatches={stats.get('integrity_mismatches')} "
+         f"quarantines={stats.get('quarantines')} "
+         f"repairs={stats.get('repairs')}")
+    need(np.array_equal(fleet.predict(victim, Xv, timeout=120),
+                        expected[(victim, n_v)][0]),
+         "the repaired device route is not bit-identical to pre-rot")
+    for k in keys:
+        led = {n: ledger.get(k, {}).get(n, 0) - base.get(k, {}).get(n, 0)
+               for n in ("requests", "shed", "expired")}
+        for n in ("requests", "shed", "expired"):
+            need(led[n] == observed[k][n],
+                 f"tenant {k} {n} accounting: server {led[n]} != "
+                 f"client {observed[k][n]}")
+    record["integrity"] = {
+        "responses": len(results), "torn": torn,
+        "detect_sec": round(detect_sec, 3),
+        "repair_sec": (round(repair_sec, 3)
+                       if repair_sec is not None else None),
+        "victim": victim, "victim_ledger": dict(vled),
+        "integrity_probes": stats.get("integrity_probes", 0),
+        "integrity_mismatches": stats.get("integrity_mismatches", 0),
+        "quarantines": stats.get("quarantines", 0),
+        "repairs": stats.get("repairs", 0)}
+    fleet.close()
+
+    # leg 2 — trainer numeric-health rollback: a single-fire nan_grad
+    # poisons the cycle after the first commit; the guard refuses, the
+    # trainer rolls back to the newest CRC-valid checkpoint and retries
+    # the SAME window, so the final model is bit-identical to clean
+    t0 = time.perf_counter()
+    rngt = np.random.default_rng(3)
+    Xt = rngt.standard_normal((600, 6))
+    yt = (Xt[:, 0] - 0.3 * Xt[:, 2] > 0).astype(np.float64)
+    rows = np.concatenate([yt[:, None], Xt], axis=1)
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbose": -1,
+              "deterministic": True, "seed": 7}
+
+    def train_once(d, spec_fault=None):
+        spec = TrainerSpec(
+            params=dict(params), stream_path=stream, ckpt_dir=d,
+            window_rows=4096, min_rows=256, iters_per_cycle=3,
+            publish_every_iters=3, target_iterations=6, poll_sec=0.05,
+            keep_last=3)
+        if spec_fault:
+            with faults.inject(spec_fault):
+                rc = run_resident_trainer(spec)
+        else:
+            rc = run_resident_trainer(spec)
+        need(rc == 0, f"resident trainer rc={rc} ({d})")
+        found = ckpt.latest_valid_checkpoint(d)
+        need(found is not None, f"no valid checkpoint in {d}")
+        return found[1]["model"] if found else None
+
+    with tempfile.TemporaryDirectory() as tmp:
+        stream = os.path.join(tmp, "stream.csv")
+        with open(stream, "w") as fh:
+            for r in rows:
+                fh.write(",".join(f"{v:.9g}" for v in r) + "\n")
+        clean = train_once(os.path.join(tmp, "clean"))
+        poisoned = train_once(os.path.join(tmp, "poisoned"),
+                              "nan_grad:p=1:after=1")
+    identical = (clean is not None and poisoned == clean)
+    need(identical,
+         "nan_grad rollback: final model NOT bit-identical to the "
+         "fault-free run")
+    record["trainer_poison"] = {
+        "fault": "nan_grad:p=1:after=1",
+        "rollback_bit_identical": bool(identical),
+        "wall_sec": round(time.perf_counter() - t0, 2)}
+    print(f"[load] trainer poison leg: bit_identical={identical} "
+          f"({record['trainer_poison']['wall_sec']}s)", flush=True)
+
+    if failures:
+        record["integrity"]["failures"] = failures
+        for f in failures:
+            print(f"[load] INTEGRITY CHAOS FAIL: {f}", file=sys.stderr,
+                  flush=True)
+        return "no_result", "; ".join(failures)
+    return "measured", None
+
+
 def live_route(args, record):
     """ISSUE 14 freshness chaos gate. Returns (status, note).
 
@@ -1245,6 +1578,14 @@ def main() -> int:
             record["mode"] = "open"
             record["rate"] = args.rate
             status, note = live_route(args, record)
+            return finish(status, note)
+
+        # ---- integrity-chaos mode (ISSUE 19): silent corruption -----
+        if args.integrity_chaos:
+            record["metric"] = "serving_integrity_qps"
+            record["mode"] = "open"
+            record["rate"] = args.rate
+            status, note = integrity_chaos_route(args, record)
             return finish(status, note)
 
         # ---- mem-chaos mode (ISSUE 17): OOM + eviction churn --------

@@ -27,6 +27,7 @@ import pytest
 import lightgbm_tpu as lgb
 from lightgbm_tpu.robustness import checkpoint as ckpt
 from lightgbm_tpu.robustness import faults
+from lightgbm_tpu.robustness import integrity as _integrity
 from lightgbm_tpu.robustness.retry import (RetryError, RetryPolicy,
                                            is_transient_error,
                                            retry_call)
@@ -244,11 +245,22 @@ def test_error_classifier_table():
             RuntimeError("UNAVAILABLE: failed to allocate 1G"),
         ],
         "FATAL": [ValueError("a code bug"), KeyError("t0")],
+        "DATA_CORRUPTION": [
+            RuntimeError("DATA_CORRUPTION: non-finite gradient sum"),
+            # the integrity exceptions carry the marker in-message
+            _integrity.IntegrityError("host pack CRC mismatch"),
+            _integrity.NumericHealthError("NaN leaf at iteration 3"),
+            _integrity.CanaryMismatch("route t0 parity"),
+            _integrity.GangDivergence("rank 1 digest"),
+        ],
     }
+    from lightgbm_tpu.robustness.retry import is_corruption_error
     for expected, excs in cases.items():
         for e in excs:
             assert classify_error(e) == expected, (e, classify_error(e))
             assert is_oom_error(e) == (expected == "RESOURCE_EXHAUSTED")
+            assert is_corruption_error(e) == \
+                (expected == "DATA_CORRUPTION")
             # DEADLINE is retried like TRANSIENT (fresh sub-slot); OOM
             # and FATAL are not
             assert is_transient_error(e) == \

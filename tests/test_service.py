@@ -479,3 +479,103 @@ def test_continual_service_publishes_and_serves(tmp_path):
         svc.close()
     # closed service reports closed
     assert svc.closed
+
+
+# ---------------------------------------------------------------------------
+# integrity defense satellites (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+def test_readyz_vs_healthz_liveness():
+    """``/readyz`` is the load-balancer signal: 503 the moment the tier
+    is degraded, while ``/healthz`` stays 200 — restarting a live
+    process never fixes degradation, so liveness must not flap with
+    readiness."""
+    block = _rows(400, seed=11)
+    X, y = block[:, 1:], block[:, 0]
+    bst = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y),
+                    num_boost_round=2)
+    # probe_interval_s=0: forced degradation is sticky (no recovery
+    # probe to un-degrade mid-assert)
+    srv = bst.serve(linger_ms=1.0, raw_score=True, probe_interval_s=0.0)
+    gw = ServerGateway(srv)
+    door = FrontDoor(gw)
+    try:
+        r = urllib.request.urlopen(door.address + "/readyz", timeout=30)
+        assert r.status == 200
+        assert json.loads(r.read()) == {"ready": True, "status": "ok"}
+
+        srv.degrade("readiness drill")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(door.address + "/readyz", timeout=30)
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read())
+        assert body["ready"] is False and body["status"] == "degraded"
+        # liveness unchanged: degraded-but-alive is 200 on /healthz
+        r = urllib.request.urlopen(door.address + "/healthz", timeout=30)
+        assert r.status == 200
+        assert json.loads(r.read())["status"] == "degraded"
+        # and the degraded tier still answers correctly (host walk)
+        probe = _rows(16, seed=13)[:, 1:].astype(np.float64)
+        out, _r = _post_npy(door.address + "/v1/predict", probe)
+        np.testing.assert_allclose(
+            out, bst.predict(probe, raw_score=True),
+            rtol=1e-5, atol=1e-6)
+    finally:
+        door.close()
+        srv.close(timeout=60)
+    # a CLOSED server is neither live nor ready
+    door2 = FrontDoor(ServerGateway(srv))
+    try:
+        for route in ("/readyz", "/healthz"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(door2.address + route, timeout=30)
+            assert ei.value.code == 503, route
+        assert json.loads(ei.value.read())["status"] == "closed"
+    finally:
+        door2.close()
+
+
+def test_deadletter_survives_supervised_relaunch(tmp_path):
+    """Poison rows quarantined to the ``.deadletter`` sidecar — and the
+    ``skipped_rows`` count in the checkpointed watermark — survive a
+    supervised trainer crash + relaunch: the relaunched child must not
+    report a clean stream while the sidecar holds quarantined lines."""
+    from lightgbm_tpu.robustness.checkpoint import latest_valid_checkpoint
+    from lightgbm_tpu.service.trainer import TrainerSupervisor
+    stream = str(tmp_path / "s.csv")
+    ck = str(tmp_path / "ck")
+    block = _rows(600)
+    _append(stream, block[:300])
+    with open(stream, "a") as f:
+        f.write("not,a,number,row,at,all,zzz\n")   # unparseable
+        f.write("1.0,2.0\n")                        # ragged
+    _append(stream, block[300:])
+    spec = TrainerSpec(params=dict(PARAMS), stream_path=stream,
+                       ckpt_dir=ck, window_rows=600, min_rows=256,
+                       iters_per_cycle=2, publish_every_iters=2,
+                       target_iterations=6, poll_sec=0.05)
+    # attempt 0 is murdered at the iteration boundary AFTER its first
+    # commit; attempt 1 runs fault-free to the target
+    sup = TrainerSupervisor(
+        spec, max_relaunches=2,
+        attempt_env=lambda i: (
+            {"LGBM_TPU_FAULTS": "rank_kill:rank=0:after=2"}
+            if i == 0 else {"LGBM_TPU_FAULTS": ""}))
+    t_end = time.time() + 570
+    try:
+        while time.time() < t_end and sup.alive:
+            time.sleep(0.25)
+        assert not sup.alive, sup.describe()
+        assert sup.last_rc == 0, sup.describe()
+        assert sup.relaunches == 1, sup.describe()
+    finally:
+        sup.stop()
+    found = latest_valid_checkpoint(ck)
+    assert found is not None
+    st = found[1]
+    assert int(st["iteration"]) == 6
+    # BOTH halves of the deadletter contract survived the relaunch
+    assert int(st["service"]["skipped_rows"]) >= 2, st["service"]
+    with open(stream + ".deadletter", "rb") as f:
+        dead = f.read()
+    assert b"not,a,number" in dead and b"1.0,2.0" in dead
