@@ -87,6 +87,10 @@ ENV_PARTIAL_PREDICT = "LGBM_TPU_PARTIAL_PREDICT"
 BENCH_PREDICT = os.environ.get("BENCH_PREDICT", "1") == "1"
 PREDICT_BATCH = int(os.environ.get("BENCH_PREDICT_BATCH", 100_000))
 PREDICT_ROWS = int(os.environ.get("BENCH_PREDICT_ROWS", 1_000_000))
+# SHAP contribution serving (ISSUE 20): each row emits (F+1)*K values
+# through the packed path tensors, so the explain leg drives fewer rows
+# than the score legs at the same wall budget
+CONTRIB_ROWS = int(os.environ.get("BENCH_CONTRIB_ROWS", 200_000))
 
 # ingestion axis (ISSUE 7): replicated-vs-sharded ingest A/B at the
 # reference Higgs shape. A launch_local gang of BENCH_INGEST_WORLD
@@ -421,12 +425,13 @@ def _timed_predict(predict_fn, X, tag: str, sched: str,
     a crash-safe partial so a late park/stall still salvages a
     provably-sustained rate + latency tail."""
     n = X.shape[0]
+    rows_target = extra.pop("_rows_target", PREDICT_ROWS)
     rows_done = 0
     lats = []
     t0 = time.perf_counter()
     next_bank = t0 + PARTIAL_EVERY_SEC if bank_path else None
     chunk_i = 0
-    while rows_done < PREDICT_ROWS:
+    while rows_done < rows_target:
         off = (chunk_i * PREDICT_BATCH) % n
         chunk = X[off:off + PREDICT_BATCH]
         t_chunk = time.perf_counter()
@@ -436,7 +441,7 @@ def _timed_predict(predict_fn, X, tag: str, sched: str,
         chunk_i += 1
         heartbeat.beat(heartbeat.PHASE_MEASURING, 10_000 + chunk_i)
         now = time.perf_counter()
-        if next_bank is not None and rows_done < PREDICT_ROWS and \
+        if next_bank is not None and rows_done < rows_target and \
                 now >= next_bank:
             _bank_record(bank_path, _predict_record(
                 rows_done / (now - t0), partial=True, path=tag,
@@ -492,6 +497,31 @@ def _measure_predict(lgb, booster, X, sched: str) -> None:
     raw_rps, raw_lats = _timed_predict(raw, X, "raw", sched, bank_path,
                                        extra)
 
+    # SHAP contribution serving (ISSUE 20): the packed-path-tensor
+    # explain route over the same model — same heartbeat / partial
+    # banking / salvage grammar, fewer rows (CONTRIB_ROWS) because each
+    # row emits (F+1)*K values instead of K
+    def contrib(chunk):
+        return booster.predict(chunk, device=True, pred_contrib=True)
+
+    t0 = time.perf_counter()
+    contrib(Xq[:PREDICT_BATCH])          # compile + SHAP pack, untimed
+    print(f"[bench] predict contrib warmup {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+    # the same host-fallback guard as the score legs: Booster.predict
+    # answers the host predict_contrib walk (loudly once) when the SHAP
+    # pack refuses the model — that number must never publish as the
+    # device explain metric
+    srv = getattr(booster._engine, "_serving", None)
+    if srv is None or srv.shap_pack is None or \
+            srv.shap_pack.count != len(booster._engine.models):
+        raise RuntimeError("contrib device route did not serve (host "
+                           "fallback engaged) — refusing to publish host "
+                           "throughput as the packed-path metric")
+    contrib_rps, contrib_lats = _timed_predict(
+        contrib, X, "contrib", sched, bank_path,
+        dict(extra, _rows_target=CONTRIB_ROWS))
+
     # parity guard: a serving engine that quietly diverged must not
     # publish a throughput number
     host = booster.predict(Xq[:4096], raw_score=True)
@@ -502,8 +532,11 @@ def _measure_predict(lgb, booster, X, sched: str) -> None:
     rec = _predict_record(binned_rps, sched=sched,
                           binned_rows_per_sec=round(binned_rps, 1),
                           raw_rows_per_sec=round(raw_rps, 1),
+                          contrib_rows_per_sec=round(contrib_rps, 1),
                           **_lat_fields(binned_lats),
-                          **_lat_fields(raw_lats, "raw_"), **extra)
+                          **_lat_fields(raw_lats, "raw_"),
+                          **_lat_fields(contrib_lats, "contrib_"),
+                          **extra)
     if bank_path:
         _bank_record(bank_path, dict(rec, partial=True,
                                      rows_done=PREDICT_ROWS))

@@ -1044,6 +1044,24 @@ class Booster:
 
         if pred_contrib:
             from .core.shap import predict_contrib
+            # opt-in device explanation (predict(..., pred_contrib=True,
+            # device=True)) through the packed SHAP path tensors
+            # (ops/shap_pack.py, ISSUE 20): f32 EXTEND/UNWIND on device,
+            # within f32-accumulation tolerance of the f64 host walk.
+            # Linear trees / categorical splits / raw f64-only requests
+            # fall back to the host walk LOUDLY ONCE per model — silent
+            # per-call WARNING spam would drown serving logs, silence
+            # would hide that the device never served.
+            if kwargs.get("device",
+                          self.params.get("tpu_predict_device", False)):
+                try:
+                    return eng.explain_device(X, start_iteration,
+                                              end_iteration)
+                except ValueError as e:
+                    from .utils import log
+                    log.info_once(
+                        f"device explanation unavailable ({e}); using "
+                        "the host predict_contrib walk")
             return predict_contrib(eng, X, start_iteration, end_iteration)
 
         # prediction early stopping (ref: src/boosting/

@@ -60,6 +60,10 @@ _CHOICES: Dict[str, Tuple[str, ...]] = {
     # relaunch-and-resume (crash-isolated from serving), "thread" =
     # in-process (tests, single-process deployments).
     "tpu_service_trainer": ("process", "thread"),
+    # explanation-serving fallback (ISSUE 20): "host" answers
+    # device-ineligible or degraded contrib requests with the host
+    # predict_contrib oracle, "refuse" fails them loudly.
+    "tpu_serving_explain_fallback": ("host", "refuse"),
 }
 
 
@@ -406,6 +410,28 @@ _reg("tpu_serving_fleet_quota_rows", int, 0, (), (0, None, True, False))
 # force-evicts the coldest pack instead of failing. 0 = unbounded.
 _reg("tpu_serving_mem_budget_mb", float, 0.0, (),
      (0.0, None, True, False))
+# explanation serving (ISSUE 20): SHAP contribution requests
+# (submit(kind="contrib") / TenantHandle.explain() / POST /v1/explain)
+# coalesce on their OWN micro-batcher — contrib outputs are
+# [rows, (F+1)*K] and must never share a dispatch with predict batches.
+# The explain batch cap defaults far below the predict cap: the path
+# kernel holds [leaves, depth, rows] intermediates per tree slot, so a
+# 4096-row contrib batch would cost ~40x a predict batch in working
+# set. linger/deadline/queue-row knobs mirror their predict-route
+# counterparts (0 deadline = none).
+_reg("tpu_serving_explain_max_batch", int, 1024, (),
+     (1, None, True, False))
+_reg("tpu_serving_explain_linger_ms", float, 2.0, (),
+     (0.0, None, True, False))
+_reg("tpu_serving_explain_deadline_ms", float, 0.0, (),
+     (0.0, None, True, False))
+_reg("tpu_serving_explain_max_queue_rows", int, 262_144, (),
+     (0, None, True, False))
+# what an explain request gets when the device route cannot serve it
+# (ineligible model, degraded/quarantined server, dispatch failure):
+# "host" answers with the bit-anchoring host predict_contrib oracle
+# (counted per tenant as explain_degraded), "refuse" fails the request.
+_reg("tpu_serving_explain_fallback", str, "host", ())
 # continual-learning service (lightgbm_tpu/service/, ISSUE 14): one
 # process joining the resident trainer, the publish pump and the HTTP
 # front door. port 0 binds an ephemeral port (ContinualService.frontdoor

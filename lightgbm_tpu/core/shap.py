@@ -227,16 +227,23 @@ def _decisions_all(t: HostTree, X: np.ndarray) -> np.ndarray:
     return out
 
 
-def shap_tree_batch(t: HostTree, X: np.ndarray, num_features: int
-                    ) -> np.ndarray:
-    """Exact TreeSHAP for all rows of X against one tree: [N, F+1]."""
+def shap_tree_batch(t: HostTree, X: np.ndarray, num_features: int,
+                    goes_left: np.ndarray = None) -> np.ndarray:
+    """Exact TreeSHAP for all rows of X against one tree: [N, F+1].
+
+    ``goes_left`` (bool [I, N], the ``_decisions_all`` matrix) lets a
+    caller that walks the SAME rows repeatedly — start/num_iteration
+    windows over one matrix, the serving host oracle replaying a
+    request — pay the decision sweep once instead of per call; omitted,
+    it is computed here (the original behavior, bit-identical)."""
     N = X.shape[0]
     phi = np.zeros((N, num_features + 1))
     if t.num_leaves <= 1:
         phi[:, -1] += float(t.leaf_value[0])
         return phi
     phi[:, -1] += _expected_value(t, 0)
-    goes_left = _decisions_all(t, X)
+    if goes_left is None:
+        goes_left = _decisions_all(t, X)
 
     def recurse(node, d, feats, zf, of, pw, pz, po, pf):
         # copy-extend the parent path (siblings must not see mutations);
@@ -372,15 +379,22 @@ def _native_tree_shap(t: HostTree, X64: np.ndarray, out: np.ndarray,
 
 
 def predict_contrib(engine, X: np.ndarray, start_iteration: int,
-                    end_iteration: int, row_chunk: int = 16384
-                    ) -> np.ndarray:
+                    end_iteration: int, row_chunk: int = 16384,
+                    decisions: dict = None) -> np.ndarray:
     """SHAP contributions [N, (F+1)*K] (ref: PredictType kPredictContrib,
     layout matches the reference: per-class blocks of F+1).
 
     Dispatch: the C++ row-parallel kernel when the native library is
     available (1M-row scale), else the numpy row-batched DFS in chunks
     (path copies hold O(depth^2 * chunk) floats). Both reproduce the
-    scalar recursion exactly in f64."""
+    scalar recursion exactly in f64.
+
+    ``decisions`` maps model index (``it * K + k``) to that tree's
+    ``_decisions_all`` bool [I, N] matrix over the SAME rows as ``X``
+    — the numpy path slices it per row chunk instead of re-walking
+    every internal node's split per call (the ISSUE 20 fix for callers
+    that explain one matrix across several iteration windows). The
+    native kernel computes decisions in C and ignores it."""
     K = engine.num_tree_per_iteration
     F = engine.max_feature_idx + 1
     N = X.shape[0]
@@ -406,9 +420,12 @@ def predict_contrib(engine, X: np.ndarray, start_iteration: int,
                                                      lib):
                 out[:, base + F] += _expected_value(t, 0)
                 continue
+            gl = None if decisions is None else \
+                decisions.get(it * K + k)
             for lo in range(0, N, row_chunk):
                 hi = min(lo + row_chunk, N)
                 Xc = np.ascontiguousarray(X[lo:hi])
                 out[lo:hi, base:base + F + 1] += shap_tree_batch(
-                    t, Xc, F)
+                    t, Xc, F,
+                    None if gl is None else gl[:, lo:hi])
     return out.reshape(N, -1) if K > 1 else out

@@ -291,6 +291,26 @@ class _LoadedEngine:
                                         X, lo, hi)
         return out.T  # [R, K]
 
+    def explain_device(self, X, start_iteration: int,
+                       end_iteration: int):
+        """[R, (F+1)*K] device SHAP contributions over raw thresholds
+        (ISSUE 20) — the loaded-model counterpart of
+        ``GBDT.explain_device``; linear/categorical models raise
+        ValueError for the Booster's loud-once host fallback."""
+        from ..ops.forest import ServingEngine
+        K = max(self.num_tree_per_iteration, 1)
+        lo, hi = start_iteration * K, end_iteration * K
+        if not self.models[lo:hi]:
+            raise ValueError("device explanation needs a non-empty "
+                             "tree range")
+        bucket = bool(self.config.tpu_predict_buckets)
+        if self._serving is None or self._serving.bucket != bucket:
+            cap = max([t.num_leaves for t in self.models] + [2])
+            self._serving = ServingEngine(cap, K, bucket=bucket)
+        return self._serving.explain_raw(
+            self.models, self._model_gen, X, lo, hi,
+            self.max_feature_idx + 1)
+
     def serving_state(self):
         """Server-snapshot source (serving/server.py ISSUE 8): a loaded
         model has no bin mappers, so the server serves the raw route."""

@@ -2013,6 +2013,40 @@ class GBDT:
             out = srv.predict_raw(models, self._model_gen, X, lo, hi)
         return out.T  # [R, K]
 
+    def explain_device(self, X: np.ndarray, start_iteration: int,
+                       end_iteration: int) -> np.ndarray:
+        """[R, (F+1)*K] f64 SHAP contributions through the packed path
+        tensors (ops/shap_pack.py, ISSUE 20) — the device counterpart
+        of ``core.shap.predict_contrib`` with the same output layout
+        (per-class blocks of F+1, bias last). Route selection mirrors
+        ``predict_device`` (binned with in-session mappers, raw
+        thresholds for loaded models); linear trees and categorical
+        splits raise ValueError for the Booster's loud-once host
+        fallback. The SHAP pack rides the SAME ServingEngine as
+        predictions, so it grows incrementally with training and
+        generations stay shared."""
+        K = self.num_tree_per_iteration
+        models = self.models          # property: flushes pending trees
+        lo, hi = start_iteration * K, end_iteration * K
+        if not models[lo:hi]:
+            raise ValueError("device explanation needs a non-empty "
+                             "tree range")
+        n_features = self.max_feature_idx + 1
+        bucket = bool(self.config.tpu_predict_buckets)
+        srv = self._serving
+        if srv is None or srv.bucket != bucket:
+            srv = self._serving = ServingEngine(
+                self.config.num_leaves, K, bucket=bucket)
+        if self.train_set is not None and self.train_set.bin_mappers:
+            if self._serving_mappers is None:
+                self._serving_mappers = self.train_set.used_bin_mappers()
+            return srv.explain_binned(
+                models, self._model_gen, X, lo, hi,
+                self._serving_mappers, self.train_set.used_feature_map,
+                n_features)
+        return srv.explain_raw(models, self._model_gen, X, lo, hi,
+                               n_features)
+
     def serving_state(self):
         """Frozen ``(models, generation, mappers, used_feature_map)``
         for an external model server (serving/server.py ISSUE 8). The

@@ -707,6 +707,10 @@ class ServingEngine:
         self.raw_pack = RawForestPack(max_leaves)
         self.binner: Optional[DeviceBinner] = None
         self._binner_src = None
+        # SHAP path packs (ISSUE 20), created lazily on the first
+        # explanation request — predict-only servers never pay for them
+        self.shap_pack = None
+        self.raw_shap_pack = None
 
     def _padded_rows(self, r: int) -> int:
         return bucket_rows(r) if self.bucket else r
@@ -746,6 +750,65 @@ class ServingEngine:
         snap = self.snapshot(models, gen, lo, hi, mappers,
                              used_feature_map)
         return snapshot_scores(snap, X)
+
+    def snapshot_shap(self, models, gen, lo: int, hi: int,
+                      n_features: int, mappers=None,
+                      used_feature_map=None, place_window=None):
+        """Sync the right SHAP path pack and freeze an immutable
+        explanation snapshot of the [lo, hi) window (ISSUE 20). Same
+        route selection and thread contract as ``snapshot``; raises
+        ValueError for linear/categorical models (the Booster falls
+        back to the host ``predict_contrib`` walk, loudly once)."""
+        from . import shap_pack as _sp
+        if not models[lo:hi]:
+            raise ValueError("explanation snapshot needs a non-empty "
+                             "tree range")
+        # pow2 tree-slot capacity: an in-window publish (more trees,
+        # same cap) keeps the compiled kernel's window shape; the dead
+        # slots are masked out via the snapshot's live count
+        slots = self.k * pow2_cap(max((hi - lo) // self.k, 1), 1)
+        if mappers is not None:
+            pack = self.shap_pack
+            if pack is None or pack.n_features != n_features:
+                pack = _sp.ShapForestPack(self.pack.max_leaves,
+                                          n_features)
+            pack.sync(models, gen, mappers)   # may refuse (eligibility)
+            self.shap_pack = pack             # ... so assign after
+            if self.binner is None or self._binner_src is not mappers:
+                self.binner = DeviceBinner(mappers, used_feature_map)
+                self._binner_src = mappers
+            win, _steps = pack.window(lo, hi, slots=slots)
+            kind, binner = "binned", self.binner
+        else:
+            pack = self.raw_shap_pack
+            if pack is None or pack.n_features != n_features:
+                pack = _sp.RawShapPack(self.raw_pack.max_leaves,
+                                       n_features)
+            pack.sync(models, gen)            # may refuse (eligibility)
+            self.raw_shap_pack = pack
+            win, _steps = pack.window(lo, hi, slots=slots)
+            kind, binner = "raw", None
+        if place_window is not None:
+            win = place_window(win)
+        return _sp.ShapSnapshot(kind, win, self.k, hi - lo, n_features,
+                                self.bucket, binner)
+
+    def explain_binned(self, models, gen, X: np.ndarray, lo: int,
+                       hi: int, mappers, used_feature_map,
+                       n_features: int) -> np.ndarray:
+        """[R, (F+1)*K] f32-accumulated contributions, binned route."""
+        from . import shap_pack as _sp
+        snap = self.snapshot_shap(models, gen, lo, hi, n_features,
+                                  mappers, used_feature_map)
+        return _sp.shap_snapshot_scores(snap, X)
+
+    def explain_raw(self, models, gen, X: np.ndarray, lo: int, hi: int,
+                    n_features: int) -> np.ndarray:
+        """Raw-route counterpart of ``explain_binned`` — same
+        f32-representability refusal as ``predict_raw``."""
+        from . import shap_pack as _sp
+        snap = self.snapshot_shap(models, gen, lo, hi, n_features)
+        return _sp.shap_snapshot_scores(snap, X)
 
     def predict_raw(self, models, gen, X: np.ndarray,
                     lo: int, hi: int) -> np.ndarray:

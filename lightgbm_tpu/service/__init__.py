@@ -305,10 +305,11 @@ class ContinualService:
                 log.warning(f"publish pump error: {e!r}")
 
     # -- gateway surface (front door) ----------------------------------
-    def submit(self, X, deadline_ms=None, tenant: Optional[str] = None):
+    def submit(self, X, deadline_ms=None, tenant: Optional[str] = None,
+               kind: str = "score"):
         if tenant is not None:
             raise KeyError(tenant)     # solo service has no tenants
-        return self._server.submit(X, deadline_ms=deadline_ms)
+        return self._server.submit(X, deadline_ms=deadline_ms, kind=kind)
 
     def predict(self, X, timeout: Optional[float] = None):
         return self._server.predict(X, timeout=timeout)

@@ -7,7 +7,13 @@ in-process Python threads calling a method:
 
 - **routes**: ``POST /v1/predict`` (solo server), ``POST
   /v1/tenants/<name>/predict`` (fleet), ``GET /healthz``, ``GET
-  /readyz``, ``GET /v1/stats``.
+  /readyz``, ``GET /v1/stats``. Explanation serving (ISSUE 20) adds
+  ``POST /v1/explain`` and ``POST /v1/tenants/<name>/explain`` — the
+  SAME body formats and failure map, answered with per-row SHAP
+  contribution matrices ``[rows, (F+1)*k]`` through the coalesced
+  explain route (``submit(kind="contrib")``); device-ineligible or
+  degraded models answer by the host ``predict_contrib`` oracle
+  (still 200 — correctness is preserved, only throughput changes).
 - **liveness vs readiness** (ISSUE 19): ``/healthz`` answers "is the
   process alive and able to speak HTTP" — it stays 200 even while the
   serving tier is degraded to the host walk, because restarting a live
@@ -75,14 +81,16 @@ class ServerGateway:
         self.staleness = LatencyRecorder()
         self._marks = {}
 
-    def submit(self, X, deadline_ms=None, tenant: Optional[str] = None):
+    def submit(self, X, deadline_ms=None, tenant: Optional[str] = None,
+               kind: str = "score"):
         if tenant is not None:
             if self.fleet is None:
                 raise KeyError(tenant)
-            return self.fleet.submit(tenant, X, deadline_ms=deadline_ms)
+            return self.fleet.submit(tenant, X, deadline_ms=deadline_ms,
+                                     kind=kind)
         if self.server is None:
             raise KeyError("no solo server mounted")
-        return self.server.submit(X, deadline_ms=deadline_ms)
+        return self.server.submit(X, deadline_ms=deadline_ms, kind=kind)
 
     def set_watermark(self, version: int, rows: int, ts: float,
                       iteration: Optional[int] = None) -> None:
@@ -298,10 +306,17 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802 — stdlib contract
         door = self.frontdoor
         tenant = None
+        kind = "score"
         path = self.path
         if path.startswith("/v1/tenants/") and \
                 path.endswith("/predict"):
             tenant = path[len("/v1/tenants/"):-len("/predict")]
+        elif path.startswith("/v1/tenants/") and \
+                path.endswith("/explain"):
+            tenant = path[len("/v1/tenants/"):-len("/explain")]
+            kind = "contrib"
+        elif path == "/v1/explain":
+            kind = "contrib"
         elif path != "/v1/predict":
             self._fail(404, f"no route {path!r}")
             return
@@ -326,7 +341,7 @@ class _Handler(BaseHTTPRequestHandler):
             t0 = time.time()
             try:
                 fut = door.gateway.submit(X, deadline_ms=deadline_ms,
-                                          tenant=tenant)
+                                          tenant=tenant, kind=kind)
             except Overloaded as e:
                 self._fail(429, str(e), retry_after=True)
                 return

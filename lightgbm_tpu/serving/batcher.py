@@ -95,10 +95,10 @@ class PendingRequest:
 
     __slots__ = ("X", "n", "t_enq", "t_done", "deadline", "_event",
                  "_value", "_error", "_settle_lock", "_settled",
-                 "generation", "tenant")
+                 "generation", "tenant", "kind")
 
     def __init__(self, X: np.ndarray, deadline_sec: Optional[float] = None,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None, kind: str = "score"):
         self.X = X
         self.n = X.shape[0]
         # fleet serving (ISSUE 13): the tenant whose model serves this
@@ -106,6 +106,13 @@ class PendingRequest:
         # BEFORE the request is visible to the dispatcher — so routing
         # and per-tenant accounting never race the enqueue.
         self.tenant = tenant
+        # what the request asks for (ISSUE 20): "score" (raw/transformed
+        # scores, [rows, K]) or "contrib" (SHAP contributions,
+        # [rows, (F+1)*K]). Explanation requests ride their OWN batcher
+        # instance so the two output shapes never coalesce into one
+        # dispatch; the kind tag travels with the request for routing
+        # and the per-tenant explain ledger.
+        self.kind = kind
         self.t_enq = time.perf_counter()
         self.t_done: Optional[float] = None
         self.deadline = (None if deadline_sec is None
@@ -241,7 +248,8 @@ class MicroBatcher:
     def submit(self, X: np.ndarray,
                deadline_sec: Optional[float] = None,
                tenant: Optional[str] = None,
-               max_tenant_rows: int = 0) -> PendingRequest:
+               max_tenant_rows: int = 0,
+               kind: str = "score") -> PendingRequest:
         """Enqueue one request (blocks on a full queue — backpressure,
         not unbounded buffering). With ``max_queue_rows`` set, fails
         fast with :class:`Overloaded` instead of blocking once that
@@ -253,7 +261,7 @@ class MicroBatcher:
         if X.ndim != 2 or X.shape[0] == 0:
             raise ValueError("requests must be non-empty [rows, features] "
                              "matrices")
-        req = PendingRequest(X, deadline_sec, tenant=tenant)
+        req = PendingRequest(X, deadline_sec, tenant=tenant, kind=kind)
         with self._submit_lock:
             if self._closed:
                 raise RuntimeError("serving batcher is closed")

@@ -51,6 +51,18 @@ hosts a model FLEET on one shared device arena:
   the coldest pack instead of failing; OOM-classified dispatch
   failures bisect the request group down to a per-request host-walk
   floor, never whole-fleet degradation.
+- **coalesced explanation serving** (ISSUE 20):
+  ``submit(kind="contrib")`` / ``TenantHandle.explain()`` ride their
+  OWN grouped micro-batcher over per-bucket SHAP path mega-packs
+  (ops/shap_pack.py) — a [rows, (F+1)*k] contribution output never
+  shares a dispatch with score outputs, so explain traffic costs the
+  predict tier zero new traces. SHAP packs are LRU-evictable residents
+  under the same HBM budget (host pack retained, lazy bit-exact
+  rebuild; they evict BEFORE score packs — scores are the
+  latency-critical class) and are dropped on publish; quarantined,
+  device-ineligible (linear/categorical) or degraded tenants answer by
+  the host ``predict_contrib`` oracle, counted per tenant
+  (``explain_requests`` / ``explain_degraded``).
 
 Entry points: ``lightgbm_tpu.serve_fleet({name: booster, ...})`` and
 ``Booster.serve(fleet=server, tenant=name)``.
@@ -68,8 +80,8 @@ from . import mesh as mesh_mod
 from .batcher import MicroBatcher, PendingRequest
 from .metrics import ServingCounters
 from .server import (DegradeControl, Generation, finish_scores,
-                     host_walk_scores)
-from ..ops import forest
+                     host_contrib_scores, host_walk_scores)
+from ..ops import forest, shap_pack
 from ..ops.forest import TenantShape
 from ..robustness import faults, integrity
 from ..robustness.retry import (RetryError, RetryPolicy, SERVING_POLICY,
@@ -119,6 +131,30 @@ class _Bucket(NamedTuple):
     device: object            # owner device or None
     host: object              # numpy pytree — the rebuild source
     host_crc: int             # pack-time CRC32 fingerprint of ``host``
+
+
+class _ShapBucket(NamedTuple):
+    """One shape bucket's SHAP path mega-pack (ISSUE 20) — DERIVED
+    state, cached OUTSIDE the immutable fleet state and keyed by the
+    exact member generations it was packed for (``token``): any
+    member's publish invalidates it, and the first explain after that
+    rebuilds it lazily, so score-only traffic never pays for path
+    packing. ``dev is None`` marks an HBM-budget eviction: ``host``
+    (CRC-fingerprinted like ``_Bucket.host``) is retained and the next
+    explain re-uploads it bit-exactly. ``blocked`` maps members whose
+    models the packed kernels cannot explain (linear trees /
+    categorical splits) to the reason — their requests take the host
+    ``predict_contrib`` oracle and their window slots hold inert
+    zeros."""
+    key: TenantShape
+    token: tuple              # ((member, generation.version), ...)
+    dev: object               # device pytree, or None when evicted
+    host: object              # numpy pytree — the rebuild source
+    host_crc: int
+    nbytes: int
+    phi_cap: int              # pow2 cap of max member (F + 1)
+    blocked: dict             # member -> ineligibility reason
+    device: object            # model-shard owner device or None
 
 
 class _FleetState(NamedTuple):
@@ -174,12 +210,20 @@ class TenantHandle:
         self.fleet = fleet
         self.name = name
 
-    def submit(self, X, deadline_ms: Optional[float] = None
-               ) -> PendingRequest:
-        return self.fleet.submit(self.name, X, deadline_ms=deadline_ms)
+    def submit(self, X, deadline_ms: Optional[float] = None,
+               kind: str = "score") -> PendingRequest:
+        return self.fleet.submit(self.name, X, deadline_ms=deadline_ms,
+                                 kind=kind)
 
     def predict(self, X, timeout: Optional[float] = None) -> np.ndarray:
         return self.fleet.predict(self.name, X, timeout=timeout)
+
+    def explain(self, X, timeout: Optional[float] = None) -> np.ndarray:
+        """SHAP contributions [rows, (F+1)*k] for this tenant (ISSUE
+        20) — reference ``pred_contrib`` layout, served by the packed
+        fleet SHAP kernel with the host ``predict_contrib`` walk as
+        the degrade oracle."""
+        return self.fleet.explain(self.name, X, timeout=timeout)
 
     def publish(self) -> Generation:
         return self.fleet.publish(self.name)
@@ -297,6 +341,35 @@ class FleetServer:
                                     "tpu_serving_max_queue_rows",
                                     1_048_576)),
             counters=self.counters)
+        # explanation serving (ISSUE 20): contrib requests ride their
+        # OWN grouped batcher over per-bucket SHAP path mega-packs —
+        # the two output shapes never coalesce into one dispatch. The
+        # smaller max_batch default reflects the SHAP kernel's
+        # [leaves, depth, rows] working set per row.
+        self.explain_deadline_ms = float(knob(
+            None, "tpu_serving_explain_deadline_ms", 0.0))
+        self._explain_refuse = str(knob(
+            None, "tpu_serving_explain_fallback", "host")) == "refuse"
+        # SHAP pack cache: derived state keyed by bucket shape, token-
+        # checked against member generations (entries are immutable
+        # NamedTuples; the dict mutates only under the publish lock,
+        # dispatcher reads are GIL-atomic). _shap_touch is the explain
+        # LRU signal; _explain_block caches per-tenant device
+        # eligibility per generation (dispatcher thread only).
+        self._shap_cache: Dict[TenantShape, _ShapBucket] = {}
+        self._shap_touch: Dict[TenantShape, int] = {}
+        self._explain_block: Dict[str, tuple] = {}
+        self._explain_batcher = MicroBatcher(
+            self._dispatch_explain_many, grouped=True,
+            max_batch=int(knob(None, "tpu_serving_explain_max_batch",
+                               1024)),
+            linger_ms=float(knob(None, "tpu_serving_explain_linger_ms",
+                                 2.0)),
+            queue_depth=int(knob(queue_depth, "tpu_serving_queue_depth",
+                                 8192)),
+            max_queue_rows=int(knob(
+                None, "tpu_serving_explain_max_queue_rows", 262_144)),
+            counters=self.counters)
         # integrity defense (ISSUE 19): silent-corruption canary parity
         # probes. 0 = disarmed — no probe thread, no per-publish canary
         # replay, zero behavior change. Goldens are DEVICE replays of a
@@ -379,6 +452,7 @@ class FleetServer:
                 return
             self.counters.drop_tenant(name)
             self._goldens.pop(name, None)
+            self._explain_block.pop(name, None)
             with self._qlock:
                 if name in self._quarantined:
                     self._quarantined = self._quarantined - {name}
@@ -587,6 +661,17 @@ class FleetServer:
             buckets = rebuilt
         buckets = self._enforce_budget(buckets, keep=keep)
         self._state = _FleetState(buckets, routes, shard)  # GIL-atomic
+        # SHAP packs are DERIVED state (ISSUE 20): drop entries whose
+        # bucket disappeared or whose member generations moved on — an
+        # in-flight explain keeps its own reference, so the drop never
+        # tears a dispatch; the next explain rebuilds lazily
+        for k in list(self._shap_cache):
+            b = buckets.get(k)
+            token = None if b is None else tuple(
+                (m, routes[m].generation.version) for m in b.members)
+            if self._shap_cache[k].token != token:
+                del self._shap_cache[k]
+                self._shap_touch.pop(k, None)
 
     def _enforce_budget(self, buckets, keep=(), incoming: int = 0):
         """LRU-evict cold resident packs until resident bytes (plus
@@ -600,6 +685,15 @@ class FleetServer:
             return buckets
         resident = sum(b.nbytes for b in buckets.values()
                        if b.dev is not None)
+        resident += sum(sb.nbytes for sb in self._shap_cache.values()
+                        if sb.dev is not None)
+        if resident + incoming <= self._mem_budget:
+            return buckets
+        # SHAP packs evict FIRST (ISSUE 20): the score dispatch is the
+        # latency-critical class; an evicted explanation pack costs one
+        # lazy re-upload on the next explain
+        resident -= self._evict_shap(
+            resident + incoming - self._mem_budget)
         if resident + incoming <= self._mem_budget:
             return buckets
         order = sorted(
@@ -618,10 +712,36 @@ class FleetServer:
                      f"{self._mem_budget / 1e6:.1f} MB budget")
         return buckets
 
+    def _evict_shap(self, over: int, keep=()) -> int:
+        """Evict cold SHAP packs (LRU by explain touch) until at least
+        ``over`` bytes are freed or none are left resident; returns the
+        bytes freed. Device reference dropped, host pack retained —
+        the next explain re-uploads bit-exactly (``_shap_bucket``).
+        Caller holds the publish lock."""
+        freed = 0
+        for k in sorted((k for k, sb in self._shap_cache.items()
+                         if sb.dev is not None and k not in keep),
+                        key=lambda k: self._shap_touch.get(k, -1)):
+            if freed >= over:
+                break
+            sb = self._shap_cache[k]
+            self._shap_cache[k] = sb._replace(dev=None)
+            freed += sb.nbytes
+            self.counters.inc("evictions")
+            log.info(f"fleet SHAP pack evicted (LRU, "
+                     f"{sb.nbytes / 1e6:.2f} MB, members "
+                     f"{tuple(m for m, _v in sb.token)}): resident "
+                     "bytes over the HBM budget")
+        return freed
+
     def _evict_coldest(self, buckets, exclude=()) -> bool:
-        """Force-evict the single coldest resident pack in ``buckets``
-        (the OOM'd-upload recovery step); False when nothing is left to
-        evict. Caller holds the publish lock."""
+        """Force-evict the single coldest resident pack (the
+        OOM'd-upload recovery step): a resident SHAP pack first — the
+        cheaper class to lose — else the coldest score pack in
+        ``buckets``; False when nothing is left to evict. Caller holds
+        the publish lock."""
+        if self._evict_shap(1):
+            return True
         order = sorted(
             (k for k, b in buckets.items()
              if b.dev is not None and k not in exclude),
@@ -744,12 +864,18 @@ class FleetServer:
 
     # ---- request path ------------------------------------------------
     def submit(self, tenant: str, X,
-               deadline_ms: Optional[float] = None) -> PendingRequest:
+               deadline_ms: Optional[float] = None,
+               kind: str = "score") -> PendingRequest:
         """Enqueue one request for ``tenant``. Validation happens HERE
         (tenant existence, shape, the raw route's f32-representability
         contract) so a malformed request raises to ITS submitter and
         never joins — let alone poisons — the cross-tenant batch its
-        peers form."""
+        peers form. ``kind="contrib"`` (ISSUE 20) requests SHAP
+        contributions and rides the explain batcher — its own
+        coalescing and admission knobs (``tpu_serving_explain_*``)."""
+        if kind not in ("score", "contrib"):
+            raise ValueError(f"unknown request kind {kind!r} "
+                             "(expected 'score' or 'contrib')")
         t = self._tenants.get(tenant)
         if t is None:
             raise KeyError(f"unknown tenant {tenant!r}")
@@ -768,6 +894,13 @@ class FleetServer:
                     f"requests ({int((~f32_ok).sum())} value(s) are "
                     "f64-only and could cross a split threshold under "
                     "f32 rounding)")
+        if kind == "contrib":
+            dl = self.explain_deadline_ms if deadline_ms is None \
+                else float(deadline_ms)
+            return self._explain_batcher.submit(
+                X, deadline_sec=(dl / 1e3 if dl and dl > 0 else None),
+                tenant=tenant, max_tenant_rows=t.quota_rows,
+                kind="contrib")
         dl = t.deadline_ms if deadline_ms is None else float(deadline_ms)
         return self._batcher.submit(
             X, deadline_sec=(dl / 1e3 if dl and dl > 0 else None),
@@ -779,6 +912,16 @@ class FleetServer:
         machinery like ``ModelServer.predict``."""
         dl_ms = None if timeout is None else timeout * 1e3
         return self.submit(tenant, X, deadline_ms=dl_ms).result(timeout)
+
+    def explain(self, tenant: str, X,
+                timeout: Optional[float] = None) -> np.ndarray:
+        """Sync sugar for the explanation route (ISSUE 20): SHAP
+        contributions [rows, (F+1)*k] for ``tenant`` in the reference
+        ``pred_contrib`` layout (per-class blocks of F+1, bias
+        last)."""
+        dl_ms = None if timeout is None else timeout * 1e3
+        return self.submit(tenant, X, deadline_ms=dl_ms,
+                           kind="contrib").result(timeout)
 
     # ---- dispatch ----------------------------------------------------
     def _dispatch_many(self, batch: List[PendingRequest]) -> list:
@@ -993,6 +1136,318 @@ class FleetServer:
                              route.average_output, route.objective,
                              route.raw_score)
         return vals, info
+
+    # ---- explanation route (ISSUE 20) -------------------------------
+    def _explain_blocked(self, route: TenantRoute) -> Optional[str]:
+        """None when ``route``'s model is device-explainable, else the
+        reason (linear trees / categorical splits). Cached per (tenant,
+        generation); dispatcher thread only."""
+        ent = self._explain_block.get(route.name)
+        if ent is not None and ent[0] == route.generation.version:
+            return ent[1]
+        try:
+            shap_pack.check_explainable(route.models)
+            reason = None
+        except ValueError as e:
+            reason = str(e)
+        self._explain_block[route.name] = (route.generation.version,
+                                           reason)
+        return reason
+
+    def _assemble_shap_host(self, key: TenantShape, b: _Bucket,
+                            routes: Dict[str, TenantRoute]
+                            ) -> _ShapBucket:
+        """HOST SHAP mega-pack for ``key``'s bucket: members' packed
+        path windows concatenated in slot order (the SAME ``route.lo``
+        offsets the score pack serves), zero windows for blocked
+        members and the pow2 slot padding — zeros are inert because no
+        row ever routes to them and the kernel masks dead slots
+        bit-preservingly. Returns an un-uploaded (``dev=None``) entry;
+        caller holds the publish lock."""
+        token = tuple((m, routes[m].generation.version)
+                      for m in b.members)
+        wins, blocked, template = [], {}, None
+        phi = 1
+        for m in b.members:
+            route = routes[m]
+            reason = self._explain_blocked(route)
+            if reason is not None:
+                blocked[m] = reason
+                wins.append(None)
+                continue
+            if key.kind == "binned":
+                win = shap_pack.pack_window_shap_binned(
+                    route.models, route.mappers, key, route.n_features)
+            else:
+                win = shap_pack.pack_window_shap_raw(
+                    route.models, key, route.n_features)
+            template = win
+            phi = max(phi, route.n_features + 1)
+            wins.append(win)
+        phi_cap = forest.pow2_cap(phi, 1)
+        if template is None:    # every member blocked: host oracle only
+            return _ShapBucket(key, token, None, None, 0, 0, phi_cap,
+                               blocked, None)
+        zero = _np_map(np.zeros_like, template)
+        wins = [w if w is not None else zero for w in wins]
+        if b.slot_cap > len(b.members):
+            wins = wins + [zero] * (b.slot_cap - len(b.members))
+        host = _np_map(lambda *xs: np.concatenate(xs), *wins)
+        return _ShapBucket(key, token, None, host,
+                           integrity.crc32_fingerprint(host),
+                           forest.pytree_nbytes(host), phi_cap, blocked,
+                           b.device)
+
+    def _shap_bucket(self, state: _FleetState,
+                     key: TenantShape) -> _ShapBucket:
+        """The resident SHAP mega-pack paired with ``key``'s bucket in
+        ``state`` — built lazily on the FIRST explain after a publish
+        (score-only traffic never pays for path packing), cached until
+        any member's generation moves (``token``), and re-made resident
+        after an HBM eviction by ONE bit-exact re-upload of the
+        CRC-verified retained host pack (a failed CRC means the host
+        bytes rotted: full re-assembly from the tenants' models)."""
+        b = state.buckets[key]
+        token = tuple((m, state.routes[m].generation.version)
+                      for m in b.members)
+        sb = self._shap_cache.get(key)
+        if sb is not None and sb.token == token and \
+                (sb.dev is not None or sb.host is None):
+            return sb
+        with self._publish_lock:
+            sb = self._shap_cache.get(key)
+            rebuild = sb is not None and sb.token == token
+            if not rebuild:
+                sb = self._assemble_shap_host(key, b, state.routes)
+            elif sb.dev is not None or sb.host is None:
+                return sb          # raced another builder
+            elif integrity.crc32_fingerprint(sb.host) != sb.host_crc:
+                self.counters.inc("integrity_mismatches")
+                log.warning(
+                    f"fleet SHAP pack rebuild refused for members "
+                    f"{tuple(m for m, _v in sb.token)}: retained host "
+                    "pack failed its CRC fingerprint — re-assembling "
+                    "from the tenants' models")
+                sb = self._assemble_shap_host(key, b, state.routes)
+            if sb.host is None:    # every member blocked
+                self._shap_cache[key] = sb
+                return sb
+            if self._mem_budget > 0:
+                resident = sum(
+                    x.nbytes for x in self._state.buckets.values()
+                    if x.dev is not None)
+                resident += sum(
+                    x.nbytes for x in self._shap_cache.values()
+                    if x.dev is not None)
+                self._evict_shap(
+                    resident + sb.nbytes - self._mem_budget,
+                    keep={key})
+            try:
+                dev = forest.upload_window(sb.host)
+            except BaseException as e:  # noqa: BLE001 — classify
+                if not is_oom_error(e) or not self._evict_shap(1,
+                                                               keep={key}):
+                    raise
+                log.warning(
+                    f"fleet SHAP pack upload OOM ({e!r}); retrying "
+                    "after evicting the coldest resident SHAP pack")
+                dev = forest.upload_window(sb.host)
+            if sb.device is not None:
+                dev = mesh_mod.place_on(dev, sb.device)
+            else:
+                dev = mesh_mod.replicate(dev, self.mesh)
+            nb = sb._replace(dev=dev)
+            self._shap_cache[key] = nb    # GIL-atomic store
+            if rebuild:
+                self.counters.inc("rebuilds")
+                log.info(f"fleet SHAP pack rebuilt after eviction "
+                         f"({nb.nbytes / 1e6:.2f} MB, members "
+                         f"{tuple(m for m, _v in nb.token)})")
+            return nb
+
+    def _group_contrib(self, sb: _ShapBucket, items) -> list:
+        """The PURE explain dispatch math for one resident SHAP bucket
+        group: per-item [n, (F_t+1)*k] f64 contribution blocks in item
+        order (members' phi widths differ, so the shared ``phi_cap``
+        accumulator is sliced per tenant on the host — an on-device
+        slice would retrace per width)."""
+        key = sb.key
+        total = sum(r.n for _i, r, _route in items)
+        rows = forest.bucket_rows(total) if self.bucket else total
+        lo = np.zeros(rows, np.int32)
+        nl = np.zeros(rows, np.int32)
+        if key.kind == "binned":
+            operand = np.zeros((key.feat_cap, rows), np.int32)
+        else:
+            operand = np.zeros((key.feat_cap, rows), np.float32)
+        off = 0
+        for _i, r, route in items:
+            n = r.n
+            lo[off:off + n] = route.lo
+            nl[off:off + n] = route.n_trees
+            if key.kind == "binned":
+                operand[:len(route.mappers), off:off + n] = \
+                    _host_bins(route, r.X)
+            else:
+                operand[:r.X.shape[1], off:off + n] = \
+                    r.X.T.astype(np.float32)
+            off += n
+        lo_d, nl_d, op_d = jnp.asarray(lo), jnp.asarray(nl), \
+            jnp.asarray(operand)
+        if sb.device is not None:
+            lo_d = mesh_mod.place_on(lo_d, sb.device)
+            nl_d = mesh_mod.place_on(nl_d, sb.device)
+            op_d = mesh_mod.place_on(op_d, sb.device)
+        elif self.mesh is not None:
+            lo_d = mesh_mod.shard_rows(lo_d, 0, self.mesh)
+            nl_d = mesh_mod.shard_rows(nl_d, 0, self.mesh)
+            op_d = mesh_mod.shard_rows(op_d, 1, self.mesh)
+        run = (shap_pack._fleet_shap_binned if key.kind == "binned"
+               else shap_pack._fleet_shap_raw)
+        out = mesh_mod.locked_launch(
+            self.mesh if sb.device is None else None, run,
+            sb.phi_cap, key.k, key.win_slots, sb.dev, lo_d, nl_d, op_d)
+        # pad slice + per-tenant width slice on the HOST
+        host = np.asarray(out, np.float64)[:, :, :total]  # [k, phi, R]
+        host = np.ascontiguousarray(host.transpose(2, 0, 1))
+        vals, off = [], 0
+        for _i, r, route in items:
+            seg = host[off:off + r.n, :, :route.n_features + 1]
+            vals.append(np.ascontiguousarray(seg).reshape(r.n, -1))
+            off += r.n
+        return vals
+
+    def _bucket_contrib(self, state: _FleetState, key: TenantShape,
+                        items) -> list:
+        """One device attempt at an explain bucket group. Same fault
+        sites as ``_bucket_scores`` — an injected outage or OOM plan
+        must bite the explain route identically; an evicted SHAP pack
+        is lazily made resident first."""
+        faults.maybe_delay("slow_dispatch")
+        faults.maybe_fail("dispatch_error")
+        faults.maybe_fail("oom")
+        sb = self._shap_bucket(state, key)
+        return self._group_contrib(sb, items)
+
+    def _host_contrib(self, route: TenantRoute, X: np.ndarray
+                      ) -> np.ndarray:
+        """[R, (F+1)*K] f64 contributions by the tenant's HOST TreeSHAP
+        walk (server.host_contrib_scores — ONE copy with the solo
+        server), bit-identical to its own
+        ``Booster.predict(pred_contrib=True)``."""
+        return host_contrib_scores(route.models, route.k,
+                                   route.n_features, X)
+
+    def _adaptive_group_contrib(self, state: _FleetState,
+                                key: TenantShape, items) -> list:
+        """Explain-group dispatch with the OOM bisection ladder — the
+        explain analogue of ``_adaptive_group_scores`` (sub-groups
+        rejoin the same pow2/octave row-bucket family: zero new
+        steady-state traces). A single request that still OOMs is
+        answered by the host oracle alone (or refused when the
+        fallback knob says so); RetryError propagates to the caller's
+        degrade path."""
+        try:
+            return retry_call(
+                self._bucket_contrib, state, key, items,
+                policy=self._retry_policy, what="fleet explain dispatch",
+                on_retry=lambda _a, _e:
+                    self.counters.inc("dispatch_retries"))
+        except RetryError:
+            raise
+        except BaseException as e:  # noqa: BLE001 — classifier decides
+            if not is_oom_error(e):
+                raise
+            if len(items) > 1:
+                self.counters.inc("oom_bisects")
+                mid = len(items) // 2
+                log.warning(
+                    f"fleet explain dispatch OOM over {len(items)} "
+                    f"requests ({e!r}); bisecting into "
+                    f"{mid}+{len(items) - mid}")
+                return (self._adaptive_group_contrib(state, key,
+                                                     items[:mid])
+                        + self._adaptive_group_contrib(state, key,
+                                                       items[mid:]))
+            if self._explain_refuse:
+                raise
+            _i, r, route = items[0]
+            log.warning(
+                f"fleet explain dispatch OOM at the single-request "
+                f"floor ({e!r}); host predict_contrib for tenant "
+                f"{route.name!r}'s rows only")
+            return [self._host_contrib(route, r.X)]
+
+    def _dispatch_explain_many(self, batch: List[PendingRequest]
+                               ) -> list:
+        """Serve one coalesced cross-tenant EXPLAIN batch: group by
+        shape bucket, one SHAP-kernel dispatch per group against ONE
+        fleet state. Quarantined (ISSUE 19), device-ineligible or
+        fleet-degraded tenants answer by the host ``predict_contrib``
+        oracle — counted per tenant as ``explain_degraded`` — or are
+        refused when ``tpu_serving_explain_fallback="refuse"``; every
+        fulfilled contrib request counts ``explain_requests``."""
+        state = self._state            # single read: atomic pairing
+        q = self._quarantined          # single read: GIL-atomic
+        degraded = self._degrade.degraded
+        outcomes: list = [None] * len(batch)
+        groups: Dict[TenantShape, list] = {}
+        oracle: list = []              # (i, r, route, why)
+        for i, r in enumerate(batch):
+            route = state.routes.get(r.tenant)
+            if route is None:
+                outcomes[i] = KeyError(
+                    f"tenant {r.tenant!r} was removed before dispatch")
+                continue
+            block = self._explain_blocked(route)
+            if block is not None:
+                oracle.append((i, r, route,
+                               f"not device-explainable: {block}"))
+            elif degraded or route.name in q:
+                oracle.append((i, r, route,
+                               "tenant quarantined" if route.name in q
+                               else "fleet degraded"))
+            else:
+                groups.setdefault(route.key, []).append((i, r, route))
+        for key in groups:
+            # explain LRU signal (dispatcher thread only)
+            self._touch_seq += 1
+            self._shap_touch[key] = self._touch_seq
+        for key, items in groups.items():
+            try:
+                vals = self._adaptive_group_contrib(state, key, items)
+            except RetryError as e:
+                self.counters.inc("dispatch_failures")
+                self._degrade.enter(
+                    f"explain dispatch retry budget exhausted: "
+                    f"{e.last!r}")
+                for i, r, route in items:
+                    oracle.append((i, r, route,
+                                   "retry budget exhausted"))
+                continue
+            except BaseException as e:  # noqa: BLE001 — group-scoped
+                for i, _r, _route in items:
+                    outcomes[i] = e
+                continue
+            for (i, r, _route), v in zip(items, vals):
+                outcomes[i] = (v, _route.generation)
+                self.counters.inc("explain_requests", tenant=r.tenant)
+        for i, r, route, why in oracle:
+            if self._explain_refuse:
+                outcomes[i] = RuntimeError(
+                    "explanation serving unavailable "
+                    f"(fallback='refuse') for tenant {route.name!r}: "
+                    f"{why}")
+                continue
+            try:
+                outcomes[i] = (self._host_contrib(route, r.X),
+                               route.generation)
+            except BaseException as e:  # noqa: BLE001 — per-request
+                outcomes[i] = e
+                continue
+            self.counters.inc("explain_requests", tenant=r.tenant)
+            self.counters.inc("explain_degraded", tenant=r.tenant)
+        return outcomes
 
     # ---- degradation / lifecycle ------------------------------------
     def degrade(self, reason: str = "forced") -> None:
@@ -1218,6 +1673,9 @@ class FleetServer:
             b.nbytes for b in state.buckets.values() if b.dev is not None)
         s["evicted_buckets"] = sum(
             1 for b in state.buckets.values() if b.dev is None)
+        s["resident_shap_bytes"] = sum(
+            sb.nbytes for sb in self._shap_cache.values()
+            if sb.dev is not None)
         s["mem_budget_mb"] = self._mem_budget / 1e6
         s["mesh_devices"] = (self.mesh.shape[mesh_mod.SERVE_AXIS]
                              if self.mesh is not None else 1)
@@ -1230,6 +1688,11 @@ class FleetServer:
             s["integrity_probe_interval_s"] = self._integrity_interval
         if self._quarantined:
             s["quarantined"] = sorted(self._quarantined)
+        eb = self._explain_batcher
+        s["explain"] = {"requests": eb.n_requests, "rows": eb.n_rows,
+                        "batches": eb.n_batches,
+                        "max_coalesced": eb.max_coalesced,
+                        **eb.latency.summary_ms()}
         return s
 
     def tenant_stats(self, name: str) -> dict:
@@ -1264,6 +1727,7 @@ class FleetServer:
         if self._iprobe is not None:
             self._iprobe.close()
         self._degrade.close()
+        self._explain_batcher.close(timeout)
         self._batcher.close(timeout)
 
     def __enter__(self) -> "FleetServer":

@@ -115,16 +115,21 @@ class ServingCounters:
              "degrade_events", "recoveries", "degraded_batches",
              "publish_failures", "shutdown_failed", "oom_bisects",
              "evictions", "rebuilds", "integrity_probes",
-             "integrity_mismatches", "quarantines", "repairs")
+             "integrity_mismatches", "quarantines", "repairs",
+             "explain_requests", "explain_degraded")
     # the per-tenant ledger: request/row volume plus every failure-path
     # event that is attributable to ONE tenant (retry/degrade/recovery
     # events are fleet-wide device state, deliberately not per-tenant;
     # integrity mismatch/quarantine/repair ARE per-tenant — the whole
-    # point of the canary is blaming exactly one route)
+    # point of the canary is blaming exactly one route).
+    # Explanation serving (ISSUE 20) adds ``explain_requests`` (contrib
+    # requests fulfilled, device or host) and ``explain_degraded``
+    # (contrib requests answered by the host predict_contrib oracle).
     TENANT_NAMES = ("requests", "rows", "expired", "shed",
                     "degraded_batches", "dispatch_failures",
                     "publish_failures", "shutdown_failed",
-                    "integrity_mismatches", "quarantines", "repairs")
+                    "integrity_mismatches", "quarantines", "repairs",
+                    "explain_requests", "explain_degraded")
 
     def __init__(self):
         self._lock = threading.Lock()

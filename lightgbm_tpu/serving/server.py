@@ -40,6 +40,17 @@ failure behavior:
   generation intact and the version counter untouched — rollback,
   never a torn pack.
 
+Explanation serving (ISSUE 20): ``submit(kind="contrib")`` /
+``explain()`` coalesce SHAP-contribution requests into their OWN
+micro-batcher — a [rows, (F+1)*K] contribution output must never share
+a dispatch with [rows, K] scores — riding the same deadline, admission,
+retry-then-degrade and OOM-bisection machinery. The explanation
+snapshot (packed path tensors, ops/shap_pack.py) is built lazily on the
+first explain after a publish, so predict-only traffic never pays for
+path packing; the degrade fallback is the host ``predict_contrib`` walk
+(core/shap.py) — the bit-anchoring oracle the device kernel is
+validated against.
+
 The reference's serving analogue is an OMP row-parallel pointer walk per
 process (src/application/predictor.hpp:31); this is the batch-coalescing
 device-dispatch counterpart the TPU needs (per-request dispatch would be
@@ -56,7 +67,7 @@ import numpy as np
 from . import mesh as mesh_mod
 from .batcher import MicroBatcher, PendingRequest
 from .metrics import ServingCounters
-from ..ops import forest
+from ..ops import forest, shap_pack
 from ..robustness import faults, integrity
 from ..robustness.retry import (RetryError, RetryPolicy, SERVING_POLICY,
                                 is_oom_error, retry_call)
@@ -83,6 +94,29 @@ def host_walk_scores(models, k: int, X: np.ndarray) -> np.ndarray:
     for i, t in enumerate(models):
         raw[:, i % max(int(k), 1)] += t.predict(X)
     return raw
+
+
+class _FrozenModels(NamedTuple):
+    """Just enough engine surface for ``core.shap.predict_contrib`` over
+    a FROZEN published model list (the live engine keeps training while
+    the snapshot's generation serves)."""
+    models: tuple
+    num_tree_per_iteration: int
+    max_feature_idx: int
+
+
+def host_contrib_scores(models, k: int, n_features: int,
+                        X: np.ndarray) -> np.ndarray:
+    """[R, (F+1)*K] f64 SHAP contributions by the HOST TreeSHAP walk
+    (``core.shap.predict_contrib``, the exact-in-f64 recursion) — the
+    explanation route's degrade oracle, bit-identical to
+    ``Booster.predict(pred_contrib=True)`` on the same frozen trees.
+    ONE copy shared by the single-model and fleet servers, for the same
+    reason as ``host_walk_scores``."""
+    from ..core.shap import predict_contrib
+    kk = max(int(k), 1)
+    eng = _FrozenModels(tuple(models), kk, int(n_features) - 1)
+    return predict_contrib(eng, X, 0, len(models) // kk)
 
 
 def finish_scores(raw: np.ndarray, k: int, n_trees: int,
@@ -277,6 +311,14 @@ class ModelServer:
                                                 rows=self._canary_rows)
         self._canary = None   # (golden [rows, K], version) — ONE ref
         self._integrity_quarantined = False
+        # explanation route state (ISSUE 20), all set by publish():
+        # the bin mappers frozen WITH the active generation, the lazy
+        # SHAP snapshot cache (snapshot, version), and the device
+        # eligibility verdict (None = explainable; else the reason the
+        # host oracle serves instead)
+        self._route_maps = (None, None)
+        self._shap_snap = None
+        self._explain_block: Optional[str] = None
         self.publish()
         self._iprobe = None
         if self._integrity_interval > 0:
@@ -293,6 +335,28 @@ class ModelServer:
                                     "tpu_serving_max_queue_rows",
                                     1_048_576)),
             counters=self.counters)
+        # explanation serving (ISSUE 20): contrib requests coalesce in
+        # their OWN batcher — a [rows, (F+1)*K] output shape must never
+        # share a dispatch with [rows, K] scores — GROUPED so the
+        # explain ledger counts exact per-request fulfillment. The
+        # smaller max_batch default reflects the SHAP kernel's
+        # [leaves, depth, rows] working set (~40x a predict dispatch
+        # per row at the bench shape).
+        self.explain_deadline_ms = float(knob(
+            None, "tpu_serving_explain_deadline_ms", 0.0))
+        self._explain_refuse = str(knob(
+            None, "tpu_serving_explain_fallback", "host")) == "refuse"
+        self._explain_batcher = MicroBatcher(
+            self._dispatch_explain,
+            max_batch=int(knob(None, "tpu_serving_explain_max_batch",
+                               1024)),
+            linger_ms=float(knob(None, "tpu_serving_explain_linger_ms",
+                                 2.0)),
+            queue_depth=int(knob(queue_depth, "tpu_serving_queue_depth",
+                                 8192)),
+            max_queue_rows=int(knob(
+                None, "tpu_serving_explain_max_queue_rows", 262_144)),
+            counters=self.counters, grouped=True)
 
     # ---- hot-swap ----------------------------------------------------
     def publish(self) -> Generation:
@@ -370,6 +434,38 @@ class ModelServer:
             # the host model list rides along so the degraded host-walk
             # route serves the SAME frozen generation the snapshot does
             self._active = (snap, info, models)  # GIL-atomic ref swap
+            # invalidate the lazy explanation snapshot (rebuilt on the
+            # first explain of this generation — predict-only traffic
+            # never pays for path packing) and refresh the device
+            # eligibility verdict for the frozen model list
+            self._route_maps = (mappers, used_map)
+            prev_shap = self._shap_snap
+            self._shap_snap = None
+            try:
+                shap_pack.check_explainable(models)
+                self._explain_block = None
+            except ValueError as e:
+                self._explain_block = str(e)
+            else:
+                if prev_shap is not None:
+                    # explain traffic is live: pay the path-pack append
+                    # HERE, at publish, so the first post-swap explain
+                    # stays on the compiled kernel (the pow2-padded
+                    # window keeps its shape inside the slot cap). Best
+                    # effort — a failure falls back to the lazy rebuild,
+                    # never fails an already-committed publish.
+                    try:
+                        snap2 = self._srv.snapshot_shap(
+                            models, gen, 0, len(models), self.n_features,
+                            mappers, used_map,
+                            place_window=lambda w: mesh_mod.replicate(
+                                w, self.mesh))
+                        self._shap_snap = (snap2, self._version)
+                    except BaseException as e:  # noqa: BLE001
+                        log.warning(
+                            "publish-time explanation snapshot rebuild "
+                            f"failed ({e!r}); deferring to the lazy "
+                            "first-explain rebuild")
             return info
 
     @property
@@ -471,6 +567,141 @@ class ModelServer:
             return self._finish(self._host_scores(models, X), info)
         return self._finish(raw, info)
 
+    # ---- explanation route (ISSUE 20) -------------------------------
+    def _shap_snapshot(self, info: Generation, models):
+        """The explanation snapshot paired with generation ``info`` —
+        built lazily on the FIRST explain after a publish (predict-only
+        traffic never pays for SHAP path packing) under the publish
+        lock (the path-pack sync must not race a publish's engine
+        read), then cached until the next publish invalidates it."""
+        cached = self._shap_snap
+        if cached is not None and cached[1] == info.version:
+            return cached[0]
+        with self._publish_lock:
+            cached = self._shap_snap
+            if cached is not None and cached[1] == info.version:
+                return cached[0]
+            mappers, used_map = self._route_maps
+            snap = self._srv.snapshot_shap(
+                models, info.model_gen, 0, info.num_trees,
+                self.n_features, mappers, used_map,
+                place_window=lambda w: mesh_mod.replicate(w, self.mesh))
+            self._shap_snap = (snap, info.version)  # GIL-atomic
+            return snap
+
+    def _device_contrib(self, snap, X: np.ndarray) -> np.ndarray:
+        """One device attempt at explaining a batch: [R, (F+1)*K] f64
+        contributions. Consults the SAME fault sites as
+        ``_device_scores`` — an injected outage or OOM plan must bite
+        the explain route identically."""
+        faults.maybe_delay("slow_dispatch")
+        faults.maybe_fail("dispatch_error")
+        faults.maybe_fail("oom")
+        place = None
+        if self.mesh is not None:
+            place = lambda a, ax: mesh_mod.shard_rows(a, ax, self.mesh)  # noqa: E731
+        return mesh_mod.locked_launch(
+            self.mesh, shap_pack.shap_snapshot_scores, snap, X, place)
+
+    def _host_contrib(self, models, X: np.ndarray) -> np.ndarray:
+        return host_contrib_scores(models, self.k, self.n_features, X)
+
+    def _adaptive_contrib(self, snap, models, X: np.ndarray) -> np.ndarray:
+        """Device explanation with the OOM bisection ladder — the
+        explain analogue of ``_adaptive_scores`` (halves rejoin the
+        same pow2/octave row-bucket family, so steady-state bisection
+        costs zero new traces); rows that still OOM at the floor are
+        served by the host ``predict_contrib`` oracle."""
+        try:
+            return retry_call(
+                self._device_contrib, snap, X,
+                policy=self._retry_policy, what="explain dispatch",
+                on_retry=lambda _a, _e:
+                    self.counters.inc("dispatch_retries"))
+        except RetryError:
+            raise
+        except BaseException as e:  # noqa: BLE001 — classifier decides
+            if not is_oom_error(e):
+                raise
+            n = int(X.shape[0])
+            if n > forest.ROW_BUCKET_MIN:
+                self.counters.inc("oom_bisects")
+                mid = n // 2
+                log.warning(
+                    f"explain dispatch OOM at {n} rows ({e!r}); "
+                    f"bisecting into {mid}+{n - mid} and retrying")
+                return np.concatenate(
+                    [self._adaptive_contrib(snap, models, X[:mid]),
+                     self._adaptive_contrib(snap, models, X[mid:])],
+                    axis=0)
+            if self._explain_refuse:
+                raise
+            log.warning(
+                f"explain dispatch OOM at the {n}-row bisection floor "
+                f"({e!r}); host-walking ONLY these rows")
+            return self._host_contrib(models, X)
+
+    def _explain_scores(self, info: Generation, models, X: np.ndarray):
+        """([R, (F+1)*K] f64 contributions, served_by_host_oracle) for
+        one coalesced explain batch. Device route unless the model is
+        ineligible (linear trees / categorical splits — outside the
+        packed path tensors), the server is degraded or quarantined, or
+        the retry budget exhausts; the fallback is the host
+        ``predict_contrib`` oracle, or a loud refusal when
+        ``tpu_serving_explain_fallback="refuse"``."""
+        if self._explain_block is not None:
+            if self._explain_refuse:
+                raise RuntimeError(
+                    "explanation serving unavailable "
+                    f"(fallback='refuse'): {self._explain_block}")
+            log.info_once(
+                "explanation serving: model is not device-explainable "
+                f"({self._explain_block}); serving the host "
+                "predict_contrib walk instead")
+            return self._host_contrib(models, X), True
+        if self._degrade.degraded:
+            if self._explain_refuse:
+                raise RuntimeError(
+                    "explanation serving unavailable "
+                    f"(fallback='refuse'): server degraded: "
+                    f"{self._degrade.reason}")
+            return self._host_contrib(models, X), True
+        try:
+            snap = self._shap_snapshot(info, models)
+            return self._adaptive_contrib(snap, models, X), False
+        except RetryError as e:
+            self.counters.inc("dispatch_failures")
+            self._degrade.enter(
+                f"explain dispatch retry budget exhausted: {e.last!r}")
+            if self._explain_refuse:
+                raise RuntimeError(
+                    "explanation serving unavailable "
+                    f"(fallback='refuse'): {e.last!r}") from e
+            return self._host_contrib(models, X), True
+
+    def _dispatch_explain(self, batch):
+        """Explain ONE coalesced contrib batch against exactly one
+        snapshot (grouped mode: one outcome per request, exact
+        ``explain_requests``/``explain_degraded`` accounting). Same
+        snapshot-pairing, retry, OOM-bisection and degrade discipline
+        as ``_dispatch``, but the fallback truth is the host
+        ``predict_contrib`` oracle."""
+        _snap, info, models = self._active  # single read: atomic pairing
+        X = batch[0].X if len(batch) == 1 else \
+            np.concatenate([r.X for r in batch], axis=0)
+        try:
+            contrib, by_host = self._explain_scores(info, models, X)
+        except BaseException as e:  # noqa: BLE001 — settle per request
+            return [e] * len(batch)
+        self.counters.inc("explain_requests", len(batch))
+        if by_host:
+            self.counters.inc("explain_degraded", len(batch))
+        out, off = [], 0
+        for r in batch:
+            out.append((contrib[off:off + r.n], info))
+            off += r.n
+        return out
+
     # ---- integrity (ISSUE 19) ---------------------------------------
     def _canary_replay(self, snap) -> np.ndarray:
         """[rows, K] device scores of the fixed canary batch against
@@ -561,8 +792,8 @@ class ModelServer:
             self._integrity_quarantined = False
             self.counters.inc("repairs")
 
-    def submit(self, X,
-               deadline_ms: Optional[float] = None) -> PendingRequest:
+    def submit(self, X, deadline_ms: Optional[float] = None,
+               kind: str = "score") -> PendingRequest:
         """Enqueue one [rows, features] request; returns a handle whose
         ``result()`` blocks and whose ``generation`` names the snapshot
         that served it. ``deadline_ms`` (default
@@ -572,10 +803,20 @@ class ModelServer:
         queue (``max_queue_rows``) raises ``Overloaded`` here instead
         of accepting work the server cannot serve.
 
+        ``kind="contrib"`` (ISSUE 20) requests SHAP contributions
+        ([rows, (F+1)*K], reference ``pred_contrib`` layout) instead of
+        scores; it rides the explain batcher — its own coalescing,
+        linger and admission knobs (``tpu_serving_explain_*``), default
+        deadline ``tpu_serving_explain_deadline_ms`` — so explanation
+        traffic never perturbs a predict dispatch's shape.
+
         Per-request validation happens HERE (shape, and the raw route's
         f32-representability contract) so one malformed request raises
         to its own submitter instead of failing the whole coalesced
         batch it would have joined."""
+        if kind not in ("score", "contrib"):
+            raise ValueError(f"unknown request kind {kind!r} "
+                             "(expected 'score' or 'contrib')")
         X = np.ascontiguousarray(np.asarray(X, np.float64))
         if X.ndim != 2 or X.shape[1] != self.n_features:
             raise ValueError(
@@ -591,6 +832,12 @@ class ModelServer:
                     f"requests ({int((~f32_ok).sum())} value(s) are "
                     "f64-only and could cross a split threshold under "
                     "f32 rounding)")
+        if kind == "contrib":
+            dl = self.explain_deadline_ms if deadline_ms is None \
+                else float(deadline_ms)
+            return self._explain_batcher.submit(
+                X, deadline_sec=(dl / 1e3 if dl and dl > 0 else None),
+                kind="contrib")
         dl = self.deadline_ms if deadline_ms is None else float(deadline_ms)
         return self._batcher.submit(
             X, deadline_sec=(dl / 1e3 if dl and dl > 0 else None))
@@ -604,6 +851,17 @@ class ModelServer:
         into the void and held its slot the whole time)."""
         dl_ms = None if timeout is None else timeout * 1e3
         return self.submit(X, deadline_ms=dl_ms).result(timeout)
+
+    def explain(self, X, timeout: Optional[float] = None) -> np.ndarray:
+        """Sync sugar for the explanation route (ISSUE 20): SHAP
+        contributions [rows, (num_features + 1) * K] in the reference
+        ``pred_contrib`` layout (per-class blocks of F+1, bias last),
+        served by the packed-path device kernel with the host
+        ``predict_contrib`` walk as the degrade oracle. Additivity
+        holds per row: contributions + bias sum to the raw score."""
+        dl_ms = None if timeout is None else timeout * 1e3
+        return self.submit(X, deadline_ms=dl_ms,
+                           kind="contrib").result(timeout)
 
     # ---- lifecycle / observability ----------------------------------
     def stats(self) -> dict:
@@ -622,6 +880,11 @@ class ModelServer:
             s["integrity_probe_interval_s"] = self._integrity_interval
             if self._integrity_quarantined:
                 s["integrity_quarantined"] = True
+        eb = self._explain_batcher
+        s["explain"] = {"requests": eb.n_requests, "rows": eb.n_rows,
+                        "batches": eb.n_batches,
+                        "max_coalesced": eb.max_coalesced,
+                        **eb.latency.summary_ms()}
         return s
 
     @property
@@ -640,6 +903,7 @@ class ModelServer:
         if self._iprobe is not None:
             self._iprobe.close()    # before the drain: no probe replay
         self._degrade.close()       # before the drain: no new probe
+        self._explain_batcher.close(timeout)
         self._batcher.close(timeout)
 
     def __enter__(self) -> "ModelServer":

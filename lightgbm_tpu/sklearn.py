@@ -316,7 +316,10 @@ class LGBMModel(_SKBase):
         (batched device traversal, ISSUE 5) — identical split decisions
         to the host walk, f32 leaf accumulation; shapes the engine cannot
         serve fall back to the host path with a warning. ``None`` defers
-        to the ``tpu_predict_device`` parameter."""
+        to the ``tpu_predict_device`` parameter. With
+        ``pred_contrib=True`` the same flag selects the packed SHAP path
+        tensors (ISSUE 20) — f32-accumulated device TreeSHAP; linear /
+        categorical models fall back to the host walk loudly once."""
         if self._Booster is None:
             raise LightGBMError(
                 "Estimator not fitted, call fit before predict")

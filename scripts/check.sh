@@ -240,6 +240,21 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
+echo "== shap smoke (device TreeSHAP parity + hot-swap 0-retrace, 2-dev CPU) =="
+# ISSUE 20: device explanations through the packed path tensors must
+# match the f64 host predict_contrib walk (NaN/0/±inf batch) and sum
+# to the raw score per row; served explain() responses are
+# bit-identical to the direct device path; mixed-size explain bursts
+# across one in-window hot-swap (publish inside the pow2 tree-slot
+# cap) compile NOTHING; a degraded server answers explain requests
+# with the host-oracle bits and recovers to device bits.
+timeout -k 10 90 env JAX_PLATFORMS=cpu \
+    python scripts/shap_smoke.py || rc=1
+if [ $rc -ne 0 ]; then
+    echo "check.sh: shap smoke failed — skipping tier-1 pytest" >&2
+    exit $rc
+fi
+
 echo "== hybrid-path dispatch guards (compile budget + O(levels) shape) =="
 # the round-7 hot path: steady-state hybrid training must stay <=2
 # recompiles over 5 iterations and the level phase must issue

@@ -94,6 +94,19 @@ accounting is EXACT through the same stats() the front door serves as
 final model BIT-IDENTICAL to the fault-free run. Banks
 ``bench_logs/SERVING_INTEGRITY.json``.
 
+Explain mode (``--explain``, ISSUE 20): the explanation-serving gate —
+device-vs-host SHAP contribution throughput through the packed path
+tensors (the >=3x target enforced on a real accelerator; recorded only
+under virtual CPU devices, where the "device" kernel and the native C++
+host oracle share the same silicon), a mixed predict+explain open-loop
+leg through ONE solo server (0 torn responses against banked device /
+host-oracle bits, 0 new steady-state traces over the warmed window, and
+EXACT batcher-ledger separation — the proof score and contrib requests
+never share a coalesced batch), and a two-tenant fleet leg with one
+tenant quarantined mid-run (host-oracle bits, exact per-tenant
+``explain_requests`` / ``explain_degraded`` accounting). Banks
+``bench_logs/SERVING_SHAP.json``.
+
 Usage:
   python scripts/serving_load.py [--clients 8] [--rows 64]
       [--duration 10] [--mode closed|open] [--rate 200]
@@ -101,7 +114,8 @@ Usage:
       [--publish-every 0] [--skip-native] [--deadline-ms 0]
       [--max-queue-rows 0] [--chaos] [--chaos-p999-ms 10000]
       [--fleet N] [--fleet-rows 3000] [--live] [--live-crash-iter 6]
-      [--mem-chaos] [--integrity-chaos]
+      [--mem-chaos] [--integrity-chaos] [--explain]
+      [--explain-rate 16] [--explain-frac 0.3]
 
 --devices D > 1 on a CPU host re-execs with D virtual XLA devices;
 an already-set JAX_PLATFORMS (e.g. a TPU session) is honored.
@@ -125,6 +139,7 @@ OUT_FLEET = os.path.join(REPO, "bench_logs", "SERVING_FLEET.json")
 OUT_LIVE = os.path.join(REPO, "bench_logs", "SERVING_LIVE.json")
 OUT_MEM = os.path.join(REPO, "bench_logs", "SERVING_MEM.json")
 OUT_INTEGRITY = os.path.join(REPO, "bench_logs", "SERVING_INTEGRITY.json")
+OUT_SHAP = os.path.join(REPO, "bench_logs", "SERVING_SHAP.json")
 
 
 def parse_args(argv=None):
@@ -192,6 +207,19 @@ def parse_args(argv=None):
                          "(detect / quarantine / repair) + a nan_grad-"
                          "poisoned trainer rollback proof; banks "
                          "SERVING_INTEGRITY.json")
+    ap.add_argument("--explain", action="store_true",
+                    help="ISSUE 20 explanation-serving gate: device-vs-"
+                         "host SHAP throughput, a mixed predict+explain "
+                         "open-loop leg (independent coalescing, 0 torn, "
+                         "0 new steady-state traces, exact explain "
+                         "accounting) and a per-tenant fleet leg; banks "
+                         "SERVING_SHAP.json")
+    ap.add_argument("--explain-rate", type=float, default=16.0,
+                    help="explain mode: total open-loop arrival rate of "
+                         "the mixed leg (req/s)")
+    ap.add_argument("--explain-frac", type=float, default=0.3,
+                    help="explain mode: fraction of mixed-leg arrivals "
+                         "that are contrib requests")
     ap.add_argument("--out", default=None,
                     help="record path (default SERVING_LOAD.json; "
                          "SERVING_CHAOS.json under --chaos / "
@@ -199,15 +227,17 @@ def parse_args(argv=None):
                          "SERVING_LIVE.json under --live / "
                          "SERVING_MEM.json under --mem-chaos / "
                          "SERVING_INTEGRITY.json under "
-                         "--integrity-chaos so the banked throughput "
-                         "record is never clobbered)")
+                         "--integrity-chaos / SERVING_SHAP.json under "
+                         "--explain so the banked throughput record is "
+                         "never clobbered)")
     args = ap.parse_args(argv)
     if args.out is None:
-        args.out = OUT_INTEGRITY if args.integrity_chaos else \
-            (OUT_MEM if args.mem_chaos else
-             (OUT_LIVE if args.live else
-              (OUT_FLEET if args.fleet else
-               (OUT_CHAOS if args.chaos else OUT))))
+        args.out = OUT_SHAP if args.explain else \
+            (OUT_INTEGRITY if args.integrity_chaos else
+             (OUT_MEM if args.mem_chaos else
+              (OUT_LIVE if args.live else
+               (OUT_FLEET if args.fleet else
+                (OUT_CHAOS if args.chaos else OUT)))))
     return args
 
 
@@ -1532,6 +1562,291 @@ def _live_route_body(args, record, svc, rows, append, crash):
     return ("degraded" if record["degraded"] else "measured"), None
 
 
+def explain_route(args, record):
+    """ISSUE 20 explanation-serving gate. Returns (status, note).
+
+    Three legs over a ``--trees x --leaves`` 28-feature model:
+
+    1. **throughput**: device SHAP contributions through the packed
+       path tensors vs the host ``predict_contrib`` walk (the native
+       C++ kernel when built), chunked over 100k-row-scale traffic.
+       The >=3x speedup target is enforced on a REAL accelerator only —
+       under virtual XLA-CPU devices the "device" is the host CPU
+       running a scatter-heavy kernel against the native C++ oracle,
+       so the ratio measures nothing about the TPU route (recorded,
+       not gated).
+    2. **mixed open-loop**: Poisson arrivals, ``--explain-frac`` of
+       them contrib requests, through ONE solo server. Gates: 0 torn
+       responses (every response bit-matches the banked device bits or
+       the host-oracle bits of its kind), 0 new steady-state traces
+       over the warmed window, EXACT accounting — the explain
+       batcher's request/row ledger must equal the client-observed
+       explain traffic and the predict batcher's must equal the
+       predict traffic (the proof the two families never share a
+       coalesced batch), and ``explain_requests``/``explain_degraded``
+       must reconcile exactly.
+    3. **fleet per-tenant**: two tenants, one quarantined mid-leg —
+       its explains must answer the host oracle bit-exactly and land
+       in ITS ledger as ``explain_degraded``; per-tenant
+       ``explain_requests`` accounting must be exact.
+    """
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.analysis import guards
+    from lightgbm_tpu.core.shap import predict_contrib
+    from lightgbm_tpu.serving import Overloaded
+    from lightgbm_tpu.serving.metrics import latency_summary_ms
+
+    rng = np.random.default_rng(0)
+    Xtr = rng.normal(size=(60_000, 28)).astype(np.float32)
+    ytr = (Xtr[:, 0] + 0.5 * Xtr[:, 1] ** 2 > 0.5).astype(np.float32)
+    t0 = time.perf_counter()
+    bst = lgb.train({"objective": "binary", "num_leaves": args.leaves,
+                     "verbosity": -1}, lgb.Dataset(Xtr, label=ytr),
+                    num_boost_round=args.trees,
+                    keep_training_booster=True)
+    print(f"[load] trained {args.trees}x{args.leaves} "
+          f"({time.perf_counter() - t0:.1f}s)", flush=True)
+    pool = np.ascontiguousarray(
+        rng.normal(size=(100_000, 28)).astype(np.float32)
+        .astype(np.float64))
+    failures = []
+
+    def need(cond, what):
+        if not cond:
+            failures.append(what)
+
+    # ---- leg 1: device vs host contribution throughput ---------------
+    import jax
+    on_accelerator = jax.devices()[0].platform not in ("cpu",)
+    chunk = 1024 if not on_accelerator else 8192
+    budget = min(args.duration, 20.0)
+    bst.predict(pool[:chunk], pred_contrib=True, device=True)  # warm
+    dev_lats, dev_rows = [], 0
+    # jaxlint: disable=JL005 — Booster.predict returns a fetched host
+    # numpy array (implicit device sync), so the wall clock brackets
+    # real execution, not just dispatch.
+    t0 = time.perf_counter()
+    off = 0
+    while time.perf_counter() - t0 < budget:
+        tc = time.perf_counter()
+        bst.predict(pool[off:off + chunk], pred_contrib=True,
+                    device=True)
+        dev_lats.append(time.perf_counter() - tc)
+        dev_rows += chunk
+        off = (off + chunk) % (pool.shape[0] - chunk)
+    dev_wall = time.perf_counter() - t0
+    host_lats, host_rows = [], 0
+    t0 = time.perf_counter()
+    off = 0
+    while time.perf_counter() - t0 < budget:
+        tc = time.perf_counter()
+        predict_contrib(bst._engine, pool[off:off + chunk], 0,
+                        args.trees)
+        host_lats.append(time.perf_counter() - tc)
+        host_rows += chunk
+        off = (off + chunk) % (pool.shape[0] - chunk)
+    host_wall = time.perf_counter() - t0
+    dev_rps = dev_rows / dev_wall
+    host_rps = host_rows / host_wall
+    speedup = dev_rps / host_rps if host_rps else 0.0
+    record["throughput"] = {
+        "chunk_rows": chunk,
+        "device_rows_per_sec": round(dev_rps, 1),
+        "host_rows_per_sec": round(host_rps, 1),
+        "speedup": round(speedup, 3), "speedup_target": 3.0,
+        "speedup_gated": on_accelerator,
+        **{f"device_{k}": v
+           for k, v in latency_summary_ms(dev_lats).items()},
+        **{f"host_{k}": v
+           for k, v in latency_summary_ms(host_lats).items()}}
+    gate_note = "gated" if on_accelerator else \
+        "recorded only: virtual CPU devices"
+    print(f"[load] explain throughput: device {dev_rps:.0f} rows/s vs "
+          f"host {host_rps:.0f} rows/s ({speedup:.2f}x, {gate_note})",
+          flush=True)
+    if on_accelerator:
+        need(speedup >= 3.0,
+             f"device/host explain speedup {speedup:.2f}x < 3.0x")
+
+    # ---- leg 2: mixed predict+explain open-loop through one server ---
+    srv = bst.serve(linger_ms=args.linger_ms, max_batch=args.max_batch,
+                    num_devices=args.devices, raw_score=True)
+    Xp = np.ascontiguousarray(pool[:args.rows])
+    # banked references: serving responses must bit-match one of these
+    ref_pred_dev = bst.predict(Xp, device=True, raw_score=True)
+    ref_pred_host = bst.predict(Xp, raw_score=True)
+    ref_exp_dev = srv.explain(Xp, timeout=300)
+    ref_exp_host = predict_contrib(bst._engine, Xp, 0, args.trees)
+    # atol rides above the measured f32 EXTEND/UNWIND drift (~1.5e-5
+    # max abs at 60 trees x 31 leaves); route bugs land orders of
+    # magnitude higher.
+    need(np.allclose(ref_exp_dev, ref_exp_host, rtol=1e-4, atol=1e-4),
+         "device explain bits failed the host-anchor tolerance before "
+         "the measured window")
+    # warm every row bucket coalescing can produce for BOTH kinds —
+    # all the way to each batcher's own coalescing cap (a loaded
+    # machine queues deep enough to hit the cap-sized bucket)
+    score_cap = srv._batcher.max_batch       # coalescing honors the cap
+    explain_cap = srv._explain_batcher.max_batch
+    w = args.rows
+    while w <= score_cap:
+        srv.predict(pool[:w], timeout=300)
+        if w <= explain_cap:
+            srv.explain(pool[:w], timeout=300)
+        w *= 2
+    s_before = srv.stats()
+    c_before = srv.counters.snapshot()
+    sent = {"score": 0, "contrib": 0}
+    fulfilled = {"score": 0, "contrib": 0}
+    shed = {"score": 0, "contrib": 0}
+    torn = 0
+    rgen = random.Random(1)
+    pending, errs, lats = [], [], []
+    with guards.CompileCounter() as counter:
+        t0 = time.perf_counter()
+        next_t = t0
+        while True:
+            next_t += rgen.expovariate(args.explain_rate)
+            if next_t - t0 > args.duration:
+                break
+            now = time.perf_counter()
+            if next_t > now:
+                time.sleep(next_t - now)
+            kind = "contrib" if rgen.random() < args.explain_frac \
+                else "score"
+            try:
+                pending.append(
+                    (next_t, kind, srv.submit(Xp, kind=kind)))
+                sent[kind] += 1
+            except Overloaded:
+                shed[kind] += 1
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+        for intended, kind, fut in pending:
+            try:
+                out = fut.result(timeout=120)
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+                continue
+            lats.append(max(fut.t_done - intended, 0.0))
+            fulfilled[kind] += 1
+            if kind == "score":
+                ok = np.array_equal(out, ref_pred_dev) or \
+                    np.array_equal(out, ref_pred_host)
+            else:
+                ok = np.array_equal(out, ref_exp_dev) or \
+                    np.array_equal(out, ref_exp_host)
+            if not ok:
+                torn += 1
+        wall = time.perf_counter() - t0
+    s_after = srv.stats()
+    c_after = srv.counters.snapshot()
+    srv.close()
+    rec = {"qps": round(len(lats) / wall, 1),
+           "requests": len(lats), "wall_sec": round(wall, 2),
+           "sent": dict(sent), "shed": dict(shed), "torn": torn,
+           "errors": len(errs),
+           "new_traces": counter.count}
+    rec.update(latency_summary_ms(lats))
+    if errs:
+        rec["first_error"] = errs[0]
+    record["mixed_open_loop"] = rec
+    record["value"] = record["throughput"]["device_rows_per_sec"]
+    need(torn == 0, f"{torn} torn/wrong mixed-leg response(s)")
+    need(not errs, f"{len(errs)} hard mixed-leg error(s): {errs[:1]}")
+    need(counter.count == 0,
+         f"{counter.count} new steady-state trace(s): "
+         f"{counter.names[:4]}")
+    # independent coalescing, proven by exact ledger separation: the
+    # explain batcher saw exactly the explain traffic, the score
+    # batcher exactly the score traffic
+    d_exp_req = s_after["explain"]["requests"] - \
+        s_before["explain"]["requests"]
+    d_exp_rows = s_after["explain"]["rows"] - \
+        s_before["explain"]["rows"]
+    d_score_req = (s_after["requests"] - s_before["requests"])
+    d_score_rows = (s_after["rows"] - s_before["rows"])
+    need(d_exp_req == sent["contrib"],
+         f"explain batcher requests {d_exp_req} != "
+         f"client contrib submits {sent['contrib']}")
+    need(d_exp_rows == sent["contrib"] * args.rows,
+         f"explain batcher rows {d_exp_rows} != "
+         f"{sent['contrib']} x {args.rows}")
+    need(d_score_req == sent["score"],
+         f"score batcher requests {d_score_req} != "
+         f"client score submits {sent['score']}")
+    need(d_score_rows == sent["score"] * args.rows,
+         f"score batcher rows {d_score_rows} != "
+         f"{sent['score']} x {args.rows}")
+    need(c_after["explain_requests"] - c_before["explain_requests"]
+         == fulfilled["contrib"],
+         "explain_requests counter != fulfilled contrib requests")
+    need(c_after["explain_degraded"] == c_before["explain_degraded"],
+         "explain_degraded moved in the steady state")
+    print(f"[load] mixed leg: {rec['qps']:.1f} req/s "
+          f"({sent['score']} score + {sent['contrib']} contrib), "
+          f"{torn} torn, {counter.count} new traces, "
+          f"p50={rec.get('p50_ms')}ms p99={rec.get('p99_ms')}ms",
+          flush=True)
+
+    # ---- leg 3: fleet per-tenant explain accounting ------------------
+    tb = {}
+    for i, name in enumerate(("ta", "tb")):
+        y2 = (Xtr[:, 0] * (1 + 0.2 * i) + 0.5 * Xtr[:, 1] ** 2
+              > 0.4).astype(np.float32)
+        tb[name] = lgb.train(
+            {"objective": "binary", "num_leaves": 15, "verbosity": -1},
+            lgb.Dataset(Xtr[:8000], label=y2[:8000]),
+            num_boost_round=8, keep_training_booster=True)
+    fleet = lgb.serve_fleet(dict(tb), raw_score=True,
+                            linger_ms=args.linger_ms,
+                            num_devices=args.devices)
+    n_a, n_b = 7, 5
+    got_a = [fleet.explain("ta", Xp) for _ in range(n_a)]
+    fleet._quarantine("tb", "explain gate drill")
+    got_b = [fleet.explain("tb", Xp) for _ in range(n_b)]
+    fleet_torn = 0
+    ref_a_host = predict_contrib(tb["ta"]._engine, Xp, 0, 8)
+    for out in got_a:
+        if not (np.allclose(out, ref_a_host, rtol=1e-4, atol=1e-5)):
+            fleet_torn += 1
+    ref_b_host = predict_contrib(tb["tb"]._engine, Xp, 0, 8)
+    for out in got_b:
+        if not np.array_equal(out, ref_b_host):
+            fleet_torn += 1
+    led = fleet.counters.tenant_snapshot()
+    fleet.close()
+    record["fleet_leg"] = {
+        "tenants": 2, "explains": {"ta": n_a, "tb": n_b},
+        "torn": fleet_torn,
+        "ledger": {k: {n: led[k][n] for n in
+                       ("explain_requests", "explain_degraded")}
+                   for k in ("ta", "tb")}}
+    need(fleet_torn == 0,
+         f"{fleet_torn} torn fleet-leg response(s) (quarantined "
+         "tenant must serve host-oracle bits)")
+    need(led["ta"]["explain_requests"] == n_a and
+         led["ta"]["explain_degraded"] == 0,
+         f"tenant ta ledger {led['ta']} != {n_a} device explains")
+    need(led["tb"]["explain_requests"] == n_b and
+         led["tb"]["explain_degraded"] == n_b,
+         f"tenant tb ledger {led['tb']} != {n_b} degraded explains")
+    print(f"[load] fleet leg: ta {led['ta']['explain_requests']}/"
+          f"{led['ta']['explain_degraded']} tb "
+          f"{led['tb']['explain_requests']}/"
+          f"{led['tb']['explain_degraded']} (requests/degraded), "
+          f"{fleet_torn} torn", flush=True)
+
+    if failures:
+        record["failures"] = failures
+        for f in failures:
+            print(f"[load] EXPLAIN GATE FAIL: {f}", file=sys.stderr,
+                  flush=True)
+        return "no_result", "; ".join(failures)
+    return "measured", None
+
+
 def route_record(lats, n_done, wall, rows_per_req, errs) -> dict:
     from lightgbm_tpu.serving.metrics import latency_summary_ms
     rec = {"qps": round(n_done / wall, 1),
@@ -1578,6 +1893,16 @@ def main() -> int:
             record["mode"] = "open"
             record["rate"] = args.rate
             status, note = live_route(args, record)
+            return finish(status, note)
+
+        # ---- explain mode (ISSUE 20): SHAP contribution serving -----
+        if args.explain:
+            record["metric"] = "serving_shap_rows_per_sec"
+            record["unit"] = "rows/sec"
+            record["mode"] = "mixed"
+            record["explain_rate"] = args.explain_rate
+            record["explain_frac"] = args.explain_frac
+            status, note = explain_route(args, record)
             return finish(status, note)
 
         # ---- integrity-chaos mode (ISSUE 19): silent corruption -----

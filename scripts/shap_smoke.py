@@ -1,0 +1,155 @@
+"""Explanation-serving smoke gate (ISSUE 20): device TreeSHAP parity,
+the 0-retrace budget across one in-window hot-swap, and the degrade
+round-trip on the explain route — on CPU with 2 VIRTUAL devices so the
+mesh replication + request sharding path is exercised, <30 s.
+
+Asserts, end to end through the public API:
+  1. ``predict(pred_contrib=True, device=True)`` matches the f64 host
+     ``predict_contrib`` walk on a NaN/0/±inf request batch, and every
+     row is ADDITIVE (phi sums to the raw score — the TreeSHAP
+     conservation law on the device accumulation order);
+  2. served ``explain()`` responses are bit-identical to the direct
+     device path, and after warming the row buckets a burst of
+     mixed-size explain requests PLUS one in-window hot-swap
+     (``bst.update()`` + ``srv.publish()`` inside the pow2 tree-slot
+     cap) compiles NOTHING — the incremental SHAP pack appends into the
+     same padded window the warm traces bound;
+  3. a degraded server answers explain requests with the host-oracle
+     BITS (never an error, never a torn mix), accounts them under
+     ``explain_degraded``, and serves device bits again after recovery;
+  4. the decisions-precompute path of the host walk (`predict_contrib`
+     with reusable ``goes_left`` matrices) is bit-identical; its timing
+     is printed for the record (not gated — CPU timing is noisy).
+
+Wired into scripts/check.sh; exits non-zero on the first violated gate.
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=2"
+                           ).strip()
+
+import jax  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+T_START = time.perf_counter()
+BUDGET_SEC = 30.0
+
+
+def check(cond, what):
+    took = time.perf_counter() - T_START
+    if not cond:
+        print(f"shap_smoke: FAIL {what} ({took:.1f}s)", file=sys.stderr)
+        sys.exit(1)
+    print(f"shap_smoke: ok {what} ({took:.1f}s)")
+
+
+def main() -> int:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.analysis import guards
+    from lightgbm_tpu.core.shap import _decisions_all, predict_contrib
+
+    check(len(jax.devices()) == 2, f"2 virtual devices ({jax.devices()})")
+
+    rng = np.random.default_rng(7)
+    n, f = 1200, 8
+    X = rng.normal(size=(n, f)).astype(np.float32).astype(np.float64)
+    X[rng.uniform(size=X.shape) < 0.05] = np.nan
+    y = np.nan_to_num(X[:, 0]) + 0.5 * np.nan_to_num(X[:, 1]) ** 2
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "verbose": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=6,
+                    keep_training_booster=True)
+
+    Xq = X[:320].copy()
+    Xq[:60] = np.nan
+    Xq[60:120] = 0.0
+    Xq[120:160] = np.inf
+    Xq[160:200] = -np.inf
+
+    # -- gate 1: device parity + additivity ---------------------------
+    dev = np.asarray(bst.predict(Xq, pred_contrib=True, device=True))
+    host = np.asarray(predict_contrib(bst._engine, Xq, 0, 6))
+    check(np.allclose(dev, host, rtol=1e-4, atol=1e-5),
+          "device contributions match the f64 host walk (NaN/0/±inf)")
+    raw = bst.predict(Xq, raw_score=True)
+    check(np.allclose(dev.sum(axis=1), raw, rtol=1e-5, atol=1e-5),
+          "per-row additivity (phi sums to the raw score)")
+
+    # -- gate 2: served bits + 0-retrace across an in-window hot-swap --
+    srv = bst.serve(linger_ms=5.0, raw_score=True, num_devices=2)
+    try:
+        got = srv.explain(Xq, timeout=60)
+        check(np.array_equal(np.asarray(got), dev),
+              "served explain() bit-identical to the direct device path")
+        for w in (32, 64, 128, 256, 512):        # warm the row buckets
+            srv.explain(X[:w], timeout=60)
+            srv.predict(X[:w], timeout=60)
+        with guards.CompileCounter() as counter:
+            for m in (48, 200, 96, 130):
+                srv.explain(X[:m], timeout=60)
+        bst.update()                              # 6 -> 7 trees: stays
+        srv.publish()                             # inside the pow2 cap
+        # the publish itself does one-time host pack-append work; the
+        # REQUEST path (first post-swap explain included — the publish
+        # rebuilt the snapshot eagerly) must stay on the compiled
+        # kernels: the pow2-padded window kept its shape.
+        with guards.CompileCounter() as counter2:
+            for m in (70, 256, 500):
+                srv.explain(X[:m], timeout=60)
+        check(counter.count == 0 and counter2.count == 0,
+              "0 new traces over mixed explain sizes, across one "
+              "in-window hot-swap (names="
+              f"{counter.names + counter2.names})")
+        dev7 = np.asarray(bst.predict(Xq, pred_contrib=True,
+                                      device=True))
+        host7 = np.asarray(predict_contrib(bst._engine, Xq, 0, 7))
+        check(np.allclose(dev7, host7, rtol=1e-4, atol=1e-5) and
+              np.array_equal(np.asarray(srv.explain(Xq, timeout=60)),
+                             dev7),
+              "post-publish explain serves the appended-generation bits")
+
+        # -- gate 3: degrade round-trip -------------------------------
+        srv._degrade.enter("shap_smoke degrade drill")
+        before = srv.counters.get("explain_degraded")
+        got_deg = np.asarray(srv.explain(Xq, timeout=60))
+        check(np.array_equal(got_deg, host7),
+              "degraded explain answers the host-oracle BITS")
+        check(srv.counters.get("explain_degraded") > before,
+              "degraded explains accounted under explain_degraded")
+        srv._degrade._evt.clear()                 # manual recovery
+        srv._degrade.reason = None
+        got_rec = np.asarray(srv.explain(Xq, timeout=60))
+        check(np.array_equal(got_rec, dev7),
+              "recovered explain serves device bits again")
+    finally:
+        srv.close()
+
+    # -- gate 4: decisions-precompute bit identity + micro-timing -----
+    eng = bst._engine
+    Xb = X[:800]
+    t0 = time.perf_counter()
+    base = predict_contrib(eng, Xb, 0, 7)
+    t_base = time.perf_counter() - t0
+    dec = {i: _decisions_all(t, Xb) for i, t in enumerate(eng.models)}
+    t0 = time.perf_counter()
+    pre = predict_contrib(eng, Xb, 0, 7, decisions=dec)
+    t_pre = time.perf_counter() - t0
+    check(np.array_equal(np.asarray(base), np.asarray(pre)),
+          "decisions-precompute host walk is bit-identical "
+          f"(base {t_base * 1e3:.0f}ms vs precomputed {t_pre * 1e3:.0f}ms)")
+
+    took = time.perf_counter() - T_START
+    check(took < BUDGET_SEC, f"under the {BUDGET_SEC:.0f}s budget")
+    print(f"shap_smoke: PASS ({took:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
